@@ -1,0 +1,27 @@
+"""spark-rapids-tpu: a TPU-native columnar SQL execution framework.
+
+A ground-up TPU redesign of the capabilities of NVIDIA's RAPIDS Accelerator
+for Apache Spark (the reference implementation surveyed in SURVEY.md):
+Arrow-layout columnar batches resident in TPU HBM as jax Arrays; expression
+and operator kernels compiled by XLA (with Pallas for the hot paths);
+sort-based segmented groupby/join/sort under a static-shape regime; a
+handle-based HBM->host->disk spill framework with split-and-retry
+out-of-core execution; and a partition-exchange shuffle with host-file and
+ICI-collective transports.
+"""
+import jax as _jax
+
+# SQL semantics require 64-bit ints/floats (LongType, DoubleType, decimal64,
+# timestamps); enable before any array is created.
+_jax.config.update("jax_enable_x64", True)
+
+from .columnar import dtypes
+from .columnar.column import Column
+from .columnar.table import Table, Schema, Field
+from .config import TpuConf
+from .session import TpuSession, DataFrame
+from . import functions
+
+__version__ = "0.1.0"
+__all__ = ["TpuSession", "DataFrame", "Table", "Column", "Schema", "Field",
+           "TpuConf", "functions", "dtypes"]

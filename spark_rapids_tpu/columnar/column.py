@@ -1,0 +1,235 @@
+"""Device-resident Arrow-layout column.
+
+The TPU analog of the reference's `GpuColumnVector`
+(reference: sql-plugin/src/main/java/com/nvidia/spark/rapids/GpuColumnVector.java)
+backed by cudf ColumnVector. Here a column is a bundle of jax.Arrays living in
+TPU HBM:
+
+  data     : primary values buffer, shape [capacity] (padded)
+  validity : bool[capacity]; True = valid. Rows >= length are always False.
+  offsets  : int32[capacity+1] for variable-width types (string/binary/list)
+  children : nested child Columns (struct/list)
+
+XLA compiles one program per shape, so capacities are bucketed to powers of
+two (min 128 to match TPU lane width) — this bounds recompilation while
+keeping padding <2x. The logical row count `length` is a host int; kernels
+mask padding rows via `validity`.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import dtypes as dt
+
+__all__ = ["Column", "bucket_capacity", "MIN_CAPACITY"]
+
+MIN_CAPACITY = 128
+
+
+def bucket_capacity(n: int) -> int:
+    """Round n up to the next power of two, with a floor of MIN_CAPACITY."""
+    if n <= MIN_CAPACITY:
+        return MIN_CAPACITY
+    return 1 << (int(n - 1).bit_length())
+
+
+def _pad_to(arr: np.ndarray, capacity: int, fill=0) -> np.ndarray:
+    if arr.shape[0] == capacity:
+        return arr
+    pad = capacity - arr.shape[0]
+    return np.concatenate([arr, np.full((pad,) + arr.shape[1:], fill,
+                                        dtype=arr.dtype)])
+
+
+class Column:
+    """An immutable device column. All device buffers share one capacity."""
+
+    def __init__(self, dtype: dt.DataType, length: int, data, validity,
+                 offsets=None, children: Optional[List["Column"]] = None):
+        self.dtype = dtype
+        self.length = int(length)
+        self.data = data            # jax.Array [capacity] (or [0] for struct)
+        self.validity = validity    # jax.Array bool [capacity]
+        self.offsets = offsets      # jax.Array int32 [capacity+1] or None
+        self.children = children or []
+
+    # ------------------------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return int(self.validity.shape[0])
+
+    @property
+    def nbytes(self) -> int:
+        n = self.data.size * self.data.dtype.itemsize + self.validity.size
+        if self.offsets is not None:
+            n += self.offsets.size * 4
+        for c in self.children:
+            n += c.nbytes
+        return int(n)
+
+    def __repr__(self):
+        return (f"Column({self.dtype}, length={self.length}, "
+                f"capacity={self.capacity})")
+
+    # ------------------------------------------------------------------
+    # Construction
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_numpy(values: np.ndarray, dtype: dt.DataType,
+                   validity: Optional[np.ndarray] = None,
+                   capacity: Optional[int] = None) -> "Column":
+        n = len(values)
+        cap = capacity or bucket_capacity(n)
+        if validity is None:
+            validity = np.ones(n, dtype=np.bool_)
+        vals = _pad_to(np.ascontiguousarray(values), cap)
+        valid = _pad_to(validity.astype(np.bool_), cap, fill=False)
+        return Column(dtype, n, jnp.asarray(vals), jnp.asarray(valid))
+
+    @staticmethod
+    def from_pylist(values: Sequence, dtype: dt.DataType) -> "Column":
+        import pyarrow as pa
+        arr = pa.array(values, type=dt.to_arrow(dtype))
+        return Column.from_arrow(arr, dtype)
+
+    @staticmethod
+    def from_arrow(arr, dtype: Optional[dt.DataType] = None) -> "Column":
+        """Build a device column from a pyarrow Array/ChunkedArray."""
+        import pyarrow as pa
+        if isinstance(arr, pa.ChunkedArray):
+            arr = arr.combine_chunks()
+        dtype = dtype or dt.from_arrow(arr.type)
+        n = len(arr)
+        validity = np.logical_not(np.asarray(arr.is_null()))
+        cap = bucket_capacity(n)
+
+        if isinstance(dtype, (dt.StringType, dt.BinaryType)):
+            if pa.types.is_large_string(arr.type):
+                arr = arr.cast(pa.string())
+            if pa.types.is_large_binary(arr.type):
+                arr = arr.cast(pa.binary())
+            arr = arr.fill_null("" if isinstance(dtype, dt.StringType) else b"")
+            buffers = arr.buffers()  # [validity, offsets, data]
+            off = np.frombuffer(buffers[1], dtype=np.int32,
+                                count=n + 1 + arr.offset)[arr.offset:]
+            off = off - off[0]
+            databuf = buffers[2]
+            nbytes = int(off[-1]) if n else 0
+            start = np.frombuffer(buffers[1], dtype=np.int32)[arr.offset]
+            data = (np.frombuffer(databuf, dtype=np.uint8,
+                                  count=start + nbytes)[start:]
+                    if databuf is not None else np.zeros(0, np.uint8))
+            dcap = bucket_capacity(max(nbytes, 1))
+            offsets = _pad_to(off.astype(np.int32), cap + 1, fill=nbytes)
+            return Column(dtype, n, jnp.asarray(_pad_to(data, dcap)),
+                          jnp.asarray(_pad_to(validity, cap, False)),
+                          offsets=jnp.asarray(offsets))
+
+        if isinstance(dtype, dt.DecimalType):
+            if dtype.precision > dt.DecimalType.MAX_INT64_PRECISION:
+                raise NotImplementedError("decimal>18 round-1 limitation")
+            # Extract the unscaled int128 little-endian words and keep the
+            # low 64 bits (valid for p<=18); a plain cast would rescale.
+            filled = arr.fill_null(0)
+            if filled.type != pa.decimal128(38, dtype.scale):
+                filled = filled.cast(pa.decimal128(38, dtype.scale))
+            buf = filled.buffers()[1]
+            words = np.frombuffer(buf, dtype=np.int64)
+            lo = words[2 * filled.offset:2 * (filled.offset + n):2].copy()
+            return Column(dtype, n, jnp.asarray(_pad_to(lo, cap)),
+                          jnp.asarray(_pad_to(validity, cap, False)))
+
+        if isinstance(dtype, dt.TimestampType):
+            micros = np.asarray(arr.fill_null(0)
+                                .cast(pa.timestamp("us")).cast(pa.int64()))
+            return Column(dtype, n, jnp.asarray(_pad_to(micros, cap)),
+                          jnp.asarray(_pad_to(validity, cap, False)))
+
+        if isinstance(dtype, dt.DateType):
+            days = np.asarray(arr.fill_null(0).cast(pa.int32()))
+            return Column(dtype, n, jnp.asarray(_pad_to(days, cap)),
+                          jnp.asarray(_pad_to(validity, cap, False)))
+
+        if isinstance(dtype, dt.NullType):
+            return Column(dtype, n, jnp.zeros(cap, jnp.int8),
+                          jnp.zeros(cap, jnp.bool_))
+
+        if dtype.is_nested:
+            raise NotImplementedError("nested from_arrow lands with nested ops")
+
+        values = np.asarray(arr.fill_null(
+            False if isinstance(dtype, dt.BooleanType) else 0))
+        values = values.astype(dtype.np_dtype, copy=False)
+        return Column(dtype, n, jnp.asarray(_pad_to(values, cap)),
+                      jnp.asarray(_pad_to(validity, cap, False)))
+
+    @staticmethod
+    def nulls(n: int, dtype: dt.DataType) -> "Column":
+        cap = bucket_capacity(n)
+        np_dt = dtype.np_dtype or np.int8
+        col = Column(dtype, n, jnp.zeros(cap, np_dt), jnp.zeros(cap, jnp.bool_))
+        if dtype.is_variable_width:
+            col.offsets = jnp.zeros(cap + 1, jnp.int32)
+        return col
+
+    # ------------------------------------------------------------------
+    # Export
+    # ------------------------------------------------------------------
+    def to_arrow(self):
+        import pyarrow as pa
+        n = self.length
+        validity = np.asarray(jax.device_get(self.validity))[:n]
+        mask = pa.array(np.logical_not(validity))
+        if isinstance(self.dtype, (dt.StringType, dt.BinaryType)):
+            off = np.asarray(jax.device_get(self.offsets))[:n + 1]
+            nbytes = int(off[-1]) if n else 0
+            data = np.asarray(jax.device_get(self.data))[:nbytes]
+            patype = dt.to_arrow(self.dtype)
+            arr = pa.Array.from_buffers(
+                patype, n,
+                [None, pa.py_buffer(off.astype(np.int32).tobytes()),
+                 pa.py_buffer(data.tobytes())])
+            if not validity.all():
+                arr = pa.array(
+                    [v if m else None for v, m in zip(arr.to_pylist(), validity)],
+                    type=patype)
+            return arr
+        vals = np.asarray(jax.device_get(self.data))[:n]
+        if isinstance(self.dtype, dt.DecimalType):
+            # assemble int128 little-endian words from the unscaled int64s
+            # (a cast from int64 would rescale, not reinterpret)
+            lo = vals.astype(np.int64)
+            hi = np.where(lo < 0, np.int64(-1), np.int64(0))
+            words = np.empty(2 * n, np.int64)
+            words[0::2] = lo
+            words[1::2] = hi
+            arr = pa.Array.from_buffers(
+                pa.decimal128(38, self.dtype.scale), n,
+                [None, pa.py_buffer(words.tobytes())]).cast(
+                    dt.to_arrow(self.dtype))
+        elif isinstance(self.dtype, dt.TimestampType):
+            arr = pa.array(vals, type=pa.timestamp("us")).cast(
+                dt.to_arrow(self.dtype))
+        elif isinstance(self.dtype, dt.DateType):
+            arr = pa.array(vals, type=pa.int32()).cast(pa.date32())
+        elif isinstance(self.dtype, dt.NullType):
+            return pa.nulls(n)
+        else:
+            arr = pa.array(vals, type=dt.to_arrow(self.dtype))
+        if not validity.all():
+            arr = pa.array([v if m else None
+                            for v, m in zip(arr.to_pylist(), validity)],
+                           type=arr.type)
+        return arr
+
+    def to_pylist(self) -> list:
+        return self.to_arrow().to_pylist()
+
+    def to_numpy(self):
+        """(values[:length], validity[:length]) as host numpy arrays."""
+        return (np.asarray(jax.device_get(self.data))[:self.length],
+                np.asarray(jax.device_get(self.validity))[:self.length])

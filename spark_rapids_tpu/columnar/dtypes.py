@@ -1,0 +1,327 @@
+"""Data type system for TPU columnar execution.
+
+Mirrors the type surface that the reference plugin supports on GPU
+(reference: sql-plugin/src/main/scala/com/nvidia/spark/rapids/TypeChecks.scala:125,
+GpuColumnVector.java type mapping) but is designed TPU-first: every type maps
+to a fixed-width device representation (jax.numpy dtype) plus, for variable
+width types, Arrow-style offset/child buffers.
+
+Device representations:
+  - BooleanType      -> bool_
+  - ByteType         -> int8
+  - ShortType        -> int16
+  - IntegerType      -> int32
+  - LongType         -> int64
+  - FloatType        -> float32
+  - DoubleType       -> float64
+  - DateType         -> int32   (days since epoch; Spark semantics)
+  - TimestampType    -> int64   (microseconds since epoch, UTC)
+  - StringType       -> offsets int32[n+1] + data uint8[nbytes]
+  - BinaryType       -> same as string
+  - DecimalType(p,s) -> int64 scaled integer for p <= 18 (DECIMAL64);
+                        p in (18, 38] represented as (hi int64, lo uint64)
+                        pair -- round-1 supports arithmetic only on p<=18.
+  - NullType         -> int8 all-null
+  - ArrayType        -> offsets + child column
+  - StructType       -> child columns
+  - MapType          -> array of struct<key,value>
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = [
+    "DataType", "BooleanType", "ByteType", "ShortType", "IntegerType",
+    "LongType", "FloatType", "DoubleType", "StringType", "BinaryType",
+    "DateType", "TimestampType", "DecimalType", "NullType", "ArrayType",
+    "StructType", "StructField", "MapType",
+    "BOOL", "INT8", "INT16", "INT32", "INT64", "FLOAT32", "FLOAT64",
+    "STRING", "BINARY", "DATE", "TIMESTAMP", "NULLTYPE",
+]
+
+
+class DataType:
+    """Base class for all SQL data types."""
+
+    #: numpy dtype of the primary device buffer, or None for nested
+    np_dtype: Optional[np.dtype] = None
+
+    @property
+    def is_numeric(self) -> bool:
+        return isinstance(self, (ByteType, ShortType, IntegerType, LongType,
+                                 FloatType, DoubleType, DecimalType))
+
+    @property
+    def is_integral(self) -> bool:
+        return isinstance(self, (ByteType, ShortType, IntegerType, LongType))
+
+    @property
+    def is_floating(self) -> bool:
+        return isinstance(self, (FloatType, DoubleType))
+
+    @property
+    def is_variable_width(self) -> bool:
+        return isinstance(self, (StringType, BinaryType, ArrayType, MapType))
+
+    @property
+    def is_nested(self) -> bool:
+        return isinstance(self, (ArrayType, StructType, MapType))
+
+    def simple_name(self) -> str:
+        return type(self).__name__.replace("Type", "").lower()
+
+    def __repr__(self) -> str:
+        return self.simple_name()
+
+    def __eq__(self, other):
+        return type(self) is type(other)
+
+    def __hash__(self):
+        return hash(type(self))
+
+
+class BooleanType(DataType):
+    np_dtype = np.dtype(np.bool_)
+
+
+class ByteType(DataType):
+    np_dtype = np.dtype(np.int8)
+
+
+class ShortType(DataType):
+    np_dtype = np.dtype(np.int16)
+
+
+class IntegerType(DataType):
+    np_dtype = np.dtype(np.int32)
+
+
+class LongType(DataType):
+    np_dtype = np.dtype(np.int64)
+
+
+class FloatType(DataType):
+    np_dtype = np.dtype(np.float32)
+
+
+class DoubleType(DataType):
+    np_dtype = np.dtype(np.float64)
+
+
+class StringType(DataType):
+    np_dtype = np.dtype(np.uint8)  # data buffer
+
+
+class BinaryType(DataType):
+    np_dtype = np.dtype(np.uint8)
+
+
+class DateType(DataType):
+    np_dtype = np.dtype(np.int32)
+
+
+class TimestampType(DataType):
+    np_dtype = np.dtype(np.int64)
+
+
+class NullType(DataType):
+    np_dtype = np.dtype(np.int8)
+
+
+class DecimalType(DataType):
+    """Fixed-point decimal. p<=18 backed by a scaled int64 (DECIMAL64)."""
+
+    MAX_INT64_PRECISION = 18
+    MAX_PRECISION = 38
+
+    def __init__(self, precision: int = 10, scale: int = 0):
+        if not (1 <= precision <= self.MAX_PRECISION):
+            raise ValueError(f"precision out of range: {precision}")
+        if not (0 <= scale <= precision):
+            raise ValueError(f"scale out of range: {scale} (precision {precision})")
+        self.precision = precision
+        self.scale = scale
+
+    @property
+    def np_dtype(self):  # type: ignore[override]
+        return np.dtype(np.int64)
+
+    def simple_name(self) -> str:
+        return f"decimal({self.precision},{self.scale})"
+
+    def __eq__(self, other):
+        return (isinstance(other, DecimalType)
+                and other.precision == self.precision
+                and other.scale == self.scale)
+
+    def __hash__(self):
+        return hash((DecimalType, self.precision, self.scale))
+
+
+@dataclasses.dataclass(frozen=True)
+class StructField:
+    name: str
+    dtype: "DataType"
+    nullable: bool = True
+
+
+class StructType(DataType):
+    def __init__(self, fields: Tuple[StructField, ...]):
+        self.fields = tuple(fields)
+
+    def simple_name(self) -> str:
+        inner = ",".join(f"{f.name}:{f.dtype.simple_name()}" for f in self.fields)
+        return f"struct<{inner}>"
+
+    def __eq__(self, other):
+        return isinstance(other, StructType) and other.fields == self.fields
+
+    def __hash__(self):
+        return hash((StructType, self.fields))
+
+
+class ArrayType(DataType):
+    def __init__(self, element: DataType, contains_null: bool = True):
+        self.element = element
+        self.contains_null = contains_null
+
+    def simple_name(self) -> str:
+        return f"array<{self.element.simple_name()}>"
+
+    def __eq__(self, other):
+        return isinstance(other, ArrayType) and other.element == self.element
+
+    def __hash__(self):
+        return hash((ArrayType, self.element))
+
+
+class MapType(DataType):
+    def __init__(self, key: DataType, value: DataType,
+                 value_contains_null: bool = True):
+        self.key = key
+        self.value = value
+        self.value_contains_null = value_contains_null
+
+    def simple_name(self) -> str:
+        return f"map<{self.key.simple_name()},{self.value.simple_name()}>"
+
+    def __eq__(self, other):
+        return (isinstance(other, MapType) and other.key == self.key
+                and other.value == self.value)
+
+    def __hash__(self):
+        return hash((MapType, self.key, self.value))
+
+
+# Singletons for the common fixed types.
+BOOL = BooleanType()
+INT8 = ByteType()
+INT16 = ShortType()
+INT32 = IntegerType()
+INT64 = LongType()
+FLOAT32 = FloatType()
+FLOAT64 = DoubleType()
+STRING = StringType()
+BINARY = BinaryType()
+DATE = DateType()
+TIMESTAMP = TimestampType()
+NULLTYPE = NullType()
+
+_NUMERIC_ORDER = [ByteType, ShortType, IntegerType, LongType, FloatType,
+                  DoubleType]
+
+
+def promote(a: DataType, b: DataType) -> DataType:
+    """Numeric type promotion following Spark's binary-arithmetic widening."""
+    if a == b:
+        return a
+    if isinstance(a, DecimalType) or isinstance(b, DecimalType):
+        raise TypeError("decimal promotion handled by expression layer")
+    if not (a.is_numeric and b.is_numeric):
+        raise TypeError(f"cannot promote {a} and {b}")
+    ia = _NUMERIC_ORDER.index(type(a))
+    ib = _NUMERIC_ORDER.index(type(b))
+    # int64 + float32 -> float64 under Spark
+    pair = {type(a), type(b)}
+    if pair == {LongType, FloatType}:
+        return FLOAT64
+    return (a if ia >= ib else b)
+
+
+def from_arrow(at) -> DataType:
+    """Map a pyarrow type to our DataType."""
+    import pyarrow as pa
+    if pa.types.is_boolean(at):
+        return BOOL
+    if pa.types.is_int8(at):
+        return INT8
+    if pa.types.is_int16(at):
+        return INT16
+    if pa.types.is_int32(at):
+        return INT32
+    if pa.types.is_int64(at):
+        return INT64
+    if pa.types.is_float32(at):
+        return FLOAT32
+    if pa.types.is_float64(at):
+        return FLOAT64
+    if pa.types.is_string(at) or pa.types.is_large_string(at):
+        return STRING
+    if pa.types.is_binary(at) or pa.types.is_large_binary(at):
+        return BINARY
+    if pa.types.is_date32(at):
+        return DATE
+    if pa.types.is_timestamp(at):
+        return TIMESTAMP
+    if pa.types.is_decimal(at):
+        return DecimalType(at.precision, at.scale)
+    if pa.types.is_null(at):
+        return NULLTYPE
+    if pa.types.is_list(at) or pa.types.is_large_list(at):
+        return ArrayType(from_arrow(at.value_type))
+    if pa.types.is_struct(at):
+        return StructType(tuple(StructField(f.name, from_arrow(f.type))
+                                for f in at))
+    if pa.types.is_map(at):
+        return MapType(from_arrow(at.key_type), from_arrow(at.item_type))
+    raise TypeError(f"unsupported arrow type: {at}")
+
+
+def to_arrow(dt: DataType):
+    import pyarrow as pa
+    if isinstance(dt, BooleanType):
+        return pa.bool_()
+    if isinstance(dt, ByteType):
+        return pa.int8()
+    if isinstance(dt, ShortType):
+        return pa.int16()
+    if isinstance(dt, IntegerType):
+        return pa.int32()
+    if isinstance(dt, LongType):
+        return pa.int64()
+    if isinstance(dt, FloatType):
+        return pa.float32()
+    if isinstance(dt, DoubleType):
+        return pa.float64()
+    if isinstance(dt, StringType):
+        return pa.string()
+    if isinstance(dt, BinaryType):
+        return pa.binary()
+    if isinstance(dt, DateType):
+        return pa.date32()
+    if isinstance(dt, TimestampType):
+        return pa.timestamp("us", tz="UTC")
+    if isinstance(dt, DecimalType):
+        return pa.decimal128(dt.precision, dt.scale)
+    if isinstance(dt, NullType):
+        return pa.null()
+    if isinstance(dt, ArrayType):
+        return pa.list_(to_arrow(dt.element))
+    if isinstance(dt, StructType):
+        return pa.struct([(f.name, to_arrow(f.dtype)) for f in dt.fields])
+    if isinstance(dt, MapType):
+        return pa.map_(to_arrow(dt.key), to_arrow(dt.value))
+    raise TypeError(f"unsupported dtype: {dt}")

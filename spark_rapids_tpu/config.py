@@ -1,0 +1,186 @@
+"""Typed configuration registry — the RapidsConf analog.
+
+(reference: sql-plugin/.../RapidsConf.scala — builder DSL, startup vs
+runtime entries, and markdown doc generation for docs/configs.md.)
+
+Usage:
+    conf = TpuConf({"spark.rapids.tpu.sql.batchSizeRows": 1 << 21})
+    conf.batch_size_rows
+
+`generate_docs()` emits docs/configs.md content from the registry, like the
+reference's `RapidsConf.help()` doc emitters.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+__all__ = ["TpuConf", "ConfEntry", "REGISTRY", "generate_docs"]
+
+REGISTRY: Dict[str, "ConfEntry"] = {}
+
+
+class ConfEntry:
+    def __init__(self, key: str, default: Any, doc: str, typ: Callable,
+                 internal: bool = False, startup: bool = False):
+        self.key = key
+        self.default = default
+        self.doc = doc
+        self.typ = typ
+        self.internal = internal
+        self.startup = startup
+        REGISTRY[key] = self
+
+    def get(self, conf: "TpuConf"):
+        raw = conf._settings.get(self.key, self.default)
+        if raw is None:
+            return None
+        if self.typ is bool and isinstance(raw, str):
+            return raw.lower() in ("true", "1", "yes")
+        return self.typ(raw)
+
+
+def _conf(key, default, doc, typ, **kw):
+    return ConfEntry(f"spark.rapids.tpu.{key}", default, doc, typ, **kw)
+
+
+# ----------------------------------------------------------------------
+# Registry (grouped roughly like the reference's RapidsConf sections)
+# ----------------------------------------------------------------------
+SQL_ENABLED = _conf("sql.enabled", True,
+                    "Enable TPU acceleration of SQL operators.", bool)
+BATCH_SIZE_ROWS = _conf(
+    "sql.batchSizeRows", 1 << 20,
+    "Target rows per columnar batch read into HBM. Batches are padded to "
+    "power-of-two capacities to bound XLA recompilation.", int)
+BATCH_SIZE_BYTES = _conf(
+    "sql.batchSizeBytes", 512 * 1024 * 1024,
+    "Soft cap on device bytes per batch (analog of "
+    "spark.rapids.sql.batchSizeBytes).", int)
+CONCURRENT_TASKS = _conf(
+    "sql.concurrentTpuTasks", 2,
+    "Max tasks concurrently admitted to the TPU (TpuSemaphore permits; "
+    "analog of spark.rapids.sql.concurrentGpuTasks).", int)
+HBM_POOL_FRACTION = _conf(
+    "memory.tpu.allocFraction", 0.85,
+    "Fraction of HBM the memory manager may budget for columnar data.",
+    float)
+HBM_POOL_BYTES = _conf(
+    "memory.tpu.poolBytes", None,
+    "Explicit HBM budget in bytes; overrides allocFraction when set.",
+    int)
+HOST_SPILL_LIMIT = _conf(
+    "memory.host.spillStorageSize", 32 * 1024 * 1024 * 1024,
+    "Bytes of host DRAM usable for spilled device buffers before "
+    "cascading to disk.", int)
+SPILL_DIR = _conf(
+    "memory.spill.dir", "/tmp/srtpu-spill",
+    "Directory for disk-tier spill files.", str)
+OOM_MAX_RETRIES = _conf(
+    "memory.oom.maxRetries", 8,
+    "Bounded retries after device OOM before giving up "
+    "(analog of DeviceMemoryEventHandler maxFailedOOMRetries).", int)
+SHUFFLE_PARTITIONS = _conf(
+    "sql.shuffle.partitions", 8,
+    "Default partition count for exchanges (spark.sql.shuffle.partitions).",
+    int)
+SHUFFLE_DIR = _conf(
+    "shuffle.dir", "/tmp/srtpu-shuffle",
+    "Directory for multithreaded host shuffle files.", str)
+SHUFFLE_WRITER_THREADS = _conf(
+    "shuffle.multiThreaded.writer.threads", 4,
+    "Thread pool size for shuffle writes "
+    "(analog of RapidsShuffleManager MULTITHREADED mode).", int)
+SHUFFLE_READER_THREADS = _conf(
+    "shuffle.multiThreaded.reader.threads", 4,
+    "Thread pool size for shuffle reads.", int)
+SHUFFLE_COMPRESS = _conf(
+    "shuffle.compression.codec", "lz4",
+    "Shuffle wire compression: none|lz4|zstd (nvcomp analog, host-side).",
+    str)
+EXPLAIN = _conf(
+    "sql.explain", "NONE",
+    "Explain TPU planning: NONE|NOT_ON_TPU|ALL "
+    "(analog of spark.rapids.sql.explain).", str)
+ALLOW_CPU_FALLBACK = _conf(
+    "sql.allowCpuFallback", True,
+    "Allow operators that cannot run on TPU to fall back to the host CPU "
+    "path instead of failing.", bool)
+METRICS_LEVEL = _conf(
+    "sql.metrics.level", "MODERATE",
+    "Metric verbosity: ESSENTIAL|MODERATE|DEBUG.", str)
+MULTITHREADED_READ_THREADS = _conf(
+    "sql.format.parquet.multiThreadedRead.numThreads", 4,
+    "Thread pool for the multithreaded (cloud) parquet reader "
+    "(analog of spark.rapids.sql.multiThreadedRead.numThreads).", int)
+PARQUET_READER_TYPE = _conf(
+    "sql.format.parquet.reader.type", "MULTITHREADED",
+    "PERFILE|COALESCING|MULTITHREADED (GpuParquetScan reader types).", str)
+MAX_READER_BATCH_SIZE_ROWS = _conf(
+    "sql.reader.batchSizeRows", 1 << 21,
+    "Soft limit on rows per scan batch.", int)
+DECIMAL128_ENABLED = _conf(
+    "sql.decimal128.enabled", False,
+    "Round-1 limitation: decimals with precision > 18 fall back to "
+    "float64 when False.", bool)
+LORE_DUMP_IDS = _conf(
+    "sql.lore.idsToDump", None,
+    "LORE ids whose input batches should be dumped for replay "
+    "(analog of spark.rapids.sql.lore.idsToDumpPath).", str)
+LORE_DUMP_PATH = _conf(
+    "sql.lore.dumpPath", "/tmp/srtpu-lore",
+    "Directory for LORE operator dumps.", str)
+SORT_OOC_ENABLED = _conf(
+    "sql.sort.outOfCore.enabled", True,
+    "Enable out-of-core chunked merge sort for big inputs.", bool)
+AGG_FORCE_MERGE_PASSES = _conf(
+    "sql.agg.forceSinglePassMerge", False,
+    "Testing: force aggregate merge in one concat pass.", bool, internal=True)
+
+
+class TpuConf:
+    """Immutable-ish snapshot of settings, resolved against the registry."""
+
+    def __init__(self, settings: Optional[Dict[str, Any]] = None):
+        self._settings = dict(settings or {})
+
+    def get(self, entry: ConfEntry):
+        return entry.get(self)
+
+    def set(self, key: str, value) -> "TpuConf":
+        s = dict(self._settings)
+        s[key] = value
+        return TpuConf(s)
+
+    # Convenience accessors used across the engine.
+    @property
+    def batch_size_rows(self):
+        return self.get(BATCH_SIZE_ROWS)
+
+    @property
+    def shuffle_partitions(self):
+        return self.get(SHUFFLE_PARTITIONS)
+
+    @property
+    def concurrent_tasks(self):
+        return self.get(CONCURRENT_TASKS)
+
+    @property
+    def explain(self):
+        return self.get(EXPLAIN).upper()
+
+    @property
+    def allow_cpu_fallback(self):
+        return self.get(ALLOW_CPU_FALLBACK)
+
+
+def generate_docs() -> str:
+    """Emit configs.md content (the reference generates docs/configs.md
+    from RapidsConf the same way)."""
+    lines = ["# spark-rapids-tpu configuration", "",
+             "Name | Description | Default", "-----|-------------|--------"]
+    for key in sorted(REGISTRY):
+        e = REGISTRY[key]
+        if e.internal:
+            continue
+        lines.append(f"{e.key} | {e.doc} | {e.default}")
+    return "\n".join(lines) + "\n"

@@ -1,0 +1,73 @@
+"""Physical operator base: columnar, pull-based, jit-compiled per shape.
+
+Analog of the reference's GpuExec (reference: GpuExec.scala:107): every
+operator is columnar-only, produces an iterator of DeviceBatch per
+partition, and registers metrics. TPU-first difference: each operator owns
+jitted kernels (traced once per capacity bucket, cached by jax), and entire
+project/filter/agg-update chains are fused by XLA rather than being separate
+kernel launches.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Iterator, List, Optional
+
+from ..columnar.table import Schema
+from ..utils.metrics import MetricSet
+from .batch import DeviceBatch
+
+__all__ = ["TpuExec", "ExecContext"]
+
+
+class ExecContext:
+    """Per-query execution context: conf snapshot, metrics, memory runtime."""
+
+    def __init__(self, conf=None, session=None):
+        from ..config import TpuConf
+        self.conf = conf or TpuConf()
+        self.session = session
+        self.metrics: Dict[str, MetricSet] = {}
+
+    def metrics_for(self, op_id: str) -> MetricSet:
+        if op_id not in self.metrics:
+            self.metrics[op_id] = MetricSet()
+        return self.metrics[op_id]
+
+
+class TpuExec:
+    """Base physical operator."""
+
+    def __init__(self, children: List["TpuExec"], schema: Schema):
+        self.children = children
+        self._schema = schema
+        self._op_id = f"{type(self).__name__}@{id(self):x}"
+
+    @property
+    def schema(self) -> Schema:
+        return self._schema
+
+    def num_partitions(self, ctx: ExecContext) -> int:
+        if self.children:
+            return self.children[0].num_partitions(ctx)
+        return 1
+
+    def execute_partition(self, ctx: ExecContext,
+                          pid: int) -> Iterator[DeviceBatch]:
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def execute_all(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
+        for pid in range(self.num_partitions(ctx)):
+            yield from self.execute_partition(ctx, pid)
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def describe(self) -> str:
+        return self.node_name()
+
+    def tree_string(self, indent: int = 0) -> str:
+        s = "  " * indent + self.describe() + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s

@@ -1,0 +1,46 @@
+"""DeviceBatch: the unit of execution — a Table plus a live-row mask.
+
+TPU-first filter representation: instead of materializing a compacted table
+after every Filter (cudf `apply_boolean_mask` in the reference), a batch
+carries `row_mask` (bool[capacity]); padding rows and filtered rows are
+False. Downstream projections compute garbage in dead lanes (free on the
+VPU), and aggregation/compaction consume the mask. Compaction happens only
+when an operator truly needs dense rows (shuffle, join build, sort).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..columnar.table import Table
+from ..ops.kernel_utils import CV
+
+__all__ = ["DeviceBatch"]
+
+
+class DeviceBatch:
+    def __init__(self, table: Table, num_rows: Optional[int] = None,
+                 row_mask=None, capacity: Optional[int] = None):
+        self.table = table
+        if num_rows is None:
+            num_rows = table.num_rows
+        self.num_rows = num_rows           # upper bound of live rows (host)
+        if capacity is None:
+            capacity = (table.columns[0].capacity if table.columns
+                        else max(num_rows, 128))
+        self.capacity = capacity
+        if row_mask is None:
+            row_mask = jnp.arange(capacity) < num_rows
+        self.row_mask = row_mask
+
+    def cvs(self) -> List[CV]:
+        return [CV(c.data, c.validity, c.offsets) for c in self.table.columns]
+
+    @property
+    def nbytes(self) -> int:
+        return self.table.nbytes + self.capacity
+
+    def __repr__(self):
+        return (f"DeviceBatch(rows<={self.num_rows}, cap={self.capacity}, "
+                f"cols={self.table.num_columns})")

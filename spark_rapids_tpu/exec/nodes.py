@@ -1,0 +1,224 @@
+"""Core physical operators: scan, project, filter, limit, union, collect.
+
+Analogs (reference): GpuFileSourceScanExec / basicPhysicalOperators.scala
+(GpuProjectExec :~, GpuFilterExec), limit.scala, GpuUnionExec. The fused
+project/filter path compiles each operator's bound expression list into one
+jitted function over the batch's CV pytree.
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+from typing import Iterator, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.column import Column, bucket_capacity
+from ..columnar.table import Field, Schema, Table
+from ..expr.expressions import EmitCtx, Expression
+from ..ops.kernel_utils import CV
+from .base import ExecContext, TpuExec
+from .batch import DeviceBatch
+
+__all__ = ["InMemoryScanExec", "ParquetScanExec", "ProjectExec", "FilterExec",
+           "LimitExec", "UnionExec", "collect_to_arrow", "cv_to_column",
+           "make_table"]
+
+
+def cv_to_column(cv: CV, dtype: dt.DataType, length: int) -> Column:
+    return Column(dtype, length, cv.data, cv.validity, cv.offsets)
+
+
+def make_table(schema: Schema, cvs: Sequence[CV], num_rows: int) -> Table:
+    cols = [cv_to_column(cv, f.dtype, num_rows)
+            for f, cv in zip(schema.fields, cvs)]
+    return Table(schema.names, cols)
+
+
+# ----------------------------------------------------------------------
+class InMemoryScanExec(TpuExec):
+    """Streams host (arrow) slices into HBM batches."""
+
+    def __init__(self, arrow_table, schema: Schema):
+        super().__init__([], schema)
+        self.arrow = arrow_table
+
+    def num_partitions(self, ctx):
+        rows = self.arrow.num_rows
+        per = max(1, ctx.conf.batch_size_rows)
+        return max(1, -(-rows // per))
+
+    def execute_partition(self, ctx, pid) -> Iterator[DeviceBatch]:
+        per = max(1, ctx.conf.batch_size_rows)
+        start = pid * per
+        n = min(per, self.arrow.num_rows - start)
+        if n <= 0 and pid > 0:
+            return
+        sl = self.arrow.slice(start, max(n, 0))
+        m = ctx.metrics_for(self._op_id)
+        with m.timer("scanTime"):
+            tbl = Table.from_arrow(sl)
+        m.add("numOutputRows", max(n, 0))
+        m.add("numOutputBatches", 1)
+        yield DeviceBatch(tbl)
+
+
+class ParquetScanExec(TpuExec):
+    """PERFILE/MULTITHREADED parquet reader: host decode via Arrow C++,
+    one H2D per batch (reference: GpuParquetScan.scala readers; device
+    decode is follow-on work — footnote in docs/compatibility.md)."""
+
+    def __init__(self, paths: Sequence[str], schema: Schema,
+                 columns: Optional[Sequence[str]] = None,
+                 filters=None):
+        super().__init__([], schema)
+        self.paths = list(paths)
+        self.columns = list(columns) if columns else None
+        self.filters = filters
+
+    def num_partitions(self, ctx):
+        return len(self.paths)
+
+    def execute_partition(self, ctx, pid) -> Iterator[DeviceBatch]:
+        import pyarrow.parquet as pq
+        m = ctx.metrics_for(self._op_id)
+        path = self.paths[pid]
+        per = max(1, ctx.conf.batch_size_rows)
+        pf = pq.ParquetFile(path)
+        cols = self.columns or [f.name for f in self.schema.fields]
+        for rb in pf.iter_batches(batch_size=per, columns=cols):
+            with m.timer("scanTime"):
+                import pyarrow as pa
+                tbl = Table.from_arrow(pa.table(rb))
+            m.add("numOutputRows", rb.num_rows)
+            m.add("numOutputBatches", 1)
+            yield DeviceBatch(tbl)
+
+
+# ----------------------------------------------------------------------
+class ProjectExec(TpuExec):
+    def __init__(self, child: TpuExec, bound_exprs: List[Expression],
+                 schema: Schema):
+        super().__init__([child], schema)
+        self.bound = bound_exprs
+
+        def _run(cvs, mask):
+            ctx = EmitCtx(cvs, mask.shape[0])
+            return [e.emit(ctx) for e in self.bound]
+
+        self._jit = jax.jit(_run)
+
+    def describe(self):
+        return f"ProjectExec[{', '.join(map(repr, self.bound))}]"
+
+    def execute_partition(self, ctx, pid):
+        m = ctx.metrics_for(self._op_id)
+        for batch in self.children[0].execute_partition(ctx, pid):
+            with m.timer("opTime"):
+                out = self._jit(batch.cvs(), batch.row_mask)
+            m.add("numOutputBatches", 1)
+            yield DeviceBatch(make_table(self.schema, out, batch.num_rows),
+                              batch.num_rows, batch.row_mask, batch.capacity)
+
+
+class FilterExec(TpuExec):
+    def __init__(self, child: TpuExec, bound_cond: Expression):
+        super().__init__([child], child.schema)
+        self.bound = bound_cond
+
+        def _run(cvs, mask):
+            ctx = EmitCtx(cvs, mask.shape[0])
+            cv = self.bound.emit(ctx)
+            return mask & cv.validity & cv.data.astype(jnp.bool_)
+
+        self._jit = jax.jit(_run)
+
+    def describe(self):
+        return f"FilterExec[{self.bound!r}]"
+
+    def execute_partition(self, ctx, pid):
+        m = ctx.metrics_for(self._op_id)
+        for batch in self.children[0].execute_partition(ctx, pid):
+            with m.timer("opTime"):
+                new_mask = self._jit(batch.cvs(), batch.row_mask)
+            m.add("numOutputBatches", 1)
+            yield DeviceBatch(batch.table, batch.num_rows, new_mask,
+                              batch.capacity)
+
+
+class LimitExec(TpuExec):
+    """Global limit; collapses to a single output partition."""
+
+    def __init__(self, child: TpuExec, n: int):
+        super().__init__([child], child.schema)
+        self.n = n
+
+        def _clip(mask, remaining):
+            ranks = jnp.cumsum(mask.astype(jnp.int64))
+            new_mask = mask & (ranks <= remaining)
+            return new_mask, jnp.sum(new_mask.astype(jnp.int64))
+
+        self._jit = jax.jit(_clip)
+
+    def num_partitions(self, ctx):
+        return 1
+
+    def execute_partition(self, ctx, pid):
+        remaining = self.n
+        child = self.children[0]
+        for cpid in range(child.num_partitions(ctx)):
+            if remaining <= 0:
+                return
+            for batch in child.execute_partition(ctx, cpid):
+                if remaining <= 0:
+                    return
+                mask, took = self._jit(batch.row_mask, remaining)
+                took = int(took)
+                if took == 0:
+                    continue
+                remaining -= took
+                yield DeviceBatch(batch.table, batch.num_rows, mask,
+                                  batch.capacity)
+
+
+class UnionExec(TpuExec):
+    def __init__(self, children: List[TpuExec], schema: Schema):
+        super().__init__(children, schema)
+        self._offsets = []
+
+    def num_partitions(self, ctx):
+        return sum(c.num_partitions(ctx) for c in self.children)
+
+    def execute_partition(self, ctx, pid):
+        for c in self.children:
+            n = c.num_partitions(ctx)
+            if pid < n:
+                for b in c.execute_partition(ctx, pid):
+                    # positional union: rename child columns to ours
+                    yield DeviceBatch(b.table.rename(self.schema.names),
+                                      b.num_rows, b.row_mask, b.capacity)
+                return
+            pid -= n
+
+
+# ----------------------------------------------------------------------
+def collect_to_arrow(root: TpuExec, ctx: ExecContext):
+    """Run the plan and materialize a host pyarrow Table (the analog of
+    GpuColumnarToRowExec + collect)."""
+    import pyarrow as pa
+    pieces = []
+    for batch in root.execute_all(ctx):
+        at = batch.table.to_arrow()
+        mask = np.asarray(jax.device_get(batch.row_mask))[:batch.num_rows]
+        if at.num_rows == 0 and batch.num_rows > 0:
+            # zero-column batch (e.g. count(*) pipelines)
+            pieces.append(pa.table({}))
+            continue
+        if not mask.all():
+            at = at.filter(pa.array(mask))
+        pieces.append(at)
+    if not pieces:
+        return root.schema.to_arrow().empty_table()
+    return pa.concat_tables(pieces)

@@ -1,0 +1,330 @@
+"""Aggregate functions: update/merge/finalize protocol.
+
+Mirrors the reference's CudfAggregate split into update/merge phases
+(reference: org/apache/spark/sql/rapids/aggregate/aggregateFunctions.scala)
+so the exec layer can run partial-per-batch aggregation, merge partials on
+device, and finalize — for both ungrouped reductions and (sort-based)
+grouped aggregation via jax.ops.segment_* primitives.
+
+States are tuples of jnp scalars (ungrouped) or [num_segments] arrays
+(grouped). All null semantics follow Spark:
+  sum/min/max over zero valid rows -> null; count is never null;
+  avg = sum/count, null when count == 0.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..ops.kernel_utils import CV
+from .expressions import (Cast, Expression, Literal, UnsupportedExpr)
+
+__all__ = ["AggExpr", "Sum", "Count", "CountStar", "Min", "Max", "Avg",
+           "First", "Last"]
+
+_MINMAX_IDENT = {
+    jnp.float32: (jnp.inf, -jnp.inf),
+    jnp.float64: (jnp.inf, -jnp.inf),
+}
+
+
+def _ident(np_dtype, for_min: bool):
+    if jnp.issubdtype(np_dtype, jnp.floating):
+        return jnp.inf if for_min else -jnp.inf
+    if np_dtype == jnp.bool_:
+        return True if for_min else False
+    info = jnp.iinfo(np_dtype)
+    return info.max if for_min else info.min
+
+
+class AggExpr(Expression):
+    """An aggregate over a child expression. Not valid in row projections."""
+
+    def __init__(self, child: Optional[Expression]):
+        self.child = child
+        self.children = [child] if child is not None else []
+
+    def bind(self, schema):
+        b = type(self)(self.child.bind(schema) if self.child else None)
+        b._resolve_type()
+        return b
+
+    def _resolve_type(self):
+        raise NotImplementedError
+
+    # --- protocol: ungrouped ------------------------------------------
+    # update(cv, mask) -> state (tuple of scalars)
+    # merge(s1, s2) -> state
+    # finalize(state) -> (scalar_value, scalar_valid)
+    def num_state_cols(self) -> int:
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__.lower()}({self.child})"
+
+
+class Sum(AggExpr):
+    state_reducers = ("sum", "or")
+
+    def _resolve_type(self):
+        ct = self.child.dtype
+        if isinstance(ct, dt.DecimalType):
+            self.dtype = dt.DecimalType(min(38, ct.precision + 10), ct.scale)
+            if self.dtype.precision > 18:
+                self.dtype = dt.DecimalType(18, ct.scale)  # decimal64 limit
+        elif ct.is_integral or isinstance(ct, dt.BooleanType):
+            self.dtype = dt.INT64
+        elif ct.is_floating:
+            self.dtype = dt.FLOAT64
+        elif isinstance(ct, dt.NullType):
+            self.dtype = dt.FLOAT64
+        else:
+            raise UnsupportedExpr(f"sum({ct})")
+        self._acc_dtype = self.dtype.np_dtype
+
+    def update(self, cv: CV, mask):
+        m = mask & cv.validity
+        x = jnp.where(m, cv.data, 0).astype(self._acc_dtype)
+        return (jnp.sum(x), jnp.any(m))
+
+    def merge(self, s1, s2):
+        return (s1[0] + s2[0], s1[1] | s2[1])
+
+    def finalize(self, s):
+        return s[0], s[1]
+
+    # --- grouped: per-segment ----
+    def g_update(self, cv: CV, mask, seg_ids, num_segments):
+        m = mask & cv.validity
+        x = jnp.where(m, cv.data, 0).astype(self._acc_dtype)
+        return (jax.ops.segment_sum(x, seg_ids, num_segments),
+                jax.ops.segment_max(m.astype(jnp.int32), seg_ids,
+                                    num_segments) > 0)
+
+
+class Count(AggExpr):
+    state_reducers = ("sum",)
+
+    def _resolve_type(self):
+        self.dtype = dt.INT64
+
+    def update(self, cv: CV, mask):
+        return (jnp.sum((mask & cv.validity).astype(jnp.int64)),)
+
+    def merge(self, s1, s2):
+        return (s1[0] + s2[0],)
+
+    def finalize(self, s):
+        return s[0], jnp.bool_(True)
+
+    def g_update(self, cv, mask, seg_ids, num_segments):
+        m = (mask & cv.validity).astype(jnp.int64)
+        return (jax.ops.segment_sum(m, seg_ids, num_segments),)
+
+
+class CountStar(AggExpr):
+    state_reducers = ("sum",)
+
+    def __init__(self, child=None):
+        super().__init__(None)
+
+    def _resolve_type(self):
+        self.dtype = dt.INT64
+
+    def bind(self, schema):
+        b = CountStar()
+        b._resolve_type()
+        return b
+
+    def update(self, cv, mask):
+        return (jnp.sum(mask.astype(jnp.int64)),)
+
+    def merge(self, s1, s2):
+        return (s1[0] + s2[0],)
+
+    def finalize(self, s):
+        return s[0], jnp.bool_(True)
+
+    def g_update(self, cv, mask, seg_ids, num_segments):
+        return (jax.ops.segment_sum(mask.astype(jnp.int64), seg_ids,
+                                    num_segments),)
+
+    def __repr__(self):
+        return "count(*)"
+
+
+class _MinMax(AggExpr):
+    for_min = True
+
+    @property
+    def state_reducers(self):
+        return ("min" if self.for_min else "max", "or")
+
+    def _resolve_type(self):
+        ct = self.child.dtype
+        if ct.is_variable_width or ct.is_nested:
+            raise UnsupportedExpr(f"min/max({ct}) round-1")
+        self.dtype = ct
+
+    def _masked(self, cv, m):
+        """Mask invalid rows to the identity; for float min, NaN (greatest
+        per Spark ordering) must lose to any real value, so map it to +inf
+        (documented deviation: an all-NaN min yields +inf, not NaN)."""
+        ident = _ident(cv.data.dtype, self.for_min)
+        x = jnp.where(m, cv.data, ident)
+        if self.for_min and jnp.issubdtype(x.dtype, jnp.floating):
+            x = jnp.where(jnp.isnan(x), jnp.inf, x)
+        return x
+
+    def update(self, cv: CV, mask):
+        m = mask & cv.validity
+        x = self._masked(cv, m)
+        red = jnp.min(x) if self.for_min else jnp.max(x)
+        return (red, jnp.any(m))
+
+    def merge(self, s1, s2):
+        v = jnp.minimum(s1[0], s2[0]) if self.for_min else jnp.maximum(
+            s1[0], s2[0])
+        # all-invalid partials carry the identity, so plain min/max is safe
+        return (v, s1[1] | s2[1])
+
+    def finalize(self, s):
+        return s[0], s[1]
+
+    def g_update(self, cv, mask, seg_ids, num_segments):
+        m = mask & cv.validity
+        x = self._masked(cv, m)
+        seg = (jax.ops.segment_min if self.for_min else jax.ops.segment_max)
+        return (seg(x, seg_ids, num_segments),
+                jax.ops.segment_max(m.astype(jnp.int32), seg_ids,
+                                    num_segments) > 0)
+
+
+class Min(_MinMax):
+    for_min = True
+
+
+class Max(_MinMax):
+    for_min = False
+
+
+class Avg(AggExpr):
+    state_reducers = ("sum", "sum")
+
+    def _resolve_type(self):
+        ct = self.child.dtype
+        if isinstance(ct, dt.DecimalType):
+            s = min(ct.scale + 4, 18)
+            self.dtype = dt.DecimalType(18, s)
+            self._sum_scale = ct.scale
+        elif ct.is_integral or isinstance(ct, dt.BooleanType):
+            # Spark computes avg(long) from the wrapping int64 sum
+            self.dtype = dt.FLOAT64
+            self._sum_scale = None
+            self._int_acc = True
+        elif ct.is_numeric or isinstance(ct, dt.NullType):
+            self.dtype = dt.FLOAT64
+            self._sum_scale = None
+            self._int_acc = False
+        else:
+            raise UnsupportedExpr(f"avg({ct})")
+
+    def _acc(self, cv, m):
+        if self._sum_scale is not None or getattr(self, "_int_acc", False):
+            return jnp.where(m, cv.data, 0).astype(jnp.int64)
+        return jnp.where(m, cv.data, 0).astype(jnp.float64)
+
+    def update(self, cv: CV, mask):
+        m = mask & cv.validity
+        x = self._acc(cv, m)
+        return (jnp.sum(x), jnp.sum(m.astype(jnp.int64)))
+
+    def merge(self, s1, s2):
+        return (s1[0] + s2[0], s1[1] + s2[1])
+
+    def finalize(self, s):
+        total, cnt = s
+        valid = cnt > 0
+        safe = jnp.where(valid, cnt, 1)
+        if self._sum_scale is not None:
+            shift = self.dtype.scale - self._sum_scale
+            num = total * (10 ** shift)
+            half = safe // 2
+            adj = jnp.where(num >= 0, num + half, num - half)
+            q = adj // safe
+            r = adj - q * safe
+            q = jnp.where((r != 0) & (adj < 0), q + 1, q)
+            return q, valid
+        return total.astype(jnp.float64) / safe, valid
+
+    def g_update(self, cv, mask, seg_ids, num_segments):
+        m = mask & cv.validity
+        x = self._acc(cv, m)
+        return (jax.ops.segment_sum(x, seg_ids, num_segments),
+                jax.ops.segment_sum(m.astype(jnp.int64), seg_ids,
+                                    num_segments))
+
+
+class _FirstLast(AggExpr):
+    take_first = True
+    state_reducers = None  # grouped merge unsupported round-1
+
+    def __init__(self, child, ignore_nulls: bool = False):
+        super().__init__(child)
+        self.ignore_nulls = ignore_nulls
+
+    def bind(self, schema):
+        b = type(self)(self.child.bind(schema), self.ignore_nulls)
+        b._resolve_type()
+        return b
+
+    def _resolve_type(self):
+        ct = self.child.dtype
+        if ct.is_variable_width or ct.is_nested:
+            raise UnsupportedExpr("first/last on var-width round-1")
+        self.dtype = ct
+
+    def update(self, cv: CV, mask):
+        m = mask & (cv.validity if self.ignore_nulls else
+                    jnp.ones_like(cv.validity))
+        n = m.shape[0]
+        idxs = jnp.arange(n)
+        sentinel = n if self.take_first else -1
+        cand = jnp.where(m, idxs, sentinel)
+        pos = jnp.min(cand) if self.take_first else jnp.max(cand)
+        has = (pos < n) if self.take_first else (pos >= 0)
+        safe = jnp.clip(pos, 0, n - 1)
+        return (cv.data[safe], cv.validity[safe] & has, has)
+
+    def merge(self, s1, s2):
+        a, b = (s1, s2) if self.take_first else (s2, s1)
+        take_a = a[2]
+        return (jnp.where(take_a, a[0], b[0]),
+                jnp.where(take_a, a[1], b[1]), a[2] | b[2])
+
+    def finalize(self, s):
+        return s[0], s[1]
+
+    def g_update(self, cv, mask, seg_ids, num_segments):
+        m = mask & (cv.validity if self.ignore_nulls else
+                    jnp.ones_like(cv.validity))
+        n = m.shape[0]
+        idxs = jnp.arange(n)
+        sentinel = n if self.take_first else -1
+        cand = jnp.where(m, idxs, sentinel)
+        seg = jax.ops.segment_min if self.take_first else jax.ops.segment_max
+        pos = seg(cand, seg_ids, num_segments)
+        has = (pos < n) if self.take_first else (pos >= 0)
+        safe = jnp.clip(pos, 0, n - 1)
+        return (cv.data[safe], cv.validity[safe] & has, has)
+
+
+class First(_FirstLast):
+    take_first = True
+
+
+class Last(_FirstLast):
+    take_first = False

@@ -1,0 +1,958 @@
+"""Expression trees: resolution, Spark type coercion, and traced evaluation.
+
+The analog of the reference's GpuExpression layer (reference:
+sql-plugin/.../RapidsMeta.scala:1112 BaseExprMeta; arithmetic.scala,
+predicates.scala). Differences, TPU-first:
+
+  - An expression node's `emit(ctx)` runs *inside* a jax trace and returns a
+    `CV`; the whole bound tree therefore compiles into one fused XLA program
+    instead of a sequence of cudf kernel launches.
+  - Binding maps ColumnRef -> BoundRef(ordinal) against an input Schema, like
+    the reference's `GpuBindReferences.bindGpuReferences`.
+
+Unsupported expressions raise `UnsupportedExpr` during binding — the planner
+catches this and falls back to CPU for the enclosing operator, mirroring
+`willNotWorkOnGpu` tagging (RapidsMeta.scala:87).
+"""
+from __future__ import annotations
+
+import datetime
+import decimal
+import math
+from typing import List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.table import Schema
+from ..ops import elementwise as ew
+from ..ops.kernel_utils import CV
+
+__all__ = [
+    "Expression", "UnsupportedExpr", "EmitCtx", "ColumnRef", "BoundRef",
+    "Literal", "Alias", "Add", "Subtract", "Multiply", "Divide", "IntDivide",
+    "Remainder", "Pmod", "Negate", "Abs", "Eq", "Ne", "Lt", "Le", "Gt", "Ge",
+    "EqNullSafe", "And", "Or", "Not", "IsNull", "IsNotNull", "IsNaN", "Cast",
+    "Coalesce", "If", "CaseWhen", "In", "MathUnary", "Round", "Greatest",
+    "Least", "lit", "col",
+]
+
+
+class UnsupportedExpr(Exception):
+    """Raised at bind time when an expression cannot run on TPU."""
+
+
+class EmitCtx:
+    """Trace-time context: the input CVs and the batch capacity."""
+
+    def __init__(self, cvs: Sequence[CV], capacity: int):
+        self.cvs = list(cvs)
+        self.capacity = capacity
+
+
+class Expression:
+    children: List["Expression"] = []
+    dtype: Optional[dt.DataType] = None   # set after bind
+
+    def bind(self, schema: Schema) -> "Expression":
+        raise NotImplementedError
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        raise NotImplementedError
+
+    @property
+    def name(self) -> str:
+        return str(self)
+
+    # Fluent builder API (the DataFrame `Column` surface).
+    def alias(self, name):
+        return Alias(self, name)
+
+    def cast(self, dtype):
+        return Cast(self, dtype)
+
+    def __add__(self, o):
+        return Add(self, _wrap(o))
+
+    def __radd__(self, o):
+        return Add(_wrap(o), self)
+
+    def __sub__(self, o):
+        return Subtract(self, _wrap(o))
+
+    def __rsub__(self, o):
+        return Subtract(_wrap(o), self)
+
+    def __mul__(self, o):
+        return Multiply(self, _wrap(o))
+
+    def __rmul__(self, o):
+        return Multiply(_wrap(o), self)
+
+    def __truediv__(self, o):
+        return Divide(self, _wrap(o))
+
+    def __mod__(self, o):
+        return Remainder(self, _wrap(o))
+
+    def __neg__(self):
+        return Negate(self)
+
+    def __eq__(self, o):  # type: ignore[override]
+        return Eq(self, _wrap(o))
+
+    def __ne__(self, o):  # type: ignore[override]
+        return Ne(self, _wrap(o))
+
+    def __lt__(self, o):
+        return Lt(self, _wrap(o))
+
+    def __le__(self, o):
+        return Le(self, _wrap(o))
+
+    def __gt__(self, o):
+        return Gt(self, _wrap(o))
+
+    def __ge__(self, o):
+        return Ge(self, _wrap(o))
+
+    def __and__(self, o):
+        return And(self, _wrap(o))
+
+    def __or__(self, o):
+        return Or(self, _wrap(o))
+
+    def __invert__(self):
+        return Not(self)
+
+    def __hash__(self):
+        return id(self)
+
+    def isNull(self):
+        return IsNull(self)
+
+    def isNotNull(self):
+        return IsNotNull(self)
+
+    def isin(self, *values):
+        return In(self, [_wrap(v) for v in values])
+
+    def between(self, lo, hi):
+        return And(Ge(self, _wrap(lo)), Le(self, _wrap(hi)))
+
+
+def _wrap(v) -> Expression:
+    return v if isinstance(v, Expression) else Literal(v)
+
+
+def col(name: str) -> "ColumnRef":
+    return ColumnRef(name)
+
+
+def lit(v) -> "Literal":
+    return Literal(v)
+
+
+# ----------------------------------------------------------------------
+class ColumnRef(Expression):
+    def __init__(self, name: str):
+        self._name = name
+        self.children = []
+
+    @property
+    def name(self):
+        return self._name
+
+    def bind(self, schema: Schema):
+        idx = schema.index_of(self._name)
+        return BoundRef(idx, schema[idx].dtype, self._name)
+
+    def __repr__(self):
+        return self._name
+
+
+class BoundRef(Expression):
+    def __init__(self, ordinal: int, dtype: dt.DataType, name: str = ""):
+        self.ordinal = ordinal
+        self.dtype = dtype
+        self._name = name or f"c{ordinal}"
+        self.children = []
+
+    @property
+    def name(self):
+        return self._name
+
+    def bind(self, schema):
+        return self
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        return ctx.cvs[self.ordinal]
+
+    def __repr__(self):
+        return f"{self._name}#{self.ordinal}"
+
+
+def _infer_literal_dtype(v) -> dt.DataType:
+    if v is None:
+        return dt.NULLTYPE
+    if isinstance(v, bool):
+        return dt.BOOL
+    if isinstance(v, int):
+        return dt.INT32 if -2**31 <= v < 2**31 else dt.INT64
+    if isinstance(v, float):
+        return dt.FLOAT64
+    if isinstance(v, str):
+        return dt.STRING
+    if isinstance(v, bytes):
+        return dt.BINARY
+    if isinstance(v, decimal.Decimal):
+        sign, digits, exp = v.as_tuple()
+        scale = max(0, -exp)
+        precision = max(len(digits), scale)
+        return dt.DecimalType(precision, scale)
+    if isinstance(v, datetime.datetime):
+        return dt.TIMESTAMP
+    if isinstance(v, datetime.date):
+        return dt.DATE
+    raise UnsupportedExpr(f"cannot infer literal type for {v!r}")
+
+
+class Literal(Expression):
+    def __init__(self, value, dtype: Optional[dt.DataType] = None):
+        self.value = value
+        self.dtype = dtype or _infer_literal_dtype(value)
+        self.children = []
+
+    def bind(self, schema):
+        return self
+
+    def device_value(self):
+        v, d = self.value, self.dtype
+        if v is None:
+            return 0
+        if isinstance(d, dt.DecimalType):
+            return int(decimal.Decimal(v).scaleb(d.scale).to_integral_value(
+                rounding=decimal.ROUND_HALF_UP))
+        if isinstance(d, dt.DateType):
+            return (v - datetime.date(1970, 1, 1)).days
+        if isinstance(d, dt.TimestampType):
+            ts = v if v.tzinfo else v.replace(tzinfo=datetime.timezone.utc)
+            return int(ts.timestamp() * 1_000_000)
+        if isinstance(d, (dt.StringType, dt.BinaryType)):
+            return v
+        return v
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        cap = ctx.capacity
+        if self.value is None:
+            np_dt = self.dtype.np_dtype or np.int8
+            return CV(jnp.zeros(cap, np_dt), jnp.zeros(cap, jnp.bool_))
+        if isinstance(self.dtype, (dt.StringType, dt.BinaryType)):
+            raw = (self.value.encode() if isinstance(self.value, str)
+                   else self.value)
+            nb = len(raw)
+            if nb == 0:
+                return CV(jnp.zeros(128, jnp.uint8), jnp.ones(cap, jnp.bool_),
+                          jnp.zeros(cap + 1, jnp.int32))
+            # tile the bytes so offsets stay monotonic (Arrow invariant)
+            tiled = np.tile(np.frombuffer(raw, np.uint8), cap)
+            off = (jnp.arange(cap + 1, dtype=jnp.int32) * nb)
+            return CV(jnp.asarray(tiled), jnp.ones(cap, jnp.bool_), off)
+        return CV(jnp.full(cap, self.device_value(), self.dtype.np_dtype),
+                  jnp.ones(cap, jnp.bool_))
+
+    def __repr__(self):
+        return repr(self.value)
+
+
+class Alias(Expression):
+    def __init__(self, child: Expression, name: str):
+        self.child = child
+        self._name = name
+        self.children = [child]
+
+    @property
+    def name(self):
+        return self._name
+
+    def bind(self, schema):
+        b = Alias(self.child.bind(schema), self._name)
+        b.dtype = b.child.dtype
+        return b
+
+    def emit(self, ctx):
+        return self.child.emit(ctx)
+
+    def __repr__(self):
+        return f"{self.child} AS {self._name}"
+
+
+# ----------------------------------------------------------------------
+# Implicit cast insertion (Spark's binary-op type coercion)
+# ----------------------------------------------------------------------
+def _coerce_pair(l: Expression, r: Expression, for_division=False):
+    lt_, rt = l.dtype, r.dtype
+    if isinstance(lt_, dt.NullType):
+        l = Cast.bound(l, rt)
+        lt_ = rt
+    if isinstance(rt, dt.NullType):
+        r = Cast.bound(r, lt_)
+        rt = lt_
+    if isinstance(lt_, dt.DecimalType) or isinstance(rt, dt.DecimalType):
+        return _coerce_decimal(l, r, for_division)
+    if for_division:
+        if not lt_.is_floating:
+            l = Cast.bound(l, dt.FLOAT64)
+        if not rt.is_floating:
+            r = Cast.bound(r, dt.FLOAT64)
+        lt_, rt = l.dtype, r.dtype
+    if lt_ == rt:
+        return l, r, lt_
+    out = dt.promote(lt_, rt)
+    if lt_ != out:
+        l = Cast.bound(l, out)
+    if rt != out:
+        r = Cast.bound(r, out)
+    return l, r, out
+
+
+def _coerce_decimal(l, r, for_division):
+    # Round-1: decimal op decimal stays decimal64 when the Spark result
+    # precision fits 18; otherwise computed in float64 (documented compat
+    # deviation, see docs/compatibility.md).
+    def as_dec(e):
+        if isinstance(e.dtype, dt.DecimalType):
+            return e
+        if e.dtype.is_integral:
+            p = {1: 3, 2: 5, 4: 10, 8: 19}[e.dtype.np_dtype.itemsize]
+            return Cast.bound(e, dt.DecimalType(min(p, 18), 0))
+        raise UnsupportedExpr(f"decimal with {e.dtype}")
+    if l.dtype.is_floating or r.dtype.is_floating:
+        return (Cast.bound(l, dt.FLOAT64), Cast.bound(r, dt.FLOAT64),
+                dt.FLOAT64)
+    l, r = as_dec(l), as_dec(r)
+    return l, r, None  # result dtype decided per-op
+
+
+class _BinaryOp(Expression):
+    symbol = "?"
+
+    def __init__(self, left: Expression, right: Expression):
+        self.left, self.right = left, right
+        self.children = [left, right]
+
+    def bind(self, schema):
+        b = type(self)(self.left.bind(schema), self.right.bind(schema))
+        b._resolve_type()
+        return b
+
+    def _resolve_type(self):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"({self.left} {self.symbol} {self.right})"
+
+
+def _dec_scale_shift(cv: CV, shift: int) -> CV:
+    if shift == 0:
+        return cv
+    return CV(cv.data * (10 ** shift), cv.validity)
+
+
+class _Arith(_BinaryOp):
+    kernel = None
+
+    def _resolve_type(self):
+        self.left, self.right, out = _coerce_pair(self.left, self.right)
+        if out is None:  # decimal
+            p1, s1 = self.left.dtype.precision, self.left.dtype.scale
+            p2, s2 = self.right.dtype.precision, self.right.dtype.scale
+            s = max(s1, s2)
+            p = max(p1 - s1, p2 - s2) + s + 1
+            if p > 18:
+                self.left = Cast.bound(self.left, dt.FLOAT64)
+                self.right = Cast.bound(self.right, dt.FLOAT64)
+                self.dtype = dt.FLOAT64
+            else:
+                self.dtype = dt.DecimalType(p, s)
+        else:
+            self.dtype = out
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        if isinstance(self.dtype, dt.DecimalType):
+            s = self.dtype.scale
+            l = _dec_scale_shift(l, s - self.left.dtype.scale)
+            r = _dec_scale_shift(r, s - self.right.dtype.scale)
+        return type(self).kernel(l, r)
+
+
+class Add(_Arith):
+    symbol = "+"
+    kernel = staticmethod(ew.add)
+
+
+class Subtract(_Arith):
+    symbol = "-"
+    kernel = staticmethod(ew.sub)
+
+
+class Multiply(_BinaryOp):
+    symbol = "*"
+
+    def _resolve_type(self):
+        self.left, self.right, out = _coerce_pair(self.left, self.right)
+        if out is None:
+            p1, s1 = self.left.dtype.precision, self.left.dtype.scale
+            p2, s2 = self.right.dtype.precision, self.right.dtype.scale
+            p, s = p1 + p2 + 1, s1 + s2
+            if p > 18:
+                self.left = Cast.bound(self.left, dt.FLOAT64)
+                self.right = Cast.bound(self.right, dt.FLOAT64)
+                self.dtype = dt.FLOAT64
+            else:
+                self.dtype = dt.DecimalType(p, s)
+        else:
+            self.dtype = out
+
+    def emit(self, ctx):
+        return ew.mul(self.left.emit(ctx), self.right.emit(ctx))
+
+
+class Divide(_BinaryOp):
+    symbol = "/"
+
+    def _resolve_type(self):
+        self.left, self.right, out = _coerce_pair(self.left, self.right,
+                                                  for_division=True)
+        if out is None:
+            # Spark decimal division; round-1 computes in float64 then
+            # rescales (compat deviation for >15 significant digits).
+            p1, s1 = self.left.dtype.precision, self.left.dtype.scale
+            p2, s2 = self.right.dtype.precision, self.right.dtype.scale
+            s = max(6, s1 + p2 + 1)
+            p = p1 - s1 + s2 + s
+            if p > 18:
+                self.left = Cast.bound(self.left, dt.FLOAT64)
+                self.right = Cast.bound(self.right, dt.FLOAT64)
+                self.dtype = dt.FLOAT64
+            else:
+                self.dtype = dt.DecimalType(p, s)
+        else:
+            self.dtype = out
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        if isinstance(self.dtype, dt.DecimalType):
+            s = self.dtype.scale
+            num = l.data.astype(jnp.float64) / (10.0 ** self.left.dtype.scale)
+            den = r.data.astype(jnp.float64) / (10.0 ** self.right.dtype.scale)
+            zero = r.data == 0
+            q = jnp.where(zero, 0.0, num / jnp.where(zero, 1.0, den))
+            out = jnp.round(q * (10.0 ** s)).astype(jnp.int64)
+            return CV(out, ew.and_validity(l, r) & ~zero)
+        return ew.divide(l, r)
+
+
+class IntDivide(_BinaryOp):
+    symbol = "div"
+
+    def _resolve_type(self):
+        self.left, self.right, out = _coerce_pair(self.left, self.right)
+        if out is None or not out.is_integral:
+            if out is None:
+                self.dtype = dt.INT64
+                return
+            raise UnsupportedExpr("div on non-integral")
+        self.dtype = dt.INT64
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        if isinstance(self.left.dtype, dt.DecimalType):
+            s1, s2 = self.left.dtype.scale, self.right.dtype.scale
+            s = max(s1, s2)
+            l = _dec_scale_shift(l, s - s1)
+            r = _dec_scale_shift(r, s - s2)
+        out = ew.int_divide(l, r)
+        return CV(out.data.astype(jnp.int64), out.validity)
+
+
+class Remainder(_BinaryOp):
+    symbol = "%"
+
+    def _resolve_type(self):
+        self.left, self.right, out = _coerce_pair(self.left, self.right)
+        if out is None:
+            s = max(self.left.dtype.scale, self.right.dtype.scale)
+            p = min(18, max(self.left.dtype.precision,
+                            self.right.dtype.precision))
+            self.dtype = dt.DecimalType(p, s)
+        else:
+            self.dtype = out
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        if isinstance(self.dtype, dt.DecimalType):
+            s = self.dtype.scale
+            l = _dec_scale_shift(l, s - self.left.dtype.scale)
+            r = _dec_scale_shift(r, s - self.right.dtype.scale)
+        return ew.remainder(l, r)
+
+
+class Pmod(Remainder):
+    symbol = "pmod"
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        if isinstance(self.dtype, dt.DecimalType):
+            s = self.dtype.scale
+            l = _dec_scale_shift(l, s - self.left.dtype.scale)
+            r = _dec_scale_shift(r, s - self.right.dtype.scale)
+        return ew.pmod(l, r)
+
+
+class _UnaryOp(Expression):
+    def __init__(self, child: Expression):
+        self.child = child
+        self.children = [child]
+
+    def bind(self, schema):
+        b = type(self)(self.child.bind(schema))
+        b._resolve_type()
+        return b
+
+    def _resolve_type(self):
+        self.dtype = self.child.dtype
+
+
+class Negate(_UnaryOp):
+    def emit(self, ctx):
+        return ew.negate(self.child.emit(ctx))
+
+    def __repr__(self):
+        return f"(- {self.child})"
+
+
+class Abs(_UnaryOp):
+    def emit(self, ctx):
+        return ew.abs_(self.child.emit(ctx))
+
+    def __repr__(self):
+        return f"abs({self.child})"
+
+
+class _Comparison(_BinaryOp):
+    kernel = None
+
+    def _resolve_type(self):
+        lt_, rt = self.left.dtype, self.right.dtype
+        if lt_ != rt:
+            if isinstance(lt_, (dt.StringType,)) or isinstance(rt, dt.StringType):
+                raise UnsupportedExpr("string/non-string compare")
+            self.left, self.right, _ = _coerce_pair(self.left, self.right)
+            if self.left.dtype is None or (
+                    isinstance(self.left.dtype, dt.DecimalType)):
+                # align decimal scales for comparison
+                if isinstance(self.left.dtype, dt.DecimalType):
+                    s = max(self.left.dtype.scale, self.right.dtype.scale)
+                    self._cmp_scale = s
+        if isinstance(self.left.dtype, (dt.StringType, dt.BinaryType)):
+            raise UnsupportedExpr("string comparison lands with string ops")
+        self.dtype = dt.BOOL
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        if isinstance(self.left.dtype, dt.DecimalType):
+            s = max(self.left.dtype.scale, self.right.dtype.scale)
+            l = _dec_scale_shift(l, s - self.left.dtype.scale)
+            r = _dec_scale_shift(r, s - self.right.dtype.scale)
+        return type(self).kernel(l, r)
+
+
+class Eq(_Comparison):
+    symbol = "="
+    kernel = staticmethod(ew.eq)
+
+
+class Ne(_Comparison):
+    symbol = "!="
+    kernel = staticmethod(ew.ne)
+
+
+class Lt(_Comparison):
+    symbol = "<"
+    kernel = staticmethod(ew.lt)
+
+
+class Le(_Comparison):
+    symbol = "<="
+    kernel = staticmethod(ew.le)
+
+
+class Gt(_Comparison):
+    symbol = ">"
+    kernel = staticmethod(ew.gt)
+
+
+class Ge(_Comparison):
+    symbol = ">="
+    kernel = staticmethod(ew.ge)
+
+
+class EqNullSafe(_Comparison):
+    symbol = "<=>"
+    kernel = staticmethod(ew.eq_null_safe)
+
+
+class And(_BinaryOp):
+    symbol = "AND"
+
+    def _resolve_type(self):
+        self.dtype = dt.BOOL
+
+    def emit(self, ctx):
+        return ew.logical_and(self.left.emit(ctx), self.right.emit(ctx))
+
+
+class Or(_BinaryOp):
+    symbol = "OR"
+
+    def _resolve_type(self):
+        self.dtype = dt.BOOL
+
+    def emit(self, ctx):
+        return ew.logical_or(self.left.emit(ctx), self.right.emit(ctx))
+
+
+class Not(_UnaryOp):
+    def _resolve_type(self):
+        self.dtype = dt.BOOL
+
+    def emit(self, ctx):
+        return ew.logical_not(self.child.emit(ctx))
+
+    def __repr__(self):
+        return f"NOT {self.child}"
+
+
+class IsNull(_UnaryOp):
+    def _resolve_type(self):
+        self.dtype = dt.BOOL
+
+    def emit(self, ctx):
+        return ew.is_null(self.child.emit(ctx))
+
+    def __repr__(self):
+        return f"({self.child} IS NULL)"
+
+
+class IsNotNull(_UnaryOp):
+    def _resolve_type(self):
+        self.dtype = dt.BOOL
+
+    def emit(self, ctx):
+        return ew.is_not_null(self.child.emit(ctx))
+
+    def __repr__(self):
+        return f"({self.child} IS NOT NULL)"
+
+
+class IsNaN(_UnaryOp):
+    def _resolve_type(self):
+        self.dtype = dt.BOOL
+
+    def emit(self, ctx):
+        return ew.is_nan(self.child.emit(ctx))
+
+
+class Cast(Expression):
+    """Spark CAST. Full string<->numeric semantics live in ops/cast.py;
+    numeric/temporal casts are inline here."""
+
+    def __init__(self, child: Expression, to: dt.DataType, ansi=False):
+        self.child = child
+        self.to = to
+        self.ansi = ansi
+        self.children = [child]
+
+    @staticmethod
+    def bound(child: Expression, to: dt.DataType) -> "Cast":
+        c = Cast(child, to)
+        c.dtype = to
+        return c
+
+    def bind(self, schema):
+        b = Cast(self.child.bind(schema), self.to, self.ansi)
+        b.dtype = self.to
+        from_t = b.child.dtype
+        ok = (from_t == self.to or
+              (from_t.is_numeric and self.to.is_numeric) or
+              isinstance(from_t, dt.NullType) or
+              (isinstance(from_t, dt.BooleanType) and self.to.is_numeric) or
+              (from_t.is_numeric and isinstance(self.to, dt.BooleanType)) or
+              (isinstance(from_t, dt.TimestampType)
+               and isinstance(self.to, (dt.DateType, dt.LongType))) or
+              (isinstance(from_t, dt.DateType)
+               and isinstance(self.to, (dt.TimestampType, dt.IntegerType))) or
+              isinstance(self.to, dt.StringType))
+        if not ok:
+            raise UnsupportedExpr(f"cast {from_t} -> {self.to}")
+        if isinstance(self.to, dt.StringType) and not isinstance(
+                from_t, dt.StringType):
+            raise UnsupportedExpr("cast-to-string lands with string ops")
+        return b
+
+    def emit(self, ctx):
+        from ..ops import cast as cast_ops
+        cv = self.child.emit(ctx)
+        return cast_ops.cast_cv(cv, self.child.dtype, self.to)
+
+    def __repr__(self):
+        return f"CAST({self.child} AS {self.to})"
+
+
+class Coalesce(Expression):
+    def __init__(self, *children: Expression):
+        self.children = list(children)
+
+    def bind(self, schema):
+        bc = [c.bind(schema) for c in self.children]
+        out = next((c.dtype for c in bc
+                    if not isinstance(c.dtype, dt.NullType)), dt.NULLTYPE)
+        bc = [c if c.dtype == out else Cast.bound(c, out) for c in bc]
+        b = Coalesce(*bc)
+        b.dtype = out
+        return b
+
+    def emit(self, ctx):
+        cvs = [c.emit(ctx) for c in self.children]
+        out = cvs[-1]
+        for cv in reversed(cvs[:-1]):
+            out = CV(jnp.where(cv.validity, cv.data, out.data),
+                     cv.validity | out.validity)
+        return out
+
+    def __repr__(self):
+        return "coalesce(" + ", ".join(map(repr, self.children)) + ")"
+
+
+class If(Expression):
+    def __init__(self, pred: Expression, t: Expression, f: Expression):
+        self.pred, self.t, self.f = pred, t, f
+        self.children = [pred, t, f]
+
+    def bind(self, schema):
+        p, t, f = (c.bind(schema) for c in self.children)
+        out = t.dtype if not isinstance(t.dtype, dt.NullType) else f.dtype
+        if t.dtype != out:
+            t = Cast.bound(t, out)
+        if f.dtype != out:
+            f = Cast.bound(f, out)
+        b = If(p, t, f)
+        b.dtype = out
+        return b
+
+    def emit(self, ctx):
+        p, t, f = (c.emit(ctx) for c in self.children)
+        take_t = p.validity & p.data.astype(jnp.bool_)
+        return CV(jnp.where(take_t, t.data, f.data),
+                  jnp.where(take_t, t.validity, f.validity))
+
+    def __repr__(self):
+        return f"if({self.pred}, {self.t}, {self.f})"
+
+
+class CaseWhen(Expression):
+    """CASE WHEN p1 THEN v1 ... [ELSE d] END, built as nested If at bind."""
+
+    def __init__(self, branches, default: Optional[Expression] = None):
+        self.branches = branches
+        self.default = default
+        self.children = ([e for p, v in branches for e in (p, v)]
+                         + ([default] if default else []))
+
+    def bind(self, schema):
+        expr: Expression = self.default or Literal(None)
+        for p, v in reversed(self.branches):
+            expr = If(p, v, expr)
+        return expr.bind(schema)
+
+    def __repr__(self):
+        return "CASE WHEN ..."
+
+
+class In(Expression):
+    def __init__(self, child: Expression, values: List[Expression]):
+        self.child = child
+        self.values = values
+        self.children = [child] + values
+
+    def bind(self, schema):
+        expr: Expression = None
+        for v in self.values:
+            e = Eq(self.child, v)
+            expr = e if expr is None else Or(expr, e)
+        return (expr or Literal(False)).bind(schema)
+
+    def __repr__(self):
+        return f"{self.child} IN (...)"
+
+
+_MATH_FNS = {
+    "sqrt": jnp.sqrt, "exp": jnp.exp, "log": jnp.log, "log10": jnp.log10,
+    "log2": jnp.log2, "log1p": jnp.log1p, "sin": jnp.sin, "cos": jnp.cos,
+    "tan": jnp.tan, "asin": jnp.arcsin, "acos": jnp.arccos,
+    "atan": jnp.arctan, "sinh": jnp.sinh, "cosh": jnp.cosh,
+    "tanh": jnp.tanh, "cbrt": jnp.cbrt, "expm1": jnp.expm1,
+    "floor": jnp.floor, "ceil": jnp.ceil, "signum": jnp.sign,
+    "rint": jnp.rint, "degrees": jnp.degrees, "radians": jnp.radians,
+}
+
+
+class MathUnary(_UnaryOp):
+    """Double-valued unary math fn with Spark semantics (log(<=0) -> null)."""
+
+    def __init__(self, fn_name: str, child: Expression):
+        super().__init__(child)
+        self.fn_name = fn_name
+        if fn_name not in _MATH_FNS:
+            raise UnsupportedExpr(f"math fn {fn_name}")
+
+    def bind(self, schema):
+        b = MathUnary(self.fn_name, self.child.bind(schema))
+        if not (b.child.dtype.is_numeric or isinstance(b.child.dtype,
+                                                       dt.NullType)):
+            raise UnsupportedExpr(f"{self.fn_name} on {b.child.dtype}")
+        if b.fn_name in ("floor", "ceil") and b.child.dtype.is_integral:
+            b.dtype = dt.INT64
+        else:
+            b.dtype = dt.FLOAT64
+        return b
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        x = cv.data.astype(jnp.float64)
+        if isinstance(self.child.dtype, dt.DecimalType):
+            x = x / (10.0 ** self.child.dtype.scale)
+        valid = cv.validity
+        if self.fn_name in ("log", "log10", "log2"):
+            valid = valid & (x > 0)
+            x = jnp.where(x > 0, x, 1.0)
+        if self.fn_name == "log1p":
+            valid = valid & (x > -1)
+            x = jnp.where(x > -1, x, 0.0)
+        out = _MATH_FNS[self.fn_name](x)
+        if self.dtype == dt.INT64:
+            out = out.astype(jnp.int64)
+        return CV(out, valid)
+
+    def __repr__(self):
+        return f"{self.fn_name}({self.child})"
+
+
+class Round(Expression):
+    """round(x, d) half-up (Spark ROUND)."""
+
+    def __init__(self, child: Expression, digits: int = 0):
+        self.child = child
+        self.digits = digits
+        self.children = [child]
+
+    def bind(self, schema):
+        b = Round(self.child.bind(schema), self.digits)
+        ct = b.child.dtype
+        if isinstance(ct, dt.DecimalType):
+            b.dtype = dt.DecimalType(ct.precision,
+                                     min(ct.scale, max(self.digits, 0)))
+        elif ct.is_integral:
+            b.dtype = ct
+        else:
+            b.dtype = dt.FLOAT64
+        return b
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        ct = self.child.dtype
+        if isinstance(ct, dt.DecimalType):
+            # round HALF_UP at decimal position `digits` (may be negative)
+            drop = ct.scale - max(self.digits, 0)
+            out = cv.data
+            if drop > 0:
+                p = 10 ** drop
+                half = p // 2
+                adj = jnp.where(out >= 0, out + half, out - half)
+                q = adj // p
+                r = adj - q * p
+                out = jnp.where((r != 0) & (adj < 0), q + 1, q)
+            if self.digits < 0:
+                p = 10 ** (-self.digits)
+                half = p // 2
+                adj = jnp.where(out >= 0, out + half, out - half)
+                q = adj // p
+                r = adj - q * p
+                q = jnp.where((r != 0) & (adj < 0), q + 1, q)
+                out = q * p
+            return CV(out, cv.validity)
+        if ct.is_integral and self.digits >= 0:
+            return cv
+        if ct.is_integral:  # negative digits on ints: round at 10^-d
+            p = 10 ** (-self.digits)
+            half = p // 2
+            x = cv.data.astype(jnp.int64)
+            adj = jnp.where(x >= 0, x + half, x - half)
+            q = adj // p
+            r = adj - q * p
+            q = jnp.where((r != 0) & (adj < 0), q + 1, q)
+            return CV((q * p).astype(ct.np_dtype), cv.validity)
+        x = cv.data.astype(jnp.float64)
+        p = 10.0 ** self.digits
+        scaled = x * p
+        out = jnp.where(scaled >= 0, jnp.floor(scaled + 0.5),
+                        jnp.ceil(scaled - 0.5)) / p
+        if self.dtype.is_integral:
+            out = out.astype(ct.np_dtype)
+        return CV(out, cv.validity)
+
+    def __repr__(self):
+        return f"round({self.child}, {self.digits})"
+
+
+class _MinMaxOf(Expression):
+    is_greatest = True
+
+    def __init__(self, *children: Expression):
+        self.children = list(children)
+
+    def bind(self, schema):
+        bc = [c.bind(schema) for c in self.children]
+        out = bc[0].dtype
+        for c in bc[1:]:
+            out = dt.promote(out, c.dtype) if c.dtype != out else out
+        bc = [c if c.dtype == out else Cast.bound(c, out) for c in bc]
+        b = type(self)(*bc)
+        b.dtype = out
+        return b
+
+    def emit(self, ctx):
+        cvs = [c.emit(ctx) for c in self.children]
+        out = cvs[0]
+        for cv in cvs[1:]:
+            if self.is_greatest:
+                pick = (~out.validity |
+                        (cv.validity & ew._nan_lt(out.data, cv.data)))
+            else:
+                pick = (~out.validity |
+                        (cv.validity & ew._nan_lt(cv.data, out.data)))
+            pick = pick & cv.validity
+            out = CV(jnp.where(pick, cv.data, out.data),
+                     out.validity | cv.validity)
+        return out
+
+
+class Greatest(_MinMaxOf):
+    is_greatest = True
+
+
+class Least(_MinMaxOf):
+    is_greatest = False

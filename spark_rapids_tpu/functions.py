@@ -1,0 +1,138 @@
+"""Public column-function surface (`import spark_rapids_tpu.functions as F`).
+
+Mirrors pyspark.sql.functions naming for the subset the engine supports, so
+workloads port with an import swap. (reference expression inventory:
+GpuOverrides.scala:933-4258.)
+"""
+from __future__ import annotations
+
+from .expr import aggregates as _agg
+from .expr.expressions import (Abs, CaseWhen, Cast, Coalesce, ColumnRef,
+                               EqNullSafe, Expression, Greatest, If, In,
+                               IsNaN, IsNull, Least, Literal, MathUnary,
+                               Negate, Pmod, Round, col, lit)
+
+__all__ = [
+    "col", "lit", "expr_sum", "sum", "count", "countDistinct", "min", "max",
+    "avg", "mean", "first", "last", "when", "coalesce", "isnull", "isnan",
+    "abs", "sqrt", "exp", "log", "log10", "log2", "floor", "ceil", "round",
+    "greatest", "least", "pmod", "negate", "signum",
+]
+
+
+def sum(e):  # noqa: A001 - match pyspark naming
+    return _agg.Sum(_to_expr(e))
+
+
+def count(e):
+    if isinstance(e, str) and e == "*":
+        return _agg.CountStar()
+    return _agg.Count(_to_expr(e))
+
+
+def countDistinct(e):
+    raise NotImplementedError("count distinct lands with distinct-agg rewrite")
+
+
+def min(e):  # noqa: A001
+    return _agg.Min(_to_expr(e))
+
+
+def max(e):  # noqa: A001
+    return _agg.Max(_to_expr(e))
+
+
+def avg(e):
+    return _agg.Avg(_to_expr(e))
+
+
+mean = avg
+
+
+def first(e, ignorenulls=False):
+    return _agg.First(_to_expr(e), ignorenulls)
+
+
+def last(e, ignorenulls=False):
+    return _agg.Last(_to_expr(e), ignorenulls)
+
+
+def _to_expr(e) -> Expression:
+    if isinstance(e, Expression):
+        return e
+    if isinstance(e, str):
+        return col(e)
+    return lit(e)
+
+
+class _WhenBuilder:
+    def __init__(self, branches):
+        self._branches = branches
+
+    def when(self, cond, value):
+        return _WhenBuilder(self._branches + [(_to_expr(cond),
+                                               _to_expr(value))])
+
+    def otherwise(self, value):
+        return CaseWhen(self._branches, _to_expr(value))
+
+    # allow using the builder directly as an expression (no ELSE -> null)
+    def __getattr__(self, item):
+        return getattr(CaseWhen(self._branches, None), item)
+
+
+def when(cond, value):
+    return _WhenBuilder([(_to_expr(cond), _to_expr(value))])
+
+
+def coalesce(*exprs):
+    return Coalesce(*[_to_expr(e) for e in exprs])
+
+
+def isnull(e):
+    return IsNull(_to_expr(e))
+
+
+def isnan(e):
+    return IsNaN(_to_expr(e))
+
+
+def abs(e):  # noqa: A001
+    return Abs(_to_expr(e))
+
+
+def negate(e):
+    return Negate(_to_expr(e))
+
+
+def _math(name):
+    def fn(e):
+        return MathUnary(name, _to_expr(e))
+    fn.__name__ = name
+    return fn
+
+
+sqrt = _math("sqrt")
+exp = _math("exp")
+log = _math("log")
+log10 = _math("log10")
+log2 = _math("log2")
+floor = _math("floor")
+ceil = _math("ceil")
+signum = _math("signum")
+
+
+def round(e, scale=0):  # noqa: A001
+    return Round(_to_expr(e), scale)
+
+
+def greatest(*es):
+    return Greatest(*[_to_expr(e) for e in es])
+
+
+def least(*es):
+    return Least(*[_to_expr(e) for e in es])
+
+
+def pmod(a, b):
+    return Pmod(_to_expr(a), _to_expr(b))

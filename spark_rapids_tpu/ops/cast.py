@@ -1,0 +1,136 @@
+"""CAST kernels with Spark (non-ANSI) semantics.
+
+TPU-side analog of the reference's GpuCast
+(reference: sql-plugin/.../GpuCast.scala:286 and JNI CastStrings). Round-1
+covers numeric/bool/temporal/decimal casts; string casts land with the
+string kernel pack.
+
+Spark-specific behaviors implemented:
+  - floating -> integral saturates at the target range; NaN -> 0
+    (Scala `Double.toInt` semantics)
+  - integral -> narrower integral wraps (Java narrowing)
+  - decimal rescale rounds HALF_UP; overflow -> null (non-ANSI)
+  - timestamp -> date floors toward negative infinity
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from .kernel_utils import CV
+
+__all__ = ["cast_cv"]
+
+_INT_RANGE = {
+    dt.ByteType: (-128, 127),
+    dt.ShortType: (-32768, 32767),
+    dt.IntegerType: (-2**31, 2**31 - 1),
+    dt.LongType: (-2**63, 2**63 - 1),
+}
+
+MICROS_PER_DAY = 86400 * 1_000_000
+MICROS_PER_SEC = 1_000_000
+
+
+def _floor_div(a, b):
+    return a // b
+
+
+def cast_cv(cv: CV, from_t: dt.DataType, to_t: dt.DataType) -> CV:
+    if from_t == to_t:
+        return cv
+    if isinstance(from_t, dt.NullType):
+        np_dt = to_t.np_dtype
+        return CV(jnp.zeros(cv.capacity, np_dt),
+                  jnp.zeros(cv.capacity, jnp.bool_))
+
+    x, valid = cv.data, cv.validity
+
+    # ---- boolean source ------------------------------------------------
+    if isinstance(from_t, dt.BooleanType):
+        if isinstance(to_t, dt.DecimalType):
+            return CV(x.astype(jnp.int64) * (10 ** to_t.scale), valid)
+        return CV(x.astype(to_t.np_dtype), valid)
+
+    # ---- to boolean ----------------------------------------------------
+    if isinstance(to_t, dt.BooleanType):
+        if isinstance(from_t, dt.DecimalType):
+            return CV(x != 0, valid)
+        return CV(x != 0, valid)
+
+    # ---- temporal ------------------------------------------------------
+    if isinstance(from_t, dt.TimestampType):
+        if isinstance(to_t, dt.DateType):
+            return CV(_floor_div(x, MICROS_PER_DAY).astype(jnp.int32), valid)
+        if isinstance(to_t, dt.LongType):
+            return CV(_floor_div(x, MICROS_PER_SEC), valid)
+        raise NotImplementedError(f"cast timestamp -> {to_t}")
+    if isinstance(from_t, dt.DateType):
+        if isinstance(to_t, dt.TimestampType):
+            return CV(x.astype(jnp.int64) * MICROS_PER_DAY, valid)
+        if isinstance(to_t, dt.IntegerType):
+            return CV(x.astype(jnp.int32), valid)
+        raise NotImplementedError(f"cast date -> {to_t}")
+    if isinstance(to_t, dt.TimestampType) and from_t.is_integral:
+        return CV(x.astype(jnp.int64) * MICROS_PER_SEC, valid)
+
+    # ---- decimal source ------------------------------------------------
+    if isinstance(from_t, dt.DecimalType):
+        s = from_t.scale
+        if isinstance(to_t, dt.DecimalType):
+            return _rescale_decimal(x, valid, s, to_t)
+        if to_t.is_floating:
+            return CV((x.astype(jnp.float64) / (10.0 ** s)).astype(
+                to_t.np_dtype), valid)
+        if to_t.is_integral:
+            p = 10 ** s
+            q = x // p
+            r = x - q * p
+            q = jnp.where((r != 0) & (x < 0), q + 1, q)  # trunc toward zero
+            lo, hi = _INT_RANGE[type(to_t)]
+            ok = (q >= lo) & (q <= hi)
+            return CV(q.astype(to_t.np_dtype), valid & ok)
+        raise NotImplementedError(f"cast decimal -> {to_t}")
+
+    # ---- to decimal ----------------------------------------------------
+    if isinstance(to_t, dt.DecimalType):
+        limit = 10 ** to_t.precision
+        if from_t.is_integral:
+            scaled = x.astype(jnp.int64) * (10 ** to_t.scale)
+            ok = jnp.abs(x.astype(jnp.int64)) < 10 ** (to_t.precision
+                                                       - to_t.scale)
+            return CV(scaled, valid & ok)
+        if from_t.is_floating:
+            xf = x.astype(jnp.float64) * (10.0 ** to_t.scale)
+            scaled = jnp.where(xf >= 0, jnp.floor(xf + 0.5),
+                               jnp.ceil(xf - 0.5))
+            ok = jnp.abs(scaled) < limit
+            ok = ok & ~jnp.isnan(x)
+            return CV(scaled.astype(jnp.int64), valid & ok)
+        raise NotImplementedError(f"cast {from_t} -> decimal")
+
+    # ---- numeric -> numeric --------------------------------------------
+    if from_t.is_floating and to_t.is_integral:
+        lo, hi = _INT_RANGE[type(to_t)]
+        xf = jnp.nan_to_num(x, nan=0.0)
+        clamped = jnp.clip(xf, float(lo), float(hi))
+        return CV(clamped.astype(to_t.np_dtype), valid)
+    if from_t.is_numeric and to_t.is_numeric:
+        return CV(x.astype(to_t.np_dtype), valid)
+
+    raise NotImplementedError(f"cast {from_t} -> {to_t}")
+
+
+def _rescale_decimal(x, valid, from_scale: int, to_t: dt.DecimalType) -> CV:
+    ds = to_t.scale - from_scale
+    if ds >= 0:
+        out = x * (10 ** ds)
+    else:
+        p = 10 ** (-ds)
+        half = p // 2
+        adj = jnp.where(x >= 0, x + half, x - half)
+        q = adj // p
+        r = adj - q * p
+        out = jnp.where((r != 0) & (adj < 0), q + 1, q)
+    ok = jnp.abs(out) < 10 ** to_t.precision
+    return CV(out, valid & ok)

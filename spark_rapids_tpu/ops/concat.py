@@ -1,0 +1,62 @@
+"""Device concatenation of column values (cudf `Table.concatenate` analog).
+
+Used by batch coalescing and aggregate merge. String buffers concatenate
+with offset shifting by the full (padded) capacity of the earlier buffer —
+monotonicity is preserved because padding bytes simply become unreferenced
+gaps.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from .kernel_utils import CV
+
+__all__ = ["concat_cvs", "concat_masks", "pad_cv", "pad_mask"]
+
+
+def concat_cvs(parts: Sequence[CV], dtype: dt.DataType) -> CV:
+    if len(parts) == 1:
+        return parts[0]
+    data = jnp.concatenate([p.data for p in parts])
+    valid = jnp.concatenate([p.validity for p in parts])
+    if parts[0].offsets is None:
+        return CV(data, valid)
+    offs = []
+    shift = 0
+    for i, p in enumerate(parts):
+        o = p.offsets + shift
+        if i < len(parts) - 1:
+            o = o[:-1]
+        offs.append(o)
+        shift += p.data.shape[0]
+    return CV(data, valid, jnp.concatenate(offs))
+
+
+def concat_masks(masks: Sequence) -> jnp.ndarray:
+    return jnp.concatenate(list(masks))
+
+
+def pad_cv(cv: CV, capacity: int) -> CV:
+    cap = cv.validity.shape[0]
+    if cap >= capacity:
+        return cv
+    extra = capacity - cap
+    data = jnp.concatenate([cv.data, jnp.zeros(extra, cv.data.dtype)]) \
+        if cv.offsets is None else cv.data
+    valid = jnp.concatenate([cv.validity, jnp.zeros(extra, jnp.bool_)])
+    if cv.offsets is None:
+        return CV(data, valid)
+    last = cv.offsets[-1]
+    off = jnp.concatenate([
+        cv.offsets, jnp.broadcast_to(last, (extra,)).astype(jnp.int32)])
+    return CV(cv.data, valid, off)
+
+
+def pad_mask(mask, capacity: int):
+    cap = mask.shape[0]
+    if cap >= capacity:
+        return mask
+    return jnp.concatenate([mask, jnp.zeros(capacity - cap, jnp.bool_)])

@@ -1,0 +1,193 @@
+"""Elementwise kernels with Spark SQL semantics.
+
+Replaces the cudf elementwise kernel surface used by the reference's
+expression layer (reference: org/apache/spark/sql/rapids/arithmetic.scala,
+predicates.scala, mathExpressions.scala). Semantics implemented here:
+
+  - null propagation: result is null if any input is null (except Kleene
+    and/or, null predicates, null-safe equality)
+  - divide / remainder by zero -> null (non-ANSI Spark)
+  - integral overflow wraps (Java semantics; jnp ints wrap likewise)
+  - float NaN: Spark orders NaN greater than any value and NaN == NaN is
+    true in comparisons/grouping (reference docs/compatibility.md)
+
+All functions take/return `CV` and are pure jax — safe under jit, fused by
+XLA.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .kernel_utils import CV, and_validity
+
+__all__ = [
+    "add", "sub", "mul", "divide", "int_divide", "remainder", "pmod",
+    "negate", "abs_", "eq", "ne", "lt", "le", "gt", "ge", "eq_null_safe",
+    "logical_and", "logical_or", "logical_not", "is_null", "is_not_null",
+    "is_nan", "nan_safe_eq",
+]
+
+
+def _is_float(x) -> bool:
+    return jnp.issubdtype(x.dtype, jnp.floating)
+
+
+# ----------------------------------------------------------------------
+# Arithmetic
+# ----------------------------------------------------------------------
+def add(a: CV, b: CV) -> CV:
+    return CV(a.data + b.data, and_validity(a, b))
+
+
+def sub(a: CV, b: CV) -> CV:
+    return CV(a.data - b.data, and_validity(a, b))
+
+
+def mul(a: CV, b: CV) -> CV:
+    return CV(a.data * b.data, and_validity(a, b))
+
+
+def divide(a: CV, b: CV) -> CV:
+    """Spark `/`: output is fractional (or decimal); divisor 0 -> null."""
+    zero = b.data == 0
+    safe = jnp.where(zero, jnp.ones_like(b.data), b.data)
+    out = a.data / safe if _is_float(a.data) else a.data // safe
+    return CV(out, and_validity(a, b) & ~zero)
+
+
+def int_divide(a: CV, b: CV) -> CV:
+    """Spark `div`: integral division, divisor 0 -> null, Java truncation."""
+    zero = b.data == 0
+    safe = jnp.where(zero, jnp.ones_like(b.data), b.data)
+    # Java integer division truncates toward zero; jnp floor-divides.
+    q = a.data // safe
+    r = a.data - q * safe
+    q = jnp.where((r != 0) & ((a.data < 0) != (b.data < 0)), q + 1, q)
+    return CV(q, and_validity(a, b) & ~zero)
+
+
+def remainder(a: CV, b: CV) -> CV:
+    """Spark `%`: sign follows dividend (Java), divisor 0 -> null."""
+    zero = b.data == 0
+    safe = jnp.where(zero, jnp.ones_like(b.data), b.data)
+    r = jnp.where(zero, jnp.zeros_like(a.data),
+                  a.data - jnp.trunc(a.data / safe).astype(a.data.dtype) * safe
+                  if _is_float(a.data) else
+                  a.data - _java_div(a.data, safe) * safe)
+    return CV(r, and_validity(a, b) & ~zero)
+
+
+def _java_div(a, b):
+    q = a // b
+    r = a - q * b
+    return jnp.where((r != 0) & ((a < 0) != (b < 0)), q + 1, q)
+
+
+def pmod(a: CV, b: CV) -> CV:
+    """Spark pmod: positive modulus, divisor 0 -> null."""
+    zero = b.data == 0
+    safe = jnp.where(zero, jnp.ones_like(b.data), b.data)
+    m = jnp.mod(a.data, safe)
+    m = jnp.where(m < 0, m + jnp.abs(safe), m)
+    return CV(m, and_validity(a, b) & ~zero)
+
+
+def negate(a: CV) -> CV:
+    return CV(-a.data, a.validity)
+
+
+def abs_(a: CV) -> CV:
+    return CV(jnp.abs(a.data), a.validity)
+
+
+# ----------------------------------------------------------------------
+# Comparison (Spark NaN semantics: NaN == NaN, NaN greater than all)
+# ----------------------------------------------------------------------
+def nan_safe_eq(x, y):
+    if _is_float(x):
+        return (x == y) | (jnp.isnan(x) & jnp.isnan(y))
+    return x == y
+
+
+def _nan_lt(x, y):
+    if _is_float(x):
+        # NaN is greatest: x < y iff (x<y) or (x not NaN and y NaN)
+        return (x < y) | (~jnp.isnan(x) & jnp.isnan(y))
+    return x < y
+
+
+def eq(a: CV, b: CV) -> CV:
+    return CV(nan_safe_eq(a.data, b.data), and_validity(a, b))
+
+
+def ne(a: CV, b: CV) -> CV:
+    return CV(~nan_safe_eq(a.data, b.data), and_validity(a, b))
+
+
+def lt(a: CV, b: CV) -> CV:
+    return CV(_nan_lt(a.data, b.data), and_validity(a, b))
+
+
+def le(a: CV, b: CV) -> CV:
+    return CV(_nan_lt(a.data, b.data) | nan_safe_eq(a.data, b.data),
+              and_validity(a, b))
+
+
+def gt(a: CV, b: CV) -> CV:
+    return CV(_nan_lt(b.data, a.data), and_validity(a, b))
+
+
+def ge(a: CV, b: CV) -> CV:
+    return CV(_nan_lt(b.data, a.data) | nan_safe_eq(a.data, b.data),
+              and_validity(a, b))
+
+
+def eq_null_safe(a: CV, b: CV) -> CV:
+    """<=> : null <=> null is true; never returns null."""
+    both_null = ~a.validity & ~b.validity
+    both_valid = a.validity & b.validity
+    out = both_null | (both_valid & nan_safe_eq(a.data, b.data))
+    return CV(out, jnp.ones_like(out))
+
+
+# ----------------------------------------------------------------------
+# Boolean (Kleene three-valued logic)
+# ----------------------------------------------------------------------
+def logical_and(a: CV, b: CV) -> CV:
+    av = a.validity & a.data.astype(jnp.bool_)
+    bv = b.validity & b.data.astype(jnp.bool_)
+    af = a.validity & ~a.data.astype(jnp.bool_)
+    bf = b.validity & ~b.data.astype(jnp.bool_)
+    out = av & bv
+    valid = (af | bf) | (a.validity & b.validity)
+    return CV(out, valid)
+
+
+def logical_or(a: CV, b: CV) -> CV:
+    av = a.validity & a.data.astype(jnp.bool_)
+    bv = b.validity & b.data.astype(jnp.bool_)
+    out = av | bv
+    valid = (av | bv) | (a.validity & b.validity)
+    return CV(out, valid)
+
+
+def logical_not(a: CV) -> CV:
+    return CV(~a.data.astype(jnp.bool_), a.validity)
+
+
+# ----------------------------------------------------------------------
+# Null predicates
+# ----------------------------------------------------------------------
+def is_null(a: CV) -> CV:
+    out = ~a.validity
+    return CV(out, jnp.ones_like(out))
+
+
+def is_not_null(a: CV) -> CV:
+    return CV(a.validity, jnp.ones_like(a.validity))
+
+
+def is_nan(a: CV) -> CV:
+    if _is_float(a.data):
+        return CV(jnp.isnan(a.data), a.validity)
+    return CV(jnp.zeros_like(a.validity), a.validity)

@@ -1,0 +1,51 @@
+"""Kernel-layer value type: a traced columnar value.
+
+`CV` is the in-trace representation of a column: plain jax arrays bundled in a
+pytree so entire expression trees trace into a single XLA program (the TPU
+answer to the reference's per-kernel cudf dispatch — XLA fuses what cuDF had
+to launch as separate kernels).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["CV", "all_valid", "and_validity"]
+
+
+@jax.tree_util.register_pytree_node_class
+@dataclasses.dataclass
+class CV:
+    """Traced column value: data buffer + validity (+ offsets for strings)."""
+    data: Any                      # jnp array [capacity] (uint8 for strings)
+    validity: Any                  # jnp bool [capacity]
+    offsets: Optional[Any] = None  # jnp int32 [capacity+1] for var-width
+
+    def tree_flatten(self):
+        if self.offsets is None:
+            return (self.data, self.validity), False
+        return (self.data, self.validity, self.offsets), True
+
+    @classmethod
+    def tree_unflatten(cls, has_offsets, children):
+        if has_offsets:
+            return cls(children[0], children[1], children[2])
+        return cls(children[0], children[1], None)
+
+    @property
+    def capacity(self) -> int:
+        return self.validity.shape[0]
+
+
+def all_valid(shape_like) -> Any:
+    return jnp.ones(shape_like.shape[0], dtype=jnp.bool_)
+
+
+def and_validity(*cvs: CV):
+    v = cvs[0].validity
+    for c in cvs[1:]:
+        v = jnp.logical_and(v, c.validity)
+    return v

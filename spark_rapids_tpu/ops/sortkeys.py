@@ -1,0 +1,122 @@
+"""Order-key normalization: map any column to TPU-sortable key arrays.
+
+TPU-native replacement for cudf's comparator-based sort/groupby
+(reference: SortUtils.scala, cudf OrderByArg). Design constraint: TPU has no
+native 64-bit lanes — XLA emulates s64/f64 — and the x64 rewrite cannot
+implement f64<->s64 bitcasts. So keys avoid 64-bit bitcasts entirely:
+
+  - bool/ints/decimal/date/timestamp: the value itself (signed order);
+    descending = bitwise NOT (exact order reversal, no overflow)
+  - float32: IEEE bitcast trick on 32-bit (supported): uint32 radix key;
+    NaN canonicalized and ordered greatest (Spark), -0.0 == +0.0
+  - float64: TWO keys (isnan, canonical value). NaN rows get canonical 0.0
+    so equality/boundary checks are NaN-safe, and the isnan key orders NaN
+    greatest per Spark; -0.0 canonicalized to +0.0
+  - strings/binary: big-endian 4-byte chunks as uint32 (nchunks static
+    per trace); padding 0x00 sorts first = byte-lexicographic order
+
+Ascending argsort over the returned key list (most-significant first)
+yields Spark's ordering; `group_boundaries` on the same arrays is exact
+(no NaNs survive canonicalization).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from .kernel_utils import CV
+
+__all__ = ["order_keys", "string_chunk_keys", "lexsort", "group_boundaries",
+           "nchunks_for_len"]
+
+
+def nchunks_for_len(maxlen: int) -> int:
+    nc = max(1, -(-maxlen // 4))
+    return 1 << (nc - 1).bit_length()
+
+
+def _f32_key(x, descending):
+    x = jnp.where(x == 0, jnp.zeros_like(x), x)          # -0.0 -> +0.0
+    x = jnp.where(jnp.isnan(x), jnp.full_like(x, jnp.nan), x)
+    b = x.view(jnp.int32).view(jnp.uint32)
+    sign = jnp.uint32(0x80000000)
+    k = jnp.where((b & sign) != 0, ~b, b | sign)
+    return [~k if descending else k]
+
+
+def _f64_keys(x, descending):
+    x = jnp.where(x == 0, jnp.zeros_like(x), x)
+    nan = jnp.isnan(x)
+    canon = jnp.where(nan, jnp.zeros_like(x), x)
+    nankey = nan.astype(jnp.uint8)                        # NaN greatest
+    if descending:
+        return [~nankey, -canon]
+    return [nankey, canon]
+
+
+def order_keys(cv: CV, dtype: dt.DataType, nchunks: int = 0,
+               descending: bool = False) -> List[jnp.ndarray]:
+    """Key arrays for one column (excluding the null key), most-significant
+    first. Ascending unsigned/signed order of the keys == requested order."""
+    if isinstance(dtype, (dt.StringType, dt.BinaryType)):
+        ks = string_chunk_keys(cv, nchunks)
+        return [~k for k in ks] if descending else ks
+    x = cv.data
+    if isinstance(dtype, dt.BooleanType):
+        k = x.astype(jnp.uint8)
+        return [~k if descending else k]
+    if isinstance(dtype, dt.FloatType):
+        return _f32_key(x, descending)
+    if isinstance(dtype, dt.DoubleType):
+        return _f64_keys(x, descending)
+    if isinstance(dtype, dt.NullType):
+        return [jnp.zeros(cv.capacity, jnp.uint8)]
+    # integral / decimal / date / timestamp: natural signed order
+    return [~x if descending else x]
+
+
+def string_chunk_keys(cv: CV, nchunks: int) -> List[jnp.ndarray]:
+    """Big-endian uint32 4-byte chunk keys (32-bit native on TPU)."""
+    n = cv.offsets.shape[0] - 1
+    starts = cv.offsets[:-1]
+    lens = cv.offsets[1:] - starts
+    keys = []
+    data = cv.data
+    dcap = data.shape[0]
+    for c in range(nchunks):
+        base = starts + 4 * c
+        key = jnp.zeros(n, jnp.uint32)
+        for b in range(4):
+            pos = base + b
+            inb = (4 * c + b) < lens
+            idx = jnp.clip(pos, 0, dcap - 1)
+            byte = jnp.where(inb, data[idx], 0).astype(jnp.uint32)
+            key = (key << 8) | byte
+        keys.append(key)
+    return keys
+
+
+def lexsort(keys: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """Stable permutation ordering rows by keys[0], then keys[1], ...
+
+    Repeated stable argsort from least-significant key to most-significant
+    (LSD composition) — static shapes, fused by XLA.
+    """
+    n = keys[0].shape[0]
+    perm = jnp.arange(n)
+    for k in reversed(list(keys)):
+        order = jnp.argsort(k[perm], stable=True)
+        perm = perm[order]
+    return perm
+
+
+def group_boundaries(sorted_keys: Sequence[jnp.ndarray]) -> jnp.ndarray:
+    """bool[n]: True where row starts a new group (row 0 is True)."""
+    n = sorted_keys[0].shape[0]
+    new = jnp.zeros(n, jnp.bool_).at[0].set(True)
+    for k in sorted_keys:
+        prev = jnp.roll(k, 1)
+        new = new | (k != prev).at[0].set(True)
+    return new

@@ -1,0 +1,246 @@
+"""Logical plan nodes (the input to TpuOverrides planning).
+
+The host "Catalyst" analog: since this framework is standalone (no Spark JVM
+in-process for round 1), the DataFrame API builds these nodes directly; the
+planner (plan/planner.py) then plays the role of GpuOverrides
+(reference: GpuOverrides.scala:5017) — wrap, tag, convert to Tpu execs, and
+insert transitions.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..columnar.table import Schema, Field
+from ..expr.expressions import Alias, Expression, ColumnRef
+from ..expr import aggregates as agg
+
+__all__ = ["LogicalPlan", "InMemoryScan", "ParquetScan", "Project", "Filter",
+           "Aggregate", "Join", "Sort", "SortOrder", "Limit", "Union",
+           "Repartition"]
+
+
+class LogicalPlan:
+    children: List["LogicalPlan"] = []
+
+    @property
+    def schema(self) -> Schema:
+        raise NotImplementedError
+
+    def node_name(self) -> str:
+        return type(self).__name__
+
+    def tree_string(self, indent=0) -> str:
+        s = "  " * indent + self.describe() + "\n"
+        for c in self.children:
+            s += c.tree_string(indent + 1)
+        return s
+
+    def describe(self) -> str:
+        return self.node_name()
+
+
+class InMemoryScan(LogicalPlan):
+    """Scan over a host (pyarrow) table; batches stream host->HBM."""
+
+    def __init__(self, arrow_table):
+        self.arrow = arrow_table
+        self.children = []
+        self._schema = Schema.from_arrow(arrow_table.schema)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"InMemoryScan[rows={self.arrow.num_rows}] {self._schema}"
+
+
+class ParquetScan(LogicalPlan):
+    def __init__(self, paths: Sequence[str], schema: Optional[Schema] = None,
+                 columns: Optional[Sequence[str]] = None):
+        import pyarrow.parquet as pq
+        self.paths = list(paths)
+        self.columns = list(columns) if columns else None
+        if schema is None:
+            schema = Schema.from_arrow(pq.read_schema(self.paths[0]))
+            if self.columns:
+                schema = Schema([f for f in schema.fields
+                                 if f.name in self.columns])
+        self._schema = schema
+        self.children = []
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"ParquetScan[{len(self.paths)} files] {self._schema}"
+
+
+class Project(LogicalPlan):
+    def __init__(self, child: LogicalPlan, exprs: Sequence[Expression]):
+        self.child = child
+        self.children = [child]
+        self.exprs = list(exprs)
+        self.bound = [e.bind(child.schema) for e in self.exprs]
+        self._schema = Schema([Field(e.name, b.dtype)
+                               for e, b in zip(self.exprs, self.bound)])
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"Project[{', '.join(map(repr, self.exprs))}]"
+
+
+class Filter(LogicalPlan):
+    def __init__(self, child: LogicalPlan, condition: Expression):
+        self.child = child
+        self.children = [child]
+        self.condition = condition
+        self.bound = condition.bind(child.schema)
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    def describe(self):
+        return f"Filter[{self.condition!r}]"
+
+
+class Aggregate(LogicalPlan):
+    """Grouped or ungrouped aggregation.
+
+    aggs are (output_name, AggExpr) pairs; keys are grouping expressions.
+    """
+
+    def __init__(self, child: LogicalPlan, keys: Sequence[Expression],
+                 aggs: Sequence[Tuple[str, agg.AggExpr]]):
+        self.child = child
+        self.children = [child]
+        self.keys = list(keys)
+        self.aggs = list(aggs)
+        self.bound_keys = [k.bind(child.schema) for k in self.keys]
+        self.bound_aggs = [(n, a.bind(child.schema)) for n, a in self.aggs]
+        fields = [Field(k.name, bk.dtype)
+                  for k, bk in zip(self.keys, self.bound_keys)]
+        fields += [Field(n, a.dtype) for n, a in self.bound_aggs]
+        self._schema = Schema(fields)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return (f"Aggregate[keys={[repr(k) for k in self.keys]}, "
+                f"aggs={[n for n, _ in self.aggs]}]")
+
+
+class Join(LogicalPlan):
+    def __init__(self, left: LogicalPlan, right: LogicalPlan,
+                 left_keys: Sequence[Expression],
+                 right_keys: Sequence[Expression], how: str = "inner"):
+        assert how in ("inner", "left", "right", "full", "left_semi",
+                       "left_anti", "cross")
+        self.left, self.right = left, right
+        self.children = [left, right]
+        self.how = how
+        self.left_keys = list(left_keys)
+        self.right_keys = list(right_keys)
+        self.bound_left_keys = [k.bind(left.schema) for k in self.left_keys]
+        self.bound_right_keys = [k.bind(right.schema)
+                                 for k in self.right_keys]
+        lf = list(left.schema.fields)
+        rf = list(right.schema.fields)
+        if how in ("left_semi", "left_anti"):
+            fields = lf
+        else:
+            fields = lf + rf
+        self._schema = Schema(fields)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"Join[{self.how}, on={list(zip(self.left_keys, self.right_keys))}]"
+
+
+class SortOrder:
+    def __init__(self, expr: Expression, ascending: bool = True,
+                 nulls_first: Optional[bool] = None):
+        self.expr = expr
+        self.ascending = ascending
+        # Spark default: nulls first for asc, nulls last for desc
+        self.nulls_first = ascending if nulls_first is None else nulls_first
+
+    def __repr__(self):
+        d = "ASC" if self.ascending else "DESC"
+        nf = "NULLS FIRST" if self.nulls_first else "NULLS LAST"
+        return f"{self.expr!r} {d} {nf}"
+
+
+class Sort(LogicalPlan):
+    def __init__(self, child: LogicalPlan, orders: Sequence[SortOrder],
+                 global_sort: bool = True):
+        self.child = child
+        self.children = [child]
+        self.orders = list(orders)
+        self.global_sort = global_sort
+        self.bound_orders = [SortOrder(o.expr.bind(child.schema), o.ascending,
+                                       o.nulls_first) for o in self.orders]
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    def describe(self):
+        return f"Sort[{self.orders}]"
+
+
+class Limit(LogicalPlan):
+    def __init__(self, child: LogicalPlan, n: int):
+        self.child = child
+        self.children = [child]
+        self.n = n
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    def describe(self):
+        return f"Limit[{self.n}]"
+
+
+class Union(LogicalPlan):
+    def __init__(self, children: Sequence[LogicalPlan]):
+        self.children = list(children)
+        s0 = self.children[0].schema
+        for c in self.children[1:]:
+            if [f.dtype for f in c.schema.fields] != [f.dtype for f in
+                                                      s0.fields]:
+                raise ValueError("UNION schema mismatch")
+        self._schema = s0
+
+    @property
+    def schema(self):
+        return self._schema
+
+
+class Repartition(LogicalPlan):
+    def __init__(self, child: LogicalPlan, num_partitions: int,
+                 keys: Optional[Sequence[Expression]] = None):
+        self.child = child
+        self.children = [child]
+        self.num_partitions = num_partitions
+        self.keys = list(keys) if keys else None
+        self.bound_keys = ([k.bind(child.schema) for k in self.keys]
+                           if self.keys else None)
+
+    @property
+    def schema(self):
+        return self.child.schema
+
+    def describe(self):
+        return f"Repartition[{self.num_partitions}]"

@@ -1,0 +1,170 @@
+"""Planner: logical plan -> TPU physical plan with tagging + explain.
+
+The GpuOverrides analog (reference: GpuOverrides.scala:5017-5191 apply path;
+RapidsMeta.scala:87 tagging). Flow: wrap each logical node in a PlanMeta,
+tag it (record `willNotWorkOnTpu` reasons), then convert — per-node
+replacement rules live in `_RULES`, keyed by logical node class, mirroring
+the reference's `execs` map (GpuOverrides.scala:4801).
+
+Round-1 fallback policy: a node whose expressions cannot run on TPU raises
+at conversion with the collected reasons (transparent CPU fallback execs
+arrive with the host expression interpreter).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Type
+
+from ..config import TpuConf, EXPLAIN
+from ..exec import aggregate as agg_exec
+from ..exec import nodes as x
+from ..exec.base import TpuExec
+from ..expr.expressions import UnsupportedExpr
+from . import logical as L
+
+__all__ = ["Planner", "PlanMeta", "plan_query"]
+
+
+class PlanMeta:
+    """Wrapper recording per-node TPU support (RapidsMeta analog)."""
+
+    def __init__(self, node: L.LogicalPlan):
+        self.node = node
+        self.children = [PlanMeta(c) for c in node.children]
+        self.reasons: List[str] = []
+
+    def will_not_work(self, reason: str):
+        self.reasons.append(reason)
+
+    @property
+    def can_run_on_tpu(self) -> bool:
+        return not self.reasons
+
+    def explain_lines(self, only_not_on_tpu: bool, indent=0) -> List[str]:
+        lines = []
+        tag = ("*" if self.can_run_on_tpu else "!")
+        desc = f"{'  ' * indent}{tag} {self.node.describe()}"
+        if self.reasons:
+            desc += "  <-- cannot run on TPU because " + "; ".join(
+                self.reasons)
+        if not only_not_on_tpu or self.reasons:
+            lines.append(desc)
+        for c in self.children:
+            lines.extend(c.explain_lines(only_not_on_tpu, indent + 1))
+        return lines
+
+
+_RULES: Dict[Type[L.LogicalPlan], Callable] = {}
+
+
+def _rule(cls):
+    def deco(fn):
+        _RULES[cls] = fn
+        return fn
+    return deco
+
+
+@_rule(L.InMemoryScan)
+def _scan(meta: PlanMeta, conv, conf) -> TpuExec:
+    return x.InMemoryScanExec(meta.node.arrow, meta.node.schema)
+
+
+@_rule(L.ParquetScan)
+def _pq(meta, conv, conf):
+    n = meta.node
+    return x.ParquetScanExec(n.paths, n.schema, n.columns)
+
+
+@_rule(L.Project)
+def _project(meta, conv, conf):
+    child = conv(meta.children[0])
+    return x.ProjectExec(child, meta.node.bound, meta.node.schema)
+
+
+@_rule(L.Filter)
+def _filter(meta, conv, conf):
+    child = conv(meta.children[0])
+    return x.FilterExec(child, meta.node.bound)
+
+
+@_rule(L.Aggregate)
+def _agg(meta, conv, conf):
+    child = conv(meta.children[0])
+    n = meta.node
+    names = [nm for nm, _ in n.bound_aggs]
+    aggs = [a for _, a in n.bound_aggs]
+    if not n.keys:
+        return agg_exec.UngroupedAggExec(child, names, aggs, n.schema)
+    key_names = [k.name for k in n.keys]
+    return agg_exec.HashAggregateExec(child, key_names, n.bound_keys,
+                                      names, aggs, n.schema)
+
+
+@_rule(L.Limit)
+def _limit(meta, conv, conf):
+    return x.LimitExec(conv(meta.children[0]), meta.node.n)
+
+
+@_rule(L.Union)
+def _union(meta, conv, conf):
+    return x.UnionExec([conv(c) for c in meta.children], meta.node.schema)
+
+
+@_rule(L.Sort)
+def _sort(meta, conv, conf):
+    from ..exec.sort import SortExec
+    return SortExec(conv(meta.children[0]), meta.node.bound_orders,
+                    meta.node.schema)
+
+
+@_rule(L.Join)
+def _join(meta, conv, conf):
+    from ..exec.join import HashJoinExec
+    n = meta.node
+    return HashJoinExec(conv(meta.children[0]), conv(meta.children[1]),
+                        n.bound_left_keys, n.bound_right_keys, n.how,
+                        n.schema)
+
+
+@_rule(L.Repartition)
+def _repart(meta, conv, conf):
+    from ..exec.exchange import ShuffleExchangeExec
+    n = meta.node
+    return ShuffleExchangeExec(conv(meta.children[0]), n.num_partitions,
+                               n.bound_keys, n.schema)
+
+
+class Planner:
+    def __init__(self, conf: Optional[TpuConf] = None):
+        self.conf = conf or TpuConf()
+
+    def plan(self, root: L.LogicalPlan) -> TpuExec:
+        meta = PlanMeta(root)
+        self._tag(meta)
+        explain_mode = self.conf.explain
+        if explain_mode in ("ALL", "NOT_ON_TPU"):
+            for line in meta.explain_lines(explain_mode == "NOT_ON_TPU"):
+                print(line)
+        return self._convert(meta)
+
+    def _tag(self, meta: PlanMeta):
+        if type(meta.node) not in _RULES:
+            meta.will_not_work(
+                f"no TPU replacement rule for {meta.node.node_name()}")
+        for c in meta.children:
+            self._tag(c)
+
+    def _convert(self, meta: PlanMeta) -> TpuExec:
+        if not meta.can_run_on_tpu:
+            raise UnsupportedExpr("; ".join(meta.reasons))
+        rule = _RULES[type(meta.node)]
+        try:
+            return rule(meta, self._convert, self.conf)
+        except ModuleNotFoundError as e:
+            raise UnsupportedExpr(
+                f"{meta.node.node_name()} not yet implemented on TPU "
+                f"({e.name} missing)") from e
+
+
+def plan_query(root: L.LogicalPlan,
+               conf: Optional[TpuConf] = None) -> TpuExec:
+    return Planner(conf).plan(root)

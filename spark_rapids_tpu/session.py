@@ -1,0 +1,226 @@
+"""Session + DataFrame: the host-facing API that drives TPU execution.
+
+In the reference, Spark provides this surface and the plugin rewrites plans
+underneath (Plugin.scala:56 ColumnarOverrideRules). Standalone round-1: the
+DataFrame builds logical plans directly and `collect()` runs
+plan -> TpuOverrides-style planner -> TPU physical plan. Method names track
+pyspark.sql.DataFrame so workloads port mechanically.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+from .columnar.table import Schema
+from .config import TpuConf
+from .exec.base import ExecContext
+from .exec.nodes import collect_to_arrow
+from .expr.expressions import Expression, col, lit
+from .expr.aggregates import AggExpr
+from .functions import _to_expr
+from .plan import logical as L
+from .plan.planner import Planner
+
+__all__ = ["TpuSession", "DataFrame"]
+
+
+class TpuSession:
+    _active: Optional["TpuSession"] = None
+
+    def __init__(self, conf: Optional[Dict] = None):
+        self.conf = TpuConf(conf)
+        self.read = DataFrameReader(self)
+        TpuSession._active = self
+
+    @staticmethod
+    def builder_get_or_create(conf: Optional[Dict] = None) -> "TpuSession":
+        if TpuSession._active is None:
+            TpuSession(conf)
+        return TpuSession._active
+
+    def set_conf(self, key, value):
+        self.conf = self.conf.set(key, value)
+
+    # ------------------------------------------------------------------
+    def create_dataframe(self, data, schema=None) -> "DataFrame":
+        import pyarrow as pa
+        if isinstance(data, pa.Table):
+            at = data
+        elif isinstance(data, dict):
+            if schema is not None:
+                at = pa.table(data, schema=schema.to_arrow()
+                              if isinstance(schema, Schema) else schema)
+            else:
+                at = pa.table(data)
+        else:
+            raise TypeError("create_dataframe expects a pyarrow Table or dict")
+        return DataFrame(self, L.InMemoryScan(at))
+
+    def sql(self, query: str) -> "DataFrame":
+        from .sql.parser import parse_sql
+        return parse_sql(self, query)
+
+
+class DataFrameReader:
+    def __init__(self, session: TpuSession):
+        self._session = session
+
+    def parquet(self, *paths: str, columns=None) -> "DataFrame":
+        import glob as _glob
+        import os
+        expanded: List[str] = []
+        for p in paths:
+            if os.path.isdir(p):
+                expanded.extend(sorted(
+                    _glob.glob(os.path.join(p, "*.parquet"))))
+            elif any(ch in p for ch in "*?["):
+                expanded.extend(sorted(_glob.glob(p)))
+            else:
+                expanded.append(p)
+        return DataFrame(self._session,
+                         L.ParquetScan(expanded, columns=columns))
+
+    def csv(self, path: str, header=True, schema=None) -> "DataFrame":
+        from .io.csv import read_csv_to_arrow
+        at = read_csv_to_arrow(path, header=header, schema=schema)
+        return DataFrame(self._session, L.InMemoryScan(at))
+
+    def json(self, path: str, schema=None) -> "DataFrame":
+        from .io.json_io import read_json_to_arrow
+        at = read_json_to_arrow(path, schema=schema)
+        return DataFrame(self._session, L.InMemoryScan(at))
+
+
+class GroupedData:
+    def __init__(self, df: "DataFrame", keys: Sequence[Expression]):
+        self._df = df
+        self._keys = list(keys)
+
+    def agg(self, *aggs, **named_aggs) -> "DataFrame":
+        pairs = []
+        for a in aggs:
+            name = getattr(a, "_alias", None) or a.name
+            inner = a
+            from .expr.expressions import Alias
+            if isinstance(a, Alias):
+                name = a._name
+                inner = a.child
+            if not isinstance(inner, AggExpr):
+                raise TypeError(f"not an aggregate: {a!r}")
+            pairs.append((name, inner))
+        for name, a in named_aggs.items():
+            inner = a.child if hasattr(a, "child") and not isinstance(
+                a, AggExpr) else a
+            pairs.append((name, inner))
+        return DataFrame(self._df._session,
+                         L.Aggregate(self._df._plan, self._keys, pairs))
+
+    def count(self) -> "DataFrame":
+        from .expr.aggregates import CountStar
+        return DataFrame(self._df._session,
+                         L.Aggregate(self._df._plan, self._keys,
+                                     [("count", CountStar())]))
+
+
+class DataFrame:
+    def __init__(self, session: TpuSession, plan: L.LogicalPlan):
+        self._session = session
+        self._plan = plan
+
+    # -- plan builders --------------------------------------------------
+    @property
+    def schema(self) -> Schema:
+        return self._plan.schema
+
+    @property
+    def columns(self) -> List[str]:
+        return self._plan.schema.names
+
+    def select(self, *exprs) -> "DataFrame":
+        es = [_to_expr(e) for e in exprs]
+        return DataFrame(self._session, L.Project(self._plan, es))
+
+    def with_column(self, name: str, e) -> "DataFrame":
+        es = [col(n) for n in self.columns if n != name]
+        es.append(_to_expr(e).alias(name))
+        return DataFrame(self._session, L.Project(self._plan, es))
+
+    withColumn = with_column
+
+    def filter(self, cond) -> "DataFrame":
+        return DataFrame(self._session, L.Filter(self._plan, _to_expr(cond)))
+
+    where = filter
+
+    def group_by(self, *keys) -> GroupedData:
+        return GroupedData(self, [_to_expr(k) for k in keys])
+
+    groupBy = group_by
+
+    def agg(self, *aggs, **named) -> "DataFrame":
+        return GroupedData(self, []).agg(*aggs, **named)
+
+    def join(self, other: "DataFrame", on, how: str = "inner") -> "DataFrame":
+        if isinstance(on, str):
+            on = [on]
+        if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
+            lk = [col(c) for c in on]
+            rk = [col(c) for c in on]
+        else:
+            raise NotImplementedError("join on expressions: pass column names")
+        return DataFrame(self._session,
+                         L.Join(self._plan, other._plan, lk, rk, how))
+
+    def sort(self, *orders, ascending=True) -> "DataFrame":
+        sos = []
+        for o in orders:
+            if isinstance(o, L.SortOrder):
+                sos.append(o)
+            else:
+                sos.append(L.SortOrder(_to_expr(o), ascending))
+        return DataFrame(self._session, L.Sort(self._plan, sos))
+
+    orderBy = sort
+
+    def limit(self, n: int) -> "DataFrame":
+        return DataFrame(self._session, L.Limit(self._plan, n))
+
+    def union(self, other: "DataFrame") -> "DataFrame":
+        return DataFrame(self._session, L.Union([self._plan, other._plan]))
+
+    def repartition(self, n: int, *keys) -> "DataFrame":
+        ks = [_to_expr(k) for k in keys] or None
+        return DataFrame(self._session, L.Repartition(self._plan, n, ks))
+
+    # -- actions --------------------------------------------------------
+    def _execute(self):
+        planner = Planner(self._session.conf)
+        root = planner.plan(self._plan)
+        ctx = ExecContext(self._session.conf, self._session)
+        return root, ctx
+
+    def to_arrow(self):
+        root, ctx = self._execute()
+        return collect_to_arrow(root, ctx)
+
+    def collect(self) -> List[tuple]:
+        at = self.to_arrow()
+        cols = [at.column(i).to_pylist() for i in range(at.num_columns)]
+        return list(zip(*cols)) if cols else []
+
+    def to_pydict(self) -> Dict[str, list]:
+        return self.to_arrow().to_pydict()
+
+    def count(self) -> int:
+        from .expr.aggregates import CountStar
+        df = DataFrame(self._session,
+                       L.Aggregate(self._plan, [], [("count", CountStar())]))
+        return df.collect()[0][0]
+
+    def explain(self, mode: str = "ALL"):
+        old = self._session.conf
+        planner = Planner(old.set("spark.rapids.tpu.sql.explain", mode))
+        planner.plan(self._plan)
+
+    def write_parquet(self, path: str, **kw):
+        from .io.parquet import write_parquet
+        write_parquet(self, path, **kw)

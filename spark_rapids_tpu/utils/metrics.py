@@ -1,0 +1,45 @@
+"""Operator metrics with levels, analog of GpuMetric
+(reference: sql-plugin/.../GpuMetrics.scala:377 ESSENTIAL/MODERATE/DEBUG).
+"""
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+
+ESSENTIAL = 0
+MODERATE = 1
+DEBUG = 2
+
+__all__ = ["MetricSet", "ESSENTIAL", "MODERATE", "DEBUG"]
+
+
+class MetricSet:
+    def __init__(self):
+        self._values = {}
+        self._levels = {}
+
+    def add(self, name: str, amount, level: int = MODERATE):
+        self._values[name] = self._values.get(name, 0) + amount
+        self._levels[name] = level
+
+    def set(self, name: str, value, level: int = MODERATE):
+        self._values[name] = value
+        self._levels[name] = level
+
+    def get(self, name: str, default=0):
+        return self._values.get(name, default)
+
+    @contextmanager
+    def timer(self, name: str, level: int = MODERATE):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.add(name, time.perf_counter() - t0, level)
+
+    def snapshot(self, max_level: int = DEBUG):
+        return {k: v for k, v in self._values.items()
+                if self._levels.get(k, MODERATE) <= max_level}
+
+    def __repr__(self):
+        return f"MetricSet({self._values})"

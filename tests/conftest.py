@@ -1,0 +1,27 @@
+"""Test env: force the CPU platform with a virtual 8-device mesh so
+multi-chip sharding paths compile and run without TPU hardware (the
+analog of the reference's `local-cluster[...]` pseudo-distributed tests,
+integration_tests/README.md:205)."""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# Force the CPU backend: the axon site package overrides JAX_PLATFORMS, so
+# the env var alone is not enough.
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+import spark_rapids_tpu as st  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def session():
+    return st.TpuSession({
+        "spark.rapids.tpu.sql.batchSizeRows": 4096,
+    })

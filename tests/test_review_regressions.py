@@ -1,0 +1,75 @@
+"""Regressions for the round-1 code-review findings."""
+import decimal
+
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col, lit
+
+
+def test_decimal_ingestion_roundtrip(session):
+    vals = [decimal.Decimal("1.23"), decimal.Decimal("-45.60"), None,
+            decimal.Decimal("0.01")]
+    df = session.create_dataframe(
+        {"d": pa.array(vals, type=pa.decimal128(9, 2))})
+    assert df.to_arrow().column(0).to_pylist() == vals
+
+
+def test_decimal_arithmetic_with_literal(session):
+    df = session.create_dataframe(
+        {"d": pa.array([decimal.Decimal("1.00")], pa.decimal128(5, 2))})
+    out = df.select((col("d") + lit(decimal.Decimal("0.50"))).alias("s"))
+    assert out.to_arrow().column(0).to_pylist() == [decimal.Decimal("1.50")]
+
+
+def test_string_literal_broadcasts_all_rows(session):
+    df = session.create_dataframe({"a": [1, 2, 3]})
+    out = df.select(lit("ab").alias("s")).to_arrow()
+    assert out.column(0).to_pylist() == ["ab", "ab", "ab"]
+
+
+def test_math_on_decimal_unscales(session):
+    df = session.create_dataframe(
+        {"d": pa.array([decimal.Decimal("4.00")], pa.decimal128(5, 2))})
+    out = df.select(F.sqrt(col("d")).alias("r")).to_arrow()
+    assert out.column(0).to_pylist() == [2.0]
+
+
+def test_round_negative_digits(session):
+    df = session.create_dataframe(
+        {"d": pa.array([decimal.Decimal("123.45")], pa.decimal128(7, 2)),
+         "i": pa.array([987], pa.int32())})
+    out = df.select(F.round(col("d"), -1).alias("rd"),
+                    F.round(col("i"), -2).alias("ri")).to_arrow()
+    assert out.column(0).to_pylist() == [decimal.Decimal("120")]
+    assert out.column(1).to_pylist() == [1000]
+
+
+def test_grouped_bool_minmax_multi_batch(session):
+    import spark_rapids_tpu as st
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 64})
+    n = 300  # several batches of 64
+    ks = [i % 3 for i in range(n)]
+    bs = [(i % 7) < 3 for i in range(n)]
+    df = s.create_dataframe({"k": pa.array(ks, pa.int32()),
+                             "b": pa.array(bs, pa.bool_())})
+    out = df.group_by("k").agg(F.min("b").alias("mn"),
+                               F.max("b").alias("mx")).to_arrow()
+    got = {k: (mn, mx) for k, mn, mx in zip(*[out.column(i).to_pylist()
+                                              for i in range(3)])}
+    for k in (0, 1, 2):
+        vals = [b for kk, b in zip(ks, bs) if kk == k]
+        assert got[k] == (min(vals), max(vals))
+
+
+def test_sort_not_implemented_raises_clean(session):
+    from spark_rapids_tpu.expr.expressions import UnsupportedExpr
+    df = session.create_dataframe({"a": [3, 1, 2]})
+    try:
+        df.sort("a").collect()
+    except UnsupportedExpr as e:
+        assert "not yet implemented" in str(e)
+    except ModuleNotFoundError:
+        pytest.fail("ModuleNotFoundError leaked from planner")
+    # once exec.sort exists this test simply passes via collect
