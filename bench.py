@@ -1,0 +1,78 @@
+#!/usr/bin/env python
+"""Benchmark: TPC-H Q6 (scan+filter+reduction) on the TPU engine vs a
+vectorized single-core numpy CPU baseline (the CPU-Spark stand-in,
+BASELINE.json config #1).
+
+Both sides run over memory-resident data: the engine over an HBM-cached
+columnar table (GpuInMemoryTableScan analog), the baseline over RAM-resident
+numpy arrays — symmetric "hot table" scans, measuring the engine rather
+than the host<->device tunnel.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    sf = float(os.environ.get("BENCH_SF", "4.0"))
+    iters = int(os.environ.get("BENCH_ITERS", "5"))
+
+    import spark_rapids_tpu as st
+    from spark_rapids_tpu.workloads import tpch
+
+    at = tpch.gen_lineitem(sf=sf, seed=7)
+    n = at.num_rows
+
+    # raw arrays for the CPU baseline (unscaled decimal ints)
+    ship = at.column("l_shipdate").to_numpy()
+    rng = np.random.default_rng(7)  # same sequence as gen_lineitem
+    qty = rng.integers(1, 51, n).astype(np.int64) * 100
+    price = rng.integers(90_000, 10_500_000, n).astype(np.int64)
+    disc = rng.integers(0, 11, n).astype(np.int64)
+
+    # --- CPU baseline (RAM-resident arrays) ------------------------------
+    tpch.q6_numpy_baseline(ship, disc, qty, price)  # warm cache
+    cpu_times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        base_val = tpch.q6_numpy_baseline(ship, disc, qty, price)
+        cpu_times.append(time.perf_counter() - t0)
+    cpu_s = min(cpu_times)
+
+    # --- TPU engine (HBM-cached table) -----------------------------------
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 1 << 22})
+    cols = ["l_quantity", "l_extendedprice", "l_discount", "l_shipdate"]
+    df = s.create_dataframe(at.select(cols)).cache()
+    q = tpch.q6(df)
+    r = q.to_arrow()  # warmup: traces + compiles
+    import decimal
+    got = r.column(0).to_pylist()[0]
+    expect = decimal.Decimal(base_val).scaleb(-4)
+    assert got == expect, f"Q6 mismatch: {got} != {expect}"
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        q.to_arrow()  # cached physical plan + compiled kernels
+        times.append(time.perf_counter() - t0)
+    tpu_s = min(times)
+
+    rows_per_s = n / tpu_s
+    vs = cpu_s / tpu_s
+    print(json.dumps({
+        "metric": f"tpch_q6_sf{sf}_rows_per_sec",
+        "value": round(rows_per_s, 1),
+        "unit": "rows/s",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

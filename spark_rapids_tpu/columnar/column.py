@@ -99,6 +99,15 @@ class Column:
     @staticmethod
     def from_arrow(arr, dtype: Optional[dt.DataType] = None) -> "Column":
         """Build a device column from a pyarrow Array/ChunkedArray."""
+        dtype, n, bufs = Column.host_from_arrow(arr, dtype)
+        dev = jax.device_put(bufs)
+        return Column(dtype, n, dev["data"], dev["validity"],
+                      dev.get("offsets"))
+
+    @staticmethod
+    def host_from_arrow(arr, dtype: Optional[dt.DataType] = None):
+        """Decode a pyarrow array into host numpy buffers (no transfer).
+        Returns (dtype, length, {"data","validity"[,"offsets"]})."""
         import pyarrow as pa
         if isinstance(arr, pa.ChunkedArray):
             arr = arr.combine_chunks()
@@ -125,9 +134,9 @@ class Column:
                     if databuf is not None else np.zeros(0, np.uint8))
             dcap = bucket_capacity(max(nbytes, 1))
             offsets = _pad_to(off.astype(np.int32), cap + 1, fill=nbytes)
-            return Column(dtype, n, jnp.asarray(_pad_to(data, dcap)),
-                          jnp.asarray(_pad_to(validity, cap, False)),
-                          offsets=jnp.asarray(offsets))
+            return dtype, n, {"data": _pad_to(data, dcap),
+                              "validity": _pad_to(validity, cap, False),
+                              "offsets": offsets}
 
         if isinstance(dtype, dt.DecimalType):
             if dtype.precision > dt.DecimalType.MAX_INT64_PRECISION:
@@ -140,23 +149,23 @@ class Column:
             buf = filled.buffers()[1]
             words = np.frombuffer(buf, dtype=np.int64)
             lo = words[2 * filled.offset:2 * (filled.offset + n):2].copy()
-            return Column(dtype, n, jnp.asarray(_pad_to(lo, cap)),
-                          jnp.asarray(_pad_to(validity, cap, False)))
+            return dtype, n, {"data": _pad_to(lo, cap),
+                              "validity": _pad_to(validity, cap, False)}
 
         if isinstance(dtype, dt.TimestampType):
             micros = np.asarray(arr.fill_null(0)
                                 .cast(pa.timestamp("us")).cast(pa.int64()))
-            return Column(dtype, n, jnp.asarray(_pad_to(micros, cap)),
-                          jnp.asarray(_pad_to(validity, cap, False)))
+            return dtype, n, {"data": _pad_to(micros, cap),
+                              "validity": _pad_to(validity, cap, False)}
 
         if isinstance(dtype, dt.DateType):
             days = np.asarray(arr.fill_null(0).cast(pa.int32()))
-            return Column(dtype, n, jnp.asarray(_pad_to(days, cap)),
-                          jnp.asarray(_pad_to(validity, cap, False)))
+            return dtype, n, {"data": _pad_to(days, cap),
+                              "validity": _pad_to(validity, cap, False)}
 
         if isinstance(dtype, dt.NullType):
-            return Column(dtype, n, jnp.zeros(cap, jnp.int8),
-                          jnp.zeros(cap, jnp.bool_))
+            return dtype, n, {"data": np.zeros(cap, np.int8),
+                              "validity": np.zeros(cap, np.bool_)}
 
         if dtype.is_nested:
             raise NotImplementedError("nested from_arrow lands with nested ops")
@@ -164,8 +173,8 @@ class Column:
         values = np.asarray(arr.fill_null(
             False if isinstance(dtype, dt.BooleanType) else 0))
         values = values.astype(dtype.np_dtype, copy=False)
-        return Column(dtype, n, jnp.asarray(_pad_to(values, cap)),
-                      jnp.asarray(_pad_to(validity, cap, False)))
+        return dtype, n, {"data": _pad_to(values, cap),
+                          "validity": _pad_to(validity, cap, False)}
 
     @staticmethod
     def nulls(n: int, dtype: dt.DataType) -> "Column":
@@ -179,27 +188,39 @@ class Column:
     # ------------------------------------------------------------------
     # Export
     # ------------------------------------------------------------------
+    def device_buffers(self):
+        d = {"data": self.data, "validity": self.validity}
+        if self.offsets is not None:
+            d["offsets"] = self.offsets
+        return d
+
     def to_arrow(self):
+        from ..utils.transfer import fetch
+        bufs = fetch(self.device_buffers())
+        return Column.arrow_from_host(self.dtype, self.length, bufs)
+
+    @staticmethod
+    def arrow_from_host(dtype: dt.DataType, n: int, bufs):
+        """Assemble a pyarrow array from fetched host buffers."""
         import pyarrow as pa
-        n = self.length
-        validity = np.asarray(jax.device_get(self.validity))[:n]
-        mask = pa.array(np.logical_not(validity))
-        if isinstance(self.dtype, (dt.StringType, dt.BinaryType)):
-            off = np.asarray(jax.device_get(self.offsets))[:n + 1]
+        validity = np.asarray(bufs["validity"])[:n]
+        if isinstance(dtype, (dt.StringType, dt.BinaryType)):
+            off = np.asarray(bufs["offsets"])[:n + 1]
             nbytes = int(off[-1]) if n else 0
-            data = np.asarray(jax.device_get(self.data))[:nbytes]
-            patype = dt.to_arrow(self.dtype)
+            patype = dt.to_arrow(dtype)
+            # pass the full (padded) data buffer: offsets may not start at 0
             arr = pa.Array.from_buffers(
                 patype, n,
                 [None, pa.py_buffer(off.astype(np.int32).tobytes()),
-                 pa.py_buffer(data.tobytes())])
+                 pa.py_buffer(np.asarray(bufs["data"]).tobytes())])
             if not validity.all():
                 arr = pa.array(
-                    [v if m else None for v, m in zip(arr.to_pylist(), validity)],
+                    [v if m else None
+                     for v, m in zip(arr.to_pylist(), validity)],
                     type=patype)
             return arr
-        vals = np.asarray(jax.device_get(self.data))[:n]
-        if isinstance(self.dtype, dt.DecimalType):
+        vals = np.asarray(bufs["data"])[:n]
+        if isinstance(dtype, dt.DecimalType):
             # assemble int128 little-endian words from the unscaled int64s
             # (a cast from int64 would rescale, not reinterpret)
             lo = vals.astype(np.int64)
@@ -208,18 +229,18 @@ class Column:
             words[0::2] = lo
             words[1::2] = hi
             arr = pa.Array.from_buffers(
-                pa.decimal128(38, self.dtype.scale), n,
+                pa.decimal128(38, dtype.scale), n,
                 [None, pa.py_buffer(words.tobytes())]).cast(
-                    dt.to_arrow(self.dtype))
-        elif isinstance(self.dtype, dt.TimestampType):
+                    dt.to_arrow(dtype))
+        elif isinstance(dtype, dt.TimestampType):
             arr = pa.array(vals, type=pa.timestamp("us")).cast(
-                dt.to_arrow(self.dtype))
-        elif isinstance(self.dtype, dt.DateType):
+                dt.to_arrow(dtype))
+        elif isinstance(dtype, dt.DateType):
             arr = pa.array(vals, type=pa.int32()).cast(pa.date32())
-        elif isinstance(self.dtype, dt.NullType):
+        elif isinstance(dtype, dt.NullType):
             return pa.nulls(n)
         else:
-            arr = pa.array(vals, type=dt.to_arrow(self.dtype))
+            arr = pa.array(vals, type=dt.to_arrow(dtype))
         if not validity.all():
             arr = pa.array([v if m else None
                             for v, m in zip(arr.to_pylist(), validity)],

@@ -25,6 +25,7 @@ from ..ops import sortkeys as sk
 from ..ops.concat import concat_cvs, concat_masks, pad_cv, pad_mask
 from ..ops.gather import take
 from ..ops.kernel_utils import CV
+from ..utils.transfer import fetch_int
 from .base import ExecContext, TpuExec
 from .batch import DeviceBatch
 from .nodes import make_table
@@ -246,7 +247,7 @@ class HashAggregateExec(TpuExec):
             if isinstance(kexpr.dtype, (dt.StringType, dt.BinaryType)):
                 lens = kcv.offsets[1:] - kcv.offsets[:-1]
                 lens = jnp.where(mask & kcv.validity, lens, 0)
-                maxlen = int(jax.device_get(jnp.max(lens))) if \
+                maxlen = fetch_int((jnp.max(lens))) if \
                     lens.shape[0] else 0
                 ncs.append(sk.nchunks_for_len(max(maxlen, 1)))
             else:
@@ -273,7 +274,7 @@ class HashAggregateExec(TpuExec):
                 kcv = k.emit(EmitCtx(cvs, batch.capacity))
             lens = kcv.offsets[1:] - kcv.offsets[:-1]
             lens = jnp.where(batch.row_mask & kcv.validity, lens, 0)
-            maxlen = int(jax.device_get(jnp.max(lens)))
+            maxlen = fetch_int((jnp.max(lens)))
             ncs.append(sk.nchunks_for_len(max(maxlen, 1)))
         return tuple(ncs)
 

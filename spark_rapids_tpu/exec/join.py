@@ -1,0 +1,301 @@
+"""Hash-join execution (inner/left/right/full/semi/anti/cross).
+
+Reference: GpuShuffledHashJoinExec.scala:167 + GpuHashJoin.scala (gather-map
+join over cudf hash tables) and GpuBroadcastNestedLoopJoinExecBase for
+cross. TPU-first redesign under the static-shape regime:
+
+  1. BUILD: concat the right side into one device table.
+  2. Per stream batch, COUNT phase (one XLA program): sort the combined
+     (build + stream) keys — radix-normalized, NaN/null aware — derive
+     equality segments, count joinable build rows per segment, and for
+     every stream row its match count. Matching rows of a segment are
+     contiguous in combined-sorted space, so a (segment start, j) pair
+     addresses the j-th match directly.
+  3. Host-sync ONLY the total match count -> bucket the output capacity
+     (the cudf analog returns gather-map sizes the same way).
+  4. EXPAND phase (second XLA program, shape keyed by output bucket):
+     searchsorted over the per-row offsets builds the left/right gather
+     maps; gather payload columns from both sides.
+
+Semi/anti joins skip phases 3-4 entirely — they are a mask update on the
+stream batch. Right/full outer track per-build-row matched flags across
+stream batches and emit unmatched build rows in a final batch.
+
+Null join keys never match (SQL equi-join); NaN keys match NaN per Spark.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.column import bucket_capacity
+from ..columnar.table import Schema
+from ..expr.expressions import EmitCtx, Expression
+from ..ops import sortkeys as sk
+from ..ops.concat import concat_cvs, concat_masks
+from ..ops.gather import take
+from ..ops.kernel_utils import CV
+from ..utils.transfer import fetch_int
+from .base import ExecContext, TpuExec
+from .batch import DeviceBatch
+from .nodes import make_table
+
+__all__ = ["HashJoinExec"]
+
+
+class HashJoinExec(TpuExec):
+    def __init__(self, left: TpuExec, right: TpuExec,
+                 bound_left_keys: Sequence[Expression],
+                 bound_right_keys: Sequence[Expression], how: str,
+                 schema: Schema):
+        super().__init__([left, right], schema)
+        self.lkeys = list(bound_left_keys)
+        self.rkeys = list(bound_right_keys)
+        self.how = how
+        self._count_cache = {}
+        self._expand_cache = {}
+
+    def num_partitions(self, ctx):
+        return 1
+
+    def describe(self):
+        return f"HashJoinExec[{self.how}]"
+
+    # ------------------------------------------------------------------
+    def _collect_side(self, ctx, child, key_exprs):
+        batches = []
+        for pid in range(child.num_partitions(ctx)):
+            batches.extend(child.execute_partition(ctx, pid))
+        if not batches:
+            cvs = [CV(jnp.zeros(128, f.dtype.np_dtype or jnp.int8),
+                      jnp.zeros(128, jnp.bool_),
+                      jnp.zeros(129, jnp.int32)
+                      if f.dtype.is_variable_width else None)
+                   for f in child.schema.fields]
+            return cvs, jnp.zeros(128, jnp.bool_)
+        ncols = len(batches[0].table.columns)
+        if len(batches) == 1:
+            return batches[0].cvs(), batches[0].row_mask
+        cvs = [concat_cvs([b.cvs()[i] for b in batches],
+                          child.schema.fields[i].dtype)
+               for i in range(ncols)]
+        mask = concat_masks([b.row_mask for b in batches])
+        return cvs, mask
+
+    def _key_nchunks(self, bkey_cvs, bmask, skey_cvs, smask):
+        ncs = []
+        for i, ke in enumerate(self.lkeys):
+            if isinstance(ke.dtype, (dt.StringType, dt.BinaryType)):
+                mx = 0
+                for kcv, mk in ((bkey_cvs[i], bmask), (skey_cvs[i], smask)):
+                    lens = kcv.offsets[1:] - kcv.offsets[:-1]
+                    lens = jnp.where(mk & kcv.validity, lens, 0)
+                    mx = max(mx, fetch_int((jnp.max(lens))))
+                ncs.append(sk.nchunks_for_len(max(mx, 1)))
+            else:
+                ncs.append(0)
+        return tuple(ncs)
+
+    # ---- phase 1+2: combined sort & count (jitted) --------------------
+    def _count_fn(self, nchunks, cap_b, cap_s):
+        def fn(bkeys, bmask, skeys, smask):
+            nk = len(self.rkeys)
+            joinable_b = bmask
+            joinable_s = smask
+            comb_keys: List = []
+            for i in range(nk):
+                kb, ks_ = bkeys[i], skeys[i]
+                joinable_b = joinable_b & kb.validity
+                joinable_s = joinable_s & ks_.validity
+                comb_keys.append(concat_cvs([kb, ks_], self.rkeys[i].dtype))
+            joinable = jnp.concatenate([joinable_b, joinable_s])
+            is_build = jnp.concatenate([
+                jnp.ones(cap_b, jnp.bool_), jnp.zeros(cap_s, jnp.bool_)])
+            arrays = [jnp.logical_not(joinable).astype(jnp.uint8)]
+            for i, kcv in enumerate(comb_keys):
+                arrays.extend(sk.order_keys(kcv, self.rkeys[i].dtype,
+                                            nchunks[i]))
+            perm = sk.lexsort(arrays)
+            sorted_arrays = [a[perm] for a in arrays]
+            boundary = sk.group_boundaries(sorted_arrays)
+            seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+            n = cap_b + cap_s
+            jb_sorted = (is_build & joinable)[perm]
+            js_sorted = (joinable & ~is_build)[perm]
+            seg_bcnt = jax.ops.segment_sum(jb_sorted.astype(jnp.int64),
+                                           seg_ids, n)
+            seg_scnt = jax.ops.segment_sum(js_sorted.astype(jnp.int64),
+                                           seg_ids, n)
+            # combined-sorted position of the first joinable build row of
+            # each segment (build rows sort before stream rows? not
+            # guaranteed -> take min over build rows only)
+            pos = jnp.arange(n)
+            seg_bstart = jax.ops.segment_min(
+                jnp.where(jb_sorted, pos, n), seg_ids, n)
+            # per ORIGINAL stream row: its segment & match count
+            seg_of_comb = jnp.zeros(n, jnp.int32).at[perm].set(seg_ids)
+            seg_of_stream = seg_of_comb[cap_b:]
+            cnt = jnp.where(joinable_s, seg_bcnt[seg_of_stream], 0)
+            bstart_of_stream = seg_bstart[seg_of_stream]
+            # matched flags for build rows (right/full outer)
+            matched_comb = jb_sorted & (seg_scnt[seg_ids] > 0)
+            matched_orig = jnp.zeros(n, jnp.bool_).at[perm].set(matched_comb)
+            matched_b = matched_orig[:cap_b]
+            offsets = jnp.cumsum(cnt) - cnt
+            total = jnp.sum(cnt)
+            return (cnt, offsets, total, bstart_of_stream, perm, matched_b)
+        return fn
+
+    # ---- phase 3: expansion (jitted, keyed by out capacity) ------------
+    def _expand_fn(self, out_cap, cap_b, with_left_nulls):
+        def fn(cnt, offsets, bstart_of_stream, perm, smask):
+            t = jnp.arange(out_cap, dtype=jnp.int64)
+            # stream row for each output slot
+            i = jnp.searchsorted(offsets + cnt, t, side="right")
+            cap_s = cnt.shape[0]
+            if with_left_nulls:
+                # left/full: unmatched live stream rows produce one row
+                eff_cnt = jnp.where(smask & (cnt == 0), 1, cnt)
+                offs = jnp.cumsum(eff_cnt) - eff_cnt
+                i = jnp.searchsorted(offs + eff_cnt, t, side="right")
+                i = jnp.clip(i, 0, cap_s - 1)
+                j = t - offs[i]
+                matched = cnt[i] > 0
+                total = jnp.sum(eff_cnt)
+            else:
+                i = jnp.clip(i, 0, cap_s - 1)
+                j = t - offsets[i]
+                matched = cnt[i] > 0
+                total = jnp.sum(cnt)
+            in_bounds = t < total
+            comb_pos = bstart_of_stream[i] + j
+            comb_pos = jnp.clip(comb_pos, 0, perm.shape[0] - 1)
+            b_orig = perm[comb_pos]           # original combined index
+            b_orig = jnp.clip(b_orig, 0, cap_b - 1)
+            lgather = i.astype(jnp.int32)
+            rgather = b_orig.astype(jnp.int32)
+            rvalid = matched & in_bounds
+            lvalid = in_bounds
+            return lgather, rgather, lvalid, rvalid, total
+        return fn
+
+    # ------------------------------------------------------------------
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        if self.how == "cross":
+            yield from self._execute_cross(ctx)
+            return
+        m = ctx.metrics_for(self._op_id)
+        left, right = self.children
+        with m.timer("buildTime"):
+            bcvs, bmask = self._collect_side(ctx, right, self.rkeys)
+            cap_b = bmask.shape[0]
+            bctx = EmitCtx(bcvs, cap_b)
+            bkey_cvs = [k.emit(bctx) for k in self.rkeys]
+        matched_b_acc = jnp.zeros(cap_b, jnp.bool_)
+        nl = len(left.schema.fields)
+
+        for lpid in range(left.num_partitions(ctx)):
+            for batch in left.execute_partition(ctx, lpid):
+                with m.timer("opTime"):
+                    scvs, smask = batch.cvs(), batch.row_mask
+                    cap_s = batch.capacity
+                    sctx = EmitCtx(scvs, cap_s)
+                    skey_cvs = [k.emit(sctx) for k in self.lkeys]
+                    nchunks = self._key_nchunks(bkey_cvs, bmask,
+                                                skey_cvs, smask)
+                    ckey = (nchunks, cap_b, cap_s)
+                    cfn = self._count_cache.get(ckey)
+                    if cfn is None:
+                        cfn = jax.jit(self._count_fn(nchunks, cap_b, cap_s))
+                        self._count_cache[ckey] = cfn
+                    (cnt, offsets, total, bstart, perm,
+                     matched_b) = cfn(bkey_cvs, bmask, skey_cvs, smask)
+                    if self.how in ("right", "full"):
+                        matched_b_acc = matched_b_acc | matched_b
+                    if self.how == "left_semi":
+                        yield DeviceBatch(batch.table, batch.num_rows,
+                                          smask & (cnt > 0), cap_s)
+                        continue
+                    if self.how == "left_anti":
+                        yield DeviceBatch(batch.table, batch.num_rows,
+                                          smask & (cnt == 0), cap_s)
+                        continue
+                    with_left_nulls = self.how in ("left", "full")
+                    if with_left_nulls:
+                        eff = jnp.where(smask & (cnt == 0), 1, cnt)
+                        n_out = fetch_int((jnp.sum(eff)))
+                    else:
+                        n_out = fetch_int((total))
+                    if n_out == 0:
+                        continue
+                    out_cap = bucket_capacity(n_out)
+                    ekey = (out_cap, cap_b, cap_s, with_left_nulls)
+                    efn = self._expand_cache.get(ekey)
+                    if efn is None:
+                        efn = jax.jit(self._expand_fn(out_cap, cap_b,
+                                                      with_left_nulls))
+                        self._expand_cache[ekey] = efn
+                    lg, rg, lvalid, rvalid, _ = efn(cnt, offsets, bstart,
+                                                    perm, smask)
+                    out_cvs = [take(cv, lg, in_bounds=lvalid)
+                               for cv in scvs]
+                    out_cvs += [take(cv, rg, in_bounds=rvalid)
+                                for cv in bcvs]
+                    tbl = make_table(self.schema, out_cvs, n_out)
+                m.add("numOutputRows", n_out)
+                m.add("numOutputBatches", 1)
+                yield DeviceBatch(tbl, n_out,
+                                  jnp.arange(out_cap) < n_out, out_cap)
+
+        if self.how in ("right", "full"):
+            unmatched = bmask & ~matched_b_acc
+            n_un = fetch_int((jnp.sum(unmatched)))
+            if n_un > 0:
+                # emit unmatched build rows with null left columns
+                idx = jnp.arange(cap_b, dtype=jnp.int32)
+                out_cvs = []
+                for f in left.schema.fields:
+                    np_dt = f.dtype.np_dtype or jnp.int8
+                    cv = CV(jnp.zeros(cap_b, np_dt),
+                            jnp.zeros(cap_b, jnp.bool_),
+                            jnp.zeros(cap_b + 1, jnp.int32)
+                            if f.dtype.is_variable_width else None)
+                    out_cvs.append(cv)
+                out_cvs += [CV(cv.data, cv.validity & unmatched, cv.offsets)
+                            for cv in bcvs]
+                tbl = make_table(self.schema, out_cvs, cap_b)
+                yield DeviceBatch(tbl, cap_b, unmatched, cap_b)
+
+    # ------------------------------------------------------------------
+    def _execute_cross(self, ctx: ExecContext):
+        m = ctx.metrics_for(self._op_id)
+        left, right = self.children
+        bcvs, bmask = self._collect_side(ctx, right, [])
+        cap_b = bmask.shape[0]
+        # densify build side row ids on host once
+        bidx = jnp.nonzero(bmask, size=cap_b, fill_value=0)[0]
+        n_b = fetch_int((jnp.sum(bmask)))
+        for lpid in range(left.num_partitions(ctx)):
+            for batch in left.execute_partition(ctx, lpid):
+                scvs, smask = batch.cvs(), batch.row_mask
+                cap_s = batch.capacity
+                sidx = jnp.nonzero(smask, size=cap_s, fill_value=0)[0]
+                n_s = fetch_int((jnp.sum(smask)))
+                n_out = n_s * n_b
+                if n_out == 0:
+                    continue
+                out_cap = bucket_capacity(n_out)
+                t = jnp.arange(out_cap)
+                li = sidx[jnp.clip(t // max(n_b, 1), 0, cap_s - 1)]
+                ri = bidx[jnp.clip(t % max(n_b, 1), 0, cap_b - 1)]
+                inb = t < n_out
+                out_cvs = [take(cv, li.astype(jnp.int32), in_bounds=inb)
+                           for cv in scvs]
+                out_cvs += [take(cv, ri.astype(jnp.int32), in_bounds=inb)
+                            for cv in bcvs]
+                tbl = make_table(self.schema, out_cvs, n_out)
+                m.add("numOutputRows", n_out)
+                yield DeviceBatch(tbl, n_out, inb, out_cap)

@@ -22,7 +22,8 @@ from ..ops.kernel_utils import CV
 from .base import ExecContext, TpuExec
 from .batch import DeviceBatch
 
-__all__ = ["InMemoryScanExec", "ParquetScanExec", "ProjectExec", "FilterExec",
+__all__ = ["InMemoryScanExec", "CachedScanExec", "ParquetScanExec",
+           "ProjectExec", "FilterExec",
            "LimitExec", "UnionExec", "collect_to_arrow", "cv_to_column",
            "make_table"]
 
@@ -62,7 +63,7 @@ class InMemoryScanExec(TpuExec):
             tbl = Table.from_arrow(sl)
         m.add("numOutputRows", max(n, 0))
         m.add("numOutputBatches", 1)
-        yield DeviceBatch(tbl)
+        yield DeviceBatch(tbl, num_rows=max(n, 0))
 
 
 class ParquetScanExec(TpuExec):
@@ -87,14 +88,30 @@ class ParquetScanExec(TpuExec):
         path = self.paths[pid]
         per = max(1, ctx.conf.batch_size_rows)
         pf = pq.ParquetFile(path)
-        cols = self.columns or [f.name for f in self.schema.fields]
+        cols = (self.columns if self.columns is not None
+                else [f.name for f in self.schema.fields])
         for rb in pf.iter_batches(batch_size=per, columns=cols):
             with m.timer("scanTime"):
                 import pyarrow as pa
                 tbl = Table.from_arrow(pa.table(rb))
             m.add("numOutputRows", rb.num_rows)
             m.add("numOutputBatches", 1)
-            yield DeviceBatch(tbl)
+            yield DeviceBatch(tbl, num_rows=rb.num_rows)
+
+
+class CachedScanExec(TpuExec):
+    """Serves HBM-resident batches directly (GpuInMemoryTableScan analog)."""
+
+    def __init__(self, batches, schema: Schema):
+        super().__init__([], schema)
+        self.batches = list(batches)
+
+    def num_partitions(self, ctx):
+        return max(1, len(self.batches))
+
+    def execute_partition(self, ctx, pid):
+        if pid < len(self.batches):
+            yield self.batches[pid]
 
 
 # ----------------------------------------------------------------------
@@ -208,10 +225,18 @@ def collect_to_arrow(root: TpuExec, ctx: ExecContext):
     """Run the plan and materialize a host pyarrow Table (the analog of
     GpuColumnarToRowExec + collect)."""
     import pyarrow as pa
+    from ..columnar.column import Column
     pieces = []
     for batch in root.execute_all(ctx):
-        at = batch.table.to_arrow()
-        mask = np.asarray(jax.device_get(batch.row_mask))[:batch.num_rows]
+        # fetch the mask together with all column buffers: ONE device_get
+        from ..utils.transfer import fetch
+        host = fetch([c.device_buffers() for c in batch.table.columns]
+                     + [batch.row_mask])
+        mask = np.asarray(host[-1])[:batch.num_rows]
+        arrs = [Column.arrow_from_host(c.dtype, c.length, b)
+                for c, b in zip(batch.table.columns, host[:-1])]
+        at = (pa.Table.from_arrays(arrs, names=list(batch.table.names))
+              if arrs else pa.table({}))
         if at.num_rows == 0 and batch.num_rows > 0:
             # zero-column batch (e.g. count(*) pipelines)
             pieces.append(pa.table({}))

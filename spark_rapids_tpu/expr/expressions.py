@@ -712,6 +712,20 @@ class Cast(Expression):
         return f"CAST({self.child} AS {self.to})"
 
 
+def _select_cv(pick_a, a: CV, b: CV, out_valid) -> CV:
+    """Row-wise select between two CVs; handles var-width via a gather
+    over the concatenation of both buffers."""
+    if a.offsets is not None or b.offsets is not None:
+        from ..ops.concat import concat_cvs
+        from ..ops.gather import take_strings
+        combined = concat_cvs([a, b], dt.STRING)
+        cap = pick_a.shape[0]
+        idx = jnp.where(pick_a, jnp.arange(cap), cap + jnp.arange(cap))
+        out = take_strings(combined, idx.astype(jnp.int32))
+        return CV(out.data, out_valid, out.offsets)
+    return CV(jnp.where(pick_a, a.data, b.data), out_valid)
+
+
 class Coalesce(Expression):
     def __init__(self, *children: Expression):
         self.children = list(children)
@@ -729,8 +743,7 @@ class Coalesce(Expression):
         cvs = [c.emit(ctx) for c in self.children]
         out = cvs[-1]
         for cv in reversed(cvs[:-1]):
-            out = CV(jnp.where(cv.validity, cv.data, out.data),
-                     cv.validity | out.validity)
+            out = _select_cv(cv.validity, cv, out, cv.validity | out.validity)
         return out
 
     def __repr__(self):
@@ -756,8 +769,8 @@ class If(Expression):
     def emit(self, ctx):
         p, t, f = (c.emit(ctx) for c in self.children)
         take_t = p.validity & p.data.astype(jnp.bool_)
-        return CV(jnp.where(take_t, t.data, f.data),
-                  jnp.where(take_t, t.validity, f.validity))
+        out_valid = jnp.where(take_t, t.validity, f.validity)
+        return _select_cv(take_t, t, f, out_valid)
 
     def __repr__(self):
         return f"if({self.pred}, {self.t}, {self.f})"

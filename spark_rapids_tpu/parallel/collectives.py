@@ -1,0 +1,65 @@
+"""ICI partition exchange: the all-to-all shuffle core.
+
+Replaces the reference's UCX peer-to-peer transfer path
+(reference: shuffle-plugin/.../UCXShuffleTransport.scala:49,
+RapidsShuffleClient/Server) with a single XLA collective: rows are bucketed
+by target shard inside each shard (one stable sort, static shapes), then
+`jax.lax.all_to_all` moves the buckets over ICI. No bounce buffers, no tag
+matching, no flow control — XLA schedules the transfer.
+
+All functions here run INSIDE shard_map (they reference an axis name).
+Bucket capacity is static = the shard's batch capacity (safe upper bound:
+all local rows could target one shard). A tighter 2x-expected bucket with
+overflow retry is the planned optimization.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["exchange_rows"]
+
+
+def exchange_rows(arrays: Sequence[jnp.ndarray], mask, pids,
+                  n_shards: int, axis_name: str = "data"):
+    """Exchange rows so each row lands on shard `pids[row]`.
+
+    arrays: per-shard [cap] buffers (fixed-width row payloads).
+    mask:   bool[cap] live rows.
+    pids:   int32[cap] target shard per row (garbage where dead).
+
+    Returns (out_arrays [n*cap], out_mask [n*cap]) on each shard: the rows
+    received from all shards, dead-padded.
+    """
+    cap = mask.shape[0]
+    eff_pid = jnp.where(mask, pids, n_shards)      # dead rows -> bucket n
+    order = jnp.argsort(eff_pid, stable=True)
+    pid_sorted = eff_pid[order]
+    # rank within each target bucket
+    ranks = jnp.arange(cap)
+    bucket_start = jnp.searchsorted(pid_sorted, jnp.arange(n_shards + 1),
+                                    side="left")
+    rank_in_bucket = ranks - bucket_start[jnp.clip(pid_sorted, 0, n_shards)]
+    live_sorted = pid_sorted < n_shards
+
+    safe_pid = jnp.clip(pid_sorted, 0, n_shards - 1)
+    safe_rank = jnp.clip(rank_in_bucket, 0, cap - 1)
+
+    out_arrays = []
+    for a in arrays:
+        a_sorted = a[order]
+        send = jnp.zeros((n_shards, cap), a.dtype)
+        # scatter-add: dead rows contribute identity even when their
+        # clipped (pid, rank) collides with a live slot
+        send = send.at[safe_pid, safe_rank].add(
+            jnp.where(live_sorted, a_sorted, jnp.zeros_like(a_sorted)))
+        recv = jax.lax.all_to_all(send, axis_name, split_axis=0,
+                                  concat_axis=0, tiled=False)
+        out_arrays.append(recv.reshape(-1))
+    send_mask = jnp.zeros((n_shards, cap), jnp.bool_)
+    send_mask = send_mask.at[safe_pid, safe_rank].max(live_sorted)
+    recv_mask = jax.lax.all_to_all(send_mask, axis_name, split_axis=0,
+                                   concat_axis=0, tiled=False)
+    return out_arrays, recv_mask.reshape(-1)

@@ -14,7 +14,7 @@ from ..columnar.table import Schema, Field
 from ..expr.expressions import Alias, Expression, ColumnRef
 from ..expr import aggregates as agg
 
-__all__ = ["LogicalPlan", "InMemoryScan", "ParquetScan", "Project", "Filter",
+__all__ = ["LogicalPlan", "InMemoryScan", "CachedScan", "ParquetScan", "Project", "Filter",
            "Aggregate", "Join", "Sort", "SortOrder", "Limit", "Union",
            "Repartition"]
 
@@ -55,15 +55,34 @@ class InMemoryScan(LogicalPlan):
         return f"InMemoryScan[rows={self.arrow.num_rows}] {self._schema}"
 
 
+class CachedScan(LogicalPlan):
+    """Scan over HBM-resident device batches — the analog of the
+    reference's GpuInMemoryTableScanExec + ParquetCachedBatchSerializer
+    (reference: ParquetCachedBatchSerializer.scala): df.cache() pins the
+    columnar data on device so repeated queries skip host decode + H2D."""
+
+    def __init__(self, batches, schema):
+        self.batches = list(batches)
+        self._schema = schema
+        self.children = []
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"CachedScan[{len(self.batches)} device batches] {self._schema}"
+
+
 class ParquetScan(LogicalPlan):
     def __init__(self, paths: Sequence[str], schema: Optional[Schema] = None,
                  columns: Optional[Sequence[str]] = None):
         import pyarrow.parquet as pq
         self.paths = list(paths)
-        self.columns = list(columns) if columns else None
+        self.columns = list(columns) if columns is not None else None
         if schema is None:
             schema = Schema.from_arrow(pq.read_schema(self.paths[0]))
-            if self.columns:
+            if self.columns is not None:
                 schema = Schema([f for f in schema.fields
                                  if f.name in self.columns])
         self._schema = schema

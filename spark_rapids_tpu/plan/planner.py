@@ -68,6 +68,12 @@ def _scan(meta: PlanMeta, conv, conf) -> TpuExec:
     return x.InMemoryScanExec(meta.node.arrow, meta.node.schema)
 
 
+@_rule(L.CachedScan)
+def _cached(meta, conv, conf):
+    from ..exec.nodes import CachedScanExec
+    return CachedScanExec(meta.node.batches, meta.node.schema)
+
+
 @_rule(L.ParquetScan)
 def _pq(meta, conv, conf):
     n = meta.node
@@ -138,6 +144,8 @@ class Planner:
         self.conf = conf or TpuConf()
 
     def plan(self, root: L.LogicalPlan) -> TpuExec:
+        from .optimizer import optimize
+        root = optimize(root)
         meta = PlanMeta(root)
         self._tag(meta)
         explain_mode = self.conf.explain

@@ -167,8 +167,34 @@ class DataFrame:
             rk = [col(c) for c in on]
         else:
             raise NotImplementedError("join on expressions: pass column names")
-        return DataFrame(self._session,
-                         L.Join(self._plan, other._plan, lk, rk, how))
+        jplan = L.Join(self._plan, other._plan, lk, rk, how)
+        if how in ("left_semi", "left_anti"):
+            return DataFrame(self._session, jplan)
+        # pyspark semantics: the `on` columns appear once, then left rest,
+        # then right rest. For right joins take the key from the right side;
+        # for full outer coalesce both sides.
+        from .expr.expressions import BoundRef, Coalesce
+        nl = len(self._plan.schema.fields)
+        on_set = set(on)
+        exprs = []
+        jschema = jplan.schema
+        for name in on:
+            li = self._plan.schema.index_of(name)
+            ri = nl + other._plan.schema.index_of(name)
+            lref = BoundRef(li, jschema[li].dtype, name)
+            rref = BoundRef(ri, jschema[ri].dtype, name)
+            if how == "right":
+                exprs.append(rref)
+            elif how == "full":
+                c = Coalesce(lref, rref)
+                exprs.append(c.alias(name))
+            else:
+                exprs.append(lref)
+        for i, f in enumerate(jschema.fields):
+            if f.name in on_set:
+                continue
+            exprs.append(BoundRef(i, f.dtype, f.name))
+        return DataFrame(self._session, L.Project(jplan, exprs))
 
     def sort(self, *orders, ascending=True) -> "DataFrame":
         sos = []
@@ -191,10 +217,27 @@ class DataFrame:
         ks = [_to_expr(k) for k in keys] or None
         return DataFrame(self._session, L.Repartition(self._plan, n, ks))
 
+    def cache(self) -> "DataFrame":
+        """Materialize this DataFrame into HBM-resident device batches
+        (GpuInMemoryTableScan analog); later queries skip decode + H2D."""
+        root, ctx = self._execute()
+        batches = list(root.execute_all(ctx))
+        return DataFrame(self._session,
+                         L.CachedScan(batches, self._plan.schema))
+
     # -- actions --------------------------------------------------------
+    _cached: Optional[tuple] = None
+
     def _execute(self):
-        planner = Planner(self._session.conf)
-        root = planner.plan(self._plan)
+        # Cache the physical plan: exec nodes own their jitted kernels, so
+        # re-collecting a DataFrame reuses compiled programs (the analog of
+        # Spark's executedPlan reuse).
+        if self._cached is not None and self._cached[0] is self._session.conf:
+            root = self._cached[1]
+        else:
+            planner = Planner(self._session.conf)
+            root = planner.plan(self._plan)
+            self._cached = (self._session.conf, root)
         ctx = ExecContext(self._session.conf, self._session)
         return root, ctx
 

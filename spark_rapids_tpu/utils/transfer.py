@@ -1,0 +1,28 @@
+"""Device<->host transfer helpers.
+
+On tunneled TPU runtimes each D2H copy pays a large fixed latency; issuing
+`copy_to_host_async` on every leaf before `device_get` overlaps those
+latencies (measured ~6x on a 6-leaf fetch). This is the engine's single
+D2H chokepoint — all exports and host syncs go through `fetch`.
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["fetch", "fetch_int"]
+
+
+def fetch(tree):
+    leaves = jax.tree_util.tree_leaves(tree)
+    for leaf in leaves:
+        copy_async = getattr(leaf, "copy_to_host_async", None)
+        if copy_async is not None:
+            try:
+                copy_async()
+            except Exception:
+                pass
+    return jax.device_get(tree)
+
+
+def fetch_int(x) -> int:
+    return int(fetch(x))

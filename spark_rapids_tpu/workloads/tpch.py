@@ -1,0 +1,95 @@
+"""TPC-H workload: schema, data generation, and query definitions.
+
+The perf harness analog of the reference's datagen/ScaleTest
+(reference: datagen/ScaleTest.md). Decimal columns use precisions that keep
+the engine on the decimal64 (int64) path — exact fixed-point arithmetic
+without f64 emulation on TPU.
+"""
+from __future__ import annotations
+
+import numpy as np
+import pyarrow as pa
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col, lit
+
+LINEITEM_ROWS_PER_SF = 6_001_215
+
+
+def dec_from_unscaled(vals: np.ndarray, precision: int, scale: int):
+    """Build a decimal128 array whose UNSCALED value is `vals` (a cast from
+    int64 would rescale instead)."""
+    n = len(vals)
+    lo = vals.astype(np.int64)
+    hi = np.where(lo < 0, np.int64(-1), np.int64(0))
+    words = np.empty(2 * n, np.int64)
+    words[0::2] = lo
+    words[1::2] = hi
+    return pa.Array.from_buffers(
+        pa.decimal128(38, scale), n,
+        [None, pa.py_buffer(words.tobytes())]).cast(
+            pa.decimal128(precision, scale))
+
+
+def gen_lineitem(sf: float = 0.1, seed: int = 0) -> pa.Table:
+    n = int(LINEITEM_ROWS_PER_SF * sf)
+    rng = np.random.default_rng(seed)
+    qty = rng.integers(1, 51, n).astype(np.int64) * 100          # dec(12,2)
+    price = rng.integers(90_000, 10_500_000, n).astype(np.int64)  # dec(12,2)
+    disc = rng.integers(0, 11, n).astype(np.int64)                # dec(4,2)
+    tax = rng.integers(0, 9, n).astype(np.int64)
+    shipdate = rng.integers(8036, 10591, n).astype(np.int32)      # days
+    rf = rng.integers(0, 3, n)
+    ls = rng.integers(0, 2, n)
+    returnflag = pa.array(np.array(["A", "N", "R"])[rf])
+    linestatus = pa.array(np.array(["F", "O"])[ls])
+    okey = rng.integers(0, max(n // 4, 1), n).astype(np.int64)
+    return pa.table({
+        "l_orderkey": pa.array(okey, pa.int64()),
+        "l_quantity": dec_from_unscaled(qty, 12, 2),
+        "l_extendedprice": dec_from_unscaled(price, 12, 2),
+        "l_discount": dec_from_unscaled(disc, 4, 2),
+        "l_tax": dec_from_unscaled(tax, 4, 2),
+        "l_returnflag": returnflag,
+        "l_linestatus": linestatus,
+        "l_shipdate": pa.array(shipdate, pa.int32()),
+    })
+
+
+def q6(df):
+    """TPC-H Q6: forecasting revenue change (scan+filter+reduction)."""
+    import decimal
+    d = decimal.Decimal
+    return (df.filter((col("l_shipdate") >= 8766) & (col("l_shipdate") < 9131)
+                      & (col("l_discount") >= lit(d("0.05")))
+                      & (col("l_discount") <= lit(d("0.07")))
+                      & (col("l_quantity") < lit(d("24"))))
+            .agg(F.sum(col("l_extendedprice") * col("l_discount"))
+                 .alias("revenue")))
+
+
+def q1(df):
+    """TPC-H Q1: pricing summary report (grouped agg, 8 aggregates)."""
+    import decimal
+    d = decimal.Decimal
+    disc_price = col("l_extendedprice") * (lit(d("1")) - col("l_discount"))
+    charge = disc_price * (lit(d("1")) + col("l_tax"))
+    return (df.filter(col("l_shipdate") <= 10471)
+            .group_by("l_returnflag", "l_linestatus")
+            .agg(F.sum("l_quantity").alias("sum_qty"),
+                 F.sum("l_extendedprice").alias("sum_base_price"),
+                 F.sum(disc_price).alias("sum_disc_price"),
+                 F.sum(charge).alias("sum_charge"),
+                 F.avg("l_quantity").alias("avg_qty"),
+                 F.avg("l_extendedprice").alias("avg_price"),
+                 F.avg("l_discount").alias("avg_disc"),
+                 F.count("*").alias("count_order")))
+
+
+def q6_numpy_baseline(ship, disc_unscaled, qty_unscaled, price_unscaled):
+    """Vectorized single-core CPU reference over the raw unscaled arrays
+    (the CPU-Spark stand-in for bench.py)."""
+    m = ((ship >= 8766) & (ship < 9131)
+         & (disc_unscaled >= 5) & (disc_unscaled <= 7)
+         & (qty_unscaled < 2400))
+    return int(np.sum(price_unscaled[m] * disc_unscaled[m]))
