@@ -30,12 +30,18 @@ def main():
     at = tpch.gen_lineitem(sf=sf, seed=7)
     n = at.num_rows
 
-    # raw arrays for the CPU baseline (unscaled decimal ints)
+    # raw arrays for the CPU baseline: extract the unscaled decimal ints
+    # straight from the table so both sides read identical data
+    from spark_rapids_tpu.columnar.column import Column
+
+    def unscaled(name):
+        return np.asarray(
+            Column.host_from_arrow(at.column(name))[2]["data"][:n])
+
     ship = at.column("l_shipdate").to_numpy()
-    rng = np.random.default_rng(7)  # same sequence as gen_lineitem
-    qty = rng.integers(1, 51, n).astype(np.int64) * 100
-    price = rng.integers(90_000, 10_500_000, n).astype(np.int64)
-    disc = rng.integers(0, 11, n).astype(np.int64)
+    qty = unscaled("l_quantity")
+    price = unscaled("l_extendedprice")
+    disc = unscaled("l_discount")
 
     # --- CPU baseline (RAM-resident arrays) ------------------------------
     tpch.q6_numpy_baseline(ship, disc, qty, price)  # warm cache

@@ -46,6 +46,18 @@ from .nodes import make_table
 __all__ = ["HashJoinExec"]
 
 
+@jax.jit
+def _measure_string_bytes(offs, idxs, inbs):
+    """Total bytes each gathered string column needs (join expansion can
+    duplicate rows, so the source buffer capacity is NOT an upper bound)."""
+    outs = []
+    for off, idx, inb in zip(offs, idxs, inbs):
+        safe = jnp.clip(idx, 0, off.shape[0] - 2)
+        lens = off[safe + 1] - off[safe]
+        outs.append(jnp.sum(jnp.where(inb, lens.astype(jnp.int64), 0)))
+    return outs
+
+
 class HashJoinExec(TpuExec):
     def __init__(self, left: TpuExec, right: TpuExec,
                  bound_left_keys: Sequence[Expression],
@@ -182,6 +194,29 @@ class HashJoinExec(TpuExec):
             return lgather, rgather, lvalid, rvalid, total
         return fn
 
+    def _gather_cols(self, cvs, idx, inb):
+        """Gather payload columns by idx; string columns get an output
+        data capacity sized from the actual gathered byte totals."""
+        str_cols = [i for i, cv in enumerate(cvs) if cv.offsets is not None]
+        dcaps = {}
+        if str_cols:
+            totals = _measure_string_bytes(
+                [cvs[i].offsets for i in str_cols],
+                [idx] * len(str_cols), [inb] * len(str_cols))
+            from ..utils.transfer import fetch
+            got = fetch(totals)
+            for i, t in zip(str_cols, got):
+                dcaps[i] = bucket_capacity(max(int(t), 1))
+        out = []
+        for i, cv in enumerate(cvs):
+            if cv.offsets is not None:
+                from ..ops.gather import take_strings
+                out.append(take_strings(cv, idx, in_bounds=inb,
+                                        out_data_capacity=dcaps[i]))
+            else:
+                out.append(take(cv, idx, in_bounds=inb))
+        return out
+
     # ------------------------------------------------------------------
     def execute_partition(self, ctx: ExecContext, pid: int):
         if self.how == "cross":
@@ -240,10 +275,8 @@ class HashJoinExec(TpuExec):
                         self._expand_cache[ekey] = efn
                     lg, rg, lvalid, rvalid, _ = efn(cnt, offsets, bstart,
                                                     perm, smask)
-                    out_cvs = [take(cv, lg, in_bounds=lvalid)
-                               for cv in scvs]
-                    out_cvs += [take(cv, rg, in_bounds=rvalid)
-                                for cv in bcvs]
+                    out_cvs = self._gather_cols(scvs, lg, lvalid)
+                    out_cvs += self._gather_cols(bcvs, rg, rvalid)
                     tbl = make_table(self.schema, out_cvs, n_out)
                 m.add("numOutputRows", n_out)
                 m.add("numOutputBatches", 1)
@@ -292,10 +325,8 @@ class HashJoinExec(TpuExec):
                 li = sidx[jnp.clip(t // max(n_b, 1), 0, cap_s - 1)]
                 ri = bidx[jnp.clip(t % max(n_b, 1), 0, cap_b - 1)]
                 inb = t < n_out
-                out_cvs = [take(cv, li.astype(jnp.int32), in_bounds=inb)
-                           for cv in scvs]
-                out_cvs += [take(cv, ri.astype(jnp.int32), in_bounds=inb)
-                            for cv in bcvs]
+                out_cvs = self._gather_cols(scvs, li.astype(jnp.int32), inb)
+                out_cvs += self._gather_cols(bcvs, ri.astype(jnp.int32), inb)
                 tbl = make_table(self.schema, out_cvs, n_out)
                 m.add("numOutputRows", n_out)
                 yield DeviceBatch(tbl, n_out, inb, out_cap)

@@ -1,0 +1,96 @@
+"""HBM budget manager — the RMM-pool analog.
+
+XLA owns the real allocator and gives no alloc-failure callback
+(SURVEY.md §7.3 item 2), so the design is *inverted* from the reference's
+reactive RmmSpark interruption: the engine budgets HBM analytically.
+Operators reserve estimated bytes before launching a kernel; a failed
+reservation (or a caught RESOURCE_EXHAUSTED from XLA) triggers the spill
+store, then the retry framework re-executes with spilled/split inputs
+(reference: GpuDeviceManager.scala:182, DeviceMemoryEventHandler.scala:36).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+import jax
+
+__all__ = ["DeviceManager", "BudgetExceeded", "device_manager"]
+
+
+class BudgetExceeded(Exception):
+    """Raised when an HBM reservation cannot be satisfied even after
+    spilling everything spillable."""
+
+
+class DeviceManager:
+    def __init__(self, budget_bytes: Optional[int] = None,
+                 alloc_fraction: float = 0.85):
+        self._lock = threading.RLock()
+        self._reserved = 0
+        self._spill_hooks: List[Callable[[int], int]] = []
+        if budget_bytes is None:
+            budget_bytes = self._detect_budget(alloc_fraction)
+        self.budget = budget_bytes
+
+    @staticmethod
+    def _detect_budget(fraction: float) -> int:
+        try:
+            stats = jax.devices()[0].memory_stats()
+            if stats and "bytes_limit" in stats:
+                return int(stats["bytes_limit"] * fraction)
+        except Exception:
+            pass
+        return int(12 * (1 << 30) * fraction)  # v5e-ish default
+
+    # ------------------------------------------------------------------
+    def register_spill_hook(self, hook: Callable[[int], int]):
+        """hook(bytes_needed) -> bytes_freed; called under pressure."""
+        self._spill_hooks.append(hook)
+
+    @property
+    def reserved(self) -> int:
+        return self._reserved
+
+    def try_reserve(self, nbytes: int) -> bool:
+        with self._lock:
+            if self._reserved + nbytes <= self.budget:
+                self._reserved += nbytes
+                return True
+        return False
+
+    def reserve(self, nbytes: int):
+        """Reserve, spilling as needed; raises BudgetExceeded if the spill
+        store cannot free enough."""
+        if self.try_reserve(nbytes):
+            return
+        needed = nbytes - (self.budget - self._reserved)
+        for hook in self._spill_hooks:
+            freed = hook(max(needed, 0))
+            if self.try_reserve(nbytes):
+                return
+        raise BudgetExceeded(
+            f"need {nbytes} bytes, reserved {self._reserved} of "
+            f"{self.budget} and spill store exhausted")
+
+    def release(self, nbytes: int):
+        with self._lock:
+            self._reserved = max(0, self._reserved - nbytes)
+
+
+_GLOBAL: Optional[DeviceManager] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def device_manager(conf=None) -> DeviceManager:
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        if _GLOBAL is None:
+            budget = None
+            frac = 0.85
+            if conf is not None:
+                from ..config import HBM_POOL_BYTES, HBM_POOL_FRACTION
+                budget = conf.get(HBM_POOL_BYTES)
+                frac = conf.get(HBM_POOL_FRACTION)
+            _GLOBAL = DeviceManager(budget, frac)
+        return _GLOBAL

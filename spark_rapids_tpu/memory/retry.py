@@ -1,0 +1,84 @@
+"""Split-and-retry: out-of-core execution for bigger-than-HBM inputs.
+
+The reference's RmmRapidsRetryIterator (reference:
+RmmRapidsRetryIterator.scala:36-105 `withRetry(input, splitPolicy)(fn)`):
+an idempotent fn over spillable input re-executes on OOM, with the input
+split in half when retrying alone cannot help. Here OOM is either our
+analytic BudgetExceeded or XLA's RESOURCE_EXHAUSTED; both route through
+the same split loop. Inputs are DeviceBatch halves split by capacity
+(static shapes: each half keeps a power-of-two capacity).
+"""
+from __future__ import annotations
+
+import gc
+from typing import Callable, Iterator, List
+
+import jax.numpy as jnp
+
+from ..columnar.column import Column
+from ..columnar.table import Table
+from ..exec.batch import DeviceBatch
+from .device import BudgetExceeded
+
+__all__ = ["with_retry", "split_batch_in_half", "OutOfCoreError",
+           "is_oom_error"]
+
+MAX_SPLITS = 12
+
+
+class OutOfCoreError(Exception):
+    pass
+
+
+def is_oom_error(e: Exception) -> bool:
+    if isinstance(e, BudgetExceeded):
+        return True
+    msg = str(e)
+    return ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+            or "out of memory" in msg)
+
+
+def split_batch_in_half(batch: DeviceBatch) -> List[DeviceBatch]:
+    """Slice a batch into two capacity halves (no data movement for
+    variable-width columns: offsets slices still index the shared data
+    buffer)."""
+    cap = batch.capacity
+    if cap <= 128:
+        raise OutOfCoreError("cannot split a minimum-capacity batch")
+    half = cap // 2
+    outs = []
+    for lo, hi in ((0, half), (half, cap)):
+        cols = []
+        for c in batch.table.columns:
+            if c.offsets is not None:
+                off = c.offsets[lo:hi + 1]
+                cols.append(Column(c.dtype, max(0, min(c.length, hi) - lo),
+                                   c.data, c.validity[lo:hi], off))
+            else:
+                cols.append(Column(c.dtype, max(0, min(c.length, hi) - lo),
+                                   c.data[lo:hi], c.validity[lo:hi]))
+        outs.append(DeviceBatch(Table(batch.table.names, cols),
+                                max(0, min(batch.num_rows, hi) - lo),
+                                batch.row_mask[lo:hi], half))
+    return outs
+
+
+def with_retry(batch: DeviceBatch,
+               fn: Callable[[DeviceBatch], object],
+               max_splits: int = MAX_SPLITS) -> Iterator[object]:
+    """Run `fn` (idempotent!) over `batch`, splitting in half and retrying
+    on device OOM. Yields one result per final sub-batch, in row order."""
+    stack: List[tuple] = [(batch, 0)]
+    while stack:
+        b, depth = stack.pop(0)
+        try:
+            yield fn(b)
+        except Exception as e:  # noqa: BLE001 - filtered below
+            if not is_oom_error(e):
+                raise
+            gc.collect()
+            if depth >= max_splits:
+                raise OutOfCoreError(
+                    f"still OOM after {depth} splits") from e
+            halves = split_batch_in_half(b)
+            stack = [(halves[0], depth + 1), (halves[1], depth + 1)] + stack

@@ -1,0 +1,54 @@
+"""TpuSemaphore: bound tasks concurrently on the device.
+
+Analog of the reference's GpuSemaphore (reference: GpuSemaphore.scala:183,
+PrioritySemaphore.scala): a counting semaphore with priority ordering;
+tasks acquire before device work and release around host-side I/O so
+another task's kernels can occupy the chip.
+"""
+from __future__ import annotations
+
+import heapq
+import itertools
+import threading
+from contextlib import contextmanager
+
+__all__ = ["TpuSemaphore"]
+
+
+class TpuSemaphore:
+    def __init__(self, permits: int = 2):
+        self._permits = permits
+        self._available = permits
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._waiters = []          # heap of (priority, seq)
+        self._seq = itertools.count()
+        self.metrics = {"acquireWaitTime": 0.0, "acquires": 0}
+
+    def acquire(self, priority: int = 0):
+        import time
+        t0 = time.perf_counter()
+        with self._cond:
+            seq = next(self._seq)
+            heapq.heappush(self._waiters, (priority, seq))
+            while not (self._available > 0
+                       and self._waiters[0] == (priority, seq)):
+                self._cond.wait()
+            heapq.heappop(self._waiters)
+            self._available -= 1
+            self.metrics["acquires"] += 1
+            self.metrics["acquireWaitTime"] += time.perf_counter() - t0
+            self._cond.notify_all()
+
+    def release(self):
+        with self._cond:
+            self._available += 1
+            self._cond.notify_all()
+
+    @contextmanager
+    def hold(self, priority: int = 0):
+        self.acquire(priority)
+        try:
+            yield
+        finally:
+            self.release()

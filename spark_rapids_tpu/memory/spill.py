@@ -1,0 +1,210 @@
+"""Handle-based spill framework: HBM -> host DRAM -> disk.
+
+Port-in-spirit of the reference's SpillFramework
+(reference: spill/SpillFramework.scala:51-140): operators own
+SpillableBatchHandle objects instead of raw batches; the store can demote
+any handle that is not currently materialized. Demotion cascades
+device->host->disk under the host-memory limit; `materialize()` promotes
+back to device. Priorities: lower spill-order value spills first (the
+reference's SpillPriorities).
+"""
+from __future__ import annotations
+
+import os
+import threading
+import uuid
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ..columnar.column import Column
+from ..columnar.table import Schema, Table
+from ..exec.batch import DeviceBatch
+from ..utils.transfer import fetch
+from .device import DeviceManager, device_manager
+
+__all__ = ["SpillStore", "SpillableBatchHandle", "spill_store"]
+
+DEVICE, HOST, DISK = "device", "host", "disk"
+
+
+class SpillableBatchHandle:
+    """One spillable columnar batch. Not thread-safe per handle; the store
+    lock serializes spills."""
+
+    def __init__(self, store: "SpillStore", batch: DeviceBatch,
+                 priority: int = 0):
+        self.store = store
+        self.priority = priority
+        self.id = uuid.uuid4().hex
+        self.state = DEVICE
+        self._batch = batch
+        self._host = None          # host pytree
+        self._disk_path = None
+        self._meta = None          # (schema, names, num_rows, capacity)
+        self.nbytes = batch.nbytes
+        self._pinned = 0
+
+    # -- spill path ----------------------------------------------------
+    def spill_to_host(self) -> int:
+        if self.state != DEVICE or self._pinned:
+            return 0
+        b = self._batch
+        tree = {
+            "cols": [c.device_buffers() for c in b.table.columns],
+            "mask": b.row_mask,
+        }
+        self._host = fetch(tree)
+        self._meta = (b.table.schema, list(b.table.names), b.num_rows,
+                      b.capacity)
+        self._batch = None
+        self.state = HOST
+        return self.nbytes
+
+    def spill_to_disk(self, spill_dir: str) -> int:
+        if self._pinned:
+            return 0
+        if self.state == DEVICE:
+            self.spill_to_host()
+        if self.state != HOST:
+            return 0
+        os.makedirs(spill_dir, exist_ok=True)
+        path = os.path.join(spill_dir, f"spill-{self.id}.npz")
+        flat = {}
+        for i, bufs in enumerate(self._host["cols"]):
+            for k, v in bufs.items():
+                flat[f"c{i}_{k}"] = np.asarray(v)
+        flat["mask"] = np.asarray(self._host["mask"])
+        np.savez(path, **flat)
+        self._disk_path = path
+        self._host = None
+        self.state = DISK
+        return self.nbytes
+
+    # -- promote back ----------------------------------------------------
+    def materialize(self) -> DeviceBatch:
+        import jax
+        # pin first: the reserve() below may fire the spill hook, which
+        # must not demote the handle being promoted (re-entrancy guard)
+        self.pin()
+        try:
+            if self.state == DEVICE:
+                return self._batch
+            if self.state == DISK:
+                data = np.load(self._disk_path)
+                schema, names, num_rows, capacity = self._meta
+                cols = []
+                for i in range(len(names)):
+                    bufs = {k.split("_", 1)[1]: data[k] for k in data.files
+                            if k.startswith(f"c{i}_")}
+                    cols.append(bufs)
+                self._host = {"cols": cols, "mask": data["mask"]}
+                os.unlink(self._disk_path)
+                self._disk_path = None
+                self.state = HOST
+            schema, names, num_rows, capacity = self._meta
+            self.store.dm.reserve(self.nbytes)
+            dev = jax.device_put(self._host)
+            cols = [Column(f.dtype, num_rows, d["data"], d["validity"],
+                           d.get("offsets"))
+                    for f, d in zip(schema.fields, dev["cols"])]
+            batch = DeviceBatch(Table(names, cols), num_rows, dev["mask"],
+                                capacity)
+            self._batch = batch
+            self._host = None
+            self.state = DEVICE
+            return batch
+        finally:
+            self.unpin()
+
+    def pin(self):
+        self._pinned += 1
+
+    def unpin(self):
+        self._pinned = max(0, self._pinned - 1)
+
+    def close(self):
+        if self._disk_path and os.path.exists(self._disk_path):
+            os.unlink(self._disk_path)
+        if self.state == DEVICE and self._batch is not None:
+            self.store.dm.release(self.nbytes)
+        self._batch = None
+        self._host = None
+        self.store._remove(self)
+
+
+class SpillStore:
+    """Registry of spillable handles + the DeviceManager spill hook
+    (the reference's device/host store pair)."""
+
+    def __init__(self, dm: Optional[DeviceManager] = None,
+                 spill_dir: str = "/tmp/srtpu-spill",
+                 host_limit: int = 32 << 30):
+        self.dm = dm or device_manager()
+        self.spill_dir = spill_dir
+        self.host_limit = host_limit
+        self._lock = threading.RLock()
+        self._handles: Dict[str, SpillableBatchHandle] = {}
+        self.dm.register_spill_hook(self.spill)
+        self.metrics = {"spillToHost": 0, "spillToDisk": 0,
+                        "spillBytes": 0}
+
+    def add_batch(self, batch: DeviceBatch,
+                  priority: int = 0) -> SpillableBatchHandle:
+        self.dm.reserve(batch.nbytes)
+        h = SpillableBatchHandle(self, batch, priority)
+        with self._lock:
+            self._handles[h.id] = h
+        return h
+
+    def _remove(self, h: SpillableBatchHandle):
+        with self._lock:
+            self._handles.pop(h.id, None)
+
+    def spill(self, bytes_needed: int) -> int:
+        """DeviceManager pressure hook: demote device handles (lowest
+        priority first, biggest first) until enough is freed; cascade to
+        disk if host memory is over its limit."""
+        freed = 0
+        with self._lock:
+            device_handles = sorted(
+                (h for h in self._handles.values() if h.state == DEVICE),
+                key=lambda h: (h.priority, -h.nbytes))
+            for h in device_handles:
+                if freed >= bytes_needed:
+                    break
+                got = h.spill_to_host()
+                if got:
+                    self.dm.release(got)
+                    freed += got
+                    self.metrics["spillToHost"] += 1
+                    self.metrics["spillBytes"] += got
+            host_bytes = sum(h.nbytes for h in self._handles.values()
+                             if h.state == HOST)
+            if host_bytes > self.host_limit:
+                for h in sorted((h for h in self._handles.values()
+                                 if h.state == HOST),
+                                key=lambda h: (h.priority, -h.nbytes)):
+                    if host_bytes <= self.host_limit:
+                        break
+                    h.spill_to_disk(self.spill_dir)
+                    self.metrics["spillToDisk"] += 1
+                    host_bytes -= h.nbytes
+        return freed
+
+
+_STORE: Optional[SpillStore] = None
+_STORE_LOCK = threading.Lock()
+
+
+def spill_store(conf=None) -> SpillStore:
+    global _STORE
+    with _STORE_LOCK:
+        if _STORE is None:
+            kw = {}
+            if conf is not None:
+                from ..config import HOST_SPILL_LIMIT, SPILL_DIR
+                kw = {"spill_dir": conf.get(SPILL_DIR),
+                      "host_limit": conf.get(HOST_SPILL_LIMIT)}
+            _STORE = SpillStore(device_manager(conf), **kw)
+        return _STORE

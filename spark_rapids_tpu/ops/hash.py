@@ -3,7 +3,12 @@
 Replaces the reference's JNI Hash kernels (reference: HashFunctions.scala,
 jni Hash: murmur3/xxhash64). Spark's hash() uses Murmur3_x86_32 with
 hashInt/hashLong on the raw bits; implemented here in pure int32 jnp ops
-(native TPU lanes), vectorized across rows.
+(native TPU lanes), vectorized across rows. Spark-bit-compatible for
+bool/int/long/date/timestamp/decimal64 and float32. Strings hash the
+first 64 bytes Spark-style plus a tail-word + length fold (engine-internal
+beyond 64 bytes); float64 uses a frexp decomposition (engine-internal —
+the TPU x64 rewrite cannot bitcast f64). Documented in
+docs/compatibility.md.
 
 Null handling follows Spark: a null input leaves the running hash
 unchanged (the seed/previous column hash passes through).
@@ -120,7 +125,18 @@ def _hash_string(cv: CV, seed):
             word = word | (byte << (8 * b))
         has_word = (4 * w) < lens
         h1 = jnp.where(has_word, _mix_h1(h1, _mix_k1(word)), h1)
-    return _fmix(h1, jnp.minimum(lens, MAXB).astype(jnp.int32))
+    # beyond the 64-byte prefix, fold in the LAST word so common-prefix
+    # keys (URLs, paths) do not collapse into one partition
+    tail_base = jnp.maximum(starts, starts + lens - 4)
+    tail = jnp.zeros(n, jnp.int32)
+    for b in range(4):
+        idx = jnp.clip(tail_base + b, 0, dcap - 1)
+        inb = b < lens
+        byte = jnp.where(inb, data[idx], 0).astype(jnp.int32)
+        tail = tail | (byte << (8 * b))
+    overlong = lens > MAXB
+    h1 = jnp.where(overlong, _mix_h1(h1, _mix_k1(tail)), h1)
+    return _fmix(h1, lens.astype(jnp.int32))
 
 
 def murmur3_row_hash(cvs, dtypes, seed: int = 42):

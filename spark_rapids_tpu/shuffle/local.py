@@ -1,0 +1,201 @@
+"""Multithreaded host-file shuffle — the portable baseline transport.
+
+(reference: RapidsShuffleThreadedWriter/Reader + MULTITHREADED mode,
+RapidsShuffleInternalManagerBase.scala:120; SURVEY.md §2.7.) Map tasks
+bucket rows by target partition ON DEVICE (one sort + one bulk D2H per
+batch), slice per-partition sub-batches host-side, and a thread pool
+appends them to per-map shuffle files with a trailing segment index.
+Reduce tasks read their segment from every map file (thread pool),
+concatenate on host, and do ONE H2D.
+"""
+from __future__ import annotations
+
+import concurrent.futures as cf
+import io
+import os
+import struct
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..columnar.column import Column, bucket_capacity
+from ..columnar.table import Schema, Table
+from ..exec.batch import DeviceBatch
+from ..utils.transfer import fetch
+from .serializer import HostSubBatch, read_subbatch, write_subbatch
+
+__all__ = ["LocalShuffle", "get_codec"]
+
+
+def get_codec(name: str):
+    if name in (None, "none", ""):
+        return None
+    if name == "lz4":
+        try:
+            import lz4.frame as lz4f  # optional
+            return lz4f
+        except ImportError:
+            import zlib
+            return zlib  # gated fallback: zlib is always available
+    if name == "zstd":
+        try:
+            import zstandard  # optional
+
+            class _Z:
+                compress = staticmethod(
+                    lambda b: zstandard.ZstdCompressor().compress(b))
+                decompress = staticmethod(
+                    lambda b: zstandard.ZstdDecompressor().decompress(b))
+            return _Z
+        except ImportError:
+            import zlib
+            return zlib
+    raise ValueError(f"unknown codec {name}")
+
+
+def _np_dtype_for(f_dtype: dt.DataType) -> np.dtype:
+    return np.dtype(f_dtype.np_dtype or np.int8)
+
+
+class LocalShuffle:
+    """One shuffle exchange: N map inputs -> M reduce partitions."""
+
+    def __init__(self, shuffle_id: str, num_reduce: int, schema: Schema,
+                 shuffle_dir: str = "/tmp/srtpu-shuffle",
+                 writer_threads: int = 4, reader_threads: int = 4,
+                 codec: Optional[str] = None):
+        self.id = shuffle_id
+        self.n = num_reduce
+        self.schema = schema
+        self.dir = os.path.join(shuffle_dir, f"shuffle-{shuffle_id}")
+        os.makedirs(self.dir, exist_ok=True)
+        import atexit
+        atexit.register(self.cleanup)  # ShuffleCleanupManager analog
+        self.writer_threads = writer_threads
+        self.reader_threads = reader_threads
+        self.codec = get_codec(codec)
+        self._lock = threading.Lock()
+        self._map_files: List[str] = []
+        self.metrics = {"bytesWritten": 0, "blocksWritten": 0}
+
+    # ---------------- map side ----------------------------------------
+    def write_map_partition(self, mpid: int, pieces_per_reduce):
+        """pieces_per_reduce: list over reduce pid of lists of
+        HostSubBatch. Serialization runs on the writer thread pool; the
+        file itself is written sequentially with a trailing index."""
+        path = os.path.join(self.dir, f"map-{mpid}.bin")
+
+        def ser(sb: HostSubBatch) -> bytes:
+            buf = io.BytesIO()
+            write_subbatch(buf, sb, self.codec)
+            return buf.getvalue()
+
+        flat = [(rp, sb) for rp in range(self.n)
+                for sb in pieces_per_reduce[rp]]
+        if self.writer_threads > 1 and len(flat) > 1:
+            with cf.ThreadPoolExecutor(self.writer_threads) as pool:
+                blocks = list(pool.map(lambda t: ser(t[1]), flat))
+        else:
+            blocks = [ser(sb) for _, sb in flat]
+        index = []  # (offset, length) per reduce partition
+        with open(path, "wb") as f:
+            bi = 0
+            for rp in range(self.n):
+                start = f.tell()
+                for sb in pieces_per_reduce[rp]:
+                    f.write(blocks[bi])
+                    self.metrics["bytesWritten"] += len(blocks[bi])
+                    self.metrics["blocksWritten"] += 1
+                    bi += 1
+                index.append((start, f.tell() - start))
+            idx_off = f.tell()
+            for off, ln in index:
+                f.write(struct.pack("<QQ", off, ln))
+            f.write(struct.pack("<QI", idx_off, self.n))
+        with self._lock:
+            self._map_files.append(path)
+
+    # ---------------- reduce side --------------------------------------
+    def read_reduce_partition(self, rpid: int) -> List[HostSubBatch]:
+        dtypes = [_np_dtype_for(f.dtype) for f in self.schema.fields]
+
+        def read_one(path: str) -> List[HostSubBatch]:
+            out = []
+            with open(path, "rb") as f:
+                f.seek(-12, os.SEEK_END)
+                idx_off, n = struct.unpack("<QI", f.read(12))
+                f.seek(idx_off + 16 * rpid)
+                off, ln = struct.unpack("<QQ", f.read(16))
+                f.seek(off)
+                seg = io.BytesIO(f.read(ln))
+            while True:
+                sb = read_subbatch(seg, dtypes, self.codec)
+                if sb is None:
+                    break
+                out.append(sb)
+            return out
+
+        with self._lock:
+            files = list(self._map_files)
+        if self.reader_threads > 1 and len(files) > 1:
+            with cf.ThreadPoolExecutor(self.reader_threads) as pool:
+                results = list(pool.map(read_one, files))
+        else:
+            results = [read_one(p) for p in files]
+        return [sb for r in results for sb in r]
+
+    def reduce_batch(self, rpid: int) -> Optional[DeviceBatch]:
+        """Concat this partition's sub-batches on host, one H2D."""
+        import jax
+        subs = self.read_reduce_partition(rpid)
+        total = sum(sb.n_rows for sb in subs)
+        if total == 0:
+            return None
+        cap = bucket_capacity(total)
+        ncols = len(self.schema.fields)
+        bufs = []
+        for ci, f in enumerate(self.schema.fields):
+            np_dt = _np_dtype_for(f.dtype)
+            validity = np.zeros(cap, np.bool_)
+            pos = 0
+            if f.dtype.is_variable_width:
+                datas, offs = [], [np.zeros(1, np.int32)]
+                shift = 0
+                for sb in subs:
+                    c = sb.cols[ci]
+                    validity[pos:pos + sb.n_rows] = c["validity"]
+                    pos += sb.n_rows
+                    datas.append(c["data"])
+                    o = c["offsets"]
+                    offs.append(o[1:].astype(np.int32) + shift)
+                    shift += len(c["data"])
+                data = (np.concatenate(datas) if datas
+                        else np.zeros(0, np.uint8))
+                dcap = bucket_capacity(max(len(data), 1))
+                data = np.concatenate(
+                    [data, np.zeros(dcap - len(data), np.uint8)])
+                off = np.concatenate(offs)
+                off = np.concatenate(
+                    [off, np.full(cap + 1 - len(off), off[-1], np.int32)])
+                bufs.append({"data": data, "validity": validity,
+                             "offsets": off})
+            else:
+                data = np.zeros(cap, np_dt)
+                for sb in subs:
+                    c = sb.cols[ci]
+                    data[pos:pos + sb.n_rows] = c["data"]
+                    validity[pos:pos + sb.n_rows] = c["validity"]
+                    pos += sb.n_rows
+                bufs.append({"data": data, "validity": validity})
+        dev = jax.device_put(bufs)
+        cols = [Column(f.dtype, total, d["data"], d["validity"],
+                       d.get("offsets"))
+                for f, d in zip(self.schema.fields, dev)]
+        return DeviceBatch(Table(self.schema.names, cols), total)
+
+    def cleanup(self):
+        import shutil
+        shutil.rmtree(self.dir, ignore_errors=True)

@@ -1,0 +1,89 @@
+"""Columnar shuffle wire format — the kudo analog.
+
+(reference: jni kudo.KudoSerializer + GpuColumnarBatchSerializer.scala.)
+A flat, length-prefixed binary layout per sub-batch: little-endian header,
+then per column validity/data(/offsets) raw buffers. No compression by
+default (nvcomp analog is a conf'd host codec). Written/read with numpy
+memoryviews — zero object overhead, mmap-friendly.
+
+Layout:
+  u32 magic 'KTPU' | u32 n_cols | u64 n_rows
+  per column: u8 has_offsets | u64 validity_bytes | u64 data_bytes |
+              u64 offsets_bytes | buffers...
+"""
+from __future__ import annotations
+
+import io
+import struct
+from typing import BinaryIO, Dict, List, Optional, Tuple
+
+import numpy as np
+
+__all__ = ["write_subbatch", "read_subbatch", "HostSubBatch"]
+
+_MAGIC = 0x4B545055
+
+
+class HostSubBatch:
+    """Host-side compacted rows of one shuffle partition: per column a
+    dict with 'validity', 'data', and optionally 'offsets' (rebased to 0)."""
+
+    def __init__(self, cols: List[Dict[str, np.ndarray]], n_rows: int):
+        self.cols = cols
+        self.n_rows = n_rows
+
+    @property
+    def nbytes(self) -> int:
+        return sum(b.nbytes for c in self.cols for b in c.values())
+
+
+def write_subbatch(out: BinaryIO, sb: HostSubBatch, codec=None) -> int:
+    body = io.BytesIO()
+    body.write(struct.pack("<IIQ", _MAGIC, len(sb.cols), sb.n_rows))
+    for c in sb.cols:
+        off = c.get("offsets")
+        validity = np.packbits(c["validity"].astype(np.bool_))
+        data = np.ascontiguousarray(c["data"])
+        body.write(struct.pack("<BQQQ", 1 if off is not None else 0,
+                               validity.nbytes, data.nbytes,
+                               off.nbytes if off is not None else 0))
+        body.write(validity.tobytes())
+        body.write(data.tobytes())
+        if off is not None:
+            body.write(np.ascontiguousarray(off).tobytes())
+    raw = body.getvalue()
+    if codec is not None:
+        raw = codec.compress(raw)
+    out.write(struct.pack("<Q", len(raw)))
+    out.write(raw)
+    return 8 + len(raw)
+
+
+def read_subbatch(inp: BinaryIO, dtypes, codec=None) -> Optional[HostSubBatch]:
+    """dtypes: list of numpy dtypes for the data buffers."""
+    hdr = inp.read(8)
+    if len(hdr) < 8:
+        return None
+    (blen,) = struct.unpack("<Q", hdr)
+    raw = inp.read(blen)
+    if codec is not None:
+        raw = codec.decompress(raw)
+    buf = memoryview(raw)
+    magic, n_cols, n_rows = struct.unpack_from("<IIQ", buf, 0)
+    assert magic == _MAGIC, "corrupt shuffle block"
+    pos = 16
+    cols = []
+    for ci in range(n_cols):
+        has_off, vb, db, ob = struct.unpack_from("<BQQQ", buf, pos)
+        pos += 25
+        vbits = np.frombuffer(buf, np.uint8, vb, pos)
+        pos += vb
+        validity = np.unpackbits(vbits)[:n_rows].astype(np.bool_)
+        data = np.frombuffer(buf, dtypes[ci], db // dtypes[ci].itemsize, pos)
+        pos += db
+        col = {"validity": validity, "data": data}
+        if has_off:
+            col["offsets"] = np.frombuffer(buf, np.int32, ob // 4, pos)
+            pos += ob
+        cols.append(col)
+    return HostSubBatch(cols, n_rows)

@@ -99,7 +99,6 @@ def test_cross_join(session):
                       n=40, seed=26)
     rdf, rat = gen_df(session, [("b", IntegerGen(nullable=False))],
                       n=30, seed=27)
-    out = ldf.join(rdf, on=[], how="cross") if False else None
     # cross joins go through the logical node directly
     from spark_rapids_tpu.plan import logical as L
     from spark_rapids_tpu.session import DataFrame
@@ -176,3 +175,17 @@ def test_full_join_string_key(session):
                  key=lambda t: (t[0] is None, str(t[0])))
     assert out == [("a", 1, None), ("b", 2, 20), ("c", None, 30),
                    (None, 3, None)]
+
+
+def test_join_string_payload_expansion(session):
+    # all-match join duplicates string payloads beyond the source buffer
+    n = 64
+    l = session.create_dataframe({"k": [1] * n,
+                                  "s": [f"leftpayload-{i:04d}" for i in range(n)]})
+    r = session.create_dataframe({"k": [1] * n})
+    out = l.join(r, on=["k"], how="inner").to_arrow()
+    assert out.num_rows == n * n
+    vals = out.column("s").to_pylist()
+    from collections import Counter
+    c = Counter(vals)
+    assert len(c) == n and all(v == n for v in c.values())

@@ -1,0 +1,150 @@
+"""Memory runtime (spill / retry / semaphore) and shuffle exchange tests —
+the analog of the reference's *RetrySuite + shuffle suites (SURVEY.md §4.2).
+"""
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.columnar.table import Table
+from spark_rapids_tpu.exec.batch import DeviceBatch
+from spark_rapids_tpu.memory.device import BudgetExceeded, DeviceManager
+from spark_rapids_tpu.memory.retry import (split_batch_in_half, with_retry)
+from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+from spark_rapids_tpu.memory.spill import SpillStore
+
+from asserts import assert_rows_equal
+from data_gen import IntegerGen, StringGen, gen_df
+
+
+def _mk_batch(n=1024, seed=0):
+    rng = np.random.default_rng(seed)
+    at = pa.table({"a": rng.integers(0, 100, n),
+                   "s": [f"row{i}" for i in range(n)]})
+    return DeviceBatch(Table.from_arrow(at))
+
+
+def test_spill_roundtrip_device_host_disk(tmp_path):
+    dm = DeviceManager(budget_bytes=1 << 30)
+    store = SpillStore(dm, spill_dir=str(tmp_path), host_limit=0)
+    b = _mk_batch(500)
+    expect = b.table.to_arrow().to_pydict()
+    h = store.add_batch(b)
+    assert h.spill_to_host() > 0
+    assert h.state == "host"
+    h.spill_to_disk(str(tmp_path))
+    assert h.state == "disk"
+    b2 = h.materialize()
+    assert b2.table.to_arrow().to_pydict() == expect
+    h.close()
+
+
+def test_budget_pressure_triggers_spill(tmp_path):
+    b1 = _mk_batch(4096, 1)
+    size = b1.nbytes
+    dm = DeviceManager(budget_bytes=int(size * 1.5))
+    store = SpillStore(dm, spill_dir=str(tmp_path))
+    h1 = store.add_batch(b1)
+    # second reservation can't fit until h1 spills
+    b2 = _mk_batch(4096, 2)
+    h2 = store.add_batch(b2)
+    assert h1.state == "host"
+    assert h2.state == "device"
+    # over-budget reservation fails cleanly
+    with pytest.raises(BudgetExceeded):
+        dm.reserve(int(size * 10))
+
+
+def test_split_and_retry_oom_injection():
+    """Force OOM on big batches; with_retry must split until fn succeeds
+    and the concatenated results must equal the unsplit sum."""
+    b = _mk_batch(4096, 3)
+    calls = {"n": 0}
+
+    import jax.numpy as jnp
+
+    def fn(batch):
+        calls["n"] += 1
+        if batch.capacity > 1024:
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected OOM")
+        cv = batch.cvs()[0]
+        live = batch.row_mask & cv.validity
+        return int(jnp.sum(jnp.where(live, cv.data, 0)))
+
+    parts = list(with_retry(b, fn))
+    cv = b.cvs()[0]
+    import jax.numpy as jnp
+    expect = int(jnp.sum(jnp.where(b.row_mask & cv.validity, cv.data, 0)))
+    assert sum(parts) == expect
+    assert len(parts) == 4  # 4096 -> 4 x 1024
+    assert calls["n"] > 4   # includes the failed attempts
+
+
+def test_split_preserves_string_rows():
+    b = _mk_batch(512, 4)
+    left, right = split_batch_in_half(b)
+    import pyarrow as pa
+    got = (left.table.to_arrow().column("s").to_pylist()[:left.num_rows]
+           + right.table.to_arrow().column("s").to_pylist()[:right.num_rows])
+    assert got == [f"row{i}" for i in range(512)]
+
+
+def test_semaphore_priority_and_bound():
+    sem = TpuSemaphore(2)
+    running = []
+    peak = []
+    lock = threading.Lock()
+
+    def task(i):
+        with sem.hold(priority=i):
+            with lock:
+                running.append(i)
+                peak.append(len(running))
+            import time
+            time.sleep(0.01)
+            with lock:
+                running.remove(i)
+
+    threads = [threading.Thread(target=task, args=(i,)) for i in range(6)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert max(peak) <= 2
+    assert sem.metrics["acquires"] == 6
+
+
+def test_repartition_roundtrip(session):
+    df, at = gen_df(session, [("k", IntegerGen(lo=0, hi=1000)),
+                              ("s", StringGen(max_len=10)),
+                              ("v", IntegerGen())], n=3000, seed=40)
+    out = df.repartition(5, F.col("k")).to_arrow()
+    assert_rows_equal(out, list(zip(*[at.column(i).to_pylist()
+                                      for i in range(3)])))
+
+
+def test_repartition_hash_colocates_keys(session):
+    # same key must land in the same partition: groupby after repartition
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 256})
+    df, at = gen_df(s, [("k", IntegerGen(lo=0, hi=20, nullable=False)),
+                        ("v", IntegerGen(lo=0, hi=100))], n=2000, seed=41)
+    out = (df.repartition(4, F.col("k")).group_by("k")
+           .agg(F.sum("v").alias("sv")).to_arrow())
+    from collections import defaultdict
+    sums = defaultdict(lambda: None)
+    for k, v in zip(at.column(0).to_pylist(), at.column(1).to_pylist()):
+        if v is not None:
+            sums[k] = (sums[k] or 0) + v
+    exp = [(k, sums[k]) for k in set(at.column(0).to_pylist())]
+    assert_rows_equal(out, exp)
+
+
+def test_repartition_roundrobin(session):
+    df, at = gen_df(session, [("v", IntegerGen(nullable=False))],
+                    n=1000, seed=42)
+    out = df.repartition(7).to_arrow()
+    assert sorted(out.column(0).to_pylist()) == \
+        sorted(at.column(0).to_pylist())
