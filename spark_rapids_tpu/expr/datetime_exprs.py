@@ -1,0 +1,152 @@
+"""Date/time expressions (reference: datetimeExpressions.scala rules)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..ops import datetime as ops_dt
+from ..ops.kernel_utils import CV
+from .expressions import (Expression, UnsupportedExpr, _BinaryOp, _UnaryOp,
+                          _wrap)
+
+__all__ = ["Year", "Month", "DayOfMonth", "DayOfWeek", "DayOfYear",
+           "Quarter", "Hour", "Minute", "Second", "DateAdd", "DateSub",
+           "DateDiff", "LastDay", "ToDate"]
+
+
+class _DateField(_UnaryOp):
+    kernel = None
+
+    def _resolve_type(self):
+        ct = self.child.dtype
+        if not isinstance(ct, (dt.DateType, dt.TimestampType)):
+            raise UnsupportedExpr(f"{type(self).__name__}({ct})")
+        self.dtype = dt.INT32
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        days = (ops_dt.micros_to_days(cv.data)
+                if isinstance(self.child.dtype, dt.TimestampType)
+                else cv.data)
+        return CV(type(self).kernel(days), cv.validity)
+
+    def __repr__(self):
+        return f"{type(self).__name__.lower()}({self.child})"
+
+
+class Year(_DateField):
+    kernel = staticmethod(ops_dt.year)
+
+
+class Month(_DateField):
+    kernel = staticmethod(ops_dt.month)
+
+
+class DayOfMonth(_DateField):
+    kernel = staticmethod(ops_dt.day)
+
+
+class DayOfWeek(_DateField):
+    kernel = staticmethod(ops_dt.day_of_week)
+
+
+class DayOfYear(_DateField):
+    kernel = staticmethod(ops_dt.day_of_year)
+
+
+class Quarter(_DateField):
+    kernel = staticmethod(ops_dt.quarter)
+
+
+class _TimeField(_UnaryOp):
+    kernel = None
+
+    def _resolve_type(self):
+        if not isinstance(self.child.dtype, dt.TimestampType):
+            raise UnsupportedExpr(f"{type(self).__name__} needs timestamp")
+        self.dtype = dt.INT32
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        return CV(type(self).kernel(cv.data), cv.validity)
+
+
+class Hour(_TimeField):
+    kernel = staticmethod(ops_dt.hour)
+
+
+class Minute(_TimeField):
+    kernel = staticmethod(ops_dt.minute)
+
+
+class Second(_TimeField):
+    kernel = staticmethod(ops_dt.second)
+
+
+class _DateDelta(_BinaryOp):
+    sign = 1
+
+    def _resolve_type(self):
+        if not isinstance(self.left.dtype, dt.DateType):
+            raise UnsupportedExpr("date_add/sub needs a date")
+        if not self.right.dtype.is_integral:
+            raise UnsupportedExpr("date_add/sub delta must be integral")
+        self.dtype = dt.DATE
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        out = l.data + self.sign * r.data.astype(jnp.int32)
+        return CV(out.astype(jnp.int32), l.validity & r.validity)
+
+
+class DateAdd(_DateDelta):
+    sign = 1
+    symbol = "date_add"
+
+
+class DateSub(_DateDelta):
+    sign = -1
+    symbol = "date_sub"
+
+
+class DateDiff(_BinaryOp):
+    symbol = "datediff"
+
+    def _resolve_type(self):
+        if not (isinstance(self.left.dtype, dt.DateType)
+                and isinstance(self.right.dtype, dt.DateType)):
+            raise UnsupportedExpr("datediff needs dates")
+        self.dtype = dt.INT32
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        return CV((l.data - r.data).astype(jnp.int32),
+                  l.validity & r.validity)
+
+
+class LastDay(_UnaryOp):
+    def _resolve_type(self):
+        if not isinstance(self.child.dtype, dt.DateType):
+            raise UnsupportedExpr("last_day needs a date")
+        self.dtype = dt.DATE
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        return CV(ops_dt.last_day(cv.data), cv.validity)
+
+
+class ToDate(_UnaryOp):
+    def _resolve_type(self):
+        ct = self.child.dtype
+        if isinstance(ct, dt.DateType):
+            self.dtype = dt.DATE
+        elif isinstance(ct, dt.TimestampType):
+            self.dtype = dt.DATE
+        else:
+            raise UnsupportedExpr("to_date(string) lands with date parsing")
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        if isinstance(self.child.dtype, dt.TimestampType):
+            return CV(ops_dt.micros_to_days(cv.data), cv.validity)
+        return cv

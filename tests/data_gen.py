@@ -16,9 +16,11 @@ class DataGen:
     arrow_type = None
     special = []
 
-    def __init__(self, nullable=True, null_prob=0.1):
+    def __init__(self, nullable=True, null_prob=0.1, no_special=False):
         self.nullable = nullable
         self.null_prob = null_prob
+        if no_special:
+            self.special = []
 
     def value(self, rng: random.Random):
         raise NotImplementedError
@@ -86,11 +88,6 @@ class FloatGen(DataGen):
     arrow_type = pa.float32()
     special = [float("nan"), float("inf"), float("-inf"), -0.0, 0.0]
 
-    def __init__(self, nullable=True, no_special=False, **kw):
-        super().__init__(nullable, **kw)
-        if no_special:
-            self.special = []
-
     def value(self, rng):
         return np.float32(rng.uniform(-1e6, 1e6)).item()
 
@@ -98,11 +95,6 @@ class FloatGen(DataGen):
 class DoubleGen(DataGen):
     arrow_type = pa.float64()
     special = [float("nan"), float("inf"), float("-inf"), -0.0, 0.0]
-
-    def __init__(self, nullable=True, no_special=False, **kw):
-        super().__init__(nullable, **kw)
-        if no_special:
-            self.special = []
 
     def value(self, rng):
         return rng.uniform(-1e9, 1e9)

@@ -1,0 +1,65 @@
+"""Datetime expression correctness vs Python datetime."""
+import calendar
+import datetime
+
+import pyarrow as pa
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col
+
+from asserts import assert_rows_equal
+from data_gen import DateGen, TimestampGen, IntegerGen, gen_df
+
+
+def test_date_fields(session):
+    df, at = gen_df(session, [("d", DateGen())], n=600, seed=60)
+    out = df.select(F.year(col("d")).alias("y"),
+                    F.month(col("d")).alias("m"),
+                    F.dayofmonth(col("d")).alias("dom"),
+                    F.dayofweek(col("d")).alias("dow"),
+                    F.dayofyear(col("d")).alias("doy"),
+                    F.quarter(col("d")).alias("q"),
+                    F.last_day(col("d")).alias("ld")).to_arrow()
+    exp = []
+    for d in at.column(0).to_pylist():
+        if d is None:
+            exp.append((None,) * 7)
+        else:
+            dow = (d.weekday() + 1) % 7 + 1  # Spark: 1=Sunday
+            ld = d.replace(day=calendar.monthrange(d.year, d.month)[1])
+            exp.append((d.year, d.month, d.day, dow,
+                        d.timetuple().tm_yday, (d.month - 1) // 3 + 1, ld))
+    assert_rows_equal(out, exp, ignore_order=False)
+
+
+def test_timestamp_fields(session):
+    df, at = gen_df(session, [("t", TimestampGen())], n=400, seed=61)
+    out = df.select(F.hour(col("t")).alias("h"),
+                    F.minute(col("t")).alias("mi"),
+                    F.second(col("t")).alias("s"),
+                    F.year(col("t")).alias("y")).to_arrow()
+    exp = []
+    for t in at.column(0).to_pylist():
+        if t is None:
+            exp.append((None,) * 4)
+        else:
+            exp.append((t.hour, t.minute, t.second, t.year))
+    assert_rows_equal(out, exp, ignore_order=False)
+
+
+def test_date_arithmetic(session):
+    df, at = gen_df(session, [("d", DateGen(no_special=True)),
+                              ("n", IntegerGen(lo=-1000, hi=1000,
+                                               no_special=True))],
+                    n=500, seed=62)
+    out = df.select(F.date_add(col("d"), col("n")).alias("a"),
+                    F.date_sub(col("d"), 7).alias("s"),
+                    F.datediff(col("d"), col("d")).alias("z")).to_arrow()
+    exp = []
+    for d, n in zip(at.column(0).to_pylist(), at.column(1).to_pylist()):
+        a = (d + datetime.timedelta(days=n)
+             if d is not None and n is not None else None)
+        s = d - datetime.timedelta(days=7) if d is not None else None
+        z = 0 if d is not None else None
+        exp.append((a, s, z))
+    assert_rows_equal(out, exp, ignore_order=False)

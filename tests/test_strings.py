@@ -1,0 +1,185 @@
+"""String kernel + expression correctness vs Python references."""
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col, lit
+from spark_rapids_tpu.columnar import dtypes as dt
+
+from asserts import assert_rows_equal
+from data_gen import IntegerGen, StringGen, gen_df
+
+
+def _py(at, i=0):
+    return at.column(i).to_pylist()
+
+
+def test_length_upper_lower(session):
+    df, at = gen_df(session, [("s", StringGen(max_len=15))], n=800, seed=50)
+    out = df.select(F.length(col("s")).alias("l"),
+                    F.upper(col("s")).alias("u"),
+                    F.lower(col("s")).alias("lo")).to_arrow()
+    exp = []
+    for s in _py(at):
+        if s is None:
+            exp.append((None, None, None))
+        else:
+            # ASCII-only case mapping (documented deviation); test data is
+            # mostly ASCII, snowman passes through unchanged
+            up = "".join(c.upper() if c.isascii() else c for c in s)
+            lo = "".join(c.lower() if c.isascii() else c for c in s)
+            exp.append((len(s), up, lo))
+    assert_rows_equal(out, exp, ignore_order=False)
+
+
+def test_substring(session):
+    df, at = gen_df(session, [("s", StringGen(max_len=12, charset="abcdef",
+                                              no_special=True))],
+                    n=500, seed=51)
+    out = df.select(F.substring(col("s"), 2, 3).alias("a"),
+                    F.substring(col("s"), -2, None).alias("b")).to_arrow()
+    exp = []
+    for s in _py(at):
+        if s is None:
+            exp.append((None, None))
+        else:
+            exp.append((s[1:4], s[-2:] if len(s) >= 2 else s))
+    assert_rows_equal(out, exp, ignore_order=False)
+
+
+def test_concat(session):
+    df, at = gen_df(session, [("a", StringGen(max_len=6, charset="xyz")),
+                              ("b", StringGen(max_len=6, charset="123"))],
+                    n=600, seed=52)
+    out = df.select(F.concat(col("a"), lit("-"), col("b")).alias("c"))
+    exp = []
+    for a, b in zip(_py(at, 0), _py(at, 1)):
+        exp.append((None if a is None or b is None else f"{a}-{b}",))
+    assert_rows_equal(out.to_arrow(), exp, ignore_order=False)
+
+
+def test_predicates_contains_starts_ends(session):
+    df, at = gen_df(session, [("s", StringGen(max_len=10,
+                                              charset="abc"))],
+                    n=800, seed=53)
+    out = df.select(col("s").contains("ab").alias("c"),
+                    col("s").startswith("a").alias("st"),
+                    col("s").endswith("bc").alias("en")).to_arrow()
+    exp = []
+    for s in _py(at):
+        if s is None:
+            exp.append((None, None, None))
+        else:
+            exp.append(("ab" in s, s.startswith("a"), s.endswith("bc")))
+    assert_rows_equal(out, exp, ignore_order=False)
+
+
+def test_like(session):
+    df, at = gen_df(session, [("s", StringGen(max_len=8, charset="ab%"))],
+                    n=500, seed=54)
+    import fnmatch
+    out = df.filter(col("s").like("a%b")).to_arrow()
+    exp = [(s,) for s in _py(at)
+           if s is not None and len(s) >= 2 and s.startswith("a")
+           and s.endswith("b")]
+    assert_rows_equal(out, exp)
+
+
+def test_string_comparisons(session):
+    df, at = gen_df(session, [("a", StringGen(max_len=8, charset="abc")),
+                              ("b", StringGen(max_len=8, charset="abc"))],
+                    n=900, seed=55)
+    out = df.select((col("a") == col("b")).alias("eq"),
+                    (col("a") < col("b")).alias("lt"),
+                    (col("a") >= col("b")).alias("ge")).to_arrow()
+    exp = []
+    for a, b in zip(_py(at, 0), _py(at, 1)):
+        if a is None or b is None:
+            exp.append((None, None, None))
+        else:
+            exp.append((a == b, a < b, a >= b))
+    assert_rows_equal(out, exp, ignore_order=False)
+
+
+def test_string_compare_literal_filter(session):
+    df, at = gen_df(session, [("s", StringGen(max_len=5, charset="mnop"))],
+                    n=400, seed=56)
+    out = df.filter(col("s") > "n").to_arrow()
+    exp = [(s,) for s in _py(at) if s is not None and s > "n"]
+    assert_rows_equal(out, exp)
+
+
+def test_cast_string_to_numbers(session):
+    vals = ["42", " -7 ", "3.99", "abc", "", None, "999999999999",
+            "  +12", "1e3", "Infinity", "-infinity", "NaN", "12.5e-1"]
+    df = session.create_dataframe({"s": pa.array(vals, pa.string())})
+    out = df.select(col("s").cast(dt.INT32).alias("i"),
+                    col("s").cast(dt.FLOAT64).alias("f"),
+                    ).to_arrow().to_pydict()
+    assert out["i"] == [42, -7, 3, None, None, None, None, 12, None, None,
+                        None, None, None]
+    import math
+    f = out["f"]
+    assert f[0] == 42.0 and f[1] == -7.0 and f[2] == 3.99
+    assert f[3] is None and f[4] is None and f[5] is None
+    assert f[6] == 999999999999.0
+    assert f[8] == 1000.0
+    assert f[9] == math.inf and f[10] == -math.inf
+    assert math.isnan(f[11])
+    assert abs(f[12] - 1.25) < 1e-12
+
+
+def test_cast_numbers_to_string(session):
+    import decimal
+    df = session.create_dataframe({
+        "i": pa.array([0, -5, 12345, None], pa.int64()),
+        "b": pa.array([True, False, None, True]),
+        "d": pa.array([decimal.Decimal("1.50"), decimal.Decimal("-0.05"),
+                       decimal.Decimal("123.00"), None],
+                      pa.decimal128(9, 2)),
+    })
+    out = df.select(col("i").cast(dt.STRING).alias("si"),
+                    col("b").cast(dt.STRING).alias("sb"),
+                    col("d").cast(dt.STRING).alias("sd")).to_arrow()
+    got = out.to_pydict()
+    assert got["si"] == ["0", "-5", "12345", None]
+    assert got["sb"] == ["true", "false", None, "true"]
+    assert got["sd"] == ["1.50", "-0.05", "123.00", None]
+
+
+def test_cast_date_to_string(session):
+    import datetime
+    df = session.create_dataframe({"d": pa.array(
+        [datetime.date(1970, 1, 1), datetime.date(2024, 2, 29),
+         datetime.date(1969, 12, 31), None], pa.date32())})
+    out = df.select(col("d").cast(dt.STRING).alias("s")).to_arrow()
+    assert out.column(0).to_pylist() == \
+        ["1970-01-01", "2024-02-29", "1969-12-31", None]
+
+
+def test_string_cast_bool(session):
+    df = session.create_dataframe({"s": pa.array(
+        ["true", "FALSE", "yes", "0", "maybe", None])})
+    out = df.select(col("s").cast(dt.BOOL).alias("b")).to_arrow()
+    assert out.column(0).to_pylist() == [True, False, True, False, None,
+                                         None]
+
+
+def test_like_exact_and_cast_wide_ints(session):
+    df = session.create_dataframe({"s": ["abc", "abcabc", "ab"],
+                                   "i": pa.array([123456789] * 1024
+                                                 + [None] * 0,
+                                                 pa.int64())[:3]})
+    got = df.select(col("s").like("abc").alias("m")).to_arrow()
+    assert got.column(0).to_pylist() == [True, False, False]
+    # wide ints: 1024 rows of 9-digit numbers must not overflow the buffer
+    wide = session.create_dataframe(
+        {"i": pa.array([123456789] * 1024, pa.int64())})
+    out = wide.select(col("i").cast(dt.STRING).alias("s")).to_arrow()
+    assert out.column(0).to_pylist() == ["123456789"] * 1024
+
+
+def test_float_parse_rejects_long_garbage(session):
+    df = session.create_dataframe({"s": ["1" * 40 + "xyz", "2.5"]})
+    out = df.select(col("s").cast(dt.FLOAT64).alias("f")).to_arrow()
+    assert out.column(0).to_pylist() == [None, 2.5]
