@@ -1,0 +1,272 @@
+"""Window function execution.
+
+(reference: window/GpuWindowExec.scala + GpuRunningWindowExec — batched
+running windows.) TPU-first: ONE sort by (partition, order) keys, then
+every window function is a segment scan or segment reduction over the
+sorted layout — ranking from boundary cumsums, running aggregates from
+prefix sums (segmented via jax.lax.associative_scan for min/max), sliding
+row frames from prefix-sum differences, lag/lead from shifted gathers.
+All window expressions over the same spec fuse into one XLA program.
+Output is in (partition, order) sorted order; Spark guarantees no
+particular output order.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.table import Schema
+from ..expr.expressions import EmitCtx, UnsupportedExpr
+from ..ops import sortkeys as sk
+from ..ops.concat import concat_cvs, concat_masks
+from ..ops.gather import take
+from ..ops.kernel_utils import CV
+from ..utils.transfer import fetch_int
+from ..window import CURRENT_ROW, UNBOUNDED, WindowExpr
+from .base import ExecContext, TpuExec
+from .batch import DeviceBatch
+from .nodes import make_table
+
+__all__ = ["WindowExec"]
+
+
+def _seg_scan_minmax(vals, valid, boundary, is_min: bool):
+    """Segmented running min/max via associative scan."""
+    ident = (jnp.inf if is_min else -jnp.inf) if jnp.issubdtype(
+        vals.dtype, jnp.floating) else (
+        jnp.iinfo(vals.dtype).max if is_min else jnp.iinfo(vals.dtype).min)
+    v = jnp.where(valid, vals, ident)
+
+    def combine(a, b):
+        af, av = a
+        bf, bv = b
+        out_v = jnp.where(bf, bv,
+                          jnp.minimum(av, bv) if is_min
+                          else jnp.maximum(av, bv))
+        return (af | bf, out_v)
+
+    _, out = jax.lax.associative_scan(combine, (boundary, v))
+    return out
+
+
+class WindowExec(TpuExec):
+    def __init__(self, child: TpuExec, names: Sequence[str],
+                 wexprs: Sequence[WindowExpr], schema: Schema):
+        super().__init__([child], schema)
+        self.names = list(names)
+        self.wexprs = list(wexprs)
+        spec = self.wexprs[0].spec
+        for w in self.wexprs[1:]:
+            if (len(w.spec.partition_keys) != len(spec.partition_keys)
+                    or len(w.spec.orders) != len(spec.orders)):
+                raise UnsupportedExpr(
+                    "multiple window specs in one select: split into "
+                    "separate selects (planner staging lands later)")
+        self.spec = spec
+        self._jit_cache = {}
+
+    def num_partitions(self, ctx):
+        return 1
+
+    def describe(self):
+        return f"WindowExec[{[w.fn for w in self.wexprs]}]"
+
+    # ------------------------------------------------------------------
+    def _compute(self, cvs, mask, nchunks):
+        cap = mask.shape[0]
+        ctx = EmitCtx(list(cvs), cap)
+        pkeys = [k.emit(ctx) for k in self.spec.partition_keys]
+        okeys = [o.expr.emit(ctx) for o in self.spec.orders]
+
+        arrays = [jnp.logical_not(mask).astype(jnp.uint8)]
+        pk_arrays = []
+        i = 0
+        for kcv, kexpr in zip(pkeys, self.spec.partition_keys):
+            pk_arrays.append(jnp.logical_not(kcv.validity).astype(jnp.uint8))
+            pk_arrays.extend(sk.order_keys(kcv, kexpr.dtype, nchunks[i]))
+            i += 1
+        ok_arrays = []
+        for kcv, o in zip(okeys, self.spec.orders):
+            vkey = kcv.validity.astype(jnp.uint8)
+            ok_arrays.append(vkey if o.nulls_first else ~vkey)
+            ok_arrays.extend(sk.order_keys(kcv, o.expr.dtype, nchunks[i],
+                                           descending=not o.ascending))
+            i += 1
+        perm = sk.lexsort(arrays + pk_arrays + ok_arrays)
+        live = mask[perm]
+
+        pb = sk.group_boundaries([a[perm] for a in arrays + pk_arrays])
+        seg_ids = jnp.cumsum(pb.astype(jnp.int32)) - 1
+        pos = jnp.arange(cap)
+        seg_start = jax.ops.segment_min(pos, seg_ids, cap)[seg_ids]
+        seg_cnt = jax.ops.segment_sum(jnp.ones(cap, jnp.int64), seg_ids,
+                                      cap)
+        seg_end = seg_start + seg_cnt[seg_ids] - 1
+        pos_in_seg = pos - seg_start
+        # order-value change boundaries (for rank/dense_rank)
+        ob = pb | sk.group_boundaries(
+            [a[perm] for a in arrays + pk_arrays + ok_arrays])
+
+        outs = []
+        for w in self.wexprs:
+            outs.append(self._one(w, ctx, perm, live, pb, ob, seg_ids,
+                                  seg_start, seg_end, pos, pos_in_seg, cap))
+        sorted_cols = [take(cv, perm, in_bounds=live) for cv in cvs]
+        return sorted_cols, outs, live
+
+    def _one(self, w: WindowExpr, ctx, perm, live, pb, ob, seg_ids,
+             seg_start, seg_end, pos, pos_in_seg, cap):
+        always = jnp.ones(cap, jnp.bool_)
+        if w.fn == "row_number":
+            return CV((pos_in_seg + 1).astype(jnp.int32), live)
+        if w.fn == "rank":
+            last_ob = jax.lax.associative_scan(jnp.maximum,
+                                               jnp.where(ob, pos, -1))
+            return CV((last_ob - seg_start + 1).astype(jnp.int32), live)
+        if w.fn == "dense_rank":
+            c2 = jnp.cumsum(ob.astype(jnp.int32))
+            base = c2[jnp.clip(seg_start, 0, cap - 1)]
+            return CV((c2 - base + 1).astype(jnp.int32), live)
+
+        cv = w.child.emit(ctx)
+        scv = take(cv, perm, in_bounds=live)
+        if w.fn in ("lag", "lead"):
+            off = w.offset if w.fn == "lag" else -w.offset
+            j = pos - off
+            in_seg = (j >= seg_start) & (j <= seg_end)
+            j = jnp.clip(j, 0, cap - 1)
+            out = take(scv, j.astype(jnp.int32), in_bounds=in_seg & live)
+            if w.default is not None and scv.offsets is None:
+                from ..expr.expressions import Literal
+                dv = Literal(w.default, w.dtype).device_value()
+                out = CV(jnp.where(in_seg, out.data, dv),
+                         jnp.where(in_seg, out.validity, True) & live)
+            return out
+
+        valid = scv.validity & live
+        frame = w.spec.frame
+        if scv.offsets is not None:
+            raise UnsupportedExpr(f"window {w.fn} over strings")
+        x = scv.data
+        acc_dt = (jnp.float64 if jnp.issubdtype(x.dtype, jnp.floating)
+                  else jnp.int64)
+        xz = jnp.where(valid, x, 0).astype(acc_dt)
+        vz = valid.astype(jnp.int64)
+
+        if frame == (UNBOUNDED, UNBOUNDED):
+            if w.fn in ("sum", "avg", "count"):
+                s = jax.ops.segment_sum(xz, seg_ids, cap)[seg_ids]
+                c = jax.ops.segment_sum(vz, seg_ids, cap)[seg_ids]
+            elif w.fn == "min":
+                s = jax.ops.segment_min(
+                    jnp.where(valid, x, _ident_of(x.dtype, True)),
+                    seg_ids, cap)[seg_ids]
+                c = jax.ops.segment_sum(vz, seg_ids, cap)[seg_ids]
+            else:
+                s = jax.ops.segment_max(
+                    jnp.where(valid, x, _ident_of(x.dtype, False)),
+                    seg_ids, cap)[seg_ids]
+                c = jax.ops.segment_sum(vz, seg_ids, cap)[seg_ids]
+            return self._finish(w, s, c, live)
+
+        if frame == (UNBOUNDED, CURRENT_ROW):
+            if w.fn in ("min", "max"):
+                s = _seg_scan_minmax(x, valid, pb, w.fn == "min")
+                c = _running(vz, seg_start)
+                return self._finish(w, s, c, live)
+            s = _running(xz, seg_start)
+            c = _running(vz, seg_start)
+            return self._finish(w, s, c, live)
+
+        # bounded rows frame (-k .. m) via prefix sums
+        k, m_ = frame
+        if w.fn in ("min", "max"):
+            raise UnsupportedExpr("bounded min/max window lands with the "
+                                  "doubling scan")
+        pre = jnp.cumsum(xz)
+        prev = jnp.cumsum(vz)
+        lo = seg_start if k is UNBOUNDED else jnp.maximum(pos + k,
+                                                          seg_start)
+        hi = seg_end if m_ is UNBOUNDED else jnp.minimum(pos + m_,
+                                                         seg_end)
+        lo_idx = jnp.clip(lo - 1, 0, cap - 1)
+        s = pre[jnp.clip(hi, 0, cap - 1)] - jnp.where(lo > 0,
+                                                      pre[lo_idx], 0)
+        c = prev[jnp.clip(hi, 0, cap - 1)] - jnp.where(lo > 0,
+                                                       prev[lo_idx], 0)
+        empty = hi < lo
+        c = jnp.where(empty, 0, c)
+        return self._finish(w, s, c, live)
+
+    def _finish(self, w, s, c, live):
+        if w.fn == "count":
+            return CV(c.astype(jnp.int64), live)
+        if w.fn == "avg":
+            safe = jnp.where(c > 0, c, 1)
+            return CV(s.astype(jnp.float64) / safe, live & (c > 0))
+        return CV(s.astype(w.dtype.np_dtype), live & (c > 0))
+
+    # ------------------------------------------------------------------
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        m = ctx.metrics_for(self._op_id)
+        child = self.children[0]
+        batches = []
+        for cpid in range(child.num_partitions(ctx)):
+            batches.extend(child.execute_partition(ctx, cpid))
+        if not batches:
+            return
+        ncols = len(batches[0].table.columns)
+        if len(batches) == 1:
+            cvs, mask = batches[0].cvs(), batches[0].row_mask
+        else:
+            cvs = [concat_cvs([b.cvs()[i] for b in batches],
+                              child.schema.fields[i].dtype)
+                   for i in range(ncols)]
+            mask = concat_masks([b.row_mask for b in batches])
+        with m.timer("opTime"):
+            nchunks = self._nchunks(cvs, mask)
+            fn = self._jit_cache.get(nchunks)
+            if fn is None:
+                fn = jax.jit(lambda c, mk: self._compute(c, mk, nchunks))
+                self._jit_cache[nchunks] = fn
+            sorted_cols, outs, live = fn(cvs, mask)
+        cap = live.shape[0]
+        tbl = make_table(self.schema, list(sorted_cols) + list(outs), cap)
+        m.add("numOutputBatches", 1)
+        yield DeviceBatch(tbl, cap, live, cap)
+
+    def _nchunks(self, cvs, mask) -> Tuple[int, ...]:
+        ctx = EmitCtx(list(cvs), mask.shape[0])
+        ncs = []
+        exprs = list(self.spec.partition_keys) + [o.expr for o in
+                                                  self.spec.orders]
+        for e in exprs:
+            if isinstance(e.dtype, (dt.StringType, dt.BinaryType)):
+                kcv = e.emit(ctx)
+                lens = kcv.offsets[1:] - kcv.offsets[:-1]
+                lens = jnp.where(mask & kcv.validity, lens, 0)
+                ncs.append(sk.nchunks_for_len(
+                    max(fetch_int(jnp.max(lens)), 1)))
+            else:
+                ncs.append(0)
+        return tuple(ncs)
+
+
+def _ident_of(dtype, for_min: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.inf if for_min else -jnp.inf
+    if dtype == jnp.bool_:
+        return for_min
+    return jnp.iinfo(dtype).max if for_min else jnp.iinfo(dtype).min
+
+
+def _running(x, seg_start):
+    """Segmented running sum: cumsum minus the segment's base prefix."""
+    cap = x.shape[0]
+    pre = jnp.cumsum(x)
+    base_idx = jnp.clip(seg_start - 1, 0, cap - 1)
+    base = jnp.where(seg_start > 0, pre[base_idx], 0)
+    return pre - base

@@ -764,6 +764,10 @@ class Cast(Expression):
                 return cast_ops.cast_cv(f, dt.FLOAT64, self.to)
         if isinstance(self.to, dt.StringType) and not isinstance(
                 from_t, dt.StringType):
+            if isinstance(from_t, dt.NullType):
+                return CV(jnp.zeros(128, jnp.uint8),
+                          jnp.zeros(cv.capacity, jnp.bool_),
+                          jnp.zeros(cv.capacity + 1, jnp.int32))
             if isinstance(from_t, dt.BooleanType):
                 return cs.bool_to_string(cv)
             if isinstance(from_t, dt.DecimalType):

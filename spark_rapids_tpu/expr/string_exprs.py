@@ -177,23 +177,22 @@ class Like(Expression):
         trail = not pat.endswith("%")
         inner = [p for p in parts if p]
         n = cv.offsets.shape[0] - 1
-        ok = jnp.ones(n, jnp.bool_)
-        lens = ops_str.str_len_bytes(cv)
-        min_len = sum(len(p) for p in inner)
-        ok = ok & (lens >= min_len)
+        ok = (lens0 >= sum(len(p) for p in inner))
         if not inner:
-            # pattern is only % signs (or empty): '' matches only empty
-            if pat == "":
-                ok = lens == 0
-            return CV(ok, cv.validity)
+            # pattern is only % signs: matches anything (incl. empty)
+            return CV(jnp.ones(n, jnp.bool_), cv.validity)
+        # with >=1 '%', a single literal run cannot be both the required
+        # prefix and suffix, so lead/trail consume distinct runs
+        middle = list(inner)
         if lead:
             ok = ok & ops_str.startswith(cv, parts[0])
+            middle = middle[1:]
         if trail:
             ok = ok & ops_str.endswith(cv, parts[-1])
-        # middle parts must appear in order; round-1 checks containment
-        # (exact ordered search needs per-part position tracking; patterns
-        # with repeated inner runs may over-match — documented)
-        for p in inner:
+            middle = middle[:-1]
+        # middle runs must appear; containment check (may over-match for
+        # repeated runs — documented in docs/compatibility.md)
+        for p in middle:
             ok = ok & ops_str.contains(cv, p)
         return CV(ok, cv.validity)
 

@@ -1,0 +1,84 @@
+"""Columnar Python UDFs — the RapidsUDF / CPU-bridge analog.
+
+The reference has two escape hatches: RapidsUDF.evaluateColumnar (user
+supplies a columnar kernel, reference: sql-plugin-api/.../RapidsUDF.java:22)
+and GpuCpuBridgeExpression (copy to host, evaluate on CPU, copy back —
+reference: GpuCpuBridgeExpression.scala). Here both collapse into one
+mechanism: `PyUDF` wraps a numpy-vectorized Python function and emits a
+`jax.pure_callback` inside the traced pipeline — XLA suspends the device
+program, runs the host function on the fetched buffers, and resumes with
+the result. Null-safe by default (null in -> null out, fn sees raw
+buffers).
+"""
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..columnar import dtypes as dt
+from ..ops.kernel_utils import CV
+from .expressions import Expression, UnsupportedExpr
+
+__all__ = ["PyUDF", "udf"]
+
+
+class PyUDF(Expression):
+    def __init__(self, fn: Callable, return_type: dt.DataType,
+                 children: Sequence[Expression], null_safe: bool = True):
+        self.fn = fn
+        self.return_type = return_type
+        self.children = list(children)
+        self.null_safe = null_safe
+        if return_type.is_variable_width or return_type.is_nested:
+            raise UnsupportedExpr("PyUDF round-1 returns fixed-width types")
+
+    @property
+    def name(self):
+        return getattr(self.fn, "__name__", "udf")
+
+    def bind(self, schema):
+        b = PyUDF(self.fn, self.return_type,
+                  [c.bind(schema) for c in self.children], self.null_safe)
+        b.dtype = self.return_type
+        return b
+
+    def emit(self, ctx):
+        cvs = [c.emit(ctx) for c in self.children]
+        for c, cv in zip(self.children, cvs):
+            if cv.offsets is not None:
+                raise UnsupportedExpr("PyUDF over strings round-1")
+        cap = ctx.capacity
+        np_dt = self.return_type.np_dtype
+
+        def host_fn(*arrays):
+            out = self.fn(*[np.asarray(a) for a in arrays])
+            return np.ascontiguousarray(out, dtype=np_dt)
+
+        out_shape = jax.ShapeDtypeStruct((cap,), np_dt)
+        data = jax.pure_callback(host_fn, out_shape,
+                                 *[cv.data for cv in cvs])
+        valid = jnp.ones(cap, jnp.bool_)
+        if self.null_safe:
+            for cv in cvs:
+                valid = valid & cv.validity
+        return CV(data, valid)
+
+    def __repr__(self):
+        return f"{self.name}({', '.join(map(repr, self.children))})"
+
+
+def udf(fn: Callable, return_type: dt.DataType, null_safe: bool = True):
+    """Wrap a numpy-vectorized function as a columnar UDF factory:
+
+        doubled = udf(lambda x: x * 2, dtypes.INT64)
+        df.select(doubled(col("a")))
+    """
+    def factory(*cols):
+        from ..functions import _to_expr
+        return PyUDF(fn, return_type, [_to_expr(c) for c in cols],
+                     null_safe)
+    factory.__name__ = getattr(fn, "__name__", "udf")
+    return factory

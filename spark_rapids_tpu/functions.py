@@ -9,6 +9,7 @@ from __future__ import annotations
 from .expr import aggregates as _agg
 from .expr import string_exprs as _se
 from .expr import datetime_exprs as _de
+from .expr.udf import udf  # noqa: F401  (public re-export)
 from .expr.expressions import (Abs, CaseWhen, Cast, Coalesce, ColumnRef,
                                EqNullSafe, Expression, Greatest, If, In,
                                IsNaN, IsNull, Least, Literal, MathUnary,
@@ -21,6 +22,7 @@ __all__ = [
     "greatest", "least", "pmod", "negate", "signum",
     "length", "upper", "lower", "substring", "concat", "contains",
     "startswith", "endswith", "like",
+    "udf",
     "year", "month", "dayofmonth", "dayofweek", "dayofyear", "quarter",
     "hour", "minute", "second", "date_add", "date_sub", "datediff",
     "last_day", "to_date",

@@ -1,0 +1,29 @@
+"""CSV scan (reference: GpuCSVScan.scala:57 over cudf read_csv; here Arrow
+C++ host decode feeding device batches — the same host-decode H2D split the
+round-1 parquet reader uses)."""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def read_csv_to_arrow(path: str, header: bool = True, schema=None,
+                      delimiter: str = ","):
+    import pyarrow.csv as pc
+    ropts = pc.ReadOptions(autogenerate_column_names=not header)
+    popts = pc.ParseOptions(delimiter=delimiter)
+    copts = None
+    if schema is not None:
+        import pyarrow as pa
+        arrow_schema = schema.to_arrow() if hasattr(schema, "to_arrow") \
+            else schema
+        copts = pc.ConvertOptions(column_types={
+            f.name: f.type for f in arrow_schema})
+    return pc.read_csv(path, read_options=ropts, parse_options=popts,
+                       convert_options=copts)
+
+
+def write_csv(df, path: str, header: bool = True):
+    import pyarrow.csv as pc
+    at = df.to_arrow()
+    pc.write_csv(at, path,
+                 write_options=pc.WriteOptions(include_header=header))

@@ -1,0 +1,13 @@
+"""JSON-lines scan (reference: GpuJsonScan.scala over cudf read_json)."""
+from __future__ import annotations
+
+
+def read_json_to_arrow(path: str, schema=None):
+    import pyarrow.json as pj
+    popts = None
+    if schema is not None:
+        import pyarrow as pa
+        arrow_schema = schema.to_arrow() if hasattr(schema, "to_arrow") \
+            else schema
+        popts = pj.ParseOptions(explicit_schema=arrow_schema)
+    return pj.read_json(path, parse_options=popts)

@@ -94,6 +94,10 @@ def _parse_digits(cv: CV, tstart, tlen):
     has_digits = ndig > 0
     invalid = invalid | ~has_digits | (ndig > _MAX_DIGITS)
     invalid = invalid | (tlen == 0)
+    # 19-digit magnitudes can wrap int64: a wrapped accumulator is negative.
+    # The single legal wrap is INT64_MIN ("-9223372036854775808").
+    int64_min = jnp.int64(-2**63)
+    invalid = invalid | ((value < 0) & ~(neg & (value == int64_min)))
     value = jnp.where(neg, -value, value)
     return value, ndig, frac_first, seen_dot, ~invalid
 
@@ -131,6 +135,8 @@ def string_to_float(cv: CV) -> CV:
     in_exp = jnp.zeros(n, jnp.bool_)
     ndig = jnp.zeros(n, jnp.int32)
     invalid = jnp.zeros(n, jnp.bool_)
+    prev_was_e = jnp.zeros(n, jnp.bool_)
+    exp_ndig = jnp.zeros(n, jnp.int32)
 
     for k in range(40):
         p = skip + k
@@ -152,12 +158,19 @@ def string_to_float(cv: CV) -> CV:
                        cv.data[jnp.clip(tstart + p1, 0, dcap - 1)]
                        .astype(jnp.int32), -1)
         exp_neg = jnp.where(newly_exp & (b1 == 45), True, exp_neg)
+        was_in_exp = in_exp
         in_exp = in_exp | newly_exp
-        is_exp_sign = in_exp & ((b == 45) | (b == 43))
-        valid_char = is_digit | newly_dot | newly_exp | is_exp_sign
+        # a sign inside the exponent is legal ONLY immediately after e/E
+        sign_ok = prev_was_e & ((b == 45) | (b == 43))
+        valid_char = is_digit | newly_dot | newly_exp | sign_ok
         invalid = invalid | (active & ~valid_char)
+        prev_was_e = newly_exp
+        exp_ndig = jnp.where(active & is_digit & in_exp & ~newly_exp,
+                             exp_ndig + 1, exp_ndig)
     # anything beyond the scan window is unvalidated -> reject
     invalid = invalid | (tlen > skip + 40)
+    # 'e' with no exponent digits is malformed
+    invalid = invalid | (in_exp & (exp_ndig == 0))
     exp = jnp.where(exp_neg, -exp_val, exp_val) - frac_scale
     out = mant * jnp.power(10.0, exp.astype(jnp.float64))
     out = jnp.where(neg, -out, out)

@@ -16,7 +16,7 @@ from ..expr import aggregates as agg
 
 __all__ = ["LogicalPlan", "InMemoryScan", "CachedScan", "ParquetScan", "Project", "Filter",
            "Aggregate", "Join", "Sort", "SortOrder", "Limit", "Union",
-           "Repartition"]
+           "Repartition", "WindowOp"]
 
 
 class LogicalPlan:
@@ -245,6 +245,26 @@ class Union(LogicalPlan):
     @property
     def schema(self):
         return self._schema
+
+
+class WindowOp(LogicalPlan):
+    """Appends window-function columns (reference: GpuWindowExec planning
+    in GpuWindowExecMeta.scala — round-1 requires one shared spec)."""
+
+    def __init__(self, child: LogicalPlan, wcols):
+        self.child = child
+        self.children = [child]
+        self.wcols = list(wcols)          # (name, WindowExpr) unbound
+        self.bound = [(n, w.bind(child.schema)) for n, w in self.wcols]
+        self._schema = Schema(list(child.schema.fields)
+                              + [Field(n, w.dtype) for n, w in self.bound])
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"WindowOp[{[n for n, _ in self.wcols]}]"
 
 
 class Repartition(LogicalPlan):

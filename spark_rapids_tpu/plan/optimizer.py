@@ -95,6 +95,8 @@ def prune(plan: L.LogicalPlan,
         # cannot be pruned without rewriting parent BoundRefs
         return L.Join(prune(plan.left, None), prune(plan.right, None),
                       plan.left_keys, plan.right_keys, plan.how)
+    if isinstance(plan, L.WindowOp):
+        return L.WindowOp(prune(plan.child, None), plan.wcols)
     if isinstance(plan, L.Repartition):
         return L.Repartition(prune(plan.child, None), plan.num_partitions,
                              plan.keys)

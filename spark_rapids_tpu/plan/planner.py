@@ -131,6 +131,14 @@ def _join(meta, conv, conf):
                         n.schema)
 
 
+@_rule(L.WindowOp)
+def _window(meta, conv, conf):
+    from ..exec.window import WindowExec
+    n = meta.node
+    return WindowExec(conv(meta.children[0]), [nm for nm, _ in n.bound],
+                      [w for _, w in n.bound], n.schema)
+
+
 @_rule(L.Repartition)
 def _repart(meta, conv, conf):
     from ..exec.exchange import ShuffleExchangeExec
@@ -152,7 +160,10 @@ class Planner:
         if explain_mode in ("ALL", "NOT_ON_TPU"):
             for line in meta.explain_lines(explain_mode == "NOT_ON_TPU"):
                 print(line)
-        return self._convert(meta)
+        root_exec = self._convert(meta)
+        from ..utils.lore import apply_lore_dump, assign_lore_ids
+        assign_lore_ids(root_exec)
+        return apply_lore_dump(root_exec, self.conf)
 
     def _tag(self, meta: PlanMeta):
         if type(meta.node) not in _RULES:

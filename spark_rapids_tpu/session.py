@@ -136,13 +136,32 @@ class DataFrame:
         return self._plan.schema.names
 
     def select(self, *exprs) -> "DataFrame":
+        from .window import WindowExpr
+        from .expr.expressions import Alias, ColumnRef
         es = [_to_expr(e) for e in exprs]
+        # extract window expressions into a WindowOp stage (the planner
+        # split the reference does in GpuWindowExecMeta)
+        wcols, plain = [], []
+        for e in es:
+            inner = e.child if isinstance(e, Alias) else e
+            if isinstance(inner, WindowExpr):
+                name = e._name if isinstance(e, Alias) else \
+                    f"_w{len(wcols)}"
+                wcols.append((name, inner))
+                plain.append(ColumnRef(name))
+            else:
+                plain.append(e)
+        if wcols:
+            return DataFrame(self._session,
+                             L.Project(L.WindowOp(self._plan, wcols),
+                                       plain))
         return DataFrame(self._session, L.Project(self._plan, es))
 
     def with_column(self, name: str, e) -> "DataFrame":
+        # route through select() so window-expression extraction applies
         es = [col(n) for n in self.columns if n != name]
         es.append(_to_expr(e).alias(name))
-        return DataFrame(self._session, L.Project(self._plan, es))
+        return self.select(*es)
 
     withColumn = with_column
 
@@ -207,6 +226,12 @@ class DataFrame:
 
     orderBy = sort
 
+    def distinct(self) -> "DataFrame":
+        ks = [col(n) for n in self.columns]
+        return DataFrame(self._session, L.Aggregate(self._plan, ks, []))
+
+    dropDuplicates = distinct
+
     def limit(self, n: int) -> "DataFrame":
         return DataFrame(self._session, L.Limit(self._plan, n))
 
@@ -243,7 +268,15 @@ class DataFrame:
 
     def to_arrow(self):
         root, ctx = self._execute()
-        return collect_to_arrow(root, ctx)
+        out = collect_to_arrow(root, ctx)
+        self._last_metrics = {op: ms.snapshot()
+                              for op, ms in ctx.metrics.items()}
+        return out
+
+    def last_metrics(self):
+        """Per-operator metrics of the most recent action (GpuMetric
+        analog; levels per spark.rapids.tpu.sql.metrics.level)."""
+        return getattr(self, "_last_metrics", {})
 
     def collect(self) -> List[tuple]:
         at = self.to_arrow()

@@ -1,0 +1,40 @@
+"""Tracing / profiling hooks.
+
+NVTX-range analog (reference: NvtxWithMetrics.scala, docs/dev/nvtx_profiling
+.md): named ranges show up in the XLA/Perfetto profiler timeline; the
+built-in profiler capture (reference: profiler.scala CUPTI Profiler) maps
+to jax.profiler traces written to a directory viewable in Perfetto/
+TensorBoard.
+"""
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+import jax
+
+__all__ = ["range_annotation", "start_profile", "stop_profile"]
+
+
+@contextmanager
+def range_annotation(name: str):
+    """NVTX-range analog; nests, shows in profiler timelines."""
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+_active = {"dir": None}
+
+
+def start_profile(out_dir: str):
+    jax.profiler.start_trace(out_dir)
+    _active["dir"] = out_dir
+    return out_dir
+
+
+def stop_profile() -> Optional[str]:
+    if _active["dir"] is None:
+        return None
+    jax.profiler.stop_trace()
+    d, _active["dir"] = _active["dir"], None
+    return d

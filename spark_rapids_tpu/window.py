@@ -1,0 +1,179 @@
+"""Window function API: WindowSpec + window expressions.
+
+Mirrors pyspark.sql.Window / the reference's window package
+(reference: sql-plugin/.../window/ — GpuWindowExec, GpuRunningWindowExec,
+GpuBatchedBoundedWindowExec). Frames supported round-1:
+
+  - unboundedPreceding..currentRow  (running aggregates / ranking)
+  - unboundedPreceding..unboundedFollowing (whole-partition aggregates)
+  - rowsBetween(-k, m) for sum/count/avg (prefix-sum differences)
+  - lag/lead
+
+Usage:
+    from spark_rapids_tpu.window import Window
+    w = Window.partition_by("k").order_by("ts")
+    df.select(F.col("v"), row_number().over(w).alias("rn"))
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from .expr.expressions import Expression, UnsupportedExpr, _wrap
+from .plan.logical import SortOrder
+
+__all__ = ["Window", "WindowSpec", "WindowExpr", "row_number", "rank",
+           "dense_rank", "lag", "lead", "win_sum", "win_count", "win_min",
+           "win_max", "win_avg", "CURRENT_ROW", "UNBOUNDED"]
+
+UNBOUNDED = object()
+CURRENT_ROW = 0
+
+
+class WindowSpec:
+    def __init__(self, partition_keys=(), orders=(),
+                 frame: Tuple = (UNBOUNDED, CURRENT_ROW)):
+        self.partition_keys = list(partition_keys)
+        self.orders = list(orders)
+        self.frame = frame
+
+    def partition_by(self, *keys) -> "WindowSpec":
+        from .functions import _to_expr
+        return WindowSpec([_to_expr(k) for k in keys], self.orders,
+                          self.frame)
+
+    def order_by(self, *orders) -> "WindowSpec":
+        from .functions import _to_expr
+        sos = []
+        for o in orders:
+            if isinstance(o, SortOrder):
+                sos.append(o)
+            else:
+                sos.append(SortOrder(_to_expr(o), True))
+        return WindowSpec(self.partition_keys, sos, self.frame)
+
+    def rows_between(self, start, end) -> "WindowSpec":
+        return WindowSpec(self.partition_keys, self.orders, (start, end))
+
+
+class _WindowBuilder:
+    """Window.partition_by(...) entry point (class-method style)."""
+
+    @staticmethod
+    def partition_by(*keys) -> WindowSpec:
+        return WindowSpec().partition_by(*keys)
+
+    @staticmethod
+    def order_by(*orders) -> WindowSpec:
+        return WindowSpec().order_by(*orders)
+
+    unboundedPreceding = UNBOUNDED
+    unboundedFollowing = UNBOUNDED
+    currentRow = CURRENT_ROW
+
+
+Window = _WindowBuilder
+
+
+class WindowExpr(Expression):
+    """fn OVER spec. Bound by the Window logical node."""
+
+    FNS = ("row_number", "rank", "dense_rank", "lag", "lead", "sum",
+           "count", "min", "max", "avg")
+
+    def __init__(self, fn: str, child: Optional[Expression],
+                 spec: WindowSpec, offset: int = 1,
+                 default=None):
+        assert fn in self.FNS
+        self.fn = fn
+        self.child = child
+        self.spec = spec
+        self.offset = offset
+        self.default = default
+        self.children = [c for c in [child] if c is not None]
+
+    def bind(self, schema):
+        b = WindowExpr(self.fn,
+                       self.child.bind(schema) if self.child else None,
+                       WindowSpec(
+                           [k.bind(schema) for k in self.spec.partition_keys],
+                           [SortOrder(o.expr.bind(schema), o.ascending,
+                                      o.nulls_first)
+                            for o in self.spec.orders],
+                           self.spec.frame),
+                       self.offset, self.default)
+        from .columnar import dtypes as dt
+        if self.fn in ("row_number", "rank", "dense_rank"):
+            if not b.spec.orders:
+                raise UnsupportedExpr(f"{self.fn} requires ORDER BY")
+            b.dtype = dt.INT32
+        elif self.fn in ("lag", "lead"):
+            b.dtype = b.child.dtype
+        elif self.fn == "count":
+            b.dtype = dt.INT64
+        elif self.fn == "avg":
+            b.dtype = dt.FLOAT64
+        else:
+            from .expr.aggregates import Sum, Min, Max
+            proto = {"sum": Sum, "min": Min, "max": Max}[self.fn](b.child)
+            proto._resolve_type()
+            b.dtype = proto.dtype
+        return b
+
+    @property
+    def name(self):
+        return f"{self.fn}()"
+
+    def __repr__(self):
+        return f"{self.fn}(...) OVER (...)"
+
+
+class _PendingWindowFn:
+    def __init__(self, fn, child=None, offset=1, default=None):
+        self.fn = fn
+        self.child = child
+        self.offset = offset
+        self.default = default
+
+    def over(self, spec: WindowSpec) -> WindowExpr:
+        return WindowExpr(self.fn, self.child, spec, self.offset,
+                          self.default)
+
+
+def row_number():
+    return _PendingWindowFn("row_number")
+
+
+def rank():
+    return _PendingWindowFn("rank")
+
+
+def dense_rank():
+    return _PendingWindowFn("dense_rank")
+
+
+def lag(e, offset: int = 1, default=None):
+    return _PendingWindowFn("lag", _wrap(e), offset, default)
+
+
+def lead(e, offset: int = 1, default=None):
+    return _PendingWindowFn("lead", _wrap(e), offset, default)
+
+
+def win_sum(e):
+    return _PendingWindowFn("sum", _wrap(e))
+
+
+def win_count(e):
+    return _PendingWindowFn("count", _wrap(e))
+
+
+def win_min(e):
+    return _PendingWindowFn("min", _wrap(e))
+
+
+def win_max(e):
+    return _PendingWindowFn("max", _wrap(e))
+
+
+def win_avg(e):
+    return _PendingWindowFn("avg", _wrap(e))

@@ -1,0 +1,58 @@
+"""IO round-trips: parquet/csv/json read + parquet write."""
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col
+
+from asserts import assert_rows_equal
+from data_gen import DoubleGen, IntegerGen, StringGen, gen_arrow_table
+
+
+def test_parquet_read_multi_file(session, tmp_path):
+    at = gen_arrow_table([("a", IntegerGen()), ("s", StringGen())],
+                         n=2000, seed=80)
+    for i in range(3):
+        pq.write_table(at.slice(i * 600, 600), tmp_path / f"f{i}.parquet")
+    df = session.read.parquet(str(tmp_path))
+    rows = list(zip(at.column(0).to_pylist()[:1800],
+                    at.column(1).to_pylist()[:1800]))
+    assert_rows_equal(df.to_arrow(), rows)
+    assert df.count() == 1800
+
+
+def test_parquet_write_roundtrip(session, tmp_path):
+    at = gen_arrow_table([("a", IntegerGen()), ("b", DoubleGen()),
+                          ("s", StringGen())], n=1500, seed=81)
+    df = session.create_dataframe(at)
+    out = str(tmp_path / "out")
+    df.filter(col("a").isNotNull()).write_parquet(out)
+    assert os.path.exists(os.path.join(out, "_SUCCESS"))
+    back = session.read.parquet(out)
+    exp = [r for r in zip(at.column(0).to_pylist(),
+                          at.column(1).to_pylist(),
+                          at.column(2).to_pylist()) if r[0] is not None]
+    assert_rows_equal(back.to_arrow(), exp)
+
+
+def test_csv_roundtrip(session, tmp_path):
+    at = gen_arrow_table([("x", IntegerGen(nullable=False)),
+                          ("y", StringGen(charset="abc", max_len=5,
+                                          no_special=True))],
+                         n=500, seed=82)
+    import pyarrow.csv as pc
+    p = str(tmp_path / "t.csv")
+    pc.write_csv(at, p)
+    df = session.read.csv(p)
+    got = df.agg(F.sum("x").alias("s")).collect()[0][0]
+    assert got == sum(v for v in at.column(0).to_pylist())
+
+
+def test_json_read(session, tmp_path):
+    p = str(tmp_path / "t.jsonl")
+    with open(p, "w") as f:
+        f.write('{"a": 1, "s": "x"}\n{"a": 2, "s": null}\n{"a": null, "s": "z"}\n')
+    df = session.read.json(p)
+    assert_rows_equal(df.to_arrow(), [(1, "x"), (2, None), (None, "z")])

@@ -183,3 +183,28 @@ def test_float_parse_rejects_long_garbage(session):
     df = session.create_dataframe({"s": ["1" * 40 + "xyz", "2.5"]})
     out = df.select(col("s").cast(dt.FLOAT64).alias("f")).to_arrow()
     assert out.column(0).to_pylist() == [None, 2.5]
+
+
+def test_float_parse_exponent_validation(session):
+    df = session.create_dataframe({"s": ["1e5-3", "2e", "3e+4", "5e-2",
+                                         "1e5"]})
+    out = df.select(col("s").cast(dt.FLOAT64).alias("f")).to_arrow()
+    assert out.column(0).to_pylist() == [None, None, 30000.0, 0.05,
+                                         100000.0]
+
+
+def test_int_parse_19_digit_overflow(session):
+    df = session.create_dataframe({"s": [
+        "9223372036854775807", "9223372036854775808",
+        "-9223372036854775808", "-9223372036854775809"]})
+    out = df.select(col("s").cast(dt.INT64).alias("i")).to_arrow()
+    assert out.column(0).to_pylist() == [2**63 - 1, None, -2**63, None]
+
+
+def test_window_via_with_column(session):
+    from spark_rapids_tpu.window import Window, row_number
+    df = session.create_dataframe({"k": [1, 1, 2], "v": [5, 3, 9]})
+    out = df.with_column(
+        "rn", row_number().over(Window.partition_by("k").order_by("v")))
+    got = sorted(out.collect())
+    assert got == [(1, 3, 1), (1, 5, 2), (2, 9, 1)]

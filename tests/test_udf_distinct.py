@@ -1,0 +1,46 @@
+"""Columnar UDF bridge + distinct."""
+import numpy as np
+import pyarrow as pa
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.expressions import col
+
+from asserts import assert_rows_equal
+from data_gen import IntegerGen, StringGen, gen_df
+
+
+def test_py_udf_columnar(session):
+    df, at = gen_df(session, [("a", IntegerGen(lo=0, hi=1000)),
+                              ("b", IntegerGen(lo=0, hi=1000))],
+                    n=700, seed=90)
+    gcd = F.udf(np.gcd, dt.INT32)
+    out = df.select(gcd(col("a"), col("b")).alias("g")).to_arrow()
+    exp = [(None if a is None or b is None else int(np.gcd(a, b)),)
+           for a, b in zip(at.column(0).to_pylist(),
+                           at.column(1).to_pylist())]
+    assert_rows_equal(out, exp, ignore_order=False)
+
+
+def test_udf_composes_with_pipeline(session):
+    df, _ = gen_df(session, [("a", IntegerGen(lo=1, hi=100,
+                                              nullable=False))],
+                   n=500, seed=91)
+    triple = F.udf(lambda x: x * 3, dt.INT64)
+    out = df.select(triple(col("a")).alias("t")) \
+        .filter(col("t") > 150).agg(F.count("*").alias("n"))
+    a = df.to_arrow().column(0).to_pylist()
+    exp = sum(1 for v in a if v * 3 > 150)
+    assert out.collect()[0][0] == exp
+
+
+def test_distinct(session):
+    df, at = gen_df(session, [("k", IntegerGen(lo=0, hi=10)),
+                              ("s", StringGen(max_len=3, charset="ab"))],
+                    n=2000, seed=92)
+    out = df.distinct().to_arrow()
+    exp = sorted(set(zip(at.column(0).to_pylist(),
+                         at.column(1).to_pylist())),
+                 key=lambda t: (t[0] is None, str(t)))
+    assert out.num_rows == len(exp)
+    assert_rows_equal(out, list(exp))

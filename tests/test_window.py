@@ -1,0 +1,116 @@
+"""Window function correctness vs Python references."""
+from collections import defaultdict
+
+import pyarrow as pa
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col
+from spark_rapids_tpu.plan.logical import SortOrder
+from spark_rapids_tpu.window import (Window, dense_rank, lag, lead, rank,
+                                     row_number, win_avg, win_count,
+                                     win_max, win_min, win_sum)
+
+from asserts import assert_rows_equal
+from data_gen import IntegerGen, gen_df
+
+
+def _groups(at, kcol, vcols):
+    rows = list(zip(*[at.column(i).to_pylist()
+                      for i in range(at.num_columns)]))
+    g = defaultdict(list)
+    for r in rows:
+        g[r[kcol]].append(r)
+    return g
+
+
+def test_row_number_rank(session):
+    df, at = gen_df(session, [("k", IntegerGen(lo=0, hi=5, nullable=False)),
+                              ("v", IntegerGen(lo=0, hi=20,
+                                               nullable=False))],
+                    n=600, seed=70)
+    w = Window.partition_by("k").order_by("v")
+    out = df.select("k", "v", row_number().over(w).alias("rn"),
+                    rank().over(w).alias("rk"),
+                    dense_rank().over(w).alias("dr")).to_arrow()
+    exp = []
+    for k, rows in _groups(at, 0, [1]).items():
+        vs = sorted(r[1] for r in rows)
+        seen = {}
+        dense = {}
+        for i, v in enumerate(vs):
+            if v not in seen:
+                seen[v] = i + 1
+                dense[v] = len(dense) + 1
+        for i, v in enumerate(vs):
+            exp.append((k, v, i + 1, seen[v], dense[v]))
+    assert_rows_equal(out, exp)
+
+
+def test_running_and_total_sum(session):
+    df, at = gen_df(session, [("k", IntegerGen(lo=0, hi=4, nullable=False)),
+                              ("o", IntegerGen(lo=0, hi=10**6,
+                                               nullable=False)),
+                              ("v", IntegerGen(lo=-100, hi=100))],
+                    n=500, seed=71)
+    w = Window.partition_by("k").order_by("o")
+    wt = w.rows_between(Window.unboundedPreceding,
+                        Window.unboundedFollowing)
+    out = df.select("k", "o", "v",
+                    win_sum(col("v")).over(w).alias("run"),
+                    win_sum(col("v")).over(wt).alias("tot"),
+                    win_count(col("v")).over(w).alias("cnt"),
+                    win_min(col("v")).over(w).alias("rmin")).to_arrow()
+    exp = []
+    for k, rows in _groups(at, 0, [1, 2]).items():
+        rows = sorted(rows, key=lambda r: r[1])
+        tot_vals = [r[2] for r in rows if r[2] is not None]
+        tot = sum(tot_vals) if tot_vals else None
+        run = 0
+        cnt = 0
+        rmin = None
+        any_valid = False
+        for k_, o, v in rows:
+            if v is not None:
+                run += v
+                cnt += 1
+                rmin = v if rmin is None else min(rmin, v)
+                any_valid = True
+            exp.append((k_, o, v, run if any_valid else None, tot, cnt,
+                        rmin))
+    assert_rows_equal(out, exp)
+
+
+def test_lag_lead(session):
+    df, at = gen_df(session, [("k", IntegerGen(lo=0, hi=3, nullable=False)),
+                              ("o", IntegerGen(lo=0, hi=10**6,
+                                               nullable=False)),
+                              ("v", IntegerGen(nullable=False))],
+                    n=300, seed=72)
+    w = Window.partition_by("k").order_by("o")
+    out = df.select("k", "o", lag(col("v")).over(w).alias("lg"),
+                    lead(col("v"), 2).over(w).alias("ld")).to_arrow()
+    exp = []
+    for k, rows in _groups(at, 0, [1, 2]).items():
+        rows = sorted(rows, key=lambda r: r[1])
+        for i, (k_, o, v) in enumerate(rows):
+            lg = rows[i - 1][2] if i >= 1 else None
+            ld = rows[i + 2][2] if i + 2 < len(rows) else None
+            exp.append((k_, o, lg, ld))
+    assert_rows_equal(out, exp)
+
+
+def test_sliding_frame_sum(session):
+    df, at = gen_df(session, [("o", IntegerGen(lo=0, hi=10**7,
+                                               nullable=False)),
+                              ("v", IntegerGen(lo=0, hi=100,
+                                               nullable=False))],
+                    n=200, seed=73)
+    w = Window.order_by("o").rows_between(-2, 2)
+    out = df.select("o", win_sum(col("v")).over(w).alias("s")).to_arrow()
+    rows = sorted(zip(at.column(0).to_pylist(), at.column(1).to_pylist()))
+    exp = []
+    for i, (o, v) in enumerate(rows):
+        lo = max(0, i - 2)
+        hi = min(len(rows) - 1, i + 2)
+        exp.append((o, sum(r[1] for r in rows[lo:hi + 1])))
+    assert_rows_equal(out, exp)
