@@ -1,0 +1,182 @@
+// Native host runtime for spark-rapids-tpu.
+//
+// The reference keeps its host-side hot paths in C++ behind JNI (kudo
+// serializer, RMM host pools, murmur3 — reference: spark-rapids-jni
+// artifacts, SURVEY.md §2.8). This library is the TPU build's equivalent:
+// the shuffle wire-format kernels (validity bit packing, buffer
+// scatter/gather), Spark-compatible murmur3 for host-side partitioning,
+// and an aligned host memory arena for shuffle assembly. Exposed via a
+// plain C ABI consumed with ctypes (no pybind11 in the image).
+//
+// Build: make -C native  (g++ -O3 -march=native -shared -fPIC)
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------
+// Validity bitmap pack/unpack (Arrow LSB bit order, like np.packbits
+// with bitorder='little')
+// ---------------------------------------------------------------------
+void srtpu_pack_validity(const uint8_t* bools, int64_t n, uint8_t* out) {
+    int64_t nbytes = (n + 7) / 8;
+    std::memset(out, 0, nbytes);
+    for (int64_t i = 0; i < n; ++i) {
+        out[i >> 3] |= (bools[i] != 0) << (i & 7);
+    }
+}
+
+void srtpu_unpack_validity(const uint8_t* bits, int64_t n, uint8_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        out[i] = (bits[i >> 3] >> (i & 7)) & 1;
+    }
+}
+
+// ---------------------------------------------------------------------
+// Sliced gather: copy rows [sel[i]] of a fixed-width buffer into a dense
+// output (host-side shuffle compaction fallback / CPU bridge).
+// ---------------------------------------------------------------------
+void srtpu_gather_fixed(const uint8_t* src, int64_t elem_size,
+                        const int32_t* sel, int64_t n_out, uint8_t* dst) {
+    for (int64_t i = 0; i < n_out; ++i) {
+        std::memcpy(dst + i * elem_size, src + (int64_t)sel[i] * elem_size,
+                    elem_size);
+    }
+}
+
+// Gather variable-width rows: offsets are int32 [n+1]; returns new bytes
+// written. dst_offsets must hold n_out+1 entries.
+int64_t srtpu_gather_strings(const uint8_t* data, const int32_t* offsets,
+                             const int32_t* sel, int64_t n_out,
+                             uint8_t* dst, int32_t* dst_offsets) {
+    int64_t pos = 0;
+    dst_offsets[0] = 0;
+    for (int64_t i = 0; i < n_out; ++i) {
+        int32_t r = sel[i];
+        int32_t len = offsets[r + 1] - offsets[r];
+        std::memcpy(dst + pos, data + offsets[r], (size_t)len);
+        pos += len;
+        dst_offsets[i + 1] = (int32_t)pos;
+    }
+    return pos;
+}
+
+// ---------------------------------------------------------------------
+// Murmur3_x86_32 (Spark variant, seed folding) for host partitioning.
+// ---------------------------------------------------------------------
+static inline uint32_t rotl32(uint32_t x, int8_t r) {
+    return (x << r) | (x >> (32 - r));
+}
+
+static inline uint32_t mix_k1(uint32_t k1) {
+    k1 *= 0xcc9e2d51u;
+    k1 = rotl32(k1, 15);
+    k1 *= 0x1b873593u;
+    return k1;
+}
+
+static inline uint32_t mix_h1(uint32_t h1, uint32_t k1) {
+    h1 ^= k1;
+    h1 = rotl32(h1, 13);
+    h1 = h1 * 5 + 0xe6546b64u;
+    return h1;
+}
+
+static inline uint32_t fmix(uint32_t h1, uint32_t length) {
+    h1 ^= length;
+    h1 ^= h1 >> 16;
+    h1 *= 0x85ebca6bu;
+    h1 ^= h1 >> 13;
+    h1 *= 0xc2b2ae35u;
+    h1 ^= h1 >> 16;
+    return h1;
+}
+
+void srtpu_murmur3_int32(const int32_t* vals, const uint8_t* validity,
+                         int64_t n, int32_t seed, int32_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        if (validity && !validity[i]) { out[i] = seed; continue; }
+        uint32_t h1 = mix_h1((uint32_t)seed, mix_k1((uint32_t)vals[i]));
+        out[i] = (int32_t)fmix(h1, 4);
+    }
+}
+
+void srtpu_murmur3_int64(const int64_t* vals, const uint8_t* validity,
+                         int64_t n, int32_t seed, int32_t* out) {
+    for (int64_t i = 0; i < n; ++i) {
+        if (validity && !validity[i]) { out[i] = seed; continue; }
+        uint64_t v = (uint64_t)vals[i];
+        uint32_t h1 = mix_h1((uint32_t)seed, mix_k1((uint32_t)(v & 0xFFFFFFFFu)));
+        h1 = mix_h1(h1, mix_k1((uint32_t)(v >> 32)));
+        out[i] = (int32_t)fmix(h1, 8);
+    }
+}
+
+// ---------------------------------------------------------------------
+// Host memory arena: bump allocator over one aligned region (the
+// RMM-host-pool analog for shuffle assembly buffers).
+// ---------------------------------------------------------------------
+struct SrtpuArena {
+    uint8_t* base;
+    int64_t  size;
+    int64_t  used;
+};
+
+void* srtpu_arena_create(int64_t size) {
+    void* mem = nullptr;
+    if (posix_memalign(&mem, 4096, (size_t)size) != 0) return nullptr;
+    SrtpuArena* a = new SrtpuArena{(uint8_t*)mem, size, 0};
+    return a;
+}
+
+void* srtpu_arena_alloc(void* arena, int64_t nbytes) {
+    SrtpuArena* a = (SrtpuArena*)arena;
+    int64_t aligned = (nbytes + 63) & ~63LL;
+    if (a->used + aligned > a->size) return nullptr;
+    void* p = a->base + a->used;
+    a->used += aligned;
+    return p;
+}
+
+void srtpu_arena_reset(void* arena) {
+    ((SrtpuArena*)arena)->used = 0;
+}
+
+int64_t srtpu_arena_used(void* arena) {
+    return ((SrtpuArena*)arena)->used;
+}
+
+void srtpu_arena_destroy(void* arena) {
+    SrtpuArena* a = (SrtpuArena*)arena;
+    std::free(a->base);
+    delete a;
+}
+
+// ---------------------------------------------------------------------
+// Serializer block assembly: interleave validity(bitpacked) + data
+// (+offsets) buffers of one column into a destination in a single pass.
+// Returns bytes written.
+// ---------------------------------------------------------------------
+int64_t srtpu_write_column_block(const uint8_t* validity_bools, int64_t n,
+                                 const uint8_t* data, int64_t data_bytes,
+                                 const int32_t* offsets,  // null if fixed
+                                 uint8_t* dst) {
+    int64_t pos = 0;
+    int64_t vbytes = (n + 7) / 8;
+    srtpu_pack_validity(validity_bools, n, dst + pos);
+    pos += vbytes;
+    std::memcpy(dst + pos, data, (size_t)data_bytes);
+    pos += data_bytes;
+    if (offsets) {
+        std::memcpy(dst + pos, offsets, (size_t)((n + 1) * 4));
+        pos += (n + 1) * 4;
+    }
+    return pos;
+}
+
+int32_t srtpu_version() { return 1; }
+
+}  // extern "C"

@@ -84,6 +84,10 @@ class DataFrameReader:
         at = read_csv_to_arrow(path, header=header, schema=schema)
         return DataFrame(self._session, L.InMemoryScan(at))
 
+    def delta(self, path: str, version=None) -> "DataFrame":
+        from .io.delta import read_delta
+        return read_delta(self._session, path, version)
+
     def json(self, path: str, schema=None) -> "DataFrame":
         from .io.json_io import read_json_to_arrow
         at = read_json_to_arrow(path, schema=schema)
@@ -226,6 +230,12 @@ class DataFrame:
 
     orderBy = sort
 
+    def create_or_replace_temp_view(self, name: str):
+        from .sql.parser import register_view
+        register_view(self._session, name, self)
+
+    createOrReplaceTempView = create_or_replace_temp_view
+
     def distinct(self) -> "DataFrame":
         ks = [col(n) for n in self.columns]
         return DataFrame(self._session, L.Aggregate(self._plan, ks, []))
@@ -300,3 +310,7 @@ class DataFrame:
     def write_parquet(self, path: str, **kw):
         from .io.parquet import write_parquet
         write_parquet(self, path, **kw)
+
+    def write_delta(self, path: str, mode: str = "append") -> int:
+        from .io.delta import write_delta
+        return write_delta(self, path, mode)

@@ -79,6 +79,7 @@ class LocalShuffle:
         self.codec = get_codec(codec)
         self._lock = threading.Lock()
         self._map_files: List[str] = []
+        self._arena = None  # lazy HostArena for reduce-side assembly
         self.metrics = {"bytesWritten": 0, "blocksWritten": 0}
 
     # ---------------- map side ----------------------------------------
@@ -183,7 +184,7 @@ class LocalShuffle:
                 bufs.append({"data": data, "validity": validity,
                              "offsets": off})
             else:
-                data = np.zeros(cap, np_dt)
+                data = self._arena_zeros(cap, np_dt)
                 for sb in subs:
                     c = sb.cols[ci]
                     data[pos:pos + sb.n_rows] = c["data"]
@@ -191,10 +192,33 @@ class LocalShuffle:
                     pos += sb.n_rows
                 bufs.append({"data": data, "validity": validity})
         dev = jax.device_put(bufs)
+        if self._arena is not None:
+            self._arena.reset()  # safe: device_put copied the buffers
         cols = [Column(f.dtype, total, d["data"], d["validity"],
                        d.get("offsets"))
                 for f, d in zip(self.schema.fields, dev)]
         return DeviceBatch(Table(self.schema.names, cols), total)
+
+    def _arena_zeros(self, count: int, np_dt) -> np.ndarray:
+        """Assembly buffer from the native host arena (RMM-host-pool
+        analog); heap fallback when absent or full."""
+        import jax
+        from ..utils.native import HostArena, native_lib
+        # On the CPU backend device_put may ALIAS host memory, so arena
+        # reset would corrupt live batches; accelerators always copy H2D.
+        if jax.default_backend() == "cpu":
+            return np.zeros(count, np.dtype(np_dt))
+        if self._arena is None and native_lib() is not None:
+            try:
+                self._arena = HostArena(256 << 20)
+            except MemoryError:
+                self._arena = None
+        if self._arena is not None:
+            arr = self._arena.alloc_array(count, np_dt)
+            if arr is not None:
+                arr[:] = 0
+                return arr
+        return np.zeros(count, np.dtype(np_dt))
 
     def cleanup(self):
         import shutil

@@ -19,9 +19,11 @@ from typing import BinaryIO, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..utils.native import pack_validity, unpack_validity
+
 __all__ = ["write_subbatch", "read_subbatch", "HostSubBatch"]
 
-_MAGIC = 0x4B545055
+_MAGIC = 0x4B545056  # v2: validity bit order is LSB-first
 
 
 class HostSubBatch:
@@ -42,7 +44,7 @@ def write_subbatch(out: BinaryIO, sb: HostSubBatch, codec=None) -> int:
     body.write(struct.pack("<IIQ", _MAGIC, len(sb.cols), sb.n_rows))
     for c in sb.cols:
         off = c.get("offsets")
-        validity = np.packbits(c["validity"].astype(np.bool_))
+        validity = pack_validity(c["validity"])
         data = np.ascontiguousarray(c["data"])
         body.write(struct.pack("<BQQQ", 1 if off is not None else 0,
                                validity.nbytes, data.nbytes,
@@ -78,7 +80,7 @@ def read_subbatch(inp: BinaryIO, dtypes, codec=None) -> Optional[HostSubBatch]:
         pos += 25
         vbits = np.frombuffer(buf, np.uint8, vb, pos)
         pos += vb
-        validity = np.unpackbits(vbits)[:n_rows].astype(np.bool_)
+        validity = unpack_validity(vbits, n_rows)
         data = np.frombuffer(buf, dtypes[ci], db // dtypes[ci].itemsize, pos)
         pos += db
         col = {"validity": validity, "data": data}

@@ -1,0 +1,496 @@
+"""Minimal SQL frontend.
+
+In the reference, Spark parses SQL and the plugin only sees physical plans;
+standalone we provide a subset so `session.sql(...)` works:
+
+  SELECT <exprs> FROM <view> [JOIN <view> ON a = b | USING (c,...)]
+  [WHERE <pred>] [GROUP BY <exprs>] [ORDER BY <expr> [ASC|DESC], ...]
+  [LIMIT n]
+
+Expressions: identifiers, string/number literals, + - * / %, comparisons,
+AND/OR/NOT, IS [NOT] NULL, BETWEEN, IN (...), CASE WHEN, CAST(e AS type),
+function calls (aggregates + the functions registry). Hand-rolled Pratt
+parser — no dependencies.
+"""
+from __future__ import annotations
+
+import re
+from typing import List, Optional
+
+from ..columnar import dtypes as dt
+from ..expr import aggregates as agg
+from ..expr.expressions import (CaseWhen, Cast, ColumnRef, Literal,
+                                UnsupportedExpr)
+from ..plan.logical import SortOrder
+
+__all__ = ["parse_sql", "register_view"]
+
+_TOKEN_RE = re.compile(r"""
+    \s*(?:
+      (?P<num>\d+\.\d+|\.\d+|\d+)
+    | (?P<str>'(?:[^']|'')*')
+    | (?P<id>[A-Za-z_][A-Za-z_0-9]*)
+    | (?P<op><=|>=|<>|!=|=|<|>|\(|\)|,|\*|\+|-|/|%|\.)
+    )""", re.VERBOSE)
+
+_KEYWORDS = {"select", "from", "where", "group", "by", "order", "limit",
+             "as", "and", "or", "not", "is", "null", "between", "in",
+             "case", "when", "then", "else", "end", "cast", "join",
+             "inner", "left", "right", "full", "outer", "on", "using",
+             "asc", "desc", "distinct", "like", "true", "false", "semi",
+             "anti", "cross", "having"}
+
+_TYPES = {"int": dt.INT32, "integer": dt.INT32, "bigint": dt.INT64,
+          "long": dt.INT64, "smallint": dt.INT16, "tinyint": dt.INT8,
+          "float": dt.FLOAT32, "real": dt.FLOAT32, "double": dt.FLOAT64,
+          "string": dt.STRING, "boolean": dt.BOOL, "date": dt.DATE,
+          "timestamp": dt.TIMESTAMP}
+
+_AGG_FNS = {"sum": agg.Sum, "count": agg.Count, "min": agg.Min,
+            "max": agg.Max, "avg": agg.Avg, "first": agg.First,
+            "last": agg.Last}
+
+
+def _tokenize(sql: str):
+    out = []
+    pos = 0
+    while pos < len(sql):
+        m = _TOKEN_RE.match(sql, pos)
+        if not m:
+            if sql[pos:].strip() == "":
+                break
+            raise ValueError(f"SQL tokenize error at: {sql[pos:pos+20]!r}")
+        pos = m.end()
+        if m.lastgroup == "num":
+            t = m.group("num")
+            out.append(("num", float(t) if "." in t else int(t)))
+        elif m.lastgroup == "str":
+            out.append(("str", m.group("str")[1:-1].replace("''", "'")))
+        elif m.lastgroup == "id":
+            word = m.group("id")
+            if word.lower() in _KEYWORDS:
+                out.append(("kw", word.lower()))
+            else:
+                out.append(("id", word))
+        else:
+            out.append(("op", m.group("op")))
+    out.append(("eof", None))
+    return out
+
+
+class _Parser:
+    def __init__(self, tokens):
+        self.toks = tokens
+        self.i = 0
+
+    def peek(self):
+        return self.toks[self.i]
+
+    def next(self):
+        t = self.toks[self.i]
+        self.i += 1
+        return t
+
+    def accept(self, kind, val=None):
+        k, v = self.peek()
+        if k == kind and (val is None or v == val):
+            return self.next()
+        return None
+
+    def expect(self, kind, val=None):
+        t = self.accept(kind, val)
+        if t is None:
+            raise ValueError(f"expected {val or kind}, got {self.peek()}")
+        return t
+
+    # ---- expressions (precedence climbing) ----------------------------
+    def expr(self):
+        return self.or_expr()
+
+    def or_expr(self):
+        left = self.and_expr()
+        while self.accept("kw", "or"):
+            left = left | self.and_expr()
+        return left
+
+    def and_expr(self):
+        left = self.not_expr()
+        while self.accept("kw", "and"):
+            left = left & self.not_expr()
+        return left
+
+    def not_expr(self):
+        if self.accept("kw", "not"):
+            return ~self.not_expr()
+        return self.comparison()
+
+    def comparison(self):
+        left = self.additive()
+        k, v = self.peek()
+        if k == "op" and v in ("=", "!=", "<>", "<", "<=", ">", ">="):
+            self.next()
+            right = self.additive()
+            return {"=": lambda: left == right,
+                    "!=": lambda: left != right,
+                    "<>": lambda: left != right,
+                    "<": lambda: left < right,
+                    "<=": lambda: left <= right,
+                    ">": lambda: left > right,
+                    ">=": lambda: left >= right}[v]()
+        if k == "kw" and v == "is":
+            self.next()
+            if self.accept("kw", "not"):
+                self.expect("kw", "null")
+                return left.isNotNull()
+            self.expect("kw", "null")
+            return left.isNull()
+        if k == "kw" and v == "between":
+            self.next()
+            lo = self.additive()
+            self.expect("kw", "and")
+            hi = self.additive()
+            return left.between(lo, hi)
+        if k == "kw" and v == "like":
+            self.next()
+            kk, pat = self.expect("str")
+            from ..expr.string_exprs import Like
+            return Like(left, pat)
+        if k == "kw" and v == "in":
+            self.next()
+            self.expect("op", "(")
+            vals = [self.expr()]
+            while self.accept("op", ","):
+                vals.append(self.expr())
+            self.expect("op", ")")
+            from ..expr.expressions import In
+            return In(left, vals)
+        if k == "kw" and v == "not":
+            # NOT LIKE / NOT IN / NOT BETWEEN
+            save = self.i
+            self.next()
+            k2, v2 = self.peek()
+            if k2 == "kw" and v2 in ("like", "in", "between"):
+                self.i = save
+                self.next()
+                inner = self.comparison_tail(left)
+                return ~inner
+            self.i = save
+        return left
+
+    def comparison_tail(self, left):
+        k, v = self.peek()
+        if v == "like":
+            self.next()
+            _, pat = self.expect("str")
+            from ..expr.string_exprs import Like
+            return Like(left, pat)
+        if v == "in":
+            self.next()
+            self.expect("op", "(")
+            vals = [self.expr()]
+            while self.accept("op", ","):
+                vals.append(self.expr())
+            self.expect("op", ")")
+            from ..expr.expressions import In
+            return In(left, vals)
+        if v == "between":
+            self.next()
+            lo = self.additive()
+            self.expect("kw", "and")
+            hi = self.additive()
+            return left.between(lo, hi)
+        raise ValueError(f"unexpected after NOT: {v}")
+
+    def additive(self):
+        left = self.multiplicative()
+        while True:
+            if self.accept("op", "+"):
+                left = left + self.multiplicative()
+            elif self.accept("op", "-"):
+                left = left - self.multiplicative()
+            else:
+                return left
+
+    def multiplicative(self):
+        left = self.unary()
+        while True:
+            if self.accept("op", "*"):
+                left = left * self.unary()
+            elif self.accept("op", "/"):
+                left = left / self.unary()
+            elif self.accept("op", "%"):
+                left = left % self.unary()
+            else:
+                return left
+
+    def unary(self):
+        if self.accept("op", "-"):
+            return -self.unary()
+        return self.primary()
+
+    def primary(self):
+        k, v = self.next()
+        if k == "num":
+            return Literal(v)
+        if k == "str":
+            return Literal(v)
+        if k == "kw" and v == "null":
+            return Literal(None)
+        if k == "kw" and v in ("true", "false"):
+            return Literal(v == "true")
+        if k == "kw" and v == "case":
+            branches = []
+            default = None
+            while self.accept("kw", "when"):
+                p = self.expr()
+                self.expect("kw", "then")
+                val = self.expr()
+                branches.append((p, val))
+            if self.accept("kw", "else"):
+                default = self.expr()
+            self.expect("kw", "end")
+            return CaseWhen(branches, default)
+        if k == "kw" and v == "cast":
+            self.expect("op", "(")
+            e = self.expr()
+            self.expect("kw", "as")
+            tk, tv = self.next()
+            typ = _TYPES.get(tv.lower() if isinstance(tv, str) else "")
+            if typ is None:
+                raise ValueError(f"unknown type {tv}")
+            self.expect("op", ")")
+            return Cast(e, typ)
+        if k == "op" and v == "(":
+            e = self.expr()
+            self.expect("op", ")")
+            return e
+        if k == "id":
+            if self.accept("op", "("):
+                return self._call(v)
+            # qualified name a.b -> use last part (round-1 single scope)
+            while self.accept("op", "."):
+                _, v2 = self.expect("id")
+                v = v2
+            return ColumnRef(v)
+        if k == "op" and v == "*":
+            return "*"
+        raise ValueError(f"unexpected token {k} {v}")
+
+    def _call(self, name):
+        name_l = name.lower()
+        args = []
+        if self.accept("op", "*"):
+            self.expect("op", ")")
+            if name_l == "count":
+                return agg.CountStar()
+            raise ValueError(f"{name}(*) unsupported")
+        if not self.accept("op", ")"):
+            args.append(self.expr())
+            while self.accept("op", ","):
+                args.append(self.expr())
+            self.expect("op", ")")
+        if name_l in _AGG_FNS:
+            return _AGG_FNS[name_l](args[0])
+        from .. import functions as F
+        fn = getattr(F, name_l, None)
+        if fn is None or name_l in ("col", "lit"):
+            raise UnsupportedExpr(f"unknown function {name}")
+        try:
+            return fn(*args)
+        except TypeError:
+            # functions taking python scalars (e.g. substring start/len)
+            conv = [a.value if isinstance(a, Literal) else a for a in args]
+            return fn(conv[0], *conv[1:])
+
+
+def register_view(session, name: str, df):
+    if not hasattr(session, "_views"):
+        session._views = {}
+    session._views[name.lower()] = df
+
+
+def parse_sql(session, sql: str):
+    from ..session import DataFrame
+    from ..plan import logical as L
+
+    p = _Parser(_tokenize(sql))
+    p.expect("kw", "select")
+    distinct = bool(p.accept("kw", "distinct"))
+    # projections
+    projs = []
+    while True:
+        e = p.expr()
+        alias = None
+        if p.accept("kw", "as"):
+            alias = p.expect("id")[1]
+        else:
+            t = p.accept("id")
+            if t:
+                alias = t[1]
+        projs.append((e, alias))
+        if not p.accept("op", ","):
+            break
+    p.expect("kw", "from")
+    views = getattr(session, "_views", {})
+
+    def get_view(nm):
+        if nm.lower() not in views:
+            raise ValueError(f"unknown table/view {nm}")
+        return views[nm.lower()]
+
+    base = get_view(p.expect("id")[1])
+    p.accept("id")  # optional table alias (names are global round-1)
+
+    # joins
+    while True:
+        how = None
+        if p.accept("kw", "join") or (p.accept("kw", "inner")
+                                      and p.expect("kw", "join")):
+            how = "inner"
+        elif p.accept("kw", "left"):
+            p.accept("kw", "outer")
+            if p.accept("kw", "semi"):
+                how = "left_semi"
+            elif p.accept("kw", "anti"):
+                how = "left_anti"
+            else:
+                how = "left"
+            p.expect("kw", "join")
+        elif p.accept("kw", "right"):
+            p.accept("kw", "outer")
+            p.expect("kw", "join")
+            how = "right"
+        elif p.accept("kw", "full"):
+            p.accept("kw", "outer")
+            p.expect("kw", "join")
+            how = "full"
+        elif p.accept("kw", "cross"):
+            p.expect("kw", "join")
+            how = "cross"
+        else:
+            break
+        other = get_view(p.expect("id")[1])
+        p.accept("id")
+        if how == "cross":
+            base = DataFrame(session, L.Join(base._plan, other._plan, [],
+                                             [], "cross"))
+            continue
+        if p.accept("kw", "using"):
+            p.expect("op", "(")
+            cols = [p.expect("id")[1]]
+            while p.accept("op", ","):
+                cols.append(p.expect("id")[1])
+            p.expect("op", ")")
+            base = base.join(other, on=cols, how=how)
+        else:
+            p.expect("kw", "on")
+            cond = p.expr()
+            from ..expr.expressions import Eq
+            if not isinstance(cond, Eq) or not isinstance(
+                    cond.left, ColumnRef) or not isinstance(
+                    cond.right, ColumnRef):
+                raise UnsupportedExpr(
+                    "JOIN ON supports single equi-conditions round-1")
+            if cond.left.name != cond.right.name:
+                raise UnsupportedExpr(
+                    "JOIN ON a.x = b.y with x != y: use USING or rename")
+            base = base.join(other, on=[cond.left.name], how=how)
+
+    df = base
+    if p.accept("kw", "where"):
+        df = df.filter(p.expr())
+
+    group_keys = None
+    having_expr = None
+    if p.accept("kw", "group"):
+        p.expect("kw", "by")
+        group_keys = [p.expr()]
+        while p.accept("op", ","):
+            group_keys.append(p.expr())
+    if p.accept("kw", "having"):
+        having_expr = p.expr()
+
+    # build select
+    def is_agg(e):
+        return isinstance(e, agg.AggExpr)
+
+    has_agg = any(is_agg(e) for e, _ in projs
+                  if not isinstance(e, str))
+    if group_keys is not None or has_agg:
+        keys = group_keys or []
+        aggs = []
+        for j, (e, alias) in enumerate(projs):
+            if isinstance(e, str):
+                raise ValueError("SELECT * with GROUP BY")
+            if is_agg(e):
+                aggs.append((alias or f"{e!r}", e))
+        # HAVING: rewrite aggregate calls to (possibly hidden) agg columns
+        # BEFORE projection (SQL applies HAVING pre-projection)
+        if having_expr is not None:
+            by_repr = {repr(a): n for n, a in aggs}
+
+            def rw(e):
+                if is_agg(e):
+                    nm = by_repr.get(repr(e))
+                    if nm is None:
+                        nm = f"_having{len(aggs)}"
+                        aggs.append((nm, e))
+                        by_repr[repr(e)] = nm
+                    return ColumnRef(nm)
+                for attr in ("left", "right", "child", "pred", "t", "f"):
+                    c = getattr(e, attr, None)
+                    if c is not None and hasattr(c, "bind"):
+                        setattr(e, attr, rw(c))
+                if getattr(e, "children", None):
+                    e.children = [rw(c) if hasattr(c, "bind") else c
+                                  for c in e.children]
+                return e
+
+            having_expr = rw(having_expr)
+        gp = df.group_by(*keys) if keys else df.group_by()
+        df = gp.agg(*[a.alias(n) for n, a in aggs]) if aggs             else gp.count()
+        if having_expr is not None:
+            df = df.filter(having_expr)
+        # reorder/select per projection list (drops hidden having cols)
+        sel = []
+        for e, alias in projs:
+            if is_agg(e):
+                nm = alias or [n for n, a in aggs if a is e][0]
+                sel.append(ColumnRef(nm).alias(alias) if alias
+                           else ColumnRef(nm))
+            else:
+                sel.append(e.alias(alias) if alias else e)
+        df = df.select(*sel)
+    else:
+        if having_expr is not None:
+            raise ValueError("HAVING without aggregation")
+        if len(projs) == 1 and isinstance(projs[0][0], str):
+            pass
+        else:
+            sel = [e.alias(a) if a else e for e, a in projs]
+            df = df.select(*sel)
+
+    if distinct:
+        df = df.distinct()
+
+    if p.accept("kw", "order"):
+        p.expect("kw", "by")
+        orders = []
+        while True:
+            e = p.expr()
+            asc = True
+            if p.accept("kw", "desc"):
+                asc = False
+            else:
+                p.accept("kw", "asc")
+            orders.append(SortOrder(e, asc))
+            if not p.accept("op", ","):
+                break
+        df = DataFrame(session, L.Sort(df._plan, orders))
+
+    if p.accept("kw", "limit"):
+        n = p.expect("num")[1]
+        df = df.limit(int(n))
+
+    p.expect("eof")
+    return df

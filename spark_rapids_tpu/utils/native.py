@@ -1,0 +1,153 @@
+"""Loader for the native host runtime (native/srtpu_native.cpp).
+
+Builds on first use with the in-image toolchain (g++), loads via ctypes
+(no pybind11 in the image), and degrades gracefully to the numpy paths
+when unavailable. The JNI-boundary analog of the reference
+(SURVEY.md §2.8): Python orchestrates, C++ does the host hot loops.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+import numpy as np
+
+__all__ = ["native_lib", "pack_validity", "unpack_validity",
+           "gather_strings_host", "HostArena"]
+
+_LIB = None
+_TRIED = False
+_LOCK = threading.Lock()
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def native_lib() -> Optional[ctypes.CDLL]:
+    global _LIB, _TRIED
+    with _LOCK:
+        if _TRIED:
+            return _LIB
+        _TRIED = True
+        so = os.path.join(_ROOT, "native", "build", "libsrtpu_native.so")
+        if not os.path.exists(so):
+            try:
+                subprocess.run(["make", "-C",
+                                os.path.join(_ROOT, "native")],
+                               check=True, capture_output=True,
+                               timeout=120)
+            except Exception:
+                return None
+        try:
+            lib = ctypes.CDLL(so)
+        except OSError:
+            return None
+        lib.srtpu_pack_validity.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+        lib.srtpu_unpack_validity.argtypes = [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p]
+        lib.srtpu_gather_strings.restype = ctypes.c_int64
+        lib.srtpu_gather_strings.argtypes = [ctypes.c_void_p] * 2 + [
+            ctypes.c_void_p, ctypes.c_int64, ctypes.c_void_p,
+            ctypes.c_void_p]
+        lib.srtpu_arena_create.restype = ctypes.c_void_p
+        lib.srtpu_arena_create.argtypes = [ctypes.c_int64]
+        lib.srtpu_arena_alloc.restype = ctypes.c_void_p
+        lib.srtpu_arena_alloc.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        lib.srtpu_arena_reset.argtypes = [ctypes.c_void_p]
+        lib.srtpu_arena_used.restype = ctypes.c_int64
+        lib.srtpu_arena_used.argtypes = [ctypes.c_void_p]
+        lib.srtpu_arena_destroy.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+def _cptr(arr: np.ndarray):
+    return arr.ctypes.data_as(ctypes.c_void_p)
+
+
+def pack_validity(bools: np.ndarray) -> np.ndarray:
+    lib = native_lib()
+    b = np.ascontiguousarray(bools, np.uint8)
+    if lib is None:
+        return np.packbits(b.astype(np.bool_), bitorder="little")
+    out = np.empty((len(b) + 7) // 8, np.uint8)
+    lib.srtpu_pack_validity(_cptr(b), len(b), _cptr(out))
+    return out
+
+
+def unpack_validity(bits: np.ndarray, n: int) -> np.ndarray:
+    lib = native_lib()
+    bits = np.ascontiguousarray(bits, np.uint8)
+    if lib is None:
+        return np.unpackbits(bits, bitorder="little")[:n].astype(np.bool_)
+    out = np.empty(n, np.uint8)
+    lib.srtpu_unpack_validity(_cptr(bits), n, _cptr(out))
+    return out.astype(np.bool_)
+
+
+def gather_strings_host(data: np.ndarray, offsets: np.ndarray,
+                        sel: np.ndarray):
+    """Dense host-side string gather (CPU-bridge / serializer path)."""
+    lib = native_lib()
+    sel = np.ascontiguousarray(sel, np.int32)
+    offsets = np.ascontiguousarray(offsets, np.int32)
+    data = np.ascontiguousarray(data, np.uint8)
+    n_out = len(sel)
+    if lib is None:
+        lens = offsets[sel + 1] - offsets[sel]
+        new_off = np.zeros(n_out + 1, np.int32)
+        np.cumsum(lens, out=new_off[1:])
+        out = np.empty(int(new_off[-1]), np.uint8)
+        for i, r in enumerate(sel):
+            out[new_off[i]:new_off[i + 1]] = data[offsets[r]:offsets[r + 1]]
+        return out, new_off
+    total_cap = int((offsets[sel + 1] - offsets[sel]).sum())
+    out = np.empty(max(total_cap, 1), np.uint8)
+    new_off = np.empty(n_out + 1, np.int32)
+    lib.srtpu_gather_strings(_cptr(data), _cptr(offsets), _cptr(sel),
+                             n_out, _cptr(out), _cptr(new_off))
+    return out[:int(new_off[-1])], new_off
+
+
+class HostArena:
+    """Aligned bump-allocator region (RMM host pool analog)."""
+
+    def __init__(self, size: int):
+        lib = native_lib()
+        self._lib = lib
+        self._arena = lib.srtpu_arena_create(size) if lib else None
+        self.size = size
+        if lib and not self._arena:
+            raise MemoryError(f"arena of {size} bytes")
+
+    def alloc_array(self, count: int, dtype=np.uint8):
+        """Allocate `count` ELEMENTS of dtype from the arena; None when
+        full (caller falls back to heap). Arrays are valid until reset()/
+        close() — callers must copy out (e.g. device_put) before that."""
+        dtype = np.dtype(dtype)
+        nbytes = int(count) * dtype.itemsize
+        if self._arena is None:
+            return np.empty(count, dtype)
+        p = self._lib.srtpu_arena_alloc(self._arena, nbytes)
+        if not p:
+            return None
+        buf = (ctypes.c_uint8 * nbytes).from_address(p)
+        return np.frombuffer(buf, dtype=dtype)
+
+    def reset(self):
+        if self._arena is not None:
+            self._lib.srtpu_arena_reset(self._arena)
+
+    @property
+    def used(self) -> int:
+        if self._arena is None:
+            return 0
+        return self._lib.srtpu_arena_used(self._arena)
+
+    def close(self):
+        if self._arena is not None:
+            self._lib.srtpu_arena_destroy(self._arena)
+            self._arena = None

@@ -93,3 +93,57 @@ def q6_numpy_baseline(ship, disc_unscaled, qty_unscaled, price_unscaled):
          & (disc_unscaled >= 5) & (disc_unscaled <= 7)
          & (qty_unscaled < 2400))
     return int(np.sum(price_unscaled[m] * disc_unscaled[m]))
+
+
+ORDERS_ROWS_PER_SF = 1_500_000
+
+
+def gen_orders(sf: float = 0.1, seed: int = 1) -> pa.Table:
+    n = int(ORDERS_ROWS_PER_SF * sf)
+    rng = np.random.default_rng(seed)
+    okey = np.arange(n, dtype=np.int64)
+    ckey = rng.integers(0, max(n // 10, 1), n).astype(np.int64)
+    odate = rng.integers(8036, 10591, n).astype(np.int32)
+    seg = rng.integers(0, 5, n)
+    total = rng.integers(100_000, 50_000_000, n).astype(np.int64)
+    return pa.table({
+        "o_orderkey": pa.array(okey),
+        "o_custkey": pa.array(ckey),
+        "o_orderdate": pa.array(odate, pa.int32()),
+        "o_totalprice": dec_from_unscaled(total, 15, 2),
+        "o_shippriority": pa.array(rng.integers(0, 2, n).astype(np.int32),
+                                   pa.int32()),
+    })
+
+
+def gen_customer(sf: float = 0.1, seed: int = 2) -> pa.Table:
+    n = int(150_000 * sf)
+    rng = np.random.default_rng(seed)
+    segs = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
+                     "MACHINERY"])
+    return pa.table({
+        "c_custkey": pa.array(np.arange(n, dtype=np.int64)),
+        "c_mktsegment": pa.array(segs[rng.integers(0, 5, n)]),
+    })
+
+
+def q3(customer, orders, lineitem):
+    """TPC-H Q3 shape: shipping priority (join+join+grouped agg+topk)."""
+    import decimal
+    d = decimal.Decimal
+    rev = col("l_extendedprice") * (lit(d("1")) - col("l_discount"))
+    df = (customer.filter(col("c_mktsegment") == lit("BUILDING"))
+          .join(orders.with_column("c_custkey", col("o_custkey")),
+                on=["c_custkey"], how="inner")
+          .filter(col("o_orderdate") < 9204)
+          .with_column("l_orderkey", col("o_orderkey"))
+          .join(lineitem, on=["l_orderkey"], how="inner")
+          .filter(col("l_shipdate") > 9204)
+          .group_by("l_orderkey", "o_orderdate", "o_shippriority")
+          .agg(F.sum(rev).alias("revenue")))
+    from ..plan.logical import Sort, SortOrder
+    from ..session import DataFrame
+    sorted_df = DataFrame(df._session, Sort(df._plan, [
+        SortOrder(col("revenue"), ascending=False),
+        SortOrder(col("o_orderdate"), ascending=True)]))
+    return sorted_df.limit(10)

@@ -1,0 +1,37 @@
+"""Delta-log behavior: versioned commits, time travel, overwrite."""
+import spark_rapids_tpu.functions as F
+
+from asserts import assert_rows_equal
+from data_gen import IntegerGen, gen_df
+
+
+def test_delta_append_and_time_travel(session, tmp_path):
+    p = str(tmp_path / "dt")
+    df1, at1 = gen_df(session, [("a", IntegerGen(nullable=False))],
+                      n=100, seed=110)
+    v0 = df1.write_delta(p)
+    df2, at2 = gen_df(session, [("a", IntegerGen(nullable=False))],
+                      n=50, seed=111)
+    v1 = df2.write_delta(p)
+    assert (v0, v1) == (0, 1)
+    latest = session.read.delta(p)
+    assert latest.count() == 150
+    old = session.read.delta(p, version=0)
+    assert old.count() == 100
+    assert_rows_equal(old.to_arrow(),
+                      [(v,) for v in at1.column(0).to_pylist()])
+
+
+def test_delta_overwrite(session, tmp_path):
+    p = str(tmp_path / "dt2")
+    df1, _ = gen_df(session, [("a", IntegerGen(nullable=False))],
+                    n=80, seed=112)
+    df1.write_delta(p)
+    df2, at2 = gen_df(session, [("a", IntegerGen(nullable=False))],
+                      n=30, seed=113)
+    df2.write_delta(p, mode="overwrite")
+    assert session.read.delta(p).count() == 30
+    assert session.read.delta(p, version=0).count() == 80
+    from spark_rapids_tpu.io.delta import DeltaTable
+    h = DeltaTable(p).history()
+    assert [x["operation"] for x in h] == ["WRITE", "OVERWRITE"]

@@ -1,0 +1,62 @@
+"""Native C++ runtime parity tests (the JNI-boundary analog)."""
+import ctypes
+
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.utils.native import (HostArena, gather_strings_host,
+                                           native_lib, pack_validity,
+                                           unpack_validity)
+
+
+def test_validity_roundtrip():
+    rng = np.random.default_rng(0)
+    b = rng.integers(0, 2, 1003).astype(bool)
+    assert (unpack_validity(pack_validity(b), 1003) == b).all()
+
+
+def test_gather_strings_host():
+    data = np.frombuffer(b"aabbbcccc", np.uint8).copy()
+    off = np.array([0, 2, 5, 9], np.int32)
+    out, noff = gather_strings_host(data, off, np.array([2, 0, 2], np.int32))
+    assert bytes(out) == b"ccccaacccc"
+    assert list(noff) == [0, 4, 6, 10]
+
+
+def test_arena_alloc_and_reset():
+    a = HostArena(1 << 16)
+    x = a.alloc_array(100, np.int64)
+    assert x is not None and x.nbytes == 800
+    x[:] = 7
+    assert a.used >= 800
+    y = a.alloc_array(1 << 16, np.int64)  # too big for remaining space
+    if native_lib() is not None:
+        assert y is None
+    a.reset()
+    assert a.used == 0
+    a.close()
+
+
+@pytest.mark.skipif(native_lib() is None, reason="native lib unavailable")
+def test_native_murmur3_matches_device_kernel():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.ops.hash import murmur3_cv
+    from spark_rapids_tpu.ops.kernel_utils import CV
+    lib = native_lib()
+    rng = np.random.default_rng(1)
+    for np_dt, fn, dtp in [
+        (np.int32, lib.srtpu_murmur3_int32, dt.INT32),
+        (np.int64, lib.srtpu_murmur3_int64, dt.INT64),
+    ]:
+        vals = rng.integers(-2**30, 2**30, 512).astype(np_dt)
+        validity = rng.integers(0, 2, 512).astype(np.uint8)
+        out = np.empty(512, np.int32)
+        fn(vals.ctypes.data_as(ctypes.c_void_p),
+           validity.ctypes.data_as(ctypes.c_void_p), 512, 42,
+           out.ctypes.data_as(ctypes.c_void_p))
+        cv = CV(jnp.asarray(vals), jnp.asarray(validity.astype(bool)))
+        dev = np.asarray(murmur3_cv(cv, dtp, jnp.full(512, 42, jnp.int32)))
+        assert (out == dev).all()

@@ -1,0 +1,80 @@
+"""SQL frontend subset."""
+import pyarrow as pa
+
+from asserts import assert_rows_equal
+from data_gen import IntegerGen, StringGen, gen_df
+
+
+def test_sql_select_where_group_order(session):
+    df, at = gen_df(session, [("k", IntegerGen(lo=0, hi=5, nullable=False)),
+                              ("v", IntegerGen(lo=0, hi=100,
+                                               nullable=False))],
+                    n=1000, seed=100)
+    df.create_or_replace_temp_view("t")
+    out = session.sql(
+        "SELECT k, sum(v) AS sv, count(*) AS n FROM t "
+        "WHERE v > 10 GROUP BY k ORDER BY k").to_arrow()
+    from collections import defaultdict
+    sums = defaultdict(int)
+    cnts = defaultdict(int)
+    for k, v in zip(at.column(0).to_pylist(), at.column(1).to_pylist()):
+        if v > 10:
+            sums[k] += v
+            cnts[k] += 1
+    exp = [(k, sums[k], cnts[k]) for k in sorted(sums)]
+    got = list(zip(*[out.column(i).to_pylist() for i in range(3)]))
+    assert got == exp
+
+
+def test_sql_join_using(session):
+    l, lat = gen_df(session, [("id", IntegerGen(lo=0, hi=50,
+                                                nullable=False)),
+                              ("x", IntegerGen(nullable=False))],
+                    n=300, seed=101)
+    r, rat = gen_df(session, [("id", IntegerGen(lo=0, hi=50,
+                                                nullable=False)),
+                              ("y", IntegerGen(nullable=False))],
+                    n=200, seed=102)
+    l.create_or_replace_temp_view("l")
+    r.create_or_replace_temp_view("r")
+    out = session.sql(
+        "SELECT id, x, y FROM l JOIN r USING (id)").to_arrow()
+    rmap = {}
+    for i, y in zip(rat.column(0).to_pylist(), rat.column(1).to_pylist()):
+        rmap.setdefault(i, []).append(y)
+    exp = [(i, x, y) for i, x in zip(lat.column(0).to_pylist(),
+                                     lat.column(1).to_pylist())
+           for y in rmap.get(i, [])]
+    assert_rows_equal(out, exp)
+
+
+def test_sql_expressions(session):
+    df = session.create_dataframe({"a": [1, 2, 3, None],
+                                   "s": ["x", "yy", "zzz", None]})
+    df.create_or_replace_temp_view("e")
+    out = session.sql(
+        "SELECT a * 2 + 1 AS b, CASE WHEN a >= 2 THEN 'big' ELSE 'small' "
+        "END AS c, CAST(a AS string) AS d, length(s) AS ln FROM e "
+        "WHERE a IS NOT NULL").to_arrow()
+    assert out.to_pydict() == {
+        "b": [3, 5, 7], "c": ["small", "big", "big"],
+        "d": ["1", "2", "3"], "ln": [1, 2, 3]}
+
+
+def test_sql_limit_distinct_like(session):
+    df = session.create_dataframe(
+        {"s": ["apple", "banana", "apple", "cherry"]})
+    df.create_or_replace_temp_view("f")
+    out = session.sql("SELECT DISTINCT s FROM f WHERE s LIKE 'a%'")
+    assert out.collect() == [("apple",)]
+    out2 = session.sql("SELECT s FROM f ORDER BY s LIMIT 2")
+    assert out2.collect() == [("apple",), ("apple",)]
+
+
+def test_sql_having(session):
+    df = session.create_dataframe({"k": [1, 1, 2, 2, 3],
+                                   "v": [10, 20, 1, 2, 100]})
+    df.create_or_replace_temp_view("h")
+    out = session.sql("SELECT k, sum(v) AS sv FROM h GROUP BY k "
+                      "HAVING sum(v) > 10 ORDER BY k")
+    assert out.collect() == [(1, 30), (3, 100)]

@@ -1,0 +1,82 @@
+"""TPC-H query-shape correctness at tiny scale vs Python references."""
+import decimal
+from collections import defaultdict
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.workloads import tpch
+
+from asserts import assert_rows_equal
+
+
+def _unscaled(at, name):
+    from spark_rapids_tpu.columnar.column import Column
+    import numpy as np
+    return np.asarray(
+        Column.host_from_arrow(at.column(name))[2]["data"][:at.num_rows])
+
+
+def test_q6(session):
+    at = tpch.gen_lineitem(sf=0.002, seed=3)
+    df = session.create_dataframe(at)
+    got = tpch.q6(df).to_arrow().column(0).to_pylist()[0]
+    ship = at.column("l_shipdate").to_numpy()
+    q = _unscaled(at, "l_quantity")
+    p = _unscaled(at, "l_extendedprice")
+    d = _unscaled(at, "l_discount")
+    exp = tpch.q6_numpy_baseline(ship, d, q, p)
+    assert got == decimal.Decimal(exp).scaleb(-4)
+
+
+def test_q1(session):
+    at = tpch.gen_lineitem(sf=0.002, seed=4)
+    df = session.create_dataframe(at)
+    out = tpch.q1(df).to_arrow()
+    # cross-check one aggregate: count per (returnflag, linestatus)
+    ship = at.column("l_shipdate").to_numpy()
+    rf = at.column("l_returnflag").to_pylist()
+    ls = at.column("l_linestatus").to_pylist()
+    qty = _unscaled(at, "l_quantity")
+    cnt = defaultdict(int)
+    sq = defaultdict(int)
+    for i in range(at.num_rows):
+        if ship[i] <= 10471:
+            cnt[(rf[i], ls[i])] += 1
+            sq[(rf[i], ls[i])] += int(qty[i])
+    got = {(r, l): (c, s) for r, l, s, c in zip(
+        out.column("l_returnflag").to_pylist(),
+        out.column("l_linestatus").to_pylist(),
+        [int(v.scaleb(2)) for v in out.column("sum_qty").to_pylist()],
+        out.column("count_order").to_pylist())}
+    assert got == {k: (cnt[k], sq[k]) for k in cnt}
+
+
+def test_q3(session):
+    cust = session.create_dataframe(tpch.gen_customer(sf=0.01, seed=5))
+    orders = session.create_dataframe(tpch.gen_orders(sf=0.002, seed=6))
+    li = session.create_dataframe(tpch.gen_lineitem(sf=0.002, seed=7))
+    out = tpch.q3(cust, orders, li).to_arrow()
+    # python reference
+    cat, oat, lat = (tpch.gen_customer(sf=0.01, seed=5),
+                     tpch.gen_orders(sf=0.002, seed=6),
+                     tpch.gen_lineitem(sf=0.002, seed=7))
+    building = {k for k, s in zip(cat.column(0).to_pylist(),
+                                  cat.column(1).to_pylist())
+                if s == "BUILDING"}
+    omap = {}
+    for ok, ck, od, sp in zip(oat.column(0).to_pylist(),
+                              oat.column(1).to_pylist(),
+                              oat.column(2).to_pylist(),
+                              oat.column(4).to_pylist()):
+        if ck in building and od < 9204:
+            omap[ok] = (od, sp)
+    price = _unscaled(lat, "l_extendedprice")
+    disc = _unscaled(lat, "l_discount")
+    rev = defaultdict(int)
+    for i, (lk, sd) in enumerate(zip(lat.column(0).to_pylist(),
+                                     lat.column("l_shipdate").to_numpy())):
+        if lk in omap and sd > 9204:
+            # price(12,2) * (1 - disc)(5,2) -> scale 4 unscaled product
+            rev[(lk, *omap[lk])] += int(price[i]) * (100 - int(disc[i]))
+    exp = [(k[0], k[1], k[2], decimal.Decimal(v).scaleb(-4))
+           for k, v in rev.items()]
+    assert_rows_equal(out, exp)
