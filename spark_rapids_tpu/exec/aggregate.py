@@ -141,8 +141,14 @@ class HashAggregateExec(TpuExec):
 
     def __init__(self, child: TpuExec, key_names: Sequence[str],
                  bound_keys: Sequence[Expression], agg_names: Sequence[str],
-                 bound_aggs: Sequence[AggExpr], schema: Schema):
+                 bound_aggs: Sequence[AggExpr], schema: Schema,
+                 per_partition: bool = False):
+        """per_partition: the child is hash-partitioned on the grouping
+        keys (an exchange below us), so each partition aggregates
+        independently — the distributed topology
+        (reference: partial/final agg around GpuShuffleExchangeExec)."""
         super().__init__([child], schema)
+        self.per_partition = per_partition
         self.key_names = list(key_names)
         self.keys = list(bound_keys)
         self.agg_names = list(agg_names)
@@ -159,10 +165,13 @@ class HashAggregateExec(TpuExec):
         self._finalize_jit = jax.jit(self._finalize_fn)
 
     def num_partitions(self, ctx):
+        if self.per_partition:
+            return self.children[0].num_partitions(ctx)
         return 1
 
     def describe(self):
-        return (f"HashAggregateExec[keys={self.key_names}, "
+        mode = "distributed" if self.per_partition else "single"
+        return (f"HashAggregateExec[{mode}, keys={self.key_names}, "
                 f"aggs={self.agg_names}]")
 
     # -- sort/segment machinery (runs inside jit) ----------------------
@@ -282,23 +291,34 @@ class HashAggregateExec(TpuExec):
         m = ctx.metrics_for(self._op_id)
         child = self.children[0]
         partials = []   # (key_cvs, flat_states, seg_live, capacity)
-        for cpid in range(child.num_partitions(ctx)):
+        child_pids = ([pid] if self.per_partition
+                      else range(child.num_partitions(ctx)))
+        def update_one(b):
+            nchunks = self._batch_nchunks(b)
+            fn = self._update_cache.get(nchunks)
+            if fn is None:
+                fn = jax.jit(self._update_fn(nchunks))
+                self._update_cache[nchunks] = fn
+            ks, st, sl = fn(b.cvs(), b.row_mask)
+            return (ks, st, sl, b.capacity)
+
+        from ..memory.retry import with_retry
+        for cpid in child_pids:
             for batch in child.execute_partition(ctx, cpid):
                 with m.timer("opTime"):
-                    nchunks = self._batch_nchunks(batch)
-                    fn = self._update_cache.get(nchunks)
-                    if fn is None:
-                        fn = jax.jit(self._update_fn(nchunks))
-                        self._update_cache[nchunks] = fn
-                    ks, st, sl = fn(batch.cvs(), batch.row_mask)
-                    partials.append((ks, st, sl, batch.capacity))
+                    # split-and-retry: idempotent per-batch first-pass agg
+                    # re-executes on halves under memory pressure
+                    for part in with_retry(batch, update_one):
+                        partials.append(part)
                 if sum(p[3] for p in partials) > _MERGE_THRESHOLD_ROWS \
                         and len(partials) > 1:
                     partials = [self._merge_partials(partials)]
         if not partials:
             yield DeviceBatch(make_table(self.schema, [
                 CV(jnp.zeros(128, f.dtype.np_dtype or jnp.int8),
-                   jnp.zeros(128, jnp.bool_))
+                   jnp.zeros(128, jnp.bool_),
+                   jnp.zeros(129, jnp.int32)
+                   if f.dtype.is_variable_width else None)
                 for f in self.schema.fields], 0),
                 0, jnp.zeros(128, jnp.bool_), 128)
             return

@@ -23,15 +23,18 @@ class ExecContext:
     """Per-query execution context: conf snapshot, metrics, memory runtime."""
 
     def __init__(self, conf=None, session=None):
+        import threading
         from ..config import TpuConf
         self.conf = conf or TpuConf()
         self.session = session
         self.metrics: Dict[str, MetricSet] = {}
+        self._metrics_lock = threading.Lock()
 
     def metrics_for(self, op_id: str) -> MetricSet:
-        if op_id not in self.metrics:
-            self.metrics[op_id] = MetricSet()
-        return self.metrics[op_id]
+        with self._metrics_lock:
+            if op_id not in self.metrics:
+                self.metrics[op_id] = MetricSet()
+            return self.metrics[op_id]
 
 
 class TpuExec:

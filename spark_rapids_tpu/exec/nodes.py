@@ -221,29 +221,74 @@ class UnionExec(TpuExec):
 
 
 # ----------------------------------------------------------------------
-def collect_to_arrow(root: TpuExec, ctx: ExecContext):
-    """Run the plan and materialize a host pyarrow Table (the analog of
-    GpuColumnarToRowExec + collect)."""
+def _batch_to_arrow(batch: DeviceBatch):
     import pyarrow as pa
     from ..columnar.column import Column
-    pieces = []
-    for batch in root.execute_all(ctx):
-        # fetch the mask together with all column buffers: ONE device_get
-        from ..utils.transfer import fetch
-        host = fetch([c.device_buffers() for c in batch.table.columns]
-                     + [batch.row_mask])
-        mask = np.asarray(host[-1])[:batch.num_rows]
-        arrs = [Column.arrow_from_host(c.dtype, c.length, b)
-                for c, b in zip(batch.table.columns, host[:-1])]
-        at = (pa.Table.from_arrays(arrs, names=list(batch.table.names))
-              if arrs else pa.table({}))
-        if at.num_rows == 0 and batch.num_rows > 0:
-            # zero-column batch (e.g. count(*) pipelines)
-            pieces.append(pa.table({}))
-            continue
-        if not mask.all():
-            at = at.filter(pa.array(mask))
-        pieces.append(at)
+    from ..utils.transfer import fetch
+    # fetch the mask together with all column buffers: ONE device_get
+    host = fetch([c.device_buffers() for c in batch.table.columns]
+                 + [batch.row_mask])
+    mask = np.asarray(host[-1])[:batch.num_rows]
+    arrs = [Column.arrow_from_host(c.dtype, c.length, b)
+            for c, b in zip(batch.table.columns, host[:-1])]
+    at = (pa.Table.from_arrays(arrs, names=list(batch.table.names))
+          if arrs else pa.table({}))
+    if at.num_rows == 0 and batch.num_rows > 0:
+        return pa.table({})  # zero-column batch (count(*) pipelines)
+    if not mask.all():
+        at = at.filter(pa.array(mask))
+    return at
+
+
+def collect_to_arrow(root: TpuExec, ctx: ExecContext):
+    """Run the plan and materialize a host pyarrow Table (the analog of
+    GpuColumnarToRowExec + collect). Partitions run as concurrent tasks
+    bounded by the TpuSemaphore (the GpuSemaphore admission model:
+    reference GpuSemaphore.scala:183)."""
+    import pyarrow as pa
+    nparts = root.num_partitions(ctx)
+    if nparts <= 1:
+        pieces = [_batch_to_arrow(b) for b in root.execute_all(ctx)]
+    else:
+        sem = _session_semaphore(ctx)
+        import concurrent.futures as cf
+
+        def run_part(pid):
+            # GpuSemaphore model: hold the permit while DEVICE work runs
+            # (advancing the iterator executes the jitted kernels), release
+            # around the host-side fetch/convert
+            out = []
+            it = root.execute_partition(ctx, pid)
+            while True:
+                sem.acquire(priority=pid)
+                try:
+                    b = next(it, None)
+                finally:
+                    sem.release()
+                if b is None:
+                    break
+                out.append(_batch_to_arrow(b))
+            return out
+
+        workers = min(nparts, max(2, ctx.conf.concurrent_tasks * 2))
+        with cf.ThreadPoolExecutor(workers) as pool:
+            results = list(pool.map(run_part, range(nparts)))
+        pieces = [at for r in results for at in r]
     if not pieces:
         return root.schema.to_arrow().empty_table()
     return pa.concat_tables(pieces)
+
+
+_SEM_LOCK = __import__("threading").Lock()
+
+
+def _session_semaphore(ctx: ExecContext):
+    from ..memory.semaphore import TpuSemaphore
+    if ctx.session is None:
+        return TpuSemaphore(ctx.conf.concurrent_tasks)
+    with _SEM_LOCK:
+        sem = getattr(ctx.session, "_semaphore", None)
+        if sem is None:
+            sem = TpuSemaphore(ctx.conf.concurrent_tasks)
+            ctx.session._semaphore = sem
+        return sem

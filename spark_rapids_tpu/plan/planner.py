@@ -94,6 +94,7 @@ def _filter(meta, conv, conf):
 
 @_rule(L.Aggregate)
 def _agg(meta, conv, conf):
+    from ..config import SHUFFLE_PARTITIONS
     child = conv(meta.children[0])
     n = meta.node
     names = [nm for nm, _ in n.bound_aggs]
@@ -101,6 +102,19 @@ def _agg(meta, conv, conf):
     if not n.keys:
         return agg_exec.UngroupedAggExec(child, names, aggs, n.schema)
     key_names = [k.name for k in n.keys]
+    # distributed topology: hash-exchange on grouping keys, then each
+    # partition aggregates independently (GpuShuffleExchange + final agg)
+    from ..exec.base import ExecContext
+    nparts = conf.get(SHUFFLE_PARTITIONS)
+    multi_input = child.num_partitions(ExecContext(conf)) > 1
+    keys_ok = all(not (k.dtype.is_nested) for k in n.bound_keys)
+    if multi_input and keys_ok and nparts > 1:
+        from ..exec.exchange import ShuffleExchangeExec
+        exch = ShuffleExchangeExec(child, nparts, n.bound_keys,
+                                   child.schema)
+        return agg_exec.HashAggregateExec(exch, key_names, n.bound_keys,
+                                          names, aggs, n.schema,
+                                          per_partition=True)
     return agg_exec.HashAggregateExec(child, key_names, n.bound_keys,
                                       names, aggs, n.schema)
 

@@ -84,6 +84,11 @@ class DataFrameReader:
         at = read_csv_to_arrow(path, header=header, schema=schema)
         return DataFrame(self._session, L.InMemoryScan(at))
 
+    def orc(self, path: str) -> "DataFrame":
+        from pyarrow import orc as _orc
+        at = _orc.read_table(path)
+        return DataFrame(self._session, L.InMemoryScan(at))
+
     def delta(self, path: str, version=None) -> "DataFrame":
         from .io.delta import read_delta
         return read_delta(self._session, path, version)

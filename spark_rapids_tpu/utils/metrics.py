@@ -3,6 +3,7 @@
 """
 from __future__ import annotations
 
+import threading
 import time
 from contextlib import contextmanager
 
@@ -14,17 +15,22 @@ __all__ = ["MetricSet", "ESSENTIAL", "MODERATE", "DEBUG"]
 
 
 class MetricSet:
+    """Thread-safe: partitions update operator metrics concurrently."""
+
     def __init__(self):
         self._values = {}
         self._levels = {}
+        self._lock = threading.Lock()
 
     def add(self, name: str, amount, level: int = MODERATE):
-        self._values[name] = self._values.get(name, 0) + amount
-        self._levels[name] = level
+        with self._lock:
+            self._values[name] = self._values.get(name, 0) + amount
+            self._levels[name] = level
 
     def set(self, name: str, value, level: int = MODERATE):
-        self._values[name] = value
-        self._levels[name] = level
+        with self._lock:
+            self._values[name] = value
+            self._levels[name] = level
 
     def get(self, name: str, default=0):
         return self._values.get(name, default)

@@ -79,4 +79,10 @@ def test_q3(session):
             rev[(lk, *omap[lk])] += int(price[i]) * (100 - int(disc[i]))
     exp = [(k[0], k[1], k[2], decimal.Decimal(v).scaleb(-4))
            for k, v in rev.items()]
-    assert_rows_equal(out, exp)
+    # Q3 returns the top 10 by (revenue DESC, o_orderdate ASC)
+    exp_sorted = sorted(exp, key=lambda r: (-r[3], r[1]))
+    got = list(zip(*[out.column(i).to_pylist() for i in range(4)]))
+    assert [r[3] for r in got] == [r[3] for r in exp_sorted[:10]]
+    exp_map = {(r[0]): r for r in exp}
+    for r in got:
+        assert exp_map[r[0]] == r
