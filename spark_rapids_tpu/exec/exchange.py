@@ -55,8 +55,19 @@ class ShuffleExchangeExec(TpuExec):
         if self.keys:
             ctx = EmitCtx(cvs, cap)
             key_cvs = [k.emit(ctx) for k in self.keys]
-            pids = partition_ids(key_cvs, [k.dtype for k in self.keys],
-                                 self.n)
+            pids = None
+            if (len(self.keys) == 1 and cap % 1024 == 0
+                    and jax.default_backend() == "tpu"):
+                kd = self.keys[0].dtype
+                if isinstance(kd, (dt.IntegerType, dt.DateType)):
+                    # hot path: fused Pallas murmur3+pmod kernel
+                    from ..ops.pallas_kernels import pallas_partition_ids_i32
+                    kcv = key_cvs[0]
+                    pids = pallas_partition_ids_i32(
+                        kcv.data.astype(jnp.int32), kcv.validity, self.n)
+            if pids is None:
+                pids = partition_ids(key_cvs, [k.dtype for k in self.keys],
+                                     self.n)
         else:
             pids = ((jnp.cumsum(mask.astype(jnp.int32)) - 1)
                     % self.n).astype(jnp.int32)

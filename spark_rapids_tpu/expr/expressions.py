@@ -35,7 +35,8 @@ __all__ = [
     "Remainder", "Pmod", "Negate", "Abs", "Eq", "Ne", "Lt", "Le", "Gt", "Ge",
     "EqNullSafe", "And", "Or", "Not", "IsNull", "IsNotNull", "IsNaN", "Cast",
     "Coalesce", "If", "CaseWhen", "In", "MathUnary", "Round", "Greatest",
-    "Least", "lit", "col",
+    "Least", "lit", "col", "BitwiseAnd", "BitwiseOr", "BitwiseXor",
+    "BitwiseNot", "ShiftLeft", "ShiftRight", "Pow", "Atan2",
 ]
 
 
@@ -1032,6 +1033,113 @@ class _MinMaxOf(Expression):
             out = CV(jnp.where(pick, cv.data, out.data),
                      out.validity | cv.validity)
         return out
+
+
+class _Bitwise(_BinaryOp):
+    op = None
+
+    def _resolve_type(self):
+        self.left, self.right, out = _coerce_pair(self.left, self.right)
+        if out is None or not out.is_integral:
+            raise UnsupportedExpr("bitwise op on non-integral")
+        self.dtype = out
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        return CV(type(self).op(l.data, r.data), ew.and_validity(l, r))
+
+
+class BitwiseAnd(_Bitwise):
+    symbol = "&"
+    op = staticmethod(jnp.bitwise_and)
+
+
+class BitwiseOr(_Bitwise):
+    symbol = "|"
+    op = staticmethod(jnp.bitwise_or)
+
+
+class BitwiseXor(_Bitwise):
+    symbol = "^"
+    op = staticmethod(jnp.bitwise_xor)
+
+
+class BitwiseNot(_UnaryOp):
+    def _resolve_type(self):
+        if not self.child.dtype.is_integral:
+            raise UnsupportedExpr("~ on non-integral")
+        self.dtype = self.child.dtype
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        return CV(jnp.bitwise_not(cv.data), cv.validity)
+
+
+class ShiftLeft(_BinaryOp):
+    symbol = "<<"
+
+    def _resolve_type(self):
+        if not (self.left.dtype.is_integral
+                and self.right.dtype.is_integral):
+            raise UnsupportedExpr("shift on non-integral")
+        # Spark promotes byte/short to int before shifting (mask by 31)
+        if isinstance(self.left.dtype, (dt.ByteType, dt.ShortType)):
+            self.left = Cast.bound(self.left, dt.INT32)
+        self.dtype = self.left.dtype
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        nbits = l.data.dtype.itemsize * 8
+        sh = (r.data.astype(jnp.int32) % nbits)  # Java masks the shift
+        return CV(l.data << sh.astype(l.data.dtype),
+                  ew.and_validity(l, r))
+
+
+class ShiftRight(ShiftLeft):
+    symbol = ">>"
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        nbits = l.data.dtype.itemsize * 8
+        sh = (r.data.astype(jnp.int32) % nbits)
+        return CV(l.data >> sh.astype(l.data.dtype),
+                  ew.and_validity(l, r))
+
+
+class Pow(_BinaryOp):
+    symbol = "pow"
+
+    def _resolve_type(self):
+        self.left = (self.left if self.left.dtype.is_floating
+                     else Cast.bound(self.left, dt.FLOAT64))
+        self.right = (self.right if self.right.dtype.is_floating
+                      else Cast.bound(self.right, dt.FLOAT64))
+        self.dtype = dt.FLOAT64
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        return CV(jnp.power(l.data.astype(jnp.float64),
+                            r.data.astype(jnp.float64)),
+                  ew.and_validity(l, r))
+
+
+class Atan2(_BinaryOp):
+    symbol = "atan2"
+
+    def _resolve_type(self):
+        for side in ("left", "right"):
+            e = getattr(self, side)
+            if not (e.dtype.is_numeric or isinstance(e.dtype, dt.NullType)):
+                raise UnsupportedExpr(f"atan2 on {e.dtype}")
+            if not e.dtype.is_floating:
+                setattr(self, side, Cast.bound(e, dt.FLOAT64))
+        self.dtype = dt.FLOAT64
+
+    def emit(self, ctx):
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        return CV(jnp.arctan2(l.data.astype(jnp.float64),
+                              r.data.astype(jnp.float64)),
+                  ew.and_validity(l, r))
 
 
 class Greatest(_MinMaxOf):

@@ -1,0 +1,34 @@
+"""hash() expression — Spark's murmur3 row hash surfaced to users
+(reference: HashFunctions.scala Murmur3Hash rule)."""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..ops.hash import murmur3_row_hash
+from ..ops.kernel_utils import CV
+from .expressions import Expression
+
+__all__ = ["Murmur3Hash"]
+
+
+class Murmur3Hash(Expression):
+    def __init__(self, children: List[Expression], seed: int = 42):
+        self.children = list(children)
+        self.seed = seed
+
+    def bind(self, schema):
+        b = Murmur3Hash([c.bind(schema) for c in self.children], self.seed)
+        b.dtype = dt.INT32
+        return b
+
+    def emit(self, ctx):
+        cvs = [c.emit(ctx) for c in self.children]
+        h = murmur3_row_hash(cvs, [c.dtype for c in self.children],
+                             self.seed)
+        return CV(h, jnp.ones(ctx.capacity, jnp.bool_))
+
+    def __repr__(self):
+        return "hash(" + ", ".join(map(repr, self.children)) + ")"

@@ -13,7 +13,8 @@ from ..ops.kernel_utils import CV
 from .expressions import (Expression, Literal, UnsupportedExpr, _UnaryOp)
 
 __all__ = ["Length", "Upper", "Lower", "Substring", "ConcatStr",
-           "Contains", "StartsWith", "EndsWith", "Like"]
+           "Contains", "StartsWith", "EndsWith", "Like", "Trim",
+           "Reverse", "Instr"]
 
 
 def _require_string(e: Expression, what: str):
@@ -198,3 +199,48 @@ class Like(Expression):
 
     def __repr__(self):
         return f"({self.child} LIKE '{self.pattern}')"
+
+
+class Trim(Expression):
+    def __init__(self, child: Expression, left: bool = True,
+                 right: bool = True):
+        self.child = child
+        self.left, self.right = left, right
+        self.children = [child]
+
+    def bind(self, schema):
+        b = Trim(self.child.bind(schema), self.left, self.right)
+        _require_string(b.child, "trim")
+        b.dtype = dt.STRING
+        return b
+
+    def emit(self, ctx):
+        return ops_str.trim(self.child.emit(ctx), self.left, self.right)
+
+    def __repr__(self):
+        kind = "trim" if self.left and self.right else (
+            "ltrim" if self.left else "rtrim")
+        return f"{kind}({self.child})"
+
+
+class Reverse(_UnaryOp):
+    def _resolve_type(self):
+        _require_string(self.child, "reverse")
+        self.dtype = dt.STRING
+
+    def emit(self, ctx):
+        return ops_str.reverse(self.child.emit(ctx))
+
+
+class Instr(_LiteralPatternPredicate):
+    """instr(str, substr): 1-based position, 0 when absent."""
+
+    def bind(self, schema):
+        b = super().bind(schema)
+        b.dtype = dt.INT32
+        return b
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        out = ops_str.find_first(cv, self._pattern_bytes())
+        return CV(out, cv.validity)

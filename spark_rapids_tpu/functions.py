@@ -167,16 +167,21 @@ def concat(*es):
     return _se.ConcatStr(*[_to_expr(e) for e in es])
 
 
+def _to_pattern(p):
+    # pattern args are LITERALS, not column references
+    return Literal(p) if isinstance(p, (str, bytes)) else _to_expr(p)
+
+
 def contains(e, pattern):
-    return _se.Contains(_to_expr(e), _to_expr(pattern))
+    return _se.Contains(_to_expr(e), _to_pattern(pattern))
 
 
 def startswith(e, pattern):
-    return _se.StartsWith(_to_expr(e), _to_expr(pattern))
+    return _se.StartsWith(_to_expr(e), _to_pattern(pattern))
 
 
 def endswith(e, pattern):
-    return _se.EndsWith(_to_expr(e), _to_expr(pattern))
+    return _se.EndsWith(_to_expr(e), _to_pattern(pattern))
 
 
 def like(e, pattern: str):
@@ -237,3 +242,72 @@ def last_day(e):
 
 def to_date(e):
     return _de.ToDate(_to_expr(e))
+
+
+def trim(e):
+    return _se.Trim(_to_expr(e))
+
+
+def ltrim(e):
+    return _se.Trim(_to_expr(e), left=True, right=False)
+
+
+def rtrim(e):
+    return _se.Trim(_to_expr(e), left=False, right=True)
+
+
+def reverse(e):
+    return _se.Reverse(_to_expr(e))
+
+
+def instr(e, sub):
+    return _se.Instr(_to_expr(e), _to_pattern(sub))
+
+
+def locate(sub, e):
+    return _se.Instr(_to_expr(e), _to_pattern(sub))
+
+
+def bitwise_and(a, b):
+    from .expr.expressions import BitwiseAnd
+    return BitwiseAnd(_to_expr(a), _to_expr(b))
+
+
+def bitwise_or(a, b):
+    from .expr.expressions import BitwiseOr
+    return BitwiseOr(_to_expr(a), _to_expr(b))
+
+
+def bitwise_xor(a, b):
+    from .expr.expressions import BitwiseXor
+    return BitwiseXor(_to_expr(a), _to_expr(b))
+
+
+def bitwise_not(a):
+    from .expr.expressions import BitwiseNot
+    return BitwiseNot(_to_expr(a))
+
+
+def shiftleft(a, b):
+    from .expr.expressions import ShiftLeft
+    return ShiftLeft(_to_expr(a), _to_expr(b))
+
+
+def shiftright(a, b):
+    from .expr.expressions import ShiftRight
+    return ShiftRight(_to_expr(a), _to_expr(b))
+
+
+def pow(a, b):  # noqa: A001
+    from .expr.expressions import Pow
+    return Pow(_to_expr(a), _to_expr(b))
+
+
+def atan2(a, b):
+    from .expr.expressions import Atan2
+    return Atan2(_to_expr(a), _to_expr(b))
+
+
+def hash(*cols):  # noqa: A001 - pyspark naming
+    from .expr.hash_expr import Murmur3Hash
+    return Murmur3Hash([_to_expr(c) for c in cols])

@@ -23,7 +23,8 @@ from .kernel_utils import CV
 
 __all__ = ["byte_row_map", "str_len_bytes", "str_len_chars", "upper",
            "lower", "substring", "concat_strings", "compare", "contains",
-           "startswith", "endswith", "rebuild_strings"]
+           "startswith", "endswith", "rebuild_strings", "trim", "reverse",
+           "find_first"]
 
 
 def byte_row_map(offsets, dcap: int):
@@ -208,3 +209,58 @@ def endswith(cv: CV, pattern: bytes):
     ok, row, rel, lens = _find_literal(cv, pattern)
     at_end = ok & (rel == lens[row] - len(pattern))
     return jax.ops.segment_max(at_end.astype(jnp.int32), row, n) > 0
+
+
+def trim(cv: CV, left: bool = True, right: bool = True) -> CV:
+    """Strip ASCII spaces (Spark trim/ltrim/rtrim trim ' ' by default).
+    Unbounded: one byte-domain pass finds each row's first/last non-space
+    via segment reductions."""
+    lens = str_len_bytes(cv)
+    n = lens.shape[0]
+    dcap = cv.data.shape[0]
+    starts = cv.offsets[:-1]
+    row = byte_row_map(cv.offsets, dcap)
+    pos = jnp.arange(dcap, dtype=jnp.int32)
+    rel = pos - starts[row]
+    in_range = (rel >= 0) & (rel < lens[row])
+    non_space = in_range & (cv.data != 32)
+    first_rel = jax.ops.segment_min(
+        jnp.where(non_space, rel, jnp.int32(2**30)), row, n)
+    last_rel = jax.ops.segment_max(
+        jnp.where(non_space, rel, jnp.int32(-1)), row, n)
+    all_space = first_rel >= 2**30
+    lead = jnp.where(left, jnp.where(all_space, lens, first_rel), 0)
+    end = jnp.where(right, last_rel + 1, lens)
+    new_len = jnp.maximum(end - lead, 0)
+    new_len = jnp.where(all_space, 0, new_len)
+    return rebuild_strings(cv, (starts + lead).astype(jnp.int32),
+                           new_len.astype(jnp.int32))
+
+
+def reverse(cv: CV) -> CV:
+    """Byte-reverse each row (exact for ASCII; documented deviation)."""
+    n = cv.offsets.shape[0] - 1
+    dcap = cv.data.shape[0]
+    row = byte_row_map(cv.offsets, dcap)
+    pos = jnp.arange(dcap, dtype=jnp.int32)
+    rel = pos - cv.offsets[row]
+    lens = str_len_bytes(cv)
+    src = cv.offsets[row] + (lens[row] - 1 - rel)
+    src = jnp.clip(src, 0, dcap - 1)
+    in_range = (rel >= 0) & (rel < lens[row])
+    data = jnp.where(in_range, cv.data[src], 0).astype(jnp.uint8)
+    return CV(data, cv.validity, cv.offsets)
+
+
+def find_first(cv: CV, pattern: bytes):
+    """1-based position of the first occurrence per row; 0 if absent
+    (Spark instr/locate semantics)."""
+    n = cv.offsets.shape[0] - 1
+    if len(pattern) == 0:
+        return jnp.ones(n, jnp.int32)
+    ok, row, rel, lens = _find_literal(cv, pattern)
+    first = jax.ops.segment_min(
+        jnp.where(ok, rel, jnp.int32(2**30)), row, n)
+    return jnp.where(first < 2**30, first + 1, 0).astype(jnp.int32)
+
+

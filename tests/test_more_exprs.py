@@ -1,0 +1,83 @@
+"""Trim/reverse/instr, bitwise, pow/atan2, hash()."""
+import numpy as np
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col
+
+from asserts import assert_rows_equal
+from data_gen import IntegerGen, StringGen, gen_df
+
+
+def test_trim_reverse_instr(session):
+    df = session.create_dataframe({"s": ["  hi  ", "a b", "", None, "xx "]})
+    out = df.select(F.trim(col("s")).alias("t"),
+                    F.ltrim(col("s")).alias("l"),
+                    F.rtrim(col("s")).alias("r"),
+                    F.reverse(col("s")).alias("rv"),
+                    F.instr(col("s"), "b").alias("i")).to_arrow()
+    got = out.to_pydict()
+    assert got["t"] == ["hi", "a b", "", None, "xx"]
+    assert got["l"] == ["hi  ", "a b", "", None, "xx "]
+    assert got["r"] == ["  hi", "a b", "", None, "xx"]
+    assert got["rv"] == ["  ih  ", "b a", "", None, " xx"]
+    assert got["i"] == [0, 3, 0, None, 0]
+
+
+def test_bitwise_and_shifts(session):
+    df, at = gen_df(session, [("a", IntegerGen(lo=0, hi=10**6)),
+                              ("b", IntegerGen(lo=0, hi=10**6))],
+                    n=600, seed=120)
+    out = df.select(F.bitwise_and(col("a"), col("b")).alias("and_"),
+                    F.bitwise_or(col("a"), col("b")).alias("or_"),
+                    F.bitwise_xor(col("a"), col("b")).alias("xor_"),
+                    F.shiftleft(col("a"), 3).alias("shl"),
+                    F.shiftright(col("a"), 2).alias("shr")).to_arrow()
+    def w32(x):
+        return ((x + 2**31) % 2**32) - 2**31
+
+    exp = []
+    for a, b in zip(at.column(0).to_pylist(), at.column(1).to_pylist()):
+        exp.append((
+            None if a is None or b is None else a & b,
+            None if a is None or b is None else a | b,
+            None if a is None or b is None else a ^ b,
+            None if a is None else w32(a << 3),
+            None if a is None else a >> 2))
+    assert_rows_equal(out, exp, ignore_order=False)
+
+
+def test_pow_atan2(session):
+    df = session.create_dataframe({"a": [2.0, 3.0, None],
+                                   "b": [10.0, 0.5, 1.0]})
+    out = df.select(F.pow(col("a"), col("b")).alias("p"),
+                    F.atan2(col("a"), col("b")).alias("t")).to_arrow()
+    import math
+    got = out.to_pydict()
+    assert got["p"][0] == 1024.0
+    assert abs(got["p"][1] - math.sqrt(3)) < 1e-12
+    assert got["p"][2] is None
+    assert abs(got["t"][0] - math.atan2(2, 10)) < 1e-12
+
+
+def test_hash_expression_consistency(session):
+    df, at = gen_df(session, [("a", IntegerGen()),
+                              ("s", StringGen(max_len=10))], n=400,
+                    seed=121)
+    out1 = df.select(F.hash(col("a"), col("s")).alias("h")).to_arrow()
+    out2 = df.select(F.hash(col("a"), col("s")).alias("h")).to_arrow()
+    assert out1.column(0).to_pylist() == out2.column(0).to_pylist()
+    # hash is never null and is int32
+    assert all(v is not None for v in out1.column(0).to_pylist())
+
+
+def test_trim_unbounded_and_short_shift(session):
+    import pyarrow as pa
+    from spark_rapids_tpu.columnar import dtypes as dt
+    df = session.create_dataframe({
+        "s": [" " * 70 + "x" + " " * 70, " " * 100],
+        "sh": pa.array([1, 2], pa.int16())})
+    out = df.select(F.trim(col("s")).alias("t"),
+                    F.shiftleft(col("sh"), 17).alias("sl")).to_arrow()
+    assert out.column(0).to_pylist() == ["x", ""]
+    # smallint promotes to int: 1 << 17 = 131072 (Spark semantics)
+    assert out.column(1).to_pylist() == [131072, 262144]

@@ -1,0 +1,22 @@
+"""Pallas kernel parity vs the jnp murmur3 implementation."""
+import numpy as np
+import pytest
+
+
+def test_pallas_partition_ids_matches_jnp(session):
+    import jax
+    import jax.numpy as jnp
+    from spark_rapids_tpu.columnar import dtypes as dt
+    from spark_rapids_tpu.ops.hash import partition_ids
+    from spark_rapids_tpu.ops.kernel_utils import CV
+    from spark_rapids_tpu.ops.pallas_kernels import pallas_partition_ids_i32
+
+    rng = np.random.default_rng(0)
+    vals = rng.integers(-2**31, 2**31, 4096).astype(np.int32)
+    valid = rng.integers(0, 2, 4096).astype(bool)
+    interpret = jax.default_backend() == "cpu"
+    got = np.asarray(pallas_partition_ids_i32(
+        jnp.asarray(vals), jnp.asarray(valid), 16, interpret=interpret))
+    cv = CV(jnp.asarray(vals), jnp.asarray(valid))
+    exp = np.asarray(partition_ids([cv], [dt.INT32], 16))
+    assert (got == exp).all()
