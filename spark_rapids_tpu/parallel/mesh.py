@@ -18,6 +18,16 @@ __all__ = ["make_mesh", "P", "NamedSharding", "Mesh", "shard_rows"]
 def make_mesh(n_devices: Optional[int] = None,
               axis_name: str = "data") -> Mesh:
     devs = jax.devices()
+    if n_devices is not None and len(devs) < n_devices:
+        # a TPU tunnel may own the default platform with one chip; the
+        # virtual CPU mesh (xla_force_host_platform_device_count) still
+        # exists on the cpu platform — fall back to it
+        try:
+            cpu = jax.devices("cpu")
+            if len(cpu) >= n_devices:
+                devs = cpu
+        except RuntimeError:
+            pass
     if n_devices is not None:
         if len(devs) < n_devices:
             raise ValueError(
