@@ -157,6 +157,9 @@ class HashAggregateExec(TpuExec):
             if a.state_reducers is None:
                 raise UnsupportedExpr(
                     f"{a!r} does not support grouped merge")
+            if "custom" in a.state_reducers and not hasattr(
+                    a, "g_merge_custom"):
+                raise UnsupportedExpr(f"{a!r} lacks g_merge_custom")
             if (a.child is not None and a.child.dtype.is_variable_width
                     and type(a).__name__ not in ("Count",)):
                 raise UnsupportedExpr(f"{a!r} over variable-width input")
@@ -225,18 +228,32 @@ class HashAggregateExec(TpuExec):
             out_flat = []
             i = 0
             for a in self.aggs:
-                for r in a.state_reducers:
-                    arr = flat_states[i][perm]
-                    out_flat.append(_seg_reduce(r, arr, live, seg_ids, cap))
-                    i += 1
+                width = self._state_width(a)
+                if "custom" in a.state_reducers:
+                    cols = [flat_states[i + j][perm] for j in range(width)]
+                    out_flat.extend(a.g_merge_custom(cols, live, seg_ids,
+                                                     cap))
+                    i += width
+                else:
+                    for r in a.state_reducers:
+                        arr = flat_states[i][perm]
+                        out_flat.append(_seg_reduce(r, arr, live, seg_ids,
+                                                    cap))
+                        i += 1
             return key_out, out_flat, seg_live
         return fn
+
+    @staticmethod
+    def _state_width(a) -> int:
+        if "custom" in a.state_reducers:
+            return a.num_state_cols()
+        return len(a.state_reducers)
 
     def _finalize_fn(self, key_cvs, flat_states, seg_live):
         outs = list(key_cvs)
         i = 0
         for a in self.aggs:
-            k = len(a.state_reducers)
+            k = self._state_width(a)
             s = tuple(flat_states[i:i + k])
             i += k
             v, ok = a.finalize(s)

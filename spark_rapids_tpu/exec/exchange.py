@@ -28,7 +28,7 @@ from ..utils.transfer import fetch
 from .base import ExecContext, TpuExec
 from .batch import DeviceBatch
 
-__all__ = ["ShuffleExchangeExec"]
+__all__ = ["ShuffleExchangeExec", "RangeShuffleExchangeExec"]
 
 
 class ShuffleExchangeExec(TpuExec):
@@ -39,7 +39,7 @@ class ShuffleExchangeExec(TpuExec):
         self.n = num_partitions
         self.keys = list(bound_keys) if bound_keys else None
         self._shuffle: Optional[LocalShuffle] = None
-        self._lock = threading.Lock()
+        self._lock = threading.RLock()
         self._jit = jax.jit(self._map_fn)
 
     def describe(self):
@@ -50,27 +50,28 @@ class ShuffleExchangeExec(TpuExec):
         return self.n
 
     # ---- map-side device program --------------------------------------
-    def _map_fn(self, cvs, mask):
+    def _compute_pids(self, cvs, mask):
+        """int32[cap] target partition per row (overridden by range)."""
         cap = mask.shape[0]
-        if self.keys:
-            ctx = EmitCtx(cvs, cap)
-            key_cvs = [k.emit(ctx) for k in self.keys]
-            pids = None
-            if (len(self.keys) == 1 and cap % 1024 == 0
-                    and jax.default_backend() == "tpu"):
-                kd = self.keys[0].dtype
-                if isinstance(kd, (dt.IntegerType, dt.DateType)):
-                    # hot path: fused Pallas murmur3+pmod kernel
-                    from ..ops.pallas_kernels import pallas_partition_ids_i32
-                    kcv = key_cvs[0]
-                    pids = pallas_partition_ids_i32(
-                        kcv.data.astype(jnp.int32), kcv.validity, self.n)
-            if pids is None:
-                pids = partition_ids(key_cvs, [k.dtype for k in self.keys],
-                                     self.n)
-        else:
-            pids = ((jnp.cumsum(mask.astype(jnp.int32)) - 1)
+        if not self.keys:
+            return ((jnp.cumsum(mask.astype(jnp.int32)) - 1)
                     % self.n).astype(jnp.int32)
+        ctx = EmitCtx(cvs, cap)
+        key_cvs = [k.emit(ctx) for k in self.keys]
+        if (len(self.keys) == 1 and cap % 1024 == 0
+                and jax.default_backend() == "tpu"):
+            kd = self.keys[0].dtype
+            if isinstance(kd, (dt.IntegerType, dt.DateType)):
+                # hot path: fused Pallas murmur3+pmod kernel
+                from ..ops.pallas_kernels import pallas_partition_ids_i32
+                kcv = key_cvs[0]
+                return pallas_partition_ids_i32(
+                    kcv.data.astype(jnp.int32), kcv.validity, self.n)
+        return partition_ids(key_cvs, [k.dtype for k in self.keys],
+                             self.n)
+
+    def _map_fn(self, cvs, mask):
+        pids = self._compute_pids(cvs, mask)
         eff = jnp.where(mask, pids, self.n)
         order = jnp.argsort(eff, stable=True)
         live_sorted = mask[order]
@@ -147,3 +148,53 @@ class ShuffleExchangeExec(TpuExec):
         if batch is not None:
             m.add("numOutputBatches", 1)
             yield batch
+
+
+class RangeShuffleExchangeExec(ShuffleExchangeExec):
+    """Range partitioning (reference: GpuRangePartitioner.scala —
+    sample-based bounds). Round-1 supports a single numeric/date key:
+    bounds come from sampling the first child batch; partition ids via
+    searchsorted over the bounds."""
+
+    def __init__(self, child, num_partitions, bound_keys, schema):
+        from ..expr.expressions import UnsupportedExpr
+        super().__init__(child, num_partitions, bound_keys, schema)
+        if not bound_keys or len(bound_keys) != 1:
+            raise UnsupportedExpr(
+                "range partitioning supports one key round-1")
+        self._bounds = None
+
+    def describe(self):
+        return f"RangeShuffleExchangeExec[n={self.n}]"
+
+    def _compute_pids(self, cvs, mask):
+        cap = mask.shape[0]
+        ctx = EmitCtx(cvs, cap)
+        kcv = self.keys[0].emit(ctx)
+        pids = jnp.searchsorted(self._bounds, kcv.data,
+                                side="right").astype(jnp.int32)
+        # nulls partition first (Spark null ordering for range)
+        return jnp.where(kcv.validity, pids, 0)
+
+    def _ensure_shuffled(self, ctx):
+        with self._lock:  # RLock: safe to re-enter in super()
+            self._ensure_bounds(ctx)
+            super()._ensure_shuffled(ctx)
+
+    def _ensure_bounds(self, ctx):
+        if self._bounds is None:
+            # sample bounds from the first child batch
+            child = self.children[0]
+            first = next(iter(child.execute_partition(ctx, 0)), None)
+            if first is None:
+                self._bounds = jnp.zeros(self.n - 1)
+            else:
+                ectx = EmitCtx(first.cvs(), first.capacity)
+                kcv = self.keys[0].emit(ectx)
+                live = first.row_mask & kcv.validity
+                order = jnp.argsort(jnp.where(live, kcv.data,
+                                              kcv.data.max()))
+                nlive = jnp.maximum(jnp.sum(live.astype(jnp.int32)), 1)
+                qs = (jnp.arange(1, self.n) * nlive) // self.n
+                self._bounds = kcv.data[order[jnp.clip(qs, 0,
+                                                       first.capacity - 1)]]

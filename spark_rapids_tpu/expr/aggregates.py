@@ -23,7 +23,7 @@ from ..ops.kernel_utils import CV
 from .expressions import (Cast, Expression, Literal, UnsupportedExpr)
 
 __all__ = ["AggExpr", "Sum", "Count", "CountStar", "Min", "Max", "Avg",
-           "First", "Last"]
+           "First", "Last", "Stddev", "Variance"]
 
 _MINMAX_IDENT = {
     jnp.float32: (jnp.inf, -jnp.inf),
@@ -328,3 +328,97 @@ class First(_FirstLast):
 
 class Last(_FirstLast):
     take_first = False
+
+
+class Variance(AggExpr):
+    """var_samp (Spark variance) with Welford/Chan merging — the
+    E[x^2]-E[x]^2 form catastrophically cancels for large-magnitude
+    inputs. State: (n, mean, M2); batch update computes the per-segment
+    mean then M2 = sum((x-mean)^2); merges use Chan's formula via a
+    custom grouped merge (reference: aggregateFunctions.scala M2-based
+    variance)."""
+
+    state_reducers = ("custom",)  # uses g_merge_custom
+    ddof = 1
+
+    def _resolve_type(self):
+        ct = self.child.dtype
+        if not (ct.is_numeric or isinstance(ct, dt.NullType)):
+            raise UnsupportedExpr(f"variance({ct})")
+        self.dtype = dt.FLOAT64
+        self._scale = (10.0 ** -ct.scale
+                       if isinstance(ct, dt.DecimalType) else 1.0)
+
+    def num_state_cols(self):
+        return 3
+
+    def _xs(self, cv, m):
+        return jnp.where(m, cv.data, 0).astype(jnp.float64) * self._scale
+
+    # ---- ungrouped ----------------------------------------------------
+    def update(self, cv: CV, mask):
+        m = mask & cv.validity
+        x = self._xs(cv, m)
+        n = jnp.sum(m.astype(jnp.int64))
+        nf = jnp.maximum(n, 1).astype(jnp.float64)
+        mean = jnp.sum(x) / nf
+        d = jnp.where(m, x - mean, 0.0)
+        m2 = jnp.sum(d * d)
+        return (n, mean, m2)
+
+    def merge(self, s1, s2):
+        n1, m1, q1 = s1
+        n2, m2_, q2 = s2
+        n = n1 + n2
+        nf = jnp.maximum(n, 1).astype(jnp.float64)
+        delta = m2_ - m1
+        mean = m1 + delta * (n2.astype(jnp.float64) / nf)
+        q = (q1 + q2 + delta * delta
+             * (n1.astype(jnp.float64) * n2.astype(jnp.float64) / nf))
+        return (n, mean, q)
+
+    def finalize(self, s):
+        n, _, m2 = s
+        valid = n > self.ddof
+        denom = jnp.where(valid, (n - self.ddof).astype(jnp.float64), 1.0)
+        return self._final_value(jnp.maximum(m2, 0.0) / denom), valid
+
+    def _final_value(self, var):
+        return var
+
+    # ---- grouped ------------------------------------------------------
+    def g_update(self, cv, mask, seg_ids, num_segments):
+        m = mask & cv.validity
+        x = self._xs(cv, m)
+        n = jax.ops.segment_sum(m.astype(jnp.int64), seg_ids, num_segments)
+        nf = jnp.maximum(n, 1).astype(jnp.float64)
+        mean = jax.ops.segment_sum(x, seg_ids, num_segments) / nf
+        d = jnp.where(m, x - mean[seg_ids], 0.0)
+        m2 = jax.ops.segment_sum(d * d, seg_ids, num_segments)
+        return (n, mean, m2)
+
+    def g_merge_custom(self, cols_sorted, live, seg_ids, num_segments):
+        """Chan's parallel combine across partial states of one segment:
+        Mean = sum(n_i mean_i)/N; M2 = sum(M2_i) + sum(n_i (mean_i-Mean)^2).
+        Differences of means stay small, so no cancellation."""
+        n_i, mean_i, m2_i = cols_sorted
+        n_i = jnp.where(live, n_i, 0)
+        mean_i = jnp.where(live, mean_i, 0.0)
+        m2_i = jnp.where(live, m2_i, 0.0)
+        N = jax.ops.segment_sum(n_i, seg_ids, num_segments)
+        Nf = jnp.maximum(N, 1).astype(jnp.float64)
+        Mean = jax.ops.segment_sum(
+            n_i.astype(jnp.float64) * mean_i, seg_ids, num_segments) / Nf
+        dev = mean_i - Mean[seg_ids]
+        M2 = (jax.ops.segment_sum(m2_i, seg_ids, num_segments)
+              + jax.ops.segment_sum(
+                  n_i.astype(jnp.float64) * dev * dev, seg_ids,
+                  num_segments))
+        return (N, Mean, M2)
+
+
+class Stddev(Variance):
+    """stddev_samp (Spark stddev)."""
+
+    def _final_value(self, var):
+        return jnp.sqrt(var)

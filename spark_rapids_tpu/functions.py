@@ -58,6 +58,20 @@ def avg(e):
 mean = avg
 
 
+def stddev(e):
+    return _agg.Stddev(_to_expr(e))
+
+
+stddev_samp = stddev
+
+
+def variance(e):
+    return _agg.Variance(_to_expr(e))
+
+
+var_samp = variance
+
+
 def first(e, ignorenulls=False):
     return _agg.First(_to_expr(e), ignorenulls)
 

@@ -135,3 +135,47 @@ def test_agg_over_expression(session):
     exp_v = sum(wrap32(a * b) for a, b in _py_rows(at)
                 if a is not None and b is not None)
     assert out.to_pydict()["dot"] == [exp_v]
+
+
+def test_stddev_variance(session):
+    import statistics
+    df, at = gen_df(session, [("k", IntegerGen(lo=0, hi=4, nullable=False)),
+                              ("v", IntegerGen(lo=0, hi=1000))],
+                    n=2000, seed=130)
+    out = (df.group_by("k").agg(F.stddev("v").alias("sd"),
+                                F.variance("v").alias("vr")).to_arrow())
+    groups = defaultdict(list)
+    for k, v in zip(at.column(0).to_pylist(), at.column(1).to_pylist()):
+        if v is not None:
+            groups[k].append(v)
+    got = {k: (sd, vr) for k, sd, vr in zip(
+        *[out.column(i).to_pylist() for i in range(3)])}
+    for k, vs in groups.items():
+        sd, vr = got[k]
+        assert abs(sd - statistics.stdev(vs)) < 1e-6 * max(statistics.stdev(vs), 1)
+        assert abs(vr - statistics.variance(vs)) < 1e-6 * max(statistics.variance(vs), 1)
+    # ungrouped + edge: single row -> null
+    one = session.create_dataframe({"v": [5]})
+    r = one.agg(F.stddev("v").alias("s")).to_arrow().to_pydict()
+    assert r["s"] == [None]
+
+
+def test_variance_no_catastrophic_cancellation(session):
+    import pyarrow as pa
+    n = 2000
+    vals = [10**9 + (i % 2) for i in range(n)]
+    df = session.create_dataframe({"v": pa.array(vals, pa.int64()),
+                                   "k": pa.array([i % 3 for i in range(n)])})
+    got = df.agg(F.variance("v").alias("v")).collect()[0][0]
+    import statistics
+    exp = statistics.variance(vals)
+    assert abs(got - exp) < 1e-6, (got, exp)
+    # grouped + multi-batch merge path
+    s2 = __import__("spark_rapids_tpu").TpuSession(
+        {"spark.rapids.tpu.sql.batchSizeRows": 128})
+    df2 = s2.create_dataframe({"v": pa.array(vals, pa.int64()),
+                               "k": pa.array([i % 3 for i in range(n)])})
+    out = df2.group_by("k").agg(F.variance("v").alias("vr")).to_arrow()
+    for k, vr in zip(out.column(0).to_pylist(), out.column(1).to_pylist()):
+        gvals = [v for i, v in enumerate(vals) if i % 3 == k]
+        assert abs(vr - statistics.variance(gvals)) < 1e-6

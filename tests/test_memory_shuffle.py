@@ -148,3 +148,38 @@ def test_repartition_roundrobin(session):
     out = df.repartition(7).to_arrow()
     assert sorted(out.column(0).to_pylist()) == \
         sorted(at.column(0).to_pylist())
+
+
+def test_range_partition_ordering(session):
+    import spark_rapids_tpu as st
+    from spark_rapids_tpu.exec.exchange import RangeShuffleExchangeExec
+    from spark_rapids_tpu.plan.planner import Planner
+    from spark_rapids_tpu.plan import logical as L
+    import spark_rapids_tpu.functions as F
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 512})
+    df, at = gen_df(s, [("k", IntegerGen(lo=0, hi=10**6, nullable=False)),
+                        ("v", IntegerGen())], n=3000, seed=140)
+    # build the exec directly (range exchange is not yet planner-selected)
+    planner = Planner(s.conf)
+    child = planner.plan(df._plan)
+    from spark_rapids_tpu.exec.base import ExecContext
+    ctx = ExecContext(s.conf, s)
+    keys = [F.col("k").bind(df.schema)]
+    ex = RangeShuffleExchangeExec(child, 4, keys, df.schema)
+    parts = []
+    for pid in range(4):
+        rows = []
+        for b in ex.execute_partition(ctx, pid):
+            at2 = b.table.to_arrow()
+            import numpy as np, jax
+            mask = np.asarray(jax.device_get(b.row_mask))[:b.num_rows]
+            ks = [k for k, m in zip(at2.column(0).to_pylist(), mask) if m]
+            rows.extend(ks)
+        parts.append(rows)
+    # all rows preserved
+    assert sorted(x for p in parts for x in p) == \
+        sorted(at.column(0).to_pylist())
+    # ranges are disjoint and ordered: max(part i) <= min(part i+1)
+    nonempty = [p for p in parts if p]
+    for a, b in zip(nonempty, nonempty[1:]):
+        assert max(a) <= min(b)
