@@ -131,7 +131,12 @@ LORE_DUMP_PATH = _conf(
     "Directory for LORE operator dumps.", str)
 SORT_OOC_ENABLED = _conf(
     "sql.sort.outOfCore.enabled", True,
-    "Enable out-of-core chunked merge sort for big inputs.", bool)
+    "Enable out-of-core sort (range-exchange to spill files + "
+    "per-partition sorts) for big inputs.", bool)
+SORT_OOC_THRESHOLD = _conf(
+    "sql.sort.outOfCore.thresholdBytes", 2 << 30,
+    "Device bytes of sort input above which the out-of-core path "
+    "activates.", int)
 AGG_FORCE_MERGE_PASSES = _conf(
     "sql.agg.forceSinglePassMerge", False,
     "Testing: force aggregate merge in one concat pass.", bool, internal=True)

@@ -4,8 +4,9 @@ Analog of GpuSortExec (reference: GpuSortExec.scala:87; SortUtils.scala).
 TPU-first: one fused XLA program — radix-normalized order keys (Spark
 null ordering + NaN-greatest + descending via bitwise complement),
 stable lexsort, then a gather of every payload column. Dead rows sort to
-the back. The out-of-core chunked merge path arrives with the spill
-framework; round-1 concatenates all input batches on device.
+the back. Inputs collect into spill-store handles (bounded HBM); above
+the out-of-core threshold the sort becomes a range exchange over the
+handles plus independent per-partition sorts emitted in range order.
 """
 from __future__ import annotations
 
@@ -54,6 +55,13 @@ def sort_batch_cvs(cvs: Sequence[CV], mask, orders, nchunks):
 
 
 class SortExec(TpuExec):
+    """In-core: concat + one fused sort. Out-of-core (input above
+    sql.sort.outOfCore.thresholdBytes, single ascending/nulls-first
+    leading key): range-exchange the input into ordered spill-file
+    partitions, sort each partition independently, emit in partition
+    order — bounded device memory (reference: GpuSortExec.scala:44
+    out-of-core mode, redesigned around the exchange)."""
+
     def __init__(self, child: TpuExec, bound_orders, schema: Schema):
         super().__init__([child], schema)
         self.orders = list(bound_orders)
@@ -79,15 +87,58 @@ class SortExec(TpuExec):
                 ncs.append(0)
         return tuple(ncs)
 
+    def _ooc_eligible(self, ctx) -> bool:
+        from ..config import SORT_OOC_ENABLED
+        if not ctx.conf.get(SORT_OOC_ENABLED):
+            return False
+        o0 = self.orders[0]
+        # range boundaries follow ascending natural order with nulls in
+        # partition 0; other leading orders fall back to in-core
+        return (o0.ascending and o0.nulls_first
+                and not isinstance(o0.expr.dtype,
+                                   (dt.StringType, dt.BinaryType)))
+
+    def _sort_one_batch(self, ctx, cvs, mask):
+        m = ctx.metrics_for(self._op_id)
+        with m.timer("sortTime"):
+            nchunks = self._nchunks(cvs, mask)
+            fn = self._jit_cache.get(nchunks)
+            if fn is None:
+                fn = jax.jit(lambda c, mk, _nc=nchunks:
+                             sort_batch_cvs(c, mk, self.orders, _nc))
+                self._jit_cache[nchunks] = fn
+            out, out_mask = fn(cvs, mask)
+        cap = out_mask.shape[0]
+        m.add("numOutputBatches", 1)
+        return DeviceBatch(make_table(self.schema, out, cap), cap,
+                           out_mask, cap)
+
     def execute_partition(self, ctx: ExecContext, pid: int):
+        """Collect the child into spillable handles (the SpillStore keeps
+        HBM bounded while we measure the exact input size), then pick
+        in-core (one fused sort) or out-of-core (range exchange over the
+        handles + per-partition sorts, reference GpuSortExec.scala:44)."""
+        from ..config import SORT_OOC_THRESHOLD
+        from ..memory.spill import spill_store
         m = ctx.metrics_for(self._op_id)
         child = self.children[0]
-        batches: List[DeviceBatch] = []
-        for cpid in range(child.num_partitions(ctx)):
-            batches.extend(child.execute_partition(ctx, cpid))
-        if not batches:
-            return
-        with m.timer("sortTime"):
+        store = spill_store(ctx.conf)
+        handles = []
+        total = 0
+        try:
+            for cpid in range(child.num_partitions(ctx)):
+                for batch in child.execute_partition(ctx, cpid):
+                    handles.append(store.add_batch(batch))
+                    total += batch.nbytes
+            if not handles:
+                return
+            thr = ctx.conf.get(SORT_OOC_THRESHOLD)
+            if total > thr and self._ooc_eligible(ctx):
+                m.add("oocRangePartitions",
+                      max(2, int(2 * total // max(thr, 1)) + 1))
+                yield from self._execute_out_of_core(ctx, handles, total)
+                return
+            batches = [h.materialize() for h in handles]
             if len(batches) == 1:
                 cvs, mask = batches[0].cvs(), batches[0].row_mask
             else:
@@ -96,14 +147,37 @@ class SortExec(TpuExec):
                                   self.schema.fields[i].dtype)
                        for i in range(ncols)]
                 mask = concat_masks([b.row_mask for b in batches])
-            nchunks = self._nchunks(cvs, mask)
-            fn = self._jit_cache.get(nchunks)
-            if fn is None:
-                fn = jax.jit(lambda c, mk: sort_batch_cvs(
-                    c, mk, self.orders, nchunks))
-                self._jit_cache[nchunks] = fn
-            out, out_mask = fn(cvs, mask)
-        cap = out_mask.shape[0]
-        m.add("numOutputBatches", 1)
-        yield DeviceBatch(make_table(self.schema, out, cap), cap, out_mask,
-                          cap)
+            yield self._sort_one_batch(ctx, cvs, mask)
+        finally:
+            for h in handles:
+                h.close()
+
+    def _execute_out_of_core(self, ctx: ExecContext, handles, total):
+        from ..config import SORT_OOC_THRESHOLD
+        from ..exec.exchange import RangeShuffleExchangeExec
+        thr = ctx.conf.get(SORT_OOC_THRESHOLD)
+        nparts = max(2, int(2 * total // max(thr, 1)) + 1)
+        ex = RangeShuffleExchangeExec(
+            _HandleScanExec(handles, self.schema), nparts,
+            [self.orders[0].expr], self.schema)
+        for rp in range(nparts):  # partitions are range-ordered
+            for batch in ex.execute_partition(ctx, rp):
+                yield self._sort_one_batch(ctx, batch.cvs(),
+                                           batch.row_mask)
+
+
+
+class _HandleScanExec(TpuExec):
+    """Serves spill-store handles as batches, one child partition per
+    handle (feeds the out-of-core sort's range exchange)."""
+
+    def __init__(self, handles, schema: Schema):
+        super().__init__([], schema)
+        self.handles = list(handles)
+
+    def num_partitions(self, ctx):
+        return max(1, len(self.handles))
+
+    def execute_partition(self, ctx, pid):
+        if pid < len(self.handles):
+            yield self.handles[pid].materialize()
