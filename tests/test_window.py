@@ -114,3 +114,20 @@ def test_sliding_frame_sum(session):
         hi = min(len(rows) - 1, i + 2)
         exp.append((o, sum(r[1] for r in rows[lo:hi + 1])))
     assert_rows_equal(out, exp)
+
+
+def test_window_string_partition_keys(session):
+    from data_gen import StringGen
+    df, at = gen_df(session, [("k", StringGen(max_len=6, charset="ab")),
+                              ("v", IntegerGen(nullable=False))],
+                    n=400, seed=75)
+    w = Window.partition_by("k").order_by("v")
+    out = df.select("k", "v", row_number().over(w).alias("rn")).to_arrow()
+    groups = defaultdict(list)
+    for k, v in zip(at.column(0).to_pylist(), at.column(1).to_pylist()):
+        groups[k].append(v)
+    exp = []
+    for k, vs in groups.items():
+        for i, v in enumerate(sorted(vs)):
+            exp.append((k, v, i + 1))
+    assert_rows_equal(out, exp)
