@@ -14,7 +14,7 @@ from .expressions import (Expression, Literal, UnsupportedExpr, _UnaryOp)
 
 __all__ = ["Length", "Upper", "Lower", "Substring", "ConcatStr",
            "Contains", "StartsWith", "EndsWith", "Like", "Trim",
-           "Reverse", "Instr"]
+           "Reverse", "Instr", "Pad", "Repeat", "ConcatWs"]
 
 
 def _require_string(e: Expression, what: str):
@@ -244,3 +244,96 @@ class Instr(_LiteralPatternPredicate):
         cv = self.child.emit(ctx)
         out = ops_str.find_first(cv, self._pattern_bytes())
         return CV(out, cv.validity)
+
+
+class Pad(Expression):
+    def __init__(self, child: Expression, target_len: int, pad: str,
+                 left: bool):
+        self.child = child
+        self.target_len = int(target_len)
+        self.pad = pad
+        self.left = left
+        self.children = [child]
+
+    def bind(self, schema):
+        b = Pad(self.child.bind(schema), self.target_len, self.pad,
+                self.left)
+        _require_string(b.child, "lpad/rpad")
+        b.dtype = dt.STRING
+        return b
+
+    def emit(self, ctx):
+        return ops_str.pad(self.child.emit(ctx), self.target_len,
+                           self.pad.encode(), self.left)
+
+    def __repr__(self):
+        return f"{'l' if self.left else 'r'}pad({self.child})"
+
+
+class Repeat(Expression):
+    def __init__(self, child: Expression, times: int):
+        self.child = child
+        self.times = int(times)
+        self.children = [child]
+
+    def bind(self, schema):
+        b = Repeat(self.child.bind(schema), self.times)
+        _require_string(b.child, "repeat")
+        b.dtype = dt.STRING
+        return b
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        out_cap = max(cv.data.shape[0] * max(self.times, 1), 1)
+        return ops_str.repeat_str(cv, self.times, out_cap)
+
+    def __repr__(self):
+        return f"repeat({self.child}, {self.times})"
+
+
+class ConcatWs(Expression):
+    """concat_ws(sep, cols...): skips NULL inputs (Spark semantics,
+    unlike concat which nulls out the row)."""
+
+    def __init__(self, sep: str, *children: Expression):
+        self.sep = sep
+        self.children = list(children)
+
+    def bind(self, schema):
+        bc = [c.bind(schema) for c in self.children]
+        for c in bc:
+            _require_string(c, "concat_ws")
+        b = ConcatWs(self.sep, *bc)
+        b.dtype = dt.STRING
+        return b
+
+    def emit(self, ctx):
+        cvs = [c.emit(ctx) for c in self.children]
+        cap = ctx.capacity
+        if not cvs:
+            return CV(jnp.zeros(128, jnp.uint8), jnp.ones(cap, jnp.bool_),
+                      jnp.zeros(cap + 1, jnp.int32))
+        sep_raw = self.sep.encode()
+        # single interleaved pass: [c0, sep1, c1, sep2, c2, ...] where
+        # sep_i is present iff any of c0..c_{i-1} is non-null AND c_i is
+        parts = []
+        prefix_has = None
+        for i, cv in enumerate(cvs):
+            has = cv.validity
+            lens = ops_str.str_len_bytes(cv)
+            safe = ops_str.rebuild_strings(
+                cv, cv.offsets[:-1],
+                jnp.where(has, lens, 0).astype(jnp.int32))
+            safe = CV(safe.data, jnp.ones(cap, jnp.bool_), safe.offsets)
+            if i > 0 and sep_raw:
+                present = prefix_has & has
+                parts.append(ops_str.literal_column(
+                    sep_raw, present, cap * len(sep_raw)))
+            parts.append(safe)
+            prefix_has = has if prefix_has is None else (prefix_has | has)
+        out_cap = sum(p.data.shape[0] for p in parts)
+        out = ops_str.concat_strings(parts, out_cap)
+        return CV(out.data, jnp.ones(cap, jnp.bool_), out.offsets)
+
+    def __repr__(self):
+        return f"concat_ws('{self.sep}', ...)"

@@ -325,3 +325,19 @@ def atan2(a, b):
 def hash(*cols):  # noqa: A001 - pyspark naming
     from .expr.hash_expr import Murmur3Hash
     return Murmur3Hash([_to_expr(c) for c in cols])
+
+
+def lpad(e, length, pad=" "):
+    return _se.Pad(_to_expr(e), length, pad, left=True)
+
+
+def rpad(e, length, pad=" "):
+    return _se.Pad(_to_expr(e), length, pad, left=False)
+
+
+def repeat(e, n):
+    return _se.Repeat(_to_expr(e), n)
+
+
+def concat_ws(sep, *es):
+    return _se.ConcatWs(sep, *[_to_expr(e) for e in es])

@@ -24,7 +24,7 @@ from .kernel_utils import CV
 __all__ = ["byte_row_map", "str_len_bytes", "str_len_chars", "upper",
            "lower", "substring", "concat_strings", "compare", "contains",
            "startswith", "endswith", "rebuild_strings", "trim", "reverse",
-           "find_first"]
+           "find_first", "pad", "repeat_str", "literal_column"]
 
 
 def byte_row_map(offsets, dcap: int):
@@ -73,9 +73,12 @@ def lower(cv: CV) -> CV:
 
 
 def rebuild_strings(cv: CV, new_starts, new_lens,
-                    out_data_capacity: Optional[int] = None) -> CV:
+                    out_data_capacity: Optional[int] = None,
+                    wrap=None) -> CV:
     """Build a new string column where row i is the byte range
-    [new_starts[i], new_starts[i]+new_lens[i]) of cv.data."""
+    [new_starts[i], new_starts[i]+new_lens[i]) of cv.data. With `wrap`
+    (per-row period), source bytes repeat cyclically every wrap[i] bytes
+    (the repeat() kernel)."""
     n = new_lens.shape[0]
     new_lens = jnp.maximum(new_lens, 0)
     new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
@@ -84,7 +87,10 @@ def rebuild_strings(cv: CV, new_starts, new_lens,
     pos = jnp.arange(out_cap, dtype=jnp.int32)
     row = jnp.clip(jnp.searchsorted(new_off[1:], pos, side="right"),
                    0, n - 1).astype(jnp.int32)
-    src = new_starts[row] + (pos - new_off[row])
+    rel = pos - new_off[row]
+    if wrap is not None:
+        rel = rel % jnp.maximum(wrap[row], 1)
+    src = new_starts[row] + rel
     src = jnp.clip(src, 0, cv.data.shape[0] - 1)
     data = cv.data[src]
     total = new_off[n]
@@ -264,3 +270,72 @@ def find_first(cv: CV, pattern: bytes):
     return jnp.where(first < 2**30, first + 1, 0).astype(jnp.int32)
 
 
+
+
+def pad(cv: CV, target_len: int, pad_bytes: bytes, left: bool) -> CV:
+    """lpad/rpad to target_len BYTES with a cyclic literal pad; rows
+    longer than target are truncated to it. Byte-based (exact for ASCII;
+    documented deviation in docs/compatibility.md — Spark counts chars).
+    Spark edge semantics honored: negative target -> empty strings; empty
+    pad -> truncate only, never extend."""
+    import numpy as np
+    target_len = max(int(target_len), 0)
+    lens = str_len_bytes(cv)
+    n = lens.shape[0]
+    if len(pad_bytes) == 0:
+        # Spark: empty pad never extends; rows only truncate to target
+        return rebuild_strings(cv, cv.offsets[:-1],
+                               jnp.minimum(lens, target_len)
+                               .astype(jnp.int32))
+    new_off = jnp.arange(n + 1, dtype=jnp.int32) * target_len
+    out_cap = max(int(n * target_len), 1)
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.clip(pos // max(target_len, 1), 0, n - 1)
+    rel = pos - row * target_len
+    cur = jnp.minimum(lens, target_len)
+    padlen = max(len(pad_bytes), 1)
+    pad_arr = jnp.asarray(np.frombuffer(
+        pad_bytes if pad_bytes else b"\0", np.uint8))
+    if left:
+        npad = target_len - cur
+        from_pad = rel < npad[row]
+        src_data = cv.offsets[row] + (rel - npad[row])
+        pad_idx = rel % padlen
+    else:
+        from_pad = rel >= cur[row]
+        src_data = cv.offsets[row] + rel
+        pad_idx = (rel - cur[row]) % padlen
+    src_data = jnp.clip(src_data, 0, cv.data.shape[0] - 1)
+    out = jnp.where(from_pad, pad_arr[jnp.clip(pad_idx, 0, padlen - 1)],
+                    cv.data[src_data]).astype(jnp.uint8)
+    return CV(out, cv.validity, new_off)
+
+
+def repeat_str(cv: CV, times: int, out_data_capacity: int) -> CV:
+    """Repeat each row `times` times (Spark repeat; times<=0 -> empty)."""
+    times = max(times, 0)
+    lens = str_len_bytes(cv)
+    return rebuild_strings(cv, cv.offsets[:-1],
+                           (lens * times).astype(jnp.int32),
+                           out_data_capacity, wrap=lens)
+
+
+def literal_column(raw: bytes, present, capacity: int) -> CV:
+    """String CV holding `raw` where `present` is True, '' elsewhere
+    (always valid) — the concat_ws separator builder."""
+    import numpy as np
+    n = present.shape[0]
+    nb = max(len(raw), 1)
+    lens = jnp.where(present, len(raw), 0).astype(jnp.int32)
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    out_cap = max(capacity, 1)
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.clip(jnp.searchsorted(new_off[1:], pos, side="right"),
+                   0, n - 1).astype(jnp.int32)
+    rel = pos - new_off[row]
+    src = jnp.asarray(np.frombuffer(raw.ljust(nb, b"\0"), np.uint8))
+    data = src[jnp.clip(rel, 0, nb - 1)]
+    total = new_off[n]
+    data = jnp.where(pos < total, data, 0).astype(jnp.uint8)
+    return CV(data, jnp.ones(n, jnp.bool_), new_off)

@@ -81,3 +81,29 @@ def test_trim_unbounded_and_short_shift(session):
     assert out.column(0).to_pylist() == ["x", ""]
     # smallint promotes to int: 1 << 17 = 131072 (Spark semantics)
     assert out.column(1).to_pylist() == [131072, 262144]
+
+
+def test_pad_repeat_concat_ws(session):
+    df = session.create_dataframe({"a": ["hi", "xyz", None, ""],
+                                   "b": ["1", None, "2", "3"]})
+    out = df.select(F.lpad(col("a"), 5, "*").alias("lp"),
+                    F.rpad(col("a"), 4, "-").alias("rp"),
+                    F.repeat(col("a"), 3).alias("r3"),
+                    F.concat_ws(",", col("a"), col("b")).alias("cw"))
+    got = out.to_arrow().to_pydict()
+    assert got["lp"] == ["***hi", "**xyz", None, "*****"]
+    assert got["rp"] == ["hi--", "xyz-", None, "----"]
+    assert got["r3"] == ["hihihi", "xyzxyzxyz", None, ""]
+    # concat_ws skips nulls (Spark semantics)
+    assert got["cw"] == ["hi,1", "xyz", "2", ",3"]
+
+
+def test_pad_edge_cases(session):
+    df = session.create_dataframe({"a": ["hi", "abcdef"]})
+    out = df.select(F.lpad(col("a"), -1, "*").alias("neg"),
+                    F.lpad(col("a"), 5, "").alias("emptypad"),
+                    F.lpad(col("a"), 7, "ab").alias("multi")).to_arrow()
+    got = out.to_pydict()
+    assert got["neg"] == ["", ""]
+    assert got["emptypad"] == ["hi", "abcde"]
+    assert got["multi"] == ["ababahi", "aabcdef"]
