@@ -1,0 +1,88 @@
+"""Batch coalescing (reference: GpuCoalesceBatches.scala:899 + the
+CoalesceGoal protocol). Small batches — many-small-files scans, post-shuffle
+shards — concatenate on device toward a target row count before flowing
+into sort/agg/join, amortizing per-batch dispatch and padding waste.
+Batches already at or above half the target pass through untouched; filter
+row-masks are compacted away during the concat (the one place the lazy-mask
+design materializes)."""
+from __future__ import annotations
+
+from typing import List
+
+import jax.numpy as jnp
+
+from ..columnar.column import bucket_capacity
+from ..columnar.table import Schema
+from ..ops.concat import concat_cvs, concat_masks, pad_cv, pad_mask
+from ..ops.gather import compact
+from ..utils.transfer import fetch_int
+from .base import ExecContext, TpuExec
+from .batch import DeviceBatch
+from .nodes import make_table
+
+__all__ = ["CoalesceBatchesExec"]
+
+
+class CoalesceBatchesExec(TpuExec):
+    def __init__(self, child: TpuExec, target_rows: int, fan_in: int = 1):
+        """fan_in: how many child partitions each output partition drains
+        (merging across small files needs cross-partition coalescing)."""
+        super().__init__([child], child.schema)
+        self.target = target_rows
+        self.fan_in = max(1, fan_in)
+
+    def describe(self):
+        return (f"CoalesceBatchesExec[target={self.target}, "
+                f"fanIn={self.fan_in}]")
+
+    def num_partitions(self, ctx):
+        n = self.children[0].num_partitions(ctx)
+        return max(1, -(-n // self.fan_in))
+
+    def _flush(self, ctx: ExecContext, pending: List[DeviceBatch]):
+        if not pending:
+            return None
+        m = ctx.metrics_for(self._op_id)
+        if len(pending) == 1:
+            return pending[0]
+        with m.timer("concatTime"):
+            ncols = len(pending[0].table.columns)
+            cvs = [concat_cvs([b.cvs()[i] for b in pending],
+                              self.schema.fields[i].dtype)
+                   for i in range(ncols)]
+            mask = concat_masks([b.row_mask for b in pending])
+            # pad to a power-of-two capacity BEFORE compacting so output
+            # shapes stay bucketed (bounds XLA recompilation)
+            cap = bucket_capacity(mask.shape[0])
+            cvs = [pad_cv(cv, cap) for cv in cvs]
+            mask = pad_mask(mask, cap)
+            out_cvs, count = compact(cvs, mask)
+            m.add("numConcats", 1)
+        n = fetch_int(count)
+        return DeviceBatch(make_table(self.schema, out_cvs, n), n,
+                           jnp.arange(cap) < n, cap)
+
+    def _child_batches(self, ctx, pid):
+        child = self.children[0]
+        n = child.num_partitions(ctx)
+        for cpid in range(pid * self.fan_in,
+                          min((pid + 1) * self.fan_in, n)):
+            yield from child.execute_partition(ctx, cpid)
+
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        pending: List[DeviceBatch] = []
+        pending_rows = 0
+        for batch in self._child_batches(ctx, pid):
+            if batch.num_rows >= self.target // 2 and not pending:
+                yield batch  # already big enough: pass through untouched
+                continue
+            pending.append(batch)
+            pending_rows += batch.num_rows
+            if pending_rows >= self.target:
+                out = self._flush(ctx, pending)
+                if out is not None:
+                    yield out
+                pending, pending_rows = [], 0
+        out = self._flush(ctx, pending)
+        if out is not None:
+            yield out

@@ -76,8 +76,26 @@ def _cached(meta, conv, conf):
 
 @_rule(L.ParquetScan)
 def _pq(meta, conv, conf):
+    from ..config import BATCH_SIZE_ROWS
+    from ..exec.coalesce import CoalesceBatchesExec
     n = meta.node
-    return x.ParquetScanExec(n.paths, n.schema, n.columns)
+    scan = x.ParquetScanExec(n.paths, n.schema, n.columns)
+    if len(n.paths) > 1:
+        # many-small-files: coalesce toward the batch target
+        # (GpuCoalesceBatches after scans, GpuTransitionOverrides.scala:77);
+        # fan-in sized from the first file's row count (footer metadata)
+        import pyarrow.parquet as pq
+        try:
+            counts = [pq.ParquetFile(p).metadata.num_rows
+                      for p in n.paths]
+            avg = sum(counts) // max(len(counts), 1)
+        except Exception:
+            avg = 0
+        target = conf.get(BATCH_SIZE_ROWS)
+        if 0 < avg < target // 2:
+            fan_in = min(max(1, target // max(avg, 1)), len(n.paths))
+            return CoalesceBatchesExec(scan, target, fan_in)
+    return scan
 
 
 @_rule(L.Project)

@@ -56,3 +56,21 @@ def test_json_read(session, tmp_path):
         f.write('{"a": 1, "s": "x"}\n{"a": 2, "s": null}\n{"a": null, "s": "z"}\n')
     df = session.read.json(p)
     assert_rows_equal(df.to_arrow(), [(1, "x"), (2, None), (None, "z")])
+
+
+def test_many_small_files_coalesce(session, tmp_path):
+    import spark_rapids_tpu as st
+    at = gen_arrow_table([("a", IntegerGen(nullable=False)),
+                          ("s", StringGen(max_len=6))], n=900, seed=84)
+    for i in range(9):
+        pq.write_table(at.slice(i * 100, 100), tmp_path / f"s{i}.parquet")
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 400})
+    df = s.read.parquet(str(tmp_path))
+    out = df.to_arrow()
+    assert_rows_equal(out, list(zip(at.column(0).to_pylist(),
+                                    at.column(1).to_pylist())))
+    q = df.filter(F.col("a").isNotNull())
+    q.to_arrow()
+    ms = q.last_metrics()
+    # 9 batches of 100 rows coalesced into ~3 concats of >=400 rows
+    assert any(v.get("numConcats", 0) >= 1 for v in ms.values())
