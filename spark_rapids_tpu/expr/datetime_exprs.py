@@ -11,7 +11,7 @@ from .expressions import (Expression, UnsupportedExpr, _BinaryOp, _UnaryOp,
 
 __all__ = ["Year", "Month", "DayOfMonth", "DayOfWeek", "DayOfYear",
            "Quarter", "Hour", "Minute", "Second", "DateAdd", "DateSub",
-           "DateDiff", "LastDay", "ToDate"]
+           "DateDiff", "LastDay", "ToDate", "ToTimestamp"]
 
 
 class _DateField(_UnaryOp):
@@ -138,15 +138,36 @@ class LastDay(_UnaryOp):
 class ToDate(_UnaryOp):
     def _resolve_type(self):
         ct = self.child.dtype
-        if isinstance(ct, dt.DateType):
-            self.dtype = dt.DATE
-        elif isinstance(ct, dt.TimestampType):
+        if isinstance(ct, (dt.DateType, dt.TimestampType, dt.StringType)):
             self.dtype = dt.DATE
         else:
-            raise UnsupportedExpr("to_date(string) lands with date parsing")
+            raise UnsupportedExpr(f"to_date({ct})")
 
     def emit(self, ctx):
         cv = self.child.emit(ctx)
         if isinstance(self.child.dtype, dt.TimestampType):
             return CV(ops_dt.micros_to_days(cv.data), cv.validity)
+        if isinstance(self.child.dtype, dt.StringType):
+            from ..ops.cast_strings import string_to_date
+            return string_to_date(cv)
         return cv
+
+
+class ToTimestamp(_UnaryOp):
+    def _resolve_type(self):
+        ct = self.child.dtype
+        if isinstance(ct, (dt.TimestampType, dt.DateType, dt.StringType)):
+            self.dtype = dt.TIMESTAMP
+        else:
+            raise UnsupportedExpr(f"to_timestamp({ct})")
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        ct = self.child.dtype
+        if isinstance(ct, dt.TimestampType):
+            return cv
+        if isinstance(ct, dt.DateType):
+            return CV(cv.data.astype(jnp.int64) * ops_dt.MICROS_PER_DAY,
+                      cv.validity)
+        from ..ops.cast_strings import string_to_timestamp
+        return string_to_timestamp(cv)

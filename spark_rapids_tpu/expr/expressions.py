@@ -726,7 +726,9 @@ class Cast(Expression):
         from_t = b.child.dtype
         str_src_ok = (isinstance(from_t, dt.StringType)
                       and (self.to.is_numeric
-                           or isinstance(self.to, dt.BooleanType)))
+                           or isinstance(self.to, (dt.BooleanType,
+                                                   dt.DateType,
+                                                   dt.TimestampType))))
         str_dst_ok = (isinstance(self.to, dt.StringType)
                       and (from_t.is_integral
                            or isinstance(from_t, (dt.BooleanType,
@@ -760,6 +762,10 @@ class Cast(Expression):
                 return CV(out.data.astype(self.to.np_dtype), out.validity)
             if isinstance(self.to, dt.BooleanType):
                 return cs.string_to_bool(cv)
+            if isinstance(self.to, dt.DateType):
+                return cs.string_to_date(cv)
+            if isinstance(self.to, dt.TimestampType):
+                return cs.string_to_timestamp(cv)
             if isinstance(self.to, dt.DecimalType):
                 f = cs.string_to_float(cv)
                 return cast_ops.cast_cv(f, dt.FLOAT64, self.to)

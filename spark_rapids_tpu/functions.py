@@ -25,7 +25,7 @@ __all__ = [
     "udf",
     "year", "month", "dayofmonth", "dayofweek", "dayofyear", "quarter",
     "hour", "minute", "second", "date_add", "date_sub", "datediff",
-    "last_day", "to_date",
+    "last_day", "to_date", "to_timestamp",
 ]
 
 
@@ -256,6 +256,10 @@ def last_day(e):
 
 def to_date(e):
     return _de.ToDate(_to_expr(e))
+
+
+def to_timestamp(e):
+    return _de.ToTimestamp(_to_expr(e))
 
 
 def trim(e):

@@ -22,7 +22,7 @@ from .strings import str_len_bytes
 
 __all__ = ["string_to_int", "string_to_float", "string_to_bool",
            "int_to_string", "bool_to_string", "decimal_to_string",
-           "date_to_string"]
+           "date_to_string", "string_to_date", "string_to_timestamp"]
 
 _MAX_DIGITS = 19
 
@@ -351,3 +351,71 @@ def date_to_string(cv: CV, out_capacity: Optional[int] = None) -> CV:
     lens = jnp.full(n, 10, jnp.int32)
     return _emit_from_staging(staging, lens,
                               out_capacity or max(n * 10, 128), cv.validity)
+
+
+def _digits_at(cv: CV, tstart, tlen, pos: int, width: int):
+    """Parse `width` digits at byte offset `pos` of each trimmed row.
+    Returns (value, ok)."""
+    dcap = cv.data.shape[0]
+    n = tlen.shape[0]
+    val = jnp.zeros(n, jnp.int32)
+    ok = jnp.ones(n, jnp.bool_)
+    for k in range(width):
+        idx = jnp.clip(tstart + pos + k, 0, dcap - 1)
+        b = jnp.where(pos + k < tlen, cv.data[idx].astype(jnp.int32), -1)
+        is_d = (b >= 48) & (b <= 57)
+        ok = ok & is_d
+        val = val * 10 + jnp.where(is_d, b - 48, 0)
+    return val, ok
+
+
+def _char_at(cv: CV, tstart, tlen, pos: int):
+    dcap = cv.data.shape[0]
+    idx = jnp.clip(tstart + pos, 0, dcap - 1)
+    return jnp.where(pos < tlen, cv.data[idx].astype(jnp.int32), -1)
+
+
+def string_to_date(cv: CV) -> CV:
+    """Parse 'YYYY-MM-DD' (Spark default date format; other layouts ->
+    null round-1, docs/compatibility.md)."""
+    from .datetime import days_from_civil, days_in_month
+    tstart, tlen = _trim_bounds(cv)
+    y, oky = _digits_at(cv, tstart, tlen, 0, 4)
+    m, okm = _digits_at(cv, tstart, tlen, 5, 2)
+    d, okd = _digits_at(cv, tstart, tlen, 8, 2)
+    dashes = (_char_at(cv, tstart, tlen, 4) == 45) &         (_char_at(cv, tstart, tlen, 7) == 45)
+    ok = (oky & okm & okd & dashes & (tlen == 10)
+          & (m >= 1) & (m <= 12) & (d >= 1))
+    ok = ok & (d <= days_in_month(y, m))
+    days = days_from_civil(y, m, d)
+    return CV(jnp.where(ok, days, 0).astype(jnp.int32), cv.validity & ok)
+
+
+def string_to_timestamp(cv: CV) -> CV:
+    """Parse 'YYYY-MM-DD[ HH:MM:SS]' as UTC micros (bare dates ->
+    midnight; fractional seconds / timezones -> null round-1)."""
+    from .datetime import days_from_civil, days_in_month
+    tstart, tlen = _trim_bounds(cv)
+    y, oky = _digits_at(cv, tstart, tlen, 0, 4)
+    m, okm = _digits_at(cv, tstart, tlen, 5, 2)
+    d, okd = _digits_at(cv, tstart, tlen, 8, 2)
+    dashes = (_char_at(cv, tstart, tlen, 4) == 45) &         (_char_at(cv, tstart, tlen, 7) == 45)
+    date_ok = (oky & okm & okd & dashes & (m >= 1) & (m <= 12)
+               & (d >= 1) & (d <= days_in_month(y, m)))
+    hh, okh = _digits_at(cv, tstart, tlen, 11, 2)
+    mi, okmi = _digits_at(cv, tstart, tlen, 14, 2)
+    ss, oks = _digits_at(cv, tstart, tlen, 17, 2)
+    seps = ((_char_at(cv, tstart, tlen, 10) == 32)
+            | (_char_at(cv, tstart, tlen, 10) == 84))  # ' ' or 'T'
+    colons = (_char_at(cv, tstart, tlen, 13) == 58) &         (_char_at(cv, tstart, tlen, 16) == 58)
+    time_ok = (okh & okmi & oks & seps & colons & (hh < 24) & (mi < 60)
+               & (ss < 60) & (tlen == 19))
+    bare_date = tlen == 10
+    ok = date_ok & (bare_date | time_ok)
+    from .datetime import MICROS_PER_DAY, MICROS_PER_SEC
+    days = days_from_civil(y, m, d).astype(jnp.int64)
+    tod = jnp.where(bare_date, 0,
+                    (hh.astype(jnp.int64) * 3600 + mi * 60 + ss)
+                    * MICROS_PER_SEC)
+    micros = days * MICROS_PER_DAY + tod
+    return CV(jnp.where(ok, micros, 0), cv.validity & ok)

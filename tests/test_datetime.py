@@ -63,3 +63,36 @@ def test_date_arithmetic(session):
         z = 0 if d is not None else None
         exp.append((a, s, z))
     assert_rows_equal(out, exp, ignore_order=False)
+
+
+def test_to_date_to_timestamp_from_strings(session):
+    import datetime as dtmod
+    df = session.create_dataframe({"s": [
+        "2024-02-29", " 1999-12-31 ", "2024-13-01", "2023-02-29",
+        "not a date", None, "2024-1-1"]})
+    out = df.select(F.to_date(F.col("s")).alias("d")).to_arrow()
+    assert out.column(0).to_pylist() == [
+        dtmod.date(2024, 2, 29), dtmod.date(1999, 12, 31), None, None,
+        None, None, None]
+    df2 = session.create_dataframe({"s": [
+        "2024-06-15 13:45:30", "2024-06-15T00:00:00", "2024-06-15",
+        "2024-06-15 25:00:00", None]})
+    out2 = df2.select(F.to_timestamp(F.col("s")).alias("t")).to_arrow()
+    got = out2.column(0).to_pylist()
+    tz = dtmod.timezone.utc
+    assert got[0] == dtmod.datetime(2024, 6, 15, 13, 45, 30, tzinfo=tz)
+    assert got[1] == dtmod.datetime(2024, 6, 15, 0, 0, 0, tzinfo=tz)
+    assert got[2] == dtmod.datetime(2024, 6, 15, 0, 0, 0, tzinfo=tz)
+    assert got[3] is None and got[4] is None
+
+
+def test_cast_string_to_date_timestamp(session):
+    import datetime as dtmod
+    from spark_rapids_tpu.columnar import dtypes as dt
+    df = session.create_dataframe({"s": ["2021-07-04", "nope", None]})
+    out = df.select(F.col("s").cast(dt.DATE).alias("d"),
+                    F.col("s").cast(dt.TIMESTAMP).alias("t")).to_arrow()
+    assert out.column(0).to_pylist() == [dtmod.date(2021, 7, 4), None,
+                                         None]
+    assert out.column(1).to_pylist()[0] == dtmod.datetime(
+        2021, 7, 4, tzinfo=dtmod.timezone.utc)
