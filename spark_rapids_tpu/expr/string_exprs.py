@@ -143,11 +143,13 @@ class EndsWith(_LiteralPatternPredicate):
     kernel = staticmethod(ops_str.endswith)
 
 
+_WILD = ord("_")
+
+
 class Like(Expression):
-    """SQL LIKE with a literal pattern. Round-1 supports patterns made of
-    literal runs separated by % (no _ wildcard): 'abc', 'abc%', '%abc',
-    '%a%b%', 'a%b'. (Full regex arrives with the transpiler — reference:
-    RegexParser.scala.)"""
+    """SQL LIKE with a literal pattern: runs of literals/_ separated by %.
+    `_` matches exactly one byte. Escapes land with the regex transpiler
+    (reference: RegexParser.scala)."""
 
     def __init__(self, child: Expression, pattern: str):
         self.child = child
@@ -157,8 +159,8 @@ class Like(Expression):
     def bind(self, schema):
         c = self.child.bind(schema)
         _require_string(c, "like")
-        if "_" in self.pattern or "\\" in self.pattern:
-            raise UnsupportedExpr("LIKE _ / escapes land with the regex "
+        if "\\" in self.pattern:
+            raise UnsupportedExpr("LIKE escapes land with the regex "
                                   "transpiler")
         b = Like(c, self.pattern)
         b.dtype = dt.BOOL
@@ -170,8 +172,9 @@ class Like(Expression):
         lens0 = ops_str.str_len_bytes(cv)
         if "%" not in pat:
             raw = pat.encode()
-            ok = (lens0 == len(raw)) & (ops_str.startswith(cv, raw)
-                                        if raw else (lens0 == 0))
+            ok = (lens0 == len(raw)) & (
+                ops_str.startswith(cv, raw, _WILD) if raw
+                else (lens0 == 0))
             return CV(ok, cv.validity)
         parts = [p.encode() for p in pat.split("%")]
         lead = not pat.startswith("%")
@@ -186,15 +189,18 @@ class Like(Expression):
         # prefix and suffix, so lead/trail consume distinct runs
         middle = list(inner)
         if lead:
-            ok = ok & ops_str.startswith(cv, parts[0])
+            ok = ok & ops_str.startswith(cv, parts[0], _WILD)
             middle = middle[1:]
         if trail:
-            ok = ok & ops_str.endswith(cv, parts[-1])
+            ok = ok & ops_str.endswith(cv, parts[-1], _WILD)
             middle = middle[:-1]
-        # middle runs must appear; containment check (may over-match for
-        # repeated runs — documented in docs/compatibility.md)
+        # middle runs must appear BETWEEN the consumed prefix/suffix;
+        # multiple middle runs are containment-checked, which can
+        # over-match when they overlap (docs/compatibility.md)
+        skip_pre = len(parts[0]) if lead else 0
+        skip_suf = len(parts[-1]) if trail else 0
         for p in middle:
-            ok = ok & ops_str.contains(cv, p)
+            ok = ok & ops_str.contains(cv, p, _WILD, skip_pre, skip_suf)
         return CV(ok, cv.validity)
 
     def __repr__(self):

@@ -175,9 +175,9 @@ def compare(a: CV, b: CV):
     return jnp.where(has_diff, cmp_diff, cmp_len).astype(jnp.int8)
 
 
-def _find_literal(cv: CV, pattern: bytes):
+def _find_literal(cv: CV, pattern: bytes, wildcard=None):
     """bool per byte position: pattern matches starting here (within the
-    row)."""
+    row). Bytes equal to `wildcard` (e.g. ord('_')) match anything."""
     dcap = cv.data.shape[0]
     row = byte_row_map(cv.offsets, dcap)
     pos = jnp.arange(dcap, dtype=jnp.int32)
@@ -186,33 +186,43 @@ def _find_literal(cv: CV, pattern: bytes):
     m = len(pattern)
     ok = (rel >= 0) & (rel + m <= lens[row])
     for j, pb in enumerate(pattern):
+        if wildcard is not None and pb == wildcard:
+            continue
         idx = jnp.clip(pos + j, 0, dcap - 1)
         ok = ok & (cv.data[idx] == pb)
     return ok, row, rel, lens
 
 
-def contains(cv: CV, pattern: bytes):
+def contains(cv: CV, pattern: bytes, wildcard=None,
+             skip_prefix: int = 0, skip_suffix: int = 0):
+    """True per row when pattern occurs within
+    [skip_prefix, len-skip_suffix) — the bounds let LIKE exclude the
+    bytes already consumed by its prefix/suffix runs."""
     n = cv.offsets.shape[0] - 1
     if len(pattern) == 0:
         return jnp.ones(n, jnp.bool_)
-    ok, row, rel, lens = _find_literal(cv, pattern)
+    ok, row, rel, lens = _find_literal(cv, pattern, wildcard)
+    if skip_prefix:
+        ok = ok & (rel >= skip_prefix)
+    if skip_suffix:
+        ok = ok & (rel + len(pattern) <= lens[row] - skip_suffix)
     return jax.ops.segment_max(ok.astype(jnp.int32), row, n) > 0
 
 
-def startswith(cv: CV, pattern: bytes):
+def startswith(cv: CV, pattern: bytes, wildcard=None):
     n = cv.offsets.shape[0] - 1
     if len(pattern) == 0:
         return jnp.ones(n, jnp.bool_)
-    ok, row, rel, lens = _find_literal(cv, pattern)
+    ok, row, rel, lens = _find_literal(cv, pattern, wildcard)
     at0 = ok & (rel == 0)
     return jax.ops.segment_max(at0.astype(jnp.int32), row, n) > 0
 
 
-def endswith(cv: CV, pattern: bytes):
+def endswith(cv: CV, pattern: bytes, wildcard=None):
     n = cv.offsets.shape[0] - 1
     if len(pattern) == 0:
         return jnp.ones(n, jnp.bool_)
-    ok, row, rel, lens = _find_literal(cv, pattern)
+    ok, row, rel, lens = _find_literal(cv, pattern, wildcard)
     at_end = ok & (rel == lens[row] - len(pattern))
     return jax.ops.segment_max(at_end.astype(jnp.int32), row, n) > 0
 

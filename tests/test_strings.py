@@ -208,3 +208,22 @@ def test_window_via_with_column(session):
         "rn", row_number().over(Window.partition_by("k").order_by("v")))
     got = sorted(out.collect())
     assert got == [(1, 3, 1), (1, 5, 2), (2, 9, 1)]
+
+
+def test_like_underscore(session):
+    df = session.create_dataframe({"s": ["cat", "cut", "ct", "cart",
+                                         "scatter", None]})
+    out = df.select(col("s").like("c_t").alias("a"),
+                    col("s").like("c_t%").alias("b"),
+                    col("s").like("%c_t%").alias("c")).to_arrow()
+    got = out.to_pydict()
+    assert got["a"] == [True, True, False, False, False, None]
+    assert got["b"] == [True, True, False, False, False, None]
+    assert got["c"] == [True, True, False, False, True, None]
+
+
+def test_like_middle_run_not_in_prefix(session):
+    df = session.create_dataframe({"s": ["abQQcd", "abXbYcd"]})
+    out = df.select(col("s").like("ab%_b%cd").alias("m")).to_arrow()
+    # '_b' must occur BETWEEN the 'ab' prefix and 'cd' suffix
+    assert out.column(0).to_pylist() == [False, True]
