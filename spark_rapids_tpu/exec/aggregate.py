@@ -163,6 +163,9 @@ class HashAggregateExec(TpuExec):
             if (a.child is not None and a.child.dtype.is_variable_width
                     and type(a).__name__ not in ("Count",)):
                 raise UnsupportedExpr(f"{a!r} over variable-width input")
+            # First/Last keep batch order only because concat order IS the
+            # stable-sort tiebreak; nothing extra needed here
+
         self._update_cache = {}
         self._merge_cache = {}
         self._finalize_jit = jax.jit(self._finalize_fn)

@@ -268,9 +268,26 @@ class Avg(AggExpr):
                                     num_segments))
 
 
+def _seg_extreme_pos(eligible, seg_ids, num_segments, take_first: bool):
+    """Per-segment position of the first/last eligible row ->
+    (safe_index, found). Shared by _FirstLast update/merge paths."""
+    n = eligible.shape[0]
+    idxs = jnp.arange(n)
+    sentinel = n if take_first else -1
+    cand = jnp.where(eligible, idxs, sentinel)
+    seg = jax.ops.segment_min if take_first else jax.ops.segment_max
+    pos = seg(cand, seg_ids, num_segments)
+    found = (pos < n) if take_first else (pos >= 0)
+    return jnp.clip(pos, 0, n - 1), found
+
+
 class _FirstLast(AggExpr):
+    """State (value, valid, has): `has` marks whether an eligible row was
+    seen. Grouped merge picks the first/last eligible partial in concat
+    order (the stable key sort preserves it) via g_merge_custom."""
+
     take_first = True
-    state_reducers = None  # grouped merge unsupported round-1
+    state_reducers = ("custom",)
 
     def __init__(self, child, ignore_nulls: bool = False):
         super().__init__(child)
@@ -308,18 +325,22 @@ class _FirstLast(AggExpr):
     def finalize(self, s):
         return s[0], s[1]
 
+    def num_state_cols(self):
+        return 3
+
     def g_update(self, cv, mask, seg_ids, num_segments):
         m = mask & (cv.validity if self.ignore_nulls else
                     jnp.ones_like(cv.validity))
-        n = m.shape[0]
-        idxs = jnp.arange(n)
-        sentinel = n if self.take_first else -1
-        cand = jnp.where(m, idxs, sentinel)
-        seg = jax.ops.segment_min if self.take_first else jax.ops.segment_max
-        pos = seg(cand, seg_ids, num_segments)
-        has = (pos < n) if self.take_first else (pos >= 0)
-        safe = jnp.clip(pos, 0, n - 1)
+        safe, has = _seg_extreme_pos(m, seg_ids, num_segments,
+                                     self.take_first)
         return (cv.data[safe], cv.validity[safe] & has, has)
+
+    def g_merge_custom(self, cols_sorted, live, seg_ids, num_segments):
+        val, valid, has = cols_sorted
+        eligible = live & has.astype(jnp.bool_)
+        safe, found = _seg_extreme_pos(eligible, seg_ids, num_segments,
+                                       self.take_first)
+        return (val[safe], valid[safe].astype(jnp.bool_) & found, found)
 
 
 class First(_FirstLast):

@@ -179,3 +179,25 @@ def test_variance_no_catastrophic_cancellation(session):
     for k, vr in zip(out.column(0).to_pylist(), out.column(1).to_pylist()):
         gvals = [v for i, v in enumerate(vals) if i % 3 == k]
         assert abs(vr - statistics.variance(gvals)) < 1e-6
+
+
+def test_grouped_first_last(session):
+    import spark_rapids_tpu as st
+    import pyarrow as pa
+    # small batches force the merge path across partial states
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 64})
+    n = 500
+    ks = [i % 5 for i in range(n)]
+    vs = [None if i % 7 == 0 else i for i in range(n)]
+    df = s.create_dataframe({"k": pa.array(ks, pa.int32()),
+                             "v": pa.array(vs, pa.int64())})
+    out = df.group_by("k").agg(
+        F.first("v").alias("f"), F.last("v").alias("l"),
+        F.first("v", ignorenulls=True).alias("fn")).to_arrow()
+    got = {k: (f, l, fn) for k, f, l, fn in zip(
+        *[out.column(i).to_pylist() for i in range(4)])}
+    for k in range(5):
+        vals = [v for kk, v in zip(ks, vs) if kk == k]
+        nn = [v for v in vals if v is not None]
+        assert got[k] == (vals[0], vals[-1], nn[0] if nn else None), \
+            (k, got[k])
