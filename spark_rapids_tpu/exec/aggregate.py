@@ -23,7 +23,7 @@ from ..expr.aggregates import AggExpr
 from ..expr.expressions import EmitCtx, Expression, UnsupportedExpr
 from ..ops import sortkeys as sk
 from ..ops.concat import concat_cvs, concat_masks, pad_cv, pad_mask
-from ..ops.gather import take
+from ..ops.gather import take, take_strings
 from ..ops.kernel_utils import CV
 from ..utils.transfer import fetch_int
 from .base import ExecContext, TpuExec
@@ -269,8 +269,8 @@ class HashAggregateExec(TpuExec):
                    for k in self.keys)
 
     def _nchunks_for(self, key_cvs, mask) -> Tuple[int, ...]:
-        """Static string-chunk counts; measures only live+valid rows (the
-        concat of partials leaves phantom junction gaps in offsets)."""
+        """Static string-chunk counts; measures only live+valid rows so
+        dead/padding rows cannot inflate the chunk count."""
         ncs = []
         for kcv, kexpr in zip(key_cvs, self.keys):
             if isinstance(kexpr.dtype, (dt.StringType, dt.BinaryType)):
@@ -371,4 +371,32 @@ class HashAggregateExec(TpuExec):
             fn = jax.jit(self._merge_fn(nchunks))
             self._merge_cache[nchunks] = fn
         ks2, st2, sl2 = fn(ks, st, sl)
-        return (ks2, st2, sl2, sl2.shape[0])
+        return self._compact_partial(ks2, st2, sl2)
+
+    def _compact_partial(self, ks, st, sl):
+        """Shrink a merged partial to a capacity sized by live group count.
+
+        Merge output sorts live rows first, so live segments occupy the
+        prefix [0, nlive); without this, the buffered partial stays at the
+        concatenated input capacity and grows with total input rows even
+        when there are few groups (reference shrinks on merge too:
+        GpuAggregateExec.scala:863-894 repartition buckets)."""
+        cap = sl.shape[0]
+        nlive = fetch_int(jnp.sum(sl.astype(jnp.int32)))
+        new_cap = bucket_capacity(max(nlive, 1))
+        if new_cap >= cap:
+            return (ks, st, sl, cap)
+        idx = jnp.arange(new_cap)
+        in_bounds = idx < nlive
+        ks2 = []
+        for kcv in ks:
+            if kcv.offsets is not None:
+                nbytes = fetch_int(kcv.offsets[nlive])
+                byte_cap = bucket_capacity(max(nbytes, 1))
+                byte_cap = min(byte_cap, kcv.data.shape[0])
+                ks2.append(take_strings(kcv, idx, in_bounds=in_bounds,
+                                        out_data_capacity=byte_cap))
+            else:
+                ks2.append(CV(kcv.data[:new_cap], kcv.validity[:new_cap]))
+        st2 = [s[:new_cap] for s in st]
+        return (ks2, st2, sl[:new_cap], new_cap)

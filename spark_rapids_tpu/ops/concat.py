@@ -1,9 +1,10 @@
 """Device concatenation of column values (cudf `Table.concatenate` analog).
 
-Used by batch coalescing and aggregate merge. String buffers concatenate
-with offset shifting by the full (padded) capacity of the earlier buffer —
-monotonicity is preserved because padding bytes simply become unreferenced
-gaps.
+Used by batch coalescing and aggregate merge. String concatenation rebuilds
+a gap-free byte layout from per-part row lengths: naively shifting raw
+offsets would extend each part's final row into that part's padding bytes
+whenever the part is exactly full (offsets[-1] < data capacity cannot be
+assumed), corrupting the row with trailing NULs.
 """
 from __future__ import annotations
 
@@ -24,15 +25,15 @@ def concat_cvs(parts: Sequence[CV], dtype: dt.DataType) -> CV:
     valid = jnp.concatenate([p.validity for p in parts])
     if parts[0].offsets is None:
         return CV(data, valid)
-    offs = []
+    from .strings import rebuild_strings
+    starts, lens = [], []
     shift = 0
-    for i, p in enumerate(parts):
-        o = p.offsets + shift
-        if i < len(parts) - 1:
-            o = o[:-1]
-        offs.append(o)
+    for p in parts:
+        starts.append((p.offsets[:-1] + shift).astype(jnp.int32))
+        lens.append((p.offsets[1:] - p.offsets[:-1]).astype(jnp.int32))
         shift += p.data.shape[0]
-    return CV(data, valid, jnp.concatenate(offs))
+    return rebuild_strings(CV(data, valid),
+                           jnp.concatenate(starts), jnp.concatenate(lens))
 
 
 def concat_masks(masks: Sequence) -> jnp.ndarray:

@@ -101,30 +101,38 @@ def murmur3_cv(cv: CV, dtype: dt.DataType, seed):
 
 
 def _hash_string(cv: CV, seed):
-    """Spark hashUnsafeBytes: process 4-byte little-endian words, then
-    remaining bytes one at a time (each as a 4-byte block in cuDF/Spark's
-    murmur3 spec for bytes: Spark uses hashUnsafeBytes2 lanes). Implemented
-    as a dense loop over the max length (static), masked per row."""
+    """Spark Murmur3_x86_32.hashUnsafeBytes: mix each full 4-byte
+    little-endian word, then each remaining tail byte individually as a
+    sign-extended int (its own mixK1/mixH1 round). Exact for strings up to
+    64 bytes; beyond that a last-word fold keeps common-prefix keys apart
+    (engine-internal, documented in docs/compatibility.md)."""
     n = cv.offsets.shape[0] - 1
     starts = cv.offsets[:-1]
-    lens = cv.offsets[1:] - starts
+    lens = (cv.offsets[1:] - starts).astype(jnp.int32)
     data = cv.data
     dcap = data.shape[0]
-    maxlen_static = dcap  # bounded loop; cheap only for small strings
     # Practical bound: 64 bytes (engine-internal hashing for exchange).
     MAXB = 64
     h1 = seed
     nwords = MAXB // 4
+    nfull = lens // 4
     for w in range(nwords):
         base = starts + 4 * w
         word = jnp.zeros(n, jnp.int32)
         for b in range(4):
             idx = jnp.clip(base + b, 0, dcap - 1)
-            inb = (4 * w + b) < lens
-            byte = jnp.where(inb, data[idx], 0).astype(jnp.int32)
-            word = word | (byte << (8 * b))
-        has_word = (4 * w) < lens
-        h1 = jnp.where(has_word, _mix_h1(h1, _mix_k1(word)), h1)
+            word = word | (data[idx].astype(jnp.int32) << (8 * b))
+        h1 = jnp.where(w < nfull, _mix_h1(h1, _mix_k1(word)), h1)
+    # tail (lens % 4 bytes): one round per byte, sign-extended
+    overlong = lens > MAXB
+    aligned = nfull * 4
+    for t in range(3):
+        pos = aligned + t
+        idx = jnp.clip(starts + pos, 0, dcap - 1)
+        byte = data[idx].astype(jnp.int32)
+        byte = jnp.where(byte >= 128, byte - 256, byte)
+        active = (pos < lens) & (~overlong)
+        h1 = jnp.where(active, _mix_h1(h1, _mix_k1(byte)), h1)
     # beyond the 64-byte prefix, fold in the LAST word so common-prefix
     # keys (URLs, paths) do not collapse into one partition
     tail_base = jnp.maximum(starts, starts + lens - 4)
@@ -134,9 +142,8 @@ def _hash_string(cv: CV, seed):
         inb = b < lens
         byte = jnp.where(inb, data[idx], 0).astype(jnp.int32)
         tail = tail | (byte << (8 * b))
-    overlong = lens > MAXB
     h1 = jnp.where(overlong, _mix_h1(h1, _mix_k1(tail)), h1)
-    return _fmix(h1, lens.astype(jnp.int32))
+    return _fmix(h1, lens)
 
 
 def murmur3_row_hash(cvs, dtypes, seed: int = 42):

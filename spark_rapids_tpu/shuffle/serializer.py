@@ -68,16 +68,32 @@ def read_subbatch(inp: BinaryIO, dtypes, codec=None) -> Optional[HostSubBatch]:
         return None
     (blen,) = struct.unpack("<Q", hdr)
     raw = inp.read(blen)
+    if len(raw) < blen:
+        raise IOError(f"truncated shuffle block: {len(raw)}/{blen} bytes")
     if codec is not None:
         raw = codec.decompress(raw)
     buf = memoryview(raw)
+    if len(buf) < 16:
+        raise IOError("corrupt shuffle block: short header")
     magic, n_cols, n_rows = struct.unpack_from("<IIQ", buf, 0)
-    assert magic == _MAGIC, "corrupt shuffle block"
+    if magic != _MAGIC:
+        raise IOError(f"corrupt shuffle block: bad magic {magic:#x}")
+    if n_cols != len(dtypes):
+        raise IOError(f"corrupt shuffle block: {n_cols} columns, "
+                      f"expected {len(dtypes)}")
     pos = 16
     cols = []
     for ci in range(n_cols):
+        if pos + 25 > len(buf):
+            raise IOError("corrupt shuffle block: truncated column header")
         has_off, vb, db, ob = struct.unpack_from("<BQQQ", buf, pos)
         pos += 25
+        if pos + vb + db + (ob if has_off else 0) > len(buf):
+            raise IOError("corrupt shuffle block: buffer lengths exceed "
+                          "block size")
+        if vb * 8 < n_rows:
+            raise IOError("corrupt shuffle block: validity buffer shorter "
+                          f"than {n_rows} rows")
         vbits = np.frombuffer(buf, np.uint8, vb, pos)
         pos += vb
         validity = unpack_validity(vbits, n_rows)
