@@ -140,6 +140,13 @@ SORT_OOC_THRESHOLD = _conf(
 AGG_FORCE_MERGE_PASSES = _conf(
     "sql.agg.forceSinglePassMerge", False,
     "Testing: force aggregate merge in one concat pass.", bool, internal=True)
+MESH_DEVICES = _conf(
+    "mesh.devices", 0,
+    "Number of devices in the SPMD execution mesh. When > 0, hash "
+    "exchanges run as one all_to_all collective over ICI "
+    "(jax.sharding.Mesh) instead of the host file shuffle — the TPU-pod "
+    "analog of the reference's UCX shuffle mode. 0 disables (single-chip "
+    "+ host shuffle).", int)
 
 
 class TpuConf:

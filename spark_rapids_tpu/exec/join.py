@@ -62,24 +62,33 @@ class HashJoinExec(TpuExec):
     def __init__(self, left: TpuExec, right: TpuExec,
                  bound_left_keys: Sequence[Expression],
                  bound_right_keys: Sequence[Expression], how: str,
-                 schema: Schema):
+                 schema: Schema, per_partition: bool = False):
+        """per_partition: both children are hash-partitioned on the join
+        keys (exchanges below us), so each partition joins independently —
+        the distributed shuffled-join topology (reference:
+        GpuShuffledHashJoinExec.scala:167)."""
         super().__init__([left, right], schema)
         self.lkeys = list(bound_left_keys)
         self.rkeys = list(bound_right_keys)
         self.how = how
+        self.per_partition = per_partition
         self._count_cache = {}
         self._expand_cache = {}
 
     def num_partitions(self, ctx):
+        if self.per_partition:
+            return self.children[0].num_partitions(ctx)
         return 1
 
     def describe(self):
-        return f"HashJoinExec[{self.how}]"
+        mode = "distributed" if self.per_partition else "single"
+        return f"HashJoinExec[{self.how}, {mode}]"
 
     # ------------------------------------------------------------------
-    def _collect_side(self, ctx, child, key_exprs):
+    def _collect_side(self, ctx, child, key_exprs, pids=None):
         batches = []
-        for pid in range(child.num_partitions(ctx)):
+        for pid in (pids if pids is not None
+                    else range(child.num_partitions(ctx))):
             batches.extend(child.execute_partition(ctx, pid))
         if not batches:
             cvs = [CV(jnp.zeros(128, f.dtype.np_dtype or jnp.int8),
@@ -224,15 +233,18 @@ class HashJoinExec(TpuExec):
             return
         m = ctx.metrics_for(self._op_id)
         left, right = self.children
+        build_pids = [pid] if self.per_partition else None
         with m.timer("buildTime"):
-            bcvs, bmask = self._collect_side(ctx, right, self.rkeys)
+            bcvs, bmask = self._collect_side(ctx, right, self.rkeys,
+                                             pids=build_pids)
             cap_b = bmask.shape[0]
             bctx = EmitCtx(bcvs, cap_b)
             bkey_cvs = [k.emit(bctx) for k in self.rkeys]
         matched_b_acc = jnp.zeros(cap_b, jnp.bool_)
         nl = len(left.schema.fields)
 
-        for lpid in range(left.num_partitions(ctx)):
+        for lpid in ([pid] if self.per_partition
+                     else range(left.num_partitions(ctx))):
             for batch in left.execute_partition(ctx, lpid):
                 with m.timer("opTime"):
                     scvs, smask = batch.cvs(), batch.row_mask

@@ -1,0 +1,248 @@
+"""Mesh (ICI collective) shuffle exchange.
+
+The multi-chip execution heart: instead of the in-process file shuffle
+(shuffle/local.py — the MULTITHREADED-mode analog), the exchange runs as ONE
+compiled SPMD program over a jax.sharding.Mesh: every shard computes target
+partition ids locally, then `jax.lax.all_to_all` moves row payloads (and
+string bytes) over ICI. Replaces the reference's UCX peer-to-peer transport
+(reference: RapidsShuffleInternalManagerBase.scala:56, shuffle-plugin
+UCXShuffleTransport.scala:49) with XLA collectives — no bounce buffers, no
+tag matching; XLA schedules the transfer.
+
+Downstream operators see one output partition per shard (device), each
+holding exactly the rows whose keys hash to that shard — the same ownership
+contract the hash file-shuffle provides, so per-partition aggregation/join
+run unchanged on top.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.table import Schema
+from ..expr.expressions import EmitCtx, Expression
+from ..ops.concat import concat_cvs, concat_masks, pad_cv, pad_mask
+from ..ops.hash import partition_ids
+from ..ops.kernel_utils import CV
+from .base import ExecContext, TpuExec
+from .batch import DeviceBatch
+from .nodes import make_table
+
+__all__ = ["MeshExchangeExec"]
+
+
+class MeshExchangeExec(TpuExec):
+    """Hash partition exchange over a device mesh (one shard_map program)."""
+
+    def __init__(self, child: TpuExec, n_devices: int,
+                 bound_keys: Sequence[Expression], schema: Schema,
+                 axis_name: str = "data"):
+        super().__init__([child], schema)
+        self.n = n_devices
+        self.keys = list(bound_keys)
+        self.axis_name = axis_name
+        self._mesh = None
+        self._out: Optional[List[Optional[DeviceBatch]]] = None
+        self._lock = threading.RLock()
+        self._jit_cache = {}
+
+    def describe(self):
+        return f"MeshExchangeExec[hash, devices={self.n}]"
+
+    def num_partitions(self, ctx):
+        return self.n
+
+    # ------------------------------------------------------------------
+    def _get_mesh(self):
+        if self._mesh is None:
+            from ..parallel.mesh import make_mesh
+            self._mesh = make_mesh(self.n, self.axis_name)
+        return self._mesh
+
+    def _build_program(self, has_offsets):
+        """shard_map program: emit keys -> pids -> exchange_cvs."""
+        from jax.sharding import PartitionSpec as P
+        from ..parallel.collectives import exchange_cvs
+
+        mesh = self._get_mesh()
+        n = self.n
+        axis = self.axis_name
+        key_dtypes = [k.dtype for k in self.keys]
+
+        def shard_fn(flat, mask):
+            cvs = _unflatten_cvs(flat, has_offsets)
+            cap = mask.shape[0]
+            ectx = EmitCtx(cvs, cap)
+            key_cvs = [k.emit(ectx) for k in self.keys]
+            pids = partition_ids(key_cvs, key_dtypes, n)
+            out_cvs, out_mask = exchange_cvs(cvs, mask, pids, n, axis)
+            return _flatten_cvs(out_cvs), out_mask
+
+        def step(flat, mask):
+            return jax.shard_map(
+                shard_fn, mesh=mesh,
+                in_specs=(tuple(P(axis) for _ in flat), P(axis)),
+                out_specs=(tuple(P(axis) for _ in flat), P(axis)),
+            )(tuple(flat), mask)
+
+        return jax.jit(step)
+
+    # ------------------------------------------------------------------
+    def _ensure_exchanged(self, ctx: ExecContext):
+        with self._lock:
+            if self._out is not None:
+                return
+            m = ctx.metrics_for(self._op_id)
+            mesh = self._get_mesh()
+            child = self.children[0]
+            n = self.n
+
+            # 1. drain the child, one input pile per shard (round-robin)
+            piles: List[List[DeviceBatch]] = [[] for _ in range(n)]
+            i = 0
+            for cpid in range(child.num_partitions(ctx)):
+                for b in child.execute_partition(ctx, cpid):
+                    piles[i % n].append(b)
+                    i += 1
+            if i == 0:
+                self._out = [None] * n
+                return
+
+            # 2. concat each shard's pile; pad all shards to common shapes
+            with m.timer("partitionTime"):
+                shard_cvs, shard_masks = [], []
+                for pile in piles:
+                    if pile:
+                        cvs = [concat_cvs([b.cvs()[ci] for b in pile],
+                                          f.dtype)
+                               for ci, f in enumerate(self.schema.fields)]
+                        msk = concat_masks([b.row_mask for b in pile])
+                    else:
+                        cvs = [_empty_cv(f.dtype)
+                               for f in self.schema.fields]
+                        msk = jnp.zeros(128, jnp.bool_)
+                    shard_cvs.append(cvs)
+                    shard_masks.append(msk)
+                cap = max(mk.shape[0] for mk in shard_masks)
+                bcaps = [max(cvs[ci].data.shape[0]
+                             for cvs in shard_cvs)
+                         if f.dtype.is_variable_width else 0
+                         for ci, f in enumerate(self.schema.fields)]
+                for s in range(n):
+                    shard_cvs[s] = [
+                        _pad_shard_cv(cv, cap, bcaps[ci])
+                        for ci, cv in enumerate(shard_cvs[s])]
+                    shard_masks[s] = pad_mask(shard_masks[s], cap)
+
+                # 3. lay out globally: row-sharded [n*cap] per buffer
+                from jax.sharding import NamedSharding, PartitionSpec as P
+                sharding = NamedSharding(mesh, P(self.axis_name))
+                flat_global = []
+                ncols = len(self.schema.fields)
+                has_offsets = [cv.offsets is not None
+                               for cv in shard_cvs[0]]
+                for ci in range(ncols):
+                    parts = [shard_cvs[s][ci] for s in range(n)]
+                    flat_global.append(jax.device_put(
+                        jnp.concatenate([p.data for p in parts]), sharding))
+                    flat_global.append(jax.device_put(
+                        jnp.concatenate([p.validity for p in parts]),
+                        sharding))
+                    if has_offsets[ci]:
+                        flat_global.append(jax.device_put(
+                            jnp.concatenate([p.offsets for p in parts]),
+                            sharding))
+                mask_global = jax.device_put(
+                    jnp.concatenate(shard_masks), sharding)
+
+            # 4. one collective program
+            key = (tuple(has_offsets), cap,
+                   tuple(bc for bc in bcaps))
+            prog = self._jit_cache.get(key)
+            if prog is None:
+                prog = self._build_program(has_offsets)
+                self._jit_cache[key] = prog
+            with m.timer("exchangeTime"):
+                out_flat, out_mask = prog(flat_global, mask_global)
+                jax.block_until_ready(out_mask)
+
+            # 5. slice per-shard outputs into DeviceBatches
+            out_cap = n * cap
+            out = []
+            for s in range(n):
+                cvs = []
+                fi = 0
+                for ci, f in enumerate(self.schema.fields):
+                    if has_offsets[ci]:
+                        bc = n * bcaps[ci]
+                        data = out_flat[fi][s * bc:(s + 1) * bc]
+                        valid = out_flat[fi + 1][
+                            s * out_cap:(s + 1) * out_cap]
+                        offs = out_flat[fi + 2][
+                            s * (out_cap + 1):(s + 1) * (out_cap + 1)]
+                        cvs.append(CV(data, valid, offs))
+                        fi += 3
+                    else:
+                        data = out_flat[fi][s * out_cap:(s + 1) * out_cap]
+                        valid = out_flat[fi + 1][
+                            s * out_cap:(s + 1) * out_cap]
+                        cvs.append(CV(data, valid))
+                        fi += 2
+                msk = out_mask[s * out_cap:(s + 1) * out_cap]
+                nlive = int(jnp.sum(msk.astype(jnp.int32)))
+                # live rows are scattered (packed per SOURCE block), so the
+                # live-prefix length is the full capacity
+                tbl = make_table(self.schema, cvs, out_cap)
+                out.append(DeviceBatch(tbl, out_cap, msk, out_cap))
+                m.add("numOutputRows", nlive)
+            self._out = out
+
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        self._ensure_exchanged(ctx)
+        b = self._out[pid]
+        if b is not None:
+            yield b
+
+
+def _flatten_cvs(cvs: Sequence[CV]):
+    flat = []
+    for cv in cvs:
+        flat.append(cv.data)
+        flat.append(cv.validity)
+        if cv.offsets is not None:
+            flat.append(cv.offsets)
+    return tuple(flat)
+
+
+def _unflatten_cvs(flat, has_offsets):
+    cvs, i = [], 0
+    for ho in has_offsets:
+        if ho:
+            cvs.append(CV(flat[i], flat[i + 1], flat[i + 2]))
+            i += 3
+        else:
+            cvs.append(CV(flat[i], flat[i + 1]))
+            i += 2
+    return cvs
+
+
+def _empty_cv(dtype: dt.DataType) -> CV:
+    if dtype.is_variable_width:
+        return CV(jnp.zeros(128, jnp.uint8), jnp.zeros(128, jnp.bool_),
+                  jnp.zeros(129, jnp.int32))
+    return CV(jnp.zeros(128, dtype.np_dtype or jnp.int8),
+              jnp.zeros(128, jnp.bool_))
+
+
+def _pad_shard_cv(cv: CV, cap: int, byte_cap: int) -> CV:
+    cv = pad_cv(cv, cap)
+    if cv.offsets is not None and cv.data.shape[0] < byte_cap:
+        extra = byte_cap - cv.data.shape[0]
+        cv = CV(jnp.concatenate([cv.data,
+                                 jnp.zeros(extra, jnp.uint8)]),
+                cv.validity, cv.offsets)
+    return cv

@@ -19,7 +19,7 @@ from typing import List, Sequence, Tuple
 import jax
 import jax.numpy as jnp
 
-__all__ = ["exchange_rows"]
+__all__ = ["exchange_rows", "exchange_cvs"]
 
 
 def exchange_rows(arrays: Sequence[jnp.ndarray], mask, pids,
@@ -63,3 +63,69 @@ def exchange_rows(arrays: Sequence[jnp.ndarray], mask, pids,
     recv_mask = jax.lax.all_to_all(send_mask, axis_name, split_axis=0,
                                    concat_axis=0, tiled=False)
     return out_arrays, recv_mask.reshape(-1)
+
+
+def _exchange_bytes(data, offsets, row_mask, row_pids, n_shards: int,
+                    axis_name: str):
+    """Move string bytes to each byte's row's target shard. Bytes within a
+    (source, target) bucket keep source row order — the same invariant the
+    row exchange provides — so lengths received via the row path rebuild
+    the offsets on the receive side."""
+    from ..ops.strings import byte_row_map
+    bcap = data.shape[0]
+    row = byte_row_map(offsets, bcap)
+    bmask = row_mask[row] & (jnp.arange(bcap) < offsets[-1])
+    bpids = row_pids[row]
+    (out,), _ = exchange_rows([data], bmask, bpids, n_shards, axis_name)
+    return out
+
+
+def exchange_cvs(cvs: Sequence, mask, pids, n_shards: int,
+                 axis_name: str = "data"):
+    """Exchange the rows of a list of CVs (fixed-width and string columns)
+    so each live row lands on shard pids[row].
+
+    Returns (out_cvs, out_mask) with row capacity n_shards * cap. String
+    columns arrive as packed (gap-free) byte buffers with rebuilt offsets.
+    Runs INSIDE shard_map.
+    """
+    from ..ops.kernel_utils import CV
+    from ..ops.strings import rebuild_strings
+
+    cap = mask.shape[0]
+    payload = []       # fixed-width arrays riding the row exchange
+    layout = []        # per-cv: ("fixed", payload_idx) | ("str", idx, data)
+    for cv in cvs:
+        if cv.offsets is None:
+            layout.append(("fixed", len(payload)))
+            payload.append(cv.data)
+        else:
+            lens = (cv.offsets[1:] - cv.offsets[:-1]).astype(jnp.int32)
+            layout.append(("str", len(payload), cv))
+            payload.append(lens)
+        payload.append(cv.validity.astype(jnp.uint8))
+    out_payload, out_mask = exchange_rows(payload, mask, pids, n_shards,
+                                          axis_name)
+    out_cvs = []
+    for spec in layout:
+        if spec[0] == "fixed":
+            _, i = spec
+            out_cvs.append(CV(out_payload[i],
+                              out_payload[i + 1].astype(jnp.bool_)))
+        else:
+            _, i, cv = spec
+            lens_r = out_payload[i]
+            valid_r = out_payload[i + 1].astype(jnp.bool_)
+            bytes_r = _exchange_bytes(cv.data, cv.offsets, mask, pids,
+                                      n_shards, axis_name)
+            bcap = cv.data.shape[0]
+            # per source-shard block: bytes packed from block start; row
+            # starts are the within-block exclusive cumsum of lengths
+            lens2 = lens_r.reshape(n_shards, cap)
+            excl = jnp.cumsum(lens2, axis=1) - lens2
+            base = (jnp.arange(n_shards, dtype=jnp.int32) * bcap)[:, None]
+            starts = (base + excl).reshape(-1).astype(jnp.int32)
+            out_cvs.append(rebuild_strings(
+                CV(bytes_r, valid_r), starts,
+                lens_r.reshape(-1).astype(jnp.int32)))
+    return out_cvs, out_mask

@@ -17,7 +17,12 @@ __all__ = ["make_mesh", "P", "NamedSharding", "Mesh", "shard_rows"]
 
 def make_mesh(n_devices: Optional[int] = None,
               axis_name: str = "data") -> Mesh:
-    devs = jax.devices()
+    try:
+        devs = jax.devices()
+    except RuntimeError:
+        # default platform broken/absent (e.g. a libtpu client/terminal
+        # mismatch through the tunnel): fall back to the CPU platform
+        devs = jax.devices("cpu")
     if n_devices is not None and len(devs) < n_devices:
         # a TPU tunnel may own the default platform with one chip; the
         # virtual CPU mesh (xla_force_host_platform_device_count) still

@@ -110,9 +110,24 @@ def _filter(meta, conv, conf):
     return x.FilterExec(child, meta.node.bound)
 
 
+def _make_hash_exchange(child, bound_keys, conf):
+    """Choose the exchange transport: mesh collective (all_to_all over
+    ICI when spark.rapids.tpu.mesh.devices > 0) or the host file shuffle
+    (the reference's UCX vs MULTITHREADED mode split,
+    RapidsConf.scala:2216-2230)."""
+    from ..config import MESH_DEVICES, SHUFFLE_PARTITIONS
+    mesh_n = conf.get(MESH_DEVICES)
+    if mesh_n and mesh_n > 1:
+        from ..exec.mesh_exchange import MeshExchangeExec
+        return MeshExchangeExec(child, mesh_n, bound_keys, child.schema)
+    from ..exec.exchange import ShuffleExchangeExec
+    return ShuffleExchangeExec(child, conf.get(SHUFFLE_PARTITIONS),
+                               bound_keys, child.schema)
+
+
 @_rule(L.Aggregate)
 def _agg(meta, conv, conf):
-    from ..config import SHUFFLE_PARTITIONS
+    from ..config import MESH_DEVICES, SHUFFLE_PARTITIONS
     child = conv(meta.children[0])
     n = meta.node
     names = [nm for nm, _ in n.bound_aggs]
@@ -124,12 +139,11 @@ def _agg(meta, conv, conf):
     # partition aggregates independently (GpuShuffleExchange + final agg)
     from ..exec.base import ExecContext
     nparts = conf.get(SHUFFLE_PARTITIONS)
+    mesh_n = conf.get(MESH_DEVICES)
     multi_input = child.num_partitions(ExecContext(conf)) > 1
     keys_ok = all(not (k.dtype.is_nested) for k in n.bound_keys)
-    if multi_input and keys_ok and nparts > 1:
-        from ..exec.exchange import ShuffleExchangeExec
-        exch = ShuffleExchangeExec(child, nparts, n.bound_keys,
-                                   child.schema)
+    if keys_ok and ((multi_input and nparts > 1) or mesh_n > 1):
+        exch = _make_hash_exchange(child, n.bound_keys, conf)
         return agg_exec.HashAggregateExec(exch, key_names, n.bound_keys,
                                           names, aggs, n.schema,
                                           per_partition=True)
@@ -156,11 +170,27 @@ def _sort(meta, conv, conf):
 
 @_rule(L.Join)
 def _join(meta, conv, conf):
+    from ..config import MESH_DEVICES
     from ..exec.join import HashJoinExec
     n = meta.node
-    return HashJoinExec(conv(meta.children[0]), conv(meta.children[1]),
-                        n.bound_left_keys, n.bound_right_keys, n.how,
-                        n.schema)
+    left, right = conv(meta.children[0]), conv(meta.children[1])
+    mesh_n = conf.get(MESH_DEVICES)
+    if (mesh_n > 1 and n.how != "cross" and n.bound_left_keys
+            and all(lk.dtype == rk.dtype for lk, rk in
+                    zip(n.bound_left_keys, n.bound_right_keys))):
+        # distributed shuffled join: hash-exchange both sides on the join
+        # keys over the mesh, then each shard joins its co-partitioned
+        # slice (GpuShuffledHashJoinExec over GpuShuffleExchange)
+        from ..exec.mesh_exchange import MeshExchangeExec
+        lex = MeshExchangeExec(left, mesh_n, n.bound_left_keys,
+                               left.schema)
+        rex = MeshExchangeExec(right, mesh_n, n.bound_right_keys,
+                               right.schema)
+        return HashJoinExec(lex, rex, n.bound_left_keys,
+                            n.bound_right_keys, n.how, n.schema,
+                            per_partition=True)
+    return HashJoinExec(left, right, n.bound_left_keys,
+                        n.bound_right_keys, n.how, n.schema)
 
 
 @_rule(L.WindowOp)
@@ -173,10 +203,19 @@ def _window(meta, conv, conf):
 
 @_rule(L.Repartition)
 def _repart(meta, conv, conf):
-    from ..exec.exchange import ShuffleExchangeExec
+    from ..config import MESH_DEVICES
     n = meta.node
-    return ShuffleExchangeExec(conv(meta.children[0]), n.num_partitions,
-                               n.bound_keys, n.schema)
+    child = conv(meta.children[0])
+    # the mesh collective produces exactly mesh-many partitions; honor an
+    # explicit different repartition count via the file shuffle instead
+    if n.bound_keys and conf.get(MESH_DEVICES) == n.num_partitions \
+            and n.num_partitions > 1:
+        from ..exec.mesh_exchange import MeshExchangeExec
+        return MeshExchangeExec(child, conf.get(MESH_DEVICES),
+                                n.bound_keys, n.schema)
+    from ..exec.exchange import ShuffleExchangeExec
+    return ShuffleExchangeExec(child, n.num_partitions, n.bound_keys,
+                               n.schema)
 
 
 class Planner:

@@ -94,6 +94,13 @@ def read_subbatch(inp: BinaryIO, dtypes, codec=None) -> Optional[HostSubBatch]:
         if vb * 8 < n_rows:
             raise IOError("corrupt shuffle block: validity buffer shorter "
                           f"than {n_rows} rows")
+        item = dtypes[ci].itemsize
+        if not has_off and (db % item or db // item < n_rows):
+            raise IOError(f"corrupt shuffle block: data buffer {db}B for "
+                          f"{n_rows} rows of {dtypes[ci]}")
+        if has_off and ob < 4 * (n_rows + 1):
+            raise IOError(f"corrupt shuffle block: offsets buffer {ob}B "
+                          f"for {n_rows} rows")
         vbits = np.frombuffer(buf, np.uint8, vb, pos)
         pos += vb
         validity = unpack_validity(vbits, n_rows)
