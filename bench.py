@@ -1,18 +1,18 @@
 #!/usr/bin/env python
-"""Benchmark: TPC-H Q6 (scan+filter+reduction) on the TPU engine vs a
-vectorized single-core numpy CPU baseline (the CPU-Spark stand-in,
-BASELINE.json config #1).
+"""Benchmark: TPC-H Q6/Q1/Q3 on the TPU engine vs vectorized single-core
+numpy CPU baselines (the CPU-Spark stand-in, BASELINE.json configs), plus a
+COLD Q6 run (parquet decode + H2D + compute, nothing cached).
 
-Both sides run over memory-resident data: the engine over an HBM-cached
-columnar table (GpuInMemoryTableScan analog), the baseline over RAM-resident
-numpy arrays — symmetric "hot table" scans, measuring the engine rather
-than the host<->device tunnel.
+Hot runs use HBM-cached columnar tables (GpuInMemoryTableScan analog) so the
+engine — not the host<->device tunnel — is measured; the cold run measures
+the full parquet->result path.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 """
 import json
 import os
 import sys
+import tempfile
 import time
 
 sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
@@ -20,9 +20,25 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 import numpy as np  # noqa: E402
 
 
+def _best(fn, iters):
+    fn()  # warm
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
 def main():
     sf = float(os.environ.get("BENCH_SF", "4.0"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
+    plat = os.environ.get("BENCH_PLATFORM")
+    if plat:
+        # the axon site package overrides JAX_PLATFORMS; jax.config is the
+        # only reliable way to pick a backend for local bench runs
+        import jax
+        jax.config.update("jax_platforms", plat)
 
     import spark_rapids_tpu as st
     from spark_rapids_tpu.workloads import tpch
@@ -30,8 +46,6 @@ def main():
     at = tpch.gen_lineitem(sf=sf, seed=7)
     n = at.num_rows
 
-    # raw arrays for the CPU baseline: extract the unscaled decimal ints
-    # straight from the table so both sides read identical data
     from spark_rapids_tpu.columnar.column import Column
 
     def unscaled(name):
@@ -42,41 +56,96 @@ def main():
     qty = unscaled("l_quantity")
     price = unscaled("l_extendedprice")
     disc = unscaled("l_discount")
+    tax = unscaled("l_tax")
+    rf_codes = np.select(
+        [at.column("l_returnflag").to_numpy(zero_copy_only=False) == c
+         for c in ("A", "N", "R")], [0, 1, 2])
+    ls_codes = np.select(
+        [at.column("l_linestatus").to_numpy(zero_copy_only=False) == c
+         for c in ("F", "O")], [0, 1])
 
-    # --- CPU baseline (RAM-resident arrays) ------------------------------
-    tpch.q6_numpy_baseline(ship, disc, qty, price)  # warm cache
-    cpu_times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        base_val = tpch.q6_numpy_baseline(ship, disc, qty, price)
-        cpu_times.append(time.perf_counter() - t0)
-    cpu_s = min(cpu_times)
+    # ---- CPU baselines --------------------------------------------------
+    base_q6_val = tpch.q6_numpy_baseline(ship, disc, qty, price)
+    cpu_q6 = _best(lambda: tpch.q6_numpy_baseline(ship, disc, qty, price),
+                   iters)
+    cpu_q1 = _best(lambda: tpch.q1_numpy_baseline(
+        ship, rf_codes, ls_codes, qty, price, disc, tax), iters)
 
-    # --- TPU engine (HBM-cached table) -----------------------------------
+    cust = tpch.gen_customer(sf=sf)
+    orders = tpch.gen_orders(sf=sf)
+    segs = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
+                     "MACHINERY"])
+    c_seg = np.select(
+        [cust.column("c_mktsegment").to_numpy(zero_copy_only=False) == s
+         for s in segs], [0, 1, 2, 3, 4])
+    c_key = cust.column("c_custkey").to_numpy()
+    o_okey = orders.column("o_orderkey").to_numpy()
+    o_ckey = orders.column("o_custkey").to_numpy()
+    o_date = orders.column("o_orderdate").to_numpy()
+    o_prio = orders.column("o_shippriority").to_numpy()
+    l_okey = at.column("l_orderkey").to_numpy()
+    cpu_q3 = _best(lambda: tpch.q3_numpy_baseline(
+        c_key, c_seg, o_okey, o_ckey, o_date, o_prio,
+        l_okey, ship, price, disc), max(2, iters // 2))
+
+    # ---- TPU engine: hot (HBM-cached) -----------------------------------
     s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 1 << 22})
     cols = ["l_quantity", "l_extendedprice", "l_discount", "l_shipdate"]
     df = s.create_dataframe(at.select(cols)).cache()
     q = tpch.q6(df)
-    r = q.to_arrow()  # warmup: traces + compiles
+    r = q.to_arrow()
     import decimal
     got = r.column(0).to_pylist()[0]
-    expect = decimal.Decimal(base_val).scaleb(-4)
+    expect = decimal.Decimal(base_q6_val).scaleb(-4)
     assert got == expect, f"Q6 mismatch: {got} != {expect}"
+    tpu_q6 = _best(lambda: q.to_arrow(), iters)
 
-    times = []
-    for _ in range(iters):
+    df_full = s.create_dataframe(at).cache()
+    q1 = tpch.q1(df_full)
+    q1.to_arrow()
+    tpu_q1 = _best(lambda: q1.to_arrow(), iters)
+
+    cust_df = s.create_dataframe(cust).cache()
+    ord_df = s.create_dataframe(orders).cache()
+    q3 = tpch.q3(cust_df, ord_df, df_full)
+    q3.to_arrow()
+    tpu_q3 = _best(lambda: q3.to_arrow(), max(2, iters // 2))
+
+    # ---- TPU engine: cold Q6 (parquet -> result) ------------------------
+    import shutil
+    pq_dir = tempfile.mkdtemp(prefix="srtpu-bench-")
+    try:
+        pq_path = os.path.join(pq_dir, "lineitem.parquet")
+        import pyarrow.parquet as pq_mod
+        pq_mod.write_table(at.select(cols), pq_path)
+
+        def cold_q6():
+            s2 = st.TpuSession(
+                {"spark.rapids.tpu.sql.batchSizeRows": 1 << 22})
+            return tpch.q6(s2.read.parquet(pq_path)).to_arrow()
+
+        cold_val = cold_q6().column(0).to_pylist()[0]
+        assert cold_val == expect, f"cold Q6 mismatch: {cold_val}"
         t0 = time.perf_counter()
-        q.to_arrow()  # cached physical plan + compiled kernels
-        times.append(time.perf_counter() - t0)
-    tpu_s = min(times)
+        cold_q6()
+        tpu_q6_cold = time.perf_counter() - t0
+    finally:
+        shutil.rmtree(pq_dir, ignore_errors=True)
 
-    rows_per_s = n / tpu_s
-    vs = cpu_s / tpu_s
+    rows_per_s = n / tpu_q6
     print(json.dumps({
         "metric": f"tpch_q6_sf{sf}_rows_per_sec",
         "value": round(rows_per_s, 1),
         "unit": "rows/s",
-        "vs_baseline": round(vs, 3),
+        "vs_baseline": round(cpu_q6 / tpu_q6, 3),
+        "extra": {
+            "q1_rows_per_sec": round(n / tpu_q1, 1),
+            "q1_vs_numpy": round(cpu_q1 / tpu_q1, 3),
+            "q3_rows_per_sec": round(n / tpu_q3, 1),
+            "q3_vs_numpy": round(cpu_q3 / tpu_q3, 3),
+            "q6_cold_rows_per_sec": round(n / tpu_q6_cold, 1),
+            "q6_cold_s": round(tpu_q6_cold, 3),
+        },
     }))
 
 

@@ -35,17 +35,36 @@ __all__ = ["UngroupedAggExec", "HashAggregateExec"]
 # Merge partial results eagerly once the buffered capacity crosses this.
 _MERGE_THRESHOLD_ROWS = 1 << 21
 
+# Hash-bucket first pass: O(n) scatter-reduce into this many buckets per
+# round (no sort), with exact per-bucket key verification; rows whose
+# bucket is owned by a different key retry the next round under a new
+# seed, and any survivors fall back to the sort path. The TPU answer to
+# cudf's hash groupby (reference: GpuAggregateExec first pass).
+_HASH_BUCKETS = 4096
+_HASH_ROUNDS = 2
+
 
 class UngroupedAggExec(TpuExec):
-    """Reduction without grouping keys -> one row."""
+    """Reduction without grouping keys -> one row.
+
+    The filter/project chain below collapses into the update program
+    (collapse_fusable) and the cross-batch merge folds in too: ONE jitted
+    dispatch per batch instead of one per operator — the whole-stage-fusion
+    answer to the reference's per-kernel cudf dispatch (§3.3 hot loop)."""
 
     def __init__(self, child: TpuExec, agg_names: Sequence[str],
                  bound_aggs: Sequence[AggExpr], schema: Schema):
         super().__init__([child], schema)
         self.agg_names = list(agg_names)
         self.aggs = list(bound_aggs)
+        # fusion resolves lazily at first execute: children may be wrapped
+        # after plan construction (LORE dump pass-throughs)
+        self._base = None
+        self._stages = None
+        self._n_fused = 0
 
         def _update(cvs, mask):
+            cvs, mask = self._stages(cvs, mask)
             ctx = EmitCtx(cvs, mask.shape[0])
             states = []
             for a in self.aggs:
@@ -57,8 +76,9 @@ class UngroupedAggExec(TpuExec):
                 states.append(a.update(cv, mask))
             return states
 
-        def _merge(s1, s2):
-            return [a.merge(x, y) for a, x, y in zip(self.aggs, s1, s2)]
+        def _update_merge(acc, cvs, mask):
+            st = _update(cvs, mask)
+            return [a.merge(x, y) for a, x, y in zip(self.aggs, acc, st)]
 
         def _finalize(states):
             out = []
@@ -68,31 +88,100 @@ class UngroupedAggExec(TpuExec):
             return out
 
         self._update_jit = jax.jit(_update)
-        self._merge_jit = jax.jit(_merge)
+        self._update_merge_jit = jax.jit(_update_merge,
+                                         donate_argnums=(0,))
         self._finalize_jit = jax.jit(_finalize)
 
     def num_partitions(self, ctx):
         return 1
 
     def describe(self):
-        return f"UngroupedAggExec[{self.agg_names}]"
+        fused = f", fused_stages={self._n_fused}" if self._n_fused else ""
+        return f"UngroupedAggExec[{self.agg_names}{fused}]"
+
+    def _resolve_fusion(self):
+        if self._base is None:
+            from .base import collapse_fusable
+            self._base, self._stages, self._n_fused = collapse_fusable(
+                self.children[0])
+
+    def _whole_input_program(self):
+        """ONE dispatch for the whole HBM-resident input: every batch is an
+        argument, the per-batch update/merge loop unrolls inside a single
+        XLA program, and finalize folds in too — zero per-batch Python
+        round-trips (the deepest whole-stage fusion)."""
+        def run(batches):
+            acc = None
+            for cvs, mask in batches:
+                cvs2, mask2 = self._stages(list(cvs), mask)
+                ctx = EmitCtx(cvs2, mask2.shape[0])
+                st = []
+                for a in self.aggs:
+                    if a.child is not None:
+                        cv = a.child.emit(ctx)
+                    else:
+                        cv = CV(jnp.zeros(mask2.shape[0], jnp.int8),
+                                jnp.ones(mask2.shape[0], jnp.bool_))
+                    st.append(a.update(cv, mask2))
+                acc = st if acc is None else [
+                    a.merge(x, y) for a, x, y in zip(self.aggs, acc, st)]
+            out = []
+            for a, s in zip(self.aggs, acc):
+                v, ok = a.finalize(s)
+                out.append((jnp.reshape(v, (1,)), jnp.reshape(ok, (1,))))
+            return out
+        return jax.jit(run)
+
+    def _try_whole_input(self, ctx, m):
+        """Single-dispatch path for an HBM-resident child; returns
+        finalized outputs or None. No copies: batch buffers pass as
+        program arguments."""
+        from .nodes import CachedScanExec
+        if not isinstance(self._base, CachedScanExec):
+            return None
+        batches = self._base.batches
+        if not batches or len(batches) > 64:  # unroll bound
+            return None
+        if not hasattr(self, "_whole_jit"):
+            self._whole_jit = self._whole_input_program()
+        args = tuple((tuple(b.cvs()), b.row_mask) for b in batches)
+        with m.timer("opTime"):
+            return self._whole_jit(args)
 
     def execute_partition(self, ctx: ExecContext, pid: int):
+        self._resolve_fusion()
         m = ctx.metrics_for(self._op_id)
-        child = self.children[0]
+        child = self._base
+        stacked_out = self._try_whole_input(ctx, m)
+        if stacked_out is not None:
+            cvs = []
+            for (v, ok) in stacked_out:
+                pad = 128 - 1
+                data = jnp.concatenate([v, jnp.zeros(pad, v.dtype)])
+                valid = jnp.concatenate([ok.astype(jnp.bool_),
+                                         jnp.zeros(pad, jnp.bool_)])
+                cvs.append(CV(data, valid))
+            tbl = make_table(self.schema, cvs, 1)
+            m.add("numOutputRows", 1)
+            yield DeviceBatch(tbl, 1)
+            return
         acc = None
         for cpid in range(child.num_partitions(ctx)):
             for batch in child.execute_partition(ctx, cpid):
                 with m.timer("opTime"):
-                    st = self._update_jit(batch.cvs(), batch.row_mask)
-                    acc = st if acc is None else self._merge_jit(acc, st)
+                    if acc is None:
+                        acc = self._update_jit(batch.cvs(), batch.row_mask)
+                    else:
+                        acc = self._update_merge_jit(acc, batch.cvs(),
+                                                     batch.row_mask)
         if acc is None:
-            # aggregate over empty input still yields one row
-            empty = DeviceBatch(make_table(self.children[0].schema, [
-            ], 0), 0, jnp.zeros(128, jnp.bool_), 128)
+            # aggregate over empty input still yields one row (stages run
+            # over all-dead base-schema columns)
             cvs = [CV(jnp.zeros(128, f.dtype.np_dtype or jnp.int8),
-                      jnp.zeros(128, jnp.bool_))
-                   for f in self.children[0].schema.fields]
+                      jnp.zeros(128, jnp.bool_),
+                      jnp.zeros(129, jnp.int32)
+                      if f.dtype.is_variable_width else None)
+                   for f in self._base.schema.fields]
             acc = self._update_jit(cvs, jnp.zeros(128, jnp.bool_))
         outs = self._finalize_jit(acc)
         # build 1-row (padded) columns
@@ -136,19 +225,45 @@ def _seg_reduce(reducer: str, arr, live, seg_ids, num_segments):
     raise ValueError(reducer)
 
 
+_NP2DT = None
+
+
+def _dtype_for_np(npdt) -> dt.DataType:
+    global _NP2DT
+    if _NP2DT is None:
+        import numpy as np
+        _NP2DT = {np.dtype(np.bool_): dt.BOOL, np.dtype(np.int8): dt.INT8,
+                  np.dtype(np.int16): dt.INT16, np.dtype(np.int32): dt.INT32,
+                  np.dtype(np.int64): dt.INT64,
+                  np.dtype(np.float32): dt.FLOAT32,
+                  np.dtype(np.float64): dt.FLOAT64}
+    import numpy as np
+    return _NP2DT[np.dtype(npdt)]
+
+
 class HashAggregateExec(TpuExec):
-    """Grouped aggregation via segmented reduction over sorted keys."""
+    """Grouped aggregation via segmented reduction over sorted keys.
+
+    Modes (reference: GpuHashAggregateExec partial/final around
+    GpuShuffleExchangeExec, GpuAggregateExec.scala:1942):
+      complete      — drain every child partition, merge, finalize (1 out).
+      per_partition — child is key-partitioned; each partition aggregates
+                      independently to final results.
+      partial       — per child partition: first-pass + merges, emit ONE
+                      batch of (keys..., state columns...) — the
+                      exchange-input side; rows shrink to group count
+                      BEFORE any shuffle.
+      final         — child delivers partial-format batches (post
+                      exchange); merge states and finalize.
+    The filter chain below collapses into the first-pass program
+    (collapse_fusable): one dispatch per input batch."""
 
     def __init__(self, child: TpuExec, key_names: Sequence[str],
                  bound_keys: Sequence[Expression], agg_names: Sequence[str],
                  bound_aggs: Sequence[AggExpr], schema: Schema,
-                 per_partition: bool = False):
-        """per_partition: the child is hash-partitioned on the grouping
-        keys (an exchange below us), so each partition aggregates
-        independently — the distributed topology
-        (reference: partial/final agg around GpuShuffleExchangeExec)."""
-        super().__init__([child], schema)
-        self.per_partition = per_partition
+                 per_partition: bool = False, mode: Optional[str] = None):
+        self.mode = mode or ("per_partition" if per_partition
+                             else "complete")
         self.key_names = list(key_names)
         self.keys = list(bound_keys)
         self.agg_names = list(agg_names)
@@ -166,19 +281,61 @@ class HashAggregateExec(TpuExec):
             # First/Last keep batch order only because concat order IS the
             # stable-sort tiebreak; nothing extra needed here
 
+        if self.mode == "partial":
+            schema = self._partial_schema(child.schema)
+        super().__init__([child], schema)
+        # fusion resolves lazily at first execute (see UngroupedAggExec)
+        self._base = None
+        self._stages = None
+        self._n_fused = 0
+
         self._update_cache = {}
         self._merge_cache = {}
         self._finalize_jit = jax.jit(self._finalize_fn)
+        hashable = (dt.BooleanType, dt.ByteType, dt.ShortType,
+                    dt.IntegerType, dt.DateType, dt.LongType,
+                    dt.TimestampType, dt.DecimalType, dt.FloatType,
+                    dt.DoubleType, dt.StringType, dt.BinaryType)
+        self._hash_ok = all(isinstance(k.dtype, hashable) for k in self.keys)
+        self._hash_disabled = False
+
+    # -- partial-state wire schema --------------------------------------
+    def _state_np_dtypes(self):
+        """Infer the flat state array dtypes via abstract evaluation."""
+        shapes = []
+        for a in self.aggs:
+            cap = 128
+            if a.child is not None:
+                np_dt = a.child.dtype.np_dtype or jnp.int8
+            else:
+                np_dt = jnp.int8
+            cv = jax.ShapeDtypeStruct((cap,), np_dt)
+            vcv = jax.ShapeDtypeStruct((cap,), jnp.bool_)
+            seg = jax.ShapeDtypeStruct((cap,), jnp.int32)
+            out = jax.eval_shape(
+                lambda c, v, s: a.g_update(CV(c, v), v, s, cap),
+                cv, vcv, seg)
+            shapes.extend([o.dtype for o in out])
+        return shapes
+
+    def _partial_schema(self, child_schema: Schema) -> Schema:
+        from ..columnar.table import Field
+        fields = []
+        for nm, k in zip(self.key_names, self.keys):
+            fields.append(Field(f"_k_{nm}", k.dtype))
+        for si, npdt in enumerate(self._state_np_dtypes()):
+            fields.append(Field(f"_s{si}", _dtype_for_np(npdt)))
+        return Schema(fields)
 
     def num_partitions(self, ctx):
-        if self.per_partition:
+        if self.mode in ("per_partition", "partial", "final"):
             return self.children[0].num_partitions(ctx)
         return 1
 
     def describe(self):
-        mode = "distributed" if self.per_partition else "single"
-        return (f"HashAggregateExec[{mode}, keys={self.key_names}, "
-                f"aggs={self.agg_names}]")
+        fused = f", fused_stages={self._n_fused}" if self._n_fused else ""
+        return (f"HashAggregateExec[{self.mode}, keys={self.key_names}, "
+                f"aggs={self.agg_names}{fused}]")
 
     # -- sort/segment machinery (runs inside jit) ----------------------
     def _sort_and_segment(self, key_cvs, mask, nchunks):
@@ -200,8 +357,86 @@ class HashAggregateExec(TpuExec):
                    for kcv in key_cvs]
         return perm, seg_ids, live_sorted, seg_live, key_out
 
+    def _hash_update_fn(self, nchunks):
+        """Sort-free first pass: bucket rows by key hash, verify each row's
+        key against its bucket's representative (canonical order-key
+        equality — NaN/-0.0/null exact), segment-reduce matching rows, and
+        leave collisions to the next round / sort fallback. Returns
+        (key_cvs, flat_states, live, n_leftover) with capacity
+        _HASH_ROUNDS * _HASH_BUCKETS."""
+        from ..ops.hash import murmur3_row_hash
+
+        def fn(cvs, mask):
+            cvs, mask = self._stages(cvs, mask)
+            cap = mask.shape[0]
+            ctx = EmitCtx(cvs, cap)
+            key_cvs = [k.emit(ctx) for k in self.keys]
+            key_dtypes = [k.dtype for k in self.keys]
+            eq_arrays = []
+            for kcv, kexpr, nc in zip(key_cvs, self.keys, nchunks):
+                arrs = [jnp.logical_not(kcv.validity).astype(jnp.uint8)]
+                arrs += sk.order_keys(kcv, kexpr.dtype, nc)
+                eq_arrays.append(arrs)
+            agg_inputs = []
+            for a in self.aggs:
+                if a.child is not None:
+                    agg_inputs.append(a.child.emit(ctx))
+                else:
+                    agg_inputs.append(CV(jnp.zeros(cap, jnp.int8),
+                                         jnp.ones(cap, jnp.bool_)))
+            B = _HASH_BUCKETS
+            remaining = mask
+            rowidx = jnp.arange(cap, dtype=jnp.int32)
+            round_keys = [[] for _ in self.keys]
+            round_states = None
+            round_live = []
+            for r in range(_HASH_ROUNDS):
+                h = murmur3_row_hash(key_cvs, key_dtypes,
+                                     seed=42 + r * 1000003)
+                b = (h.astype(jnp.uint32) % jnp.uint32(B)).astype(jnp.int32)
+                repmin = jax.ops.segment_min(
+                    jnp.where(remaining, rowidx, cap), b, B)
+                has = repmin < cap
+                rep = jnp.clip(repmin, 0, cap - 1)
+                rep_of_row = rep[b]
+                match = remaining
+                for arrs in eq_arrays:
+                    for arr in arrs:
+                        match = match & (arr == arr[rep_of_row])
+                states_r = []
+                for a, icv in zip(self.aggs, agg_inputs):
+                    if icv.offsets is not None:
+                        scv = CV(jnp.zeros(cap, jnp.int8), icv.validity)
+                    else:
+                        scv = icv
+                    states_r.append(a.g_update(scv, match, b, B))
+                flat_r = [c for s in states_r for c in s]
+                round_states = ([[f] for f in flat_r] if round_states is None
+                                else [o + [f] for o, f in
+                                      zip(round_states, flat_r)])
+                for ki, (kcv, nc) in enumerate(zip(key_cvs, nchunks)):
+                    if kcv.offsets is not None:
+                        bcap = min(kcv.data.shape[0],
+                                   bucket_capacity(B * nc * 4))
+                        round_keys[ki].append(take_strings(
+                            kcv, rep, in_bounds=has,
+                            out_data_capacity=bcap))
+                    else:
+                        round_keys[ki].append(take(kcv, rep,
+                                                   in_bounds=has))
+                round_live.append(has)
+                remaining = remaining & ~match
+            key_out = [concat_cvs(parts, kd)
+                       for parts, kd in zip(round_keys, key_dtypes)]
+            flat = [jnp.concatenate(parts) for parts in round_states]
+            live = jnp.concatenate(round_live)
+            leftover = jnp.sum(remaining.astype(jnp.int32))
+            return key_out, flat, live, leftover
+        return fn
+
     def _update_fn(self, nchunks):
         def fn(cvs, mask):
+            cvs, mask = self._stages(cvs, mask)
             cap = mask.shape[0]
             ctx = EmitCtx(cvs, cap)
             key_cvs = [k.emit(ctx) for k in self.keys]
@@ -307,14 +542,43 @@ class HashAggregateExec(TpuExec):
             ncs.append(sk.nchunks_for_len(max(maxlen, 1)))
         return tuple(ncs)
 
+    def _resolve_fusion(self):
+        if self._base is None:
+            if self.mode in ("complete", "partial", "per_partition"):
+                from .base import collapse_fusable
+                self._base, self._stages, self._n_fused = collapse_fusable(
+                    self.children[0], require_ordinals=True)
+            else:
+                self._base, self._n_fused = self.children[0], 0
+                self._stages = lambda cvs, mask: (cvs, mask)
+
     def execute_partition(self, ctx: ExecContext, pid: int):
+        self._resolve_fusion()
         m = ctx.metrics_for(self._op_id)
-        child = self.children[0]
+        child = self._base
         partials = []   # (key_cvs, flat_states, seg_live, capacity)
-        child_pids = ([pid] if self.per_partition
+        child_pids = ([pid] if self.mode in ("per_partition", "partial",
+                                             "final")
                       else range(child.num_partitions(ctx)))
+
+        if self.mode == "final":
+            yield from self._execute_final(ctx, pid, m)
+            return
+
         def update_one(b):
             nchunks = self._batch_nchunks(b)
+            if self._hash_ok and not self._hash_disabled:
+                hfn = self._update_cache.get(("hash", nchunks))
+                if hfn is None:
+                    hfn = jax.jit(self._hash_update_fn(nchunks))
+                    self._update_cache[("hash", nchunks)] = hfn
+                ks, st, sl, leftover = hfn(b.cvs(), b.row_mask)
+                if fetch_int(leftover) == 0:
+                    return (ks, st, sl, sl.shape[0])
+                # bucket-collision overflow (high-cardinality batch):
+                # fall back to the exact sort path, and stop trying the
+                # hash pass for the rest of this query
+                self._hash_disabled = True
             fn = self._update_cache.get(nchunks)
             if fn is None:
                 fn = jax.jit(self._update_fn(nchunks))
@@ -334,15 +598,48 @@ class HashAggregateExec(TpuExec):
                         and len(partials) > 1:
                     partials = [self._merge_partials(partials)]
         if not partials:
-            yield DeviceBatch(make_table(self.schema, [
-                CV(jnp.zeros(128, f.dtype.np_dtype or jnp.int8),
-                   jnp.zeros(128, jnp.bool_),
-                   jnp.zeros(129, jnp.int32)
-                   if f.dtype.is_variable_width else None)
-                for f in self.schema.fields], 0),
-                0, jnp.zeros(128, jnp.bool_), 128)
+            if self.mode != "partial":
+                yield DeviceBatch(make_table(self.schema, [
+                    CV(jnp.zeros(128, f.dtype.np_dtype or jnp.int8),
+                       jnp.zeros(128, jnp.bool_),
+                       jnp.zeros(129, jnp.int32)
+                       if f.dtype.is_variable_width else None)
+                    for f in self.schema.fields], 0),
+                    0, jnp.zeros(128, jnp.bool_), 128)
             return
         with m.timer("opTime"):
+            while len(partials) > 1:
+                partials = [self._merge_partials(partials)]
+            ks, st, sl, cap = partials[0]
+            if self.mode == "partial":
+                cvs = list(ks) + [
+                    CV(s, jnp.ones(cap, jnp.bool_)) for s in st]
+                tbl = make_table(self.schema, cvs, cap)
+                m.add("numOutputBatches", 1)
+                yield DeviceBatch(tbl, cap, sl, cap)
+                return
+            outs = self._finalize_jit(ks, st, sl)
+        tbl = make_table(self.schema, outs, cap)
+        m.add("numOutputBatches", 1)
+        yield DeviceBatch(tbl, cap, sl, cap)
+
+    def _execute_final(self, ctx: ExecContext, pid: int, m):
+        """Merge partial-format batches (keys + state columns) arriving
+        from the exchange, then finalize — the final-mode half of the
+        partial/final split."""
+        nkeys = len(self.keys)
+        partials = []
+        for batch in self.children[0].execute_partition(ctx, pid):
+            cvs = batch.cvs()
+            ks = cvs[:nkeys]
+            st = [cv.data for cv in cvs[nkeys:]]
+            partials.append((ks, st, batch.row_mask, batch.capacity))
+        if not partials:
+            return
+        with m.timer("opTime"):
+            # always run >= 1 merge pass: a single exchanged batch still
+            # holds same-key partial rows from different map partitions
+            partials = [self._merge_partials(partials)]
             while len(partials) > 1:
                 partials = [self._merge_partials(partials)]
             ks, st, sl, cap = partials[0]

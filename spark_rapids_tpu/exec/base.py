@@ -58,6 +58,19 @@ class TpuExec:
                           pid: int) -> Iterator[DeviceBatch]:
         raise NotImplementedError
 
+    def fusable_stage(self):
+        """Pure per-batch device transform (cvs, mask) -> (cvs, mask) when
+        this operator can fuse into its parent's jitted program (the
+        whole-stage-fusion analog: XLA compiles the parent's kernel with
+        this stage inlined, eliminating a dispatch + intermediate
+        materialization per batch). None when not fusable."""
+        return None
+
+    def preserves_ordinals(self) -> bool:
+        """True when fusable_stage keeps the child's column ordinals
+        (filters do; projections do not)."""
+        return True
+
     # ------------------------------------------------------------------
     def execute_all(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         for pid in range(self.num_partitions(ctx)):
@@ -74,3 +87,28 @@ class TpuExec:
         for c in self.children:
             s += c.tree_string(indent + 1)
         return s
+
+
+def collapse_fusable(node: TpuExec, require_ordinals: bool = False):
+    """Walk down a chain of fusable operators (filter/project) and return
+    (base_child, composed_fn, n_stages). composed_fn applies the stages
+    bottom-up inside the caller's jit; n_stages == 0 means nothing fused
+    (composed_fn is identity and base_child is `node`).
+
+    require_ordinals: stop at stages that renumber columns (projections) —
+    for parents that inspect child batches by ordinal outside the jit."""
+    stages = []
+    while True:
+        fn = node.fusable_stage()
+        if fn is None or (require_ordinals and not node.preserves_ordinals()):
+            break
+        stages.append(fn)
+        node = node.children[0]
+    stages.reverse()
+
+    def composed(cvs, mask):
+        for fn in stages:
+            cvs, mask = fn(cvs, mask)
+        return cvs, mask
+
+    return node, composed, len(stages)

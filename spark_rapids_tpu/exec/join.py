@@ -120,6 +120,89 @@ class HashJoinExec(TpuExec):
                 ncs.append(0)
         return tuple(ncs)
 
+    # ---- single-key fast path: sorted build + searchsorted probe -------
+    # The build side sorts ONCE per join (not once per stream batch): keys
+    # normalize to a monotone uint64 radix word, invalid keys pin to
+    # UINT64_MAX (sorted last, excluded by clipping ranges to n_valid), and
+    # each stream batch probes with two binary searches — O(S log B) per
+    # batch instead of a combined (B+S) sort (reference contrast:
+    # GpuHashJoin.scala builds a hash table once; this is the TPU-sortable
+    # equivalent).
+    @staticmethod
+    def _single_key_u64(kcv: CV, dtype: dt.DataType):
+        """Monotone uint64 key, or None when the dtype needs >1 array."""
+        arrs = sk.order_keys(kcv, dtype)
+        if len(arrs) != 1:
+            return None
+        a = arrs[0]
+        if a.dtype == jnp.uint8 or a.dtype == jnp.uint32:
+            return a.astype(jnp.uint64)
+        if a.dtype == jnp.int64:
+            return a.astype(jnp.uint64) ^ jnp.uint64(1 << 63)
+        if a.dtype == jnp.int32:
+            return (a.astype(jnp.int64).astype(jnp.uint64)
+                    ^ jnp.uint64(1 << 63))
+        if a.dtype == jnp.int8 or a.dtype == jnp.int16:
+            return (a.astype(jnp.int64).astype(jnp.uint64)
+                    ^ jnp.uint64(1 << 63))
+        return None
+
+    def _fast_path_ok(self):
+        if len(self.rkeys) != 1:
+            return False
+        d = self.rkeys[0].dtype
+        return not (d.is_variable_width or d.is_nested
+                    or isinstance(d, dt.DoubleType))
+
+    def _build_sorted(self, bkey_cvs, bmask):
+        """jitted once per build capacity (cached in _count_cache):
+        returns (sorted ukeys with invalids pinned MAX, perm sorted->orig,
+        n_valid)."""
+        key = ("buildsort", bmask.shape[0])
+        fn = self._count_cache.get(key)
+        if fn is None:
+            def fn_(kcv, mask):
+                ukey = self._single_key_u64(kcv, self.rkeys[0].dtype)
+                valid = mask & kcv.validity
+                pinned = jnp.where(valid, ukey,
+                                   jnp.uint64(0xFFFFFFFFFFFFFFFF))
+                inv = jnp.logical_not(valid).astype(jnp.uint8)
+                perm = sk.lexsort([inv, pinned])
+                return pinned[perm], perm.astype(jnp.int32), \
+                    jnp.sum(valid.astype(jnp.int32))
+            fn = jax.jit(fn_)
+            self._count_cache[key] = fn
+        return fn(bkey_cvs[0], bmask)
+
+    def _probe_fn(self, cap_b, cap_s):
+        """Per-stream-batch count phase against the sorted build keys."""
+        def fn(sorted_ukey, n_valid, skcv, smask):
+            ukey_s = self._single_key_u64(skcv, self.lkeys[0].dtype)
+            joinable = smask & skcv.validity
+            lo = jnp.searchsorted(sorted_ukey, ukey_s, side="left")
+            hi = jnp.searchsorted(sorted_ukey, ukey_s, side="right")
+            lo = jnp.minimum(lo, n_valid)
+            hi = jnp.minimum(hi, n_valid)
+            cnt = jnp.where(joinable, (hi - lo).astype(jnp.int64), 0)
+            offsets = jnp.cumsum(cnt) - cnt
+            total = jnp.sum(cnt)
+            # matched build positions (right/full outer): range-mark via
+            # +1/-1 diff then prefix sum over sorted build space
+            diff = jnp.zeros(cap_b + 1, jnp.int32)
+            add_lo = jnp.where(joinable, lo, cap_b)
+            add_hi = jnp.where(joinable, hi, cap_b)
+            diff = diff.at[add_lo].add(1).at[add_hi].add(-1)
+            touched = jnp.cumsum(diff[:-1]) > 0
+            return (cnt, offsets, total, lo.astype(jnp.int64), touched)
+        return fn
+
+    @staticmethod
+    @jax.jit
+    def _matched_from_touched(bperm, touched, n_valid, acc):
+        pos_ok = jnp.arange(touched.shape[0]) < n_valid
+        upd = jnp.zeros_like(acc).at[bperm].max(touched & pos_ok)
+        return acc | upd
+
     # ---- phase 1+2: combined sort & count (jitted) --------------------
     def _count_fn(self, nchunks, cap_b, cap_s):
         def fn(bkeys, bmask, skeys, smask):
@@ -242,6 +325,11 @@ class HashJoinExec(TpuExec):
             bkey_cvs = [k.emit(bctx) for k in self.rkeys]
         matched_b_acc = jnp.zeros(cap_b, jnp.bool_)
         nl = len(left.schema.fields)
+        fast = self._fast_path_ok()
+        if fast:
+            with m.timer("buildTime"):
+                sorted_ukey, bperm, n_valid_b = self._build_sorted(
+                    bkey_cvs, bmask)
 
         for lpid in ([pid] if self.per_partition
                      else range(left.num_partitions(ctx))):
@@ -251,17 +339,32 @@ class HashJoinExec(TpuExec):
                     cap_s = batch.capacity
                     sctx = EmitCtx(scvs, cap_s)
                     skey_cvs = [k.emit(sctx) for k in self.lkeys]
-                    nchunks = self._key_nchunks(bkey_cvs, bmask,
-                                                skey_cvs, smask)
-                    ckey = (nchunks, cap_b, cap_s)
-                    cfn = self._count_cache.get(ckey)
-                    if cfn is None:
-                        cfn = jax.jit(self._count_fn(nchunks, cap_b, cap_s))
-                        self._count_cache[ckey] = cfn
-                    (cnt, offsets, total, bstart, perm,
-                     matched_b) = cfn(bkey_cvs, bmask, skey_cvs, smask)
-                    if self.how in ("right", "full"):
-                        matched_b_acc = matched_b_acc | matched_b
+                    if fast:
+                        pkey = ("probe", cap_b, cap_s)
+                        pfn = self._count_cache.get(pkey)
+                        if pfn is None:
+                            pfn = jax.jit(self._probe_fn(cap_b, cap_s))
+                            self._count_cache[pkey] = pfn
+                        (cnt, offsets, total, bstart,
+                         touched) = pfn(sorted_ukey, n_valid_b,
+                                        skey_cvs[0], smask)
+                        perm = bperm
+                        if self.how in ("right", "full"):
+                            matched_b_acc = self._matched_from_touched(
+                                bperm, touched, n_valid_b, matched_b_acc)
+                    else:
+                        nchunks = self._key_nchunks(bkey_cvs, bmask,
+                                                    skey_cvs, smask)
+                        ckey = (nchunks, cap_b, cap_s)
+                        cfn = self._count_cache.get(ckey)
+                        if cfn is None:
+                            cfn = jax.jit(self._count_fn(nchunks, cap_b,
+                                                         cap_s))
+                            self._count_cache[ckey] = cfn
+                        (cnt, offsets, total, bstart, perm,
+                         matched_b) = cfn(bkey_cvs, bmask, skey_cvs, smask)
+                        if self.how in ("right", "full"):
+                            matched_b_acc = matched_b_acc | matched_b
                     if self.how == "left_semi":
                         yield DeviceBatch(batch.table, batch.num_rows,
                                           smask & (cnt > 0), cap_s)

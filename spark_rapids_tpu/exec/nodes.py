@@ -130,6 +130,15 @@ class ProjectExec(TpuExec):
     def describe(self):
         return f"ProjectExec[{', '.join(map(repr, self.bound))}]"
 
+    def fusable_stage(self):
+        def fn(cvs, mask):
+            ctx = EmitCtx(cvs, mask.shape[0])
+            return [e.emit(ctx) for e in self.bound], mask
+        return fn
+
+    def preserves_ordinals(self):
+        return False
+
     def execute_partition(self, ctx, pid):
         m = ctx.metrics_for(self._op_id)
         for batch in self.children[0].execute_partition(ctx, pid):
@@ -154,6 +163,13 @@ class FilterExec(TpuExec):
 
     def describe(self):
         return f"FilterExec[{self.bound!r}]"
+
+    def fusable_stage(self):
+        def fn(cvs, mask):
+            ctx = EmitCtx(cvs, mask.shape[0])
+            cv = self.bound.emit(ctx)
+            return cvs, mask & cv.validity & cv.data.astype(jnp.bool_)
+        return fn
 
     def execute_partition(self, ctx, pid):
         m = ctx.metrics_for(self._op_id)

@@ -1,19 +1,22 @@
-"""Logical optimizations. Round-1: column pruning into scans.
+"""Logical optimizations: filter pushdown + column pruning.
 
-The reference gets pruning from Spark Catalyst for free; standalone we do
-it here: required attributes flow top-down through
-Project/Filter/Aggregate/Sort/Limit chains and shrink scans (dropping e.g.
-unused string columns before the host->HBM transfer, which profiling shows
-dominates scan time).
+The reference gets both from Spark Catalyst for free; standalone we do
+them here:
+- `push_filters` moves Filter conditions below pass-through Projects and
+  into the matching side of Joins (inner: both sides; left/semi/anti:
+  left only; right: right only), so joins see pre-filtered inputs.
+- `prune` flows required attributes top-down through
+  Project/Filter/Aggregate/Sort/Limit/Join chains and shrinks scans AND
+  join gather widths (the join expansion gathers only surviving columns).
 """
 from __future__ import annotations
 
 from typing import Optional, Set
 
-from ..expr.expressions import BoundRef, ColumnRef, Expression
+from ..expr.expressions import Alias, BoundRef, ColumnRef, Expression
 from . import logical as L
 
-__all__ = ["optimize", "refs_of"]
+__all__ = ["optimize", "refs_of", "push_filters"]
 
 
 def refs_of(e: Expression) -> Optional[Set[str]]:
@@ -64,9 +67,14 @@ def prune(plan: L.LogicalPlan,
                 return L.ParquetScan(plan.paths, columns=names)
         return plan
     if isinstance(plan, L.Project):
-        child_req = _refs_of_all(plan.exprs)
+        exprs = plan.exprs
+        if required is not None:
+            kept = [e for e in exprs if e.name in required]
+            if kept:
+                exprs = kept
+        child_req = _refs_of_all(exprs)
         child = prune(plan.child, child_req)
-        return L.Project(child, plan.exprs)
+        return L.Project(child, exprs)
     if isinstance(plan, L.Filter):
         creq = None
         if required is not None:
@@ -91,9 +99,16 @@ def prune(plan: L.LogicalPlan,
     if isinstance(plan, L.Union):
         return L.Union([prune(c, None) for c in plan.children])
     if isinstance(plan, L.Join):
-        # the Join schema is positional over ALL child columns, so children
-        # cannot be pruned without rewriting parent BoundRefs
-        return L.Join(prune(plan.left, None), prune(plan.right, None),
+        lnames = set(plan.left.schema.names)
+        rnames = set(plan.right.schema.names)
+        lkr = _refs_of_all(plan.left_keys)
+        rkr = _refs_of_all(plan.right_keys)
+        lreq = rreq = None
+        if (required is not None and lkr is not None and rkr is not None
+                and not (lnames & rnames)):
+            lreq = {n for n in required if n in lnames} | lkr
+            rreq = {n for n in required if n in rnames} | rkr
+        return L.Join(prune(plan.left, lreq), prune(plan.right, rreq),
                       plan.left_keys, plan.right_keys, plan.how)
     if isinstance(plan, L.WindowOp):
         return L.WindowOp(prune(plan.child, None), plan.wcols)
@@ -103,7 +118,72 @@ def prune(plan: L.LogicalPlan,
     return plan
 
 
+def _rebuild(plan: L.LogicalPlan, kids) -> L.LogicalPlan:
+    """Reconstruct a node over new children (re-binding expressions)."""
+    if isinstance(plan, L.Project):
+        return L.Project(kids[0], plan.exprs)
+    if isinstance(plan, L.Filter):
+        return L.Filter(kids[0], plan.condition)
+    if isinstance(plan, L.Aggregate):
+        return L.Aggregate(kids[0], plan.keys, plan.aggs)
+    if isinstance(plan, L.Sort):
+        return L.Sort(kids[0], plan.orders, plan.global_sort)
+    if isinstance(plan, L.Limit):
+        return L.Limit(kids[0], plan.n)
+    if isinstance(plan, L.Union):
+        return L.Union(kids)
+    if isinstance(plan, L.Join):
+        return L.Join(kids[0], kids[1], plan.left_keys, plan.right_keys,
+                      plan.how)
+    if isinstance(plan, L.WindowOp):
+        return L.WindowOp(kids[0], plan.wcols)
+    if isinstance(plan, L.Repartition):
+        return L.Repartition(kids[0], plan.num_partitions, plan.keys)
+    return plan
+
+
+def _passthrough_names(project: L.Project) -> Set[str]:
+    """Output names that are plain same-named column references."""
+    out = set()
+    for e in project.exprs:
+        if isinstance(e, ColumnRef):
+            out.add(e.name)
+    return out
+
+
+def push_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """Sink Filters below pass-through Projects and into Join sides."""
+    kids = [push_filters(c) for c in plan.children]
+    plan = _rebuild(plan, kids)
+    if not isinstance(plan, L.Filter):
+        return plan
+    child = plan.child
+    refs = refs_of(plan.condition)
+    if refs is None:
+        return plan
+    if isinstance(child, L.Project) and refs <= _passthrough_names(child):
+        return L.Project(
+            push_filters(L.Filter(child.child, plan.condition)),
+            child.exprs)
+    if isinstance(child, L.Join):
+        lnames = set(child.left.schema.names)
+        rnames = set(child.right.schema.names)
+        if not (refs & lnames & rnames):
+            if refs <= lnames and child.how in ("inner", "left",
+                                                "left_semi", "left_anti"):
+                return L.Join(
+                    push_filters(L.Filter(child.left, plan.condition)),
+                    child.right, child.left_keys, child.right_keys,
+                    child.how)
+            if refs <= rnames and child.how in ("inner", "right"):
+                return L.Join(
+                    child.left,
+                    push_filters(L.Filter(child.right, plan.condition)),
+                    child.left_keys, child.right_keys, child.how)
+    return plan
+
+
 def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
     # Aggregate/Project at the root define their own required set; start
     # unconstrained and let node rules narrow it.
-    return prune(plan, None)
+    return prune(push_filters(plan), None)

@@ -135,18 +135,27 @@ def _agg(meta, conv, conf):
     if not n.keys:
         return agg_exec.UngroupedAggExec(child, names, aggs, n.schema)
     key_names = [k.name for k in n.keys]
-    # distributed topology: hash-exchange on grouping keys, then each
-    # partition aggregates independently (GpuShuffleExchange + final agg)
+    # distributed topology: PARTIAL agg per input partition (rows shrink
+    # to group count), exchange the partial states on the grouping keys,
+    # FINAL merge per output partition (reference: partial/final
+    # GpuHashAggregateExec around GpuShuffleExchangeExec)
     from ..exec.base import ExecContext
     nparts = conf.get(SHUFFLE_PARTITIONS)
     mesh_n = conf.get(MESH_DEVICES)
     multi_input = child.num_partitions(ExecContext(conf)) > 1
     keys_ok = all(not (k.dtype.is_nested) for k in n.bound_keys)
     if keys_ok and ((multi_input and nparts > 1) or mesh_n > 1):
-        exch = _make_hash_exchange(child, n.bound_keys, conf)
-        return agg_exec.HashAggregateExec(exch, key_names, n.bound_keys,
+        from ..expr.expressions import BoundRef
+        partial = agg_exec.HashAggregateExec(
+            child, key_names, n.bound_keys, names, aggs, child.schema,
+            mode="partial")
+        pkeys = [BoundRef(i, k.dtype, f.name)
+                 for i, (k, f) in enumerate(
+                     zip(n.bound_keys, partial.schema.fields))]
+        exch = _make_hash_exchange(partial, pkeys, conf)
+        return agg_exec.HashAggregateExec(exch, key_names, pkeys,
                                           names, aggs, n.schema,
-                                          per_partition=True)
+                                          mode="final")
     return agg_exec.HashAggregateExec(child, key_names, n.bound_keys,
                                       names, aggs, n.schema)
 

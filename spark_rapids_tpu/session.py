@@ -195,13 +195,62 @@ class DataFrame:
             rk = [col(c) for c in on]
         else:
             raise NotImplementedError("join on expressions: pass column names")
-        jplan = L.Join(self._plan, other._plan, lk, rk, how)
         if how in ("left_semi", "left_anti"):
-            return DataFrame(self._session, jplan)
+            return DataFrame(self._session,
+                             L.Join(self._plan, other._plan, lk, rk, how))
+        lnames_list = list(self._plan.schema.names)
+        rnames_list = list(other._plan.schema.names)
+        if (len(set(lnames_list)) < len(lnames_list)
+                or len(set(rnames_list)) < len(rnames_list)):
+            # a side already carries duplicate column names (e.g. the
+            # output of a previous join): name-based projection would
+            # collapse the duplicates, so keep the positional form
+            return self._join_positional(other, on, how, lk, rk)
+        # Rename colliding right-side columns before the join so every
+        # name in the joined schema is unique — the post-join projection
+        # then stays purely name-based, which keeps the optimizer's
+        # column pruning and filter pushdown working above joins.
+        from .expr.expressions import Coalesce
+        lnames = set(self._plan.schema.names)
+        rename = {f.name: f"__join_r_{f.name}"
+                  for f in other._plan.schema.fields if f.name in lnames}
+        rplan = other._plan
+        if rename:
+            rplan = L.Project(rplan, [
+                col(f.name).alias(rename[f.name]) if f.name in rename
+                else col(f.name) for f in other._plan.schema.fields])
+        rk = [col(rename.get(c, c)) for c in on]
+        jplan = L.Join(self._plan, rplan, lk, rk, how)
         # pyspark semantics: the `on` columns appear once, then left rest,
-        # then right rest. For right joins take the key from the right side;
-        # for full outer coalesce both sides.
+        # then right rest. For right joins take the key from the right
+        # side; for full outer coalesce both sides.
+        on_set = set(on)
+        exprs = []
+        for name in on:
+            rn = rename.get(name, name)
+            if how == "right":
+                exprs.append(col(rn).alias(name))
+            elif how == "full":
+                exprs.append(Coalesce(col(name), col(rn)).alias(name))
+            else:
+                exprs.append(col(name))
+        for f in self._plan.schema.fields:
+            if f.name not in on_set:
+                exprs.append(col(f.name))
+        for f in other._plan.schema.fields:
+            if f.name in on_set:
+                continue
+            rn = rename.get(f.name, f.name)
+            exprs.append(col(rn).alias(f.name) if rn != f.name
+                         else col(f.name))
+        return DataFrame(self._session, L.Project(jplan, exprs))
+
+    def _join_positional(self, other: "DataFrame", on, how, lk, rk):
+        """Positional (BoundRef) post-join projection: exact for
+        duplicate-named inputs, at the cost of disabling name-based
+        pruning above this join."""
         from .expr.expressions import BoundRef, Coalesce
+        jplan = L.Join(self._plan, other._plan, lk, rk, how)
         nl = len(self._plan.schema.fields)
         on_set = set(on)
         exprs = []
@@ -214,8 +263,7 @@ class DataFrame:
             if how == "right":
                 exprs.append(rref)
             elif how == "full":
-                c = Coalesce(lref, rref)
-                exprs.append(c.alias(name))
+                exprs.append(Coalesce(lref, rref).alias(name))
             else:
                 exprs.append(lref)
         for i, f in enumerate(jschema.fields):

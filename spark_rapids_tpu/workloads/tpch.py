@@ -95,6 +95,42 @@ def q6_numpy_baseline(ship, disc_unscaled, qty_unscaled, price_unscaled):
     return int(np.sum(price_unscaled[m] * disc_unscaled[m]))
 
 
+def q1_numpy_baseline(ship, rf, ls, qty, price, disc, tax):
+    """Vectorized single-core Q1 reference: grouped sums via bincount over
+    the 6 (returnflag, linestatus) combinations. rf/ls are small int codes."""
+    m = ship <= 10471
+    g = (rf * 2 + ls)[m]
+    qty, price, disc, tax = qty[m], price[m], disc[m], tax[m]
+    disc_price = price * (100 - disc)          # scale 4
+    charge = disc_price * (100 + tax)          # scale 6
+    out = {}
+    out["sum_qty"] = np.bincount(g, qty, 6)
+    out["sum_base_price"] = np.bincount(g, price, 6)
+    out["sum_disc_price"] = np.bincount(g, disc_price, 6)
+    out["sum_charge"] = np.bincount(g, charge.astype(np.float64), 6)
+    out["count"] = np.bincount(g, minlength=6)
+    return out
+
+
+def q3_numpy_baseline(c_key, c_seg, o_okey, o_ckey, o_date, o_prio,
+                      l_okey, l_ship, l_price, l_disc):
+    """Vectorized single-core Q3 reference: semi-join via np.isin +
+    dict-free grouped sum over order keys."""
+    cust = c_key[c_seg == 1]                      # BUILDING code == 1
+    om = (o_date < 9204) & np.isin(o_ckey, cust)
+    okeys = o_okey[om]
+    lm = (l_ship > 9204) & np.isin(l_okey, okeys)
+    lk = l_okey[lm]
+    rev = l_price[lm] * (100 - l_disc[lm])
+    order = np.argsort(lk, kind="stable")
+    lk_s, rev_s = lk[order], rev[order]
+    starts = np.flatnonzero(np.r_[True, lk_s[1:] != lk_s[:-1]])
+    sums = np.add.reduceat(rev_s, starts) if lk_s.size else np.array([])
+    keys = lk_s[starts] if lk_s.size else np.array([], np.int64)
+    top = np.argsort(-sums, kind="stable")[:10]
+    return keys[top], sums[top]
+
+
 ORDERS_ROWS_PER_SF = 1_500_000
 
 

@@ -209,3 +209,19 @@ def test_out_of_core_sort_matches_in_core(session):
     # metrics show the OOC path ran
     ms = dfq.last_metrics()
     assert any(v.get("oocRangePartitions") for v in ms.values())
+
+
+def test_chained_join_duplicate_names_preserved(session):
+    import pyarrow as pa
+    t1 = session.create_dataframe({"k": pa.array([1, 2, 3], pa.int64()),
+                                   "x": pa.array([10, 20, 30], pa.int64())})
+    t2 = session.create_dataframe({"k": pa.array([1, 2, 3], pa.int64()),
+                                   "x": pa.array([100, 200, 300],
+                                                 pa.int64())})
+    t3 = session.create_dataframe({"k": pa.array([1, 2, 3], pa.int64()),
+                                   "y": pa.array([7, 8, 9], pa.int64())})
+    out = t1.join(t2, on=["k"]).join(t3, on=["k"]).to_arrow()
+    rows = sorted(tuple(out.column(i)[j].as_py()
+                        for i in range(out.num_columns))
+                  for j in range(out.num_rows))
+    assert rows == [(1, 10, 100, 7), (2, 20, 200, 8), (3, 30, 300, 9)]
