@@ -1,0 +1,177 @@
+"""Regex expressions: RLike, RegexpExtract, RegexpReplace.
+
+(reference: the regex transpiler RegexParser.scala:47 /
+CudfRegexTranspiler:696 feeding cuDF RegexProgram kernels via
+stringFunctions.scala rules.) Patterns compile at bind time to a
+bit-parallel NFA (ops/regex_nfa.py); unsupported patterns raise
+UnsupportedExpr so the planner tags/falls back instead of crashing.
+
+Deviations documented in docs/compatibility.md (Regex): byte-domain
+matching, greedy-longest alternation order, MAX_SCAN-byte scan bound.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.column import bucket_capacity
+from ..ops.kernel_utils import CV
+from ..ops.regex_exec import (MAX_SCAN, extract_first, nfa_match,
+                              replace_all)
+from ..ops.regex_nfa import (Concat, Group, RegexUnsupported, compile_nfa,
+                             parse, _len_bounds)
+from .expressions import Expression, UnsupportedExpr
+from .string_exprs import _require_string
+
+__all__ = ["RLike", "RegexpExtract", "RegexpReplace"]
+
+
+def _compile(pattern: str):
+    try:
+        return compile_nfa(pattern)
+    except RegexUnsupported as e:
+        raise UnsupportedExpr(
+            f"regex pattern {pattern!r} outside the TPU-transpilable "
+            f"subset: {e}") from e
+
+
+class RLike(Expression):
+    """`str rlike pattern` — unanchored regex search (Java semantics on
+    the supported subset)."""
+
+    def __init__(self, child: Expression, pattern: str):
+        self.child = child
+        self.pattern = pattern
+        self.children = [child]
+
+    def bind(self, schema):
+        c = self.child.bind(schema)
+        _require_string(c, "rlike")
+        b = RLike(c, self.pattern)
+        b._rx = _compile(self.pattern)
+        b.dtype = dt.BOOL
+        return b
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        # scan whole rows (an unanchored match can start anywhere), up to
+        # the MAX_SCAN byte bound (documented)
+        L = min(MAX_SCAN, int(cv.data.shape[0]))
+        m = nfa_match(self._rx, cv, max(L, 1))
+        return CV(m, cv.validity)
+
+    def __repr__(self):
+        return f"({self.child!r} RLIKE {self.pattern!r})"
+
+
+class RegexpReplace(Expression):
+    """regexp_replace(str, pattern, replacement-literal): replace all
+    non-overlapping matches."""
+
+    def __init__(self, child: Expression, pattern: str, replacement: str):
+        self.child = child
+        self.pattern = pattern
+        self.replacement = replacement
+        self.children = [child]
+        if "$" in replacement or "\\" in replacement:
+            raise UnsupportedExpr(
+                "regexp_replace group references in replacement")
+
+    def bind(self, schema):
+        c = self.child.bind(schema)
+        _require_string(c, "regexp_replace")
+        b = RegexpReplace(c, self.pattern, self.replacement)
+        b._rx = _compile(self.pattern)
+        b.dtype = dt.STRING
+        return b
+
+    def emit(self, ctx):
+        cv = self.child.emit(ctx)
+        rx = self._rx
+        B = int(cv.data.shape[0])
+        max_match = min(rx.max_len if rx.max_len is not None else MAX_SCAN,
+                        MAX_SCAN, B)
+        rl = len(self.replacement.encode())
+        if rx.min_len <= 0:
+            factor = rl + 1
+        else:
+            factor = max(1, -(-rl // rx.min_len))
+        out_cap = bucket_capacity(B * factor)
+        return replace_all(rx, cv, self.replacement.encode(),
+                           max(max_match, 1), out_cap)
+
+    def __repr__(self):
+        return (f"regexp_replace({self.child!r}, {self.pattern!r}, "
+                f"{self.replacement!r})")
+
+
+class RegexpExtract(Expression):
+    """regexp_extract(str, pattern, idx): substring matched by group idx
+    of the first match; '' when no match (Spark semantics).
+
+    idx=0 extracts the whole match. idx>0 is supported when the group is
+    a top-level concat element with fixed-length prefix and suffix
+    subpatterns (e.g. `foo=([0-9]+);`), else tagged unsupported."""
+
+    def __init__(self, child: Expression, pattern: str, idx: int = 0):
+        self.child = child
+        self.pattern = pattern
+        self.idx = idx
+        self.children = [child]
+
+    def bind(self, schema):
+        c = self.child.bind(schema)
+        _require_string(c, "regexp_extract")
+        b = RegexpExtract(c, self.pattern, self.idx)
+        b._rx = _compile(self.pattern)
+        b._pre, b._post = self._group_margins()
+        b.dtype = dt.STRING
+        return b
+
+    def _group_margins(self):
+        if self.idx == 0:
+            return 0, 0
+        ast, _, aend, ngroups = parse(self.pattern)
+        if aend:
+            # the compiled NFA consumes an optional final line terminator
+            # for '$', which would shift the fixed post-margin
+            raise UnsupportedExpr(
+                "regexp_extract group with a $-anchored pattern")
+        if self.idx > ngroups:
+            raise UnsupportedExpr(
+                f"regexp_extract group {self.idx} of {ngroups}")
+        parts = ast.parts if isinstance(ast, Concat) else [ast]
+        gpos = None
+        for i, p in enumerate(parts):
+            if isinstance(p, Group) and p.index == self.idx:
+                gpos = i
+                break
+        if gpos is None:
+            raise UnsupportedExpr(
+                "regexp_extract group must be a top-level concat element")
+        pre_lo, pre_hi = _len_bounds(Concat(parts[:gpos]))
+        post_lo, post_hi = _len_bounds(Concat(parts[gpos + 1:]))
+        if pre_lo != pre_hi or post_lo != post_hi:
+            raise UnsupportedExpr(
+                "regexp_extract needs fixed-length text around the group")
+        return pre_lo, post_lo
+
+    def emit(self, ctx):
+        from ..ops.strings import rebuild_strings
+        cv = self.child.emit(ctx)
+        rx = self._rx
+        B = int(cv.data.shape[0])
+        max_match = min(rx.max_len if rx.max_len is not None else MAX_SCAN,
+                        MAX_SCAN, B)
+        start, ln, found = extract_first(rx, cv, max(max_match, 1))
+        gstart = start + self._pre
+        glen = jnp.maximum(ln - self._pre - self._post, 0)
+        # no match -> empty string (Spark), null in -> null out
+        gstart = jnp.where(found, gstart, 0).astype(jnp.int32)
+        glen = jnp.where(found, glen, 0).astype(jnp.int32)
+        out = rebuild_strings(cv, gstart, glen)
+        return CV(out.data, cv.validity, out.offsets)
+
+    def __repr__(self):
+        return (f"regexp_extract({self.child!r}, {self.pattern!r}, "
+                f"{self.idx})")

@@ -345,3 +345,18 @@ def repeat(e, n):
 
 def concat_ws(sep, *es):
     return _se.ConcatWs(sep, *[_to_expr(e) for e in es])
+
+
+def rlike(e, pattern: str):
+    from .expr.regex_exprs import RLike
+    return RLike(_to_expr(e), pattern)
+
+
+def regexp_extract(e, pattern: str, idx: int = 0):
+    from .expr.regex_exprs import RegexpExtract
+    return RegexpExtract(_to_expr(e), pattern, idx)
+
+
+def regexp_replace(e, pattern: str, replacement: str):
+    from .expr.regex_exprs import RegexpReplace
+    return RegexpReplace(_to_expr(e), pattern, replacement)

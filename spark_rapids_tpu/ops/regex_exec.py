@@ -1,0 +1,235 @@
+"""Vectorized NFA execution over string columns.
+
+Bit-parallel Thompson simulation: per lane a uint32 state bitmask; each
+scan step gathers one byte per lane, looks up its equivalence class, and
+advances every active state through the dense transition table — all
+fused VPU work, no per-row control flow (the TPU answer to cuDF's regex
+kernel; reference: jni RegexProgram usage in stringFunctions.scala).
+
+Two drivers:
+- `nfa_match` (rlike): one lane per ROW, scan over character positions.
+- `match_spans` (extract/replace): one lane per BYTE POSITION — computes
+  for every position whether a match starts there and its greedy-longest
+  length; `_leftmost_nonoverlap` then picks the matches a left-to-right
+  scan would, by pointer-jumping over the skip chain.
+
+Documented deviations (docs/compatibility.md Regex): byte-domain (ASCII
+exact; multi-byte UTF-8 matched bytewise), greedy-longest instead of
+backtracking order for alternations of different lengths, zero-length
+matches at end-of-string are not replaced.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .kernel_utils import CV
+from .regex_nfa import CompiledRegex
+
+__all__ = ["nfa_match", "match_spans", "replace_all", "extract_first",
+           "MAX_SCAN"]
+
+# scan-length safety bound: matches past this byte offset in longer rows
+# are missed (documented in docs/compatibility.md Regex)
+MAX_SCAN = 256
+
+
+def _advance(state, cls_id, trans_dev, n_states):
+    """One NFA step for all lanes: state uint32[n], cls_id int32[n]."""
+    nxt = jnp.zeros_like(state)
+    for s in range(n_states):
+        active = ((state >> np.uint32(s)) & np.uint32(1)).astype(jnp.bool_)
+        nxt = nxt | jnp.where(active, trans_dev[s][cls_id], jnp.uint32(0))
+    return nxt
+
+
+def nfa_match(rx: CompiledRegex, cv: CV, max_len: int):
+    """bool[n]: does each row match (Spark rlike = unanchored search)."""
+    n = cv.offsets.shape[0] - 1
+    starts = cv.offsets[:-1]
+    lens = (cv.offsets[1:] - starts).astype(jnp.int32)
+    data = cv.data
+    dcap = data.shape[0]
+    ctab = jnp.asarray(rx.class_table.astype(np.int32))
+    trans_dev = [jnp.asarray(rx.trans[s]) for s in range(rx.n_states)]
+    start_mask = jnp.uint32(rx.start_mask)
+    accept = jnp.uint32(rx.accept_mask)
+
+    state0 = jnp.full(n, rx.start_mask, jnp.uint32)
+    zero_ok = bool(rx.start_mask & rx.accept_mask)
+    if zero_ok:
+        # the empty match: always for unanchored-end; at len==0 otherwise
+        matched0 = (jnp.ones(n, jnp.bool_) if not rx.anchored_end
+                    else (lens == 0))
+    else:
+        matched0 = jnp.zeros(n, jnp.bool_)
+    final0 = jnp.where(lens == 0, state0, jnp.zeros(n, jnp.uint32))
+
+    def body(carry, t):
+        state, matched, final = carry
+        idx = jnp.clip(starts + t, 0, dcap - 1)
+        inb = t < lens
+        cls = ctab[data[idx].astype(jnp.int32)]
+        nxt = _advance(state, cls, trans_dev, rx.n_states)
+        if not rx.anchored_start:
+            nxt = nxt | start_mask    # search: a match may start anywhere
+        nxt = jnp.where(inb, nxt, state)
+        if rx.anchored_end:
+            final = jnp.where(t + 1 == lens, nxt, final)
+        else:
+            matched = matched | (inb & ((nxt & accept) != 0))
+        return (nxt, matched, final), None
+
+    (_, matched, final), _ = jax.lax.scan(
+        body, (state0, matched0, final0),
+        jnp.arange(int(max_len), dtype=jnp.int32))
+    if rx.anchored_end:
+        matched = matched0 | ((final & accept) != 0)
+    return matched & cv.validity
+
+
+def match_spans(rx: CompiledRegex, cv: CV, max_match: int):
+    """(ok bool[B], length int32[B]): for every byte position, whether a
+    match starts there (anchored at that position) and its greedy-longest
+    length, bounded by max_match bytes. Matches never cross row ends."""
+    from .strings import byte_row_map
+    data = cv.data
+    B = data.shape[0]
+    row = byte_row_map(cv.offsets, B)
+    row_start = cv.offsets[:-1][row]
+    row_end = cv.offsets[1:][row]
+    ctab = jnp.asarray(rx.class_table.astype(np.int32))
+    trans_dev = [jnp.asarray(rx.trans[s]) for s in range(rx.n_states)]
+    accept = jnp.uint32(rx.accept_mask)
+    pos = jnp.arange(B, dtype=jnp.int32)
+
+    state0 = jnp.full(B, rx.start_mask, jnp.uint32)
+    zero_ok = bool(rx.start_mask & rx.accept_mask)
+    best0 = jnp.full(B, 0 if (zero_ok and not rx.anchored_end) else -1,
+                     jnp.int32)
+
+    def body(carry, j):
+        state, best = carry
+        idx = jnp.clip(pos + j, 0, B - 1)
+        inb = (pos + j) < row_end
+        cls = ctab[data[idx].astype(jnp.int32)]
+        nxt = _advance(state, cls, trans_dev, rx.n_states)
+        nxt = jnp.where(inb, nxt, jnp.uint32(0))
+        hit = (nxt & accept) != 0
+        if rx.anchored_end:
+            hit = hit & ((pos + j + 1) == row_end)
+        best = jnp.where(hit, j + 1, best)
+        return (nxt, best), None
+
+    (_, best), _ = jax.lax.scan(
+        body, (state0, best0),
+        jnp.arange(int(max_match), dtype=jnp.int32))
+    ok = best >= 0
+    if rx.anchored_start:
+        ok = ok & (pos == row_start)
+    ok = ok & (pos < cv.offsets[-1])
+    return ok, jnp.maximum(best, 0)
+
+
+def _leftmost_nonoverlap(cv: CV, ok, length):
+    """Positions a left-to-right scan would select: walk each row from its
+    start, skipping max(len,1) at a match else 1. Pointer-jumping over the
+    skip chain marks the visited positions in O(log B) doubling steps."""
+    B = ok.shape[0]
+    pos = jnp.arange(B, dtype=jnp.int32)
+    step = jnp.where(ok, jnp.maximum(length, 1), 1)
+    jump = jnp.minimum(pos + step, B)
+    from .strings import byte_row_map
+    row = byte_row_map(cv.offsets, B)
+    row_start = cv.offsets[:-1][row]
+    visited = (pos == row_start) & (pos < cv.offsets[-1])
+    n_steps = max(1, int(np.ceil(np.log2(max(B, 2)))) + 1)
+
+    def body(carry, _):
+        visited, jump = carry
+        targets = jnp.where(visited, jump, B)
+        newly = jnp.zeros(B + 1, jnp.bool_).at[targets].set(True)[:B]
+        visited = visited | newly
+        jext = jnp.concatenate([jump, jnp.full(1, B, jnp.int32)])
+        jump = jext[jump]
+        return (visited, jump), None
+
+    (visited, _), _ = jax.lax.scan(body, (visited, jump),
+                                   jnp.arange(n_steps))
+    return visited & ok
+
+
+def replace_all(rx: CompiledRegex, cv: CV, repl: bytes, max_match: int,
+                out_capacity: int) -> CV:
+    """Replace every selected (leftmost, non-overlapping) match with the
+    literal `repl`. Output layout: at a match start the replacement bytes
+    are emitted; bytes covered by a match are dropped; everything else
+    copies through."""
+    ok, length = match_spans(rx, cv, max_match)
+    sel = _leftmost_nonoverlap(cv, ok, length)
+    B = cv.data.shape[0]
+    pos = jnp.arange(B, dtype=jnp.int32)
+    in_row = pos < cv.offsets[-1]
+    sel = sel & in_row
+
+    covered = jnp.zeros(B + 1, jnp.int32)
+    mstart = jnp.where(sel, pos, B)
+    mend = jnp.where(sel, jnp.minimum(pos + jnp.maximum(length, 0), B), B)
+    covered = covered.at[mstart].add(1).at[mend].add(-1)
+    covered = jnp.cumsum(covered[:B]) > 0
+    keep = in_row & ~covered
+
+    rl = len(repl)
+    contrib = jnp.where(sel, rl, 0) + keep.astype(jnp.int32)
+    from .strings import byte_row_map
+    n = cv.offsets.shape[0] - 1
+    row = byte_row_map(cv.offsets, B)
+    row_safe = jnp.clip(row, 0, n - 1)
+    out_len = jax.ops.segment_sum(jnp.where(in_row, contrib, 0),
+                                  row_safe, n)
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(out_len).astype(jnp.int32)])
+    excl = jnp.cumsum(contrib) - contrib
+    row_base = jax.ops.segment_min(
+        jnp.where(in_row, excl, jnp.iinfo(jnp.int32).max), row_safe, n)
+    row_base = jnp.where(out_len > 0, row_base, 0)
+    dst_base = new_off[:-1][row_safe] + (excl - row_base[row_safe])
+
+    out = jnp.zeros(out_capacity, jnp.uint8)
+    dst_keep = dst_base + jnp.where(sel, rl, 0)
+    ok_keep = keep & (dst_keep < out_capacity)
+    out = out.at[jnp.minimum(dst_keep, out_capacity - 1)].max(
+        jnp.where(ok_keep, cv.data, 0).astype(jnp.uint8))
+    for k in range(rl):
+        dsel = dst_base + k
+        ok_r = sel & (dsel < out_capacity)
+        out = out.at[jnp.minimum(dsel, out_capacity - 1)].max(
+            jnp.where(ok_r, jnp.uint8(repl[k]), jnp.uint8(0)))
+    return CV(out, cv.validity, new_off)
+
+
+def extract_first(rx: CompiledRegex, cv: CV, max_match: int):
+    """(start int32[n], length int32[n], found bool[n]) of the leftmost
+    (then greedy-longest) whole match per row."""
+    from .strings import byte_row_map
+    ok, length = match_spans(rx, cv, max_match)
+    B = cv.data.shape[0]
+    pos = jnp.arange(B, dtype=jnp.int32)
+    row = byte_row_map(cv.offsets, B)
+    n = cv.offsets.shape[0] - 1
+    row_safe = jnp.clip(row, 0, n - 1)
+    in_row = pos < cv.offsets[-1]
+    cand = jnp.where(ok & in_row, pos, B)
+    first = jax.ops.segment_min(cand, row_safe, n)
+    found = first < B
+    safe = jnp.clip(first, 0, B - 1)
+    ln = jnp.where(found, length[safe], 0)
+    start = jnp.where(found, safe, cv.offsets[:-1])
+    zero_ok = bool(rx.start_mask & rx.accept_mask)
+    if zero_ok and not rx.anchored_end:
+        # a zero-length match always exists (e.g. `x*`): empty rows match
+        found = jnp.ones(n, jnp.bool_)
+    return start, ln, found & cv.validity

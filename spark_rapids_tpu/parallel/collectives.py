@@ -49,6 +49,9 @@ def exchange_rows(arrays: Sequence[jnp.ndarray], mask, pids,
 
     out_arrays = []
     for a in arrays:
+        as_bool = a.dtype == jnp.bool_
+        if as_bool:
+            a = a.astype(jnp.uint8)  # scatter-add rejects bool operands
         a_sorted = a[order]
         send = jnp.zeros((n_shards, cap), a.dtype)
         # scatter-add: dead rows contribute identity even when their
@@ -57,7 +60,8 @@ def exchange_rows(arrays: Sequence[jnp.ndarray], mask, pids,
             jnp.where(live_sorted, a_sorted, jnp.zeros_like(a_sorted)))
         recv = jax.lax.all_to_all(send, axis_name, split_axis=0,
                                   concat_axis=0, tiled=False)
-        out_arrays.append(recv.reshape(-1))
+        flat = recv.reshape(-1)
+        out_arrays.append(flat.astype(jnp.bool_) if as_bool else flat)
     send_mask = jnp.zeros((n_shards, cap), jnp.bool_)
     send_mask = send_mask.at[safe_pid, safe_rank].max(live_sorted)
     recv_mask = jax.lax.all_to_all(send_mask, axis_name, split_axis=0,

@@ -1,0 +1,133 @@
+"""Regex transpiler tests: rlike/regexp_extract/regexp_replace against
+Python `re` as the dual-run oracle (Java and Python agree on this subset),
+plus rejection tagging for unsupported patterns."""
+import re
+
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import UnsupportedExpr, col
+
+STRINGS = ["", "a", "abc", "aX9", "hello world", "2024-01-31",
+           "foo=123;bar", "aaab", "xyzzy", "a1b2c3", "  pad  ",
+           "CAPS and lower", "tail\n", "line1\nline2", "9" * 40,
+           None, "ab" * 30, "x=;", "=1;", "foo=;"]
+
+
+def _df(session):
+    return session.create_dataframe({"s": pa.array(STRINGS, pa.string())})
+
+
+RLIKE_PATTERNS = [
+    r"abc",
+    r"^a",
+    r"[0-9]+",
+    r"^[a-z]+$",
+    r"\d{2}-\d{2}",
+    r"foo|bar",
+    r"a+b",
+    r"^\s*pad\s*$",
+    r"l.ne",
+    r"(ab)+",
+    r"x?y?z{2}",
+    r"^$",
+    r"[^a-z]",
+    r"\w+=\d*;",
+]
+
+
+@pytest.mark.parametrize("pat", RLIKE_PATTERNS)
+def test_rlike_matches_re(session, pat):
+    out = _df(session).select(F.rlike(col("s"), pat).alias("m")).to_arrow()
+    got = out.column(0).to_pylist()
+    want = [None if s is None else bool(re.search(pat, s))
+            for s in STRINGS]
+    assert got == want, (pat, list(zip(STRINGS, got, want)))
+
+
+@pytest.mark.parametrize("pat,repl", [
+    (r"[0-9]+", "#"),
+    (r"a", "AA"),
+    (r"\s+", "_"),
+    (r"ab", ""),
+    (r"l.ne", "LINE"),
+    (r"foo|bar", "Z"),
+])
+def test_regexp_replace_matches_re(session, pat, repl):
+    out = _df(session).select(
+        F.regexp_replace(col("s"), pat, repl).alias("r")).to_arrow()
+    got = out.column(0).to_pylist()
+    want = [None if s is None else re.sub(pat, repl, s) for s in STRINGS]
+    assert got == want, (pat, [(s, g, w) for s, g, w
+                               in zip(STRINGS, got, want) if g != w])
+
+
+@pytest.mark.parametrize("pat,idx", [
+    (r"[0-9]+", 0),
+    (r"^[a-z]+", 0),
+    (r"foo=([0-9]*);", 1),
+    (r"=(\d*);", 1),
+    (r"(\d{4})-(\d{2})", 1),
+    (r"l.ne", 0),
+])
+def test_regexp_extract_matches_re(session, pat, idx):
+    out = _df(session).select(
+        F.regexp_extract(col("s"), pat, idx).alias("e")).to_arrow()
+    got = out.column(0).to_pylist()
+
+    def ref(s):
+        if s is None:
+            return None
+        m = re.search(pat, s)
+        return m.group(idx) if m else ""
+    want = [ref(s) for s in STRINGS]
+    assert got == want, (pat, [(s, g, w) for s, g, w
+                               in zip(STRINGS, got, want) if g != w])
+
+
+def test_rlike_in_filter(session):
+    df = _df(session)
+    out = df.filter(F.rlike(col("s"), r"^\w+$")).to_arrow()
+    got = sorted(out.column(0).to_pylist())
+    want = sorted(s for s in STRINGS
+                  if s is not None and re.search(r"^\w+$", s))
+    assert got == want
+
+
+@pytest.mark.parametrize("pat", [
+    r"a*?",          # lazy
+    r"(?=x)y",       # lookahead
+    r"\bword",       # word boundary
+    r"(a)\1",        # backreference
+    r"a" * 40,       # too many states
+    r"café",    # non-ASCII (as a literal é in the pattern)
+])
+def test_unsupported_patterns_tagged(session, pat):
+    df = _df(session)
+    with pytest.raises(UnsupportedExpr):
+        df.select(F.rlike(col("s"), pat).alias("m")).to_arrow()
+
+
+def test_rlike_nfa_compiler_units():
+    from spark_rapids_tpu.ops.regex_nfa import compile_nfa
+    rx = compile_nfa(r"^[ab]+c$")
+    assert rx.anchored_start and rx.anchored_end
+    assert rx.min_len == 2 and rx.max_len is None
+    rx2 = compile_nfa(r"\d{2,4}")
+    assert rx2.min_len == 2 and rx2.max_len == 4
+
+
+@pytest.mark.parametrize("pat", [
+    r"a|b$", r"^a|b", r"a{x}", r"a{1,2,3}", r"a{-2}", r"\xZZ",
+])
+def test_malformed_and_branch_anchor_patterns_rejected(session, pat):
+    with pytest.raises(UnsupportedExpr):
+        _df(session).select(F.rlike(col("s"), pat).alias("m")).to_arrow()
+
+
+def test_extract_group_dollar_anchored_rejected(session):
+    with pytest.raises(UnsupportedExpr):
+        _df(session).select(
+            F.regexp_extract(col("s"), r"=(\d*);$", 1).alias("e")
+        ).to_arrow()
