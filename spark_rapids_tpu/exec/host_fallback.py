@@ -1,0 +1,93 @@
+"""Host-fallback execution nodes (the GpuCpuBridge analog).
+
+(reference: GpuCpuBridgeExpression.scala / GpuCpuBridgeThreadPool.scala —
+unsupported expressions copy to host rows, evaluate on CPU, and return to
+the device; RapidsMeta tags explain why.) A batch round-trips
+device -> arrow -> row dicts -> interpreter (expr/host_eval.py) ->
+arrow -> device. Slow and proud of it: the alternative is a failed query.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import pyarrow as pa
+
+from ..columnar.table import Schema, Table
+from ..expr.host_eval import host_eval_rows
+from .base import ExecContext, TpuExec
+from .batch import DeviceBatch
+
+__all__ = ["HostFilterExec", "HostProjectExec"]
+
+
+def _batch_rows(batch: DeviceBatch):
+    from .nodes import _batch_to_arrow
+    at = _batch_to_arrow(batch)
+    names = at.schema.names
+    cols = [at.column(i).to_pylist() for i in range(at.num_columns)]
+    rows = [dict(zip(names, vals)) for vals in zip(*cols)] \
+        if at.num_rows else []
+    return at, rows
+
+
+class HostFilterExec(TpuExec):
+    """Filter whose predicate runs on host rows."""
+
+    def __init__(self, child: TpuExec, condition, reason: str):
+        super().__init__([child], child.schema)
+        self.condition = condition
+        self.reason = reason
+
+    def describe(self):
+        return f"HostFilterExec[{self.condition!r}]  (CPU: {self.reason})"
+
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        m = ctx.metrics_for(self._op_id)
+        for batch in self.children[0].execute_partition(ctx, pid):
+            with m.timer("hostEvalTime"):
+                at, rows = _batch_rows(batch)
+                if not rows:
+                    continue
+                keep = host_eval_rows(self.condition, rows)
+                mask = pa.array([bool(k) if k is not None else False
+                                 for k in keep])
+                filtered = at.filter(mask)
+            if filtered.num_rows == 0:
+                continue
+            tbl = Table.from_arrow(filtered)
+            m.add("numOutputBatches", 1)
+            m.add("numOutputRows", filtered.num_rows)
+            yield DeviceBatch(tbl, filtered.num_rows)
+
+
+class HostProjectExec(TpuExec):
+    """Project where SOME output expressions run on host rows; supported
+    ones still evaluate there too (whole-node fallback, round 2 — the
+    reference bridges per-expression)."""
+
+    def __init__(self, child: TpuExec, exprs, schema: Schema, reason: str):
+        super().__init__([child], schema)
+        self.exprs = list(exprs)
+        self.reason = reason
+
+    def describe(self):
+        return (f"HostProjectExec[{len(self.exprs)} exprs]  "
+                f"(CPU: {self.reason})")
+
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        m = ctx.metrics_for(self._op_id)
+        for batch in self.children[0].execute_partition(ctx, pid):
+            with m.timer("hostEvalTime"):
+                at, rows = _batch_rows(batch)
+                arrays = []
+                from ..columnar.dtypes import to_arrow as dt_to_arrow
+                for e, f in zip(self.exprs, self.schema.fields):
+                    vals = host_eval_rows(e, rows)
+                    arrays.append(pa.array(vals, dt_to_arrow(f.dtype)))
+                out = (pa.Table.from_arrays(arrays,
+                                            names=list(self.schema.names))
+                       if arrays else pa.table({}))
+            tbl = Table.from_arrow(out)
+            m.add("numOutputBatches", 1)
+            m.add("numOutputRows", out.num_rows)
+            yield DeviceBatch(tbl, out.num_rows)

@@ -66,10 +66,58 @@ class InMemoryScanExec(TpuExec):
         yield DeviceBatch(tbl, num_rows=max(n, 0))
 
 
+def _rg_survives(stats, op: str, value) -> bool:
+    """Can a row group with these column stats contain a matching row?"""
+    if stats is None or not stats.has_min_max:
+        return True
+    lo, hi = stats.min, stats.max
+    try:
+        if op == ">=":
+            return hi >= value
+        if op == ">":
+            return hi > value
+        if op == "<=":
+            return lo <= value
+        if op == "<":
+            return lo < value
+        if op == "=":
+            return lo <= value <= hi
+    except TypeError:
+        return True  # incomparable stat/literal types: keep the group
+    return True
+
+
+def prune_row_groups(pf, filters) -> List[int]:
+    """Row groups whose footer stats might satisfy every conjunct
+    (the filterBlocks analog: reference GpuParquetScan.scala:679)."""
+    md = pf.metadata
+    name_to_idx = {md.schema.column(i).name: i
+                   for i in range(md.num_columns)}
+    kept = []
+    for rg in range(md.num_row_groups):
+        g = md.row_group(rg)
+        ok = True
+        for (name, op, value) in filters:
+            ci = name_to_idx.get(name)
+            if ci is None:
+                continue
+            if not _rg_survives(g.column(ci).statistics, op, value):
+                ok = False
+                break
+        if ok:
+            kept.append(rg)
+    return kept
+
+
 class ParquetScanExec(TpuExec):
-    """PERFILE/MULTITHREADED parquet reader: host decode via Arrow C++,
-    one H2D per batch (reference: GpuParquetScan.scala readers; device
-    decode is follow-on work — footnote in docs/compatibility.md)."""
+    """Parquet reader (reference: GpuParquetScan.scala reader types):
+    - footer-stats row-group pruning from pushed-down conjuncts
+      (filterBlocks :679)
+    - MULTITHREADED mode: a thread pool decodes batches ahead of the
+      device consumer through a bounded queue (the cloud reader :3134
+      fetch/decode overlap, host-side)
+    Host decode via Arrow C++, one H2D per batch; device decode is
+    follow-on work (docs/compatibility.md)."""
 
     def __init__(self, paths: Sequence[str], schema: Schema,
                  columns: Optional[Sequence[str]] = None,
@@ -77,26 +125,105 @@ class ParquetScanExec(TpuExec):
         super().__init__([], schema)
         self.paths = list(paths)
         self.columns = list(columns) if columns else None
-        self.filters = filters
+        self.filters = list(filters) if filters else None
 
     def num_partitions(self, ctx):
         return len(self.paths)
 
-    def execute_partition(self, ctx, pid) -> Iterator[DeviceBatch]:
+    def describe(self):
+        f = f", filters={self.filters}" if self.filters else ""
+        return f"ParquetScanExec[{len(self.paths)} files{f}]"
+
+    def _decoded_batches(self, ctx, path, m):
+        import pyarrow as pa
         import pyarrow.parquet as pq
-        m = ctx.metrics_for(self._op_id)
-        path = self.paths[pid]
         per = max(1, ctx.conf.batch_size_rows)
         pf = pq.ParquetFile(path)
         cols = (self.columns if self.columns is not None
                 else [f.name for f in self.schema.fields])
-        for rb in pf.iter_batches(batch_size=per, columns=cols):
+        if self.filters:
+            kept = prune_row_groups(pf, self.filters)
+            m.add("skippedRowGroups",
+                  pf.metadata.num_row_groups - len(kept))
+            if not kept:
+                return
+            it = pf.iter_batches(batch_size=per, columns=cols,
+                                 row_groups=kept)
+        else:
+            it = pf.iter_batches(batch_size=per, columns=cols)
+        for rb in it:
+            yield pa.table(rb)
+
+    def execute_partition(self, ctx, pid) -> Iterator[DeviceBatch]:
+        from ..config import MULTITHREADED_READ_THREADS, PARQUET_READER_TYPE
+        m = ctx.metrics_for(self._op_id)
+        path = self.paths[pid]
+        reader_type = str(ctx.conf.get(PARQUET_READER_TYPE)).upper()
+        host_iter = self._decoded_batches(ctx, path, m)
+        if reader_type == "MULTITHREADED":
+            nthreads = max(1, ctx.conf.get(MULTITHREADED_READ_THREADS))
+            host_iter = _prefetched(host_iter, depth=min(nthreads, 4))
+        for at in host_iter:
             with m.timer("scanTime"):
-                import pyarrow as pa
-                tbl = Table.from_arrow(pa.table(rb))
-            m.add("numOutputRows", rb.num_rows)
+                tbl = Table.from_arrow(at)
+            m.add("numOutputRows", at.num_rows)
             m.add("numOutputBatches", 1)
-            yield DeviceBatch(tbl, num_rows=rb.num_rows)
+            yield DeviceBatch(tbl, num_rows=at.num_rows)
+
+
+def _prefetched(it: Iterator, depth: int):
+    """Run `it` on a worker thread with a bounded queue so host parquet
+    decode overlaps device compute (async-IO analog, reference io/async
+    ThrottlingExecutor). An abandoned consumer (e.g. under a LIMIT)
+    signals the worker via a stop event and drains the queue so the
+    blocked put unblocks — no leaked threads or pinned batches."""
+    import queue
+    import threading
+    q: "queue.Queue" = queue.Queue(maxsize=depth)
+    DONE = object()
+    stop = threading.Event()
+    err: List[BaseException] = []
+
+    def work():
+        try:
+            for item in it:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # propagate to consumer
+            err.append(e)
+        finally:
+            # the sentinel must arrive even when the queue is full; keep
+            # trying unless the consumer already walked away
+            while not stop.is_set():
+                try:
+                    q.put(DONE, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
+
+    t = threading.Thread(target=work, daemon=True)
+    t.start()
+    try:
+        while True:
+            item = q.get()
+            if item is DONE:
+                if err:
+                    raise err[0]
+                return
+            yield item
+    finally:
+        stop.set()
+        while not q.empty():
+            try:
+                q.get_nowait()
+            except queue.Empty:
+                break
 
 
 class CachedScanExec(TpuExec):

@@ -1,0 +1,342 @@
+"""Host (CPU) expression interpreter — the graceful-fallback engine.
+
+(reference: GpuCpuBridgeExpression.scala — an unsupported expression
+subtree runs on the CPU instead of failing the whole query; RapidsMeta's
+"will not work on GPU because ..." tagging.) Here: when an expression
+cannot bind for TPU execution (e.g. a regex outside the transpilable
+subset), the planner keeps the UNBOUND tree and evaluates it row-wise on
+host Python values through this interpreter, then returns to the device.
+
+Slow by design — the point is that partial TPU coverage does not mean a
+failed query. Coverage is the common scalar/string/regex surface; an
+expression with no host rule raises UnsupportedExpr (the query then fails
+with both reasons).
+"""
+from __future__ import annotations
+
+import math
+import re as _re
+from typing import Any, Callable, Dict, List, Optional
+
+from ..columnar import dtypes as dt
+from .expressions import Expression, UnsupportedExpr
+
+__all__ = ["host_eval_rows", "host_output_dtype"]
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=256)
+def _java_like_to_re(pattern: str, escape: str = "\\"):
+    """Full SQL LIKE semantics (incl escapes) as a compiled anchored
+    regex (cached per pattern — one translation, not one per row)."""
+    out = []
+    i = 0
+    while i < len(pattern):
+        c = pattern[i]
+        if c == escape and i + 1 < len(pattern):
+            out.append(_re.escape(pattern[i + 1]))
+            i += 2
+            continue
+        if c == "%":
+            out.append(".*")
+        elif c == "_":
+            out.append(".")
+        else:
+            out.append(_re.escape(c))
+        i += 1
+    return _re.compile("(?s)^" + "".join(out) + "$")
+
+
+@functools.lru_cache(maxsize=256)
+def _java_repl_to_py(repl: str) -> str:
+    """Java regexp_replace replacement dialect -> Python re.sub template:
+    \\X = literal X, $n = group reference, all else literal."""
+    out = []
+    i = 0
+    while i < len(repl):
+        c = repl[i]
+        if c == "\\" and i + 1 < len(repl):
+            nxt = repl[i + 1]
+            out.append("\\\\" if nxt == "\\" else nxt)
+            i += 2
+        elif c == "$" and i + 1 < len(repl) and repl[i + 1].isdigit():
+            out.append("\\" + repl[i + 1])
+            i += 2
+        else:
+            out.append("\\\\" if c == "\\" else c)
+            i += 1
+    return "".join(out)
+
+
+def _num(x):
+    return x is not None
+
+
+# Each rule: fn(expr, child_values: list, row_env) -> value (None = null)
+_RULES: Dict[str, Callable] = {}
+
+
+def _rule(*names):
+    def deco(fn):
+        for n in names:
+            _RULES[n] = fn
+        return fn
+    return deco
+
+
+@_rule("Literal")
+def _lit(e, cv, env):
+    return e.value
+
+
+@_rule("ColumnRef")
+def _colref(e, cv, env):
+    return env[e.name]
+
+
+@_rule("BoundRef")
+def _bref(e, cv, env):
+    return env[e.name]
+
+
+@_rule("Alias")
+def _alias(e, cv, env):
+    return cv[0]
+
+
+@_rule("Add")
+def _add(e, cv, env):
+    a, b = cv
+    return None if a is None or b is None else a + b
+
+
+@_rule("Subtract")
+def _sub(e, cv, env):
+    a, b = cv
+    return None if a is None or b is None else a - b
+
+
+@_rule("Multiply")
+def _mul(e, cv, env):
+    a, b = cv
+    return None if a is None or b is None else a * b
+
+
+@_rule("Divide")
+def _div(e, cv, env):
+    a, b = cv
+    if a is None or b is None or b == 0:
+        return None
+    return a / b
+
+
+@_rule("Negate")
+def _neg(e, cv, env):
+    return None if cv[0] is None else -cv[0]
+
+
+@_rule("Abs")
+def _abs(e, cv, env):
+    return None if cv[0] is None else abs(cv[0])
+
+
+def _cmp(op):
+    def fn(e, cv, env):
+        a, b = cv
+        if a is None or b is None:
+            return None
+        return op(a, b)
+    return fn
+
+
+_RULES["Eq"] = _cmp(lambda a, b: a == b)
+_RULES["Ne"] = _cmp(lambda a, b: a != b)
+_RULES["Lt"] = _cmp(lambda a, b: a < b)
+_RULES["Le"] = _cmp(lambda a, b: a <= b)
+_RULES["Gt"] = _cmp(lambda a, b: a > b)
+_RULES["Ge"] = _cmp(lambda a, b: a >= b)
+
+
+@_rule("EqNullSafe")
+def _eqns(e, cv, env):
+    a, b = cv
+    if a is None and b is None:
+        return True
+    if a is None or b is None:
+        return False
+    return a == b
+
+
+@_rule("And")
+def _and(e, cv, env):
+    a, b = cv
+    if a is False or b is False:
+        return False
+    if a is None or b is None:
+        return None
+    return bool(a) and bool(b)
+
+
+@_rule("Or")
+def _or(e, cv, env):
+    a, b = cv
+    if a is True or b is True:
+        return True
+    if a is None or b is None:
+        return None
+    return bool(a) or bool(b)
+
+
+@_rule("Not")
+def _not(e, cv, env):
+    return None if cv[0] is None else not cv[0]
+
+
+@_rule("IsNull")
+def _isnull(e, cv, env):
+    return cv[0] is None
+
+
+@_rule("IsNotNull")
+def _isnotnull(e, cv, env):
+    return cv[0] is not None
+
+
+@_rule("Coalesce")
+def _coalesce(e, cv, env):
+    for v in cv:
+        if v is not None:
+            return v
+    return None
+
+
+@_rule("If")
+def _if(e, cv, env):
+    return cv[1] if cv[0] else cv[2]
+
+
+@_rule("In")
+def _in(e, cv, env):
+    v = cv[0]
+    if v is None:
+        return None
+    vals = cv[1:]
+    if v in [x for x in vals if x is not None]:
+        return True
+    return None if any(x is None for x in vals) else False
+
+
+# ---- strings ---------------------------------------------------------
+@_rule("Length")
+def _length(e, cv, env):
+    return None if cv[0] is None else len(cv[0])
+
+
+@_rule("Upper")
+def _upper(e, cv, env):
+    return None if cv[0] is None else cv[0].upper()
+
+
+@_rule("Lower")
+def _lower(e, cv, env):
+    return None if cv[0] is None else cv[0].lower()
+
+
+@_rule("Contains")
+def _contains(e, cv, env):
+    a, b = cv
+    return None if a is None or b is None else (b in a)
+
+
+@_rule("StartsWith")
+def _startswith(e, cv, env):
+    a, b = cv
+    return None if a is None or b is None else a.startswith(b)
+
+
+@_rule("EndsWith")
+def _endswith(e, cv, env):
+    a, b = cv
+    return None if a is None or b is None else a.endswith(b)
+
+
+@_rule("ConcatStr")
+def _concatstr(e, cv, env):
+    if any(v is None for v in cv):
+        return None
+    return "".join(cv)
+
+
+@_rule("Like")
+def _like(e, cv_or_child, env):
+    s = cv_or_child[0]
+    if s is None:
+        return None
+    return _java_like_to_re(e.pattern).match(s) is not None
+
+
+@_rule("RLike")
+def _rlike(e, cv, env):
+    s = cv[0]
+    if s is None:
+        return None
+    return _re.search(e.pattern, s) is not None
+
+
+@_rule("RegexpExtract")
+def _regexp_extract(e, cv, env):
+    s = cv[0]
+    if s is None:
+        return None
+    m = _re.search(e.pattern, s)
+    if not m or e.idx > (m.re.groups):
+        return ""
+    g = m.group(e.idx)
+    return g if g is not None else ""
+
+
+@_rule("RegexpReplace")
+def _regexp_replace(e, cv, env):
+    s = cv[0]
+    if s is None:
+        return None
+    return _re.sub(e.pattern, _java_repl_to_py(e.replacement), s)
+
+
+# ---------------------------------------------------------------------
+def _eval_one(e: Expression, env) -> Any:
+    name = type(e).__name__
+    fn = _RULES.get(name)
+    if fn is None:
+        raise UnsupportedExpr(
+            f"no host (CPU fallback) implementation for {name}")
+    child_vals = [_eval_one(c, env) for c in e.children if c is not None]
+    return fn(e, child_vals, env)
+
+
+def host_eval_rows(expr: Expression, rows: List[dict]) -> List[Any]:
+    """Evaluate an UNBOUND expression tree over row dicts (name->value)."""
+    return [_eval_one(expr, row) for row in rows]
+
+
+# output dtype WITHOUT capability checks, for planning around fallbacks
+_DTYPE_HINTS = {
+    "RLike": dt.BOOL, "Like": dt.BOOL, "Contains": dt.BOOL,
+    "StartsWith": dt.BOOL, "EndsWith": dt.BOOL, "And": dt.BOOL,
+    "Or": dt.BOOL, "Not": dt.BOOL, "Eq": dt.BOOL, "Ne": dt.BOOL,
+    "Lt": dt.BOOL, "Le": dt.BOOL, "Gt": dt.BOOL, "Ge": dt.BOOL,
+    "EqNullSafe": dt.BOOL, "IsNull": dt.BOOL, "IsNotNull": dt.BOOL,
+    "In": dt.BOOL,
+    "RegexpExtract": dt.STRING, "RegexpReplace": dt.STRING,
+    "Upper": dt.STRING, "Lower": dt.STRING, "ConcatStr": dt.STRING,
+    "Length": dt.INT32,
+}
+
+
+def host_output_dtype(expr: Expression) -> Optional[dt.DataType]:
+    name = type(expr).__name__
+    if name == "Alias":
+        return host_output_dtype(expr.children[0])
+    return _DTYPE_HINTS.get(name)

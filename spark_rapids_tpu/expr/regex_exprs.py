@@ -73,13 +73,15 @@ class RegexpReplace(Expression):
         self.pattern = pattern
         self.replacement = replacement
         self.children = [child]
-        if "$" in replacement or "\\" in replacement:
-            raise UnsupportedExpr(
-                "regexp_replace group references in replacement")
 
     def bind(self, schema):
         c = self.child.bind(schema)
         _require_string(c, "regexp_replace")
+        if "$" in self.replacement or "\\" in self.replacement:
+            # group references need capture tracking: host fallback serves
+            # these (expr/host_eval.py translates $n)
+            raise UnsupportedExpr(
+                "regexp_replace group references in replacement")
         b = RegexpReplace(c, self.pattern, self.replacement)
         b._rx = _compile(self.pattern)
         b.dtype = dt.STRING

@@ -24,13 +24,21 @@ from typing import List, Optional, Set, Tuple
 
 import numpy as np
 
-__all__ = ["RegexUnsupported", "parse", "compile_nfa", "CompiledRegex"]
+__all__ = ["RegexUnsupported", "RegexSyntaxError", "parse", "compile_nfa",
+           "CompiledRegex"]
 
 MAX_STATES = 32
 
 
 class RegexUnsupported(Exception):
-    """Pattern outside the transpilable subset."""
+    """Valid Java pattern outside the transpilable subset — eligible for
+    the host CPU fallback."""
+
+
+class RegexSyntaxError(ValueError):
+    """Pattern Java itself would reject (PatternSyntaxException analog):
+    a hard user error, NOT eligible for fallback — Python `re` may parse
+    some of these as literals and silently change answers."""
 
 
 # ---------------------------------------------------------------------
@@ -149,22 +157,22 @@ class _Parser:
         if c == ord("{"):
             j = self.b.find(b"}", self.i)
             if j < 0:
-                raise RegexUnsupported("unterminated {..}")
+                raise RegexSyntaxError("unterminated {..}")
             body = self.b[self.i + 1:j].decode()
             self.i = j + 1
             self._no_lazy()
             import re as _re
             if not _re.fullmatch(r"\d+(,\d*)?", body):
-                raise RegexUnsupported(f"bad repeat {{{body}}}")
+                raise RegexSyntaxError(f"bad repeat {{{body}}}")
             if "," in body:
                 lo_s, hi_s = body.split(",", 1)
                 lo = int(lo_s)
                 hi = int(hi_s) if hi_s else None
             else:
                 lo = hi = int(body)
-            if hi is not None and (hi < lo or hi > 64):
-                raise RegexUnsupported(f"bad repeat bound {{{body}}}")
-            if lo > 64:
+            if hi is not None and hi < lo:
+                raise RegexSyntaxError(f"bad repeat bound {{{body}}}")
+            if lo > 64 or (hi is not None and hi > 64):
                 raise RegexUnsupported("repeat bound > 64")
             return Repeat(atom, lo, hi)
         return atom
@@ -188,7 +196,7 @@ class _Parser:
                 idx = self.ngroups
             inner = self._alt()
             if self.peek() != ord(")"):
-                raise RegexUnsupported("unbalanced group")
+                raise RegexSyntaxError("unbalanced group")
             self.take()
             return Group(inner, idx)
         if c == ord("["):
@@ -199,14 +207,14 @@ class _Parser:
             return self._escape(in_class=False)
         if c in (ord("*"), ord("+"), ord("?"), ord(")"), ord("]"),
                  ord("{"), ord("}")):
-            raise RegexUnsupported(f"dangling metachar {chr(c)!r}")
+            raise RegexSyntaxError(f"dangling metachar {chr(c)!r}")
         if c == ord("^"):
             raise RegexUnsupported("'^' not at pattern start")
         return Lit(c)
 
     def _escape(self, in_class: bool):
         if self.peek() is None:
-            raise RegexUnsupported("trailing backslash")
+            raise RegexSyntaxError("trailing backslash")
         c = self.take()
         simple = {ord("n"): 10, ord("t"): 9, ord("r"): 13, ord("f"): 12,
                   ord("a"): 7, ord("e"): 27, ord("0"): 0}
@@ -229,14 +237,18 @@ class _Parser:
             try:
                 val = int(h, 16)
             except ValueError:
-                raise RegexUnsupported("bad \\x escape")
+                raise RegexSyntaxError("bad \\x escape")
             if len(h) != 2:
-                raise RegexUnsupported("bad \\x escape")
+                raise RegexSyntaxError("bad \\x escape")
             self.i += 2
             return Lit(val)
         if chr(c) in ".*+?()[]{}|^$\\/-'\"!#%&,:;<=>@_`~ ":
             return Lit(c)
-        raise RegexUnsupported(f"escape \\{chr(c)!r}")
+        if chr(c) in "bBAzZG123456789pPucQEkhHvVRXN":
+            # valid Java constructs (boundaries, backrefs, \p classes,
+            # \uXXXX, ...) outside the subset -> host fallback
+            raise RegexUnsupported(f"escape \\{chr(c)} construct")
+        raise RegexSyntaxError(f"escape \\{chr(c)!r}")
 
     def _klass(self):
         neg = False
@@ -248,7 +260,7 @@ class _Parser:
         while True:
             c = self.peek()
             if c is None:
-                raise RegexUnsupported("unterminated class")
+                raise RegexSyntaxError("unterminated class")
             if c == ord("]") and not first:
                 self.take()
                 break
@@ -267,10 +279,10 @@ class _Parser:
                 if hi == ord("\\"):
                     hi_atom = self._escape(in_class=True)
                     if not isinstance(hi_atom, Lit):
-                        raise RegexUnsupported("class range to a class")
+                        raise RegexSyntaxError("class range to a class")
                     hi = hi_atom.byte
                 if hi < c:
-                    raise RegexUnsupported("reversed class range")
+                    raise RegexSyntaxError("reversed class range")
                 members |= set(range(c, hi + 1))
             else:
                 members.add(c)
@@ -325,7 +337,7 @@ def _build(nfa: _NFA, node, src: int, dst: int):
         nfa.edges.append((src, frozenset([node.byte]), dst))
     elif isinstance(node, Klass):
         if not node.bytes_in:
-            raise RegexUnsupported("empty character class")
+            raise RegexSyntaxError("empty character class")
         nfa.edges.append((src, node.bytes_in, dst))
     elif isinstance(node, Group):
         _build(nfa, node.child, src, dst)

@@ -76,10 +76,13 @@ class CachedScan(LogicalPlan):
 
 class ParquetScan(LogicalPlan):
     def __init__(self, paths: Sequence[str], schema: Optional[Schema] = None,
-                 columns: Optional[Sequence[str]] = None):
+                 columns: Optional[Sequence[str]] = None, filters=None):
         import pyarrow.parquet as pq
         self.paths = list(paths)
         self.columns = list(columns) if columns is not None else None
+        # (name, op, value) conjuncts for row-group pruning, attached by
+        # the optimizer from a Filter directly above the scan
+        self.filters = list(filters) if filters else None
         if schema is None:
             schema = Schema.from_arrow(pq.read_schema(self.paths[0]))
             if self.columns is not None:
@@ -98,12 +101,31 @@ class ParquetScan(LogicalPlan):
 
 class Project(LogicalPlan):
     def __init__(self, child: LogicalPlan, exprs: Sequence[Expression]):
+        from ..expr.expressions import UnsupportedExpr
+        from ..expr.host_eval import host_output_dtype
         self.child = child
         self.children = [child]
         self.exprs = list(exprs)
-        self.bound = [e.bind(child.schema) for e in self.exprs]
-        self._schema = Schema([Field(e.name, b.dtype)
-                               for e, b in zip(self.exprs, self.bound)])
+        self.bound = []
+        self.bind_errors: List[Optional[str]] = []
+        fields = []
+        for e in self.exprs:
+            try:
+                b = e.bind(child.schema)
+                self.bound.append(b)
+                self.bind_errors.append(None)
+                fields.append(Field(e.name, b.dtype))
+            except UnsupportedExpr as err:
+                # TPU cannot run this expression; keep the unbound tree
+                # for the host-fallback exec (GpuCpuBridge analog) when
+                # the output dtype is still derivable
+                hd = host_output_dtype(e)
+                if hd is None:
+                    raise
+                self.bound.append(None)
+                self.bind_errors.append(str(err))
+                fields.append(Field(e.name, hd))
+        self._schema = Schema(fields)
 
     @property
     def schema(self):
@@ -115,10 +137,16 @@ class Project(LogicalPlan):
 
 class Filter(LogicalPlan):
     def __init__(self, child: LogicalPlan, condition: Expression):
+        from ..expr.expressions import UnsupportedExpr
         self.child = child
         self.children = [child]
         self.condition = condition
-        self.bound = condition.bind(child.schema)
+        self.bind_error: Optional[str] = None
+        try:
+            self.bound = condition.bind(child.schema)
+        except UnsupportedExpr as err:
+            self.bound = None
+            self.bind_error = str(err)
 
     @property
     def schema(self):

@@ -81,6 +81,15 @@ def prune(plan: L.LogicalPlan,
             r = refs_of(plan.condition)
             creq = None if r is None else (required | r)
         child = prune(plan.child, creq)
+        if isinstance(child, L.ParquetScan):
+            # attach pushable conjuncts for row-group pruning (the
+            # filterBlocks analog: GpuParquetScan.scala:679); the Filter
+            # stays above for exact row filtering
+            conj = extract_conjuncts(plan.condition)
+            if conj:
+                child = L.ParquetScan(child.paths, child._schema,
+                                      child.columns,
+                                      (child.filters or []) + conj)
         return L.Filter(child, plan.condition)
     if isinstance(plan, L.Aggregate):
         creq = _refs_of_all(list(plan.keys) +
@@ -140,6 +149,35 @@ def _rebuild(plan: L.LogicalPlan, kids) -> L.LogicalPlan:
     if isinstance(plan, L.Repartition):
         return L.Repartition(kids[0], plan.num_partitions, plan.keys)
     return plan
+
+
+def extract_conjuncts(cond: Expression):
+    """Pull (name, op, literal) conjuncts usable for row-group stats
+    pruning out of a condition; non-matching branches are skipped (they
+    simply don't prune)."""
+    from ..expr.expressions import (And, ColumnRef, Eq, Ge, Gt, Le, Lt,
+                                    Literal)
+    out = []
+
+    def walk(e):
+        if isinstance(e, And):
+            walk(e.children[0])
+            walk(e.children[1])
+            return
+        ops = {Ge: ">=", Gt: ">", Le: "<=", Lt: "<", Eq: "="}
+        t = type(e)
+        if t in ops and len(e.children) == 2:
+            l, r = e.children
+            flip = {">=": "<=", ">": "<", "<=": ">=", "<": ">", "=": "="}
+            if isinstance(l, ColumnRef) and isinstance(r, Literal) \
+                    and r.value is not None:
+                out.append((l.name, ops[t], r.value))
+            elif isinstance(r, ColumnRef) and isinstance(l, Literal) \
+                    and l.value is not None:
+                out.append((r.name, flip[ops[t]], l.value))
+
+    walk(cond)
+    return out
 
 
 def _passthrough_names(project: L.Project) -> Set[str]:

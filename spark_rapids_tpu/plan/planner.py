@@ -25,15 +25,23 @@ __all__ = ["Planner", "PlanMeta", "plan_query"]
 
 
 class PlanMeta:
-    """Wrapper recording per-node TPU support (RapidsMeta analog)."""
+    """Wrapper recording per-node TPU support (RapidsMeta analog).
+
+    Three states per node: runs on TPU (*), runs on the HOST CPU via the
+    fallback interpreter (!cpu, query still succeeds), or cannot run at
+    all (!, query fails at convert)."""
 
     def __init__(self, node: L.LogicalPlan):
         self.node = node
         self.children = [PlanMeta(c) for c in node.children]
         self.reasons: List[str] = []
+        self.host_reasons: List[str] = []
 
     def will_not_work(self, reason: str):
         self.reasons.append(reason)
+
+    def will_use_host(self, reason: str):
+        self.host_reasons.append(reason)
 
     @property
     def can_run_on_tpu(self) -> bool:
@@ -41,12 +49,16 @@ class PlanMeta:
 
     def explain_lines(self, only_not_on_tpu: bool, indent=0) -> List[str]:
         lines = []
-        tag = ("*" if self.can_run_on_tpu else "!")
+        tag = ("!cpu" if self.host_reasons and not self.reasons
+               else "*" if self.can_run_on_tpu else "!")
         desc = f"{'  ' * indent}{tag} {self.node.describe()}"
         if self.reasons:
             desc += "  <-- cannot run on TPU because " + "; ".join(
                 self.reasons)
-        if not only_not_on_tpu or self.reasons:
+        elif self.host_reasons:
+            desc += ("  <-- will run on CPU because "
+                     + "; ".join(self.host_reasons))
+        if not only_not_on_tpu or self.reasons or self.host_reasons:
             lines.append(desc)
         for c in self.children:
             lines.extend(c.explain_lines(only_not_on_tpu, indent + 1))
@@ -79,7 +91,8 @@ def _pq(meta, conv, conf):
     from ..config import BATCH_SIZE_ROWS
     from ..exec.coalesce import CoalesceBatchesExec
     n = meta.node
-    scan = x.ParquetScanExec(n.paths, n.schema, n.columns)
+    scan = x.ParquetScanExec(n.paths, n.schema, n.columns,
+                             filters=n.filters)
     if len(n.paths) > 1:
         # many-small-files: coalesce toward the batch target
         # (GpuCoalesceBatches after scans, GpuTransitionOverrides.scala:77);
@@ -101,13 +114,26 @@ def _pq(meta, conv, conf):
 @_rule(L.Project)
 def _project(meta, conv, conf):
     child = conv(meta.children[0])
-    return x.ProjectExec(child, meta.node.bound, meta.node.schema)
+    n = meta.node
+    if any(b is None for b in n.bound):
+        reason = "; ".join(e for e in n.bind_errors if e)
+        if not conf.allow_cpu_fallback:
+            raise UnsupportedExpr(reason)
+        from ..exec.host_fallback import HostProjectExec
+        return HostProjectExec(child, n.exprs, n.schema, reason)
+    return x.ProjectExec(child, n.bound, n.schema)
 
 
 @_rule(L.Filter)
 def _filter(meta, conv, conf):
     child = conv(meta.children[0])
-    return x.FilterExec(child, meta.node.bound)
+    n = meta.node
+    if n.bound is None:
+        if not conf.allow_cpu_fallback:
+            raise UnsupportedExpr(n.bind_error)
+        from ..exec.host_fallback import HostFilterExec
+        return HostFilterExec(child, n.condition, n.bind_error)
+    return x.FilterExec(child, n.bound)
 
 
 def _make_hash_exchange(child, bound_keys, conf):
@@ -246,9 +272,22 @@ class Planner:
         return apply_lore_dump(root_exec, self.conf)
 
     def _tag(self, meta: PlanMeta):
-        if type(meta.node) not in _RULES:
+        node = meta.node
+        if type(node) not in _RULES:
             meta.will_not_work(
-                f"no TPU replacement rule for {meta.node.node_name()}")
+                f"no TPU replacement rule for {node.node_name()}")
+        if isinstance(node, L.Filter) and node.bound is None:
+            if self.conf.allow_cpu_fallback:
+                meta.will_use_host(node.bind_error)
+            else:
+                meta.will_not_work(node.bind_error)
+        if isinstance(node, L.Project) and any(b is None
+                                               for b in node.bound):
+            reason = "; ".join(e for e in node.bind_errors if e)
+            if self.conf.allow_cpu_fallback:
+                meta.will_use_host(reason)
+            else:
+                meta.will_not_work(reason)
         for c in meta.children:
             self._tag(c)
 

@@ -1,0 +1,109 @@
+"""TypeSig: per-expression supported-type signatures + doc generation.
+
+(reference: TypeChecks.scala:125 TypeSig algebra; generates
+docs/supported_ops.md and tools/generated_files/supportedExprs.csv.)
+A TypeSig is a set of supported DataType classes; expressions are
+registered with input/output signatures, `check()` is used by binders for
+uniform error text, and `generate_supported_ops()` emits the docs table.
+"""
+from __future__ import annotations
+
+from typing import Dict, Tuple, Type
+
+from ..columnar import dtypes as dt
+
+__all__ = ["TypeSig", "SIGS", "register", "check", "generate_supported_ops"]
+
+
+class TypeSig:
+    def __init__(self, *classes: Type[dt.DataType], note: str = ""):
+        self.classes = tuple(classes)
+        self.note = note
+
+    def __add__(self, other: "TypeSig") -> "TypeSig":
+        return TypeSig(*(self.classes + other.classes),
+                       note=self.note or other.note)
+
+    def supports(self, dtype: dt.DataType) -> bool:
+        return isinstance(dtype, self.classes)
+
+    def describe(self) -> str:
+        names = sorted({c.__name__.replace("Type", "")
+                        for c in self.classes})
+        s = ", ".join(names)
+        return f"{s} ({self.note})" if self.note else s
+
+
+BOOL = TypeSig(dt.BooleanType)
+INTEGRAL = TypeSig(dt.ByteType, dt.ShortType, dt.IntegerType, dt.LongType)
+FLOATING = TypeSig(dt.FloatType, dt.DoubleType)
+DECIMAL = TypeSig(dt.DecimalType, note="decimal64; >18 digits gated")
+NUMERIC = INTEGRAL + FLOATING + DECIMAL
+DATETIME = TypeSig(dt.DateType, dt.TimestampType)
+STRING = TypeSig(dt.StringType, dt.BinaryType)
+NULL = TypeSig(dt.NullType)
+ALL_COMMON = NUMERIC + DATETIME + STRING + BOOL + NULL
+
+# expression class name -> (input TypeSig, description)
+SIGS: Dict[str, Tuple[TypeSig, str]] = {}
+
+
+def register(name: str, sig: TypeSig, desc: str = ""):
+    SIGS[name] = (sig, desc)
+
+
+def check(name: str, dtype: dt.DataType, what: str = ""):
+    from ..expr.expressions import UnsupportedExpr
+    ent = SIGS.get(name)
+    if ent is not None and not ent[0].supports(dtype):
+        raise UnsupportedExpr(
+            f"{what or name} does not support input type {dtype} on TPU "
+            f"(supported: {ent[0].describe()})")
+
+
+# -- registry (mirrors the expression surface; the binders stay the
+# source of truth for enforcement, this drives docs + uniform errors) ----
+for _n in ("Add", "Subtract", "Multiply", "Divide", "IntDivide",
+           "Remainder", "Pmod", "Negate", "Abs", "Round"):
+    register(_n, NUMERIC, "arithmetic")
+for _n in ("Eq", "Ne", "Lt", "Le", "Gt", "Ge", "EqNullSafe"):
+    register(_n, ALL_COMMON, "comparison")
+for _n in ("And", "Or", "Not"):
+    register(_n, BOOL, "boolean")
+for _n in ("IsNull", "IsNotNull", "Coalesce", "If", "CaseWhen", "In"):
+    register(_n, ALL_COMMON, "conditional/null")
+register("IsNaN", FLOATING, "NaN test")
+for _n in ("BitwiseAnd", "BitwiseOr", "BitwiseXor", "BitwiseNot",
+           "ShiftLeft", "ShiftRight"):
+    register(_n, INTEGRAL, "bitwise")
+for _n in ("MathUnary", "Pow", "Atan2"):
+    register(_n, NUMERIC, "double math")
+for _n in ("Length", "Upper", "Lower", "Substring", "ConcatStr",
+           "Contains", "StartsWith", "EndsWith", "Like", "Trim",
+           "Reverse", "Instr", "Pad", "Repeat", "ConcatWs"):
+    register(_n, STRING, "string")
+for _n in ("RLike", "RegexpExtract", "RegexpReplace"):
+    register(_n, STRING,
+             "regex (NFA subset; others run via CPU fallback)")
+register("Cast", ALL_COMMON, "cast matrix per docs/compatibility.md")
+for _n in ("Sum", "Min", "Max", "Count", "CountStar", "Avg", "First",
+           "Last", "VarianceSamp", "StddevSamp"):
+    register(_n, NUMERIC + DATETIME + BOOL,
+             "aggregate (Count: all types)")
+register("Greatest", NUMERIC + DATETIME + STRING, "n-ary minmax")
+register("Least", NUMERIC + DATETIME + STRING, "n-ary minmax")
+
+
+def generate_supported_ops() -> str:
+    lines = ["# Supported expressions (TPU)",
+             "",
+             "Generated from the TypeSig registry "
+             "(`spark_rapids_tpu/plan/typesig.py`), the analog of the "
+             "reference's docs/supported_ops.md from TypeChecks.",
+             "",
+             "Expression | Supported input types | Notes",
+             "-----------|----------------------|------"]
+    for name in sorted(SIGS):
+        sig, desc = SIGS[name]
+        lines.append(f"{name} | {sig.describe()} | {desc}")
+    return "\n".join(lines) + "\n"

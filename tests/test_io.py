@@ -74,3 +74,52 @@ def test_many_small_files_coalesce(session, tmp_path):
     ms = q.last_metrics()
     # 9 batches of 100 rows coalesced into ~3 concats of >=400 rows
     assert any(v.get("numConcats", 0) >= 1 for v in ms.values())
+
+
+def test_parquet_row_group_pruning(tmp_path, session):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.expr.expressions import col
+
+    n = 10_000
+    at = pa.table({"k": pa.array(list(range(n)), pa.int64()),
+                   "v": pa.array([i * 2 for i in range(n)], pa.int64())})
+    p = str(tmp_path / "t.parquet")
+    pq.write_table(at, p, row_group_size=1000)  # 10 sorted row groups
+
+    df = session.read.parquet(p).filter(col("k") >= 9_500)
+    out = df.to_arrow()
+    assert sorted(out.column(0).to_pylist()) == list(range(9_500, n))
+    ms = df.last_metrics()
+    skipped = sum(v.get("skippedRowGroups", 0) for v in ms.values())
+    assert skipped == 9, ms
+
+    # equality + no-match pruning
+    df2 = session.read.parquet(p).filter(col("k") == 4_321)
+    assert df2.to_arrow().column(1).to_pylist() == [8642]
+    df3 = session.read.parquet(p).filter(col("k") < 0)
+    assert df3.to_arrow().num_rows == 0
+
+
+def test_parquet_multithreaded_reader_matches_perfile(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    import spark_rapids_tpu as st
+    from spark_rapids_tpu.expr.expressions import col
+
+    n = 5_000
+    at = pa.table({"k": pa.array(list(range(n)), pa.int64()),
+                   "s": pa.array([f"r{i}" for i in range(n)])})
+    p = str(tmp_path / "mt.parquet")
+    pq.write_table(at, p, row_group_size=512)
+
+    def run(rt):
+        s = st.TpuSession({
+            "spark.rapids.tpu.sql.format.parquet.reader.type": rt,
+            "spark.rapids.tpu.sql.batchSizeRows": 700})
+        out = s.read.parquet(p).filter(col("k") % 7 == 0).to_arrow()
+        return sorted(zip(out.column(0).to_pylist(),
+                          out.column(1).to_pylist()))
+
+    assert run("MULTITHREADED") == run("PERFILE")

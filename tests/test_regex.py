@@ -97,16 +97,66 @@ def test_rlike_in_filter(session):
 
 @pytest.mark.parametrize("pat", [
     r"a*?",          # lazy
-    r"(?=x)y",       # lookahead
-    r"\bword",       # word boundary
-    r"(a)\1",        # backreference
-    r"a" * 40,       # too many states
-    r"café",    # non-ASCII (as a literal é in the pattern)
+    r"\bworld",      # word boundary
+    r"(a)b\1",       # backreference
+    r"a{65}b",       # repeat bound over the state budget
 ])
-def test_unsupported_patterns_tagged(session, pat):
+def test_unsupported_patterns_fall_back_to_host(session, pat):
+    """Outside the NFA subset: the query still runs, via the CPU
+    interpreter, with `re`-exact results (GpuCpuBridge analog)."""
     df = _df(session)
+    q = df.select(F.rlike(col("s"), pat).alias("m"))
+    root, _ = q._execute()
+    kinds = {type(op).__name__ for op in _walk(root)}
+    assert "HostProjectExec" in kinds, kinds
+    got = q.to_arrow().column(0).to_pylist()
+    want = [None if s is None else bool(re.search(pat, s))
+            for s in STRINGS]
+    assert got == want
+
+
+def test_unsupported_pattern_raises_when_fallback_disabled():
+    import pyarrow as pa
+    import spark_rapids_tpu as st
+    s = st.TpuSession({"spark.rapids.tpu.sql.allowCpuFallback": False})
+    df = s.create_dataframe({"s": pa.array(["a"], pa.string())})
     with pytest.raises(UnsupportedExpr):
-        df.select(F.rlike(col("s"), pat).alias("m")).to_arrow()
+        df.select(F.rlike(col("s"), r"a*?").alias("m")).to_arrow()
+
+
+def test_host_fallback_filter(session):
+    df = _df(session)
+    out = df.filter(F.rlike(col("s"), r"\bworld")).to_arrow()
+    got = sorted(out.column(0).to_pylist())
+    want = sorted(s for s in STRINGS
+                  if s is not None and re.search(r"\bworld", s))
+    assert got == want
+
+
+def test_host_fallback_replace_group_refs(session):
+    df = _df(session)
+    out = df.select(F.regexp_replace(col("s"), r"(\d+)", r"<$1>")
+                    .alias("r")).to_arrow()
+    got = out.column(0).to_pylist()
+    want = [None if s is None else re.sub(r"(\d+)", r"<\1>", s)
+            for s in STRINGS]
+    assert got == want
+
+
+def test_explain_shows_cpu_fallback(capsys):
+    import pyarrow as pa
+    import spark_rapids_tpu as st
+    s = st.TpuSession({"spark.rapids.tpu.sql.explain": "ALL"})
+    df = s.create_dataframe({"s": pa.array(["a"], pa.string())})
+    df.select(F.rlike(col("s"), r"a*?").alias("m")).to_arrow()
+    text = capsys.readouterr().out
+    assert "will run on CPU because" in text
+
+
+def _walk(node):
+    yield node
+    for c in node.children:
+        yield from _walk(c)
 
 
 def test_rlike_nfa_compiler_units():
@@ -118,16 +168,39 @@ def test_rlike_nfa_compiler_units():
     assert rx2.min_len == 2 and rx2.max_len == 4
 
 
+@pytest.mark.parametrize("pat", [r"a|b$", r"^a|b"])
+def test_branch_anchor_patterns_fall_back_correctly(session, pat):
+    """Branch-scoped anchors are outside the NFA subset (it would anchor
+    every branch); the CPU fallback gives Java-correct semantics."""
+    got = _df(session).select(F.rlike(col("s"), pat).alias("m")) \
+        .to_arrow().column(0).to_pylist()
+    want = [None if s is None else bool(re.search(pat, s))
+            for s in STRINGS]
+    assert got == want
+
+
 @pytest.mark.parametrize("pat", [
-    r"a|b$", r"^a|b", r"a{x}", r"a{1,2,3}", r"a{-2}", r"\xZZ",
+    r"\xZZ", r"a{x}", r"a{1,2,3}", r"a{-2}", r"a{3,1}", r"(a",
+    r"*a", r"[z-a]",
 ])
-def test_malformed_and_branch_anchor_patterns_rejected(session, pat):
-    with pytest.raises(UnsupportedExpr):
-        _df(session).select(F.rlike(col("s"), pat).alias("m")).to_arrow()
+def test_java_malformed_patterns_raise_not_fallback(session, pat):
+    """Patterns Java rejects (PatternSyntaxException) must error here
+    too — Python `re` would parse some as literals and silently change
+    answers, so they are NOT fallback-eligible."""
+    from spark_rapids_tpu.ops.regex_nfa import RegexSyntaxError
+    with pytest.raises(RegexSyntaxError):
+        _df(session).select(F.rlike(col("s"), pat).alias("m")) \
+            .to_arrow()
 
 
-def test_extract_group_dollar_anchored_rejected(session):
-    with pytest.raises(UnsupportedExpr):
-        _df(session).select(
-            F.regexp_extract(col("s"), r"=(\d*);$", 1).alias("e")
-        ).to_arrow()
+def test_extract_group_dollar_anchored_falls_back(session):
+    got = _df(session).select(
+        F.regexp_extract(col("s"), r"=(\d*);$", 1).alias("e")
+    ).to_arrow().column(0).to_pylist()
+
+    def ref(s):
+        if s is None:
+            return None
+        m = re.search(r"=(\d*);$", s)
+        return m.group(1) if m else ""
+    assert got == [ref(s) for s in STRINGS]
