@@ -139,16 +139,20 @@ class Column:
                               "offsets": offsets}
 
         if isinstance(dtype, dt.DecimalType):
-            if dtype.precision > dt.DecimalType.MAX_INT64_PRECISION:
-                raise NotImplementedError("decimal>18 round-1 limitation")
-            # Extract the unscaled int128 little-endian words and keep the
-            # low 64 bits (valid for p<=18); a plain cast would rescale.
+            # Extract the unscaled int128 little-endian words; a plain
+            # cast would rescale instead of reinterpreting.
             filled = arr.fill_null(0)
             if filled.type != pa.decimal128(38, dtype.scale):
                 filled = filled.cast(pa.decimal128(38, dtype.scale))
             buf = filled.buffers()[1]
             words = np.frombuffer(buf, dtype=np.int64)
-            lo = words[2 * filled.offset:2 * (filled.offset + n):2].copy()
+            o = filled.offset
+            if dtype.is_decimal128:
+                both = words[2 * o:2 * (o + n)].reshape(n, 2).copy()
+                return dtype, n, {"data": _pad_to(both, cap),
+                                  "validity": _pad_to(validity, cap,
+                                                      False)}
+            lo = words[2 * o:2 * (o + n):2].copy()
             return dtype, n, {"data": _pad_to(lo, cap),
                               "validity": _pad_to(validity, cap, False)}
 
@@ -221,13 +225,16 @@ class Column:
             return arr
         vals = np.asarray(bufs["data"])[:n]
         if isinstance(dtype, dt.DecimalType):
-            # assemble int128 little-endian words from the unscaled int64s
+            # assemble int128 little-endian words from the unscaled limbs
             # (a cast from int64 would rescale, not reinterpret)
-            lo = vals.astype(np.int64)
-            hi = np.where(lo < 0, np.int64(-1), np.int64(0))
-            words = np.empty(2 * n, np.int64)
-            words[0::2] = lo
-            words[1::2] = hi
+            if dtype.is_decimal128:
+                words = np.ascontiguousarray(vals.reshape(-1)[:2 * n])
+            else:
+                lo = vals.astype(np.int64)
+                hi = np.where(lo < 0, np.int64(-1), np.int64(0))
+                words = np.empty(2 * n, np.int64)
+                words[0::2] = lo
+                words[1::2] = hi
             arr = pa.Array.from_buffers(
                 pa.decimal128(38, dtype.scale), n,
                 [None, pa.py_buffer(words.tobytes())]).cast(

@@ -149,6 +149,12 @@ class DecimalType(DataType):
     def np_dtype(self):  # type: ignore[override]
         return np.dtype(np.int64)
 
+    @property
+    def is_decimal128(self) -> bool:
+        """precision > 18: data travels as a [cap, 2] int64 limb buffer
+        (ops/decimal128.py two's-complement little-endian)."""
+        return self.precision > self.MAX_INT64_PRECISION
+
     def simple_name(self) -> str:
         return f"decimal({self.precision},{self.scale})"
 

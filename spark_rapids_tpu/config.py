@@ -118,10 +118,9 @@ PARQUET_READER_TYPE = _conf(
 MAX_READER_BATCH_SIZE_ROWS = _conf(
     "sql.reader.batchSizeRows", 1 << 21,
     "Soft limit on rows per scan batch.", int)
-DECIMAL128_ENABLED = _conf(
-    "sql.decimal128.enabled", False,
-    "Round-1 limitation: decimals with precision > 18 fall back to "
-    "float64 when False.", bool)
+# (decimal128 is always-on: exact two-limb kernels in ops/decimal128.py;
+# the former sql.decimal128.enabled gate had no remaining effect and was
+# removed rather than shipped as a silent no-op)
 LORE_DUMP_IDS = _conf(
     "sql.lore.idsToDump", None,
     "LORE ids whose input batches should be dumped for replay "

@@ -84,7 +84,8 @@ class UngroupedAggExec(TpuExec):
             out = []
             for a, s in zip(self.aggs, states):
                 v, ok = a.finalize(s)
-                out.append((jnp.reshape(v, (1,)), jnp.reshape(ok, (1,))))
+                out.append((jnp.reshape(v, (1,) + tuple(v.shape)),
+                            jnp.reshape(ok, (1,))))
             return out
 
         self._update_jit = jax.jit(_update)
@@ -128,7 +129,8 @@ class UngroupedAggExec(TpuExec):
             out = []
             for a, s in zip(self.aggs, acc):
                 v, ok = a.finalize(s)
-                out.append((jnp.reshape(v, (1,)), jnp.reshape(ok, (1,))))
+                out.append((jnp.reshape(v, (1,) + tuple(v.shape)),
+                            jnp.reshape(ok, (1,))))
             return out
         return jax.jit(run)
 
@@ -157,7 +159,8 @@ class UngroupedAggExec(TpuExec):
             cvs = []
             for (v, ok) in stacked_out:
                 pad = 128 - 1
-                data = jnp.concatenate([v, jnp.zeros(pad, v.dtype)])
+                data = jnp.concatenate(
+                    [v, jnp.zeros((pad,) + v.shape[1:], v.dtype)])
                 valid = jnp.concatenate([ok.astype(jnp.bool_),
                                          jnp.zeros(pad, jnp.bool_)])
                 cvs.append(CV(data, valid))
@@ -188,7 +191,8 @@ class UngroupedAggExec(TpuExec):
         cvs = []
         for (v, ok) in outs:
             pad = 128 - 1
-            data = jnp.concatenate([v, jnp.zeros(pad, v.dtype)])
+            data = jnp.concatenate(
+                [v, jnp.zeros((pad,) + v.shape[1:], v.dtype)])
             valid = jnp.concatenate([ok.astype(jnp.bool_),
                                      jnp.zeros(pad, jnp.bool_)])
             cvs.append(CV(data, valid))
@@ -305,11 +309,15 @@ class HashAggregateExec(TpuExec):
         shapes = []
         for a in self.aggs:
             cap = 128
+            shape = (cap,)
             if a.child is not None:
                 np_dt = a.child.dtype.np_dtype or jnp.int8
+                if isinstance(a.child.dtype, dt.DecimalType) \
+                        and a.child.dtype.is_decimal128:
+                    shape = (cap, 2)
             else:
                 np_dt = jnp.int8
-            cv = jax.ShapeDtypeStruct((cap,), np_dt)
+            cv = jax.ShapeDtypeStruct(shape, np_dt)
             vcv = jax.ShapeDtypeStruct((cap,), jnp.bool_)
             seg = jax.ShapeDtypeStruct((cap,), jnp.int32)
             out = jax.eval_shape(

@@ -151,6 +151,8 @@ class HashJoinExec(TpuExec):
         if len(self.rkeys) != 1:
             return False
         d = self.rkeys[0].dtype
+        if isinstance(d, dt.DecimalType) and d.is_decimal128:
+            return False   # two-limb keys need the generic path
         return not (d.is_variable_width or d.is_nested
                     or isinstance(d, dt.DoubleType))
 

@@ -234,6 +234,8 @@ def _empty_cv(dtype: dt.DataType) -> CV:
     if dtype.is_variable_width:
         return CV(jnp.zeros(128, jnp.uint8), jnp.zeros(128, jnp.bool_),
                   jnp.zeros(129, jnp.int32))
+    if isinstance(dtype, dt.DecimalType) and dtype.is_decimal128:
+        return CV(jnp.zeros((128, 2), jnp.int64), jnp.zeros(128, jnp.bool_))
     return CV(jnp.zeros(128, dtype.np_dtype or jnp.int8),
               jnp.zeros(128, jnp.bool_))
 

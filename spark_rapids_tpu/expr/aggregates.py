@@ -67,14 +67,24 @@ class AggExpr(Expression):
 
 
 class Sum(AggExpr):
+    """Sum. Decimal results over precision 18 accumulate EXACTLY as
+    per-32-bit-limb int64 partial sums (JNI DecimalUtils sum analog);
+    overflow past the result precision yields null (Spark non-ANSI)."""
+
     state_reducers = ("sum", "or")
 
     def _resolve_type(self):
         ct = self.child.dtype
+        self._d128 = False
+        self._in_d128 = False
         if isinstance(ct, dt.DecimalType):
-            self.dtype = dt.DecimalType(min(38, ct.precision + 10), ct.scale)
-            if self.dtype.precision > 18:
-                self.dtype = dt.DecimalType(18, ct.scale)  # decimal64 limit
+            self.dtype = dt.DecimalType(min(38, ct.precision + 10),
+                                        ct.scale)
+            if self.dtype.is_decimal128:
+                self._d128 = True
+                self._in_d128 = ct.is_decimal128
+                nlimbs = 4 if self._in_d128 else 2
+                self.state_reducers = ("sum",) * nlimbs + ("or",)
         elif ct.is_integral or isinstance(ct, dt.BooleanType):
             self.dtype = dt.INT64
         elif ct.is_floating:
@@ -85,24 +95,47 @@ class Sum(AggExpr):
             raise UnsupportedExpr(f"sum({ct})")
         self._acc_dtype = self.dtype.np_dtype
 
+    def _limbs(self, cv: CV, m):
+        from ..ops import decimal128 as d128
+        if self._in_d128:
+            raw = d128.split_d128_limbs(cv.data)
+        else:
+            raw = d128.split_i64_limbs(cv.data)
+        return [jnp.where(m, l, 0) for l in raw]
+
     def update(self, cv: CV, mask):
         m = mask & cv.validity
+        if self._d128:
+            limbs = self._limbs(cv, m)
+            return tuple(jnp.sum(l) for l in limbs) + (jnp.any(m),)
         x = jnp.where(m, cv.data, 0).astype(self._acc_dtype)
         return (jnp.sum(x), jnp.any(m))
 
     def merge(self, s1, s2):
+        if self._d128:
+            return tuple(a + b for a, b in zip(s1[:-1], s2[:-1])) \
+                + (s1[-1] | s2[-1],)
         return (s1[0] + s2[0], s1[1] | s2[1])
 
     def finalize(self, s):
+        if self._d128:
+            from ..ops import decimal128 as d128
+            val, ovf = d128.combine_limb_sums(list(s[:-1]),
+                                              self.dtype.precision)
+            return val, s[-1] & ~ovf
         return s[0], s[1]
 
     # --- grouped: per-segment ----
     def g_update(self, cv: CV, mask, seg_ids, num_segments):
         m = mask & cv.validity
+        has = jax.ops.segment_max(m.astype(jnp.int32), seg_ids,
+                                  num_segments) > 0
+        if self._d128:
+            limbs = self._limbs(cv, m)
+            return tuple(jax.ops.segment_sum(l, seg_ids, num_segments)
+                         for l in limbs) + (has,)
         x = jnp.where(m, cv.data, 0).astype(self._acc_dtype)
-        return (jax.ops.segment_sum(x, seg_ids, num_segments),
-                jax.ops.segment_max(m.astype(jnp.int32), seg_ids,
-                                    num_segments) > 0)
+        return (jax.ops.segment_sum(x, seg_ids, num_segments), has)
 
 
 class Count(AggExpr):
@@ -156,17 +189,34 @@ class CountStar(AggExpr):
         return "count(*)"
 
 
+def _d128_sortable(data2, for_min: bool):
+    """[cap,2] -> (hi, lo') where lexicographic (hi, lo') min/max equals
+    the signed 128-bit min/max: hi signed, lo bias-flipped to signed-
+    comparable unsigned order."""
+    hi = data2[:, 1]
+    lo = data2[:, 0] ^ jnp.int64(-(1 << 63))
+    return hi, lo
+
+
+def _d128_unsortable(hi, lo):
+    return jnp.stack([lo ^ jnp.int64(-(1 << 63)), hi], axis=-1)
+
+
 class _MinMax(AggExpr):
     for_min = True
 
     @property
     def state_reducers(self):
+        if getattr(self, "_d128_in", False):
+            return ("custom",)
         return ("min" if self.for_min else "max", "or")
 
     def _resolve_type(self):
         ct = self.child.dtype
         if ct.is_variable_width or ct.is_nested:
             raise UnsupportedExpr(f"min/max({ct}) round-1")
+        self._d128_in = (isinstance(ct, dt.DecimalType)
+                         and ct.is_decimal128)
         self.dtype = ct
 
     def _masked(self, cv, m):
@@ -179,28 +229,86 @@ class _MinMax(AggExpr):
             x = jnp.where(jnp.isnan(x), jnp.inf, x)
         return x
 
+    # -- decimal128: lexicographic (hi, lo') reduction -------------------
+    def _d128_masked(self, cv, m):
+        hi, lo = _d128_sortable(cv.data, self.for_min)
+        ident_hi = _ident(jnp.dtype(jnp.int64), self.for_min)
+        hi = jnp.where(m, hi, ident_hi)
+        lo = jnp.where(m, lo, ident_hi)
+        return hi, lo
+
+    @staticmethod
+    def _lex_pick(for_min, h1, l1, h2, l2):
+        take1 = (h1 < h2) | ((h1 == h2) & (l1 <= l2))
+        if not for_min:
+            take1 = (h1 > h2) | ((h1 == h2) & (l1 >= l2))
+        return (jnp.where(take1, h1, h2), jnp.where(take1, l1, l2))
+
+    def num_state_cols(self):
+        return 3 if getattr(self, "_d128_in", False) else 2
+
     def update(self, cv: CV, mask):
         m = mask & cv.validity
+        if getattr(self, "_d128_in", False):
+            hi, lo = self._d128_masked(cv, m)
+            # reduce hi first, then lo among rows holding the winning hi
+            red_hi = jnp.min(hi) if self.for_min else jnp.max(hi)
+            cand = jnp.where(hi == red_hi, lo,
+                             _ident(jnp.dtype(jnp.int64), self.for_min))
+            red_lo = jnp.min(cand) if self.for_min else jnp.max(cand)
+            return (red_hi, red_lo, jnp.any(m))
         x = self._masked(cv, m)
         red = jnp.min(x) if self.for_min else jnp.max(x)
         return (red, jnp.any(m))
 
     def merge(self, s1, s2):
+        if getattr(self, "_d128_in", False):
+            h, l = self._lex_pick(self.for_min, s1[0], s1[1], s2[0], s2[1])
+            return (h, l, s1[2] | s2[2])
         v = jnp.minimum(s1[0], s2[0]) if self.for_min else jnp.maximum(
             s1[0], s2[0])
         # all-invalid partials carry the identity, so plain min/max is safe
         return (v, s1[1] | s2[1])
 
     def finalize(self, s):
+        if getattr(self, "_d128_in", False):
+            return _d128_unsortable(s[0], s[1]), s[2]
         return s[0], s[1]
 
     def g_update(self, cv, mask, seg_ids, num_segments):
         m = mask & cv.validity
+        if getattr(self, "_d128_in", False):
+            hi, lo = self._d128_masked(cv, m)
+            seg = (jax.ops.segment_min if self.for_min
+                   else jax.ops.segment_max)
+            red_hi = seg(hi, seg_ids, num_segments)
+            ident = _ident(jnp.dtype(jnp.int64), self.for_min)
+            cand = jnp.where(hi == red_hi[seg_ids], lo, ident)
+            red_lo = seg(cand, seg_ids, num_segments)
+            has = jax.ops.segment_max(m.astype(jnp.int32), seg_ids,
+                                      num_segments) > 0
+            return (red_hi, red_lo, has)
         x = self._masked(cv, m)
         seg = (jax.ops.segment_min if self.for_min else jax.ops.segment_max)
         return (seg(x, seg_ids, num_segments),
                 jax.ops.segment_max(m.astype(jnp.int32), seg_ids,
                                     num_segments) > 0)
+
+    def g_merge_custom(self, cols_sorted, live, seg_ids, num_segments):
+        hi, lo, has = cols_sorted
+        eligible = live & has.astype(jnp.bool_)
+        ident = _ident(jnp.dtype(jnp.int64), self.for_min)
+        hi_m = jnp.where(eligible, hi, ident)
+        lo_m = jnp.where(eligible, lo, ident)
+        seg = (jax.ops.segment_min if self.for_min
+               else jax.ops.segment_max)
+        red_hi = seg(hi_m, seg_ids, num_segments)
+        cand = jnp.where((hi_m == red_hi[seg_ids]) & eligible, lo_m,
+                         ident)
+        red_lo = seg(cand, seg_ids, num_segments)
+        has_out = jax.ops.segment_max(eligible.astype(jnp.int32), seg_ids,
+                                      num_segments) > 0
+        return (red_hi, red_lo, has_out)
 
 
 class Min(_MinMax):
@@ -217,6 +325,10 @@ class Avg(AggExpr):
     def _resolve_type(self):
         ct = self.child.dtype
         if isinstance(ct, dt.DecimalType):
+            if ct.is_decimal128:
+                raise UnsupportedExpr(
+                    "avg over decimal precision > 18 (sum/count it "
+                    "explicitly, or cast)")
             s = min(ct.scale + 4, 18)
             self.dtype = dt.DecimalType(18, s)
             self._sum_scale = ct.scale
@@ -366,6 +478,9 @@ class Variance(AggExpr):
         ct = self.child.dtype
         if not (ct.is_numeric or isinstance(ct, dt.NullType)):
             raise UnsupportedExpr(f"variance({ct})")
+        if isinstance(ct, dt.DecimalType) and ct.is_decimal128:
+            raise UnsupportedExpr(
+                "variance over decimal precision > 18 (cast first)")
         self.dtype = dt.FLOAT64
         self._scale = (10.0 ** -ct.scale
                        if isinstance(ct, dt.DecimalType) else 1.0)

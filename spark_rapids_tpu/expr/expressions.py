@@ -273,7 +273,21 @@ class Literal(Expression):
         cap = ctx.capacity
         if self.value is None:
             np_dt = self.dtype.np_dtype or np.int8
+            if isinstance(self.dtype, dt.DecimalType) \
+                    and self.dtype.is_decimal128:
+                return CV(jnp.zeros((cap, 2), jnp.int64),
+                          jnp.zeros(cap, jnp.bool_))
             return CV(jnp.zeros(cap, np_dt), jnp.zeros(cap, jnp.bool_))
+        if isinstance(self.dtype, dt.DecimalType) \
+                and self.dtype.is_decimal128:
+            u = self.device_value() & ((1 << 128) - 1)
+            lo = u & ((1 << 64) - 1)
+            hi = u >> 64
+            lo = lo - (1 << 64) if lo >= (1 << 63) else lo
+            hi = hi - (1 << 64) if hi >= (1 << 63) else hi
+            row = jnp.asarray([lo, hi], jnp.int64)
+            return CV(jnp.broadcast_to(row, (cap, 2)),
+                      jnp.ones(cap, jnp.bool_))
         if isinstance(self.dtype, (dt.StringType, dt.BinaryType)):
             raw = (self.value.encode() if isinstance(self.value, str)
                    else self.value)
@@ -344,15 +358,16 @@ def _coerce_pair(l: Expression, r: Expression, for_division=False):
 
 
 def _coerce_decimal(l, r, for_division):
-    # Round-1: decimal op decimal stays decimal64 when the Spark result
-    # precision fits 18; otherwise computed in float64 (documented compat
-    # deviation, see docs/compatibility.md).
+    # decimal op decimal/integral: Spark's implicit coercion; results over
+    # precision 18 run on the exact decimal128 kernels.
     def as_dec(e):
         if isinstance(e.dtype, dt.DecimalType):
             return e
         if e.dtype.is_integral:
-            p = {1: 3, 2: 5, 4: 10, 8: 19}[e.dtype.np_dtype.itemsize]
-            return Cast.bound(e, dt.DecimalType(min(p, 18), 0))
+            # Spark: Byte->dec(3,0) Short->dec(5,0) Int->dec(10,0)
+            # Long->dec(20,0)
+            p = {1: 3, 2: 5, 4: 10, 8: 20}[e.dtype.np_dtype.itemsize]
+            return Cast.bound(e, dt.DecimalType(p, 0))
         raise UnsupportedExpr(f"decimal with {e.dtype}")
     if l.dtype.is_floating or r.dtype.is_floating:
         return (Cast.bound(l, dt.FLOAT64), Cast.bound(r, dt.FLOAT64),
@@ -386,8 +401,28 @@ def _dec_scale_shift(cv: CV, shift: int) -> CV:
     return CV(cv.data * (10 ** shift), cv.validity)
 
 
+def _adjust_precision_scale(p: int, s: int):
+    """Spark DecimalType.adjustPrecisionScale: clamp precision at 38,
+    sacrificing scale down to a floor of min(s, 6)."""
+    if p <= 38:
+        return p, s
+    int_digits = p - s
+    min_scale = min(s, 6)
+    adjusted = max(38 - int_digits, min_scale)
+    return 38, adjusted
+
+
+def _as_dec128(cv: CV, dtype) -> CV:
+    """Widen a decimal64 CV to the [cap,2] limb layout (no-op for 128)."""
+    if dtype.is_decimal128:
+        return cv
+    from ..ops.decimal128 import dec_from_i64
+    return CV(dec_from_i64(cv.data), cv.validity)
+
+
 class _Arith(_BinaryOp):
     kernel = None
+    dec128_fn = None    # d128.dec_add / dec_sub
 
     def _resolve_type(self):
         self.left, self.right, out = _coerce_pair(self.left, self.right)
@@ -396,12 +431,8 @@ class _Arith(_BinaryOp):
             p2, s2 = self.right.dtype.precision, self.right.dtype.scale
             s = max(s1, s2)
             p = max(p1 - s1, p2 - s2) + s + 1
-            if p > 18:
-                self.left = Cast.bound(self.left, dt.FLOAT64)
-                self.right = Cast.bound(self.right, dt.FLOAT64)
-                self.dtype = dt.FLOAT64
-            else:
-                self.dtype = dt.DecimalType(p, s)
+            p, s = _adjust_precision_scale(p, s)
+            self.dtype = dt.DecimalType(p, s)
         else:
             self.dtype = out
 
@@ -409,6 +440,20 @@ class _Arith(_BinaryOp):
         l, r = self.left.emit(ctx), self.right.emit(ctx)
         if isinstance(self.dtype, dt.DecimalType):
             s = self.dtype.scale
+            if self.dtype.is_decimal128:
+                # exact 128-bit two-limb path (JNI DecimalUtils analog)
+                from ..ops import decimal128 as d128
+                ld = _as_dec128(l, self.left.dtype)
+                rd = _as_dec128(r, self.right.dtype)
+                la, o1 = d128.dec_rescale(ld.data, self.left.dtype.scale,
+                                          s, 38)
+                ra, o2 = d128.dec_rescale(rd.data, self.right.dtype.scale,
+                                          s, 38)
+                res, o3 = type(self).dec128_fn(la, ra)
+                ok = d128.fits_precision(d128.to_limbs(res),
+                                         self.dtype.precision)
+                valid = (l.validity & r.validity & ~o1 & ~o2 & ~o3 & ok)
+                return CV(res, valid)
             l = _dec_scale_shift(l, s - self.left.dtype.scale)
             r = _dec_scale_shift(r, s - self.right.dtype.scale)
         return type(self).kernel(l, r)
@@ -418,10 +463,20 @@ class Add(_Arith):
     symbol = "+"
     kernel = staticmethod(ew.add)
 
+    @staticmethod
+    def dec128_fn(a, b):
+        from ..ops.decimal128 import dec_add
+        return dec_add(a, b)
+
 
 class Subtract(_Arith):
     symbol = "-"
     kernel = staticmethod(ew.sub)
+
+    @staticmethod
+    def dec128_fn(a, b):
+        from ..ops.decimal128 import dec_sub
+        return dec_sub(a, b)
 
 
 class Multiply(_BinaryOp):
@@ -432,18 +487,24 @@ class Multiply(_BinaryOp):
         if out is None:
             p1, s1 = self.left.dtype.precision, self.left.dtype.scale
             p2, s2 = self.right.dtype.precision, self.right.dtype.scale
-            p, s = p1 + p2 + 1, s1 + s2
-            if p > 18:
-                self.left = Cast.bound(self.left, dt.FLOAT64)
-                self.right = Cast.bound(self.right, dt.FLOAT64)
-                self.dtype = dt.FLOAT64
-            else:
-                self.dtype = dt.DecimalType(p, s)
+            p, s = _adjust_precision_scale(p1 + p2 + 1, s1 + s2)
+            self._full_scale = s1 + s2
+            self.dtype = dt.DecimalType(p, s)
         else:
             self.dtype = out
 
     def emit(self, ctx):
-        return ew.mul(self.left.emit(ctx), self.right.emit(ctx))
+        l, r = self.left.emit(ctx), self.right.emit(ctx)
+        if isinstance(self.dtype, dt.DecimalType) \
+                and self.dtype.is_decimal128:
+            from ..ops import decimal128 as d128
+            ld = _as_dec128(l, self.left.dtype)
+            rd = _as_dec128(r, self.right.dtype)
+            res, ovf = d128.dec_mul_scaled(
+                ld.data, rd.data, self._full_scale - self.dtype.scale,
+                self.dtype.precision)
+            return CV(res, l.validity & r.validity & ~ovf)
+        return ew.mul(l, r)
 
 
 class Divide(_BinaryOp):
@@ -453,31 +514,33 @@ class Divide(_BinaryOp):
         self.left, self.right, out = _coerce_pair(self.left, self.right,
                                                   for_division=True)
         if out is None:
-            # Spark decimal division; round-1 computes in float64 then
-            # rescales (compat deviation for >15 significant digits).
+            # Spark decimal division result type, exact 128-bit long
+            # division with HALF_UP (JNI DecimalUtils.divide128 analog)
             p1, s1 = self.left.dtype.precision, self.left.dtype.scale
             p2, s2 = self.right.dtype.precision, self.right.dtype.scale
             s = max(6, s1 + p2 + 1)
             p = p1 - s1 + s2 + s
-            if p > 18:
-                self.left = Cast.bound(self.left, dt.FLOAT64)
-                self.right = Cast.bound(self.right, dt.FLOAT64)
-                self.dtype = dt.FLOAT64
-            else:
-                self.dtype = dt.DecimalType(p, s)
+            p, s = _adjust_precision_scale(p, s)
+            self.dtype = dt.DecimalType(p, s)
         else:
             self.dtype = out
 
     def emit(self, ctx):
         l, r = self.left.emit(ctx), self.right.emit(ctx)
         if isinstance(self.dtype, dt.DecimalType):
+            from ..ops import decimal128 as d128
             s = self.dtype.scale
-            num = l.data.astype(jnp.float64) / (10.0 ** self.left.dtype.scale)
-            den = r.data.astype(jnp.float64) / (10.0 ** self.right.dtype.scale)
-            zero = r.data == 0
-            q = jnp.where(zero, 0.0, num / jnp.where(zero, 1.0, den))
-            out = jnp.round(q * (10.0 ** s)).astype(jnp.int64)
-            return CV(out, ew.and_validity(l, r) & ~zero)
+            shift = s - self.left.dtype.scale + self.right.dtype.scale
+            ld = _as_dec128(l, self.left.dtype)
+            rd = _as_dec128(r, self.right.dtype)
+            res, ovf, divzero = d128.dec_div(
+                ld.data, rd.data, shift, self.dtype.precision,
+                num_digits=self.left.dtype.precision)
+            valid = ew.and_validity(l, r) & ~ovf & ~divzero
+            if self.dtype.is_decimal128:
+                return CV(res, valid)
+            v64, fits = d128.dec_to_i64(res)
+            return CV(v64, valid & fits)
         return ew.divide(l, r)
 
 
@@ -589,9 +652,16 @@ class _Comparison(_BinaryOp):
             c = ops_str.compare(l, r)
             return CV(type(self).cmp_op(c), ew.and_validity(l, r))
         if isinstance(self.left.dtype, dt.DecimalType):
-            s = max(self.left.dtype.scale, self.right.dtype.scale)
-            l = _dec_scale_shift(l, s - self.left.dtype.scale)
-            r = _dec_scale_shift(r, s - self.right.dtype.scale)
+            lt_, rt = self.left.dtype, self.right.dtype
+            if lt_.is_decimal128 or rt.is_decimal128:
+                from ..ops.decimal128 import dec_cmp_scaled
+                ld = _as_dec128(l, lt_)
+                rd = _as_dec128(r, rt)
+                c = dec_cmp_scaled(ld.data, lt_.scale, rd.data, rt.scale)
+                return CV(type(self).cmp_op(c), ew.and_validity(l, r))
+            s = max(lt_.scale, rt.scale)
+            l = _dec_scale_shift(l, s - lt_.scale)
+            r = _dec_scale_shift(r, s - rt.scale)
         return type(self).kernel(l, r)
 
 

@@ -1,11 +1,13 @@
-"""Delta Lake table support (round-1: transaction log + versioned reads).
+"""Delta Lake table support: transaction log, versioned reads, DML.
 
 The reference carries 60k LoC of Delta support (reference: delta-lake/
-GpuDeltaLog, GpuOptimisticTransaction, MERGE/DELETE/UPDATE commands); this
-module lands the storage core those build on: the `_delta_log` JSON-action
-commit protocol (protocol/metaData/add/remove), snapshot reconstruction at
-any version (time travel), and transactional append/overwrite writes.
-MERGE INTO / DELETE / UPDATE commands build on this in a later round.
+GpuDeltaLog, GpuOptimisticTransaction, GpuMergeIntoCommand,
+GpuDeleteCommand, GpuUpdateCommand); this module implements the storage
+core (the `_delta_log` JSON-action commit protocol, snapshot
+reconstruction/time travel, transactional append/overwrite), copy-on-write
+DML (DELETE / UPDATE / MERGE INTO — per-file rewrites through the TPU
+engine, untouched files skipped), and periodic checkpoints
+(`NNN.checkpoint.parquet` + `_last_checkpoint`, engine-internal layout).
 """
 from __future__ import annotations
 
@@ -15,7 +17,10 @@ import time
 import uuid
 from typing import Dict, List, Optional
 
-__all__ = ["DeltaTable", "write_delta", "read_delta"]
+__all__ = ["DeltaTable", "write_delta", "read_delta", "delete_delta",
+           "update_delta", "merge_delta", "CHECKPOINT_INTERVAL"]
+
+CHECKPOINT_INTERVAL = 10
 
 
 class DeltaTable:
@@ -34,14 +39,66 @@ class DeltaTable:
                     if f.endswith(".json")]
         return max(versions, default=-1)
 
-    def _actions(self, version: int) -> List[dict]:
-        out = []
-        for v in range(version + 1):
+    # ---- checkpoints ---------------------------------------------------
+    def _checkpoint_file(self, version: int) -> str:
+        return os.path.join(self.log_dir,
+                            f"{version:020d}.checkpoint.parquet")
+
+    def _last_checkpoint_version(self) -> int:
+        lc = os.path.join(self.log_dir, "_last_checkpoint")
+        if not os.path.exists(lc):
+            return -1
+        try:
+            with open(lc) as f:
+                return int(json.load(f)["version"])
+        except (ValueError, KeyError, OSError):
+            return -1
+
+    def write_checkpoint(self, version: int):
+        """Consolidate the snapshot at `version` into one parquet
+        (engine-internal layout: one JSON action per row; the reference's
+        binary checkpoint schema interop is follow-on work)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        actions = self._replay_actions(version)
+        # keep protocol/metaData + LIVE adds only
+        live: Dict[str, dict] = {}
+        keep: List[dict] = []
+        for a in actions:
+            if "add" in a:
+                live[a["add"]["path"]] = a
+            elif "remove" in a:
+                live.pop(a["remove"]["path"], None)
+            elif "protocol" in a or "metaData" in a:
+                keep.append(a)
+        rows = keep + list(live.values())
+        pq.write_table(
+            pa.table({"action": pa.array([json.dumps(a) for a in rows])}),
+            self._checkpoint_file(version))
+        with open(os.path.join(self.log_dir, "_last_checkpoint"),
+                  "w") as f:
+            json.dump({"version": version, "size": len(rows)}, f)
+
+    def _replay_actions(self, version: int) -> List[dict]:
+        """All actions up to `version`, starting from the newest usable
+        checkpoint."""
+        import pyarrow.parquet as pq
+        out: List[dict] = []
+        start = 0
+        cp = self._last_checkpoint_version()
+        if 0 <= cp <= version and os.path.exists(self._checkpoint_file(cp)):
+            at = pq.read_table(self._checkpoint_file(cp))
+            out.extend(json.loads(s) for s in at.column(0).to_pylist())
+            start = cp + 1
+        for v in range(start, version + 1):
             with open(self._commit_file(v)) as f:
                 for line in f:
                     if line.strip():
                         out.append(json.loads(line))
         return out
+
+    def _actions(self, version: int) -> List[dict]:
+        return self._replay_actions(version)
 
     def snapshot_files(self, version: Optional[int] = None) -> List[str]:
         """Live data files at a version (add minus remove)."""
@@ -74,6 +131,10 @@ class DeltaTable:
             for a in actions:
                 f.write(json.dumps(a) + "\n")
         return True
+
+    def maybe_checkpoint(self, version: int):
+        if version > 0 and version % CHECKPOINT_INTERVAL == 0:
+            self.write_checkpoint(version)
 
     def history(self) -> List[dict]:
         out = []
@@ -124,6 +185,7 @@ def write_delta(df, path: str, mode: str = "append"):
         actions.append({"commitInfo": {
             "operation": op, "timestamp": int(time.time() * 1000)}})
         if table.try_commit(actions, latest + 1):
+            table.maybe_checkpoint(latest + 1)
             return latest + 1
 
 
@@ -135,3 +197,172 @@ def read_delta(session, path: str, version: Optional[int] = None):
     if not files:
         raise ValueError(f"delta table {path} has no live files")
     return DataFrame(session, ParquetScan(files))
+
+
+# ----------------------------------------------------------------------
+# Copy-on-write DML (reference: delta-33x GpuDeleteCommand,
+# GpuUpdateCommand, GpuMergeIntoCommand — per-file rewrite through the
+# engine; files with no matching rows are left untouched)
+# ----------------------------------------------------------------------
+def _write_rows(session, at, path: str) -> Optional[dict]:
+    """Write an arrow table as one new data file; None when empty."""
+    import pyarrow.parquet as pq
+    if at.num_rows == 0:
+        return None
+    fname = f"part-{uuid.uuid4().hex[:12]}.parquet"
+    pq.write_table(at, os.path.join(path, fname))
+    return {"add": {"path": fname,
+                    "size": os.path.getsize(os.path.join(path, fname)),
+                    "modificationTime": int(time.time() * 1000),
+                    "dataChange": True}}
+
+
+def _remove_action(f: str) -> dict:
+    return {"remove": {"path": os.path.basename(f),
+                       "deletionTimestamp": int(time.time() * 1000)}}
+
+
+def _commit_dml(table: DeltaTable, build_actions, op: str) -> int:
+    """Optimistic-commit loop: recompute file actions against the latest
+    snapshot on every race loss (GpuOptimisticTransaction analog)."""
+    while True:
+        latest = table.latest_version()
+        if latest < 0:
+            raise FileNotFoundError(f"not a delta table: {table.path}")
+        actions = build_actions()
+        actions.append({"commitInfo": {
+            "operation": op, "timestamp": int(time.time() * 1000)}})
+        if table.try_commit(actions, latest + 1):
+            table.maybe_checkpoint(latest + 1)
+            return latest + 1
+
+
+def delete_delta(session, path: str, condition) -> int:
+    """DELETE FROM <path> WHERE condition. Returns the new version."""
+    table = DeltaTable(path)
+
+    from ..expr.expressions import IsNull, Not, Or
+
+    def build():
+        actions: List[dict] = []
+        keep_cond = Or(Not(condition), IsNull(condition))  # NULL -> keep
+        for f in table.snapshot_files():
+            df = session.read.parquet(f)
+            n_match = df.filter(condition).count()
+            if n_match == 0:
+                continue        # untouched file, no rewrite
+            kept = df.filter(keep_cond)
+            actions.append(_remove_action(f))
+            add = _write_rows(session, kept.to_arrow(), path)
+            if add:
+                actions.append(add)
+        return actions
+
+    return _commit_dml(table, build, "DELETE")
+
+
+def update_delta(session, path: str, condition,
+                 assignments: Dict[str, object]) -> int:
+    """UPDATE <path> SET col=expr WHERE condition. Expressions reference
+    the table's columns; returns the new version."""
+    from ..expr.expressions import Expression, If, Literal, col as col_
+    table = DeltaTable(path)
+
+    def build():
+        actions: List[dict] = []
+        for f in table.snapshot_files():
+            df = session.read.parquet(f)
+            if df.filter(condition).count() == 0:
+                continue
+            exprs = []
+            for fld in df.schema.fields:
+                if fld.name in assignments:
+                    v = assignments[fld.name]
+                    ve = v if isinstance(v, Expression) else Literal(v)
+                    exprs.append(If(condition, ve,
+                                    col_(fld.name)).alias(fld.name))
+                else:
+                    exprs.append(col_(fld.name))
+            actions.append(_remove_action(f))
+            add = _write_rows(session, df.select(*exprs).to_arrow(), path)
+            if add:
+                actions.append(add)
+        return actions
+
+    return _commit_dml(table, build, "UPDATE")
+
+
+def merge_delta(session, path: str, source_df, on: List[str],
+                when_matched: Optional[str] = "update",
+                matched_assignments: Optional[Dict[str, object]] = None,
+                when_not_matched: Optional[str] = "insert") -> int:
+    """MERGE INTO <path> USING source ON target.k == source.k.
+
+    when_matched: "update" (set matched_assignments, or replace the whole
+    row with the source's columns when None), "delete", or None (leave
+    matched rows); when_not_matched: "insert" or None. Copy-on-write:
+    only files containing matches rewrite; inserts append one new file.
+    (reference: delta-33x GpuMergeIntoCommand low-shuffle merge.)"""
+    from ..expr.expressions import Expression, If, Literal, col as col_
+    table = DeltaTable(path)
+    src = source_df.to_arrow()      # materialize once; sources are small
+
+    def build():
+        import pyarrow as pa
+        actions: List[dict] = []
+        src_df = session.create_dataframe(src)
+        # rename non-key source columns so post-join references are
+        # unambiguous ("update all" must read the SOURCE's value)
+        src_ren = src_df.select(*(
+            [col_(k) for k in on]
+            + [col_(c).alias(f"__src_{c}") for c in src_df.columns
+               if c not in on]))
+        for f in table.snapshot_files():
+            tdf = session.read.parquet(f)
+            if tdf.join(src_df, on=on, how="left_semi").count() == 0:
+                continue
+            if when_matched == "delete":
+                out_at = tdf.join(src_df, on=on,
+                                  how="left_anti").to_arrow()
+            elif when_matched == "update":
+                anti = tdf.join(src_df, on=on, how="left_anti")
+                hit = tdf.join(src_ren, on=on, how="inner")
+                exprs = []
+                for fld in tdf.schema.fields:
+                    if matched_assignments and \
+                            fld.name in matched_assignments:
+                        v = matched_assignments[fld.name]
+                        ve = (v if isinstance(v, Expression)
+                              else Literal(v))
+                        exprs.append(ve.alias(fld.name))
+                    elif matched_assignments is None \
+                            and fld.name not in on \
+                            and f"__src_{fld.name}" in hit.columns:
+                        exprs.append(
+                            col_(f"__src_{fld.name}").alias(fld.name))
+                    else:
+                        exprs.append(col_(fld.name))
+                out_at = pa.concat_tables([
+                    anti.to_arrow().select(list(tdf.columns)),
+                    hit.select(*exprs).to_arrow()])
+            else:
+                continue
+            actions.append(_remove_action(f))
+            add = _write_rows(session, out_at, path)
+            if add:
+                actions.append(add)
+        if when_not_matched == "insert":
+            target = read_delta(session, path)
+            tcols = [fld.name for fld in target.schema.fields]
+            missing = [c for c in tcols if c not in src_df.columns]
+            if missing:
+                raise ValueError(f"merge insert: source lacks {missing}")
+            inserts = src_df.join(target.select(*[col_(k) for k in on]),
+                                  on=on, how="left_anti")
+            add = _write_rows(session,
+                              inserts.to_arrow().select(tcols), path)
+            if add:
+                actions.append(add)
+        return actions
+
+    return _commit_dml(table, build, "MERGE")

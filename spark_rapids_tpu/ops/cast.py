@@ -77,6 +77,9 @@ def cast_cv(cv: CV, from_t: dt.DataType, to_t: dt.DataType) -> CV:
     # ---- decimal source ------------------------------------------------
     if isinstance(from_t, dt.DecimalType):
         s = from_t.scale
+        if from_t.is_decimal128 or (isinstance(to_t, dt.DecimalType)
+                                    and to_t.is_decimal128):
+            return _cast_decimal128(cv, from_t, to_t)
         if isinstance(to_t, dt.DecimalType):
             return _rescale_decimal(x, valid, s, to_t)
         if to_t.is_floating:
@@ -94,6 +97,15 @@ def cast_cv(cv: CV, from_t: dt.DataType, to_t: dt.DataType) -> CV:
 
     # ---- to decimal ----------------------------------------------------
     if isinstance(to_t, dt.DecimalType):
+        if to_t.is_decimal128:
+            if from_t.is_integral:
+                from .decimal128 import dec_from_i64, dec_rescale
+                w = dec_from_i64(x.astype(jnp.int64))
+                out, ovf = dec_rescale(w, 0, to_t.scale, to_t.precision)
+                return CV(out, valid & ~ovf)
+            if from_t.is_floating:
+                return _float_to_decimal128(x, valid, to_t)
+            raise NotImplementedError(f"cast {from_t} -> {to_t}")
         limit = 10 ** to_t.precision
         if from_t.is_integral:
             scaled = x.astype(jnp.int64) * (10 ** to_t.scale)
@@ -118,6 +130,58 @@ def cast_cv(cv: CV, from_t: dt.DataType, to_t: dt.DataType) -> CV:
     if from_t.is_numeric and to_t.is_numeric:
         return CV(x.astype(to_t.np_dtype), valid)
 
+    raise NotImplementedError(f"cast {from_t} -> {to_t}")
+
+
+def _float_to_decimal128(x, valid, to_t: dt.DecimalType) -> CV:
+    """float -> decimal(p>18): scale, round half-up, and decompose the
+    (<= 53 significant bits) double into 32-bit limbs exactly."""
+    from .decimal128 import from_limbs
+    xf = x.astype(jnp.float64) * (10.0 ** to_t.scale)
+    scaled = jnp.where(xf >= 0, jnp.floor(xf + 0.5), jnp.ceil(xf - 0.5))
+    ok = (jnp.abs(scaled) < 10.0 ** to_t.precision) & ~jnp.isnan(x)
+    mag = jnp.abs(jnp.where(ok, scaled, 0.0))
+    limbs = []
+    rem = mag
+    for _ in range(4):
+        l = jnp.mod(rem, 2.0 ** 32)
+        limbs.append(l.astype(jnp.int64))
+        rem = jnp.floor(rem / (2.0 ** 32))
+    pos = from_limbs(limbs)
+    from .decimal128 import dec_neg
+    neg = dec_neg(pos)
+    out = jnp.where((scaled < 0)[:, None], neg, pos)
+    return CV(out, valid & ok)
+
+
+def _cast_decimal128(cv: CV, from_t: dt.DecimalType,
+                     to_t: dt.DataType) -> CV:
+    """Casts where either side is a [cap,2]-limb decimal128."""
+    from .decimal128 import (dec_from_i64, dec_rescale, dec_to_i64,
+                             to_limbs)
+    x, valid = cv.data, cv.validity
+    wide = x if from_t.is_decimal128 else dec_from_i64(x)
+    if isinstance(to_t, dt.DecimalType):
+        out, ovf = dec_rescale(wide, from_t.scale, to_t.scale,
+                               to_t.precision)
+        if to_t.is_decimal128:
+            return CV(out, valid & ~ovf)
+        v64, fits = dec_to_i64(out)
+        return CV(v64, valid & ~ovf & fits)
+    if to_t.is_floating:
+        lo, hi = wide[:, 0], wide[:, 1]
+        ulo = jnp.where(lo < 0, lo.astype(jnp.float64) + 2.0**64,
+                        lo.astype(jnp.float64))
+        f = (hi.astype(jnp.float64) * (2.0**64) + ulo) / (10.0
+                                                          ** from_t.scale)
+        return CV(f.astype(to_t.np_dtype), valid)
+    if to_t.is_integral:
+        # truncation toward zero like the d64 path (Spark cast)
+        out, ovf = dec_rescale(wide, from_t.scale, 0, 38, half_up=False)
+        v64, fits = dec_to_i64(out)
+        lo_b, hi_b = _INT_RANGE[type(to_t)]
+        ok = (v64 >= lo_b) & (v64 <= hi_b) & fits & ~ovf
+        return CV(v64.astype(to_t.np_dtype), valid & ok)
     raise NotImplementedError(f"cast {from_t} -> {to_t}")
 
 

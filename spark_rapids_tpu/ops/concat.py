@@ -45,8 +45,9 @@ def pad_cv(cv: CV, capacity: int) -> CV:
     if cap >= capacity:
         return cv
     extra = capacity - cap
-    data = jnp.concatenate([cv.data, jnp.zeros(extra, cv.data.dtype)]) \
-        if cv.offsets is None else cv.data
+    data = (jnp.concatenate(
+        [cv.data, jnp.zeros((extra,) + cv.data.shape[1:], cv.data.dtype)])
+        if cv.offsets is None else cv.data)
     valid = jnp.concatenate([cv.validity, jnp.zeros(extra, jnp.bool_)])
     if cv.offsets is None:
         return CV(data, valid)

@@ -1,0 +1,454 @@
+"""Decimal128 exact arithmetic on 32-bit limbs (JNI DecimalUtils analog).
+
+The reference does 128-bit decimal math in CUDA via spark-rapids-jni
+DecimalUtils; TPU lanes are 32-bit, so values travel as FOUR 32-bit limbs
+held in int64 arrays (each limb in [0, 2^32); the COLUMN stores them as a
+[cap, 2] int64 buffer: limb pairs packed little-endian, two's complement).
+All kernels below are elementwise/vectorized — multi-precision schoolbook
+arithmetic with column accumulators, bit-for-bit exact:
+
+  add/sub    : 4-limb ripple carry, signed overflow detect
+  mul        : 8-column 32x32 products -> 256-bit, overflow past 127 bits
+  div        : sign-magnitude; numerator scaled to 256 bits, shift-subtract
+               long division (lax.scan), HALF_UP rounding like Spark
+  rescale    : multiply/divide by 10^k with rounding
+  sum limbs  : per-segment limb sums + final carry recombination
+
+Overflow semantics: Spark non-ANSI — result null (overflow flags returned
+to callers)."""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+__all__ = ["to_limbs", "from_limbs", "dec_add", "dec_sub", "dec_mul",
+           "dec_div", "dec_rescale", "dec_neg", "dec_cmp", "dec_from_i64",
+           "dec_to_i64", "POW10_128", "fits_precision"]
+
+# python int, NOT a jnp array: a module-level device array used inside a
+# jitted function gets lifted to a hidden executable input, which breaks
+# executable reuse across calls ("supplied 8 buffers, expected 9")
+_MASK32 = 0xFFFFFFFF
+
+# 10^k as 4x32 limb constants, k = 0..38
+POW10_128: List[Tuple[int, int, int, int]] = []
+for _k in range(39):
+    _v = 10 ** _k
+    POW10_128.append(tuple((_v >> (32 * i)) & 0xFFFFFFFF for i in range(4)))
+
+# max |unscaled| for precision p: 10^p - 1
+def _bound_limbs(p: int):
+    v = 10 ** p - 1
+    return tuple((v >> (32 * i)) & 0xFFFFFFFF for i in range(4))
+
+
+# ---------------------------------------------------------------------
+# [cap,2] int64 <-> 4-limb lists (int64 lanes holding [0, 2^32))
+# ---------------------------------------------------------------------
+def to_limbs(data2):
+    """[cap,2] packed -> [l0,l1,l2,l3] (two's-complement raw limbs)."""
+    lo, hi = data2[:, 0], data2[:, 1]
+    ulo = lo.astype(jnp.uint64)
+    uhi = hi.astype(jnp.uint64)
+    return [
+        (ulo & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64),
+        (ulo >> jnp.uint64(32)).astype(jnp.int64),
+        (uhi & jnp.uint64(0xFFFFFFFF)).astype(jnp.int64),
+        (uhi >> jnp.uint64(32)).astype(jnp.int64),
+    ]
+
+
+def from_limbs(limbs):
+    """[l0..l3] -> [cap,2] packed int64 (limbs already in [0,2^32))."""
+    l0, l1, l2, l3 = limbs
+    ulo = l0.astype(jnp.uint64) | (l1.astype(jnp.uint64) << jnp.uint64(32))
+    uhi = l2.astype(jnp.uint64) | (l3.astype(jnp.uint64) << jnp.uint64(32))
+    return jnp.stack([ulo.astype(jnp.int64), uhi.astype(jnp.int64)],
+                     axis=-1)
+
+
+def _is_neg(limbs):
+    return limbs[3] >= jnp.int64(1 << 31)
+
+
+def _neg_raw(limbs):
+    """Two's-complement negate of a 4-limb value."""
+    out = []
+    carry = jnp.ones_like(limbs[0])
+    for l in limbs:
+        v = (l ^ _MASK32) + carry
+        out.append(v & _MASK32)
+        carry = v >> 32
+    return out
+
+
+def _abs(limbs):
+    neg = _is_neg(limbs)
+    n = _neg_raw(limbs)
+    return [jnp.where(neg, a, b) for a, b in zip(n, limbs)], neg
+
+
+def _add_raw(a, b, k=None):
+    """Limbwise add with ripple carry; returns (limbs, carry_out)."""
+    k = k or max(len(a), len(b))
+    out = []
+    carry = jnp.zeros_like(a[0])
+    for i in range(k):
+        ai = a[i] if i < len(a) else 0
+        bi = b[i] if i < len(b) else 0
+        v = ai + bi + carry
+        out.append(v & _MASK32)
+        carry = v >> 32
+    return out, carry
+
+
+def _sub_raw(a, b, k=None):
+    """a - b limbwise with borrow; returns (limbs, borrow_out in {0,1})."""
+    k = k or max(len(a), len(b))
+    out = []
+    borrow = jnp.zeros_like(a[0])
+    for i in range(k):
+        ai = a[i] if i < len(a) else 0
+        bi = b[i] if i < len(b) else 0
+        v = ai - bi - borrow
+        out.append(v & _MASK32)
+        borrow = (v >> 32) & 1
+    return out, borrow
+
+
+def _cmp_raw(a, b):
+    """unsigned compare of equal-length limb lists: -1/0/1 per lane."""
+    res = jnp.zeros_like(a[0])
+    for ai, bi in zip(reversed(a), reversed(b)):
+        res = jnp.where(res != 0, res,
+                        jnp.sign(ai - bi))
+    return res
+
+
+def _const_limbs(tpl, like):
+    return [jnp.full_like(like, int(x)) for x in tpl]
+
+
+def fits_precision(limbs, precision: int):
+    """|value| <= 10^precision - 1 (on raw two's-complement limbs)."""
+    mag, _ = _abs(limbs)
+    bound = _const_limbs(_bound_limbs(precision), limbs[0])
+    return _cmp_raw(mag, bound) <= 0
+
+
+# ---------------------------------------------------------------------
+def dec_add(a2, b2):
+    """(result [cap,2], overflow bool): 128-bit signed add."""
+    a, b = to_limbs(a2), to_limbs(b2)
+    s, _ = _add_raw(a, b, 4)
+    # signed overflow: same-sign operands, different-sign result
+    sa, sb, sr = _is_neg(a), _is_neg(b), _is_neg(s)
+    ovf = (sa == sb) & (sr != sa)
+    return from_limbs(s), ovf
+
+
+def dec_neg(a2):
+    return from_limbs(_neg_raw(to_limbs(a2)))
+
+
+def dec_sub(a2, b2):
+    a, b = to_limbs(a2), to_limbs(b2)
+    nb = _neg_raw(b)
+    s, _ = _add_raw(a, nb, 4)
+    sa, sb, sr = _is_neg(a), ~_is_neg(b), _is_neg(s)
+    # a + (-b): overflow when sign(a) == sign(-b) != sign(result); the
+    # -b edge (b == MIN128) negates to itself — treat sign(-b) as ~sign(b)
+    ovf = (sa == sb) & (sr != sa)
+    return from_limbs(s), ovf
+
+
+def _mul_raw_columns(a, b, out_limbs=8):
+    """Magnitude multiply via 16x16-bit sub-limbs to keep every product
+    inside int64."""
+    # split each 32-bit limb into two 16-bit half-limbs: 8 halves each
+    ah = []
+    bh = []
+    for l in a:
+        ah.append(l & 0xFFFF)
+        ah.append(l >> 16)
+    for l in b:
+        bh.append(l & 0xFFFF)
+        bh.append(l >> 16)
+    H = out_limbs * 2
+    cols = [jnp.zeros_like(a[0]) for _ in range(H + 1)]
+    for i in range(8):
+        for j in range(8):
+            k = i + j
+            if k >= H:
+                continue
+            cols[k] = cols[k] + ah[i] * bh[j]   # < 2^32 each, <=64 terms
+    # carry-propagate 16-bit columns
+    out16 = []
+    carry = jnp.zeros_like(a[0])
+    for k in range(H):
+        v = cols[k] + carry
+        out16.append(v & 0xFFFF)
+        carry = v >> 16
+    # fold halves back to 32-bit limbs
+    out = [(out16[2 * i] | (out16[2 * i + 1] << 16))
+           for i in range(out_limbs)]
+    return out, carry
+
+
+def dec_mul(a2, b2, precision: int):
+    """(result [cap,2], overflow): exact signed multiply; overflow when
+    |product| needs more than `precision` digits (or > 127 bits)."""
+    a, b = to_limbs(a2), to_limbs(b2)
+    ma, na = _abs(a)
+    mb, nb = _abs(b)
+    prod, carry = _mul_raw_columns(ma, mb, 8)
+    hi_any = (sum(prod[4:]) + carry) > 0
+    fits = fits_precision_mag(prod[:4], precision)
+    ovf = hi_any | ~fits
+    neg = na ^ nb
+    res = [jnp.where(neg, x, y) for x, y in zip(_neg_raw(prod[:4]),
+                                                prod[:4])]
+    return from_limbs(res), ovf
+
+
+def fits_precision_mag(mag_limbs, precision: int):
+    bound = _const_limbs(_bound_limbs(precision), mag_limbs[0])
+    return _cmp_raw(mag_limbs, bound) <= 0
+
+
+def _shift_left_one(limbs, bit_in):
+    """(limbs << 1) | bit_in over k 32-bit limbs."""
+    out = []
+    carry = bit_in
+    for l in limbs:
+        v = (l << 1) | carry
+        out.append(v & _MASK32)
+        carry = (v >> 32) & 1
+    return out, carry
+
+
+def _long_div(num, den, nbits: int):
+    """Unsigned long division: num (k-limb) / den (4-limb), both
+    magnitudes. Returns (quotient k-limb, remainder 4-limb). Shift-
+    subtract over nbits via lax.scan (static)."""
+    k = len(num)
+
+    def body(carry, bit):
+        quo, rem = carry
+        # bit runs nbits-1 .. 0
+        b = jnp.zeros_like(num[0])
+        for limb_i in range(k):
+            sel = (bit // 32) == limb_i
+            b = jnp.where(sel, (num[limb_i] >> (bit % 32)) & 1, b)
+        rem, _ = _shift_left_one(rem, b)
+        rem5 = rem  # 5 limbs to be safe against shift carry
+        ge = _cmp_raw(rem5[:5], den + [jnp.zeros_like(den[0])]) >= 0
+        sub, _ = _sub_raw(rem5[:5], den + [jnp.zeros_like(den[0])], 5)
+        rem = [jnp.where(ge, s, r) for s, r in zip(sub, rem5)]
+        # set quotient bit
+        quo2 = []
+        for limb_i in range(k):
+            sel = (bit // 32) == limb_i
+            quo2.append(jnp.where(sel & ge,
+                                  quo[limb_i] | (jnp.int64(1)
+                                                 << (bit % 32)),
+                                  quo[limb_i]))
+        return (quo2, rem), None
+
+    quo0 = [jnp.zeros_like(num[0]) for _ in range(k)]
+    rem0 = [jnp.zeros_like(num[0]) for _ in range(5)]
+    (quo, rem), _ = jax.lax.scan(
+        body, (quo0, rem0),
+        jnp.arange(nbits - 1, -1, -1, dtype=jnp.int32))
+    return quo, rem[:4]
+
+
+def dec_div(a2, b2, scale_shift: int, precision: int,
+            num_digits: int = 38):
+    """Spark decimal divide: (a * 10^scale_shift) / b with HALF_UP
+    rounding. Numerator computed in 256 bits; the long-division scan is
+    bounded by the numerator's static digit count (num_digits = operand
+    precision; ~3.33 bits/digit) instead of a flat 256 steps. Returns
+    (result, overflow, divzero)."""
+    a, b = to_limbs(a2), to_limbs(b2)
+    ma, na = _abs(a)
+    mb, nb = _abs(b)
+    divzero = sum(mb) == 0
+    safe_mb = [jnp.where(divzero, jnp.ones_like(x) * (i == 0), x)
+               for i, x in enumerate(mb)]
+    pow_l = _const_limbs(POW10_128[scale_shift], a[0])
+    num, _ = _mul_raw_columns(ma, pow_l, 8)      # 256-bit numerator
+    nbits = min(256, int((num_digits + scale_shift) * 3.33) + 2)
+    quo, rem = _long_div(num, safe_mb, nbits)
+    # HALF_UP: round away from zero when 2*rem >= |b|
+    rem2, c = _shift_left_one(rem, jnp.zeros_like(rem[0]))
+    ge = (_cmp_raw(rem2, safe_mb) >= 0) | (c > 0)
+    one = [jnp.ones_like(quo[0])] + [jnp.zeros_like(quo[0])] * 7
+    quo_up, _ = _add_raw(quo, one, 8)
+    quo = [jnp.where(ge, u, q) for u, q in zip(quo_up, quo)]
+    hi_any = sum(quo[4:]) > 0
+    fits = fits_precision_mag(quo[:4], precision)
+    ovf = hi_any | ~fits
+    neg = na ^ nb
+    res = [jnp.where(neg, x, y)
+           for x, y in zip(_neg_raw(quo[:4]), quo[:4])]
+    return from_limbs(res), ovf, divzero
+
+
+def dec_rescale(a2, from_scale: int, to_scale: int, precision: int,
+                half_up: bool = True):
+    """Rescale by 10^(to-from): up = exact multiply (overflow checked),
+    down = divide with HALF_UP (or truncation toward zero when half_up is
+    False — the decimal->integral cast). Returns (result, overflow)."""
+    if to_scale == from_scale:
+        a = to_limbs(a2)
+        return a2, ~fits_precision(a, precision)
+    a = to_limbs(a2)
+    ma, neg = _abs(a)
+    if to_scale > from_scale:
+        pow_l = _const_limbs(POW10_128[to_scale - from_scale], a[0])
+        prod, carry = _mul_raw_columns(ma, pow_l, 8)
+        hi_any = (sum(prod[4:]) + carry) > 0
+        fits = fits_precision_mag(prod[:4], precision)
+        mag = prod[:4]
+        ovf = hi_any | ~fits
+    else:
+        k = from_scale - to_scale
+        pow_l = _const_limbs(POW10_128[k], a[0])
+        quo, rem = _long_div(ma + [jnp.zeros_like(ma[0])] * 4, pow_l, 128)
+        if half_up:
+            rem2, c = _shift_left_one(rem, jnp.zeros_like(rem[0]))
+            ge = (_cmp_raw(rem2, pow_l) >= 0) | (c > 0)
+            one = [jnp.ones_like(quo[0])] + [jnp.zeros_like(quo[0])] * 7
+            quo_up, _ = _add_raw(quo, one, 8)
+            quo = [jnp.where(ge, u, q) for u, q in zip(quo_up, quo)]
+        mag = quo[:4]
+        ovf = ~fits_precision_mag(mag, precision)
+    res = [jnp.where(neg, x, y) for x, y in zip(_neg_raw(mag), mag)]
+    return from_limbs(res), ovf
+
+
+def dec_cmp(a2, b2):
+    """Signed three-way compare (-1/0/1) of two [cap,2] decimals with the
+    same scale. Same-sign two's-complement values order like their raw
+    unsigned limbs, so no subtraction (and no wrap) is needed."""
+    a, b = to_limbs(a2), to_limbs(b2)
+    na, nb = _is_neg(a), _is_neg(b)
+    ucmp = _cmp_raw(a, b)
+    return jnp.where(na != nb, jnp.where(na, -1, 1),
+                     ucmp).astype(jnp.int32)
+
+
+def dec_mul_scaled(a2, b2, down_shift: int, precision: int):
+    """Exact multiply at full scale (s1+s2) then HALF_UP rescale down by
+    10^down_shift, all on the 256-bit product — matches Spark's clamped
+    result scale without intermediate overflow."""
+    a, b = to_limbs(a2), to_limbs(b2)
+    ma, na = _abs(a)
+    mb, nb = _abs(b)
+    prod, carry = _mul_raw_columns(ma, mb, 8)
+    if down_shift > 0:
+        pow_l = _const_limbs(POW10_128[down_shift], a[0])
+        quo, rem = _long_div(prod, pow_l, 256)
+        rem2, c = _shift_left_one(rem, jnp.zeros_like(rem[0]))
+        ge = (_cmp_raw(rem2, pow_l) >= 0) | (c > 0)
+        one = [jnp.ones_like(quo[0])] + [jnp.zeros_like(quo[0])] * 7
+        quo_up, _ = _add_raw(quo, one, 8)
+        prod = [jnp.where(ge, u, q) for u, q in zip(quo_up, quo)]
+        carry = jnp.zeros_like(carry)
+    hi_any = (sum(prod[4:]) + carry) > 0
+    fits = fits_precision_mag(prod[:4], precision)
+    ovf = hi_any | ~fits
+    neg = na ^ nb
+    res = [jnp.where(neg, x, y)
+           for x, y in zip(_neg_raw(prod[:4]), prod[:4])]
+    return from_limbs(res), ovf
+
+
+def dec_cmp_scaled(a2, sa: int, b2, sb: int):
+    """Three-way compare of decimals with different scales: the smaller
+    scale side scales up into 256 bits (no overflow possible), compared
+    as sign + 8-limb magnitude."""
+    a, b = to_limbs(a2), to_limbs(b2)
+    ma, na = _abs(a)
+    mb, nb = _abs(b)
+    ka, kb = max(sb - sa, 0), max(sa - sb, 0)
+    pa = _const_limbs(POW10_128[ka], a[0])
+    pb = _const_limbs(POW10_128[kb], a[0])
+    wa, ca = _mul_raw_columns(ma, pa, 8)
+    wb, cb = _mul_raw_columns(mb, pb, 8)
+    mag = _cmp_raw(wa + [ca], wb + [cb])
+    za = (sum(wa) + ca) == 0
+    zb = (sum(wb) + cb) == 0
+    both_zero = za & zb
+    res = jnp.where(
+        na & ~nb, -1, jnp.where(
+            nb & ~na, 1, jnp.where(na & nb, -mag, mag)))
+    return jnp.where(both_zero, 0, res).astype(jnp.int32)
+
+
+def split_i64_limbs(x):
+    """int64 -> [lo32 (unsigned), hi32 (signed)] for exact summation."""
+    return [x & _MASK32, x >> 32]
+
+
+def split_d128_limbs(a2):
+    """[cap,2] -> [l0,l1,l2 (unsigned 32), l3 (signed 32)] for exact
+    summation (value = l0 + l1*2^32 + l2*2^64 + l3*2^96)."""
+    l = to_limbs(a2)
+    lo, hi = a2[:, 0], a2[:, 1]
+    return [l[0], l[1], l[2], hi >> 32]
+
+
+def combine_limb_sums(sums, precision: int):
+    """Reconstruct the exact total from per-limb int64 sums (sums[k]
+    multiplies 2^(32k); the last is signed). Returns ([cap,2] packed,
+    overflow_beyond_precision). Exact while each |sums[k]| < 2^62."""
+    K = 6
+    cols = [jnp.zeros_like(sums[0]) for _ in range(K)]
+    for k, s in enumerate(sums):
+        cols[k] = cols[k] + (s & _MASK32)
+        if k + 1 < K:
+            cols[k + 1] = cols[k + 1] + (s >> 32)   # arithmetic shift
+    # normalize signed columns to 32-bit limbs (two's complement)
+    limbs = []
+    carry = jnp.zeros_like(cols[0])
+    for k in range(K):
+        v = cols[k] + carry
+        limbs.append(v & _MASK32)
+        carry = v >> 32
+    # sign from the (virtual) limb beyond: carry is the sign extension
+    neg = carry < 0
+    # magnitude check: value fits 128 bits AND 10^precision - 1
+    # negate if negative (6-limb two's complement with the carry word)
+    full = limbs + [carry & _MASK32]
+    comp = []
+    c2 = jnp.ones_like(cols[0])
+    for l in full:
+        v = (l ^ _MASK32) + c2
+        comp.append(v & _MASK32)
+        c2 = v >> 32
+    mag = [jnp.where(neg, a, b) for a, b in zip(comp, full)]
+    hi_any = sum(mag[4:]) > 0
+    fits = fits_precision_mag(mag[:4], precision) & ~hi_any
+    res_mag = mag[:4]
+    res = [jnp.where(neg, x, y)
+           for x, y in zip(_neg_raw(res_mag), res_mag)]
+    return from_limbs(res), ~fits
+
+
+def dec_from_i64(x):
+    """int64 unscaled -> [cap,2] (sign-extended)."""
+    hi = jnp.where(x < 0, jnp.int64(-1), jnp.int64(0))
+    return jnp.stack([x, hi], axis=-1)
+
+
+def dec_to_i64(a2):
+    """[cap,2] -> int64 (truncating; valid when the value fits 64 bits).
+    Returns (value, fits_bool)."""
+    lo, hi = a2[:, 0], a2[:, 1]
+    fits = (hi == 0) & (lo >= 0) | (hi == -1) & (lo < 0)
+    return lo, fits

@@ -79,7 +79,12 @@ def murmur3_cv(cv: CV, dtype: dt.DataType, seed):
     elif isinstance(dtype, (dt.LongType, dt.TimestampType)):
         h = _hash_int64(x.astype(jnp.int64), seed)
     elif isinstance(dtype, dt.DecimalType):
-        h = _hash_int64(x.astype(jnp.int64), seed)
+        if dtype.is_decimal128:
+            # engine-internal: fold the two limbs (Spark hashes the
+            # BigDecimal byte array for p>18 — documented deviation)
+            h = _hash_int64(x[:, 0] ^ x[:, 1], seed)
+        else:
+            h = _hash_int64(x.astype(jnp.int64), seed)
     elif isinstance(dtype, dt.FloatType):
         # Spark: -0.0 -> 0.0, then hash the int bits
         xx = jnp.where(x == 0, jnp.zeros_like(x), x)

@@ -73,6 +73,14 @@ def order_keys(cv: CV, dtype: dt.DataType, nchunks: int = 0,
         return _f64_keys(x, descending)
     if isinstance(dtype, dt.NullType):
         return [jnp.zeros(cv.capacity, jnp.uint8)]
+    if isinstance(dtype, dt.DecimalType) and dtype.is_decimal128:
+        # two keys: signed hi limb, then lo limb mapped to signed-
+        # comparable order (bias flip of the top bit)
+        hi = x[:, 1]
+        lo = x[:, 0] ^ jnp.int64(-(1 << 63))   # flip the sign bit
+        if descending:
+            return [~hi, ~lo]
+        return [hi, lo]
     # integral / decimal / date / timestamp: natural signed order
     return [~x if descending else x]
 

@@ -53,14 +53,16 @@ def exchange_rows(arrays: Sequence[jnp.ndarray], mask, pids,
         if as_bool:
             a = a.astype(jnp.uint8)  # scatter-add rejects bool operands
         a_sorted = a[order]
-        send = jnp.zeros((n_shards, cap), a.dtype)
+        # trailing dims (e.g. decimal128 limb pairs [cap, 2]) ride along
+        send = jnp.zeros((n_shards,) + a.shape, a.dtype)
         # scatter-add: dead rows contribute identity even when their
         # clipped (pid, rank) collides with a live slot
+        live_b = live_sorted.reshape((cap,) + (1,) * (a.ndim - 1))
         send = send.at[safe_pid, safe_rank].add(
-            jnp.where(live_sorted, a_sorted, jnp.zeros_like(a_sorted)))
+            jnp.where(live_b, a_sorted, jnp.zeros_like(a_sorted)))
         recv = jax.lax.all_to_all(send, axis_name, split_axis=0,
                                   concat_axis=0, tiled=False)
-        flat = recv.reshape(-1)
+        flat = recv.reshape((-1,) + a.shape[1:])
         out_arrays.append(flat.astype(jnp.bool_) if as_bool else flat)
     send_mask = jnp.zeros((n_shards, cap), jnp.bool_)
     send_mask = send_mask.at[safe_pid, safe_rank].max(live_sorted)

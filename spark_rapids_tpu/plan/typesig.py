@@ -37,7 +37,9 @@ class TypeSig:
 BOOL = TypeSig(dt.BooleanType)
 INTEGRAL = TypeSig(dt.ByteType, dt.ShortType, dt.IntegerType, dt.LongType)
 FLOATING = TypeSig(dt.FloatType, dt.DoubleType)
-DECIMAL = TypeSig(dt.DecimalType, note="decimal64; >18 digits gated")
+DECIMAL = TypeSig(dt.DecimalType,
+                  note="up to 38 digits (exact decimal128 kernels); "
+                       "see docs/compatibility.md")
 NUMERIC = INTEGRAL + FLOATING + DECIMAL
 DATETIME = TypeSig(dt.DateType, dt.TimestampType)
 STRING = TypeSig(dt.StringType, dt.BinaryType)

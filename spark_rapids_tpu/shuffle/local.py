@@ -122,6 +122,9 @@ class LocalShuffle:
     # ---------------- reduce side --------------------------------------
     def read_reduce_partition(self, rpid: int) -> List[HostSubBatch]:
         dtypes = [_np_dtype_for(f.dtype) for f in self.schema.fields]
+        items = [2 if (isinstance(f.dtype, dt.DecimalType)
+                       and f.dtype.is_decimal128) else 1
+                 for f in self.schema.fields]
 
         def read_one(path: str) -> List[HostSubBatch]:
             out = []
@@ -133,7 +136,8 @@ class LocalShuffle:
                 f.seek(off)
                 seg = io.BytesIO(f.read(ln))
             while True:
-                sb = read_subbatch(seg, dtypes, self.codec)
+                sb = read_subbatch(seg, dtypes, self.codec,
+                                   items_per_row=items)
                 if sb is None:
                     break
                 out.append(sb)
@@ -184,7 +188,11 @@ class LocalShuffle:
                 bufs.append({"data": data, "validity": validity,
                              "offsets": off})
             else:
-                data = self._arena_zeros(cap, np_dt)
+                if isinstance(f.dtype, dt.DecimalType) \
+                        and f.dtype.is_decimal128:
+                    data = np.zeros((cap, 2), np_dt)
+                else:
+                    data = self._arena_zeros(cap, np_dt)
                 for sb in subs:
                     c = sb.cols[ci]
                     data[pos:pos + sb.n_rows] = c["data"]

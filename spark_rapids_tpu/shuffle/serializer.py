@@ -61,8 +61,11 @@ def write_subbatch(out: BinaryIO, sb: HostSubBatch, codec=None) -> int:
     return 8 + len(raw)
 
 
-def read_subbatch(inp: BinaryIO, dtypes, codec=None) -> Optional[HostSubBatch]:
-    """dtypes: list of numpy dtypes for the data buffers."""
+def read_subbatch(inp: BinaryIO, dtypes, codec=None,
+                  items_per_row=None) -> Optional[HostSubBatch]:
+    """dtypes: list of numpy dtypes for the data buffers. items_per_row:
+    per-column fixed-width items per row (2 for decimal128 limb pairs);
+    columns with >1 reshape to [n_rows, items]."""
     hdr = inp.read(8)
     if len(hdr) < 8:
         return None
@@ -105,6 +108,11 @@ def read_subbatch(inp: BinaryIO, dtypes, codec=None) -> Optional[HostSubBatch]:
         pos += vb
         validity = unpack_validity(vbits, n_rows)
         data = np.frombuffer(buf, dtypes[ci], db // dtypes[ci].itemsize, pos)
+        ipr = items_per_row[ci] if items_per_row else 1
+        if ipr > 1 and not has_off:
+            if data.shape[0] != n_rows * ipr:
+                raise IOError("corrupt shuffle block: limb count mismatch")
+            data = data.reshape(n_rows, ipr)
         pos += db
         col = {"validity": validity, "data": data}
         if has_off:

@@ -1,0 +1,370 @@
+"""decimal128 limb-arithmetic kernels vs Python big ints (exact oracle)."""
+import decimal
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from spark_rapids_tpu.ops import decimal128 as d128
+
+I128_MIN, I128_MAX = -(1 << 127), (1 << 127) - 1
+
+
+def pack(vals):
+    """list of python ints -> [n,2] int64 two's complement."""
+    out = np.zeros((len(vals), 2), np.int64)
+    for i, v in enumerate(vals):
+        u = v & ((1 << 128) - 1)
+        lo = u & ((1 << 64) - 1)
+        hi = u >> 64
+        out[i, 0] = lo - (1 << 64) if lo >= (1 << 63) else lo
+        out[i, 1] = hi - (1 << 64) if hi >= (1 << 63) else hi
+    return jnp.asarray(out)
+
+
+def unpack(a2):
+    a = np.asarray(a2)
+    out = []
+    for lo, hi in a:
+        u = (int(lo) & ((1 << 64) - 1)) | ((int(hi) & ((1 << 64) - 1))
+                                           << 64)
+        out.append(u - (1 << 128) if u >= (1 << 127) else u)
+    return out
+
+
+def _rand_vals(rng, n, bits):
+    vals = []
+    for _ in range(n):
+        b = rng.randrange(1, bits)
+        v = rng.randrange(0, 1 << b)
+        if rng.random() < 0.5:
+            v = -v
+        vals.append(v)
+    vals += [0, 1, -1, 10**37, -(10**37), (1 << 126), -(1 << 126)]
+    return vals
+
+
+def test_pack_roundtrip():
+    rng = random.Random(1)
+    vals = _rand_vals(rng, 50, 127)
+    assert unpack(pack(vals)) == vals
+
+
+def test_add_sub_exact():
+    rng = random.Random(2)
+    a = _rand_vals(rng, 200, 126)
+    b = _rand_vals(rng, 200, 126)
+    n = min(len(a), len(b))
+    a, b = a[:n], b[:n]
+    s, ovf = d128.dec_add(pack(a), pack(b))
+    got = unpack(s)
+    ovf = np.asarray(ovf)
+    for i in range(n):
+        want = a[i] + b[i]
+        if I128_MIN <= want <= I128_MAX:
+            assert not ovf[i] and got[i] == want, i
+        else:
+            assert ovf[i], i
+    s2, ovf2 = d128.dec_sub(pack(a), pack(b))
+    got2 = unpack(s2)
+    ovf2 = np.asarray(ovf2)
+    for i in range(n):
+        want = a[i] - b[i]
+        if I128_MIN <= want <= I128_MAX:
+            assert not ovf2[i] and got2[i] == want, i
+        else:
+            assert ovf2[i], i
+
+
+def test_mul_exact_and_overflow():
+    rng = random.Random(3)
+    a = _rand_vals(rng, 150, 90)
+    b = _rand_vals(rng, 150, 90)
+    n = min(len(a), len(b))
+    a, b = a[:n], b[:n]
+    prec = 38
+    r, ovf = d128.dec_mul(pack(a), pack(b), prec)
+    got = unpack(r)
+    ovf = np.asarray(ovf)
+    bound = 10**prec - 1
+    for i in range(n):
+        want = a[i] * b[i]
+        if abs(want) <= bound:
+            assert not ovf[i] and got[i] == want, \
+                (i, a[i], b[i], got[i], want)
+        else:
+            assert ovf[i], (i, a[i], b[i])
+
+
+def test_div_half_up():
+    rng = random.Random(4)
+    cases = []
+    for _ in range(120):
+        a = rng.randrange(-(10**30), 10**30)
+        b = rng.randrange(1, 10**20) * rng.choice([1, -1])
+        cases.append((a, b))
+    cases += [(7, 2), (-7, 2), (7, -2), (-7, -2), (1, 3), (10**30, 7)]
+    a2 = pack([a for a, _ in cases])
+    b2 = pack([b for _, b in cases])
+    shift = 6
+    r, ovf, dz = d128.dec_div(a2, b2, shift, 38)
+    got = unpack(r)
+
+    def half_up(num, den):
+        q, rm = divmod(abs(num), abs(den))
+        if 2 * rm >= abs(den):
+            q += 1
+        return q if (num < 0) == (den < 0) else -q
+
+    for i, (a, b) in enumerate(cases):
+        want = half_up(a * 10**shift, b)
+        assert got[i] == want, (i, a, b, got[i], want)
+        assert not np.asarray(ovf)[i]
+
+
+def test_div_by_zero_flag():
+    r, ovf, dz = d128.dec_div(pack([5]), pack([0]), 2, 38)
+    assert bool(np.asarray(dz)[0])
+
+
+def test_rescale_up_down():
+    vals = [12345, -12345, 10**34, -(10**34), 149, 150, -149, -150, 0]
+    up, ovf = d128.dec_rescale(pack(vals), 0, 3, 38)
+    assert unpack(up) == [v * 1000 for v in vals]
+    assert not np.asarray(ovf).any()
+    down, ovf2 = d128.dec_rescale(pack(vals), 2, 0, 38)
+    want = [int(decimal.Decimal(v).scaleb(-2).to_integral_value(
+        rounding=decimal.ROUND_HALF_UP)) for v in vals]
+    assert unpack(down) == want
+    up_ovf, ovf3 = d128.dec_rescale(pack([10**36]), 0, 3, 38)
+    assert bool(np.asarray(ovf3)[0])
+
+
+def test_cmp_extremes():
+    a = [I128_MIN, I128_MAX, 0, -1, 1, 10**37]
+    b = [I128_MAX, I128_MIN, 0, 1, -1, 10**37]
+    got = np.asarray(d128.dec_cmp(pack(a), pack(b))).tolist()
+    want = [(-1 if x < y else (1 if x > y else 0)) for x, y in zip(a, b)]
+    assert got == want
+
+
+# ---- DataFrame-level end-to-end (exact vs Python decimal) --------------
+import pyarrow as pa
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col, lit
+
+
+_CTX = decimal.Context(prec=60)
+
+
+def _dec(v, scale=0):
+    """Exact scaled decimal (the default context would ROUND to 28
+    significant digits)."""
+    return decimal.Decimal(v).scaleb(-scale, _CTX)
+
+
+def _dec_df(session, vals, precision, scale, name="d"):
+    arr = pa.array([None if v is None else _dec(v, scale)
+                    for v in vals], pa.decimal128(precision, scale))
+    return session.create_dataframe({name: arr})
+
+
+def test_d128_ingest_roundtrip(session):
+    vals = [10**30, -(10**30), 0, 12345, None, 10**37 - 1, -(10**37)]
+    df = _dec_df(session, vals, 38, 2)
+    out = df.to_arrow().column(0).to_pylist()
+    want = [None if v is None else _dec(v, 2) for v in vals]
+    assert out == want
+
+
+def test_d128_add_mul_exact(session):
+    a = [10**25, -(10**25), 123456789012345678901234, 1, None]
+    b = [10**25 - 1, 10**24, 987654321098765432109876, -1, 5]
+    dfa = _dec_df(session, a, 30, 2, "a")
+    arr_b = pa.array([None if v is None else _dec(v, 2) for v in b],
+                     pa.decimal128(30, 2))
+    df = session.create_dataframe({
+        "a": dfa.to_arrow().column(0), "b": arr_b})
+    out = df.select((col("a") + col("b")).alias("s"),
+                    (col("a") * col("b")).alias("p")).to_arrow()
+    got_s = out.column(0).to_pylist()
+    got_p = out.column(1).to_pylist()
+    for i in range(len(a)):
+        if a[i] is None or b[i] is None:
+            assert got_s[i] is None and got_p[i] is None
+            continue
+        da, db = _dec(a[i], 2), _dec(b[i], 2)
+        assert got_s[i] == _CTX.add(da, db), (i, got_s[i])
+        # Spark result type: p=61 -> clamp p=38, s=4; unscaled overflow
+        # past 38 digits yields null
+        prod_unscaled = a[i] * b[i]
+        if abs(prod_unscaled) <= 10**38 - 1:
+            assert got_p[i] == _CTX.multiply(da, db), (i, got_p[i])
+        else:
+            assert got_p[i] is None, (i, got_p[i])
+
+
+def test_d128_divide_exact_half_up(session):
+    a = [10**25, 7, -(10**24), 1]
+    b = [3, 2, 7, 3]
+    df = session.create_dataframe({
+        "a": pa.array([decimal.Decimal(v) for v in a],
+                      pa.decimal128(26, 0)),
+        "b": pa.array([decimal.Decimal(v) for v in b],
+                      pa.decimal128(4, 0))})
+    out = df.select((col("a") / col("b")).alias("q")).to_arrow()
+    got = out.column(0).to_pylist()
+    # Spark: s = max(6, 0+4+1)=6... result scale from the type rules
+    for i, g in enumerate(got):
+        ctx = decimal.Context(prec=60)
+        exact = ctx.divide(decimal.Decimal(a[i]), decimal.Decimal(b[i]))
+        q = exact.quantize(decimal.Decimal(1).scaleb(-abs(g.as_tuple().exponent)),
+                           rounding=decimal.ROUND_HALF_UP,
+                           context=ctx)
+        assert g == q, (i, g, q)
+
+
+def test_d128_groupby_keys_and_sum(session):
+    keys = [10**20, 10**20, -(10**20), 5, 5, None, None]
+    vals = [10**20, 2 * 10**20, 7, 1, 2, 30, 40]
+    df = session.create_dataframe({
+        "k": pa.array([None if k is None else decimal.Decimal(k)
+                       for k in keys], pa.decimal128(25, 0)),
+        "v": pa.array([decimal.Decimal(v) for v in vals],
+                      pa.decimal128(30, 0))})
+    out = df.group_by("k").agg(F.sum("v").alias("s"),
+                               F.count("v").alias("c")).to_arrow()
+    got = {out.column(0)[i].as_py(): (out.column(1)[i].as_py(),
+                                      out.column(2)[i].as_py())
+           for i in range(out.num_rows)}
+    want = {}
+    for k, v in zip(keys, vals):
+        kk = None if k is None else decimal.Decimal(k)
+        s, c = want.get(kk, (decimal.Decimal(0), 0))
+        want[kk] = (s + v, c + 1)
+    assert got == want
+
+
+def test_d128_sum_overflow_is_null(session):
+    # sum exceeding precision 38 -> null (Spark non-ANSI)
+    big = decimal.Decimal(10**37)
+    df = session.create_dataframe({
+        "v": pa.array([big] * 20, pa.decimal128(38, 0))})
+    out = df.agg(F.sum("v").alias("s")).to_arrow()
+    assert out.column(0).to_pylist() == [None]
+
+
+def test_d128_sort_and_compare(session):
+    vals = [10**30, -(10**30), 0, 1, -1, 10**37 - 1, -(10**37), 999]
+    df = _dec_df(session, vals, 38, 0)
+    out = df.sort("d").to_arrow().column(0).to_pylist()
+    assert out == sorted(_dec(v) for v in vals)
+    flt = df.filter(col("d") > lit(decimal.Decimal(0))).to_arrow()
+    assert sorted(flt.column(0).to_pylist()) == sorted(
+        _dec(v) for v in vals if v > 0)
+
+
+def test_d128_join_on_decimal_key(session):
+    lk = [10**22, 10**22 + 1, 5, -(10**22)]
+    df1 = session.create_dataframe({
+        "k": pa.array([decimal.Decimal(v) for v in lk],
+                      pa.decimal128(26, 0)),
+        "x": pa.array([1, 2, 3, 4], pa.int64())})
+    df2 = session.create_dataframe({
+        "k": pa.array([decimal.Decimal(10**22),
+                       decimal.Decimal(-(10**22))], pa.decimal128(26, 0)),
+        "y": pa.array([10, 20], pa.int64())})
+    out = df1.join(df2, on=["k"], how="inner").to_arrow()
+    rows = sorted((out.column(1)[i].as_py(), out.column(2)[i].as_py())
+                  for i in range(out.num_rows))
+    assert rows == [(1, 10), (4, 20)]
+
+
+def test_d128_distributed_groupby_file_shuffle():
+    import spark_rapids_tpu as st
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 128})
+    n = 600
+    keys = [(i % 7) * 10**20 for i in range(n)]
+    vals = [i * 10**19 for i in range(n)]
+    df = s.create_dataframe({
+        "k": pa.array([_dec(k) for k in keys], pa.decimal128(25, 0)),
+        "v": pa.array([_dec(v) for v in vals], pa.decimal128(28, 0))})
+    out = df.group_by("k").agg(F.sum("v").alias("s")).to_arrow()
+    got = {out.column(0)[i].as_py(): out.column(1)[i].as_py()
+           for i in range(out.num_rows)}
+    want = {}
+    for k, v in zip(keys, vals):
+        want[_dec(k)] = want.get(_dec(k), decimal.Decimal(0)) + _dec(v)
+    assert got == want
+
+
+def test_d128_mesh_groupby():
+    import spark_rapids_tpu as st
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 128,
+                       "spark.rapids.tpu.mesh.devices": 8})
+    n = 512
+    keys = [(i % 5) * 10**21 for i in range(n)]
+    vals = [i * 10**20 for i in range(n)]
+    df = s.create_dataframe({
+        "k": pa.array([_dec(k) for k in keys], pa.decimal128(26, 0)),
+        "v": pa.array([_dec(v) for v in vals], pa.decimal128(30, 0))})
+    out = df.group_by("k").agg(F.sum("v").alias("s")).to_arrow()
+    got = {out.column(0)[i].as_py(): out.column(1)[i].as_py()
+           for i in range(out.num_rows)}
+    want = {}
+    for k, v in zip(keys, vals):
+        want[_dec(k)] = want.get(_dec(k), decimal.Decimal(0)) + _dec(v)
+    assert got == want
+
+
+def test_d128_min_max(session):
+    vals = [10**30, -(10**30), 5, None, 10**37 - 1, -(10**37)]
+    ks = [1, 1, 1, 2, 2, 2]
+    df = session.create_dataframe({
+        "k": pa.array(ks, pa.int64()),
+        "d": pa.array([None if v is None else _dec(v) for v in vals],
+                      pa.decimal128(38, 0))})
+    out = df.group_by("k").agg(F.min("d").alias("mn"),
+                               F.max("d").alias("mx")).to_arrow()
+    got = {out.column(0)[i].as_py():
+           (out.column(1)[i].as_py(), out.column(2)[i].as_py())
+           for i in range(out.num_rows)}
+    assert got == {1: (_dec(-(10**30)), _dec(10**30)),
+                   2: (_dec(-(10**37)), _dec(10**37 - 1))}
+    # ungrouped
+    o2 = df.agg(F.min("d").alias("mn"), F.max("d").alias("mx")).to_arrow()
+    assert o2.column(0).to_pylist() == [_dec(-(10**37))]
+    assert o2.column(1).to_pylist() == [_dec(10**37 - 1)]
+
+
+def test_d128_long_coercion_exact(session):
+    df = session.create_dataframe({
+        "d": pa.array([_dec(1)], pa.decimal128(20, 0)),
+        "l": pa.array([5 * 10**18], pa.int64())})
+    out = df.select((col("d") + col("l")).alias("s")).to_arrow()
+    assert out.column(0).to_pylist() == [_dec(5 * 10**18 + 1)]
+
+
+def test_d128_avg_variance_rejected(session):
+    from spark_rapids_tpu.expr.expressions import UnsupportedExpr
+    import pytest as pt
+    df = session.create_dataframe({
+        "d": pa.array([_dec(1)], pa.decimal128(20, 0))})
+    with pt.raises(UnsupportedExpr):
+        df.agg(F.avg("d").alias("a")).to_arrow()
+
+
+def test_float_to_d128_cast(session):
+    from spark_rapids_tpu.expr.expressions import Cast
+    from spark_rapids_tpu.columnar import dtypes as dtt
+    df = session.create_dataframe({
+        "f": pa.array([1.5, -2.25, 1e20, None], pa.float64())})
+    e = Cast(col("f"), dtt.DecimalType(30, 2))
+    out = df.select(e.alias("d")).to_arrow()
+    got = out.column(0).to_pylist()
+    assert got[0] == decimal.Decimal("1.50")
+    assert got[1] == decimal.Decimal("-2.25")
+    assert got[2] == decimal.Decimal(10**20)
+    assert got[3] is None
