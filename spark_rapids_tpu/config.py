@@ -139,6 +139,13 @@ SORT_OOC_THRESHOLD = _conf(
 AGG_FORCE_MERGE_PASSES = _conf(
     "sql.agg.forceSinglePassMerge", False,
     "Testing: force aggregate merge in one concat pass.", bool, internal=True)
+BROADCAST_THRESHOLD = _conf(
+    "sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024,
+    "Build sides estimated at or below this many bytes use a broadcast "
+    "hash join (build collected once, no exchange); larger builds "
+    "shuffle both sides on the join keys and join per partition "
+    "(analog of spark.sql.autoBroadcastJoinThreshold + the reference's "
+    "useSizedJoin decision). -1 disables broadcast.", int)
 MESH_DEVICES = _conf(
     "mesh.devices", 0,
     "Number of devices in the SPMD execution mesh. When > 0, hash "

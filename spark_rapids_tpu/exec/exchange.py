@@ -95,47 +95,55 @@ class ShuffleExchangeExec(TpuExec):
                 codec=ctx.conf.get(SHUFFLE_COMPRESS))
             m = ctx.metrics_for(self._op_id)
             child = self.children[0]
+            from ..memory.retry import with_retry
+
+            def map_one(batch):
+                """Idempotent map-side partition pass for one (sub)batch:
+                device partition + ONE bulk D2H (split-and-retry safe —
+                halves simply produce more sub-batches per partition)."""
+                with m.timer("partitionTime"):
+                    out, counts = self._jit(batch.cvs(), batch.row_mask)
+                    return fetch({
+                        "cols": [{k: v for k, v in (
+                            ("data", cv.data),
+                            ("validity", cv.validity),
+                            ("offsets", cv.offsets))
+                            if v is not None} for cv in out],
+                        "counts": counts,
+                    })
+
             for mpid in range(child.num_partitions(ctx)):
                 pieces = [[] for _ in range(self.n)]
                 for batch in child.execute_partition(ctx, mpid):
-                    with m.timer("partitionTime"):
-                        out, counts = self._jit(batch.cvs(), batch.row_mask)
-                        host = fetch({
-                            "cols": [{k: v for k, v in (
-                                ("data", cv.data),
-                                ("validity", cv.validity),
-                                ("offsets", cv.offsets))
-                                if v is not None} for cv in out],
-                            "counts": counts,
-                        })
-                    counts_h = np.asarray(host["counts"])
-                    starts = np.concatenate(
-                        [[0], np.cumsum(counts_h)]).astype(np.int64)
-                    for rp in range(self.n):
-                        cnt = int(counts_h[rp])
-                        if cnt == 0:
-                            continue
-                        lo, hi = int(starts[rp]), int(starts[rp] + cnt)
-                        cols = []
-                        for f, cb in zip(self.schema.fields, host["cols"]):
-                            if "offsets" in cb:
-                                off = np.asarray(cb["offsets"])
-                                o = off[lo:hi + 1].astype(np.int32)
-                                base = o[0]
-                                cols.append({
-                                    "validity": np.asarray(
-                                        cb["validity"])[lo:hi],
-                                    "data": np.asarray(
-                                        cb["data"])[base:o[-1]],
-                                    "offsets": o - base,
-                                })
-                            else:
-                                cols.append({
-                                    "validity": np.asarray(
-                                        cb["validity"])[lo:hi],
-                                    "data": np.asarray(cb["data"])[lo:hi],
-                                })
-                        pieces[rp].append(HostSubBatch(cols, cnt))
+                    for host in with_retry(batch, map_one):
+                        counts_h = np.asarray(host["counts"])
+                        starts = np.concatenate(
+                            [[0], np.cumsum(counts_h)]).astype(np.int64)
+                        for rp in range(self.n):
+                            cnt = int(counts_h[rp])
+                            if cnt == 0:
+                                continue
+                            lo, hi = int(starts[rp]), int(starts[rp] + cnt)
+                            cols = []
+                            for f, cb in zip(self.schema.fields, host["cols"]):
+                                if "offsets" in cb:
+                                    off = np.asarray(cb["offsets"])
+                                    o = off[lo:hi + 1].astype(np.int32)
+                                    base = o[0]
+                                    cols.append({
+                                        "validity": np.asarray(
+                                            cb["validity"])[lo:hi],
+                                        "data": np.asarray(
+                                            cb["data"])[base:o[-1]],
+                                        "offsets": o - base,
+                                    })
+                                else:
+                                    cols.append({
+                                        "validity": np.asarray(
+                                            cb["validity"])[lo:hi],
+                                        "data": np.asarray(cb["data"])[lo:hi],
+                                    })
+                            pieces[rp].append(HostSubBatch(cols, cnt))
                 with m.timer("writeTime"):
                     sh.write_map_partition(mpid, pieces)
             self._shuffle = sh
@@ -143,8 +151,12 @@ class ShuffleExchangeExec(TpuExec):
     def execute_partition(self, ctx: ExecContext, pid: int):
         self._ensure_shuffled(ctx)
         m = ctx.metrics_for(self._op_id)
+        from ..memory.retry import retry_no_split
         with m.timer("fetchAndMergeTime"):
-            batch = self._shuffle.reduce_batch(pid)
+            # the reduce-side H2D of a whole partition retries after a
+            # spill pass on OOM (streamed reduce batches are follow-on)
+            batch = retry_no_split(
+                lambda: self._shuffle.reduce_batch(pid))
         if batch is not None:
             m.add("numOutputBatches", 1)
             yield batch

@@ -333,79 +333,35 @@ class HashJoinExec(TpuExec):
                 sorted_ukey, bperm, n_valid_b = self._build_sorted(
                     bkey_cvs, bmask)
 
+        from ..memory.retry import with_retry
+
+        def probe_one(batch):
+            """Idempotent per-stream-batch probe: returns (kind, payload)
+            for the caller to yield/accumulate. Split-safe: all join
+            semantics here are stream-row-local; matched-build marks
+            OR-accumulate."""
+            out = list(self._probe_batch(ctx, m, batch, bcvs, bmask,
+                                         bkey_cvs, cap_b, fast,
+                                         sorted_ukey if fast else None,
+                                         bperm if fast else None,
+                                         n_valid_b if fast else None))
+            return out
+
         for lpid in ([pid] if self.per_partition
                      else range(left.num_partitions(ctx))):
             for batch in left.execute_partition(ctx, lpid):
-                with m.timer("opTime"):
-                    scvs, smask = batch.cvs(), batch.row_mask
-                    cap_s = batch.capacity
-                    sctx = EmitCtx(scvs, cap_s)
-                    skey_cvs = [k.emit(sctx) for k in self.lkeys]
-                    if fast:
-                        pkey = ("probe", cap_b, cap_s)
-                        pfn = self._count_cache.get(pkey)
-                        if pfn is None:
-                            pfn = jax.jit(self._probe_fn(cap_b, cap_s))
-                            self._count_cache[pkey] = pfn
-                        (cnt, offsets, total, bstart,
-                         touched) = pfn(sorted_ukey, n_valid_b,
-                                        skey_cvs[0], smask)
-                        perm = bperm
-                        if self.how in ("right", "full"):
-                            matched_b_acc = self._matched_from_touched(
-                                bperm, touched, n_valid_b, matched_b_acc)
-                    else:
-                        nchunks = self._key_nchunks(bkey_cvs, bmask,
-                                                    skey_cvs, smask)
-                        ckey = (nchunks, cap_b, cap_s)
-                        cfn = self._count_cache.get(ckey)
-                        if cfn is None:
-                            cfn = jax.jit(self._count_fn(nchunks, cap_b,
-                                                         cap_s))
-                            self._count_cache[ckey] = cfn
-                        (cnt, offsets, total, bstart, perm,
-                         matched_b) = cfn(bkey_cvs, bmask, skey_cvs, smask)
-                        if self.how in ("right", "full"):
-                            matched_b_acc = matched_b_acc | matched_b
-                    if self.how == "left_semi":
-                        yield DeviceBatch(batch.table, batch.num_rows,
-                                          smask & (cnt > 0), cap_s)
-                        continue
-                    if self.how == "left_anti":
-                        yield DeviceBatch(batch.table, batch.num_rows,
-                                          smask & (cnt == 0), cap_s)
-                        continue
-                    with_left_nulls = self.how in ("left", "full")
-                    if with_left_nulls:
-                        eff = jnp.where(smask & (cnt == 0), 1, cnt)
-                        n_out = fetch_int((jnp.sum(eff)))
-                    else:
-                        n_out = fetch_int((total))
-                    if n_out == 0:
-                        continue
-                    out_cap = bucket_capacity(n_out)
-                    ekey = (out_cap, cap_b, cap_s, with_left_nulls)
-                    efn = self._expand_cache.get(ekey)
-                    if efn is None:
-                        efn = jax.jit(self._expand_fn(out_cap, cap_b,
-                                                      with_left_nulls))
-                        self._expand_cache[ekey] = efn
-                    lg, rg, lvalid, rvalid, _ = efn(cnt, offsets, bstart,
-                                                    perm, smask)
-                    out_cvs = self._gather_cols(scvs, lg, lvalid)
-                    out_cvs += self._gather_cols(bcvs, rg, rvalid)
-                    tbl = make_table(self.schema, out_cvs, n_out)
-                m.add("numOutputRows", n_out)
-                m.add("numOutputBatches", 1)
-                yield DeviceBatch(tbl, n_out,
-                                  jnp.arange(out_cap) < n_out, out_cap)
+                for results in with_retry(batch, probe_one):
+                    for kind, payload in results:
+                        if kind == "matched_b":
+                            matched_b_acc = matched_b_acc | payload
+                        else:
+                            yield payload
 
         if self.how in ("right", "full"):
             unmatched = bmask & ~matched_b_acc
             n_un = fetch_int((jnp.sum(unmatched)))
             if n_un > 0:
                 # emit unmatched build rows with null left columns
-                idx = jnp.arange(cap_b, dtype=jnp.int32)
                 out_cvs = []
                 for f in left.schema.fields:
                     np_dt = f.dtype.np_dtype or jnp.int8
@@ -418,6 +374,76 @@ class HashJoinExec(TpuExec):
                             for cv in bcvs]
                 tbl = make_table(self.schema, out_cvs, cap_b)
                 yield DeviceBatch(tbl, cap_b, unmatched, cap_b)
+
+    def _probe_batch(self, ctx, m, batch, bcvs, bmask, bkey_cvs, cap_b,
+                     fast, sorted_ukey, bperm, n_valid_b):
+        """One stream batch through count/probe + expand. Yields
+        ("matched_b", mask) and ("batch", DeviceBatch) items. Idempotent
+        (retry/split safe): all semantics are stream-row-local and
+        matched-build marks OR-accumulate in the caller."""
+        with m.timer("opTime"):
+            scvs, smask = batch.cvs(), batch.row_mask
+            cap_s = batch.capacity
+            sctx = EmitCtx(scvs, cap_s)
+            skey_cvs = [k.emit(sctx) for k in self.lkeys]
+            if fast:
+                pkey = ("probe", cap_b, cap_s)
+                pfn = self._count_cache.get(pkey)
+                if pfn is None:
+                    pfn = jax.jit(self._probe_fn(cap_b, cap_s))
+                    self._count_cache[pkey] = pfn
+                (cnt, offsets, total, bstart,
+                 touched) = pfn(sorted_ukey, n_valid_b, skey_cvs[0],
+                                smask)
+                perm = bperm
+                if self.how in ("right", "full"):
+                    yield ("matched_b", self._matched_from_touched(
+                        bperm, touched, n_valid_b,
+                        jnp.zeros(cap_b, jnp.bool_)))
+            else:
+                nchunks = self._key_nchunks(bkey_cvs, bmask,
+                                            skey_cvs, smask)
+                ckey = (nchunks, cap_b, cap_s)
+                cfn = self._count_cache.get(ckey)
+                if cfn is None:
+                    cfn = jax.jit(self._count_fn(nchunks, cap_b, cap_s))
+                    self._count_cache[ckey] = cfn
+                (cnt, offsets, total, bstart, perm,
+                 matched_b) = cfn(bkey_cvs, bmask, skey_cvs, smask)
+                if self.how in ("right", "full"):
+                    yield ("matched_b", matched_b)
+            if self.how == "left_semi":
+                yield ("batch", DeviceBatch(batch.table, batch.num_rows,
+                                            smask & (cnt > 0), cap_s))
+                return
+            if self.how == "left_anti":
+                yield ("batch", DeviceBatch(batch.table, batch.num_rows,
+                                            smask & (cnt == 0), cap_s))
+                return
+            with_left_nulls = self.how in ("left", "full")
+            if with_left_nulls:
+                eff = jnp.where(smask & (cnt == 0), 1, cnt)
+                n_out = fetch_int((jnp.sum(eff)))
+            else:
+                n_out = fetch_int((total))
+            if n_out == 0:
+                return
+            out_cap = bucket_capacity(n_out)
+            ekey = (out_cap, cap_b, cap_s, with_left_nulls)
+            efn = self._expand_cache.get(ekey)
+            if efn is None:
+                efn = jax.jit(self._expand_fn(out_cap, cap_b,
+                                              with_left_nulls))
+                self._expand_cache[ekey] = efn
+            lg, rg, lvalid, rvalid, _ = efn(cnt, offsets, bstart, perm,
+                                            smask)
+            out_cvs = self._gather_cols(scvs, lg, lvalid)
+            out_cvs += self._gather_cols(bcvs, rg, rvalid)
+            tbl = make_table(self.schema, out_cvs, n_out)
+        m.add("numOutputRows", n_out)
+        m.add("numOutputBatches", 1)
+        yield ("batch", DeviceBatch(tbl, n_out,
+                                    jnp.arange(out_cap) < n_out, out_cap))
 
     # ------------------------------------------------------------------
     def _execute_cross(self, ctx: ExecContext):

@@ -232,7 +232,12 @@ class WindowExec(TpuExec):
             if fn is None:
                 fn = jax.jit(lambda c, mk: self._compute(c, mk, nchunks))
                 self._jit_cache[nchunks] = fn
-            sorted_cols, outs, live = fn(cvs, mask)
+            # window frames span the whole partition: input splitting is
+            # not legal, so OOM protection is retry-after-spill only
+            # (the GpuRetryOOM half of the reference's retry framework)
+            from ..memory.retry import retry_no_split
+            sorted_cols, outs, live = retry_no_split(
+                lambda: fn(cvs, mask))
         cap = live.shape[0]
         tbl = make_table(self.schema, list(sorted_cols) + list(outs), cap)
         m.add("numOutputBatches", 1)

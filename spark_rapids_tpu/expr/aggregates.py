@@ -189,7 +189,7 @@ class CountStar(AggExpr):
         return "count(*)"
 
 
-def _d128_sortable(data2, for_min: bool):
+def _d128_sortable(data2):
     """[cap,2] -> (hi, lo') where lexicographic (hi, lo') min/max equals
     the signed 128-bit min/max: hi signed, lo bias-flipped to signed-
     comparable unsigned order."""
@@ -231,7 +231,7 @@ class _MinMax(AggExpr):
 
     # -- decimal128: lexicographic (hi, lo') reduction -------------------
     def _d128_masked(self, cv, m):
-        hi, lo = _d128_sortable(cv.data, self.for_min)
+        hi, lo = _d128_sortable(cv.data)
         ident_hi = _ident(jnp.dtype(jnp.int64), self.for_min)
         hi = jnp.where(m, hi, ident_hi)
         lo = jnp.where(m, lo, ident_hi)

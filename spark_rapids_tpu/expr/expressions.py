@@ -401,6 +401,15 @@ def _dec_scale_shift(cv: CV, shift: int) -> CV:
     return CV(cv.data * (10 ** shift), cv.validity)
 
 
+def _reject_d128(dtype, what: str):
+    """Gate for operators not yet wired to the two-limb kernels: a
+    decimal128 column through a plain elementwise kernel would silently
+    corrupt (1-D math over [cap,2] limb pairs)."""
+    if isinstance(dtype, dt.DecimalType) and dtype.is_decimal128:
+        raise UnsupportedExpr(
+            f"{what} over decimal precision > 18 not yet implemented")
+
+
 def _adjust_precision_scale(p: int, s: int):
     """Spark DecimalType.adjustPrecisionScale: clamp precision at 38,
     sacrificing scale down to a floor of min(s, 6)."""
@@ -551,6 +560,8 @@ class IntDivide(_BinaryOp):
         self.left, self.right, out = _coerce_pair(self.left, self.right)
         if out is None or not out.is_integral:
             if out is None:
+                _reject_d128(self.left.dtype, "div")
+                _reject_d128(self.right.dtype, "div")
                 self.dtype = dt.INT64
                 return
             raise UnsupportedExpr("div on non-integral")
@@ -573,6 +584,8 @@ class Remainder(_BinaryOp):
     def _resolve_type(self):
         self.left, self.right, out = _coerce_pair(self.left, self.right)
         if out is None:
+            _reject_d128(self.left.dtype, "remainder")
+            _reject_d128(self.right.dtype, "remainder")
             s = max(self.left.dtype.scale, self.right.dtype.scale)
             p = min(18, max(self.left.dtype.precision,
                             self.right.dtype.precision))
@@ -616,6 +629,10 @@ class _UnaryOp(Expression):
 
 
 class Negate(_UnaryOp):
+    def _resolve_type(self):
+        _reject_d128(self.child.dtype, "negate")
+        self.dtype = self.child.dtype
+
     def emit(self, ctx):
         return ew.negate(self.child.emit(ctx))
 
@@ -624,6 +641,10 @@ class Negate(_UnaryOp):
 
 
 class Abs(_UnaryOp):
+    def _resolve_type(self):
+        _reject_d128(self.child.dtype, "abs")
+        self.dtype = self.child.dtype
+
     def emit(self, ctx):
         return ew.abs_(self.child.emit(ctx))
 
@@ -1027,6 +1048,7 @@ class Round(Expression):
     def bind(self, schema):
         b = Round(self.child.bind(schema), self.digits)
         ct = b.child.dtype
+        _reject_d128(ct, "round")
         if isinstance(ct, dt.DecimalType):
             b.dtype = dt.DecimalType(ct.precision,
                                      min(ct.scale, max(self.digits, 0)))
@@ -1094,6 +1116,7 @@ class _MinMaxOf(Expression):
         out = bc[0].dtype
         for c in bc[1:]:
             out = dt.promote(out, c.dtype) if c.dtype != out else out
+        _reject_d128(out, "greatest/least")
         bc = [c if c.dtype == out else Cast.bound(c, out) for c in bc]
         b = type(self)(*bc)
         b.dtype = out

@@ -311,6 +311,19 @@ def merge_delta(session, path: str, source_df, on: List[str],
         import pyarrow as pa
         actions: List[dict] = []
         src_df = session.create_dataframe(src)
+        if when_matched is not None:
+            # Delta MERGE semantics: a target row matched by MULTIPLE
+            # source rows is an error, not a cardinality change
+            from ..functions import count as f_count
+            dup = src_df.group_by(*on).agg(f_count("*").alias("__c"))
+            dup_keys = dup.filter(col_("__c") > 1)
+            if dup_keys.count() > 0:
+                tgt = read_delta(session, path)
+                hits = tgt.join(dup_keys, on=on, how="left_semi")
+                if hits.count() > 0:
+                    raise ValueError(
+                        "MERGE: multiple source rows matched the same "
+                        "target row")
         # rename non-key source columns so post-join references are
         # unambiguous ("update all" must read the SOURCE's value)
         src_ren = src_df.select(*(

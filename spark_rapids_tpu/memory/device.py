@@ -81,6 +81,13 @@ class DeviceManager:
         with self._lock:
             self._reserved = max(0, self._reserved - nbytes)
 
+    def trigger_spill(self, nbytes: Optional[int] = None):
+        """Ask the spill store to free memory proactively (the retry
+        framework's pressure valve between attempts)."""
+        need = nbytes if nbytes is not None else max(self.budget // 4, 1)
+        for hook in self._spill_hooks:
+            hook(need)
+
 
 _GLOBAL: Optional[DeviceManager] = None
 _GLOBAL_LOCK = threading.Lock()

@@ -82,3 +82,24 @@ def with_retry(batch: DeviceBatch,
                     f"still OOM after {depth} splits") from e
             halves = split_batch_in_half(b)
             stack = [(halves[0], depth + 1), (halves[1], depth + 1)] + stack
+
+
+def retry_no_split(fn: Callable[[], object], retries: int = 2):
+    """Run `fn` (idempotent), retrying after gc + spill-hook pressure on
+    device OOM — for operators whose semantics forbid input splitting
+    (e.g. window frames spanning the whole partition). The GpuRetryOOM
+    half of the reference's retry framework without GpuSplitAndRetryOOM."""
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except Exception as e:  # noqa: BLE001 - filtered below
+            if not is_oom_error(e) or attempt >= retries:
+                raise
+            attempt += 1
+            gc.collect()
+            try:
+                from .device import device_manager
+                device_manager().trigger_spill()
+            except Exception:
+                pass

@@ -203,19 +203,78 @@ def _sort(meta, conv, conf):
                     meta.node.schema)
 
 
+def _estimate_rows(node: L.LogicalPlan):
+    """Best-effort row estimate from scan metadata (the planner's
+    broadcast-decision input; reference: size estimates feeding
+    useSizedJoin / autoBroadcastJoinThreshold)."""
+    if isinstance(node, L.InMemoryScan):
+        return node.arrow.num_rows
+    if isinstance(node, L.CachedScan):
+        return sum(b.num_rows for b in node.batches)
+    if isinstance(node, L.ParquetScan):
+        cached = getattr(node, "_est_rows_cache", False)
+        if cached is not False:
+            return cached
+        import pyarrow.parquet as pq
+        try:
+            rows = sum(pq.ParquetFile(p).metadata.num_rows
+                       for p in node.paths)
+        except Exception:
+            rows = None
+        node._est_rows_cache = rows
+        return rows
+    if isinstance(node, (L.Project, L.Filter, L.Sort, L.Repartition,
+                         L.WindowOp)):
+        # filters keep the upper bound (a conservative broadcast choice)
+        return _estimate_rows(node.children[0])
+    if isinstance(node, L.Limit):
+        child = _estimate_rows(node.children[0])
+        return node.n if child is None else min(node.n, child)
+    if isinstance(node, L.Union):
+        parts = [_estimate_rows(c) for c in node.children]
+        return None if any(p is None for p in parts) else sum(parts)
+    if isinstance(node, L.Aggregate):
+        return _estimate_rows(node.children[0])
+    return None
+
+
+def _row_width_bytes(schema) -> int:
+    w = 1  # validity
+    for f in schema.fields:
+        if f.dtype.is_variable_width:
+            w += 24
+        elif getattr(f.dtype, "is_decimal128", False):
+            w += 16
+        else:
+            w += (f.dtype.np_dtype.itemsize if f.dtype.np_dtype else 8)
+    return w
+
+
+def _estimate_bytes(node: L.LogicalPlan):
+    rows = _estimate_rows(node)
+    if rows is None:
+        return None
+    return rows * _row_width_bytes(node.schema)
+
+
 @_rule(L.Join)
 def _join(meta, conv, conf):
-    from ..config import MESH_DEVICES
+    from ..config import BROADCAST_THRESHOLD, MESH_DEVICES, \
+        SHUFFLE_PARTITIONS
     from ..exec.join import HashJoinExec
     n = meta.node
     left, right = conv(meta.children[0]), conv(meta.children[1])
     mesh_n = conf.get(MESH_DEVICES)
-    if (mesh_n > 1 and n.how != "cross" and n.bound_left_keys
+    thr = conf.get(BROADCAST_THRESHOLD)
+    est = _estimate_bytes(meta.children[1].node)
+    broadcast_ok = thr >= 0 and est is not None and est <= thr
+    equi = (n.how != "cross" and n.bound_left_keys
             and all(lk.dtype == rk.dtype for lk, rk in
-                    zip(n.bound_left_keys, n.bound_right_keys))):
-        # distributed shuffled join: hash-exchange both sides on the join
-        # keys over the mesh, then each shard joins its co-partitioned
-        # slice (GpuShuffledHashJoinExec over GpuShuffleExchange)
+                    zip(n.bound_left_keys, n.bound_right_keys)))
+    if mesh_n > 1 and equi and not broadcast_ok:
+        # big build: hash-exchange both sides on the join keys over the
+        # mesh, then each shard joins its co-partitioned slice
+        # (GpuShuffledSizedHashJoinExec spirit over the collective)
         from ..exec.mesh_exchange import MeshExchangeExec
         lex = MeshExchangeExec(left, mesh_n, n.bound_left_keys,
                                left.schema)
@@ -224,6 +283,21 @@ def _join(meta, conv, conf):
         return HashJoinExec(lex, rex, n.bound_left_keys,
                             n.bound_right_keys, n.how, n.schema,
                             per_partition=True)
+    if mesh_n <= 1 and equi and not broadcast_ok and est is not None:
+        # single-host big-build join: file-shuffle both sides so each
+        # partition's build slice is bounded (sized-join analog)
+        from ..exec.exchange import ShuffleExchangeExec
+        nparts = conf.get(SHUFFLE_PARTITIONS)
+        if nparts > 1:
+            lex = ShuffleExchangeExec(left, nparts, n.bound_left_keys,
+                                      left.schema)
+            rex = ShuffleExchangeExec(right, nparts, n.bound_right_keys,
+                                      right.schema)
+            return HashJoinExec(lex, rex, n.bound_left_keys,
+                                n.bound_right_keys, n.how, n.schema,
+                                per_partition=True)
+    # broadcast hash join: build side collected once, stream partitions
+    # probe it (GpuBroadcastHashJoinExecBase analog)
     return HashJoinExec(left, right, n.bound_left_keys,
                         n.bound_right_keys, n.how, n.schema)
 

@@ -88,10 +88,14 @@ for _n in ("RLike", "RegexpExtract", "RegexpReplace"):
     register(_n, STRING,
              "regex (NFA subset; others run via CPU fallback)")
 register("Cast", ALL_COMMON, "cast matrix per docs/compatibility.md")
-for _n in ("Sum", "Min", "Max", "Count", "CountStar", "Avg", "First",
-           "Last", "VarianceSamp", "StddevSamp"):
+for _n in ("Sum", "Min", "Max", "Count", "CountStar", "First", "Last"):
     register(_n, NUMERIC + DATETIME + BOOL,
              "aggregate (Count: all types)")
+DEC64 = TypeSig(dt.DecimalType, note="precision <= 18 only")
+for _n in ("Avg", "VarianceSamp", "StddevSamp", "Variance", "Stddev"):
+    register(_n, INTEGRAL + FLOATING + DEC64 + BOOL + NULL,
+             "aggregate; decimal limited to p<=18 "
+             "(sum/count explicitly for p>18)")
 register("Greatest", NUMERIC + DATETIME + STRING, "n-ary minmax")
 register("Least", NUMERIC + DATETIME + STRING, "n-ary minmax")
 

@@ -368,3 +368,30 @@ def test_float_to_d128_cast(session):
     assert got[1] == decimal.Decimal("-2.25")
     assert got[2] == decimal.Decimal(10**20)
     assert got[3] is None
+
+
+def test_d128_ungated_ops_raise_cleanly(session):
+    from spark_rapids_tpu.expr.expressions import UnsupportedExpr
+    import pytest as pt
+    df = session.create_dataframe({
+        "d": pa.array([_dec(10**20)], pa.decimal128(21, 0))})
+    for build in (lambda: df.select((-col("d")).alias("x")),
+                  lambda: df.select(F.abs(col("d")).alias("x")),
+                  lambda: df.select((col("d") % col("d")).alias("x")),
+                  lambda: df.select(F.round(col("d"), 0).alias("x"))):
+        with pt.raises(UnsupportedExpr):
+            build().to_arrow()
+
+
+def test_delta_merge_multiple_match_raises(session, tmp_path):
+    from spark_rapids_tpu.io.delta import merge_delta
+    p = str(tmp_path / "mm")
+    session.create_dataframe({
+        "k": pa.array([1, 2], pa.int64()),
+        "v": pa.array([10, 20], pa.int64())}).write_delta(p)
+    src = session.create_dataframe({
+        "k": pa.array([1, 1], pa.int64()),
+        "v": pa.array([100, 200], pa.int64())})
+    import pytest as pt
+    with pt.raises(ValueError, match="multiple source rows"):
+        merge_delta(session, p, src, on=["k"])

@@ -225,3 +225,44 @@ def test_chained_join_duplicate_names_preserved(session):
                         for i in range(out.num_columns))
                   for j in range(out.num_rows))
     assert rows == [(1, 10, 100, 7), (2, 20, 200, 8), (3, 30, 300, 9)]
+
+
+def test_join_broadcast_vs_shuffled_decision(session):
+    import pyarrow as pa
+    import spark_rapids_tpu as st
+    from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+
+    def plan_kinds(conf, n_left, n_right):
+        s = st.TpuSession(conf)
+        l = s.create_dataframe({
+            "k": pa.array([i % 50 for i in range(n_left)], pa.int64()),
+            "a": pa.array(list(range(n_left)), pa.int64())})
+        r = s.create_dataframe({
+            "k": pa.array([i % 50 for i in range(n_right)], pa.int64()),
+            "b": pa.array(list(range(n_right)), pa.int64())})
+        j = l.join(r, on=["k"])
+        root, _ = j._execute()
+        kinds = [type(op).__name__ for op in _walk(root)]
+        out = j.to_arrow()
+        want = 0
+        rk = [i % 50 for i in range(n_right)]
+        for k in (i % 50 for i in range(n_left)):
+            want += rk.count(k)
+        assert out.num_rows == want
+        return kinds
+
+    # small build -> broadcast (no exchanges under the join)
+    kinds = plan_kinds({"spark.rapids.tpu.sql.batchSizeRows": 128},
+                       500, 60)
+    assert "ShuffleExchangeExec" not in kinds, kinds
+    # tiny threshold forces the sized/shuffled path
+    kinds2 = plan_kinds({"spark.rapids.tpu.sql.batchSizeRows": 128,
+                         "spark.rapids.tpu.sql.autoBroadcastJoinThreshold":
+                         64}, 500, 400)
+    assert kinds2.count("ShuffleExchangeExec") == 2, kinds2
+
+
+def _walk(node):
+    yield node
+    for c in node.children:
+        yield from _walk(c)

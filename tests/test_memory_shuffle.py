@@ -183,3 +183,89 @@ def test_range_partition_ordering(session):
     nonempty = [p for p in parts if p]
     for a, b in zip(nonempty, nonempty[1:]):
         assert max(a) <= min(b)
+
+
+# ---- per-operator OOM injection (the *RetrySuite analog) ---------------
+def test_join_probe_retry_splits_stream(session, monkeypatch):
+    """Inject OOM into the join's probe: the stream batch must split and
+    the join result stay exact."""
+    import pyarrow as pa
+    from spark_rapids_tpu.exec.join import HashJoinExec
+    n = 1000
+    l = session.create_dataframe({
+        "k": pa.array([i % 40 for i in range(n)], pa.int64()),
+        "a": pa.array(list(range(n)), pa.int64())})
+    r = session.create_dataframe({
+        "k": pa.array(list(range(40)), pa.int64()),
+        "b": pa.array([i * 2 for i in range(40)], pa.int64())})
+    orig = HashJoinExec._probe_batch
+    state = {"fired": 0}
+
+    def flaky(self, ctx, m, batch, *a, **kw):
+        if state["fired"] < 2 and batch.capacity > 256:
+            state["fired"] += 1
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+        yield from orig(self, ctx, m, batch, *a, **kw)
+
+    monkeypatch.setattr(HashJoinExec, "_probe_batch", flaky)
+    out = l.join(r, on=["k"]).to_arrow()
+    assert state["fired"] >= 1
+    assert out.num_rows == n
+    got = sorted(zip(out.column(0).to_pylist(), out.column(2).to_pylist()))
+    want = sorted((i % 40, (i % 40) * 2) for i in range(n))
+    assert got == want
+
+
+def test_exchange_map_retry_splits(monkeypatch):
+    import pyarrow as pa
+    import spark_rapids_tpu as st
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 512})
+    n = 2000
+    df = s.create_dataframe({
+        "k": pa.array([i % 9 for i in range(n)], pa.int64()),
+        "v": pa.array(list(range(n)), pa.int64())})
+    orig = ShuffleExchangeExec._map_fn
+    state = {"fired": 0}
+
+    def flaky(self, cvs, mask):
+        if state["fired"] < 1 and mask.shape[0] > 256:
+            state["fired"] += 1
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+        return orig(self, cvs, mask)
+
+    monkeypatch.setattr(ShuffleExchangeExec, "_map_fn", flaky)
+    out = df.group_by("k").agg(F.sum("v").alias("s")).to_arrow()
+    got = dict(zip(out.column(0).to_pylist(), out.column(1).to_pylist()))
+    want = {}
+    for i in range(n):
+        want[i % 9] = want.get(i % 9, 0) + i
+    assert got == want
+    assert state["fired"] == 1
+
+
+def test_window_retry_no_split(session, monkeypatch):
+    import pyarrow as pa
+    import spark_rapids_tpu.functions as F
+    from spark_rapids_tpu.window import Window, row_number
+    from spark_rapids_tpu.exec.window import WindowExec
+    orig = WindowExec._compute
+    state = {"fired": 0}
+
+    def flaky(self, cvs, mask, nchunks):
+        if state["fired"] < 1:
+            state["fired"] += 1
+            raise RuntimeError("RESOURCE_EXHAUSTED: injected")
+        return orig(self, cvs, mask, nchunks)
+
+    monkeypatch.setattr(WindowExec, "_compute", flaky)
+    df = session.create_dataframe({
+        "g": pa.array([1, 1, 2, 2], pa.int64()),
+        "v": pa.array([3, 1, 4, 2], pa.int64())})
+    w = Window.partition_by("g").order_by("v")
+    out = df.select("g", "v", row_number().over(w).alias("r")).to_arrow()
+    assert state["fired"] == 1
+    rows = sorted(zip(out.column(0).to_pylist(), out.column(1).to_pylist(),
+                      out.column(2).to_pylist()))
+    assert rows == [(1, 1, 1), (1, 3, 2), (2, 2, 1), (2, 4, 2)]

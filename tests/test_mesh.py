@@ -251,10 +251,17 @@ def test_distributed_join_matches_single_host():
                           out.column(2).to_pylist()))
 
     single = run({"spark.rapids.tpu.sql.batchSizeRows": 128})
+    # force the shuffled path (a tiny broadcast threshold) so the mesh
+    # exchange is actually exercised; small builds would broadcast
     meshed = run({"spark.rapids.tpu.sql.batchSizeRows": 128,
-                  "spark.rapids.tpu.mesh.devices": N_DEV},
+                  "spark.rapids.tpu.mesh.devices": N_DEV,
+                  "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 16},
                  want_mesh=True)
     assert meshed == single
+    # small build under mesh: broadcast (no exchange), same answer
+    bc = run({"spark.rapids.tpu.sql.batchSizeRows": 128,
+              "spark.rapids.tpu.mesh.devices": N_DEV})
+    assert bc == single
 
 
 @pytest.mark.parametrize("how", ["left", "right", "full", "left_semi",
@@ -280,7 +287,8 @@ def test_distributed_outer_joins_match_single_host(how):
 
     single = run({"spark.rapids.tpu.sql.batchSizeRows": 128})
     meshed = run({"spark.rapids.tpu.sql.batchSizeRows": 128,
-                  "spark.rapids.tpu.mesh.devices": N_DEV})
+                  "spark.rapids.tpu.mesh.devices": N_DEV,
+                  "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 16})
     assert meshed == single
 
 
