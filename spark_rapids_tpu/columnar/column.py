@@ -25,7 +25,38 @@ import numpy as np
 
 from . import dtypes as dt
 
-__all__ = ["Column", "bucket_capacity", "MIN_CAPACITY"]
+__all__ = ["Column", "bucket_capacity", "MIN_CAPACITY", "flatten_bufs",
+           "unflatten_bufs"]
+
+
+def flatten_bufs(bufs, prefix: str = "", out=None):
+    """Flatten a (possibly nested) device_buffers tree into path->array,
+    for flat containers like npz spill files. Children get `ch<j>.` path
+    segments."""
+    if out is None:
+        out = {}
+    for k, v in bufs.items():
+        if k == "children":
+            for j, cb in enumerate(v):
+                flatten_bufs(cb, f"{prefix}ch{j}.", out)
+        else:
+            out[prefix + k] = np.asarray(v)
+    return out
+
+
+def unflatten_bufs(flat):
+    """Inverse of flatten_bufs."""
+    bufs, kids = {}, {}
+    for k, v in flat.items():
+        if k.startswith("ch"):
+            head, _, rest = k.partition(".")
+            if rest and head[2:].isdigit():
+                kids.setdefault(int(head[2:]), {})[rest] = v
+                continue
+        bufs[k] = v
+    if kids:
+        bufs["children"] = [unflatten_bufs(kids[j]) for j in sorted(kids)]
+    return bufs
 
 MIN_CAPACITY = 128
 
@@ -101,8 +132,37 @@ class Column:
         """Build a device column from a pyarrow Array/ChunkedArray."""
         dtype, n, bufs = Column.host_from_arrow(arr, dtype)
         dev = jax.device_put(bufs)
-        return Column(dtype, n, dev["data"], dev["validity"],
-                      dev.get("offsets"))
+        return Column.build(dtype, n, dev)
+
+    @staticmethod
+    def element_dtype(dtype: dt.DataType) -> dt.DataType:
+        """Element type of a list layout; maps are list<struct<key,value>>."""
+        if isinstance(dtype, dt.MapType):
+            return dt.StructType((dt.StructField("key", dtype.key, False),
+                                  dt.StructField("value", dtype.value)))
+        return dtype.element
+
+    @staticmethod
+    def build(dtype: dt.DataType, n: int, bufs) -> "Column":
+        """Construct a (possibly nested) Column from a bufs tree (host or
+        device arrays). Nested child logical lengths ride in the `_n` leaf
+        written by host_from_arrow/device_buffers."""
+        if isinstance(dtype, (dt.ArrayType, dt.MapType)):
+            cb = bufs["children"][0]
+            child = Column.build(Column.element_dtype(dtype),
+                                 int(cb["_n"]), cb)
+            return Column(dtype, n, jnp.zeros(0, jnp.int8),
+                          jnp.asarray(bufs["validity"]),
+                          jnp.asarray(bufs["offsets"]), [child])
+        if isinstance(dtype, dt.StructType):
+            kids = [Column.build(f.dtype, int(cb["_n"]), cb)
+                    for f, cb in zip(dtype.fields, bufs["children"])]
+            return Column(dtype, n, jnp.zeros(0, jnp.int8),
+                          jnp.asarray(bufs["validity"]), None, kids)
+        off = bufs.get("offsets")
+        return Column(dtype, n, jnp.asarray(bufs["data"]),
+                      jnp.asarray(bufs["validity"]),
+                      jnp.asarray(off) if off is not None else None)
 
     @staticmethod
     def host_from_arrow(arr, dtype: Optional[dt.DataType] = None):
@@ -171,8 +231,41 @@ class Column:
             return dtype, n, {"data": np.zeros(cap, np.int8),
                               "validity": np.zeros(cap, np.bool_)}
 
-        if dtype.is_nested:
-            raise NotImplementedError("nested from_arrow lands with nested ops")
+        if isinstance(dtype, (dt.ArrayType, dt.MapType)):
+            # List layout: int32 offsets [n+1] + flattened element child.
+            # Offsets are kept exactly as Arrow stores them (not normalized
+            # to start at 0; null slots keep their placeholder range) —
+            # every kernel derives lengths as offsets[i+1]-offsets[i] AND
+            # masks by validity, so placeholder ranges are never read.
+            if pa.types.is_large_list(arr.type):
+                arr = arr.cast(pa.list_(arr.type.value_type))
+            if isinstance(dtype, dt.MapType):
+                elem_dt = dt.StructType((
+                    dt.StructField("key", dtype.key, False),
+                    dt.StructField("value", dtype.value)))
+                child_arr = pa.StructArray.from_arrays(
+                    [arr.keys, arr.items], ["key", "value"])
+            else:
+                elem_dt = dtype.element
+                child_arr = arr.values
+            off = np.frombuffer(arr.buffers()[1], dtype=np.int32,
+                                count=n + 1 + arr.offset)[arr.offset:]
+            cdt, cn, cbufs = Column.host_from_arrow(child_arr, elem_dt)
+            cbufs["_n"] = np.int64(cn)
+            last = int(off[-1]) if n else 0
+            return dtype, n, {
+                "validity": _pad_to(validity, cap, False),
+                "offsets": _pad_to(off.astype(np.int32), cap + 1, fill=last),
+                "children": [cbufs]}
+
+        if isinstance(dtype, dt.StructType):
+            kids = []
+            for i, f in enumerate(dtype.fields):
+                cdt, cn, cbufs = Column.host_from_arrow(arr.field(i), f.dtype)
+                cbufs["_n"] = np.int64(cn)
+                kids.append(cbufs)
+            return dtype, n, {"validity": _pad_to(validity, cap, False),
+                              "children": kids}
 
         values = np.asarray(arr.fill_null(
             False if isinstance(dtype, dt.BooleanType) else 0))
@@ -183,6 +276,15 @@ class Column:
     @staticmethod
     def nulls(n: int, dtype: dt.DataType) -> "Column":
         cap = bucket_capacity(n)
+        if isinstance(dtype, (dt.ArrayType, dt.MapType)):
+            child = Column.nulls(0, Column.element_dtype(dtype))
+            return Column(dtype, n, jnp.zeros(0, jnp.int8),
+                          jnp.zeros(cap, jnp.bool_),
+                          jnp.zeros(cap + 1, jnp.int32), [child])
+        if isinstance(dtype, dt.StructType):
+            kids = [Column.nulls(n, f.dtype) for f in dtype.fields]
+            return Column(dtype, n, jnp.zeros(0, jnp.int8),
+                          jnp.zeros(cap, jnp.bool_), None, kids)
         np_dt = dtype.np_dtype or np.int8
         col = Column(dtype, n, jnp.zeros(cap, np_dt), jnp.zeros(cap, jnp.bool_))
         if dtype.is_variable_width:
@@ -196,6 +298,13 @@ class Column:
         d = {"data": self.data, "validity": self.validity}
         if self.offsets is not None:
             d["offsets"] = self.offsets
+        if self.children:
+            kids = []
+            for c in self.children:
+                cb = c.device_buffers()
+                cb["_n"] = np.int64(c.length)
+                kids.append(cb)
+            d["children"] = kids
         return d
 
     def to_arrow(self):
@@ -208,6 +317,40 @@ class Column:
         """Assemble a pyarrow array from fetched host buffers."""
         import pyarrow as pa
         validity = np.asarray(bufs["validity"])[:n]
+        if isinstance(dtype, (dt.ArrayType, dt.MapType)):
+            off = np.asarray(bufs["offsets"])[:n + 1].astype(np.int32)
+            cb = bufs["children"][0]
+            child = Column.arrow_from_host(Column.element_dtype(dtype),
+                                           int(cb["_n"]), cb)
+            if n and not validity.all():
+                # null slots may hold placeholder offset ranges: zero them
+                # out (dense rebuild) so the arrow array never references
+                # elements a consumer could misread.
+                lens = np.diff(off)
+                lens[~validity] = 0
+                starts = off[:-1].copy()
+                starts[~validity] = 0
+                dense = np.concatenate(
+                    [[0], np.cumsum(lens)]).astype(np.int32)
+                idx = np.concatenate(
+                    [np.arange(s, s + ln) for s, ln in zip(starts, lens)]
+                ) if dense[-1] else np.zeros(0, np.int64)
+                child = child.take(pa.array(idx, type=pa.int64()))
+                mask = np.concatenate([~validity, [False]])
+                off_arr = pa.array(dense, type=pa.int32(),
+                                   mask=mask)
+            else:
+                off_arr = pa.array(off, type=pa.int32())
+            if isinstance(dtype, dt.MapType):
+                return pa.MapArray.from_arrays(
+                    off_arr, child.field(0), child.field(1))
+            return pa.ListArray.from_arrays(off_arr, child)
+        if isinstance(dtype, dt.StructType):
+            kids = [Column.arrow_from_host(f.dtype, n, cb)
+                    for f, cb in zip(dtype.fields, bufs["children"])]
+            mask = (pa.array(~validity) if not validity.all() else None)
+            return pa.StructArray.from_arrays(
+                kids, [f.name for f in dtype.fields], mask=mask)
         if isinstance(dtype, (dt.StringType, dt.BinaryType)):
             off = np.asarray(bufs["offsets"])[:n + 1]
             nbytes = int(off[-1]) if n else 0

@@ -140,7 +140,7 @@ class Table:
         host = [Column.host_from_arrow(at.column(i))
                 for i in range(len(names))]
         dev = jax.device_put([bufs for _, _, bufs in host])
-        cols = [Column(dtype, n, d["data"], d["validity"], d.get("offsets"))
+        cols = [Column.build(dtype, n, d)
                 for (dtype, n, _), d in zip(host, dev)]
         return Table(names, cols)
 

@@ -705,3 +705,176 @@ class HashAggregateExec(TpuExec):
                 ks2.append(CV(kcv.data[:new_cap], kcv.validity[:new_cap]))
         st2 = [s[:new_cap] for s in st]
         return (ks2, st2, sl[:new_cap], new_cap)
+
+
+class CollectAggExec(TpuExec):
+    """Grouped aggregation when any aggregate is collect_list/collect_set.
+
+    One stable sort of the partition's rows by (keys [, value for sets])
+    makes each group's values contiguous: the sorted value column IS the
+    concatenated list child, group-count cumsums are the offsets. Plain
+    aggregates in the same GROUP BY ride the identical segmentation.
+    (reference: GpuCollectList/GpuCollectSet in aggregateFunctions.scala,
+    executed via cudf groupby collect; here the sort-segmented design means
+    collect costs one value gather beyond the regular agg sort.)
+
+    Distributed: the planner hash-exchanges input rows on the grouping keys
+    first, so per_partition collects are final (disjoint keys).
+    """
+
+    def __init__(self, child: TpuExec, key_names, bound_keys, agg_names,
+                 bound_aggs, schema: Schema, per_partition: bool = False):
+        super().__init__([child], schema)
+        self.key_names = list(key_names)
+        self.keys = list(bound_keys)
+        self.agg_names = list(agg_names)
+        self.aggs = list(bound_aggs)
+        self.per_partition = per_partition
+        self._run_cache = {}
+
+    def num_partitions(self, ctx):
+        if self.per_partition:
+            return self.children[0].num_partitions(ctx)
+        return 1
+
+    def describe(self):
+        return (f"CollectAggExec[keys={self.key_names}, "
+                f"aggs={self.agg_names}]")
+
+    def _value_nchunks(self, cvs, mask):
+        """Static order-key chunk counts for string-typed collect_set
+        values (dedup needs full-width comparisons)."""
+        cap = mask.shape[0]
+        ctx = EmitCtx(cvs, cap)
+        ncs = []
+        for a in self.aggs:
+            if getattr(a, "is_set", False) and isinstance(
+                    a.child.dtype, (dt.StringType, dt.BinaryType)):
+                vcv = a.child.emit(ctx)
+                lens = vcv.offsets[1:] - vcv.offsets[:-1]
+                lens = jnp.where(mask & vcv.validity, lens, 0)
+                ncs.append(sk.nchunks_for_len(
+                    max(fetch_int(jnp.max(lens)), 1)))
+            else:
+                ncs.append(0)
+        return tuple(ncs)
+
+    def _key_nchunks(self, cvs, mask):
+        cap = mask.shape[0]
+        ctx = EmitCtx(cvs, cap)
+        ncs = []
+        for k in self.keys:
+            if isinstance(k.dtype, (dt.StringType, dt.BinaryType)):
+                kcv = k.emit(ctx)
+                lens = kcv.offsets[1:] - kcv.offsets[:-1]
+                lens = jnp.where(mask & kcv.validity, lens, 0)
+                ncs.append(sk.nchunks_for_len(
+                    max(fetch_int(jnp.max(lens)), 1)))
+            else:
+                ncs.append(0)
+        return tuple(ncs)
+
+    def _run_fn(self, nchunks, vnchunks):
+        def fn(cvs, mask):
+            cap = mask.shape[0]
+            ctx = EmitCtx(cvs, cap)
+            key_cvs = [k.emit(ctx) for k in self.keys]
+            arrays = [jnp.logical_not(mask).astype(jnp.uint8)]  # dead last
+            key_arrays = []
+            for kcv, kexpr, nc in zip(key_cvs, self.keys, nchunks):
+                ka = [jnp.logical_not(kcv.validity).astype(jnp.uint8)]
+                ka += sk.order_keys(kcv, kexpr.dtype, nc)
+                key_arrays.extend(ka)
+                arrays.extend(ka)
+            val_cvs, set_arrays = [], []
+            for a, vnc in zip(self.aggs, vnchunks):
+                if getattr(a, "is_collect", False):
+                    vcv = a.child.emit(ctx)
+                    val_cvs.append(vcv)
+                    if a.is_set:
+                        va = [jnp.logical_not(vcv.validity)
+                              .astype(jnp.uint8)]
+                        va += sk.order_keys(vcv, a.child.dtype, vnc)
+                        set_arrays.append(va)
+                        arrays.extend(va)
+                    else:
+                        set_arrays.append(None)
+                else:
+                    val_cvs.append(None)
+                    set_arrays.append(None)
+            perm = sk.lexsort(arrays)
+            keys_sorted = [a_[perm] for a_ in key_arrays]
+            boundary = sk.group_boundaries(keys_sorted)
+            seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
+            live = mask[perm]
+            seg_live = jax.ops.segment_max(live.astype(jnp.int32),
+                                           seg_ids, cap) > 0
+            seg_start = jax.ops.segment_min(jnp.arange(cap), seg_ids, cap)
+            src_rows = perm[jnp.clip(seg_start, 0, cap - 1)]
+            outs = [take(kcv, src_rows, in_bounds=seg_live)
+                    for kcv in key_cvs]
+            for a, vcv, sa in zip(self.aggs, val_cvs, set_arrays):
+                if vcv is None:
+                    cv = (a.child.emit(ctx) if a.child is not None
+                          else CV(jnp.zeros(cap, jnp.int8),
+                                  jnp.ones(cap, jnp.bool_)))
+                    if cv.offsets is not None:
+                        scv = CV(jnp.zeros(cap, jnp.int8),
+                                 cv.validity[perm])
+                    else:
+                        scv = CV(cv.data[perm], cv.validity[perm])
+                    st = a.g_update(scv, live, seg_ids, cap)
+                    v, okv = a.finalize(st)
+                    outs.append(CV(v, okv & seg_live))
+                    continue
+                vs = take(vcv, perm)          # values in group order
+                keep = live & vs.validity     # Spark collect skips nulls
+                if sa is not None:
+                    # set: the sort grouped equal values adjacently within
+                    # each group; keep only each run's first row
+                    vb = sk.group_boundaries(
+                        keys_sorted + [x[perm] for x in sa])
+                    keep = keep & vb
+                cnt = jax.ops.segment_sum(keep.astype(jnp.int32),
+                                          seg_ids, cap)
+                off = jnp.concatenate([
+                    jnp.zeros(1, jnp.int32),
+                    jnp.cumsum(cnt).astype(jnp.int32)])
+                perm2 = jnp.argsort(jnp.logical_not(keep), stable=True)
+                inb = jnp.arange(cap) < off[cap]
+                child_cv = take(vs, perm2, inb)
+                outs.append(CV(jnp.zeros(0, jnp.int8), seg_live, off,
+                               (child_cv,)))
+            return outs, seg_live
+        return fn
+
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        m = ctx.metrics_for(self._op_id)
+        child = self.children[0]
+        child_pids = ([pid] if self.per_partition
+                      else range(child.num_partitions(ctx)))
+        batches = []
+        for cpid in child_pids:
+            batches.extend(child.execute_partition(ctx, cpid))
+        if not batches:
+            return
+        ncols = len(child.schema.fields)
+        with m.timer("opTime"):
+            if len(batches) == 1:
+                cvs, mask = batches[0].cvs(), batches[0].row_mask
+            else:
+                cvs = [concat_cvs([b.cvs()[i] for b in batches],
+                                  child.schema.fields[i].dtype)
+                       for i in range(ncols)]
+                mask = concat_masks([b.row_mask for b in batches])
+            nchunks = self._key_nchunks(cvs, mask)
+            vnchunks = self._value_nchunks(cvs, mask)
+            fn = self._run_cache.get((nchunks, vnchunks))
+            if fn is None:
+                fn = jax.jit(self._run_fn(nchunks, vnchunks))
+                self._run_cache[(nchunks, vnchunks)] = fn
+            outs, seg_live = fn(cvs, mask)
+            cap = mask.shape[0]
+        tbl = make_table(self.schema, outs, cap)
+        m.add("numOutputBatches", 1)
+        yield DeviceBatch(tbl, cap, seg_live, cap)

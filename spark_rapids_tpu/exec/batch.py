@@ -35,7 +35,10 @@ class DeviceBatch:
         self.row_mask = row_mask
 
     def cvs(self) -> List[CV]:
-        return [CV(c.data, c.validity, c.offsets) for c in self.table.columns]
+        def as_cv(c):
+            return CV(c.data, c.validity, c.offsets,
+                      tuple(as_cv(ch) for ch in c.children))
+        return [as_cv(c) for c in self.table.columns]
 
     @property
     def nbytes(self) -> int:

@@ -102,13 +102,10 @@ class ShuffleExchangeExec(TpuExec):
                 device partition + ONE bulk D2H (split-and-retry safe —
                 halves simply produce more sub-batches per partition)."""
                 with m.timer("partitionTime"):
+                    from ..shuffle.serializer import cv_shuffle_bufs
                     out, counts = self._jit(batch.cvs(), batch.row_mask)
                     return fetch({
-                        "cols": [{k: v for k, v in (
-                            ("data", cv.data),
-                            ("validity", cv.validity),
-                            ("offsets", cv.offsets))
-                            if v is not None} for cv in out],
+                        "cols": [cv_shuffle_bufs(cv) for cv in out],
                         "counts": counts,
                     })
 
@@ -124,25 +121,9 @@ class ShuffleExchangeExec(TpuExec):
                             if cnt == 0:
                                 continue
                             lo, hi = int(starts[rp]), int(starts[rp] + cnt)
-                            cols = []
-                            for f, cb in zip(self.schema.fields, host["cols"]):
-                                if "offsets" in cb:
-                                    off = np.asarray(cb["offsets"])
-                                    o = off[lo:hi + 1].astype(np.int32)
-                                    base = o[0]
-                                    cols.append({
-                                        "validity": np.asarray(
-                                            cb["validity"])[lo:hi],
-                                        "data": np.asarray(
-                                            cb["data"])[base:o[-1]],
-                                        "offsets": o - base,
-                                    })
-                                else:
-                                    cols.append({
-                                        "validity": np.asarray(
-                                            cb["validity"])[lo:hi],
-                                        "data": np.asarray(cb["data"])[lo:hi],
-                                    })
+                            from ..shuffle.serializer import slice_host_col
+                            cols = [slice_host_col(cb, lo, hi)
+                                    for cb in host["cols"]]
                             pieces[rp].append(HostSubBatch(cols, cnt))
                 with m.timer("writeTime"):
                     sh.write_map_partition(mpid, pieces)

@@ -1,0 +1,122 @@
+"""GenerateExec: explode / posexplode (+_outer) over list and map columns.
+
+Reference: sql-plugin/.../GpuGenerateExec.scala (GpuExplode, GpuPosExplode,
+outer variants). TPU-first design: per batch, ONE fused program computes the
+generator array and its effective per-row fan-out; the output row -> (parent
+row, element) map is a searchsorted over the output offsets — the same
+static-shape expansion pattern the join count/expand path uses, so the whole
+generate is two jitted programs (count, expand) regardless of row count.
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.column import bucket_capacity
+from ..columnar.table import Schema
+from ..expr.expressions import EmitCtx, Expression
+from ..ops import gather as ops_gather
+from ..ops.kernel_utils import CV
+from ..utils.transfer import fetch
+from .base import TpuExec
+from .batch import DeviceBatch
+from .nodes import make_table
+
+__all__ = ["GenerateExec"]
+
+
+class GenerateExec(TpuExec):
+    def __init__(self, child: TpuExec, bound_gen, schema: Schema,
+                 outer: bool = False):
+        super().__init__([child], schema)
+        self.gen = bound_gen                  # bound Explode/PosExplode
+        self.outer = outer or bound_gen.outer
+        self.with_pos = bound_gen.with_position
+        self.is_map = isinstance(bound_gen.child.dtype, dt.MapType)
+
+        def _count(cvs, mask):
+            ctx = EmitCtx(cvs, mask.shape[0])
+            arr = self.gen.child.emit(ctx)
+            lens = (arr.offsets[1:] - arr.offsets[:-1]).astype(jnp.int32)
+            lens = jnp.where(arr.validity & mask, lens, 0)
+            if self.outer:
+                # empty/null arrays on live rows still emit one (null) row
+                eff = jnp.where(mask, jnp.maximum(lens, 1), 0)
+            else:
+                eff = lens
+            out_off = jnp.concatenate([
+                jnp.zeros(1, jnp.int32), jnp.cumsum(eff).astype(jnp.int32)])
+            # var-width output sizing: parent col i repeats eff[i] times
+            measures = [ops_gather.repeat_measures(cv, eff) for cv in cvs]
+            return arr, lens, out_off, out_off[mask.shape[0]], measures
+
+        self._count = jax.jit(_count)
+        self._expand_cache = {}
+
+    def describe(self):
+        mode = "posexplode" if self.with_pos else "explode"
+        if self.outer:
+            mode += "_outer"
+        return f"GenerateExec[{mode}({self.gen.child!r})]"
+
+    def _expand_fn(self, out_cap: int, caps_key):
+        # instance-level memo: a class-global lru_cache would pin exec
+        # trees + XLA executables of finished queries
+        cached = self._expand_cache.get((out_cap, caps_key))
+        if cached is not None:
+            return cached
+        return self._build_expand(out_cap, caps_key)
+
+    def _build_expand(self, out_cap: int, caps_key):
+        def fn(cvs, mask, arr, lens, out_off):
+            cap = mask.shape[0]
+            j = jnp.arange(out_cap, dtype=jnp.int32)
+            parent = jnp.searchsorted(out_off[1:], j,
+                                      side="right").astype(jnp.int32)
+            parent = jnp.clip(parent, 0, cap - 1)
+            rel = j - out_off[parent]
+            total = out_off[cap]
+            out_live = j < total
+            elem_ok = out_live & (rel < lens[parent]) & arr.validity[parent]
+            epos = arr.offsets[:-1][parent] + jnp.where(elem_ok, rel, 0)
+            outs: List[CV] = [
+                ops_gather.take(cv, parent, out_live,
+                                iter(ck) if ck else None)
+                for cv, ck in zip(cvs, caps_key)]
+            if self.with_pos:
+                outs.append(CV(rel, elem_ok))
+            if self.is_map:
+                st = arr.child
+                outs.append(ops_gather.take(st.children[0], epos, elem_ok))
+                outs.append(ops_gather.take(st.children[1], epos, elem_ok))
+            else:
+                outs.append(ops_gather.take(arr.child, epos, elem_ok))
+            out_mask = out_live
+            return outs, out_mask
+
+        jfn = jax.jit(fn)
+        self._expand_cache[(out_cap, caps_key)] = jfn
+        return jfn
+
+    def execute_partition(self, ctx, pid):
+        m = ctx.metrics_for(self._op_id)
+        for batch in self.children[0].execute_partition(ctx, pid):
+            with m.timer("opTime"):
+                cvs = batch.cvs()
+                arr, lens, out_off, total_dev, measures = self._count(
+                    cvs, batch.row_mask)
+                total, got = fetch((total_dev, measures))
+                total = int(total)
+                out_cap = bucket_capacity(max(total, 1))
+                caps_key = tuple(
+                    tuple(bucket_capacity(max(int(v), 1)) for v in ms)
+                    for ms in got)
+                outs, out_mask = self._expand_fn(out_cap, caps_key)(
+                    cvs, batch.row_mask, arr, lens, out_off)
+            m.add("numOutputRows", total)
+            m.add("numOutputBatches", 1)
+            yield DeviceBatch(make_table(self.schema, outs, total),
+                              total, out_mask, out_cap)

@@ -46,18 +46,6 @@ from .nodes import make_table
 __all__ = ["HashJoinExec"]
 
 
-@jax.jit
-def _measure_string_bytes(offs, idxs, inbs):
-    """Total bytes each gathered string column needs (join expansion can
-    duplicate rows, so the source buffer capacity is NOT an upper bound)."""
-    outs = []
-    for off, idx, inb in zip(offs, idxs, inbs):
-        safe = jnp.clip(idx, 0, off.shape[0] - 2)
-        lens = off[safe + 1] - off[safe]
-        outs.append(jnp.sum(jnp.where(inb, lens.astype(jnp.int64), 0)))
-    return outs
-
-
 class HashJoinExec(TpuExec):
     def __init__(self, left: TpuExec, right: TpuExec,
                  bound_left_keys: Sequence[Expression],
@@ -289,27 +277,24 @@ class HashJoinExec(TpuExec):
         return fn
 
     def _gather_cols(self, cvs, idx, inb):
-        """Gather payload columns by idx; string columns get an output
-        data capacity sized from the actual gathered byte totals."""
-        str_cols = [i for i, cv in enumerate(cvs) if cv.offsets is not None]
-        dcaps = {}
-        if str_cols:
-            totals = _measure_string_bytes(
-                [cvs[i].offsets for i in str_cols],
-                [idx] * len(str_cols), [inb] * len(str_cols))
+        """Gather payload columns by idx; var-width columns (strings AND
+        nested lists, recursively) get output capacities sized from the
+        actual gathered unit totals — join expansion duplicates rows, so
+        source capacities are not upper bounds."""
+        from ..ops.gather import take_measures
+        var_cols = [i for i, cv in enumerate(cvs)
+                    if cv.offsets is not None or cv.children]
+        caps = {}
+        if var_cols:
+            measures = {i: take_measures(cvs[i], idx, inb)
+                        for i in var_cols}
             from ..utils.transfer import fetch
-            got = fetch(totals)
-            for i, t in zip(str_cols, got):
-                dcaps[i] = bucket_capacity(max(int(t), 1))
-        out = []
-        for i, cv in enumerate(cvs):
-            if cv.offsets is not None:
-                from ..ops.gather import take_strings
-                out.append(take_strings(cv, idx, in_bounds=inb,
-                                        out_data_capacity=dcaps[i]))
-            else:
-                out.append(take(cv, idx, in_bounds=inb))
-        return out
+            got = fetch(measures)
+            caps = {i: tuple(bucket_capacity(max(int(v), 1)) for v in ms)
+                    for i, ms in got.items()}
+        return [take(cv, idx, in_bounds=inb,
+                     caps=iter(caps[i]) if i in caps and caps[i] else None)
+                for i, cv in enumerate(cvs)]
 
     # ------------------------------------------------------------------
     def execute_partition(self, ctx: ExecContext, pid: int):

@@ -29,7 +29,18 @@ __all__ = ["InMemoryScanExec", "CachedScanExec", "ParquetScanExec",
 
 
 def cv_to_column(cv: CV, dtype: dt.DataType, length: int) -> Column:
-    return Column(dtype, length, cv.data, cv.validity, cv.offsets)
+    children = []
+    if isinstance(dtype, (dt.ArrayType, dt.MapType)):
+        # child logical length = its full capacity: parent offsets only
+        # reference the true element prefix, so trailing garbage is inert
+        # (avoids a device sync to learn the exact element count in-trace)
+        ch = cv.children[0]
+        children = [cv_to_column(ch, Column.element_dtype(dtype),
+                                 int(ch.validity.shape[0]))]
+    elif isinstance(dtype, dt.StructType):
+        children = [cv_to_column(ch, f.dtype, length)
+                    for ch, f in zip(cv.children, dtype.fields)]
+    return Column(dtype, length, cv.data, cv.validity, cv.offsets, children)
 
 
 def make_table(schema: Schema, cvs: Sequence[CV], num_rows: int) -> Table:

@@ -558,3 +558,36 @@ class Stddev(Variance):
 
     def _final_value(self, var):
         return jnp.sqrt(var)
+
+
+class _Collect(AggExpr):
+    """collect_list / collect_set (reference: aggregateFunctions.scala
+    GpuCollectList/GpuCollectSet over cudf collect aggregations).
+
+    Variable-width result: runs on CollectAggExec's sort path (one stable
+    sort by keys makes each group's values contiguous — the sorted value
+    column IS the concatenated list child), not the flat-state machinery.
+    `state_reducers = None` keeps HashAggregateExec from accepting it."""
+
+    state_reducers = None
+    is_collect = True
+    is_set = False
+
+    def _resolve_type(self):
+        from ..columnar import dtypes as _dt
+        if self.child.dtype.is_nested:
+            raise UnsupportedExpr(
+                f"{type(self).__name__.lower()} over nested input")
+        self.dtype = _dt.ArrayType(self.child.dtype, contains_null=False)
+
+
+class CollectList(_Collect):
+    def __repr__(self):
+        return f"collect_list({self.child})"
+
+
+class CollectSet(_Collect):
+    is_set = True
+
+    def __repr__(self):
+        return f"collect_set({self.child})"

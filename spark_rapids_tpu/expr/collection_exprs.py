@@ -1,0 +1,816 @@
+"""Collection (array/map/struct) expressions + higher-order functions.
+
+TPU analog of the reference's collection and lambda expression rules
+(reference: sql-plugin/.../collectionOperations.scala,
+complexTypeCreator.scala, complexTypeExtractors.scala,
+higherOrderFunctions.scala — GpuCreateArray, GpuGetArrayItem, GpuElementAt,
+GpuSize, GpuArrayContains, GpuSortArray, GpuCreateNamedStruct,
+GpuGetStructField, GpuArrayTransform, GpuArrayFilter, GpuArrayExists).
+
+Design (TPU-first): a list column is offsets[int32 cap+1] + a flattened
+element child CV. Per-row operations over elements become flat vectorized
+kernels over the element buffer plus `segment_*` reductions keyed by the
+element->row map (searchsorted over offsets) — no per-row loops, fully
+MXU/VPU friendly, one XLA program per expression tree. Offsets may be
+non-dense (arrow slices / null placeholder ranges); every kernel masks
+elements through `_elem_rows` instead of assuming density.
+"""
+from __future__ import annotations
+
+import itertools
+from typing import Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar import dtypes as dt
+from ..columnar.column import Column
+from ..ops import concat as ops_concat
+from ..ops import gather as ops_gather
+from ..ops.kernel_utils import CV
+from .expressions import (EmitCtx, Expression, Literal, UnsupportedExpr,
+                          _UnaryOp, _wrap)
+
+__all__ = [
+    "CreateArray", "GetArrayItem", "ElementAt", "Size", "ArrayContains",
+    "ArrayMin", "ArrayMax", "SortArray", "CreateNamedStruct",
+    "GetStructField", "MapKeys", "MapValues", "Explode", "PosExplode",
+    "NamedLambdaVariable", "ArrayTransform", "ArrayFilter", "ArrayExists",
+    "ArrayForAll", "ArrayAggregate",
+]
+
+
+# ----------------------------------------------------------------------
+# element-domain helpers
+# ----------------------------------------------------------------------
+def arr_lens(cv: CV) -> jnp.ndarray:
+    """Per-row element counts (0 for null rows / placeholder ranges)."""
+    lens = (cv.offsets[1:] - cv.offsets[:-1]).astype(jnp.int32)
+    return jnp.where(cv.validity, lens, 0)
+
+
+def _elem_rows(cv: CV):
+    """Map element buffer positions to their owning row.
+
+    Returns (rows, live): rows int32[ecap] clipped to [0, cap-1]; live is
+    False for positions in offset gaps (sliced-away prefixes, null rows'
+    placeholder ranges) and beyond the last row's end.
+    """
+    off = cv.offsets
+    cap = cv.validity.shape[0]
+    ecap = cv.child.capacity
+    pos = jnp.arange(ecap, dtype=jnp.int32)
+    rows = jnp.searchsorted(off[1:], pos, side="right").astype(jnp.int32)
+    rows = jnp.clip(rows, 0, cap - 1)
+    lens = arr_lens(cv)
+    live = ((pos >= off[rows]) & (pos < off[rows] + lens[rows])
+            & cv.validity[rows])
+    return rows, live
+
+
+class _LazyElemCvs:
+    """ctx.cvs adapter for lambda bodies: outer column references are
+    gathered to the element domain on first use (captured variables)."""
+
+    def __init__(self, cvs, rows, live):
+        self._cvs = cvs
+        self._rows = rows
+        self._live = live
+        self._cache = {}
+
+    def __getitem__(self, i):
+        if i not in self._cache:
+            self._cache[i] = ops_gather.take(self._cvs[i], self._rows,
+                                             self._live)
+        return self._cache[i]
+
+    def __len__(self):
+        return len(self._cvs)
+
+
+def _elem_ctx(ctx: EmitCtx, arr: CV):
+    rows, live = _elem_rows(arr)
+    ecap = arr.child.capacity
+    ectx = EmitCtx([], ecap)
+    ectx.cvs = _LazyElemCvs(ctx.cvs, rows, live)
+    ectx.lambda_vals = dict(ctx.lambda_vals)
+    return ectx, rows, live
+
+
+def _coerce(e: Expression, target: dt.DataType, what: str) -> Expression:
+    """Spark-style implicit cast of a bound expression to `target`."""
+    if e.dtype == target:
+        return e
+    if e.dtype.is_numeric and target.is_numeric:
+        from .expressions import Cast
+        return Cast.bound(e, target)
+    raise UnsupportedExpr(f"{what}: cannot coerce {e.dtype} to {target}")
+
+
+def _require_array(e: Expression, what: str):
+    if not isinstance(e.dtype, (dt.ArrayType, dt.MapType)):
+        raise UnsupportedExpr(f"{what} requires an array/map, got {e.dtype}")
+
+
+# ----------------------------------------------------------------------
+# constructors
+# ----------------------------------------------------------------------
+class CreateArray(Expression):
+    """array(e1, ..., ek): row i -> [e1[i], ..., ek[i]].
+
+    Emission: concatenate the k child CVs (child j occupying rows
+    [j*cap, (j+1)*cap)) then gather with src(i*k+j) = j*cap + i — one
+    uniform interleave gather that works for every element type including
+    strings and nested arrays (reference: complexTypeCreator.scala
+    GpuCreateArray)."""
+
+    def __init__(self, children: List[Expression]):
+        if not children:
+            raise UnsupportedExpr("array() needs at least one element")
+        self.children = list(children)
+
+    def bind(self, schema):
+        b = CreateArray([c.bind(schema) for c in self.children])
+        et = b.children[0].dtype
+        for c in b.children[1:]:
+            if c.dtype != et:
+                raise UnsupportedExpr(
+                    f"array() elements must share a type: {et} vs {c.dtype}")
+        b.dtype = dt.ArrayType(et)
+        return b
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        k = len(self.children)
+        cap = ctx.capacity
+        cvs = [c.emit(ctx) for c in self.children]
+        comb = ops_concat.concat_cvs(cvs, self.children[0].dtype) \
+            if k > 1 else cvs[0]
+        e = jnp.arange(cap * k, dtype=jnp.int32)
+        src = (e % k) * cap + e // k
+        child = ops_gather.take(comb, src)
+        off = (jnp.arange(cap + 1, dtype=jnp.int32) * k)
+        valid = jnp.ones(cap, jnp.bool_)
+        return CV(jnp.zeros(0, jnp.int8), valid, off, (child,))
+
+    def __repr__(self):
+        return f"array({', '.join(map(repr, self.children))})"
+
+
+class CreateNamedStruct(Expression):
+    """named_struct / struct(...) (reference: GpuCreateNamedStruct)."""
+
+    def __init__(self, names: List[str], children: List[Expression]):
+        assert len(names) == len(children)
+        self.names = list(names)
+        self.children = list(children)
+
+    def bind(self, schema):
+        b = CreateNamedStruct(self.names,
+                              [c.bind(schema) for c in self.children])
+        b.dtype = dt.StructType(tuple(
+            dt.StructField(n, c.dtype) for n, c in zip(b.names, b.children)))
+        return b
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        kids = tuple(c.emit(ctx) for c in self.children)
+        valid = jnp.ones(ctx.capacity, jnp.bool_)
+        return CV(jnp.zeros(0, jnp.int8), valid, None, kids)
+
+    def __repr__(self):
+        inner = ", ".join(f"{n}: {c!r}"
+                          for n, c in zip(self.names, self.children))
+        return f"struct({inner})"
+
+
+class GetStructField(Expression):
+    """col.field (reference: complexTypeExtractors.scala GpuGetStructField)."""
+
+    def __init__(self, child: Expression, field: str):
+        self.child = child
+        self.field = field
+        self.children = [child]
+
+    def bind(self, schema):
+        b = GetStructField(self.child.bind(schema), self.field)
+        if not isinstance(b.child.dtype, dt.StructType):
+            raise UnsupportedExpr(f"getField on {b.child.dtype}")
+        for i, f in enumerate(b.child.dtype.fields):
+            if f.name == self.field:
+                b._ordinal = i
+                b.dtype = f.dtype
+                return b
+        raise UnsupportedExpr(
+            f"no field {self.field!r} in {b.child.dtype}")
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        cv = self.child.emit(ctx)
+        ch = cv.children[self._ordinal]
+        return CV(ch.data, ch.validity & cv.validity, ch.offsets, ch.children)
+
+    def __repr__(self):
+        return f"{self.child}.{self.field}"
+
+
+# ----------------------------------------------------------------------
+# extractors / scalar ops
+# ----------------------------------------------------------------------
+class Size(_UnaryOp):
+    """size(array|map) -> int32; null input -> null (Spark 3.x
+    legacy.sizeOfNull=false semantics; reference: GpuSize)."""
+
+    def _resolve_type(self):
+        _require_array(self.child, "size")
+        self.dtype = dt.INT32
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        cv = self.child.emit(ctx)
+        return CV(arr_lens(cv), cv.validity)
+
+    def __repr__(self):
+        return f"size({self.child})"
+
+
+class GetArrayItem(Expression):
+    """arr[i], 0-based; out-of-bounds/negative -> null
+    (reference: GpuGetArrayItem)."""
+
+    def __init__(self, child: Expression, index):
+        self.child = child
+        self.index = _wrap(index)
+        self.children = [self.child, self.index]
+
+    def bind(self, schema):
+        b = GetArrayItem(self.child.bind(schema), self.index.bind(schema))
+        if not isinstance(b.child.dtype, dt.ArrayType):
+            raise UnsupportedExpr(f"getItem on {b.child.dtype}")
+        if not b.index.dtype.is_integral:
+            raise UnsupportedExpr(f"array index must be integral, "
+                                  f"got {b.index.dtype}")
+        b.dtype = b.child.dtype.element
+        return b
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        arr = self.child.emit(ctx)
+        idx = self.index.emit(ctx)
+        k = idx.data.astype(jnp.int32)
+        k = jnp.broadcast_to(k, (ctx.capacity,))
+        lens = arr_lens(arr)
+        ok = arr.validity & idx.validity & (k >= 0) & (k < lens)
+        pos = arr.offsets[:-1] + jnp.where(ok, k, 0)
+        return ops_gather.take(arr.child, pos, ok)
+
+    def __repr__(self):
+        return f"{self.child}[{self.index}]"
+
+
+class ElementAt(Expression):
+    """element_at(array, i) 1-based (negative = from the end) or
+    element_at(map, key) (reference: GpuElementAt)."""
+
+    def __init__(self, child: Expression, key):
+        self.child = child
+        self.key = _wrap(key)
+        self.children = [self.child, self.key]
+
+    def bind(self, schema):
+        b = ElementAt(self.child.bind(schema), self.key.bind(schema))
+        cdt = b.child.dtype
+        if isinstance(cdt, dt.ArrayType):
+            if not b.key.dtype.is_integral:
+                raise UnsupportedExpr("element_at(array, non-integer index)")
+            b.dtype = cdt.element
+        elif isinstance(cdt, dt.MapType):
+            if cdt.key.is_nested:
+                raise UnsupportedExpr("element_at over nested map keys")
+            b.key = _coerce(b.key, cdt.key, "element_at")
+            b.children = [b.child, b.key]
+            b.dtype = cdt.value
+        else:
+            raise UnsupportedExpr(f"element_at on {cdt}")
+        return b
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        arr = self.child.emit(ctx)
+        if isinstance(self.child.dtype, dt.ArrayType):
+            idx = self.key.emit(ctx)
+            k = jnp.broadcast_to(idx.data.astype(jnp.int32),
+                                 (ctx.capacity,))
+            lens = arr_lens(arr)
+            k0 = jnp.where(k > 0, k - 1, lens + k)  # 1-based / from-end
+            ok = (arr.validity & idx.validity & (k != 0)
+                  & (k0 >= 0) & (k0 < lens))
+            pos = arr.offsets[:-1] + jnp.where(ok, k0, 0)
+            return ops_gather.take(arr.child, pos, ok)
+        # map: per-element key equality, pick the first match per row
+        key = self.key.emit(ctx)
+        rows, live = _elem_rows(arr)
+        kcv = arr.child.children[0]
+        vcv = arr.child.children[1]
+        match = _equal_rowmap(kcv, key, rows, live, ctx.capacity)
+        ecap = rows.shape[0]
+        cap = ctx.capacity
+        epos = jnp.arange(ecap, dtype=jnp.int32)
+        first = jax.ops.segment_min(jnp.where(match, epos, ecap),
+                                    rows, num_segments=cap)
+        found = first < ecap
+        pos = jnp.where(found, first, 0)
+        return ops_gather.take(vcv, pos, found & arr.validity & key.validity)
+
+    def __repr__(self):
+        return f"element_at({self.child}, {self.key})"
+
+
+def _equal_rowmap(ecv: CV, vcv: CV, rows, live, cap: int) -> jnp.ndarray:
+    """bool over the element domain: element e equals the per-row value
+    vcv[rows[e]]. Row-mapped comparison — no replication gather, so no
+    var-width output sizing is needed inside the trace."""
+    if ecv.offsets is not None:
+        from ..ops import strings as ops_str
+        return ops_str.str_equal_rowmap(ecv, vcv, rows, live)
+    vdata = jnp.broadcast_to(vcv.data, (cap,))
+    vvalid = jnp.broadcast_to(vcv.validity, (cap,))
+    return ((ecv.data == vdata[rows]) & ecv.validity
+            & vvalid[rows] & live)
+
+
+class ArrayContains(Expression):
+    """array_contains(arr, value) (reference: GpuArrayContains).
+    Spark null semantics: null array -> null; no match but the array has
+    null entries -> null; otherwise true/false."""
+
+    def __init__(self, child: Expression, value):
+        self.child = child
+        self.value = _wrap(value)
+        self.children = [self.child, self.value]
+
+    def bind(self, schema):
+        b = ArrayContains(self.child.bind(schema), self.value.bind(schema))
+        if not isinstance(b.child.dtype, dt.ArrayType):
+            raise UnsupportedExpr(f"array_contains on {b.child.dtype}")
+        if b.child.dtype.element.is_nested:
+            raise UnsupportedExpr("array_contains over nested elements")
+        b.value = _coerce(b.value, b.child.dtype.element, "array_contains")
+        b.children = [b.child, b.value]
+        b.dtype = dt.BOOL
+        return b
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        arr = self.child.emit(ctx)
+        rows, live = _elem_rows(arr)
+        cap = ctx.capacity
+        val = self.value.emit(ctx)
+        ecv = arr.child
+        match = _equal_rowmap(ecv, val, rows, live, cap)
+        # segment_max's identity for int32 is INT32_MIN — compare > 0
+        # so empty segments read as False
+        has = jax.ops.segment_max(match.astype(jnp.int32), rows,
+                                  num_segments=cap) > 0
+        has_null_elem = jax.ops.segment_max(
+            (live & ~ecv.validity).astype(jnp.int32), rows,
+            num_segments=cap) > 0
+        valid = arr.validity & val.validity & (has | ~has_null_elem)
+        return CV(has, valid)
+
+    def __repr__(self):
+        return f"array_contains({self.child}, {self.value})"
+
+
+class _ArrayReduce(_UnaryOp):
+    _kind = "min"
+
+    def _resolve_type(self):
+        _require_array(self.child, f"array_{self._kind}")
+        et = self.child.dtype.element
+        if not (et.is_numeric or et in (dt.DATE, dt.TIMESTAMP)):
+            raise UnsupportedExpr(f"array_{self._kind} on array<{et}>")
+        if isinstance(et, dt.DecimalType) and et.is_decimal128:
+            raise UnsupportedExpr(f"array_{self._kind} on decimal128")
+        self.dtype = et
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        cv = self.child.emit(ctx)
+        rows, live = _elem_rows(cv)
+        cap = ctx.capacity
+        e = cv.child
+        m = live & e.validity
+        if self._kind == "min":
+            big = _extreme(e.data.dtype, for_min=True)
+            vals = jnp.where(m, e.data, big)
+            red = jax.ops.segment_min(vals, rows, num_segments=cap)
+        else:
+            small = _extreme(e.data.dtype, for_min=False)
+            vals = jnp.where(m, e.data, small)
+            red = jax.ops.segment_max(vals, rows, num_segments=cap)
+        any_valid = jax.ops.segment_max(m.astype(jnp.int32), rows,
+                                        num_segments=cap) > 0
+        return CV(red, cv.validity & any_valid)
+
+    def __repr__(self):
+        return f"array_{self._kind}({self.child})"
+
+
+def _extreme(dtype, for_min: bool):
+    if jnp.issubdtype(dtype, jnp.floating):
+        return jnp.array(jnp.inf if for_min else -jnp.inf, dtype)
+    info = jnp.iinfo(dtype)
+    return jnp.array(info.max if for_min else info.min, dtype)
+
+
+class ArrayMin(_ArrayReduce):
+    _kind = "min"
+
+
+class ArrayMax(_ArrayReduce):
+    _kind = "max"
+
+
+class SortArray(Expression):
+    """sort_array(arr, asc): per-row element sort; nulls first when
+    ascending, last when descending (Spark semantics; reference:
+    GpuSortArray). One global stable argsort keyed by
+    (row, null_flag, value) — rows stay in place, elements order within
+    each row."""
+
+    def __init__(self, child: Expression, asc: bool = True):
+        self.child = child
+        self.asc = asc
+        self.children = [child]
+
+    def bind(self, schema):
+        b = SortArray(self.child.bind(schema), self.asc)
+        if not isinstance(b.child.dtype, dt.ArrayType):
+            raise UnsupportedExpr(f"sort_array on {b.child.dtype}")
+        et = b.child.dtype.element
+        if not (et.is_numeric or et in (dt.DATE, dt.TIMESTAMP, dt.BOOL)):
+            raise UnsupportedExpr(f"sort_array on array<{et}> "
+                                  "(fixed-width elements only)")
+        b.dtype = b.child.dtype
+        return b
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        arr = self.child.emit(ctx)
+        rows, live = _elem_rows(arr)
+        e = arr.child
+        vals = e.data
+        if not self.asc:
+            if jnp.issubdtype(vals.dtype, jnp.floating):
+                vals = -vals
+            else:
+                vals = jnp.where(
+                    vals == jnp.iinfo(vals.dtype).min,
+                    jnp.iinfo(vals.dtype).max, -vals)
+        # sort key tiers: dead elements last within their row never matter
+        # (they stay inside gaps), null elements first (asc) / last (desc)
+        nullk = jnp.where(e.validity, 1, 0 if self.asc else 2)
+        order = jnp.lexsort((vals, nullk, rows))
+        child = ops_gather.take(e, order, live[order])
+        # positions are permuted only within rows, so offsets are unchanged
+        return CV(arr.data, arr.validity, arr.offsets, (child,))
+
+    def __repr__(self):
+        return f"sort_array({self.child}, asc={self.asc})"
+
+
+class MapKeys(_UnaryOp):
+    def _resolve_type(self):
+        if not isinstance(self.child.dtype, dt.MapType):
+            raise UnsupportedExpr(f"map_keys on {self.child.dtype}")
+        self.dtype = dt.ArrayType(self.child.dtype.key, False)
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        cv = self.child.emit(ctx)
+        return CV(cv.data, cv.validity, cv.offsets,
+                  (cv.child.children[0],))
+
+    def __repr__(self):
+        return f"map_keys({self.child})"
+
+
+class MapValues(_UnaryOp):
+    def _resolve_type(self):
+        if not isinstance(self.child.dtype, dt.MapType):
+            raise UnsupportedExpr(f"map_values on {self.child.dtype}")
+        self.dtype = dt.ArrayType(self.child.dtype.value)
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        cv = self.child.emit(ctx)
+        return CV(cv.data, cv.validity, cv.offsets,
+                  (cv.child.children[1],))
+
+    def __repr__(self):
+        return f"map_values({self.child})"
+
+
+# ----------------------------------------------------------------------
+# generators (consumed by GenerateExec, not emitted inline)
+# ----------------------------------------------------------------------
+class Explode(_UnaryOp):
+    """explode(arr) — output cardinality changes, so the planner lifts
+    this into a GenerateExec (reference: GpuGenerateExec + GpuExplode);
+    emit() is never called on the expression itself."""
+
+    outer = False
+    with_position = False
+
+    def bind(self, schema):
+        b = type(self)(self.child.bind(schema))
+        b.outer = self.outer        # instance flag survives rebinding
+        b._resolve_type()
+        return b
+
+    def _resolve_type(self):
+        _require_array(self.child, "explode")
+        if isinstance(self.child.dtype, dt.MapType):
+            self.dtype = dt.StructType(
+                (dt.StructField("key", self.child.dtype.key, False),
+                 dt.StructField("value", self.child.dtype.value)))
+        else:
+            self.dtype = self.child.dtype.element
+
+    def emit(self, ctx):
+        raise UnsupportedExpr(
+            "explode() must be the top-level expression of a select "
+            "(planner lifts it into GenerateExec)")
+
+    def __repr__(self):
+        return f"explode({self.child})"
+
+
+class PosExplode(Explode):
+    with_position = True
+
+    def __repr__(self):
+        return f"posexplode({self.child})"
+
+
+# ----------------------------------------------------------------------
+# higher-order functions
+# ----------------------------------------------------------------------
+_hof_ids = itertools.count()
+
+
+class NamedLambdaVariable(Expression):
+    """A lambda parameter; emits the element-domain CV registered by the
+    enclosing higher-order function (reference: higherOrderFunctions.scala
+    GpuNamedLambdaVariable)."""
+
+    def __init__(self, name: str, dtype: Optional[dt.DataType] = None,
+                 var_id: Optional[int] = None):
+        self._name = name
+        self.dtype = dtype
+        self.var_id = var_id if var_id is not None else next(_hof_ids)
+        self.children = []
+
+    @property
+    def name(self):
+        return self._name
+
+    def bind(self, schema):
+        return self
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        try:
+            return ctx.lambda_vals[self.var_id]
+        except KeyError:
+            raise UnsupportedExpr(
+                f"lambda variable {self._name} used outside its function")
+
+    def __repr__(self):
+        return self._name
+
+
+def _reject_varwidth_captures(bound_body: Expression):
+    """Lambda bodies run over the ELEMENT domain: captured outer columns
+    are gathered with per-element replication, whose var-width output size
+    cannot be measured inside the trace — reject string/nested captures at
+    bind (the planner falls back to host). Lambda variables themselves
+    (the element child) are fine."""
+    from .expressions import BoundRef
+    stack = [bound_body]
+    while stack:
+        e = stack.pop()
+        if isinstance(e, BoundRef) and (
+                e.dtype.is_variable_width or e.dtype.is_nested):
+            raise UnsupportedExpr(
+                f"lambda captures var-width outer column {e!r} "
+                "(element-domain replication is unsized on TPU)")
+        stack.extend(getattr(e, "children", []))
+
+
+class _HigherOrder(Expression):
+    """Base: binds the array child, then binds the lambda body with the
+    lambda variables' dtypes resolved from the element type."""
+
+    def __init__(self, child: Expression, fn: Callable, bound=None):
+        self.child = child
+        self.fn = fn
+        self._bound = bound  # (bound_child, var, pos_var, bound_body)
+        # expand the lambda once with placeholder vars so tree walks
+        # (column pruning, ref collection) see captured outer columns
+        import inspect
+        nargs = len(inspect.signature(fn).parameters)
+        tvars = [NamedLambdaVariable(f"_t{i}") for i in range(nargs)]
+        self.children = [child, _wrap(fn(*tvars))]
+
+    def _element_dtype(self, cdt) -> dt.DataType:
+        return Column.element_dtype(cdt)
+
+    def bind(self, schema):
+        bchild = self.child.bind(schema)
+        _require_array_t = isinstance(bchild.dtype,
+                                      (dt.ArrayType, dt.MapType))
+        if not _require_array_t:
+            raise UnsupportedExpr(f"{type(self).__name__} on {bchild.dtype}")
+        et = self._element_dtype(bchild.dtype)
+        var = NamedLambdaVariable("x", et)
+        import inspect
+        nargs = len(inspect.signature(self.fn).parameters)
+        pos_var = NamedLambdaVariable("i", dt.INT32) if nargs >= 2 else None
+        body = self.fn(var, pos_var) if pos_var is not None else self.fn(var)
+        bbody = _wrap(body).bind(schema)
+        _reject_varwidth_captures(bbody)
+        b = type(self)(bchild, self.fn, (bchild, var, pos_var, bbody))
+        b._resolve_type(bchild, bbody)
+        return b
+
+    def _emit_body(self, ctx: EmitCtx):
+        bchild, var, pos_var, bbody = self._bound
+        arr = bchild.emit(ctx)
+        ectx, rows, live = _elem_ctx(ctx, arr)
+        ectx.lambda_vals[var.var_id] = arr.child
+        if pos_var is not None:
+            pos = jnp.arange(rows.shape[0], dtype=jnp.int32)
+            idx_in_row = pos - arr.offsets[:-1][rows]
+            ectx.lambda_vals[pos_var.var_id] = CV(idx_in_row, live)
+        out = bbody.emit(ectx)
+        return arr, rows, live, out
+
+
+class ArrayTransform(_HigherOrder):
+    """transform(arr, x -> f(x)) / transform(arr, (x, i) -> f(x, i))
+    (reference: GpuArrayTransform). Fully parallel: the lambda body runs
+    over the flat element buffer."""
+
+    def _resolve_type(self, bchild, bbody):
+        self.dtype = dt.ArrayType(bbody.dtype)
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        arr, rows, live, out = self._emit_body(ctx)
+        return CV(arr.data, arr.validity, arr.offsets, (out,))
+
+    def __repr__(self):
+        return f"transform({self.child}, <lambda>)"
+
+
+class ArrayFilter(_HigherOrder):
+    """filter(arr, x -> pred(x)) (reference: GpuArrayFilter). The kept
+    elements are compacted per row with one global stable sort."""
+
+    def _resolve_type(self, bchild, bbody):
+        if bbody.dtype != dt.BOOL:
+            raise UnsupportedExpr("filter lambda must return boolean")
+        self.dtype = bchild.dtype
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        arr, rows, live, out = self._emit_body(ctx)
+        keep = live & out.validity & out.data.astype(jnp.bool_)
+        cap = ctx.capacity
+        new_lens = jax.ops.segment_sum(keep.astype(jnp.int32), rows,
+                                       num_segments=cap)
+        new_off = jnp.concatenate([
+            jnp.zeros(1, jnp.int32),
+            jnp.cumsum(new_lens).astype(jnp.int32)])
+        # global stable compaction preserves (row, position) order
+        perm = jnp.argsort(jnp.logical_not(keep), stable=True)
+        total = new_off[cap]
+        in_bounds = jnp.arange(perm.shape[0]) < total
+        child = ops_gather.take(arr.child, perm, in_bounds)
+        return CV(arr.data, arr.validity, new_off, (child,))
+
+    def __repr__(self):
+        return f"filter({self.child}, <lambda>)"
+
+
+class _ArrayPredicate(_HigherOrder):
+    _any = True
+
+    def _resolve_type(self, bchild, bbody):
+        if bbody.dtype != dt.BOOL:
+            raise UnsupportedExpr("exists/forall lambda must return boolean")
+        self.dtype = dt.BOOL
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        arr, rows, live, out = self._emit_body(ctx)
+        cap = ctx.capacity
+        hit = live & out.validity & out.data.astype(jnp.bool_)
+        if self._any:
+            red = jax.ops.segment_max(hit.astype(jnp.int32), rows,
+                                      num_segments=cap) > 0
+        else:
+            miss = live & (~out.data.astype(jnp.bool_) | ~out.validity)
+            red = ~(jax.ops.segment_max(miss.astype(jnp.int32), rows,
+                                        num_segments=cap) > 0)
+        return CV(red, arr.validity)
+
+
+class ArrayExists(_ArrayPredicate):
+    _any = True
+
+    def __repr__(self):
+        return f"exists({self.child}, <lambda>)"
+
+
+class ArrayForAll(_ArrayPredicate):
+    _any = False
+
+    def __repr__(self):
+        return f"forall({self.child}, <lambda>)"
+
+
+class ArrayAggregate(Expression):
+    """aggregate(arr, zero, (acc, x) -> merge) — a sequential fold per row,
+    implemented as ONE segmented lax.scan over the flat element buffer
+    (carry resets at row starts). Sequential in total element count;
+    correct for arbitrary lambdas like the reference's row-wise fold
+    (reference: higherOrderFunctions.scala GpuArrayAggregate analog)."""
+
+    def __init__(self, child: Expression, zero, fn: Callable, bound=None):
+        self.child = child
+        self.zero = _wrap(zero)
+        self.fn = fn
+        self._bound = bound
+        tvars = [NamedLambdaVariable("_ta"), NamedLambdaVariable("_tx")]
+        self.children = [self.child, self.zero, _wrap(fn(*tvars))]
+
+    def bind(self, schema):
+        bchild = self.child.bind(schema)
+        if not isinstance(bchild.dtype, dt.ArrayType):
+            raise UnsupportedExpr(f"aggregate on {bchild.dtype}")
+        bzero = self.zero.bind(schema)
+        acc_var = NamedLambdaVariable("acc", bzero.dtype)
+        x_var = NamedLambdaVariable("x", bchild.dtype.element)
+        bbody = _wrap(self.fn(acc_var, x_var)).bind(schema)
+        if bbody.dtype != bzero.dtype:
+            # widen the accumulator to the merge result type (Spark's
+            # implicit cast of the zero) and rebind the lambda once
+            bzero = _coerce(bzero, bbody.dtype, "aggregate zero")
+            acc_var = NamedLambdaVariable("acc", bzero.dtype)
+            bbody = _wrap(self.fn(acc_var, x_var)).bind(schema)
+        if bbody.dtype != bzero.dtype:
+            raise UnsupportedExpr(
+                f"aggregate merge type {bbody.dtype} != zero {bzero.dtype}")
+        if bbody.dtype.is_nested or isinstance(bbody.dtype,
+                                               (dt.StringType,
+                                                dt.BinaryType)):
+            raise UnsupportedExpr("aggregate acc must be fixed-width")
+        b = ArrayAggregate(bchild, bzero, self.fn,
+                           (bchild, bzero, acc_var, x_var, bbody))
+        b.dtype = bzero.dtype
+        return b
+
+    def emit(self, ctx: EmitCtx) -> CV:
+        bchild, bzero, acc_var, x_var, bbody = self._bound
+        arr = bchild.emit(ctx)
+        rows, live = _elem_rows(arr)
+        cap = ctx.capacity
+        zcv = bzero.emit(ctx)
+        # per-ROW zero (the zero may be a non-constant expression)
+        zrow_d = jnp.broadcast_to(zcv.data, (cap,))
+        zrow_v = jnp.broadcast_to(zcv.validity, (cap,))
+        ecap = rows.shape[0]
+        starts = arr.offsets[:-1][rows]
+        pos = jnp.arange(ecap, dtype=jnp.int32)
+        is_start = pos == starts
+        ze_d = zrow_d[rows]        # this element's row zero
+        ze_v = zrow_v[rows]
+
+        e = arr.child
+        outer_ctx = ctx
+
+        def step(carry, xs):
+            acc_d, acc_v = carry
+            live_i, start_i, zd_i, zv_i, ed, ev = xs
+            a_d = jnp.where(start_i, zd_i, acc_d)
+            a_v = jnp.where(start_i, zv_i, acc_v)
+            ectx = EmitCtx([], 1)
+            ectx.lambda_vals = dict(outer_ctx.lambda_vals)
+            ectx.lambda_vals[acc_var.var_id] = CV(a_d[None], a_v[None])
+            ectx.lambda_vals[x_var.var_id] = CV(ed[None], ev[None])
+            out = bbody.emit(ectx)
+            n_d = jnp.where(live_i, out.data[0], a_d)
+            n_v = jnp.where(live_i, out.validity[0], a_v)
+            return (n_d, n_v), (n_d, n_v)
+
+        (_, _), (accs, accvs) = jax.lax.scan(
+            step, (zrow_d[0], zrow_v[0]),
+            (live, is_start, ze_d, ze_v, e.data, e.validity))
+        # per-row result = acc at that row's last live element (or zero)
+        lens = arr_lens(arr)
+        last = arr.offsets[:-1] + jnp.maximum(lens - 1, 0)
+        last = jnp.clip(last, 0, ecap - 1)
+        res_d = jnp.where(lens > 0, accs[last], zrow_d)
+        res_v = jnp.where(lens > 0, accvs[last], zrow_v)
+        return CV(res_d, res_v & arr.validity)
+
+    def __repr__(self):
+        return f"aggregate({self.child}, {self.zero}, <lambda>)"

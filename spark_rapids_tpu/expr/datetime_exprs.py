@@ -10,6 +10,7 @@ from .expressions import (Expression, UnsupportedExpr, _BinaryOp, _UnaryOp,
                           _wrap)
 
 __all__ = ["Year", "Month", "DayOfMonth", "DayOfWeek", "DayOfYear",
+           "FromUTCTimestamp", "ToUTCTimestamp",
            "Quarter", "Hour", "Minute", "Second", "DateAdd", "DateSub",
            "DateDiff", "LastDay", "ToDate", "ToTimestamp"]
 
@@ -171,3 +172,55 @@ class ToTimestamp(_UnaryOp):
                       cv.validity)
         from ..ops.cast_strings import string_to_timestamp
         return string_to_timestamp(cv)
+
+
+class _TzConvert(Expression):
+    """from_utc_timestamp / to_utc_timestamp over the TZif transition
+    tables (reference: GpuFromUTCTimestamp/GpuToUTCTimestamp +
+    GpuTimeZoneDB device table; here utils/tzdb.py). Per batch: one
+    searchsorted over the zone's transition instants + a gather — fully
+    vectorized, tables become XLA constants."""
+
+    to_utc = False
+
+    def __init__(self, child: Expression, tz: str):
+        self.child = child
+        self.tz = tz
+        self.children = [child]
+
+    def bind(self, schema):
+        b = type(self)(self.child.bind(schema), self.tz)
+        if not isinstance(b.child.dtype, dt.TimestampType):
+            raise UnsupportedExpr(
+                f"{type(self).__name__} on {b.child.dtype}")
+        from ..utils.tzdb import load_transitions
+        try:
+            load_transitions(self.tz)
+        except ValueError as e:
+            raise UnsupportedExpr(str(e))
+        b.dtype = dt.TIMESTAMP
+        return b
+
+    def emit(self, ctx):
+        from ..utils.tzdb import utc_to_wall_tables, wall_to_utc_tables
+        tables = (wall_to_utc_tables if self.to_utc
+                  else utc_to_wall_tables)(self.tz)
+        trans = jnp.asarray(tables[0])
+        offs = jnp.asarray(tables[1])
+        cv = self.child.emit(ctx)
+        idx = jnp.searchsorted(trans, cv.data, side="right") - 1
+        off = offs[jnp.clip(idx, 0, offs.shape[0] - 1)]
+        out = cv.data - off if self.to_utc else cv.data + off
+        return CV(out, cv.validity)
+
+    def __repr__(self):
+        fn = "to_utc_timestamp" if self.to_utc else "from_utc_timestamp"
+        return f"{fn}({self.child}, {self.tz!r})"
+
+
+class FromUTCTimestamp(_TzConvert):
+    to_utc = False
+
+
+class ToUTCTimestamp(_TzConvert):
+    to_utc = True

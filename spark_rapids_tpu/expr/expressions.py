@@ -50,6 +50,9 @@ class EmitCtx:
     def __init__(self, cvs: Sequence[CV], capacity: int):
         self.cvs = list(cvs)
         self.capacity = capacity
+        # bound lambda-variable values for higher-order array functions
+        # (collection_exprs): var id -> element-domain CV
+        self.lambda_vals = {}
 
 
 class Expression:
@@ -166,6 +169,19 @@ class Expression:
     def substr(self, start, length=None):
         from .string_exprs import Substring
         return Substring(self, start, length)
+
+    def getItem(self, key):
+        from .collection_exprs import GetArrayItem
+        return GetArrayItem(self, _wrap(key))
+
+    def getField(self, name: str):
+        from .collection_exprs import GetStructField
+        return GetStructField(self, name)
+
+    def __getitem__(self, key):
+        if isinstance(key, str):
+            return self.getField(key)
+        return self.getItem(key)
 
 
 def _wrap(v) -> Expression:

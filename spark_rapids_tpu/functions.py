@@ -360,3 +360,148 @@ def regexp_extract(e, pattern: str, idx: int = 0):
 def regexp_replace(e, pattern: str, replacement: str):
     from .expr.regex_exprs import RegexpReplace
     return RegexpReplace(_to_expr(e), pattern, replacement)
+
+
+# ----------------------------------------------------------------------
+# collections (arrays / maps / structs) + higher-order functions
+# (reference: collectionOperations.scala, complexTypeCreator.scala,
+#  higherOrderFunctions.scala rules in GpuOverrides)
+# ----------------------------------------------------------------------
+def array(*es):
+    from .expr import collection_exprs as _ce
+    return _ce.CreateArray([_to_expr(e) for e in es])
+
+
+def struct(*es):
+    from .expr import collection_exprs as _ce
+    from .expr.expressions import Alias
+    names, children = [], []
+    for i, e in enumerate(es):
+        ex = _to_expr(e)
+        if isinstance(ex, Alias):
+            names.append(ex.name)
+            children.append(ex.child)
+        elif isinstance(ex, ColumnRef):
+            names.append(ex.name)
+            children.append(ex)
+        else:
+            names.append(f"col{i + 1}")
+            children.append(ex)
+    return _ce.CreateNamedStruct(names, children)
+
+
+def named_struct(*pairs):
+    from .expr import collection_exprs as _ce
+    names = [pairs[i] for i in range(0, len(pairs), 2)]
+    children = [_to_expr(pairs[i]) for i in range(1, len(pairs), 2)]
+    return _ce.CreateNamedStruct(names, children)
+
+
+def get_field(e, name: str):
+    from .expr import collection_exprs as _ce
+    return _ce.GetStructField(_to_expr(e), name)
+
+
+def size(e):
+    from .expr import collection_exprs as _ce
+    return _ce.Size(_to_expr(e))
+
+
+def element_at(e, key):
+    from .expr import collection_exprs as _ce
+    return _ce.ElementAt(_to_expr(e), key)
+
+
+def array_contains(e, value):
+    from .expr import collection_exprs as _ce
+    return _ce.ArrayContains(_to_expr(e), value)
+
+
+def array_min(e):
+    from .expr import collection_exprs as _ce
+    return _ce.ArrayMin(_to_expr(e))
+
+
+def array_max(e):
+    from .expr import collection_exprs as _ce
+    return _ce.ArrayMax(_to_expr(e))
+
+
+def sort_array(e, asc: bool = True):
+    from .expr import collection_exprs as _ce
+    return _ce.SortArray(_to_expr(e), asc)
+
+
+def map_keys(e):
+    from .expr import collection_exprs as _ce
+    return _ce.MapKeys(_to_expr(e))
+
+
+def map_values(e):
+    from .expr import collection_exprs as _ce
+    return _ce.MapValues(_to_expr(e))
+
+
+def explode(e):
+    from .expr import collection_exprs as _ce
+    return _ce.Explode(_to_expr(e))
+
+
+def explode_outer(e):
+    from .expr import collection_exprs as _ce
+    g = _ce.Explode(_to_expr(e))
+    g.outer = True
+    return g
+
+
+def posexplode(e):
+    from .expr import collection_exprs as _ce
+    return _ce.PosExplode(_to_expr(e))
+
+
+def posexplode_outer(e):
+    from .expr import collection_exprs as _ce
+    g = _ce.PosExplode(_to_expr(e))
+    g.outer = True
+    return g
+
+
+def transform(e, fn):
+    from .expr import collection_exprs as _ce
+    return _ce.ArrayTransform(_to_expr(e), fn)
+
+
+def filter(e, fn):  # noqa: A001 - pyspark naming
+    from .expr import collection_exprs as _ce
+    return _ce.ArrayFilter(_to_expr(e), fn)
+
+
+def exists(e, fn):
+    from .expr import collection_exprs as _ce
+    return _ce.ArrayExists(_to_expr(e), fn)
+
+
+def forall(e, fn):
+    from .expr import collection_exprs as _ce
+    return _ce.ArrayForAll(_to_expr(e), fn)
+
+
+def aggregate(e, zero, merge):
+    from .expr import collection_exprs as _ce
+    return _ce.ArrayAggregate(_to_expr(e), zero, merge)
+
+
+def collect_list(e):
+    return _agg.CollectList(_to_expr(e))
+
+
+def collect_set(e):
+    return _agg.CollectSet(_to_expr(e))
+
+
+def from_utc_timestamp(e, tz: str):
+    return _de.FromUTCTimestamp(_to_expr(e), tz)
+
+
+def to_utc_timestamp(e, tz: str):
+    return _de.ToUTCTimestamp(_to_expr(e), tz)

@@ -17,7 +17,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
-from ..columnar.column import Column
+from ..columnar.column import Column, flatten_bufs, unflatten_bufs
 from ..columnar.table import Schema, Table
 from ..exec.batch import DeviceBatch
 from ..utils.transfer import fetch
@@ -72,8 +72,7 @@ class SpillableBatchHandle:
         path = os.path.join(spill_dir, f"spill-{self.id}.npz")
         flat = {}
         for i, bufs in enumerate(self._host["cols"]):
-            for k, v in bufs.items():
-                flat[f"c{i}_{k}"] = np.asarray(v)
+            flatten_bufs(bufs, f"c{i}_", flat)
         flat["mask"] = np.asarray(self._host["mask"])
         np.savez(path, **flat)
         self._disk_path = path
@@ -95,9 +94,9 @@ class SpillableBatchHandle:
                 schema, names, num_rows, capacity = self._meta
                 cols = []
                 for i in range(len(names)):
-                    bufs = {k.split("_", 1)[1]: data[k] for k in data.files
+                    flat = {k.split("_", 1)[1]: data[k] for k in data.files
                             if k.startswith(f"c{i}_")}
-                    cols.append(bufs)
+                    cols.append(unflatten_bufs(flat))
                 self._host = {"cols": cols, "mask": data["mask"]}
                 os.unlink(self._disk_path)
                 self._disk_path = None
@@ -105,8 +104,7 @@ class SpillableBatchHandle:
             schema, names, num_rows, capacity = self._meta
             self.store.dm.reserve(self.nbytes)
             dev = jax.device_put(self._host)
-            cols = [Column(f.dtype, num_rows, d["data"], d["validity"],
-                           d.get("offsets"))
+            cols = [Column.build(f.dtype, num_rows, d)
                     for f, d in zip(schema.fields, dev["cols"])]
             batch = DeviceBatch(Table(names, cols), num_rows, dev["mask"],
                                 capacity)

@@ -21,6 +21,8 @@ __all__ = ["concat_cvs", "concat_masks", "pad_cv", "pad_mask"]
 def concat_cvs(parts: Sequence[CV], dtype: dt.DataType) -> CV:
     if len(parts) == 1:
         return parts[0]
+    if parts[0].children:
+        return _concat_nested(parts, dtype)
     data = jnp.concatenate([p.data for p in parts])
     valid = jnp.concatenate([p.validity for p in parts])
     if parts[0].offsets is None:
@@ -36,6 +38,43 @@ def concat_cvs(parts: Sequence[CV], dtype: dt.DataType) -> CV:
                            jnp.concatenate(starts), jnp.concatenate(lens))
 
 
+def _concat_nested(parts: Sequence[CV], dtype: dt.DataType) -> CV:
+    """Concatenate list/struct columns. Lists rebuild a gap-free element
+    layout (same reasoning as strings): children are concatenated
+    recursively, then the referenced element ranges are re-gathered."""
+    from ..columnar.column import Column
+    from .gather import take
+    valid = jnp.concatenate([p.validity for p in parts])
+    if parts[0].offsets is None:  # struct
+        kids = tuple(
+            concat_cvs([p.children[i] for p in parts], f.dtype)
+            for i, f in enumerate(dtype.fields))
+        return CV(jnp.zeros(0, jnp.int8), valid, None, kids)
+    elem_dt = Column.element_dtype(dtype)
+    child_comb = concat_cvs([p.child for p in parts], elem_dt)
+    starts, lens = [], []
+    shift = 0
+    for p in parts:
+        ln = (p.offsets[1:] - p.offsets[:-1]).astype(jnp.int32)
+        ln = jnp.where(p.validity, ln, 0)
+        starts.append((p.offsets[:-1] + shift).astype(jnp.int32))
+        lens.append(ln)
+        shift += p.child.capacity
+    starts = jnp.concatenate(starts)
+    lens = jnp.concatenate(lens)
+    n_out = valid.shape[0]
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    out_cap = child_comb.capacity
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_off[1:], pos, side="right").astype(jnp.int32)
+    row = jnp.clip(row, 0, n_out - 1)
+    src = starts[row] + (pos - new_off[row])
+    elem_ok = pos < new_off[n_out]
+    child = take(child_comb, src, elem_ok)
+    return CV(jnp.zeros(0, jnp.int8), valid, new_off, (child,))
+
+
 def concat_masks(masks: Sequence) -> jnp.ndarray:
     return jnp.concatenate(list(masks))
 
@@ -45,10 +84,18 @@ def pad_cv(cv: CV, capacity: int) -> CV:
     if cap >= capacity:
         return cv
     extra = capacity - cap
+    valid = jnp.concatenate([cv.validity, jnp.zeros(extra, jnp.bool_)])
+    if cv.children:
+        if cv.offsets is None:  # struct: pad each field column
+            kids = tuple(pad_cv(ch, capacity) for ch in cv.children)
+            return CV(cv.data, valid, None, kids)
+        last = cv.offsets[-1]
+        off = jnp.concatenate([
+            cv.offsets, jnp.broadcast_to(last, (extra,)).astype(jnp.int32)])
+        return CV(cv.data, valid, off, cv.children)
     data = (jnp.concatenate(
         [cv.data, jnp.zeros((extra,) + cv.data.shape[1:], cv.data.dtype)])
         if cv.offsets is None else cv.data)
-    valid = jnp.concatenate([cv.validity, jnp.zeros(extra, jnp.bool_)])
     if cv.offsets is None:
         return CV(data, valid)
     last = cv.offsets[-1]

@@ -69,9 +69,127 @@ def take_strings(cv: CV, idx, in_bounds=None,
     return CV(data, valid, new_off)
 
 
-def take(cv: CV, idx, in_bounds=None) -> CV:
+def repeat_measures(cv: CV, eff) -> List:
+    """Device scalars of var-width output units needed when row i of `cv`
+    is replicated eff[i] times (strings: bytes; arrays: elements), in the
+    same DFS order `take(..., caps=...)` consumes them. Nested levels
+    compose through offset spans: bytes for a list<string> row =
+    child_offsets[row_end_elem] - child_offsets[row_start_elem]."""
+    out: List = []
+    _rm(cv, eff, out)
+    return out
+
+
+def _rm(cv: CV, eff, out: List):
+    if cv.children and cv.offsets is None:      # struct
+        for ch in cv.children:
+            _rm(ch, eff, out)
+        return
+    if cv.offsets is None:
+        return
+    lens = (cv.offsets[1:] - cv.offsets[:-1]).astype(jnp.int64)
+    lens = jnp.where(cv.validity, lens, 0)
+    out.append(jnp.sum(eff.astype(jnp.int64) * lens))
+    if cv.children:
+        _rm_span(cv.child, cv.offsets[:-1], cv.offsets[1:],
+                 cv.validity, eff, out)
+
+
+def _rm_span(cv: CV, starts, ends, valid, eff, out: List):
+    if cv.children and cv.offsets is None:      # struct element
+        for ch in cv.children:
+            _rm_span(ch, starts, ends, valid, eff, out)
+        return
+    if cv.offsets is None:
+        return
+    hi = cv.offsets.shape[0] - 1
+    s2 = cv.offsets[jnp.clip(starts, 0, hi)]
+    e2 = cv.offsets[jnp.clip(ends, 0, hi)]
+    units = jnp.where(valid, (e2 - s2).astype(jnp.int64), 0)
+    out.append(jnp.sum(eff.astype(jnp.int64) * units))
+    if cv.children:
+        _rm_span(cv.child, s2, e2, valid, eff, out)
+
+
+def take_measures(cv: CV, idx, in_bounds=None) -> List:
+    """Device scalars of var-width output units needed to gather rows
+    `idx` of `cv` (gathers may repeat rows, so source capacities are NOT
+    upper bounds). Same DFS order as `take(..., caps=...)`."""
+    out: List = []
+    _tm(cv, idx, in_bounds, out)
+    return out
+
+
+def _tm(cv: CV, idx, inb, out: List):
+    if cv.children and cv.offsets is None:      # struct
+        for ch in cv.children:
+            _tm(ch, idx, inb, out)
+        return
+    if cv.offsets is None:
+        return
+    safe = jnp.clip(idx, 0, cv.offsets.shape[0] - 2)
+    starts = cv.offsets[safe]
+    ends = cv.offsets[safe + 1]
+    valid = cv.validity[safe]
+    if inb is not None:
+        valid = valid & inb
+    units = jnp.where(valid, (ends - starts).astype(jnp.int64), 0)
+    out.append(jnp.sum(units))
+    if cv.children:
+        ones = jnp.ones(idx.shape[0], jnp.int64)
+        _rm_span(cv.child, starts, ends, valid, ones, out)
+
+
+def take_array(cv: CV, idx, in_bounds=None,
+               out_elem_capacity: Optional[int] = None, caps=None) -> CV:
+    """Gather rows of a list column: rebuild offsets from gathered row
+    lengths, then gather the referenced element ranges from the child
+    (recursively, so list<string>/list<list<...>> work)."""
+    n_out = idx.shape[0]
+    off = cv.offsets
+    safe = jnp.clip(idx, 0, off.shape[0] - 2)
+    starts = off[safe]
+    lens = off[safe + 1] - off[safe]
+    valid = cv.validity[safe]
+    # null slots may carry placeholder ranges — never read them
+    lens = jnp.where(valid, lens, 0)
+    if in_bounds is not None:
+        valid = valid & in_bounds
+        lens = jnp.where(in_bounds, lens, 0)
+    new_off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(lens).astype(jnp.int32)])
+    out_cap = out_elem_capacity or cv.child.capacity
+    pos = jnp.arange(out_cap, dtype=jnp.int32)
+    row = jnp.searchsorted(new_off[1:], pos, side="right").astype(jnp.int32)
+    row = jnp.clip(row, 0, n_out - 1)
+    src = starts[row] + (pos - new_off[row])
+    elem_ok = pos < new_off[n_out]
+    child = take(cv.child, src, elem_ok, caps)
+    return CV(jnp.zeros(0, jnp.int8), valid, new_off, (child,))
+
+
+def take_struct(cv: CV, idx, in_bounds=None, caps=None) -> CV:
+    safe = jnp.clip(idx, 0, cv.validity.shape[0] - 1)
+    valid = cv.validity[safe]
+    if in_bounds is not None:
+        valid = valid & in_bounds
+    kids = tuple(take(ch, idx, in_bounds, caps) for ch in cv.children)
+    return CV(jnp.zeros(0, jnp.int8), valid, None, kids)
+
+
+def take(cv: CV, idx, in_bounds=None, caps=None) -> CV:
+    """Gather rows. `caps` is an optional iterator of output var-width
+    capacities (from `repeat_measures`, bucketed) consumed in DFS order;
+    without it, source capacities are reused (correct only when no row is
+    replicated)."""
+    if cv.children:
+        if cv.offsets is not None:
+            return take_array(cv, idx, in_bounds,
+                              next(caps) if caps else None, caps)
+        return take_struct(cv, idx, in_bounds, caps)
     if cv.offsets is not None:
-        return take_strings(cv, idx, in_bounds)
+        return take_strings(cv, idx, in_bounds,
+                            next(caps) if caps else None)
     return take_fixed(cv, idx, in_bounds)
 
 

@@ -19,25 +19,34 @@ __all__ = ["CV", "all_valid", "and_validity"]
 @jax.tree_util.register_pytree_node_class
 @dataclasses.dataclass
 class CV:
-    """Traced column value: data buffer + validity (+ offsets for strings)."""
+    """Traced column value: data buffer + validity (+ offsets for var-width,
+    + child CVs for list/struct layouts)."""
     data: Any                      # jnp array [capacity] (uint8 for strings)
     validity: Any                  # jnp bool [capacity]
     offsets: Optional[Any] = None  # jnp int32 [capacity+1] for var-width
+    children: tuple = ()           # child CVs (list element / struct fields)
 
     def tree_flatten(self):
-        if self.offsets is None:
-            return (self.data, self.validity), False
-        return (self.data, self.validity, self.offsets), True
+        leaves = [self.data, self.validity]
+        if self.offsets is not None:
+            leaves.append(self.offsets)
+        leaves.extend(self.children)
+        return tuple(leaves), (self.offsets is not None, len(self.children))
 
     @classmethod
-    def tree_unflatten(cls, has_offsets, children):
-        if has_offsets:
-            return cls(children[0], children[1], children[2])
-        return cls(children[0], children[1], None)
+    def tree_unflatten(cls, aux, leaves):
+        has_offsets, n_children = aux
+        k = 3 if has_offsets else 2
+        return cls(leaves[0], leaves[1], leaves[2] if has_offsets else None,
+                   tuple(leaves[k:k + n_children]))
 
     @property
     def capacity(self) -> int:
         return self.validity.shape[0]
+
+    @property
+    def child(self) -> "CV":
+        return self.children[0]
 
 
 def all_valid(shape_like) -> Any:

@@ -349,3 +349,26 @@ def literal_column(raw: bytes, present, capacity: int) -> CV:
     total = new_off[n]
     data = jnp.where(pos < total, data, 0).astype(jnp.uint8)
     return CV(data, jnp.ones(n, jnp.bool_), new_off)
+
+
+def str_equal_rowmap(ecv: CV, vcv: CV, rows, live):
+    """bool[ecap]: element string e equals the per-row string
+    vcv[rows[e]]. Compares in the element byte domain with a row-mapped
+    source index — no replication gather, so no output-capacity sizing is
+    needed (used by array_contains / map element_at over strings)."""
+    n = ecv.offsets.shape[0] - 1
+    le = str_len_bytes(ecv)
+    lv = str_len_bytes(vcv)
+    lv_e = lv[rows]
+    len_ok = le == lv_e
+    dcap = ecv.data.shape[0]
+    rowb = byte_row_map(ecv.offsets, dcap)       # element index per byte
+    pos = jnp.arange(dcap, dtype=jnp.int32)
+    rel = pos - ecv.offsets[rowb]
+    lim = jnp.minimum(le, lv_e)
+    within = (rel >= 0) & (rel < lim[rowb])
+    vsrc = jnp.clip(vcv.offsets[rows[rowb]] + rel, 0,
+                    vcv.data.shape[0] - 1)
+    differs = within & (ecv.data != vcv.data[vsrc])
+    any_diff = jax.ops.segment_max(differs.astype(jnp.int32), rowb, n) > 0
+    return (len_ok & ~any_diff & ecv.validity & vcv.validity[rows] & live)

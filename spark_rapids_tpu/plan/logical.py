@@ -10,13 +10,14 @@ from __future__ import annotations
 
 from typing import List, Optional, Sequence, Tuple
 
+from ..columnar import dtypes as dt
 from ..columnar.table import Schema, Field
 from ..expr.expressions import Alias, Expression, ColumnRef
 from ..expr import aggregates as agg
 
 __all__ = ["LogicalPlan", "InMemoryScan", "CachedScan", "ParquetScan", "Project", "Filter",
            "Aggregate", "Join", "Sort", "SortOrder", "Limit", "Union",
-           "Repartition", "WindowOp"]
+           "Repartition", "WindowOp", "Generate"]
 
 
 class LogicalPlan:
@@ -293,6 +294,37 @@ class WindowOp(LogicalPlan):
 
     def describe(self):
         return f"WindowOp[{[n for n, _ in self.wcols]}]"
+
+
+class Generate(LogicalPlan):
+    """Explode/posexplode: appends generated columns, one output row per
+    element (reference: GpuGenerateExec.scala GpuExplode/GpuPosExplode).
+    Output = all child columns + [pos]? + (col | key,value)."""
+
+    def __init__(self, child: LogicalPlan, generator, out_names):
+        self.child = child
+        self.children = [child]
+        self.generator = generator              # unbound Explode/PosExplode
+        self.bound = generator.bind(child.schema)
+        self.out_names = list(out_names)
+        gen_dt = self.bound.dtype
+        gen_fields = []
+        if self.bound.with_position:
+            gen_fields.append(Field(self.out_names[0], dt.INT32))
+        if isinstance(self.bound.child.dtype, dt.MapType):
+            # map explode: key + value columns
+            for f, nm in zip(gen_dt.fields, self.out_names[-2:]):
+                gen_fields.append(Field(nm, f.dtype))
+        else:
+            gen_fields.append(Field(self.out_names[-1], gen_dt))
+        self._schema = Schema(list(child.schema.fields) + gen_fields)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"Generate[{self.generator!r}]"
 
 
 class Repartition(LogicalPlan):

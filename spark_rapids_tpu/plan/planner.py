@@ -158,9 +158,30 @@ def _agg(meta, conv, conf):
     n = meta.node
     names = [nm for nm, _ in n.bound_aggs]
     aggs = [a for _, a in n.bound_aggs]
+    for k in n.bound_keys:
+        if k.dtype.is_nested:
+            raise UnsupportedExpr(
+                f"group-by key {k!r} has nested type {k.dtype}")
+    has_collect = any(getattr(a, "is_collect", False) for a in aggs)
     if not n.keys:
+        if has_collect:
+            raise UnsupportedExpr(
+                "collect_list/collect_set require GROUP BY (round 2)")
         return agg_exec.UngroupedAggExec(child, names, aggs, n.schema)
     key_names = [k.name for k in n.keys]
+    if has_collect:
+        # variable-width results can't ride the partial/final flat-state
+        # wire: hash-exchange the raw rows on the grouping keys, then each
+        # partition's sort-collect is final (disjoint keys)
+        from ..exec.base import ExecContext as _Ctx
+        nparts_c = conf.get(SHUFFLE_PARTITIONS)
+        if child.num_partitions(_Ctx(conf)) > 1 and nparts_c > 1:
+            exch = _make_hash_exchange(child, n.bound_keys, conf)
+            return agg_exec.CollectAggExec(exch, key_names, n.bound_keys,
+                                           names, aggs, n.schema,
+                                           per_partition=True)
+        return agg_exec.CollectAggExec(child, key_names, n.bound_keys,
+                                       names, aggs, n.schema)
     # distributed topology: PARTIAL agg per input partition (rows shrink
     # to group count), exchange the partial states on the grouping keys,
     # FINAL merge per output partition (reference: partial/final
@@ -199,6 +220,11 @@ def _union(meta, conv, conf):
 @_rule(L.Sort)
 def _sort(meta, conv, conf):
     from ..exec.sort import SortExec
+    for o in meta.node.bound_orders:
+        if o.expr.dtype.is_nested:
+            raise UnsupportedExpr(
+                f"sort key {o.expr!r} has nested type "
+                f"{o.expr.dtype} (not orderable on TPU)")
     return SortExec(conv(meta.children[0]), meta.node.bound_orders,
                     meta.node.schema)
 
@@ -263,6 +289,10 @@ def _join(meta, conv, conf):
         SHUFFLE_PARTITIONS
     from ..exec.join import HashJoinExec
     n = meta.node
+    for k in list(n.bound_left_keys or []) + list(n.bound_right_keys or []):
+        if k.dtype.is_nested:
+            raise UnsupportedExpr(
+                f"join key {k!r} has nested type {k.dtype}")
     left, right = conv(meta.children[0]), conv(meta.children[1])
     mesh_n = conf.get(MESH_DEVICES)
     thr = conf.get(BROADCAST_THRESHOLD)
@@ -308,6 +338,13 @@ def _window(meta, conv, conf):
     n = meta.node
     return WindowExec(conv(meta.children[0]), [nm for nm, _ in n.bound],
                       [w for _, w in n.bound], n.schema)
+
+
+@_rule(L.Generate)
+def _generate(meta, conv, conf):
+    from ..exec.generate import GenerateExec
+    n = meta.node
+    return GenerateExec(conv(meta.children[0]), n.bound, n.schema)
 
 
 @_rule(L.Repartition)

@@ -98,6 +98,22 @@ for _n in ("Avg", "VarianceSamp", "StddevSamp", "Variance", "Stddev"):
              "(sum/count explicitly for p>18)")
 register("Greatest", NUMERIC + DATETIME + STRING, "n-ary minmax")
 register("Least", NUMERIC + DATETIME + STRING, "n-ary minmax")
+NESTED = TypeSig(dt.ArrayType, dt.MapType, dt.StructType)
+for _n in ("Size", "GetArrayItem", "ElementAt", "ArrayContains",
+           "SortArray", "Explode", "PosExplode", "ArrayTransform",
+           "ArrayFilter", "ArrayExists", "ArrayForAll", "ArrayAggregate"):
+    register(_n, TypeSig(dt.ArrayType, dt.MapType), "collection")
+for _n in ("CreateArray", "CreateNamedStruct"):
+    register(_n, ALL_COMMON + NESTED, "nested constructor")
+register("GetStructField", TypeSig(dt.StructType), "struct extractor")
+for _n in ("MapKeys", "MapValues"):
+    register(_n, TypeSig(dt.MapType), "map extractor")
+for _n in ("ArrayMin", "ArrayMax"):
+    register(_n, TypeSig(dt.ArrayType),
+             "numeric/temporal elements; decimal p<=18")
+for _n in ("CollectList", "CollectSet"):
+    register(_n, ALL_COMMON,
+             "aggregate -> array<T>; requires GROUP BY (sort-collect)")
 
 
 def generate_supported_ops() -> str:

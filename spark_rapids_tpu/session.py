@@ -147,7 +147,37 @@ class DataFrame:
     def select(self, *exprs) -> "DataFrame":
         from .window import WindowExpr
         from .expr.expressions import Alias, ColumnRef
+        from .expr.collection_exprs import Explode
         es = [_to_expr(e) for e in exprs]
+        # lift explode/posexplode into a Generate stage (the reference's
+        # GenerateExec planning: GpuGenerateExec.scala)
+        gens = [(i, (e.child if isinstance(e, Alias) else e), e)
+                for i, e in enumerate(es)
+                if isinstance(e.child if isinstance(e, Alias) else e,
+                              Explode)]
+        if gens:
+            if len(gens) > 1:
+                raise ValueError("only one explode per select")
+            i, gen, orig = gens[0]
+            from .columnar import dtypes as dt
+            bound_child = gen.child.bind(self._plan.schema)
+            is_map = isinstance(bound_child.dtype, dt.MapType)
+            if is_map:
+                names = ["key", "value"]
+            else:
+                names = [orig._name if isinstance(orig, Alias) else "col"]
+            if gen.with_position:
+                names = ["pos"] + names
+            # generated columns get collision-proof internal names in the
+            # Generate schema (a pre-existing 'col'/'key'/'pos' column
+            # would otherwise shadow them), then alias back for the user
+            internal = [f"#gen{id(gen) & 0xFFFF:04x}_{n}" for n in names]
+            from .expr.expressions import Alias as _Alias
+            gplan = L.Generate(self._plan, gen, internal)
+            repl = [_Alias(ColumnRef(ii), n)
+                    for ii, n in zip(internal, names)]
+            es2 = es[:i] + repl + es[i + 1:]
+            return DataFrame(self._session, L.Project(gplan, es2))
         # extract window expressions into a WindowOp stage (the planner
         # split the reference does in GpuWindowExecMeta)
         wcols, plain = [], []

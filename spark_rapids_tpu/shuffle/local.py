@@ -121,10 +121,8 @@ class LocalShuffle:
 
     # ---------------- reduce side --------------------------------------
     def read_reduce_partition(self, rpid: int) -> List[HostSubBatch]:
-        dtypes = [_np_dtype_for(f.dtype) for f in self.schema.fields]
-        items = [2 if (isinstance(f.dtype, dt.DecimalType)
-                       and f.dtype.is_decimal128) else 1
-                 for f in self.schema.fields]
+        from .serializer import wire_spec
+        specs = [wire_spec(f.dtype) for f in self.schema.fields]
 
         def read_one(path: str) -> List[HostSubBatch]:
             out = []
@@ -136,8 +134,7 @@ class LocalShuffle:
                 f.seek(off)
                 seg = io.BytesIO(f.read(ln))
             while True:
-                sb = read_subbatch(seg, dtypes, self.codec,
-                                   items_per_row=items)
+                sb = read_subbatch(seg, specs, self.codec)
                 if sb is None:
                     break
                 out.append(sb)
@@ -160,52 +157,81 @@ class LocalShuffle:
         if total == 0:
             return None
         cap = bucket_capacity(total)
-        ncols = len(self.schema.fields)
-        bufs = []
-        for ci, f in enumerate(self.schema.fields):
-            np_dt = _np_dtype_for(f.dtype)
-            validity = np.zeros(cap, np.bool_)
-            pos = 0
-            if f.dtype.is_variable_width:
-                datas, offs = [], [np.zeros(1, np.int32)]
-                shift = 0
-                for sb in subs:
-                    c = sb.cols[ci]
-                    validity[pos:pos + sb.n_rows] = c["validity"]
-                    pos += sb.n_rows
-                    datas.append(c["data"])
-                    o = c["offsets"]
-                    offs.append(o[1:].astype(np.int32) + shift)
-                    shift += len(c["data"])
-                data = (np.concatenate(datas) if datas
-                        else np.zeros(0, np.uint8))
-                dcap = bucket_capacity(max(len(data), 1))
-                data = np.concatenate(
-                    [data, np.zeros(dcap - len(data), np.uint8)])
-                off = np.concatenate(offs)
-                off = np.concatenate(
-                    [off, np.full(cap + 1 - len(off), off[-1], np.int32)])
-                bufs.append({"data": data, "validity": validity,
-                             "offsets": off})
-            else:
-                if isinstance(f.dtype, dt.DecimalType) \
-                        and f.dtype.is_decimal128:
-                    data = np.zeros((cap, 2), np_dt)
-                else:
-                    data = self._arena_zeros(cap, np_dt)
-                for sb in subs:
-                    c = sb.cols[ci]
-                    data[pos:pos + sb.n_rows] = c["data"]
-                    validity[pos:pos + sb.n_rows] = c["validity"]
-                    pos += sb.n_rows
-                bufs.append({"data": data, "validity": validity})
+        bufs = [self._assemble([sb.cols[ci] for sb in subs],
+                               [sb.n_rows for sb in subs], f.dtype, cap)
+                for ci, f in enumerate(self.schema.fields)]
         dev = jax.device_put(bufs)
         if self._arena is not None:
             self._arena.reset()  # safe: device_put copied the buffers
-        cols = [Column(f.dtype, total, d["data"], d["validity"],
-                       d.get("offsets"))
+        cols = [Column.build(f.dtype, total, d)
                 for f, d in zip(self.schema.fields, dev)]
         return DeviceBatch(Table(self.schema.names, cols), total)
+
+    def _assemble(self, cols, ns, dtype, cap):
+        """Concatenate one column's sub-batch host buffers into padded
+        device-ready buffers; recurses through list/struct children."""
+        validity = np.zeros(cap, np.bool_)
+        pos = 0
+        for c, n in zip(cols, ns):
+            validity[pos:pos + n] = c["validity"][:n]
+            pos += n
+        if isinstance(dtype, (dt.ArrayType, dt.MapType)):
+            kid_ns = [int(c["children"][0]["_n"]) for c in cols]
+            child_total = sum(kid_ns)
+            offs = [np.zeros(1, np.int32)]
+            shift = 0
+            p = 0
+            for c, n, kn in zip(cols, ns, kid_ns):
+                o = c["offsets"][:n + 1].astype(np.int32)
+                offs.append(o[1:] + shift)
+                shift += kn
+                p += n
+            off = np.concatenate(offs)
+            off = np.concatenate(
+                [off, np.full(cap + 1 - len(off),
+                              off[-1] if len(off) else 0, np.int32)])
+            child_cap = bucket_capacity(max(child_total, 1))
+            kid = self._assemble([c["children"][0] for c in cols], kid_ns,
+                                 Column.element_dtype(dtype), child_cap)
+            kid["_n"] = np.int64(child_total)
+            return {"validity": validity, "offsets": off,
+                    "children": [kid]}
+        if isinstance(dtype, dt.StructType):
+            kids = []
+            for fi, f in enumerate(dtype.fields):
+                kid = self._assemble([c["children"][fi] for c in cols],
+                                     ns, f.dtype, cap)
+                kid["_n"] = np.int64(sum(ns))
+                kids.append(kid)
+            return {"validity": validity, "children": kids}
+        if dtype.is_variable_width:
+            datas, offs = [], [np.zeros(1, np.int32)]
+            shift = 0
+            for c, n in zip(cols, ns):
+                datas.append(c["data"])
+                o = c["offsets"][:n + 1]
+                offs.append(o[1:].astype(np.int32) + shift)
+                shift += len(c["data"])
+            data = (np.concatenate(datas) if datas
+                    else np.zeros(0, np.uint8))
+            dcap = bucket_capacity(max(len(data), 1))
+            data = np.concatenate(
+                [data, np.zeros(dcap - len(data), np.uint8)])
+            off = np.concatenate(offs)
+            off = np.concatenate(
+                [off, np.full(cap + 1 - len(off), off[-1], np.int32)])
+            return {"data": data, "validity": validity, "offsets": off}
+        np_dt = _np_dtype_for(dtype)
+        if isinstance(dtype, dt.DecimalType) and dtype.is_decimal128:
+            data = np.zeros((cap, 2), np_dt)
+        else:
+            data = self._arena_zeros(cap, np_dt)
+        pos = 0
+        for c, n in zip(cols, ns):
+            data[pos:pos + n] = c["data"][:n]
+            validity[pos:pos + n] = c["validity"][:n]
+            pos += n
+        return {"data": data, "validity": validity}
 
     def _arena_zeros(self, count: int, np_dt) -> np.ndarray:
         """Assembly buffer from the native host arena (RMM-host-pool

@@ -1,0 +1,352 @@
+"""Nested types: array/struct/map columns, collection expressions,
+higher-order functions, GenerateExec (explode family), and the nested
+gather/concat kernels.
+
+Reference behaviors mirrored: collectionOperations.scala,
+complexTypeCreator.scala, complexTypeExtractors.scala,
+higherOrderFunctions.scala, GpuGenerateExec.scala.
+"""
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.columnar.column import Column
+from spark_rapids_tpu.columnar.table import Table
+from spark_rapids_tpu.expr.expressions import col, lit
+
+
+@pytest.fixture()
+def sess():
+    return st.TpuSession()
+
+
+@pytest.fixture()
+def df(sess):
+    return sess.create_dataframe({
+        "id": pa.array([1, 2, 3, 4]),
+        "arr": pa.array([[1, 2, 3], [], None, [4, 5]]),
+        "tags": pa.array([["a", "b"], ["a"], None, []]),
+        "m": pa.array([{"a": 1}, {"b": 2, "c": 3}, None, {}],
+                      type=pa.map_(pa.string(), pa.int64())),
+        "st": pa.array([{"x": 1, "y": "a"}, {"x": 2, "y": "b"},
+                        {"x": 3, "y": "c"}, None]),
+    })
+
+
+# ----------------------------------------------------------------------
+# columnar round-trips
+# ----------------------------------------------------------------------
+@pytest.mark.parametrize("data", [
+    pa.array([[1, 2], [3], None, [4, 5, 6], []]),
+    pa.array([["a", "bb"], None, ["ccc"], [], ["d", None]]),
+    pa.array([{"x": 1, "y": "a"}, {"x": 2, "y": None}, None]),
+    pa.array([{"a": 1}, {"b": 2, "c": 3}, None, {}],
+             type=pa.map_(pa.string(), pa.int64())),
+    pa.array([[[1], [2, 3]], None, [[4]], [], [None, [5]]]),
+], ids=["list_int", "list_str", "struct", "map", "list_list"])
+def test_nested_roundtrip(data):
+    c = Column.from_arrow(data)
+    assert c.to_arrow().to_pylist() == data.to_pylist()
+    s = data.slice(1, 3)
+    assert Column.from_arrow(s).to_arrow().to_pylist() == s.to_pylist()
+
+
+def test_nested_table_roundtrip():
+    t = pa.table({"a": pa.array([[1], [2, 3], None]),
+                  "s": pa.array([{"k": "x"}, None, {"k": "z"}])})
+    assert Table.from_arrow(t).to_arrow().to_pylist() == t.to_pylist()
+
+
+# ----------------------------------------------------------------------
+# collection expressions
+# ----------------------------------------------------------------------
+def test_size_getitem_element_at(df):
+    out = df.select(
+        F.size(col("arr")).alias("sz"),
+        col("arr").getItem(1).alias("it"),
+        F.element_at(col("arr"), -1).alias("ea"),
+    ).to_arrow().to_pylist()
+    assert [r["sz"] for r in out] == [3, 0, None, 2]
+    assert [r["it"] for r in out] == [2, None, None, 5]
+    assert [r["ea"] for r in out] == [3, None, None, 5]
+
+
+def test_array_contains_min_max(df):
+    out = df.select(
+        F.array_contains(col("arr"), 2).alias("ac"),
+        F.array_min(col("arr")).alias("mn"),
+        F.array_max(col("arr")).alias("mx"),
+    ).to_arrow().to_pylist()
+    assert [r["ac"] for r in out] == [True, False, None, False]
+    assert [r["mn"] for r in out] == [1, None, None, 4]
+    assert [r["mx"] for r in out] == [3, None, None, 5]
+
+
+def test_sort_array(df):
+    out = df.select(F.sort_array(col("arr"), asc=False).alias("s")) \
+        .to_arrow().to_pylist()
+    assert [r["s"] for r in out] == [[3, 2, 1], [], None, [5, 4]]
+
+
+def test_map_ops(df):
+    out = df.select(
+        F.element_at(col("m"), "b").alias("mb"),
+        F.map_keys(col("m")).alias("mk"),
+        F.map_values(col("m")).alias("mv"),
+    ).to_arrow().to_pylist()
+    assert [r["mb"] for r in out] == [None, 2, None, None]
+    assert [r["mk"] for r in out] == [["a"], ["b", "c"], None, []]
+    assert [r["mv"] for r in out] == [[1], [2, 3], None, []]
+
+
+def test_struct_create_and_getfield(df):
+    out = df.select(
+        col("st").getField("y").alias("sy"),
+        col("st")["x"].alias("sx"),
+        F.struct(col("id").alias("a"), (col("id") * 2).alias("b"))
+            .alias("mk"),
+    ).to_arrow().to_pylist()
+    assert [r["sy"] for r in out] == ["a", "b", "c", None]
+    assert [r["sx"] for r in out] == [1, 2, 3, None]
+    assert out[1]["mk"] == {"a": 2, "b": 4}
+
+
+def test_create_array(df):
+    out = df.select(F.array(col("id"), col("id") + 10).alias("a")) \
+        .to_arrow().to_pylist()
+    assert [r["a"] for r in out] == [[1, 11], [2, 12], [3, 13], [4, 14]]
+
+
+def test_create_array_strings(df):
+    out = df.select(
+        F.array(col("st").getField("y"), lit("z")).alias("a")) \
+        .to_arrow().to_pylist()
+    assert [r["a"] for r in out] == [["a", "z"], ["b", "z"], ["c", "z"],
+                                     [None, "z"]]
+
+
+# ----------------------------------------------------------------------
+# higher-order functions
+# ----------------------------------------------------------------------
+def test_transform_filter(df):
+    out = df.select(
+        F.transform(col("arr"), lambda x: x * 10).alias("t"),
+        F.transform(col("arr"), lambda x, i: x + i).alias("ti"),
+        F.filter(col("arr"), lambda x: x > 1).alias("f"),
+    ).to_arrow().to_pylist()
+    assert [r["t"] for r in out] == [[10, 20, 30], [], None, [40, 50]]
+    assert [r["ti"] for r in out] == [[1, 3, 5], [], None, [4, 6]]
+    assert [r["f"] for r in out] == [[2, 3], [], None, [4, 5]]
+
+
+def test_exists_forall_aggregate(df):
+    out = df.select(
+        F.exists(col("arr"), lambda x: x > 4).alias("e"),
+        F.forall(col("arr"), lambda x: x > 0).alias("fa"),
+        F.aggregate(col("arr"), lit(0), lambda a, x: a + x).alias("ag"),
+    ).to_arrow().to_pylist()
+    assert [r["e"] for r in out] == [False, False, None, True]
+    assert [r["fa"] for r in out] == [True, True, None, True]
+    assert [r["ag"] for r in out] == [6, 0, None, 9]
+
+
+def test_transform_captures_outer_column(df):
+    out = df.select(
+        F.transform(col("arr"), lambda x: x + col("id")).alias("t")) \
+        .to_arrow().to_pylist()
+    assert [r["t"] for r in out] == [[2, 3, 4], [], None, [8, 9]]
+
+
+# ----------------------------------------------------------------------
+# explode family (GenerateExec)
+# ----------------------------------------------------------------------
+def test_explode(df):
+    out = df.select(col("id"), F.explode(col("arr")).alias("n")) \
+        .to_arrow().to_pylist()
+    assert out == [{"id": 1, "n": 1}, {"id": 1, "n": 2}, {"id": 1, "n": 3},
+                   {"id": 4, "n": 4}, {"id": 4, "n": 5}]
+
+
+def test_explode_outer(df):
+    out = df.select(col("id"), F.explode_outer(col("tags")).alias("t")) \
+        .to_arrow().to_pylist()
+    assert out == [{"id": 1, "t": "a"}, {"id": 1, "t": "b"},
+                   {"id": 2, "t": "a"}, {"id": 3, "t": None},
+                   {"id": 4, "t": None}]
+
+
+def test_posexplode(df):
+    out = df.select(col("id"), F.posexplode(col("tags"))) \
+        .to_arrow().to_pylist()
+    assert out == [{"id": 1, "pos": 0, "col": "a"},
+                   {"id": 1, "pos": 1, "col": "b"},
+                   {"id": 2, "pos": 0, "col": "a"}]
+
+
+def test_explode_map(df):
+    out = df.select(col("id"), F.explode(col("m"))).to_arrow().to_pylist()
+    assert out == [{"id": 1, "key": "a", "value": 1},
+                   {"id": 2, "key": "b", "value": 2},
+                   {"id": 2, "key": "c", "value": 3}]
+
+
+def test_explode_feeds_groupby(df):
+    """VERDICT done-criterion: explode feeding an aggregation."""
+    out = (df.select(col("id"), F.explode(col("arr")).alias("n"))
+             .group_by("n")
+             .agg(F.count("id").alias("c"), F.sum("id").alias("s"))
+             .to_arrow().to_pylist())
+    got = {r["n"]: (r["c"], r["s"]) for r in out}
+    assert got == {1: (1, 1), 2: (1, 1), 3: (1, 1), 4: (1, 4), 5: (1, 4)}
+
+
+def test_explode_after_filter(df):
+    out = (df.filter(col("id") >= 2)
+             .select(col("id"), F.explode(col("arr")).alias("n"))
+             .to_arrow().to_pylist())
+    assert out == [{"id": 4, "n": 4}, {"id": 4, "n": 5}]
+
+
+# ----------------------------------------------------------------------
+# nested flows through engine machinery
+# ----------------------------------------------------------------------
+def test_nested_survives_coalesce_union(sess):
+    t1 = pa.table({"a": pa.array([[1, 2], None])})
+    t2 = pa.table({"a": pa.array([[3], []])})
+    d = sess.create_dataframe(t1).union(sess.create_dataframe(t2))
+    assert d.to_arrow().column("a").to_pylist() == [[1, 2], None, [3], []]
+
+
+def test_nested_filter_compaction(df):
+    out = df.filter(col("id") % 2 == 1).select(col("arr"), col("st")) \
+        .to_arrow().to_pylist()
+    assert out == [{"arr": [1, 2, 3], "st": {"x": 1, "y": "a"}},
+                   {"arr": None, "st": {"x": 3, "y": "c"}}]
+
+
+# ----------------------------------------------------------------------
+# collect_list / collect_set
+# ----------------------------------------------------------------------
+def test_collect_list_set(sess):
+    d = sess.create_dataframe({
+        "k": pa.array([1, 2, 1, 2, 1, 3]),
+        "v": pa.array([10, 20, 10, 40, 50, None]),
+        "t": pa.array(["a", "b", "a", "c", "a", None]),
+    })
+    out = d.group_by("k").agg(
+        F.collect_list(col("v")).alias("cl"),
+        F.collect_set(col("v")).alias("cs"),
+        F.collect_set(col("t")).alias("cts"),
+        F.sum("v").alias("sv"),
+    ).to_arrow().to_pylist()
+    got = {r["k"]: (sorted(r["cl"]), sorted(r["cs"]), sorted(r["cts"]),
+                    r["sv"]) for r in out}
+    assert got == {1: ([10, 10, 50], [10, 50], ["a"], 70),
+                   2: ([20, 40], [20, 40], ["b", "c"], 60),
+                   3: ([], [], [], None)}
+
+
+def test_collect_multi_partition():
+    import numpy as np
+    rng = np.random.default_rng(0)
+    k = rng.integers(0, 7, 4000)
+    v = rng.integers(0, 5, 4000)
+    s2 = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 512})
+    d = s2.create_dataframe({"k": pa.array(k), "v": pa.array(v)})
+    out = d.group_by("k").agg(F.collect_set(col("v")).alias("cs")) \
+        .to_arrow().to_pylist()
+    exp = {}
+    for kk, vv in zip(k, v):
+        exp.setdefault(int(kk), set()).add(int(vv))
+    assert {r["k"]: set(r["cs"]) for r in out} == exp
+
+
+def test_nested_through_shuffle_join():
+    """Nested columns survive the file-shuffle wire format and sized join
+    gathers (repeat gather capacity measurement)."""
+    import numpy as np
+    rng = np.random.default_rng(3)
+    n = 600
+    ks = rng.integers(0, 20, n)
+    arrs = [None if rng.random() < 0.1 else
+            list(rng.integers(0, 9, rng.integers(0, 5)))
+            for _ in range(n)]
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 128})
+    d1 = s.create_dataframe({"k": pa.array(ks),
+                             "p": pa.array(arrs, type=pa.list_(pa.int64()))})
+    d2 = s.create_dataframe({"k": pa.array(list(range(20))),
+                             "w": pa.array([k * 10 for k in range(20)])})
+    out = d1.join(d2, on=["k"]).sort("k").to_arrow().to_pylist()
+    exp = sorted(({"k": int(k), "p": p, "w": int(k) * 10}
+                  for k, p in zip(ks, arrs)), key=lambda r: r["k"])
+    assert [r["p"] for r in out] == [r["p"] for r in exp]
+    assert [r["w"] for r in out] == [r["w"] for r in exp]
+
+
+def test_collect_list_strings_shuffled():
+    import numpy as np
+    rng = np.random.default_rng(5)
+    n = 500
+    ks = rng.integers(0, 9, n)
+    ts = [f"s{x}" for x in rng.integers(0, 6, n)]
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 128})
+    d = s.create_dataframe({"k": pa.array(ks), "t": pa.array(ts)})
+    out = d.group_by("k").agg(F.collect_set(col("t")).alias("cs")) \
+        .to_arrow().to_pylist()
+    exp = {}
+    for k, t in zip(ks, ts):
+        exp.setdefault(int(k), set()).add(t)
+    assert {r["k"]: set(r["cs"]) for r in out} == exp
+
+
+# ----------------------------------------------------------------------
+# review regressions (round 2)
+# ----------------------------------------------------------------------
+def test_array_contains_long_string_values(sess):
+    """Replication-free row-mapped comparison: values longer than the
+    value column's byte bucket must still compare correctly."""
+    long = ["x" * 40 + str(i) for i in range(4)]
+    d = sess.create_dataframe({
+        "arr": pa.array([[long[0], long[2]]] * 2
+                        + [[long[1]], [long[3], long[0]]]),
+        "val": pa.array([long[0], long[1], long[1], long[2]]),
+    })
+    out = d.select(F.array_contains(col("arr"), col("val")).alias("c")) \
+        .to_arrow().to_pylist()
+    assert [r["c"] for r in out] == [True, False, True, False]
+
+
+def test_element_at_map_long_string_keys(sess):
+    long = ["x" * 40 + str(i) for i in range(3)]
+    m = pa.array([{long[0]: 1, long[1]: 2}, {long[2]: 3}],
+                 type=pa.map_(pa.string(), pa.int64()))
+    d = sess.create_dataframe({"m": m, "k": pa.array([long[1], long[2]])})
+    out = d.select(F.element_at(col("m"), col("k")).alias("v")) \
+        .to_arrow().to_pylist()
+    assert [r["v"] for r in out] == [2, 3]
+
+
+def test_explode_name_collision(sess):
+    d = sess.create_dataframe({"col": pa.array([100, 200]),
+                               "arr": pa.array([[1, 2], [3]])})
+    out = d.select(F.explode(col("arr"))).to_arrow().to_pylist()
+    assert [r["col"] for r in out] == [1, 2, 3]
+
+
+def test_aggregate_per_row_zero(sess):
+    d = sess.create_dataframe({"arr": pa.array([[1, 2], [10]]),
+                               "z": pa.array([100, 200])})
+    out = d.select(F.aggregate(col("arr"), col("z"),
+                               lambda a, x: a + x).alias("s")) \
+        .to_arrow().to_pylist()
+    assert [r["s"] for r in out] == [103, 210]
+
+
+def test_lambda_string_capture_rejected(sess):
+    from spark_rapids_tpu.expr.expressions import UnsupportedExpr
+    d = sess.create_dataframe({"arr": pa.array([[1], [2]]),
+                               "s": pa.array(["a", "b"])})
+    with pytest.raises(UnsupportedExpr):
+        d.select(F.transform(col("arr"),
+                             lambda x: x + F.length(col("s")))).to_arrow()

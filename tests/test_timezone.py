@@ -1,0 +1,97 @@
+"""Timezone database: from_utc_timestamp / to_utc_timestamp against the
+python zoneinfo oracle (same IANA data; reference: GpuTimeZoneDB,
+GpuFromUTCTimestamp/GpuToUTCTimestamp in datetimeExpressions.scala)."""
+import datetime as dtm
+from zoneinfo import ZoneInfo
+
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col
+
+UTC = ZoneInfo("UTC")
+
+CASES = [
+    dtm.datetime(2020, 1, 15, 12, 0, 0),
+    dtm.datetime(2020, 7, 15, 12, 0, 0),
+    dtm.datetime(2020, 3, 8, 6, 59, 59),   # just before spring forward
+    dtm.datetime(2020, 3, 8, 7, 0, 0),     # at spring forward
+    dtm.datetime(2020, 11, 1, 5, 59, 59),  # just before fall back
+    dtm.datetime(2020, 11, 1, 6, 0, 0),    # at fall back
+    dtm.datetime(1950, 6, 1, 0, 0, 0),     # pre-epoch rules
+    dtm.datetime(2035, 6, 1, 0, 0, 0),     # POSIX-rule future era
+]
+TS_US = [int(c.replace(tzinfo=UTC).timestamp() * 1e6) for c in CASES]
+
+
+def _ref_from_utc(ts_us: int, tz: str) -> int:
+    d = dtm.datetime.fromtimestamp(ts_us / 1e6, tz=UTC) \
+        .astimezone(ZoneInfo(tz))
+    return int(d.replace(tzinfo=UTC).timestamp() * 1e6)
+
+
+@pytest.mark.parametrize("tz", ["America/New_York", "Asia/Kolkata",
+                                "Australia/Sydney", "Europe/Paris",
+                                "America/Sao_Paulo", "UTC"])
+def test_from_utc_timestamp(tz):
+    s = st.TpuSession()
+    df = s.create_dataframe(
+        {"t": pa.array(TS_US, type=pa.timestamp("us", tz="UTC"))})
+    out = df.select(F.from_utc_timestamp(col("t"), tz).alias("w")) \
+        .to_arrow()
+    got = [v.value for v in out.column(0)]
+    assert got == [_ref_from_utc(t, tz) for t in TS_US]
+
+
+def test_to_utc_round_trip_unambiguous():
+    tz = "America/New_York"
+    # drop the fall-back instant: its wall time is ambiguous and resolves
+    # to the earlier offset (Java semantics), deliberately not an identity
+    ts = [t for i, t in enumerate(TS_US) if i != 5]
+    s = st.TpuSession()
+    df = s.create_dataframe(
+        {"t": pa.array(ts, type=pa.timestamp("us", tz="UTC"))})
+    rt = df.select(F.to_utc_timestamp(
+        F.from_utc_timestamp(col("t"), tz), tz).alias("r")).to_arrow()
+    assert [v.value for v in rt.column(0)] == ts
+
+
+def test_to_utc_overlap_earlier_offset():
+    """Ambiguous 01:30 EST/EDT on 2020-11-01 -> earlier offset (EDT),
+    i.e. 05:30 UTC (Spark's java.time withEarlierOffsetAtOverlap)."""
+    wall = int(dtm.datetime(2020, 11, 1, 1, 30, 0,
+                            tzinfo=UTC).timestamp() * 1e6)
+    s = st.TpuSession()
+    df = s.create_dataframe(
+        {"t": pa.array([wall], type=pa.timestamp("us", tz="UTC"))})
+    out = df.select(F.to_utc_timestamp(
+        col("t"), "America/New_York").alias("r")).to_arrow()
+    exp = int(dtm.datetime(2020, 11, 1, 5, 30, 0,
+                           tzinfo=UTC).timestamp() * 1e6)
+    assert out.column(0)[0].value == exp
+
+
+def test_to_utc_gap_shifts_forward():
+    """Nonexistent 02:30 on 2020-03-08 (spring forward): treated with the
+    pre-transition offset (EST) -> 07:30 UTC, matching Java's
+    shift-forward resolution."""
+    wall = int(dtm.datetime(2020, 3, 8, 2, 30, 0,
+                            tzinfo=UTC).timestamp() * 1e6)
+    s = st.TpuSession()
+    df = s.create_dataframe(
+        {"t": pa.array([wall], type=pa.timestamp("us", tz="UTC"))})
+    out = df.select(F.to_utc_timestamp(
+        col("t"), "America/New_York").alias("r")).to_arrow()
+    exp = int(dtm.datetime(2020, 3, 8, 7, 30, 0,
+                           tzinfo=UTC).timestamp() * 1e6)
+    assert out.column(0)[0].value == exp
+
+
+def test_unknown_timezone_rejected():
+    s = st.TpuSession({"spark.rapids.tpu.sql.allowCpuFallback": False})
+    df = s.create_dataframe(
+        {"t": pa.array(TS_US[:1], type=pa.timestamp("us", tz="UTC"))})
+    with pytest.raises(Exception, match="[Tt]imezone"):
+        df.select(F.from_utc_timestamp(col("t"), "Not/AZone")).to_arrow()
