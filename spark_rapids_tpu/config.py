@@ -93,6 +93,24 @@ SHUFFLE_WRITER_THREADS = _conf(
 SHUFFLE_READER_THREADS = _conf(
     "shuffle.multiThreaded.reader.threads", 4,
     "Thread pool size for shuffle reads.", int)
+ADAPTIVE_ENABLED = _conf(
+    "sql.adaptive.enabled", True,
+    "Adaptive post-shuffle re-planning: coalesce small reduce partitions "
+    "toward the target size and split skewed join stream partitions "
+    "(analog of spark.sql.adaptive.* + GpuCustomShuffleReaderExec).", bool)
+ADAPTIVE_TARGET_BYTES = _conf(
+    "sql.adaptive.advisoryPartitionSizeInBytes", 64 * 1024 * 1024,
+    "Advisory post-shuffle partition size: adjacent reduce partitions "
+    "smaller than this coalesce into one task "
+    "(spark.sql.adaptive.advisoryPartitionSizeInBytes).", int)
+ADAPTIVE_SKEW_FACTOR = _conf(
+    "sql.adaptive.skewJoin.skewedPartitionFactor", 5,
+    "A join stream partition is skewed when its bytes exceed this factor "
+    "times the median partition size (and the min threshold).", int)
+ADAPTIVE_SKEW_MIN_BYTES = _conf(
+    "sql.adaptive.skewJoin.skewedPartitionThresholdInBytes",
+    256 * 1024 * 1024,
+    "Minimum bytes before a stream partition is considered skewed.", int)
 SHUFFLE_COMPRESS = _conf(
     "shuffle.compression.codec", "lz4",
     "Shuffle wire compression: none|lz4|zstd (nvcomp analog, host-side).",

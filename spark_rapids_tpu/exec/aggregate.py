@@ -750,11 +750,7 @@ class CollectAggExec(TpuExec):
         for a in self.aggs:
             if getattr(a, "is_set", False) and isinstance(
                     a.child.dtype, (dt.StringType, dt.BinaryType)):
-                vcv = a.child.emit(ctx)
-                lens = vcv.offsets[1:] - vcv.offsets[:-1]
-                lens = jnp.where(mask & vcv.validity, lens, 0)
-                ncs.append(sk.nchunks_for_len(
-                    max(fetch_int(jnp.max(lens)), 1)))
+                ncs.append(sk.string_nchunks(a.child.emit(ctx), mask))
             else:
                 ncs.append(0)
         return tuple(ncs)
@@ -765,11 +761,7 @@ class CollectAggExec(TpuExec):
         ncs = []
         for k in self.keys:
             if isinstance(k.dtype, (dt.StringType, dt.BinaryType)):
-                kcv = k.emit(ctx)
-                lens = kcv.offsets[1:] - kcv.offsets[:-1]
-                lens = jnp.where(mask & kcv.validity, lens, 0)
-                ncs.append(sk.nchunks_for_len(
-                    max(fetch_int(jnp.max(lens)), 1)))
+                ncs.append(sk.string_nchunks(k.emit(ctx), mask))
             else:
                 ncs.append(0)
         return tuple(ncs)

@@ -1,0 +1,129 @@
+"""Adaptive query execution: post-shuffle partition re-planning.
+
+The analog of the reference's AQE integration — GpuCustomShuffleReaderExec
+(coalesced + skewed shuffle reads) over MapOutputStatistics
+(reference: GpuOverrides.scala:5019 GpuCustomShuffleReaderExec rule,
+GpuShuffledHashJoinExec skew handling). Design:
+
+  - A shuffle stage materializes on first demand (ShuffleExchangeExec
+    `stage_stats`), yielding serialized bytes per reduce partition — the
+    stage barrier AQE re-plans at.
+  - `AqeShufflePlan` computes task groups from those sizes: adjacent small
+    partitions COALESCE toward the advisory target; partitions larger than
+    max(skew_factor * median, skew_min) SPLIT into row-balanced slices
+    (only when splitting is legal for the consumer).
+  - `AQEShuffleReadExec` serves the re-planned partitions. For joins, the
+    stream-side reader splits skewed partitions while the build-side
+    reader (role="build") replays the FULL matching reduce partition for
+    every split slice — the skew-join mitigation the reference performs by
+    duplicating the build side across split stream tasks.
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional, Tuple
+
+from .base import ExecContext, TpuExec
+
+__all__ = ["AqeShufflePlan", "AQEShuffleReadExec"]
+
+
+class AqeShufflePlan:
+    """Shared re-plan over one or two exchanges feeding the same consumer
+    (both join sides must re-plan identically — same key space)."""
+
+    def __init__(self, exchanges, target_bytes: int, skew_factor: int,
+                 skew_min_bytes: int, allow_split: bool):
+        self.exchanges = list(exchanges)
+        self.target = max(1, target_bytes)
+        self.skew_factor = skew_factor
+        self.skew_min = skew_min_bytes
+        self.allow_split = allow_split
+        self._groups: Optional[List[List[Tuple[int, int, int]]]] = None
+        self._lock = threading.Lock()
+
+    def groups(self, ctx: ExecContext):
+        """List of task groups; each group is [(rpid, chunk, nchunks)...].
+        Coalesced groups hold several whole partitions; a split group
+        holds exactly one slice of one partition."""
+        with self._lock:
+            if self._groups is not None:
+                return self._groups
+            n = self.exchanges[0].num_partitions(ctx)
+            # skew is a STREAM-side property (Spark's OptimizeSkewedJoin
+            # judges per side): splitting because the build is big only
+            # multiplies full-build replays for zero stream benefit
+            stream = list(self.exchanges[0].stage_stats(ctx))
+            sizes = list(stream)
+            for ex in self.exchanges[1:]:
+                for i, b in enumerate(ex.stage_stats(ctx)):
+                    sizes[i] += b
+            nonzero = sorted(b for b in stream if b) or [0]
+            median = nonzero[len(nonzero) // 2]
+            skew_cut = max(self.skew_factor * median, self.skew_min)
+            groups: List[List[Tuple[int, int, int]]] = []
+            cur: List[Tuple[int, int, int]] = []
+            cur_bytes = 0
+            for rp in range(n):
+                sb = stream[rp]
+                if self.allow_split and sb > skew_cut and median > 0:
+                    if cur:
+                        groups.append(cur)
+                        cur, cur_bytes = [], 0
+                    nchunks = max(2, -(-sb // self.target))
+                    for c in range(nchunks):
+                        groups.append([(rp, c, nchunks)])
+                    continue
+                if cur and cur_bytes + sizes[rp] > self.target:
+                    groups.append(cur)
+                    cur, cur_bytes = [], 0
+                cur.append((rp, 0, 1))
+                cur_bytes += sizes[rp]
+            if cur:
+                groups.append(cur)
+            if not groups:
+                groups = [[(0, 0, 1)]]
+            self._groups = groups
+            return groups
+
+
+class AQEShuffleReadExec(TpuExec):
+    """Reads the re-planned partitions of one exchange.
+
+    role="stream": serves every group as planned (including split
+    slices). role="build": for each group serves the UNION of its reduce
+    partitions WITHOUT slicing, so a split stream slice still probes the
+    complete build partition."""
+
+    def __init__(self, exchange, plan: AqeShufflePlan,
+                 role: str = "stream"):
+        super().__init__([exchange], exchange.schema)
+        self.plan = plan
+        self.role = role
+
+    def describe(self):
+        return f"AQEShuffleReadExec[{self.role}]"
+
+    def num_partitions(self, ctx: ExecContext):
+        if getattr(ctx, "planning", False):
+            # plan-construction probe: report the static pre-AQE count
+            # without materializing the map stage (the stage barrier
+            # happens at first real execution)
+            return self.children[0].num_partitions(ctx)
+        return len(self.plan.groups(ctx))
+
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        group = self.plan.groups(ctx)[pid]
+        ex = self.children[0]
+        m = ctx.metrics_for(self._op_id)
+        seen = set()
+        for rpid, chunk, nchunks in group:
+            if self.role == "build":
+                if rpid in seen:
+                    continue
+                seen.add(rpid)
+                chunk, nchunks = 0, 1
+            batch = ex.read_slice(ctx, rpid, chunk, nchunks)
+            if batch is not None:
+                m.add("numOutputBatches", 1)
+                yield batch

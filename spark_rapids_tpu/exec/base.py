@@ -22,11 +22,14 @@ __all__ = ["TpuExec", "ExecContext"]
 class ExecContext:
     """Per-query execution context: conf snapshot, metrics, memory runtime."""
 
-    def __init__(self, conf=None, session=None):
+    def __init__(self, conf=None, session=None, planning: bool = False):
         import threading
         from ..config import TpuConf
         self.conf = conf or TpuConf()
         self.session = session
+        # planning probes (num_partitions during plan construction) must
+        # not trigger stage materialization (AQE readers check this)
+        self.planning = planning
         self.metrics: Dict[str, MetricSet] = {}
         self._metrics_lock = threading.Lock()
 

@@ -129,17 +129,30 @@ class ShuffleExchangeExec(TpuExec):
                     sh.write_map_partition(mpid, pieces)
             self._shuffle = sh
 
-    def execute_partition(self, ctx: ExecContext, pid: int):
+    # ---- adaptive stage API (GpuCustomShuffleReaderExec inputs) --------
+    def stage_stats(self, ctx: ExecContext):
+        """Materialize the map stage and return serialized bytes per
+        reduce partition (MapOutputStatistics analog)."""
+        self._ensure_shuffled(ctx)
+        return self._shuffle.partition_stats()
+
+    def read_slice(self, ctx: ExecContext, rpid: int, chunk: int = 0,
+                   nchunks: int = 1):
         self._ensure_shuffled(ctx)
         m = ctx.metrics_for(self._op_id)
         from ..memory.retry import retry_no_split
         with m.timer("fetchAndMergeTime"):
-            # the reduce-side H2D of a whole partition retries after a
-            # spill pass on OOM (streamed reduce batches are follow-on)
-            batch = retry_no_split(
-                lambda: self._shuffle.reduce_batch(pid))
+            if nchunks == 1:
+                return retry_no_split(
+                    lambda: self._shuffle.reduce_batch(rpid))
+            return retry_no_split(
+                lambda: self._shuffle.reduce_batch_slice(rpid, chunk,
+                                                         nchunks))
+
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        batch = self.read_slice(ctx, pid)
         if batch is not None:
-            m.add("numOutputBatches", 1)
+            ctx.metrics_for(self._op_id).add("numOutputBatches", 1)
             yield batch
 
 

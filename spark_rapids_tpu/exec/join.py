@@ -98,12 +98,8 @@ class HashJoinExec(TpuExec):
         ncs = []
         for i, ke in enumerate(self.lkeys):
             if isinstance(ke.dtype, (dt.StringType, dt.BinaryType)):
-                mx = 0
-                for kcv, mk in ((bkey_cvs[i], bmask), (skey_cvs[i], smask)):
-                    lens = kcv.offsets[1:] - kcv.offsets[:-1]
-                    lens = jnp.where(mk & kcv.validity, lens, 0)
-                    mx = max(mx, fetch_int((jnp.max(lens))))
-                ncs.append(sk.nchunks_for_len(max(mx, 1)))
+                ncs.append(max(sk.string_nchunks(bkey_cvs[i], bmask),
+                               sk.string_nchunks(skey_cvs[i], smask)))
             else:
                 ncs.append(0)
         return tuple(ncs)

@@ -448,21 +448,20 @@ class SortArray(Expression):
         return b
 
     def emit(self, ctx: EmitCtx) -> CV:
+        from ..ops import sortkeys as sk
         arr = self.child.emit(ctx)
         rows, live = _elem_rows(arr)
         e = arr.child
-        vals = e.data
-        if not self.asc:
-            if jnp.issubdtype(vals.dtype, jnp.floating):
-                vals = -vals
-            else:
-                vals = jnp.where(
-                    vals == jnp.iinfo(vals.dtype).min,
-                    jnp.iinfo(vals.dtype).max, -vals)
+        et = self.child.dtype.element
+        # radix-normalized monotone keys (descending handled by the key
+        # builder — plain negation breaks on bool and collides
+        # INT_MIN with -(INT_MIN+1))
+        keys = sk.order_keys(CV(e.data, e.validity), et,
+                             descending=not self.asc)
         # sort key tiers: dead elements last within their row never matter
         # (they stay inside gaps), null elements first (asc) / last (desc)
         nullk = jnp.where(e.validity, 1, 0 if self.asc else 2)
-        order = jnp.lexsort((vals, nullk, rows))
+        order = jnp.lexsort((*reversed(keys), nullk, rows))
         child = ops_gather.take(e, order, live[order])
         # positions are permuted only within rows, so offsets are unchanged
         return CV(arr.data, arr.validity, arr.offsets, (child,))

@@ -129,3 +129,14 @@ def group_boundaries(sorted_keys: Sequence[jnp.ndarray]) -> jnp.ndarray:
         prev = jnp.roll(k, 1)
         new = new | (k != prev).at[0].set(True)
     return new
+
+
+def string_nchunks(cv: CV, mask) -> int:
+    """Static order-key chunk count covering the longest live+valid
+    string (shared by aggregate/join/collect key sizing: dead and padding
+    rows must not inflate the count)."""
+    from ..utils.transfer import fetch_int
+    lens = cv.offsets[1:] - cv.offsets[:-1]
+    lens = jnp.where(mask & cv.validity, lens, 0)
+    mx = fetch_int(jnp.max(lens)) if lens.shape[0] else 0
+    return nchunks_for_len(max(mx, 1))

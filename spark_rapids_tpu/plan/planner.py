@@ -136,6 +136,29 @@ def _filter(meta, conv, conf):
     return x.FilterExec(child, n.bound)
 
 
+def _aqe_wrap(exchange, conf, allow_split=False, plan=None,
+              role="stream"):
+    """Wrap a file-shuffle exchange with an adaptive reader when enabled
+    (GpuCustomShuffleReaderExec analog). Mesh exchanges re-plan at trace
+    time instead, so they pass through."""
+    from ..config import (ADAPTIVE_ENABLED, ADAPTIVE_SKEW_FACTOR,
+                          ADAPTIVE_SKEW_MIN_BYTES, ADAPTIVE_TARGET_BYTES)
+    from ..exec.exchange import ShuffleExchangeExec
+    if not conf.get(ADAPTIVE_ENABLED) or \
+            not isinstance(exchange, ShuffleExchangeExec):
+        return exchange, None
+    from ..exec.aqe import AqeShufflePlan, AQEShuffleReadExec
+    if plan is None:
+        plan = AqeShufflePlan([exchange],
+                              conf.get(ADAPTIVE_TARGET_BYTES),
+                              conf.get(ADAPTIVE_SKEW_FACTOR),
+                              conf.get(ADAPTIVE_SKEW_MIN_BYTES),
+                              allow_split)
+    else:
+        plan.exchanges.append(exchange)
+    return AQEShuffleReadExec(exchange, plan, role), plan
+
+
 def _make_hash_exchange(child, bound_keys, conf):
     """Choose the exchange transport: mesh collective (all_to_all over
     ICI when spark.rapids.tpu.mesh.devices > 0) or the host file shuffle
@@ -175,8 +198,10 @@ def _agg(meta, conv, conf):
         # partition's sort-collect is final (disjoint keys)
         from ..exec.base import ExecContext as _Ctx
         nparts_c = conf.get(SHUFFLE_PARTITIONS)
-        if child.num_partitions(_Ctx(conf)) > 1 and nparts_c > 1:
+        if child.num_partitions(_Ctx(conf, planning=True)) > 1 \
+                and nparts_c > 1:
             exch = _make_hash_exchange(child, n.bound_keys, conf)
+            exch, _ = _aqe_wrap(exch, conf, allow_split=False)
             return agg_exec.CollectAggExec(exch, key_names, n.bound_keys,
                                            names, aggs, n.schema,
                                            per_partition=True)
@@ -189,7 +214,8 @@ def _agg(meta, conv, conf):
     from ..exec.base import ExecContext
     nparts = conf.get(SHUFFLE_PARTITIONS)
     mesh_n = conf.get(MESH_DEVICES)
-    multi_input = child.num_partitions(ExecContext(conf)) > 1
+    multi_input = child.num_partitions(
+        ExecContext(conf, planning=True)) > 1
     keys_ok = all(not (k.dtype.is_nested) for k in n.bound_keys)
     if keys_ok and ((multi_input and nparts > 1) or mesh_n > 1):
         from ..expr.expressions import BoundRef
@@ -200,6 +226,9 @@ def _agg(meta, conv, conf):
                  for i, (k, f) in enumerate(
                      zip(n.bound_keys, partial.schema.fields))]
         exch = _make_hash_exchange(partial, pkeys, conf)
+        # adaptive coalescing of small reduce partitions (splitting would
+        # break group completeness, so allow_split=False)
+        exch, _ = _aqe_wrap(exch, conf, allow_split=False)
         return agg_exec.HashAggregateExec(exch, key_names, pkeys,
                                           names, aggs, n.schema,
                                           mode="final")
@@ -323,7 +352,17 @@ def _join(meta, conv, conf):
                                       left.schema)
             rex = ShuffleExchangeExec(right, nparts, n.bound_right_keys,
                                       right.schema)
-            return HashJoinExec(lex, rex, n.bound_left_keys,
+            # adaptive skew join: split oversized stream partitions into
+            # row slices; the build reader replays the full partition per
+            # slice. Splitting is only sound for joins where every output
+            # row of a partition depends on (stream row, full build) —
+            # right/full outer track matched-build state across the whole
+            # partition, so those keep whole partitions.
+            allow_split = n.how in ("inner", "left", "left_semi",
+                                    "left_anti")
+            lread, plan = _aqe_wrap(lex, conf, allow_split=allow_split)
+            rread, _ = _aqe_wrap(rex, conf, plan=plan, role="build")
+            return HashJoinExec(lread, rread, n.bound_left_keys,
                                 n.bound_right_keys, n.how, n.schema,
                                 per_partition=True)
     # broadcast hash join: build side collected once, stream partitions

@@ -120,19 +120,67 @@ class LocalShuffle:
             self._map_files.append(path)
 
     # ---------------- reduce side --------------------------------------
-    def read_reduce_partition(self, rpid: int) -> List[HostSubBatch]:
+    def _segment_extent(self, f, rpid: int):
+        f.seek(-12, os.SEEK_END)
+        idx_off, _n = struct.unpack("<QI", f.read(12))
+        f.seek(idx_off + 16 * rpid)
+        return struct.unpack("<QQ", f.read(16))
+
+    def _block_ranges(self, path: str, rpid: int):
+        """(offset, length) of each serialized block in this partition's
+        segment — length prefixes only, payloads are skipped (cheap)."""
+        blocks = []
+        with open(path, "rb") as f:
+            off, ln = self._segment_extent(f, rpid)
+            pos, end = off, off + ln
+            while pos < end:
+                f.seek(pos)
+                (blen,) = struct.unpack("<Q", f.read(8))
+                blocks.append((pos, 8 + blen))
+                pos += 8 + blen
+        return blocks
+
+    def read_reduce_partition(self, rpid: int, chunk: int = 0,
+                              nchunks: int = 1) -> List[HostSubBatch]:
+        """Sub-batches of one reduce partition; with nchunks > 1 only the
+        blocks of serialized-byte slice `chunk` are read AND decoded
+        (adaptive skew split must not re-materialize the whole partition
+        per slice)."""
         from .serializer import wire_spec
         specs = [wire_spec(f.dtype) for f in self.schema.fields]
 
-        def read_one(path: str) -> List[HostSubBatch]:
+        with self._lock:
+            files = list(self._map_files)
+
+        selected = None
+        if nchunks > 1:
+            per_file = [self._block_ranges(p, rpid) for p in files]
+            total = sum(ln for blocks in per_file for _, ln in blocks)
+            bounds = [total * c // nchunks for c in range(nchunks + 1)]
+            selected = []
+            acc = 0
+            for blocks in per_file:
+                sel = []
+                for pos, ln in blocks:
+                    if bounds[chunk] <= acc < bounds[chunk + 1]:
+                        sel.append((pos, ln))
+                    acc += ln
+                selected.append(sel)
+
+        def read_one(args) -> List[HostSubBatch]:
+            fi, path = args
             out = []
             with open(path, "rb") as f:
-                f.seek(-12, os.SEEK_END)
-                idx_off, n = struct.unpack("<QI", f.read(12))
-                f.seek(idx_off + 16 * rpid)
-                off, ln = struct.unpack("<QQ", f.read(16))
-                f.seek(off)
-                seg = io.BytesIO(f.read(ln))
+                if selected is None:
+                    off, ln = self._segment_extent(f, rpid)
+                    f.seek(off)
+                    seg = io.BytesIO(f.read(ln))
+                else:
+                    chunks = []
+                    for pos, ln in selected[fi]:
+                        f.seek(pos)
+                        chunks.append(f.read(ln))
+                    seg = io.BytesIO(b"".join(chunks))
             while True:
                 sb = read_subbatch(seg, specs, self.codec)
                 if sb is None:
@@ -140,19 +188,44 @@ class LocalShuffle:
                 out.append(sb)
             return out
 
-        with self._lock:
-            files = list(self._map_files)
         if self.reader_threads > 1 and len(files) > 1:
             with cf.ThreadPoolExecutor(self.reader_threads) as pool:
-                results = list(pool.map(read_one, files))
+                results = list(pool.map(read_one, enumerate(files)))
         else:
-            results = [read_one(p) for p in files]
+            results = [read_one((i, p)) for i, p in enumerate(files)]
         return [sb for r in results for sb in r]
+
+    def partition_stats(self) -> List[int]:
+        """Serialized bytes per reduce partition, from the map-file
+        trailing indexes (the MapOutputStatistics analog feeding adaptive
+        re-planning)."""
+        sizes = [0] * self.n
+        with self._lock:
+            files = list(self._map_files)
+        for path in files:
+            with open(path, "rb") as f:
+                f.seek(-12, os.SEEK_END)
+                idx_off, n = struct.unpack("<QI", f.read(12))
+                f.seek(idx_off)
+                for rp in range(self.n):
+                    off, ln = struct.unpack("<QQ", f.read(16))
+                    sizes[rp] += ln
+        return sizes
+
+    def reduce_batch_slice(self, rpid: int, chunk: int,
+                           nchunks: int) -> Optional[DeviceBatch]:
+        """One byte-balanced block slice of a reduce partition (adaptive
+        skew split: a skewed partition becomes nchunks tasks; only this
+        slice's blocks are read + decoded)."""
+        return self._device_batch(
+            self.read_reduce_partition(rpid, chunk, nchunks))
 
     def reduce_batch(self, rpid: int) -> Optional[DeviceBatch]:
         """Concat this partition's sub-batches on host, one H2D."""
+        return self._device_batch(self.read_reduce_partition(rpid))
+
+    def _device_batch(self, subs) -> Optional[DeviceBatch]:
         import jax
-        subs = self.read_reduce_partition(rpid)
         total = sum(sb.n_rows for sb in subs)
         if total == 0:
             return None

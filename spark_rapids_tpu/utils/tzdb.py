@@ -75,9 +75,14 @@ def _parse_tzif(data: bytes):
         return ver, trans, idx, ttinfo, p
 
     ver, trans, idx, ttinfo, end = parse_block(data, 0, 4)
+    footer = b""
     if ver >= b"2":
         # the v2+ 64-bit block immediately follows the v1 block
-        ver, trans, idx, ttinfo, _ = parse_block(data, end, 8)
+        ver, trans, idx, ttinfo, end2 = parse_block(data, end, 8)
+        # v2/v3 footer: '\n' POSIX-TZ '\n' (RFC 8536 §3.3)
+        tail = data[end2:]
+        if tail.startswith(b"\n"):
+            footer = tail[1:].split(b"\n", 1)[0]
     offs = np.array([ttinfo[i][0] for i in idx], dtype=np.int32) \
         if len(idx) else np.zeros(0, np.int32)
     # initial period: first non-DST type, else type 0 (RFC 8536 §3.2)
@@ -89,7 +94,150 @@ def _parse_tzif(data: bytes):
     else:
         if ttinfo:
             init = ttinfo[0][0]
+    trans, offs = _extend_with_posix_rule(trans, offs, footer.decode(
+        "ascii", "ignore"))
     return trans, offs, init
+
+
+# ---------------------------------------------------------------------
+# POSIX TZ footer: extends rules past the last stored transition (slim
+# zic output stores few explicit transitions and relies on the footer;
+# the reference's GpuTimeZoneDB materializes rules to a max year the
+# same way).
+# ---------------------------------------------------------------------
+_MAX_YEAR = 2100
+
+
+def _parse_posix_offset(s: str, i: int):
+    """[+|-]hh[:mm[:ss]] -> (seconds WEST of UTC per POSIX, next index)"""
+    sign = 1
+    if i < len(s) and s[i] in "+-":
+        sign = -1 if s[i] == "-" else 1
+        i += 1
+    parts = [0, 0, 0]
+    for p in range(3):
+        j = i
+        while j < len(s) and s[j].isdigit():
+            j += 1
+        if j == i:
+            break
+        parts[p] = int(s[i:j])
+        i = j
+        if i < len(s) and s[i] == ":":
+            i += 1
+        else:
+            break
+    return sign * (parts[0] * 3600 + parts[1] * 60 + parts[2]), i
+
+
+def _skip_name(s: str, i: int):
+    if i < len(s) and s[i] == "<":
+        return s.index(">", i) + 1
+    while i < len(s) and not (s[i].isdigit() or s[i] in "+-,"):
+        i += 1
+    return i
+
+
+def _parse_posix_rule(s: str, i: int):
+    """Mm.w.d[/time] or Jn[/time] or n[/time] -> (spec, time_secs, i)"""
+    t = 7200  # default 02:00 local
+    if s[i] == "M":
+        j = i + 1
+        nums = []
+        while len(nums) < 3:
+            k = j
+            while k < len(s) and s[k].isdigit():
+                k += 1
+            nums.append(int(s[j:k]))
+            j = k + 1 if k < len(s) and s[k] == "." else k
+        spec = ("M", nums[0], nums[1], nums[2])
+        i = j
+    elif s[i] == "J":
+        j = i + 1
+        k = j
+        while k < len(s) and s[k].isdigit():
+            k += 1
+        spec = ("J", int(s[j:k]))
+        i = k
+    else:
+        k = i
+        while k < len(s) and s[k].isdigit():
+            k += 1
+        spec = ("n", int(s[i:k]))
+        i = k
+    if i < len(s) and s[i] == "/":
+        t, i = _parse_posix_offset(s, i + 1)
+    return spec, t, i
+
+
+def _rule_day(year: int, spec) -> int:
+    """Days since epoch of the rule date in `year` (local calendar)."""
+    import datetime as _dt
+    if spec[0] == "M":
+        _, m, w, d = spec
+        first = _dt.date(year, m, 1)
+        # day-of-week d (0=Sunday); POSIX week w (5 = last)
+        dow_first = (first.weekday() + 1) % 7  # Monday=0 -> Sunday=0 idx
+        day = 1 + (d - dow_first) % 7 + (w - 1) * 7
+        ndays = ((_dt.date(year + (m == 12), (m % 12) + 1, 1)
+                  - first).days)
+        while day > ndays:
+            day -= 7
+        return (first + _dt.timedelta(days=day - 1)
+                - _dt.date(1970, 1, 1)).days
+    if spec[0] == "J":   # 1-based day, Feb 29 never counted
+        n = spec[1]
+        leap = (year % 4 == 0 and year % 100 != 0) or year % 400 == 0
+        adj = 1 if (leap and n >= 60) else 0
+        return (_dt.date(year, 1, 1) - _dt.date(1970, 1, 1)).days \
+            + n - 1 + adj
+    return (_dt.date(year, 1, 1)
+            - _dt.date(1970, 1, 1)).days + spec[1]
+
+
+def _extend_with_posix_rule(trans, offs, footer: str):
+    """Append footer-rule transitions from after the last stored
+    transition through _MAX_YEAR."""
+    if not footer:
+        return trans, offs
+    try:
+        i = _skip_name(footer, 0)
+        std_off, i = _parse_posix_offset(footer, i)
+        std = -std_off              # POSIX offsets are west-positive
+        if i >= len(footer):        # no DST: constant offset
+            return trans, offs
+        i = _skip_name(footer, i)
+        if i < len(footer) and footer[i] not in ",":
+            dst_off, i = _parse_posix_offset(footer, i)
+            dst = -dst_off
+        else:
+            dst = std + 3600
+        if i >= len(footer) or footer[i] != ",":
+            return trans, offs
+        start_spec, start_t, i = _parse_posix_rule(footer, i + 1)
+        if i >= len(footer) or footer[i] != ",":
+            return trans, offs
+        end_spec, end_t, i = _parse_posix_rule(footer, i + 1)
+    except Exception:
+        return trans, offs
+    import datetime as _dt
+    last = int(trans[-1]) if len(trans) else 0
+    year0 = max(1970, _dt.datetime.fromtimestamp(
+        max(last, 0), tz=_dt.timezone.utc).year)
+    new_t, new_o = [], []
+    for y in range(year0, _MAX_YEAR + 1):
+        # DST start: local standard time -> UTC via std offset
+        t_start = _rule_day(y, start_spec) * 86400 + start_t - std
+        # DST end: local DST time -> UTC via dst offset
+        t_end = _rule_day(y, end_spec) * 86400 + end_t - dst
+        for t, o in sorted([(t_start, dst), (t_end, std)]):
+            if t > last:
+                new_t.append(t)
+                new_o.append(o)
+    if not new_t:
+        return trans, offs
+    return (np.concatenate([trans, np.array(new_t, np.int64)]),
+            np.concatenate([offs, np.array(new_o, np.int32)]))
 
 
 @lru_cache(maxsize=64)

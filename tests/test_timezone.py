@@ -95,3 +95,22 @@ def test_unknown_timezone_rejected():
         {"t": pa.array(TS_US[:1], type=pa.timestamp("us", tz="UTC"))})
     with pytest.raises(Exception, match="[Tt]imezone"):
         df.select(F.from_utc_timestamp(col("t"), "Not/AZone")).to_arrow()
+
+
+def test_posix_footer_future_era():
+    """Offsets after the last stored TZif transition come from the v2+
+    POSIX footer rule (slim zoneinfo stores few explicit transitions)."""
+    import numpy as np
+    from spark_rapids_tpu.utils import tzdb
+    for tz in ("America/New_York", "Australia/Sydney", "Europe/Paris"):
+        t, o = tzdb.load_transitions(tz)
+        for y in (2045, 2090):
+            for m in (1, 4, 7, 11):
+                ts = int(dtm.datetime(y, m, 15, 12,
+                                      tzinfo=UTC).timestamp() * 1e6)
+                idx = np.searchsorted(t, ts, side="right") - 1
+                got = int(o[max(idx, 0)])
+                exp = int(dtm.datetime.fromtimestamp(
+                    ts / 1e6, tz=ZoneInfo(tz))
+                    .utcoffset().total_seconds() * 1e6)
+                assert got == exp, (tz, y, m)
