@@ -30,10 +30,31 @@ def _best(fn, iters):
     return best
 
 
+def _backend_alive(timeout_s: int = 240) -> bool:
+    """Probe default-backend initialization in a SUBPROCESS: a broken TPU
+    tunnel can hang jax.devices() forever, and a hung bench records
+    nothing. On timeout/failure the bench falls back to the CPU backend
+    (still one JSON line, flagged in extra)."""
+    import subprocess
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True)
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     sf = float(os.environ.get("BENCH_SF", "4.0"))
     iters = int(os.environ.get("BENCH_ITERS", "5"))
     plat = os.environ.get("BENCH_PLATFORM")
+    fellback = False
+    if not plat and not _backend_alive():
+        plat = "cpu"
+        fellback = True
+        print("bench: default backend unreachable; falling back to cpu",
+              file=sys.stderr)
     if plat:
         # the axon site package overrides JAX_PLATFORMS; jax.config is the
         # only reliable way to pick a backend for local bench runs
@@ -145,6 +166,8 @@ def main():
             "q3_vs_numpy": round(cpu_q3 / tpu_q3, 3),
             "q6_cold_rows_per_sec": round(n / tpu_q6_cold, 1),
             "q6_cold_s": round(tpu_q6_cold, 3),
+            **({"backend_fallback": "cpu (tpu unreachable)"}
+               if fellback else {}),
         },
     }))
 

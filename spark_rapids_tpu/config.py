@@ -93,6 +93,9 @@ SHUFFLE_WRITER_THREADS = _conf(
 SHUFFLE_READER_THREADS = _conf(
     "shuffle.multiThreaded.reader.threads", 4,
     "Thread pool size for shuffle reads.", int)
+TEXT_BLOCK_SIZE = _conf(
+    "sql.text.blockSize", 32 * 1024 * 1024,
+    "Host decode block size (bytes) for streaming CSV/JSON scans.", int)
 ADAPTIVE_ENABLED = _conf(
     "sql.adaptive.enabled", True,
     "Adaptive post-shuffle re-planning: coalesce small reduce partitions "

@@ -778,35 +778,26 @@ class CollectAggExec(TpuExec):
                 ka += sk.order_keys(kcv, kexpr.dtype, nc)
                 key_arrays.extend(ka)
                 arrays.extend(ka)
-            val_cvs, set_arrays = [], []
-            for a, vnc in zip(self.aggs, vnchunks):
-                if getattr(a, "is_collect", False):
-                    vcv = a.child.emit(ctx)
-                    val_cvs.append(vcv)
-                    if a.is_set:
-                        va = [jnp.logical_not(vcv.validity)
-                              .astype(jnp.uint8)]
-                        va += sk.order_keys(vcv, a.child.dtype, vnc)
-                        set_arrays.append(va)
-                        arrays.extend(va)
-                    else:
-                        set_arrays.append(None)
-                else:
-                    val_cvs.append(None)
-                    set_arrays.append(None)
             perm = sk.lexsort(arrays)
             keys_sorted = [a_[perm] for a_ in key_arrays]
-            boundary = sk.group_boundaries(keys_sorted)
+            dead_sorted = arrays[0][perm]
+            boundary = sk.group_boundaries([dead_sorted] + keys_sorted)
             seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
             live = mask[perm]
             seg_live = jax.ops.segment_max(live.astype(jnp.int32),
                                            seg_ids, cap) > 0
+            if not self.keys:
+                # ungrouped sort-path aggregates (count(DISTINCT x),
+                # median, ...): every live row is one segment; an
+                # all-dead batch still emits row 0 (count 0 / null /
+                # empty list, matching Spark's ungrouped semantics)
+                seg_live = seg_live.at[0].set(True)
             seg_start = jax.ops.segment_min(jnp.arange(cap), seg_ids, cap)
             src_rows = perm[jnp.clip(seg_start, 0, cap - 1)]
             outs = [take(kcv, src_rows, in_bounds=seg_live)
                     for kcv in key_cvs]
-            for a, vcv, sa in zip(self.aggs, val_cvs, set_arrays):
-                if vcv is None:
+            for a, vnc in zip(self.aggs, vnchunks):
+                if not getattr(a, "is_collect", False):
                     cv = (a.child.emit(ctx) if a.child is not None
                           else CV(jnp.zeros(cap, jnp.int8),
                                   jnp.ones(cap, jnp.bool_)))
@@ -819,26 +810,100 @@ class CollectAggExec(TpuExec):
                     v, okv = a.finalize(st)
                     outs.append(CV(v, okv & seg_live))
                     continue
-                vs = take(vcv, perm)          # values in group order
-                keep = live & vs.validity     # Spark collect skips nulls
-                if sa is not None:
-                    # set: the sort grouped equal values adjacently within
-                    # each group; keep only each run's first row
-                    vb = sk.group_boundaries(
-                        keys_sorted + [x[perm] for x in sa])
-                    keep = keep & vb
-                cnt = jax.ops.segment_sum(keep.astype(jnp.int32),
-                                          seg_ids, cap)
-                off = jnp.concatenate([
-                    jnp.zeros(1, jnp.int32),
-                    jnp.cumsum(cnt).astype(jnp.int32)])
-                perm2 = jnp.argsort(jnp.logical_not(keep), stable=True)
-                inb = jnp.arange(cap) < off[cap]
-                child_cv = take(vs, perm2, inb)
-                outs.append(CV(jnp.zeros(0, jnp.int8), seg_live, off,
-                               (child_cv,)))
+                vcv = a.child.emit(ctx)
+                vs = take(vcv, perm)          # values in main (group) order
+                valid = live & vs.validity    # collect family skips nulls
+                if not getattr(a, "is_set", False):
+                    # collect_list: stable main order == input order
+                    outs.append(self._list_output(vs, valid, seg_ids, cap,
+                                                  seg_live))
+                    continue
+                # per-agg SECONDARY sort: (segment, dead, null, value) —
+                # each agg gets its own value ordering, so multiple
+                # sorted aggs on different columns stay independent
+                varrs = [jnp.logical_not(vs.validity).astype(jnp.uint8)]
+                varrs += sk.order_keys(vs, a.child.dtype, vnc)
+                order2 = sk.lexsort(
+                    [seg_ids, jnp.logical_not(live).astype(jnp.uint8)]
+                    + varrs)
+                seg2 = seg_ids[order2]
+                firsts2 = sk.group_boundaries(
+                    [seg2] + [x[order2] for x in varrs])
+                first_flag = jnp.zeros(cap, jnp.bool_).at[order2].set(
+                    firsts2)
+                kind = type(a).__name__
+                if kind in ("CountDistinct", "ApproxCountDistinct"):
+                    keep = valid & first_flag
+                    cnt = jax.ops.segment_sum(keep.astype(jnp.int64),
+                                              seg_ids, cap)
+                    outs.append(CV(cnt, seg_live))
+                elif kind in ("Percentile", "ApproxPercentile", "Median"):
+                    outs.append(self._percentile_output(
+                        a, vs, valid, seg_ids, order2, cap))
+                else:                          # CollectSet
+                    keep = valid & first_flag
+                    outs.append(self._list_output(vs, keep, seg_ids, cap,
+                                                  seg_live))
             return outs, seg_live
         return fn
+
+    @staticmethod
+    def _list_output(vs, keep, seg_ids, cap, seg_live):
+        """Array column from kept rows: per-group counts -> offsets,
+        global stable compaction preserves (group, position) order."""
+        cnt = jax.ops.segment_sum(keep.astype(jnp.int32), seg_ids, cap)
+        off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                               jnp.cumsum(cnt).astype(jnp.int32)])
+        perm2 = jnp.argsort(jnp.logical_not(keep), stable=True)
+        inb = jnp.arange(cap) < off[cap]
+        child_cv = take(vs, perm2, inb)
+        return CV(jnp.zeros(0, jnp.int8), seg_live, off, (child_cv,))
+
+    def _percentile_output(self, a, vs, valid, seg_ids, order2, cap):
+        """Rank-select percentiles from the per-agg value ordering:
+        valid live values of segment g occupy order2 positions
+        [start2[g], start2[g] + nvalid[g]) (dead/null rows sort last
+        within the segment)."""
+        rowpos = jnp.arange(cap, dtype=jnp.int32)
+        seg2 = seg_ids[order2]
+        start2 = jax.ops.segment_min(rowpos, seg2, cap)
+        nvalid = jax.ops.segment_sum(valid.astype(jnp.int32),
+                                     seg_ids, cap)
+        ok_g = nvalid > 0
+        sorted_vals = vs.data[order2]
+        ps = a.percentages
+        k = len(ps)
+
+        def value_at(frac_idx):
+            # frac_idx float per group; interpolate between floor/ceil
+            lo = jnp.floor(frac_idx).astype(jnp.int32)
+            hi = jnp.ceil(frac_idx).astype(jnp.int32)
+            pos_lo = jnp.clip(start2 + lo, 0, cap - 1)
+            pos_hi = jnp.clip(start2 + hi, 0, cap - 1)
+            vlo = sorted_vals[pos_lo]
+            vhi = sorted_vals[pos_hi]
+            if a.interpolate:
+                frac = frac_idx - lo.astype(jnp.float64)
+                return (vlo.astype(jnp.float64) * (1 - frac)
+                        + vhi.astype(jnp.float64) * frac)
+            return vlo
+
+        cols = []
+        for p in ps:
+            if a.interpolate:
+                fi = p * jnp.maximum(nvalid - 1, 0).astype(jnp.float64)
+            else:
+                # Spark discrete: element at ceil(p*n)-1 (1-based rank)
+                fi = jnp.maximum(
+                    jnp.ceil(p * nvalid.astype(jnp.float64)) - 1,
+                    0).astype(jnp.float64)
+            cols.append(value_at(fi))
+        if a.scalar_out:
+            return CV(cols[0], ok_g)
+        data = jnp.stack(cols, axis=1).reshape(-1)   # [cap*k] row-major
+        child = CV(data, jnp.repeat(ok_g, k))
+        off = jnp.arange(cap + 1, dtype=jnp.int32) * k
+        return CV(jnp.zeros(0, jnp.int8), ok_g, off, (child,))
 
     def execute_partition(self, ctx: ExecContext, pid: int):
         m = ctx.metrics_for(self._op_id)

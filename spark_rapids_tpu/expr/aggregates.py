@@ -591,3 +591,94 @@ class CollectSet(_Collect):
 
     def __repr__(self):
         return f"collect_set({self.child})"
+
+
+class CountDistinct(_Collect):
+    """count(DISTINCT x) via the sort path: per-group first-occurrence
+    flags from a segmented value sort (reference: distinct-agg rewrite +
+    cudf distinct count)."""
+
+    is_set = True       # needs per-agg value ordering for dedup
+    is_collect = True
+
+    def _resolve_type(self):
+        from ..columnar import dtypes as _dt
+        if self.child.dtype.is_nested:
+            raise UnsupportedExpr("count distinct over nested input")
+        self.dtype = _dt.INT64
+
+    def __repr__(self):
+        return f"count(DISTINCT {self.child})"
+
+
+class ApproxCountDistinct(CountDistinct):
+    """approx_count_distinct: implemented EXACTLY via the segmented sort
+    (a strict accuracy superset of the reference's HyperLogLog++;
+    the rsd argument is accepted and ignored — see docs/compatibility.md).
+    Reference: GpuHyperLogLogPlusPlus in aggregateFunctions.scala."""
+
+    def __init__(self, child, rsd: float = 0.05):
+        super().__init__(child)
+        self.rsd = rsd
+
+    def __repr__(self):
+        return f"approx_count_distinct({self.child})"
+
+
+class Percentile(_Collect):
+    """percentile / percentile_approx / median over the segmented value
+    sort: values of each group are contiguous and ordered after the
+    secondary sort, so rank selection is one gather
+    (reference: GpuApproximatePercentile's t-digest — here the sort path
+    yields EXACT percentiles, an accuracy superset; the accuracy argument
+    is accepted and ignored)."""
+
+    is_set = True        # percentile needs per-agg value ordering
+    is_collect = True
+    interpolate = True   # percentile(): linear interpolation
+
+    def __init__(self, child, percentages, accuracy: int = 10000):
+        super().__init__(child)
+        self.scalar_out = not isinstance(percentages, (list, tuple))
+        self.percentages = ([float(percentages)] if self.scalar_out
+                            else [float(p) for p in percentages])
+        for p in self.percentages:
+            if not (0.0 <= p <= 1.0):
+                raise ValueError(f"percentage out of [0,1]: {p}")
+        self.accuracy = accuracy
+
+    def bind(self, schema):
+        b = type(self)(self.child.bind(schema), 
+                       (self.percentages[0] if self.scalar_out
+                        else list(self.percentages)), self.accuracy)
+        b._resolve_type()
+        return b
+
+    def _resolve_type(self):
+        from ..columnar import dtypes as _dt
+        ct = self.child.dtype
+        if not ct.is_numeric or (isinstance(ct, _dt.DecimalType)):
+            raise UnsupportedExpr(f"percentile over {ct}")
+        elem = _dt.FLOAT64 if self.interpolate else ct
+        self.dtype = elem if self.scalar_out else _dt.ArrayType(elem)
+
+    def __repr__(self):
+        return f"percentile({self.child}, {self.percentages})"
+
+
+class ApproxPercentile(Percentile):
+    """percentile_approx: returns actual elements (no interpolation),
+    matching Spark's discrete semantics."""
+
+    interpolate = False
+
+    def __repr__(self):
+        return f"percentile_approx({self.child}, {self.percentages})"
+
+
+class Median(Percentile):
+    def __init__(self, child, percentages=0.5, accuracy: int = 10000):
+        super().__init__(child, 0.5, accuracy)
+
+    def __repr__(self):
+        return f"median({self.child})"

@@ -40,7 +40,26 @@ def count(e):
 
 
 def countDistinct(e):
-    raise NotImplementedError("count distinct lands with distinct-agg rewrite")
+    return _agg.CountDistinct(_to_expr(e))
+
+
+count_distinct = countDistinct
+
+
+def approx_count_distinct(e, rsd: float = 0.05):
+    return _agg.ApproxCountDistinct(_to_expr(e), rsd)
+
+
+def percentile(e, percentages, frequency=1):
+    return _agg.Percentile(_to_expr(e), percentages)
+
+
+def percentile_approx(e, percentages, accuracy: int = 10000):
+    return _agg.ApproxPercentile(_to_expr(e), percentages, accuracy)
+
+
+def median(e):
+    return _agg.Median(_to_expr(e))
 
 
 def min(e):  # noqa: A001

@@ -17,7 +17,7 @@ from ..expr import aggregates as agg
 
 __all__ = ["LogicalPlan", "InMemoryScan", "CachedScan", "ParquetScan", "Project", "Filter",
            "Aggregate", "Join", "Sort", "SortOrder", "Limit", "Union",
-           "Repartition", "WindowOp", "Generate"]
+           "Repartition", "WindowOp", "Generate", "TextScan"]
 
 
 class LogicalPlan:
@@ -98,6 +98,40 @@ class ParquetScan(LogicalPlan):
 
     def describe(self):
         return f"ParquetScan[{len(self.paths)} files] {self._schema}"
+
+
+class TextScan(LogicalPlan):
+    """Lazy CSV / JSON-lines / ORC scan (reference: GpuCSVScan.scala:57,
+    GpuJsonScan.scala, GpuOrcScan.scala:78). Schema comes from metadata or
+    a first-block sample; decode happens per batch at execution."""
+
+    def __init__(self, paths: Sequence[str], fmt: str,
+                 schema: Optional[Schema] = None, columns=None,
+                 options=None):
+        from ..exec.text_scan import infer_text_schema
+        self.children = []
+        self.paths = list(paths)
+        self.fmt = fmt
+        self.columns = list(columns) if columns else None
+        self.options = options
+        if schema is not None and not isinstance(schema, Schema):
+            schema = Schema.from_arrow(schema)   # accept pyarrow schemas
+        self._full_schema = schema or infer_text_schema(
+            self.paths[0], fmt, options)
+        if self.columns is not None:
+            want = set(self.columns)
+            self._schema = Schema([f for f in self._full_schema.fields
+                                   if f.name in want])
+        else:
+            self._schema = self._full_schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        cols = f", columns={self.columns}" if self.columns else ""
+        return f"TextScan[{self.fmt}, {len(self.paths)} files{cols}]"
 
 
 class Project(LogicalPlan):

@@ -66,6 +66,14 @@ def prune(plan: L.LogicalPlan,
             if len(names) < len(plan.schema.fields):
                 return L.ParquetScan(plan.paths, columns=names)
         return plan
+    if isinstance(plan, L.TextScan):
+        if required is not None:
+            names = [f.name for f in plan.schema.fields
+                     if f.name in required]
+            if len(names) < len(plan.schema.fields):
+                return L.TextScan(plan.paths, plan.fmt, plan._full_schema,
+                                  names, plan.options)
+        return plan
     if isinstance(plan, L.Project):
         exprs = plan.exprs
         if required is not None:

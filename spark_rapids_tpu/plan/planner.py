@@ -111,6 +111,16 @@ def _pq(meta, conv, conf):
     return scan
 
 
+@_rule(L.TextScan)
+def _textscan(meta, conv, conf):
+    from ..exec.text_scan import (AvroScanExec, CsvScanExec,
+                                  JsonScanExec, OrcScanExec)
+    n = meta.node
+    cls = {"csv": CsvScanExec, "json": JsonScanExec,
+           "orc": OrcScanExec, "avro": AvroScanExec}[n.fmt]
+    return cls(n.paths, n._full_schema, n.columns, n.options)
+
+
 @_rule(L.Project)
 def _project(meta, conv, conf):
     child = conv(meta.children[0])
@@ -188,8 +198,10 @@ def _agg(meta, conv, conf):
     has_collect = any(getattr(a, "is_collect", False) for a in aggs)
     if not n.keys:
         if has_collect:
-            raise UnsupportedExpr(
-                "collect_list/collect_set require GROUP BY (round 2)")
+            # ungrouped sort-path aggregates (count distinct, median,
+            # collect_*): single-segment CollectAggExec
+            return agg_exec.CollectAggExec(child, [], [], names, aggs,
+                                           n.schema)
         return agg_exec.UngroupedAggExec(child, names, aggs, n.schema)
     key_names = [k.name for k in n.keys]
     if has_collect:

@@ -111,6 +111,14 @@ for _n in ("MapKeys", "MapValues"):
 for _n in ("ArrayMin", "ArrayMax"):
     register(_n, TypeSig(dt.ArrayType),
              "numeric/temporal elements; decimal p<=18")
+for _n in ("CountDistinct", "ApproxCountDistinct"):
+    register(_n, ALL_COMMON,
+             "exact distinct count via segmented sort (accuracy superset "
+             "of HLL++)")
+for _n in ("Percentile", "ApproxPercentile", "Median"):
+    register(_n, INTEGRAL + FLOATING,
+             "exact rank selection via segmented sort (accuracy superset "
+             "of t-digest)")
 for _n in ("CollectList", "CollectSet"):
     register(_n, ALL_COMMON,
              "aggregate -> array<T>; requires GROUP BY (sort-collect)")

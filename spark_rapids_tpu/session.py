@@ -79,24 +79,47 @@ class DataFrameReader:
         return DataFrame(self._session,
                          L.ParquetScan(expanded, columns=columns))
 
-    def csv(self, path: str, header=True, schema=None) -> "DataFrame":
-        from .io.csv import read_csv_to_arrow
-        at = read_csv_to_arrow(path, header=header, schema=schema)
-        return DataFrame(self._session, L.InMemoryScan(at))
+    def csv(self, *paths: str, header=True, schema=None, delimiter=",",
+            quote='"', escape="\\", comment=None,
+            null_value="") -> "DataFrame":
+        """Lazy streaming CSV scan (reference: GpuCSVScan.scala:57);
+        schema from a first-block sample unless given."""
+        from .exec.text_scan import CsvOptions
+        opts = CsvOptions(header=header, delimiter=delimiter, quote=quote,
+                          escape=escape, comment=comment,
+                          null_value=null_value)
+        return DataFrame(self._session,
+                         L.TextScan(list(paths), "csv", schema,
+                                    options=opts))
 
-    def orc(self, path: str) -> "DataFrame":
-        from pyarrow import orc as _orc
-        at = _orc.read_table(path)
-        return DataFrame(self._session, L.InMemoryScan(at))
+    def orc(self, *paths: str) -> "DataFrame":
+        """Lazy stripe-streaming ORC scan (reference: GpuOrcScan.scala:78
+        PERFILE reader)."""
+        return DataFrame(self._session, L.TextScan(list(paths), "orc"))
+
+    def avro(self, *paths: str) -> "DataFrame":
+        """Lazy block-streaming Avro scan (reference: GpuAvroScan)."""
+        return DataFrame(self._session, L.TextScan(list(paths), "avro"))
+
+    def iceberg(self, path: str, snapshot_id=None,
+                as_of_timestamp=None) -> "DataFrame":
+        """Iceberg table read: metadata json -> manifest list -> manifests
+        -> live parquet files (reference: the iceberg module's
+        GpuIcebergParquetScan); supports snapshot time travel."""
+        from .io.iceberg import read_iceberg
+        return read_iceberg(self._session, path, snapshot_id,
+                            as_of_timestamp)
 
     def delta(self, path: str, version=None) -> "DataFrame":
         from .io.delta import read_delta
         return read_delta(self._session, path, version)
 
-    def json(self, path: str, schema=None) -> "DataFrame":
-        from .io.json_io import read_json_to_arrow
-        at = read_json_to_arrow(path, schema=schema)
-        return DataFrame(self._session, L.InMemoryScan(at))
+    def json(self, *paths: str, schema=None) -> "DataFrame":
+        """Lazy block-streaming JSON-lines scan (reference:
+        GpuJsonScan.scala); schema from a first-block sample unless
+        given."""
+        return DataFrame(self._session,
+                         L.TextScan(list(paths), "json", schema))
 
 
 class GroupedData:
@@ -397,3 +420,23 @@ class DataFrame:
     def write_delta(self, path: str, mode: str = "append") -> int:
         from .io.delta import write_delta
         return write_delta(self, path, mode)
+
+    @property
+    def write(self):
+        """Builder-style writer: df.write.mode(...).partitionBy(...)
+        .parquet/orc/csv/json/hive_text/delta(path) (reference:
+        GpuFileFormatWriter surface)."""
+        from .io.writer import DataFrameWriter
+        return DataFrameWriter(self)
+
+    def _iter_partition_tables(self):
+        """Stream the result partition-by-partition as compacted host
+        arrow tables (shared by every file writer)."""
+        import pyarrow as pa
+        from .exec.nodes import _batch_to_arrow
+        root, ctx = self._execute()
+        for pid in range(root.num_partitions(ctx)):
+            tables = [_batch_to_arrow(b)
+                      for b in root.execute_partition(ctx, pid)]
+            if tables:
+                yield pa.concat_tables(tables)
