@@ -331,3 +331,32 @@ def to_arrow(dt: DataType):
     if isinstance(dt, MapType):
         return pa.map_(to_arrow(dt.key), to_arrow(dt.value))
     raise TypeError(f"unsupported dtype: {dt}")
+
+
+_NAME_TO_DTYPE = {
+    "boolean": BOOL, "bool": BOOL,
+    "byte": INT8, "tinyint": INT8,
+    "short": INT16, "smallint": INT16,
+    "int": INT32, "integer": INT32,
+    "long": INT64, "bigint": INT64,
+    "float": FLOAT32, "real": FLOAT32,
+    "double": FLOAT64,
+    "string": STRING, "binary": BINARY,
+    "date": DATE, "timestamp": TIMESTAMP,
+}
+
+
+def from_name(name: str) -> DataType:
+    """Resolve a Spark SQL type name ('int', 'bigint', 'decimal(10,2)',
+    ...) to a DataType."""
+    t = name.strip().lower()
+    if t in _NAME_TO_DTYPE:
+        return _NAME_TO_DTYPE[t]
+    if t.startswith("decimal"):
+        inner = t[len("decimal"):].strip()
+        if not inner:
+            return DecimalType(10, 0)
+        if inner.startswith("(") and inner.endswith(")"):
+            p, _, s = inner[1:-1].partition(",")
+            return DecimalType(int(p), int(s or 0))
+    raise ValueError(f"unknown type name {name!r}")

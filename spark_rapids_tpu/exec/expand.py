@@ -1,0 +1,90 @@
+"""Expand: GROUPING SETS / ROLLUP / CUBE row expansion.
+
+(reference: GpuExpandExec.scala — each input row is emitted once per
+projection list.) TPU-first: all grouping-set projections are computed in
+ONE jitted program and laid out as contiguous blocks of the (static)
+output capacity n_sets * cap; excluded keys are the key column with its
+validity zeroed (no per-row branching), and the grouping-id column is a
+block-constant fill. The aggregation above groups by
+(keys..., grouping_id) so subtotal rows can't merge with genuine-null
+detail rows.
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..columnar.table import Schema
+from ..expr.expressions import EmitCtx, Expression
+from ..ops.concat import concat_cvs, concat_masks
+from ..ops.kernel_utils import CV
+from .base import ExecContext, TpuExec
+from .batch import DeviceBatch
+from .nodes import make_table
+
+__all__ = ["ExpandExec"]
+
+
+class ExpandExec(TpuExec):
+    def __init__(self, child: TpuExec, bound_keys: Sequence[Expression],
+                 include_masks: Sequence[Sequence[bool]], schema: Schema):
+        super().__init__([child], schema)
+        self.bound_keys = list(bound_keys)
+        self.include_masks = [tuple(m) for m in include_masks]
+        nk = len(self.bound_keys)
+        # Spark grouping_id: bit (nk-1-i) set when key i is EXCLUDED
+        self.gids = [
+            sum((0 if inc else 1) << (nk - 1 - i)
+                for i, inc in enumerate(m)) for m in self.include_masks]
+        child_dts = [f.dtype for f in child.schema.fields]
+        key_dts = [k.dtype for k in self.bound_keys]
+
+        def _run(cvs, mask):
+            cap = mask.shape[0]
+            ctx = EmitCtx(list(cvs), cap)
+            kcvs = [k.emit(ctx) for k in self.bound_keys]
+            n_sets = len(self.include_masks)
+            out = []
+            for i, cv in enumerate(cvs):
+                out.append(concat_cvs([cv] * n_sets, child_dts[i]))
+            for i, kcv in enumerate(kcvs):
+                # excluded sets get an all-null column with ZEROED
+                # buffers: grouping normalizes on (data, validity), so
+                # stale data under null would split subtotal groups
+                null_cv = CV(
+                    jnp.zeros_like(kcv.data), jnp.zeros(cap, jnp.bool_),
+                    None if kcv.offsets is None
+                    else jnp.zeros_like(kcv.offsets),
+                    kcv.children)
+                parts = [kcv if m[i] else null_cv
+                         for m in self.include_masks]
+                out.append(concat_cvs(parts, key_dts[i]))
+            gid = jnp.concatenate([jnp.full(cap, g, jnp.int64)
+                                   for g in self.gids])
+            out.append(CV(gid, jnp.ones(cap * n_sets, jnp.bool_)))
+            out_mask = concat_masks([mask] * n_sets)
+            return out, out_mask
+
+        self._jit = jax.jit(_run)
+
+    def describe(self):
+        return (f"ExpandExec[{len(self.include_masks)} sets, "
+                f"keys={[k.name for k in self.bound_keys]}]")
+
+    def num_partitions(self, ctx):
+        return self.children[0].num_partitions(ctx)
+
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        m = ctx.metrics_for(self._op_id)
+        n_sets = len(self.include_masks)
+        for batch in self.children[0].execute_partition(ctx, pid):
+            with m.timer("opTime"):
+                out, out_mask = self._jit(batch.cvs(), batch.row_mask)
+            num = (n_sets - 1) * batch.capacity + batch.num_rows
+            m.add("numOutputBatches", 1)
+            m.add("numOutputRows", batch.num_rows * n_sets)
+            yield DeviceBatch(
+                make_table(self.schema, out, num), num, out_mask,
+                batch.capacity * n_sets)

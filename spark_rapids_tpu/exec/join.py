@@ -43,23 +43,44 @@ from .base import ExecContext, TpuExec
 from .batch import DeviceBatch
 from .nodes import make_table
 
-__all__ = ["HashJoinExec"]
+__all__ = ["HashJoinExec", "NestedLoopJoinExec"]
+
+
+
+def _null_cvs(fields, cap):
+    """All-null columns for outer-join extension rows (flat dtypes;
+    nested children TODO alongside nested outer-join payload support)."""
+    out = []
+    for f in fields:
+        np_dt = f.dtype.np_dtype or jnp.int8
+        out.append(CV(jnp.zeros(cap, np_dt), jnp.zeros(cap, jnp.bool_),
+                      jnp.zeros(cap + 1, jnp.int32)
+                      if f.dtype.is_variable_width else None))
+    return out
 
 
 class HashJoinExec(TpuExec):
     def __init__(self, left: TpuExec, right: TpuExec,
                  bound_left_keys: Sequence[Expression],
                  bound_right_keys: Sequence[Expression], how: str,
-                 schema: Schema, per_partition: bool = False):
+                 schema: Schema, per_partition: bool = False,
+                 condition: Optional[Expression] = None):
         """per_partition: both children are hash-partitioned on the join
         keys (exchanges below us), so each partition joins independently —
         the distributed shuffled-join topology (reference:
-        GpuShuffledHashJoinExec.scala:167)."""
+        GpuShuffledHashJoinExec.scala:167).
+
+        condition: extra non-equi predicate bound over the COMBINED
+        (left ++ right) schema, evaluated on candidate pairs after the
+        equi-key expansion (the reference compiles these to cudf AST
+        expressions, AstUtil.scala; here the expression fuses into the
+        pair-evaluation program)."""
         super().__init__([left, right], schema)
         self.lkeys = list(bound_left_keys)
         self.rkeys = list(bound_right_keys)
         self.how = how
         self.per_partition = per_partition
+        self.condition = condition
         self._count_cache = {}
         self._expand_cache = {}
 
@@ -343,14 +364,7 @@ class HashJoinExec(TpuExec):
             n_un = fetch_int((jnp.sum(unmatched)))
             if n_un > 0:
                 # emit unmatched build rows with null left columns
-                out_cvs = []
-                for f in left.schema.fields:
-                    np_dt = f.dtype.np_dtype or jnp.int8
-                    cv = CV(jnp.zeros(cap_b, np_dt),
-                            jnp.zeros(cap_b, jnp.bool_),
-                            jnp.zeros(cap_b + 1, jnp.int32)
-                            if f.dtype.is_variable_width else None)
-                    out_cvs.append(cv)
+                out_cvs = _null_cvs(left.schema.fields, cap_b)
                 out_cvs += [CV(cv.data, cv.validity & unmatched, cv.offsets)
                             for cv in bcvs]
                 tbl = make_table(self.schema, out_cvs, cap_b)
@@ -377,7 +391,8 @@ class HashJoinExec(TpuExec):
                  touched) = pfn(sorted_ukey, n_valid_b, skey_cvs[0],
                                 smask)
                 perm = bperm
-                if self.how in ("right", "full"):
+                if self.how in ("right", "full") and \
+                        self.condition is None:
                     yield ("matched_b", self._matched_from_touched(
                         bperm, touched, n_valid_b,
                         jnp.zeros(cap_b, jnp.bool_)))
@@ -391,8 +406,14 @@ class HashJoinExec(TpuExec):
                     self._count_cache[ckey] = cfn
                 (cnt, offsets, total, bstart, perm,
                  matched_b) = cfn(bkey_cvs, bmask, skey_cvs, smask)
-                if self.how in ("right", "full"):
+                if self.how in ("right", "full") and \
+                        self.condition is None:
                     yield ("matched_b", matched_b)
+            if self.condition is not None:
+                yield from self._probe_cond(m, batch, scvs, smask, cap_s,
+                                            bcvs, cap_b, cnt, offsets,
+                                            total, bstart, perm)
+                return
             if self.how == "left_semi":
                 yield ("batch", DeviceBatch(batch.table, batch.num_rows,
                                             smask & (cnt > 0), cap_s))
@@ -427,6 +448,55 @@ class HashJoinExec(TpuExec):
                                     jnp.arange(out_cap) < n_out, out_cap))
 
     # ------------------------------------------------------------------
+    def _probe_cond(self, m, batch, scvs, smask, cap_s, bcvs, cap_b,
+                    cnt, offsets, total, bstart, perm):
+        """Conditional-join path: expand pure candidate pairs from the
+        equi keys, evaluate the bound non-equi condition on the gathered
+        pair columns, then derive per-stream-row and per-build-row match
+        state from the PASSING pairs only. Outer-side null extension uses
+        seg_matched, not the raw candidate counts."""
+        n_out = fetch_int(total)
+        seg_matched = jnp.zeros(cap_s, jnp.bool_)
+        if n_out > 0:
+            out_cap = bucket_capacity(n_out)
+            ekey = (out_cap, cap_b, cap_s, False)
+            efn = self._expand_cache.get(ekey)
+            if efn is None:
+                efn = jax.jit(self._expand_fn(out_cap, cap_b, False))
+                self._expand_cache[ekey] = efn
+            lg, rg, lvalid, rvalid, _ = efn(cnt, offsets, bstart, perm,
+                                            smask)
+            lcols = self._gather_cols(scvs, lg, lvalid)
+            rcols = self._gather_cols(bcvs, rg, rvalid)
+            cctx = EmitCtx(lcols + rcols, out_cap)
+            ccv = self.condition.emit(cctx)
+            pass_ = (lvalid & rvalid & ccv.validity
+                     & ccv.data.astype(jnp.bool_))
+            seg_matched = seg_matched.at[lg].max(pass_)
+            if self.how in ("right", "full"):
+                mb = jnp.zeros(cap_b, jnp.bool_).at[rg].max(pass_)
+                yield ("matched_b", mb)
+            if self.how not in ("left_semi", "left_anti"):
+                tbl = make_table(self.schema, lcols + rcols, n_out)
+                m.add("numOutputRows", n_out)
+                m.add("numOutputBatches", 1)
+                yield ("batch", DeviceBatch(tbl, n_out, pass_, out_cap))
+        if self.how == "left_semi":
+            yield ("batch", DeviceBatch(batch.table, batch.num_rows,
+                                        smask & seg_matched, cap_s))
+        elif self.how == "left_anti":
+            yield ("batch", DeviceBatch(batch.table, batch.num_rows,
+                                        smask & ~seg_matched, cap_s))
+        elif self.how in ("left", "full"):
+            # stream rows with no PASSING pair -> one null-extended row
+            null_mask = smask & ~seg_matched
+            out_cvs = list(batch.cvs()) + _null_cvs(
+                self.children[1].schema.fields, cap_s)
+            tbl = make_table(self.schema, out_cvs, batch.num_rows)
+            yield ("batch", DeviceBatch(tbl, batch.num_rows, null_mask,
+                                        cap_s))
+
+    # ------------------------------------------------------------------
     def _execute_cross(self, ctx: ExecContext):
         m = ctx.metrics_for(self._op_id)
         left, right = self.children
@@ -454,3 +524,96 @@ class HashJoinExec(TpuExec):
                 tbl = make_table(self.schema, out_cvs, n_out)
                 m.add("numOutputRows", n_out)
                 yield DeviceBatch(tbl, n_out, inb, out_cap)
+
+
+class NestedLoopJoinExec(HashJoinExec):
+    """Broadcast nested-loop join: no equi keys, arbitrary condition
+    (reference: GpuBroadcastNestedLoopJoinExecBase.scala). The build side
+    is collected once; each stream batch crosses against it in bounded
+    chunks (stream-slice x full build), the condition evaluates on the
+    gathered pair columns, and outer/semi/anti semantics derive from the
+    passing pairs exactly as in the conditional hash join."""
+
+    _CHUNK_TARGET = 1 << 20
+
+    def __init__(self, left: TpuExec, right: TpuExec, how: str,
+                 schema: Schema, condition: Expression):
+        super().__init__(left, right, [], [], how, schema,
+                         condition=condition)
+
+    def describe(self):
+        return f"NestedLoopJoinExec[{self.how}]"
+
+    def num_partitions(self, ctx):
+        return 1
+
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        m = ctx.metrics_for(self._op_id)
+        left, right = self.children
+        with m.timer("buildTime"):
+            bcvs, bmask = self._collect_side(ctx, right, [])
+            cap_b = bmask.shape[0]
+            bidx = jnp.nonzero(bmask, size=cap_b, fill_value=0)[0]
+            n_b = fetch_int(jnp.sum(bmask))
+        matched_b_acc = jnp.zeros(cap_b, jnp.bool_)
+        right_fields = right.schema.fields
+        for lpid in range(left.num_partitions(ctx)):
+            for batch in left.execute_partition(ctx, lpid):
+                scvs, smask = batch.cvs(), batch.row_mask
+                cap_s = batch.capacity
+                sidx = jnp.nonzero(smask, size=cap_s, fill_value=0)[0]
+                n_s = fetch_int(jnp.sum(smask))
+                seg_matched = jnp.zeros(cap_s, jnp.bool_)
+                if n_b > 0 and n_s > 0:
+                    chunk = max(1, self._CHUNK_TARGET // max(n_b, 1))
+                    for s0 in range(0, n_s, chunk):
+                        k = min(chunk, n_s - s0)
+                        n_out = k * n_b
+                        out_cap = bucket_capacity(n_out)
+                        with m.timer("opTime"):
+                            t = jnp.arange(out_cap)
+                            li = sidx[jnp.clip(s0 + t // n_b, 0,
+                                               cap_s - 1)].astype(
+                                jnp.int32)
+                            ri = bidx[jnp.clip(t % n_b, 0,
+                                               cap_b - 1)].astype(
+                                jnp.int32)
+                            inb = t < n_out
+                            lcols = self._gather_cols(scvs, li, inb)
+                            rcols = self._gather_cols(bcvs, ri, inb)
+                            cctx = EmitCtx(lcols + rcols, out_cap)
+                            ccv = self.condition.emit(cctx)
+                            pass_ = (inb & ccv.validity
+                                     & ccv.data.astype(jnp.bool_))
+                            seg_matched = seg_matched.at[li].max(pass_)
+                            if self.how in ("right", "full"):
+                                matched_b_acc = \
+                                    matched_b_acc.at[ri].max(pass_)
+                        if self.how not in ("left_semi", "left_anti"):
+                            tbl = make_table(self.schema, lcols + rcols,
+                                             n_out)
+                            m.add("numOutputBatches", 1)
+                            yield DeviceBatch(tbl, n_out, pass_, out_cap)
+                if self.how == "left_semi":
+                    yield DeviceBatch(batch.table, batch.num_rows,
+                                      smask & seg_matched, cap_s)
+                elif self.how == "left_anti":
+                    yield DeviceBatch(batch.table, batch.num_rows,
+                                      smask & ~seg_matched, cap_s)
+                elif self.how in ("left", "full"):
+                    null_mask = smask & ~seg_matched
+                    out_cvs = list(batch.cvs()) + _null_cvs(
+                        right_fields, cap_s)
+                    tbl = make_table(self.schema, out_cvs,
+                                     batch.num_rows)
+                    yield DeviceBatch(tbl, batch.num_rows, null_mask,
+                                      cap_s)
+        if self.how in ("right", "full"):
+            unmatched = bmask & ~matched_b_acc
+            n_un = fetch_int(jnp.sum(unmatched))
+            if n_un > 0:
+                out_cvs = _null_cvs(left.schema.fields, cap_b)
+                out_cvs += [CV(cv.data, cv.validity & unmatched,
+                               cv.offsets) for cv in bcvs]
+                tbl = make_table(self.schema, out_cvs, cap_b)
+                yield DeviceBatch(tbl, cap_b, unmatched, cap_b)

@@ -30,7 +30,97 @@ from .base import ExecContext, TpuExec
 from .batch import DeviceBatch
 from .nodes import make_table
 
-__all__ = ["WindowExec"]
+__all__ = ["WindowExec", "spec_signature"]
+
+
+def spec_signature(spec):
+    """Hashable (partition keys, orders) identity — frame excluded: one
+    sort serves every frame over the same keys (the reference's window
+    stage-splitting criterion, GpuWindowExecMeta.scala:182)."""
+    return (tuple(repr(k) for k in spec.partition_keys),
+            tuple((repr(o.expr), o.ascending, o.nulls_first)
+                  for o in spec.orders))
+
+
+def _floor_log2(length):
+    """floor(log2(length)) for positive int lengths — pure integer binary
+    reduction (frexp's s64 bitcast doesn't compile under the TPU x64
+    rewrite)."""
+    L = length.astype(jnp.int64)
+    j = jnp.zeros_like(L)
+    for b in (32, 16, 8, 4, 2, 1):
+        big = L >= (jnp.int64(1) << b)
+        j = j + jnp.where(big, b, 0)
+        L = jnp.where(big, L >> b, L)
+    return j.astype(jnp.int32)
+
+
+def _rmq(x, valid, lo, hi, is_min: bool, nlev: int):
+    """Range min/max over [lo, hi] per row via a sparse table (doubling):
+    T[j][i] = reduce(x[i .. i+2^j-1]). nlev bounds table height (and
+    memory, nlev*cap) to ceil(log2(max window length))+1. Invalid slots
+    carry the identity; returns (reduced, any_valid)."""
+    cap = x.shape[0]
+    ident = _ident_of(x.dtype, is_min)
+    red = jnp.minimum if is_min else jnp.maximum
+    v = jnp.where(valid, x, ident)
+    ok = valid
+    levels, oks = [v], [ok]
+    cur, curok = v, ok
+    for j in range(1, nlev):
+        sh = 1 << (j - 1)
+        if sh >= cap:
+            levels.append(cur)
+            oks.append(curok)
+            continue
+        shifted = jnp.concatenate([cur[sh:], jnp.full((sh,), ident,
+                                                      cur.dtype)])
+        shok = jnp.concatenate([curok[sh:],
+                                jnp.zeros(sh, jnp.bool_)])
+        cur = red(cur, shifted)
+        curok = curok | shok
+        levels.append(cur)
+        oks.append(curok)
+    T = jnp.stack(levels)                       # (nlev, cap)
+    TO = jnp.stack(oks)
+    length = jnp.maximum(hi - lo + 1, 1)
+    j = jnp.clip(_floor_log2(length), 0, nlev - 1)
+    a_idx = jnp.clip(lo, 0, cap - 1)
+    b_idx = jnp.clip(hi - (1 << j.astype(jnp.int64)) + 1, 0, cap - 1)
+    flatT, flatO = T.reshape(-1), TO.reshape(-1)
+    ja = j.astype(jnp.int64) * cap
+    va = flatT[ja + a_idx]
+    vb = flatT[ja + b_idx]
+    oa = flatO[ja + a_idx] | flatO[ja + b_idx]
+    out = red(va, vb)
+    nonempty = hi >= lo
+    return out, oa & nonempty
+
+
+def _bsearch(skey, q, lo0, hi0, nbits: int, left: bool,
+             descending: bool):
+    """Per-row binary search over the (segment-)sorted key array: returns
+    the first index in [lo0, hi0) whose key is >= q (left) or > q (right),
+    under the given sort direction. All rows search concurrently with
+    row-local bounds — the static-shape XLA answer to per-partition
+    scans."""
+    cap = skey.shape[0]
+
+    def body(_, state):
+        lo, hi = state
+        mid = (lo + hi) // 2
+        kv = skey[jnp.clip(mid, 0, cap - 1)]
+        if descending:
+            below = (kv > q) if left else (kv >= q)
+        else:
+            below = (kv < q) if left else (kv <= q)
+        active = lo < hi
+        new_lo = jnp.where(active & below, mid + 1, lo)
+        new_hi = jnp.where(active & ~below, mid, hi)
+        return new_lo, new_hi
+
+    lo, _ = jax.lax.fori_loop(0, nbits + 1, body, (lo0, hi0))
+    return lo
 
 
 def _seg_scan_minmax(vals, valid, boundary, is_min: bool):
@@ -59,12 +149,12 @@ class WindowExec(TpuExec):
         self.names = list(names)
         self.wexprs = list(wexprs)
         spec = self.wexprs[0].spec
+        sig = spec_signature(spec)
         for w in self.wexprs[1:]:
-            if (len(w.spec.partition_keys) != len(spec.partition_keys)
-                    or len(w.spec.orders) != len(spec.orders)):
+            if spec_signature(w.spec) != sig:
                 raise UnsupportedExpr(
-                    "multiple window specs in one select: split into "
-                    "separate selects (planner staging lands later)")
+                    "one WindowExec handles one (partition, order) spec; "
+                    "the planner stages differing specs into a chain")
         self.spec = spec
         self._jit_cache = {}
 
@@ -104,32 +194,130 @@ class WindowExec(TpuExec):
         seg_start = jax.ops.segment_min(pos, seg_ids, cap)[seg_ids]
         seg_cnt = jax.ops.segment_sum(jnp.ones(cap, jnp.int64), seg_ids,
                                       cap)
-        seg_end = seg_start + seg_cnt[seg_ids] - 1
+        cnt_row = seg_cnt[seg_ids]
+        seg_end = seg_start + cnt_row - 1
         pos_in_seg = pos - seg_start
-        # order-value change boundaries (for rank/dense_rank)
+        # order-value change boundaries (for rank/dense_rank/peer frames)
         ob = pb | sk.group_boundaries(
             [a[perm] for a in arrays + pk_arrays + ok_arrays])
+        peer_ids = jnp.cumsum(ob.astype(jnp.int32)) - 1
+        peer_start = jax.ops.segment_min(pos, peer_ids, cap)[peer_ids]
+        peer_end = jax.ops.segment_max(pos, peer_ids, cap)[peer_ids]
+        # sorted first order key (range-offset frames search over it).
+        # Integer keys widen to int64 BEFORE the null sentinel is applied
+        # so a genuine key near the narrow dtype's domain edge can never
+        # reach the sentinel via q = key + offset.
+        skey = None
+        if okeys and okeys[0].offsets is None:
+            o0 = self.spec.orders[0]
+            kcv = take(okeys[0], perm, in_bounds=live)
+            kdata = kcv.data
+            if jnp.issubdtype(kdata.dtype, jnp.integer) \
+                    and kdata.dtype != jnp.int64:
+                kdata = kdata.astype(jnp.int64)
+            sentinel = _ident_of(
+                kdata.dtype,
+                for_min=(o0.nulls_first != o0.ascending))
+            skey = (jnp.where(kcv.validity & live, kdata, sentinel),
+                    kcv.validity & live)
 
+        wctx = dict(perm=perm, live=live, pb=pb, ob=ob, seg_ids=seg_ids,
+                    seg_start=seg_start, seg_end=seg_end, pos=pos,
+                    pos_in_seg=pos_in_seg, cnt_row=cnt_row,
+                    peer_start=peer_start, peer_end=peer_end, skey=skey,
+                    cap=cap)
         outs = []
         for w in self.wexprs:
-            outs.append(self._one(w, ctx, perm, live, pb, ob, seg_ids,
-                                  seg_start, seg_end, pos, pos_in_seg, cap))
+            outs.append(self._one(w, ctx, wctx))
         sorted_cols = [take(cv, perm, in_bounds=live) for cv in cvs]
         return sorted_cols, outs, live
 
-    def _one(self, w: WindowExpr, ctx, perm, live, pb, ob, seg_ids,
-             seg_start, seg_end, pos, pos_in_seg, cap):
-        always = jnp.ones(cap, jnp.bool_)
+    def _frame_bounds(self, w: WindowExpr, wc):
+        """Resolve the frame to per-row [lo, hi] index bounds over the
+        sorted layout. None return values mean the natural segment bound
+        (used to pick fast paths). Returns (lo, hi, max_len)."""
+        k, m_ = w.spec.frame
+        mode = w.spec.frame_mode
+        seg_start, seg_end = wc["seg_start"], wc["seg_end"]
+        pos, cap = wc["pos"], wc["cap"]
+        if mode == "rows":
+            lo = (seg_start if k is UNBOUNDED
+                  else jnp.maximum(pos + k, seg_start))
+            hi = (seg_end if m_ is UNBOUNDED
+                  else jnp.minimum(pos + m_, seg_end))
+            max_len = (cap if (k is UNBOUNDED or m_ is UNBOUNDED)
+                       else max(int(m_) - int(k) + 1, 1))
+            return lo, hi, max_len
+        # RANGE frame: CURRENT_ROW bounds land on the peer group; numeric
+        # offsets binary-search the (single, numeric) sorted order key
+        def side(bound, is_lo):
+            if bound is UNBOUNDED:
+                return seg_start if is_lo else seg_end
+            if bound == 0:
+                return wc["peer_start"] if is_lo else wc["peer_end"]
+            if wc["skey"] is None or len(w.spec.orders) != 1:
+                raise UnsupportedExpr(
+                    "RANGE offset frames need exactly one numeric "
+                    "order key")
+            skey, skvalid = wc["skey"]
+            o0 = w.spec.orders[0]
+            desc = not o0.ascending
+            off = -bound if desc else bound
+            if jnp.issubdtype(skey.dtype, jnp.integer):
+                # key already widened to int64 in _compute; saturate at
+                # the int64 domain edges so key+offset can't wrap
+                q = skey + int(off)
+                if off >= 0:
+                    q = jnp.where(q < skey, jnp.iinfo(jnp.int64).max, q)
+                else:
+                    q = jnp.where(q > skey, jnp.iinfo(jnp.int64).min, q)
+            else:
+                q = skey + off
+            nbits = max(1, int(cap).bit_length())
+            idx = _bsearch(skey, q, seg_start.astype(jnp.int64),
+                           (seg_end + 1).astype(jnp.int64), nbits,
+                           left=is_lo, descending=desc)
+            if not is_lo:
+                idx = idx - 1
+            # null-key rows frame = their peer (null) group
+            return jnp.where(skvalid, idx,
+                             wc["peer_start"] if is_lo else wc["peer_end"])
+        return side(k, True), side(m_, False), wc["cap"]
+
+    def _one(self, w: WindowExpr, ctx, wc):
+        live, cap = wc["live"], wc["cap"]
+        pos, pos_in_seg = wc["pos"], wc["pos_in_seg"]
+        seg_start, seg_end = wc["seg_start"], wc["seg_end"]
+        seg_ids, pb, ob = wc["seg_ids"], wc["pb"], wc["ob"]
+        perm, cnt_row = wc["perm"], wc["cnt_row"]
         if w.fn == "row_number":
             return CV((pos_in_seg + 1).astype(jnp.int32), live)
-        if w.fn == "rank":
+        if w.fn in ("rank", "percent_rank"):
             last_ob = jax.lax.associative_scan(jnp.maximum,
                                                jnp.where(ob, pos, -1))
-            return CV((last_ob - seg_start + 1).astype(jnp.int32), live)
+            rk = (last_ob - seg_start + 1).astype(jnp.int64)
+            if w.fn == "rank":
+                return CV(rk.astype(jnp.int32), live)
+            denom = jnp.maximum(cnt_row - 1, 1).astype(jnp.float64)
+            pr = jnp.where(cnt_row > 1,
+                           (rk - 1).astype(jnp.float64) / denom, 0.0)
+            return CV(pr, live)
         if w.fn == "dense_rank":
             c2 = jnp.cumsum(ob.astype(jnp.int32))
             base = c2[jnp.clip(seg_start, 0, cap - 1)]
             return CV((c2 - base + 1).astype(jnp.int32), live)
+        if w.fn == "cume_dist":
+            frac = ((wc["peer_end"] - seg_start + 1).astype(jnp.float64)
+                    / cnt_row.astype(jnp.float64))
+            return CV(frac, live)
+        if w.fn == "ntile":
+            n = w.offset
+            q, r = cnt_row // n, cnt_row % n
+            big = r * (q + 1)
+            bucket = jnp.where(
+                pos_in_seg < big, pos_in_seg // jnp.maximum(q + 1, 1),
+                r + (pos_in_seg - big) // jnp.maximum(q, 1))
+            return CV((bucket + 1).astype(jnp.int32), live)
 
         cv = w.child.emit(ctx)
         scv = take(cv, perm, in_bounds=live)
@@ -146,8 +334,21 @@ class WindowExec(TpuExec):
                          jnp.where(in_seg, out.validity, True) & live)
             return out
 
+        if w.fn in ("first_value", "last_value", "nth_value"):
+            lo, hi, _ = self._frame_bounds(w, wc)
+            if w.fn == "first_value":
+                idx = lo
+            elif w.fn == "last_value":
+                idx = hi
+            else:
+                idx = lo + w.offset - 1
+            ok = live & (idx >= lo) & (idx <= hi) & (hi >= lo)
+            return take(scv, jnp.clip(idx, 0, cap - 1).astype(jnp.int32),
+                        in_bounds=ok)
+
         valid = scv.validity & live
         frame = w.spec.frame
+        mode = w.spec.frame_mode
         if scv.offsets is not None:
             raise UnsupportedExpr(f"window {w.fn} over strings")
         x = scv.data
@@ -173,25 +374,29 @@ class WindowExec(TpuExec):
             return self._finish(w, s, c, live)
 
         if frame == (UNBOUNDED, CURRENT_ROW):
+            # running aggregate; in range mode the frame extends to the
+            # end of the peer group (Spark default-frame tie semantics)
+            at = (wc["peer_end"] if mode == "range" else pos)
             if w.fn in ("min", "max"):
-                s = _seg_scan_minmax(x, valid, pb, w.fn == "min")
-                c = _running(vz, seg_start)
+                s = _seg_scan_minmax(x, valid, pb, w.fn == "min")[at]
+                c = _running(vz, seg_start)[at]
                 return self._finish(w, s, c, live)
-            s = _running(xz, seg_start)
-            c = _running(vz, seg_start)
+            s = _running(xz, seg_start)[at]
+            c = _running(vz, seg_start)[at]
             return self._finish(w, s, c, live)
 
-        # bounded rows frame (-k .. m) via prefix sums
-        k, m_ = frame
+        # general bounded frame: resolve [lo, hi] row bounds, then prefix
+        # sums (sum/count/avg) or sparse-table RMQ (min/max)
+        lo, hi, max_len = self._frame_bounds(w, wc)
         if w.fn in ("min", "max"):
-            raise UnsupportedExpr("bounded min/max window lands with the "
-                                  "doubling scan")
+            import math
+            nlev = max(1, int(math.ceil(math.log2(
+                max(2, min(max_len, cap))))) + 1)
+            s, ok = _rmq(x, valid, lo, hi, w.fn == "min", nlev)
+            c = jnp.where(ok, 1, 0)
+            return self._finish(w, s, c, live)
         pre = jnp.cumsum(xz)
         prev = jnp.cumsum(vz)
-        lo = seg_start if k is UNBOUNDED else jnp.maximum(pos + k,
-                                                          seg_start)
-        hi = seg_end if m_ is UNBOUNDED else jnp.minimum(pos + m_,
-                                                         seg_end)
         lo_idx = jnp.clip(lo - 1, 0, cap - 1)
         s = pre[jnp.clip(hi, 0, cap - 1)] - jnp.where(lo > 0,
                                                       pre[lo_idx], 0)

@@ -819,8 +819,10 @@ class Cast(Expression):
     """Spark CAST. Full string<->numeric semantics live in ops/cast.py;
     numeric/temporal casts are inline here."""
 
-    def __init__(self, child: Expression, to: dt.DataType, ansi=False):
+    def __init__(self, child: Expression, to, ansi=False):
         self.child = child
+        if isinstance(to, str):
+            to = dt.from_name(to)   # pyspark-style .cast("bigint")
         self.to = to
         self.ansi = ansi
         self.children = [child]

@@ -339,4 +339,178 @@ def host_output_dtype(expr: Expression) -> Optional[dt.DataType]:
     name = type(expr).__name__
     if name == "Alias":
         return host_output_dtype(expr.children[0])
+    hd = getattr(expr, "host_dtype", None)
+    if hd is not None:
+        return hd
+    if name == "Cast":
+        return expr.to
     return _DTYPE_HINTS.get(name)
+
+
+# -- JSON / URL expressions (expr/json_exprs.py) -----------------------
+import json as _json
+
+
+@_rule("GetJsonObject")
+def _get_json_object(e, cv, env):
+    s = cv[0]
+    if s is None:
+        return None
+    from .json_exprs import render_json_value, walk_json_path
+    try:
+        obj = _json.loads(s)
+    except (ValueError, TypeError):
+        return None
+    matches = walk_json_path(obj, e.steps)
+    if not matches:
+        return None
+    if len(matches) == 1:
+        return render_json_value(matches[0])
+    return _json.dumps(matches, separators=(",", ":"))
+
+
+def _coerce_json(v, dtype):
+    if v is None:
+        return None
+    if isinstance(dtype, dt.StructType):
+        if not isinstance(v, dict):
+            return None
+        return {f.name: _coerce_json(v.get(f.name), f.dtype)
+                for f in dtype.fields}
+    if isinstance(dtype, dt.ArrayType):
+        if not isinstance(v, list):
+            return None
+        return [_coerce_json(x, dtype.element) for x in v]
+    if isinstance(dtype, dt.MapType):
+        if not isinstance(v, dict):
+            return None
+        return {str(k): _coerce_json(x, dtype.value)
+                for k, x in v.items()}
+    try:
+        if isinstance(dtype, dt.StringType):
+            return v if isinstance(v, str) else _json.dumps(v)
+        if isinstance(dtype, dt.BooleanType):
+            return v if isinstance(v, bool) else None
+        if isinstance(dtype, (dt.ByteType, dt.ShortType, dt.IntegerType,
+                              dt.LongType)):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                return None
+            return int(v)
+        if isinstance(dtype, (dt.FloatType, dt.DoubleType)):
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                return None
+            return float(v)
+    except (ValueError, TypeError, OverflowError):
+        return None
+    return None
+
+
+@_rule("FromJson")
+def _from_json(e, cv, env):
+    s = cv[0]
+    if s is None:
+        return None
+    try:
+        obj = _json.loads(s)
+    except (ValueError, TypeError):
+        return None
+    return _coerce_json(obj, e.host_dtype)
+
+
+def _jsonable(v):
+    import datetime
+    import decimal
+    if isinstance(v, dict):
+        return {k: _jsonable(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_jsonable(x) for x in v]
+    if isinstance(v, decimal.Decimal):
+        return float(v)
+    if isinstance(v, (datetime.date, datetime.datetime)):
+        return v.isoformat()
+    return v
+
+
+@_rule("ToJson")
+def _to_json(e, cv, env):
+    v = cv[0]
+    if v is None:
+        return None
+    return _json.dumps(_jsonable(v), separators=(",", ":"))
+
+
+@_rule("ParseUrl")
+def _parse_url(e, cv, env):
+    s = cv[0]
+    if s is None:
+        return None
+    from urllib.parse import urlparse
+    try:
+        u = urlparse(s)
+    except ValueError:
+        return None
+    part = e.part
+    if part == "QUERY" and e.key is not None:
+        # Spark extracts the RAW value with (&|^)key=([^&]*) — no URL
+        # decoding, empty string preserved
+        mt = _re.search(r"(?:^|&)" + _re.escape(e.key) + r"=([^&]*)",
+                        u.query)
+        return mt.group(1) if mt else None
+    if part == "HOST":
+        return u.hostname
+    if part == "PATH":
+        return u.path or ""
+    if part == "QUERY":
+        return u.query or None
+    if part == "REF":
+        return u.fragment or None
+    if part == "PROTOCOL":
+        return u.scheme or None
+    if part == "FILE":
+        return (u.path or "") + (f"?{u.query}" if u.query else "")
+    if part == "AUTHORITY":
+        return u.netloc or None
+    if part == "USERINFO":
+        if u.username is None and u.password is None:
+            return None
+        return (u.username or "") + (f":{u.password}"
+                                     if u.password is not None else "")
+    return None
+
+
+@_rule("Cast")
+def _cast(e, cv, env):
+    """Host-side Spark CAST over Python values (the common scalar
+    matrix; string->number trims, failures -> null)."""
+    v = cv[0]
+    if v is None:
+        return None
+    to = e.to
+    try:
+        if isinstance(to, dt.StringType):
+            if isinstance(v, bool):
+                return "true" if v else "false"
+            return str(v)
+        if isinstance(to, dt.BooleanType):
+            if isinstance(v, str):
+                t = v.strip().lower()
+                if t in ("t", "true", "y", "yes", "1"):
+                    return True
+                if t in ("f", "false", "n", "no", "0"):
+                    return False
+                return None
+            return bool(v)
+        if isinstance(to, (dt.ByteType, dt.ShortType, dt.IntegerType,
+                           dt.LongType)):
+            if isinstance(v, str):
+                t = v.strip()
+                try:
+                    return int(t)    # exact for integral strings
+                except ValueError:
+                    return int(float(t))
+            return int(v)
+        if isinstance(to, (dt.FloatType, dt.DoubleType)):
+            return float(v.strip() if isinstance(v, str) else v)
+    except (ValueError, TypeError, OverflowError):
+        return None
+    raise UnsupportedExpr(f"host cast to {to} not implemented")

@@ -1,0 +1,185 @@
+"""JSON + URL expressions — host-bridge evaluated.
+
+(reference: GpuGetJsonObject.scala / GpuJsonToStructs.scala /
+GpuStructsToJson.scala via JNI JSONUtils; GpuParseUrl.scala via JNI
+ParseURI.) Byte-level JSON/URI parsing is the reference's hand-written
+CUDA kernel territory; here these expressions deliberately route through
+the CPU bridge (exec/host_fallback.py) — bind() raises UnsupportedExpr,
+the planner keeps the unbound tree, and rows evaluate on host between
+device stages. Correctness-first; a Pallas byte-parser can replace the
+host path later without API changes.
+"""
+from __future__ import annotations
+
+import json
+from typing import List, Optional, Tuple
+
+from ..columnar import dtypes as dt
+from .expressions import Expression, UnsupportedExpr, _wrap
+
+__all__ = ["GetJsonObject", "FromJson", "ToJson", "ParseUrl",
+           "parse_json_path"]
+
+
+def parse_json_path(path: str) -> List[Tuple[str, object]]:
+    """Parse a Spark get_json_object path ($.a.b[0]['c'][*]) into steps:
+    ("field", name) | ("index", i) | ("wild", None)."""
+    if not path or path[0] != "$":
+        raise ValueError(f"JSON path must start with $: {path!r}")
+    steps: List[Tuple[str, object]] = []
+    i = 1
+    n = len(path)
+    while i < n:
+        c = path[i]
+        if c == ".":
+            j = i + 1
+            while j < n and path[j] not in ".[":
+                j += 1
+            name = path[i + 1:j]
+            if name == "*":
+                steps.append(("wild", None))
+            elif name:
+                steps.append(("field", name))
+            else:
+                raise ValueError(f"empty field in path {path!r}")
+            i = j
+        elif c == "[":
+            j = path.index("]", i)
+            tok = path[i + 1:j].strip()
+            if tok == "*":
+                steps.append(("wild", None))
+            elif tok.startswith(("'", '"')) and tok.endswith(tok[0]):
+                steps.append(("field", tok[1:-1]))
+            else:
+                steps.append(("index", int(tok)))
+            i = j + 1
+        else:
+            raise ValueError(f"bad JSON path at {i}: {path!r}")
+    return steps
+
+
+def walk_json_path(obj, steps):
+    """Apply parsed steps; returns a list of matches (wildcards fan
+    out)."""
+    cur = [obj]
+    for kind, arg in steps:
+        nxt = []
+        for o in cur:
+            if kind == "field":
+                if isinstance(o, dict) and arg in o:
+                    nxt.append(o[arg])
+                elif isinstance(o, list):
+                    # Spark: a field step over an array maps over elems
+                    for e in o:
+                        if isinstance(e, dict) and arg in e:
+                            nxt.append(e[arg])
+            elif kind == "index":
+                if isinstance(o, list) and -len(o) <= arg < len(o):
+                    nxt.append(o[arg])
+            else:  # wild
+                if isinstance(o, list):
+                    nxt.extend(o)
+                elif isinstance(o, dict):
+                    nxt.extend(o.values())
+        cur = nxt
+        if not cur:
+            return []
+    return cur
+
+
+def render_json_value(v) -> str:
+    """Jackson-style rendering: bare scalars unquoted, containers as
+    compact JSON."""
+    if isinstance(v, str):
+        return v
+    if isinstance(v, bool):
+        return "true" if v else "false"
+    if v is None:
+        return None
+    if isinstance(v, (int, float)):
+        return json.dumps(v)
+    return json.dumps(v, separators=(",", ":"))
+
+
+class _HostOnlyExpr(Expression):
+    """Expression that always routes to the CPU bridge."""
+
+    _reason = "host-bridge expression"
+
+    def bind(self, schema):
+        raise UnsupportedExpr(self._reason)
+
+
+class GetJsonObject(_HostOnlyExpr):
+    _reason = "get_json_object runs on the CPU bridge"
+    host_dtype = dt.STRING
+
+    def __init__(self, child: Expression, path: str):
+        self.children = [_wrap(child)]
+        self.path = path
+        self.steps = parse_json_path(path)
+
+    @property
+    def name(self):
+        return f"get_json_object({self.children[0].name}, {self.path})"
+
+    def __repr__(self):
+        return f"get_json_object({self.children[0]!r}, {self.path!r})"
+
+
+class FromJson(_HostOnlyExpr):
+    _reason = "from_json runs on the CPU bridge"
+
+    def __init__(self, child: Expression, schema: dt.DataType):
+        if not isinstance(schema, (dt.StructType, dt.ArrayType,
+                                   dt.MapType)):
+            raise ValueError("from_json needs a struct/array/map dtype")
+        self.children = [_wrap(child)]
+        self.host_dtype = schema
+
+    @property
+    def name(self):
+        return f"from_json({self.children[0].name})"
+
+    def __repr__(self):
+        return f"from_json({self.children[0]!r}, {self.host_dtype})"
+
+
+class ToJson(_HostOnlyExpr):
+    _reason = "to_json runs on the CPU bridge"
+    host_dtype = dt.STRING
+
+    def __init__(self, child: Expression):
+        self.children = [_wrap(child)]
+
+    @property
+    def name(self):
+        return f"to_json({self.children[0].name})"
+
+    def __repr__(self):
+        return f"to_json({self.children[0]!r})"
+
+
+_URL_PARTS = ("HOST", "PATH", "QUERY", "REF", "PROTOCOL", "FILE",
+              "AUTHORITY", "USERINFO")
+
+
+class ParseUrl(_HostOnlyExpr):
+    _reason = "parse_url runs on the CPU bridge"
+    host_dtype = dt.STRING
+
+    def __init__(self, child: Expression, part: str,
+                 key: Optional[str] = None):
+        if part not in _URL_PARTS:
+            raise ValueError(f"parse_url part must be one of "
+                             f"{_URL_PARTS}, got {part!r}")
+        self.children = [_wrap(child)]
+        self.part = part
+        self.key = key
+
+    @property
+    def name(self):
+        return f"parse_url({self.children[0].name}, {self.part})"
+
+    def __repr__(self):
+        return f"parse_url({self.children[0]!r}, {self.part!r})"

@@ -524,3 +524,87 @@ def from_utc_timestamp(e, tz: str):
 
 def to_utc_timestamp(e, tz: str):
     return _de.ToUTCTimestamp(_to_expr(e), tz)
+
+
+# -- window functions (pyspark-style re-exports) -----------------------
+def row_number():
+    from . import window as _w
+    return _w.row_number()
+
+
+def rank():
+    from . import window as _w
+    return _w.rank()
+
+
+def dense_rank():
+    from . import window as _w
+    return _w.dense_rank()
+
+
+def percent_rank():
+    from . import window as _w
+    return _w.percent_rank()
+
+
+def cume_dist():
+    from . import window as _w
+    return _w.cume_dist()
+
+
+def ntile(n: int):
+    from . import window as _w
+    return _w.ntile(n)
+
+
+def lag(e, offset: int = 1, default=None):
+    from . import window as _w
+    return _w.lag(_to_expr(e), offset, default)
+
+
+def lead(e, offset: int = 1, default=None):
+    from . import window as _w
+    return _w.lead(_to_expr(e), offset, default)
+
+
+def first_value(e):
+    from . import window as _w
+    return _w.first_value(_to_expr(e))
+
+
+def last_value(e):
+    from . import window as _w
+    return _w.last_value(_to_expr(e))
+
+
+def nth_value(e, n: int):
+    from . import window as _w
+    return _w.nth_value(_to_expr(e), n)
+
+
+def grouping_id():
+    """Marker for rollup/cube agg lists: the grouping-set id column
+    (reference: Spark grouping_id / GpuExpandExec projections)."""
+    from .session import GroupingID
+    return GroupingID()
+
+
+# -- JSON / URL --------------------------------------------------------
+def get_json_object(e, path: str):
+    from .expr.json_exprs import GetJsonObject
+    return GetJsonObject(_to_expr(e), path)
+
+
+def from_json(e, schema):
+    from .expr.json_exprs import FromJson
+    return FromJson(_to_expr(e), schema)
+
+
+def to_json(e):
+    from .expr.json_exprs import ToJson
+    return ToJson(_to_expr(e))
+
+
+def parse_url(e, part: str, key=None):
+    from .expr.json_exprs import ParseUrl
+    return ParseUrl(_to_expr(e), part, key)

@@ -15,7 +15,7 @@ from ..columnar.table import Schema, Field
 from ..expr.expressions import Alias, Expression, ColumnRef
 from ..expr import aggregates as agg
 
-__all__ = ["LogicalPlan", "InMemoryScan", "CachedScan", "ParquetScan", "Project", "Filter",
+__all__ = ["LogicalPlan", "InMemoryScan", "CachedScan", "ParquetScan", "Project", "Filter", "Expand",
            "Aggregate", "Join", "Sort", "SortOrder", "Limit", "Union",
            "Repartition", "WindowOp", "Generate", "TextScan"]
 
@@ -219,10 +219,40 @@ class Aggregate(LogicalPlan):
                 f"aggs={[n for n, _ in self.aggs]}]")
 
 
+class Expand(LogicalPlan):
+    """GROUPING SETS expansion feeding an Aggregate (reference:
+    GpuExpandExec.scala). Output = child columns ++ grouping-key columns
+    (validity dropped where a set excludes the key) ++ grouping_id."""
+
+    def __init__(self, child: LogicalPlan, key_exprs: Sequence[Expression],
+                 key_names: Sequence[str], include_masks, gid_name: str):
+        self.child = child
+        self.children = [child]
+        self.key_exprs = list(key_exprs)
+        self.key_names = list(key_names)
+        self.include_masks = [tuple(m) for m in include_masks]
+        self.gid_name = gid_name
+        self.bound_keys = [k.bind(child.schema) for k in self.key_exprs]
+        fields = list(child.schema.fields)
+        fields += [Field(n, k.dtype)
+                   for n, k in zip(self.key_names, self.bound_keys)]
+        fields.append(Field(gid_name, dt.INT64))
+        self._schema = Schema(fields)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return (f"Expand[{len(self.include_masks)} sets, "
+                f"keys={self.key_names}]")
+
+
 class Join(LogicalPlan):
     def __init__(self, left: LogicalPlan, right: LogicalPlan,
                  left_keys: Sequence[Expression],
-                 right_keys: Sequence[Expression], how: str = "inner"):
+                 right_keys: Sequence[Expression], how: str = "inner",
+                 condition: Optional[Expression] = None):
         assert how in ("inner", "left", "right", "full", "left_semi",
                        "left_anti", "cross")
         self.left, self.right = left, right
@@ -235,6 +265,11 @@ class Join(LogicalPlan):
                                  for k in self.right_keys]
         lf = list(left.schema.fields)
         rf = list(right.schema.fields)
+        # non-equi condition binds over the COMBINED schema (the
+        # reference's AST-compiled join conditions, AstUtil.scala)
+        self.condition = condition
+        self.bound_condition = (condition.bind(Schema(lf + rf))
+                                if condition is not None else None)
         if how in ("left_semi", "left_anti"):
             fields = lf
         else:

@@ -120,13 +120,18 @@ def prune(plan: L.LogicalPlan,
         rnames = set(plan.right.schema.names)
         lkr = _refs_of_all(plan.left_keys)
         rkr = _refs_of_all(plan.right_keys)
+        ckr = (_refs_of_all([plan.condition])
+               if plan.condition is not None else set())
         lreq = rreq = None
         if (required is not None and lkr is not None and rkr is not None
-                and not (lnames & rnames)):
-            lreq = {n for n in required if n in lnames} | lkr
-            rreq = {n for n in required if n in rnames} | rkr
+                and ckr is not None and not (lnames & rnames)):
+            lreq = ({n for n in required if n in lnames} | lkr
+                    | (ckr & lnames))
+            rreq = ({n for n in required if n in rnames} | rkr
+                    | (ckr & rnames))
         return L.Join(prune(plan.left, lreq), prune(plan.right, rreq),
-                      plan.left_keys, plan.right_keys, plan.how)
+                      plan.left_keys, plan.right_keys, plan.how,
+                      condition=plan.condition)
     if isinstance(plan, L.WindowOp):
         return L.WindowOp(prune(plan.child, None), plan.wcols)
     if isinstance(plan, L.Repartition):
@@ -151,7 +156,7 @@ def _rebuild(plan: L.LogicalPlan, kids) -> L.LogicalPlan:
         return L.Union(kids)
     if isinstance(plan, L.Join):
         return L.Join(kids[0], kids[1], plan.left_keys, plan.right_keys,
-                      plan.how)
+                      plan.how, condition=plan.condition)
     if isinstance(plan, L.WindowOp):
         return L.WindowOp(kids[0], plan.wcols)
     if isinstance(plan, L.Repartition):
@@ -220,12 +225,13 @@ def push_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
                 return L.Join(
                     push_filters(L.Filter(child.left, plan.condition)),
                     child.right, child.left_keys, child.right_keys,
-                    child.how)
+                    child.how, condition=child.condition)
             if refs <= rnames and child.how in ("inner", "right"):
                 return L.Join(
                     child.left,
                     push_filters(L.Filter(child.right, plan.condition)),
-                    child.left_keys, child.right_keys, child.how)
+                    child.left_keys, child.right_keys, child.how,
+                    condition=child.condition)
     return plan
 
 

@@ -184,6 +184,14 @@ def _make_hash_exchange(child, bound_keys, conf):
                                bound_keys, child.schema)
 
 
+@_rule(L.Expand)
+def _expand(meta, conv, conf):
+    from ..exec.expand import ExpandExec
+    n = meta.node
+    return ExpandExec(conv(meta.children[0]), n.bound_keys,
+                      n.include_masks, n.schema)
+
+
 @_rule(L.Aggregate)
 def _agg(meta, conv, conf):
     from ..config import MESH_DEVICES, SHUFFLE_PARTITIONS
@@ -342,6 +350,20 @@ def _join(meta, conv, conf):
     equi = (n.how != "cross" and n.bound_left_keys
             and all(lk.dtype == rk.dtype for lk, rk in
                     zip(n.bound_left_keys, n.bound_right_keys)))
+    cond = n.bound_condition
+    if not equi and cond is not None:
+        if n.bound_left_keys:
+            # equi keys exist but are unusable (dtype mismatch): refusing
+            # beats silently joining on the residual condition alone
+            raise UnsupportedExpr(
+                "equi-join keys have mismatched types "
+                f"{[(lk.dtype, rk.dtype) for lk, rk in zip(n.bound_left_keys, n.bound_right_keys)]}; "
+                "cast both sides to a common type")
+        # no equi keys: broadcast nested-loop join on the condition
+        # (GpuBroadcastNestedLoopJoinExecBase analog)
+        from ..exec.join import NestedLoopJoinExec
+        how = "inner" if n.how == "cross" else n.how
+        return NestedLoopJoinExec(left, right, how, n.schema, cond)
     if mesh_n > 1 and equi and not broadcast_ok:
         # big build: hash-exchange both sides on the join keys over the
         # mesh, then each shard joins its co-partitioned slice
@@ -353,7 +375,7 @@ def _join(meta, conv, conf):
                                right.schema)
         return HashJoinExec(lex, rex, n.bound_left_keys,
                             n.bound_right_keys, n.how, n.schema,
-                            per_partition=True)
+                            per_partition=True, condition=cond)
     if mesh_n <= 1 and equi and not broadcast_ok and est is not None:
         # single-host big-build join: file-shuffle both sides so each
         # partition's build slice is bounded (sized-join analog)
@@ -376,19 +398,49 @@ def _join(meta, conv, conf):
             rread, _ = _aqe_wrap(rex, conf, plan=plan, role="build")
             return HashJoinExec(lread, rread, n.bound_left_keys,
                                 n.bound_right_keys, n.how, n.schema,
-                                per_partition=True)
+                                per_partition=True, condition=cond)
     # broadcast hash join: build side collected once, stream partitions
     # probe it (GpuBroadcastHashJoinExecBase analog)
     return HashJoinExec(left, right, n.bound_left_keys,
-                        n.bound_right_keys, n.how, n.schema)
+                        n.bound_right_keys, n.how, n.schema,
+                        condition=cond)
 
 
 @_rule(L.WindowOp)
 def _window(meta, conv, conf):
-    from ..exec.window import WindowExec
+    """Stage window expressions: one WindowExec per distinct
+    (partition, order) spec, chained — each appends its columns; a final
+    projection restores the requested column order (the reference splits
+    the same way, GpuWindowExecMeta.scala:182)."""
+    from ..columnar.table import Field, Schema
+    from ..exec.window import WindowExec, spec_signature
     n = meta.node
-    return WindowExec(conv(meta.children[0]), [nm for nm, _ in n.bound],
-                      [w for _, w in n.bound], n.schema)
+    groups = {}
+    for nm, w in n.bound:
+        groups.setdefault(spec_signature(w.spec), []).append((nm, w))
+    child = conv(meta.children[0])
+    if len(groups) == 1:
+        return WindowExec(child, [nm for nm, _ in n.bound],
+                          [w for _, w in n.bound], n.schema)
+    cur = child
+    cur_fields = list(meta.children[0].node.schema.fields)
+    nchild = len(cur_fields)
+    appended = {}
+    for cols in groups.values():
+        out_fields = cur_fields + [Field(nm, w.dtype) for nm, w in cols]
+        for j, (nm, _) in enumerate(cols):
+            appended[nm] = len(cur_fields) + j
+        cur = WindowExec(cur, [nm for nm, _ in cols],
+                         [w for _, w in cols], Schema(out_fields))
+        cur_fields = out_fields
+    # reorder appended columns back to request order
+    from ..exec.nodes import ProjectExec
+    from ..expr.expressions import BoundRef
+    refs = ([BoundRef(i, f.dtype, f.name)
+             for i, f in enumerate(n.schema.fields[:nchild])]
+            + [BoundRef(appended[f.name], f.dtype, f.name)
+               for f in n.schema.fields[nchild:]])
+    return ProjectExec(cur, refs, n.schema)
 
 
 @_rule(L.Generate)

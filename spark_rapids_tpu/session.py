@@ -122,20 +122,88 @@ class DataFrameReader:
                          L.TextScan(list(paths), "json", schema))
 
 
+def _split_join_condition(expr, lschema, rschema):
+    """Decompose a join-on expression: (left_keys, right_keys, residual).
+    Top-level AND conjuncts of the form left_expr == right_expr become
+    equi keys; everything else stays in the residual non-equi condition
+    (the reference's extraction in GpuHashJoin + AstUtil)."""
+    from .expr.expressions import And, ColumnRef, Eq
+
+    lnames, rnames = set(lschema.names), set(rschema.names)
+
+    def refs(e):
+        out = set()
+        stack = [e]
+        while stack:
+            x_ = stack.pop()
+            if isinstance(x_, ColumnRef):
+                out.add(x_._name if hasattr(x_, "_name") else x_.name)
+            stack.extend(getattr(x_, "children", []))
+        return out
+
+    def side(e):
+        r = refs(e)
+        if r and r <= lnames and not (r & rnames):
+            return "left"
+        if r and r <= rnames and not (r & lnames):
+            return "right"
+        return None
+
+    def conjuncts(e):
+        if isinstance(e, And):
+            return conjuncts(e.children[0]) + conjuncts(e.children[1])
+        return [e]
+
+    lkeys, rkeys, residual = [], [], None
+    for c in conjuncts(expr):
+        if isinstance(c, Eq):
+            a, b = c.children
+            sa, sb = side(a), side(b)
+            if sa == "left" and sb == "right":
+                lkeys.append(a)
+                rkeys.append(b)
+                continue
+            if sa == "right" and sb == "left":
+                lkeys.append(b)
+                rkeys.append(a)
+                continue
+        residual = c if residual is None else (residual & c)
+    return lkeys, rkeys, residual
+
+
+class GroupingID:
+    """Marker accepted in rollup/cube agg lists: resolves to the Spark
+    grouping_id of the row's grouping set."""
+
+    name = "grouping_id()"
+
+    def alias(self, name):
+        from .expr.expressions import Alias
+        return Alias(self, name)
+
+
 class GroupedData:
-    def __init__(self, df: "DataFrame", keys: Sequence[Expression]):
+    def __init__(self, df: "DataFrame", keys: Sequence[Expression],
+                 grouping_sets=None):
         self._df = df
         self._keys = list(keys)
+        # list of include-masks (one bool per key) or None for plain
+        # GROUP BY; reference: GpuExpandExec.scala projections
+        self._grouping_sets = grouping_sets
 
     def agg(self, *aggs, **named_aggs) -> "DataFrame":
         pairs = []
+        gid_cols = []
+        from .expr.expressions import Alias
         for a in aggs:
             name = getattr(a, "_alias", None) or a.name
             inner = a
-            from .expr.expressions import Alias
             if isinstance(a, Alias):
                 name = a._name
                 inner = a.child
+            if isinstance(inner, GroupingID):
+                gid_cols.append(name)
+                continue
             if not isinstance(inner, AggExpr):
                 raise TypeError(f"not an aggregate: {a!r}")
             pairs.append((name, inner))
@@ -143,14 +211,39 @@ class GroupedData:
             inner = a.child if hasattr(a, "child") and not isinstance(
                 a, AggExpr) else a
             pairs.append((name, inner))
-        return DataFrame(self._df._session,
-                         L.Aggregate(self._df._plan, self._keys, pairs))
+        if self._grouping_sets is None:
+            if gid_cols:
+                raise ValueError("grouping_id() requires rollup/cube/"
+                                 "grouping_sets")
+            return DataFrame(self._df._session,
+                             L.Aggregate(self._df._plan, self._keys,
+                                         pairs))
+        return self._agg_grouping_sets(pairs, gid_cols)
+
+    def _agg_grouping_sets(self, pairs, gid_cols) -> "DataFrame":
+        """ROLLUP/CUBE/GROUPING SETS: Expand (one block per set, excluded
+        keys nulled, + grouping_id) then aggregate by
+        (keys..., grouping_id), then project user columns."""
+        from .expr.expressions import Alias, ColumnRef
+        child = self._df._plan
+        knames = [f"#gset_k{i}" for i in range(len(self._keys))]
+        gid = "#gset_gid"
+        expand = L.Expand(child, self._keys, knames,
+                          self._grouping_sets, gid)
+        gkeys = [ColumnRef(kn) for kn in knames] + [ColumnRef(gid)]
+        agg_node = L.Aggregate(expand, gkeys, pairs)
+        out = []
+        for k, kn in zip(self._keys, knames):
+            out.append(Alias(ColumnRef(kn), k.name))
+        for nm, _ in pairs:
+            out.append(ColumnRef(nm))
+        for nm in gid_cols:
+            out.append(Alias(ColumnRef(gid), nm))
+        return DataFrame(self._df._session, L.Project(agg_node, out))
 
     def count(self) -> "DataFrame":
         from .expr.aggregates import CountStar
-        return DataFrame(self._df._session,
-                         L.Aggregate(self._df._plan, self._keys,
-                                     [("count", CountStar())]))
+        return self.agg(CountStar().alias("count"))
 
 
 class DataFrame:
@@ -237,17 +330,72 @@ class DataFrame:
 
     groupBy = group_by
 
+    def rollup(self, *keys) -> GroupedData:
+        """GROUP BY ROLLUP: (k1..kn), (k1..kn-1), ..., ()."""
+        ks = [_to_expr(k) for k in keys]
+        n = len(ks)
+        sets = [[i < j for i in range(n)] for j in range(n, -1, -1)]
+        return GroupedData(self, ks, grouping_sets=sets)
+
+    def cube(self, *keys) -> GroupedData:
+        """GROUP BY CUBE: all 2^n key subsets."""
+        ks = [_to_expr(k) for k in keys]
+        n = len(ks)
+        sets = [[not (m >> (n - 1 - i)) & 1 == 1 for i in range(n)]
+                for m in range(1 << n)]
+        return GroupedData(self, ks, grouping_sets=sets)
+
+    def grouping_sets(self, keys, sets) -> GroupedData:
+        """Explicit GROUPING SETS: `sets` is a list of key-name lists
+        (subsets of `keys`)."""
+        ks = [_to_expr(k) for k in keys]
+        names = [k.name for k in ks]
+        masks = []
+        for s_ in sets:
+            want = set(s_)
+            unknown = want - set(names)
+            if unknown:
+                raise ValueError(f"grouping set refers to unknown keys "
+                                 f"{sorted(unknown)}")
+            masks.append([nm in want for nm in names])
+        return GroupedData(self, ks, grouping_sets=masks)
+
     def agg(self, *aggs, **named) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs, **named)
 
-    def join(self, other: "DataFrame", on, how: str = "inner") -> "DataFrame":
+    def join(self, other: "DataFrame", on=None, how: str = "inner",
+             condition=None) -> "DataFrame":
+        """Join on equi-key column names (`on`) plus an optional non-equi
+        `condition` expression over the combined schema (ambiguous names
+        resolve to the left side). With no `on` and a `condition`, a
+        broadcast nested-loop join runs (reference:
+        GpuBroadcastNestedLoopJoinExecBase.scala)."""
         if isinstance(on, str):
             on = [on]
-        if isinstance(on, (list, tuple)) and on and isinstance(on[0], str):
-            lk = [col(c) for c in on]
-            rk = [col(c) for c in on]
-        else:
-            raise NotImplementedError("join on expressions: pass column names")
+        if on is None:
+            on = []
+        if isinstance(on, Expression):
+            # decompose: equality conjuncts between the two sides become
+            # equi keys, the rest joins the non-equi condition
+            lk_x, rk_x, extra = _split_join_condition(
+                on, self._plan.schema, other._plan.schema)
+            if condition is not None:
+                extra = condition if extra is None else (extra & condition)
+            return self._join_positional(other, [], how, lk_x, rk_x,
+                                         condition=extra)
+        if not (isinstance(on, (list, tuple))
+                and all(isinstance(c, str) for c in on)):
+            raise TypeError("join `on` must be column name(s) or an "
+                            "expression")
+        lk = [col(c) for c in on]
+        rk = [col(c) for c in on]
+        if not on and condition is None and how != "cross":
+            raise ValueError("join needs `on` keys or a `condition`")
+        if condition is not None or not on:
+            # conditions bind positionally over the combined schema;
+            # skip the rename machinery (ambiguous names -> left side)
+            return self._join_positional(other, list(on), how, lk, rk,
+                                         condition=condition)
         if how in ("left_semi", "left_anti"):
             return DataFrame(self._session,
                              L.Join(self._plan, other._plan, lk, rk, how))
@@ -298,12 +446,16 @@ class DataFrame:
                          else col(f.name))
         return DataFrame(self._session, L.Project(jplan, exprs))
 
-    def _join_positional(self, other: "DataFrame", on, how, lk, rk):
+    def _join_positional(self, other: "DataFrame", on, how, lk, rk,
+                         condition=None):
         """Positional (BoundRef) post-join projection: exact for
         duplicate-named inputs, at the cost of disabling name-based
         pruning above this join."""
         from .expr.expressions import BoundRef, Coalesce
-        jplan = L.Join(self._plan, other._plan, lk, rk, how)
+        jplan = L.Join(self._plan, other._plan, lk, rk, how,
+                       condition=condition)
+        if how in ("left_semi", "left_anti"):
+            return DataFrame(self._session, jplan)
         nl = len(self._plan.schema.fields)
         on_set = set(on)
         exprs = []

@@ -2,12 +2,14 @@
 
 Mirrors pyspark.sql.Window / the reference's window package
 (reference: sql-plugin/.../window/ — GpuWindowExec, GpuRunningWindowExec,
-GpuBatchedBoundedWindowExec). Frames supported round-1:
+GpuBatchedBoundedWindowExec). Frames supported:
 
-  - unboundedPreceding..currentRow  (running aggregates / ranking)
-  - unboundedPreceding..unboundedFollowing (whole-partition aggregates)
-  - rowsBetween(-k, m) for sum/count/avg (prefix-sum differences)
-  - lag/lead
+  - ROWS BETWEEN a AND b (bounded/unbounded, all agg fns incl. min/max)
+  - RANGE BETWEEN a AND b over one numeric/date/timestamp order key
+  - the Spark default frame (RANGE UNBOUNDED PRECEDING..CURRENT ROW when
+    ordered — peer rows included; whole partition when unordered)
+  - lag/lead, ranking (row_number/rank/dense_rank/percent_rank/cume_dist/
+    ntile), first_value/last_value/nth_value
 
 Usage:
     from spark_rapids_tpu.window import Window
@@ -22,24 +24,31 @@ from .expr.expressions import Expression, UnsupportedExpr, _wrap
 from .plan.logical import SortOrder
 
 __all__ = ["Window", "WindowSpec", "WindowExpr", "row_number", "rank",
-           "dense_rank", "lag", "lead", "win_sum", "win_count", "win_min",
-           "win_max", "win_avg", "CURRENT_ROW", "UNBOUNDED"]
+           "dense_rank", "percent_rank", "cume_dist", "ntile", "lag",
+           "lead", "first_value", "last_value", "nth_value", "win_sum",
+           "win_count", "win_min", "win_max", "win_avg", "CURRENT_ROW",
+           "UNBOUNDED"]
 
 UNBOUNDED = object()
 CURRENT_ROW = 0
 
 
 class WindowSpec:
-    def __init__(self, partition_keys=(), orders=(),
-                 frame: Tuple = (UNBOUNDED, CURRENT_ROW)):
+    """frame_mode: "rows", "range", or None (resolve Spark's default at
+    bind: whole partition when unordered, RANGE UNBOUNDED..CURRENT ROW —
+    peers included — when ordered)."""
+
+    def __init__(self, partition_keys=(), orders=(), frame=None,
+                 frame_mode=None):
         self.partition_keys = list(partition_keys)
         self.orders = list(orders)
         self.frame = frame
+        self.frame_mode = frame_mode
 
     def partition_by(self, *keys) -> "WindowSpec":
         from .functions import _to_expr
         return WindowSpec([_to_expr(k) for k in keys], self.orders,
-                          self.frame)
+                          self.frame, self.frame_mode)
 
     def order_by(self, *orders) -> "WindowSpec":
         from .functions import _to_expr
@@ -49,10 +58,16 @@ class WindowSpec:
                 sos.append(o)
             else:
                 sos.append(SortOrder(_to_expr(o), True))
-        return WindowSpec(self.partition_keys, sos, self.frame)
+        return WindowSpec(self.partition_keys, sos, self.frame,
+                          self.frame_mode)
 
     def rows_between(self, start, end) -> "WindowSpec":
-        return WindowSpec(self.partition_keys, self.orders, (start, end))
+        return WindowSpec(self.partition_keys, self.orders, (start, end),
+                          "rows")
+
+    def range_between(self, start, end) -> "WindowSpec":
+        return WindowSpec(self.partition_keys, self.orders, (start, end),
+                          "range")
 
 
 class _WindowBuilder:
@@ -77,8 +92,11 @@ Window = _WindowBuilder
 class WindowExpr(Expression):
     """fn OVER spec. Bound by the Window logical node."""
 
-    FNS = ("row_number", "rank", "dense_rank", "lag", "lead", "sum",
-           "count", "min", "max", "avg")
+    FNS = ("row_number", "rank", "dense_rank", "percent_rank",
+           "cume_dist", "ntile", "lag", "lead", "first_value",
+           "last_value", "nth_value", "sum", "count", "min", "max", "avg")
+    RANKING = ("row_number", "rank", "dense_rank", "percent_rank",
+               "cume_dist", "ntile")
 
     def __init__(self, fn: str, child: Optional[Expression],
                  spec: WindowSpec, offset: int = 1,
@@ -92,6 +110,14 @@ class WindowExpr(Expression):
         self.children = [c for c in [child] if c is not None]
 
     def bind(self, schema):
+        frame, mode = self.spec.frame, self.spec.frame_mode
+        if mode is None:
+            # Spark default: whole partition when unordered, RANGE
+            # UNBOUNDED..CURRENT ROW (peer-inclusive) when ordered
+            if self.spec.orders:
+                frame, mode = (UNBOUNDED, CURRENT_ROW), "range"
+            else:
+                frame, mode = (UNBOUNDED, UNBOUNDED), "rows"
         b = WindowExpr(self.fn,
                        self.child.bind(schema) if self.child else None,
                        WindowSpec(
@@ -99,14 +125,16 @@ class WindowExpr(Expression):
                            [SortOrder(o.expr.bind(schema), o.ascending,
                                       o.nulls_first)
                             for o in self.spec.orders],
-                           self.spec.frame),
+                           frame, mode),
                        self.offset, self.default)
         from .columnar import dtypes as dt
-        if self.fn in ("row_number", "rank", "dense_rank"):
+        if self.fn in self.RANKING:
             if not b.spec.orders:
                 raise UnsupportedExpr(f"{self.fn} requires ORDER BY")
-            b.dtype = dt.INT32
-        elif self.fn in ("lag", "lead"):
+            b.dtype = (dt.FLOAT64 if self.fn in ("percent_rank",
+                                                 "cume_dist") else dt.INT32)
+        elif self.fn in ("lag", "lead", "first_value", "last_value",
+                         "nth_value"):
             b.dtype = b.child.dtype
         elif self.fn == "count":
             b.dtype = dt.INT64
@@ -151,12 +179,40 @@ def dense_rank():
     return _PendingWindowFn("dense_rank")
 
 
+def percent_rank():
+    return _PendingWindowFn("percent_rank")
+
+
+def cume_dist():
+    return _PendingWindowFn("cume_dist")
+
+
+def ntile(n: int):
+    if n <= 0:
+        raise ValueError("ntile bucket count must be positive")
+    return _PendingWindowFn("ntile", offset=n)
+
+
 def lag(e, offset: int = 1, default=None):
     return _PendingWindowFn("lag", _wrap(e), offset, default)
 
 
 def lead(e, offset: int = 1, default=None):
     return _PendingWindowFn("lead", _wrap(e), offset, default)
+
+
+def first_value(e):
+    return _PendingWindowFn("first_value", _wrap(e))
+
+
+def last_value(e):
+    return _PendingWindowFn("last_value", _wrap(e))
+
+
+def nth_value(e, n: int):
+    if n <= 0:
+        raise ValueError("nth_value n must be positive")
+    return _PendingWindowFn("nth_value", _wrap(e), offset=n)
 
 
 def win_sum(e):

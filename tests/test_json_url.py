@@ -1,0 +1,94 @@
+"""JSON + URL expressions (host bridge)
+(reference: GpuGetJsonObject.scala, GpuJsonToStructs.scala,
+GpuParseUrl.scala)."""
+import pyarrow as pa
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.expressions import col
+
+
+def test_get_json_object(session):
+    js = ['{"a": {"b": 1, "c": "x"}, "arr": [1,2,3]}',
+          '{"a": {"b": 2.5}}',
+          '{"a": [{"b": 7}, {"b": 8}]}',
+          'not json', None, '[]',
+          '{"s": "plain string"}']
+    df = session.create_dataframe({"j": pa.array(js)})
+    out = df.select(
+        F.get_json_object(col("j"), "$.a.b").alias("ab"),
+        F.get_json_object(col("j"), "$.arr[1]").alias("a1"),
+        F.get_json_object(col("j"), "$.arr[-1]").alias("neg"),
+        F.get_json_object(col("j"), "$.a").alias("obj"),
+        F.get_json_object(col("j"), "$.s").alias("s"),
+        F.get_json_object(col("j"), "$.missing").alias("m"),
+    ).to_arrow().to_pydict()
+    assert out["ab"] == ["1", "2.5", "[7,8]", None, None, None, None]
+    assert out["a1"] == ["2", None, None, None, None, None, None]
+    assert out["neg"] == ["3", None, None, None, None, None, None]
+    assert out["obj"] == ['{"b":1,"c":"x"}', '{"b":2.5}',
+                          '[{"b":7},{"b":8}]', None, None, None, None]
+    assert out["s"] == [None, None, None, None, None, None,
+                        "plain string"]
+    assert out["m"] == [None] * 7
+
+
+def test_get_json_object_wildcard(session):
+    js = ['{"arr": [{"k": 1}, {"k": 2}]}', '{"arr": [{"k": 5}]}']
+    df = session.create_dataframe({"j": pa.array(js)})
+    out = df.select(
+        F.get_json_object(col("j"), "$.arr[*].k").alias("ks")
+    ).to_arrow().to_pydict()
+    assert out["ks"] == ["[1,2]", "5"]
+
+
+def test_from_json_to_json(session):
+    js = ['{"a": 1, "b": "x", "c": [1,2]}',
+          '{"a": 9}', None, "broken"]
+    df = session.create_dataframe({"j": pa.array(js)})
+    schema = dt.StructType((dt.StructField("a", dt.INT64),
+                            dt.StructField("b", dt.STRING),
+                            dt.StructField("c",
+                                           dt.ArrayType(dt.INT64))))
+    out = df.select(F.from_json(col("j"), schema).alias("s")) \
+        .to_arrow().to_pydict()
+    assert out["s"] == [{"a": 1, "b": "x", "c": [1, 2]},
+                        {"a": 9, "b": None, "c": None}, None, None]
+    out2 = df.select(
+        F.to_json(F.from_json(col("j"), schema)).alias("t")) \
+        .to_arrow().to_pydict()
+    assert out2["t"][0] == '{"a":1,"b":"x","c":[1,2]}'
+    assert out2["t"][2] is None
+
+
+def test_parse_url(session):
+    urls = ["https://user:pw@example.com:8080/p/a?x=1&y=2#frag",
+            "http://spark.apache.org/path?q=hello+world",
+            None, "ftp://h/f.txt"]
+    df = session.create_dataframe({"u": pa.array(urls)})
+    out = df.select(
+        F.parse_url(col("u"), "HOST").alias("host"),
+        F.parse_url(col("u"), "PATH").alias("path"),
+        F.parse_url(col("u"), "QUERY").alias("q"),
+        F.parse_url(col("u"), "QUERY", "y").alias("qy"),
+        F.parse_url(col("u"), "PROTOCOL").alias("proto"),
+        F.parse_url(col("u"), "REF").alias("ref"),
+        F.parse_url(col("u"), "USERINFO").alias("ui"),
+    ).to_arrow().to_pydict()
+    assert out["host"] == ["example.com", "spark.apache.org", None, "h"]
+    assert out["path"] == ["/p/a", "/path", None, "/f.txt"]
+    assert out["q"] == ["x=1&y=2", "q=hello+world", None, None]
+    assert out["qy"] == ["2", None, None, None]
+    assert out["proto"] == ["https", "http", None, "ftp"]
+    assert out["ref"] == ["frag", None, None, None]
+    assert out["ui"] == ["user:pw", None, None, None]
+
+
+def test_json_in_filter(session):
+    js = ['{"n": 5}', '{"n": 50}', '{"n": 2}', None]
+    df = session.create_dataframe({"j": pa.array(js),
+                                   "i": pa.array([1, 2, 3, 4])})
+    out = df.filter(
+        F.get_json_object(col("j"), "$.n").cast("int") > 3) \
+        .select(col("i")).to_arrow().to_pydict()
+    assert sorted(out["i"]) == [1, 2]

@@ -65,18 +65,12 @@ def test_running_and_total_sum(session):
         rows = sorted(rows, key=lambda r: r[1])
         tot_vals = [r[2] for r in rows if r[2] is not None]
         tot = sum(tot_vals) if tot_vals else None
-        run = 0
-        cnt = 0
-        rmin = None
-        any_valid = False
         for k_, o, v in rows:
-            if v is not None:
-                run += v
-                cnt += 1
-                rmin = v if rmin is None else min(rmin, v)
-                any_valid = True
-            exp.append((k_, o, v, run if any_valid else None, tot, cnt,
-                        rmin))
+            # Spark default frame: RANGE UNBOUNDED..CURRENT ROW — running
+            # aggregates include ALL peer rows (tied order keys)
+            vals = [r[2] for r in rows if r[1] <= o and r[2] is not None]
+            exp.append((k_, o, v, sum(vals) if vals else None, tot,
+                        len(vals), min(vals) if vals else None))
     assert_rows_equal(out, exp)
 
 
@@ -131,3 +125,186 @@ def test_window_string_partition_keys(session):
         for i, v in enumerate(sorted(vs)):
             exp.append((k, v, i + 1))
     assert_rows_equal(out, exp)
+
+
+def test_default_frame_includes_peers(session):
+    """Spark default frame with ORDER BY is RANGE UNBOUNDED..CURRENT ROW:
+    tied order keys (peers) are all included in the running aggregate."""
+    df, at = gen_df(session, [("k", IntegerGen(lo=0, hi=4, nullable=False)),
+                              ("o", IntegerGen(lo=0, hi=8,
+                                               nullable=False)),
+                              ("v", IntegerGen(lo=-50, hi=50,
+                                               nullable=False))],
+                    n=400, seed=76)
+    w = Window.partition_by("k").order_by("o")
+    out = df.select("k", "o", "v",
+                    win_sum(col("v")).over(w).alias("s")).to_arrow()
+    exp = []
+    for k, rows in _groups(at, 0, [1, 2]).items():
+        rows = sorted(rows, key=lambda r: r[1])
+        for k_, o, v in rows:
+            s = sum(r[2] for r in rows if r[1] <= o)
+            exp.append((k_, o, v, s))
+    assert_rows_equal(out, exp)
+
+
+def test_bounded_minmax_rows_frame(session):
+    df, at = gen_df(session, [("k", IntegerGen(lo=0, hi=3, nullable=False)),
+                              ("o", IntegerGen(lo=0, hi=10**6,
+                                               nullable=False)),
+                              ("v", IntegerGen(lo=-100, hi=100))],
+                    n=500, seed=77)
+    w = Window.partition_by("k").order_by("o").rows_between(-3, 2)
+    out = df.select("k", "o", "v",
+                    win_min(col("v")).over(w).alias("mn"),
+                    win_max(col("v")).over(w).alias("mx")).to_arrow()
+    exp = []
+    for k, rows in _groups(at, 0, [1, 2]).items():
+        rows = sorted(rows, key=lambda r: r[1])
+        for i, (k_, o, v) in enumerate(rows):
+            lo, hi = max(0, i - 3), min(len(rows) - 1, i + 2)
+            vals = [r[2] for r in rows[lo:hi + 1] if r[2] is not None]
+            exp.append((k_, o, v, min(vals) if vals else None,
+                        max(vals) if vals else None))
+    assert_rows_equal(out, exp)
+
+
+def test_range_frame_sum_minmax(session):
+    df, at = gen_df(session, [("k", IntegerGen(lo=0, hi=3, nullable=False)),
+                              ("o", IntegerGen(lo=0, hi=30,
+                                               nullable=False)),
+                              ("v", IntegerGen(lo=0, hi=100,
+                                               nullable=False))],
+                    n=400, seed=78)
+    w = Window.partition_by("k").order_by("o").range_between(-5, 5)
+    out = df.select("k", "o", "v",
+                    win_sum(col("v")).over(w).alias("s"),
+                    win_max(col("v")).over(w).alias("mx")).to_arrow()
+    exp = []
+    for k, rows in _groups(at, 0, [1, 2]).items():
+        for k_, o, v in rows:
+            inframe = [r[2] for r in rows if o - 5 <= r[1] <= o + 5]
+            exp.append((k_, o, v, sum(inframe), max(inframe)))
+    assert_rows_equal(out, exp)
+
+
+def test_range_frame_descending(session):
+    df, at = gen_df(session, [("o", IntegerGen(lo=0, hi=50,
+                                               nullable=False)),
+                              ("v", IntegerGen(lo=0, hi=100,
+                                               nullable=False))],
+                    n=300, seed=79)
+    from spark_rapids_tpu.plan.logical import SortOrder as SO
+    w = Window.order_by(SO(col("o"), ascending=False)).range_between(-5, 5)
+    out = df.select("o", "v", win_sum(col("v")).over(w).alias("s")).to_arrow()
+    rows = list(zip(at.column(0).to_pylist(), at.column(1).to_pylist()))
+    exp = []
+    for o, v in rows:
+        # descending: 5 preceding = keys up to o+5, 5 following = down to o-5
+        inframe = [r[1] for r in rows if o - 5 <= r[0] <= o + 5]
+        exp.append((o, v, sum(inframe)))
+    assert_rows_equal(out, exp)
+
+
+def test_ranking_extras(session):
+    df, at = gen_df(session, [("k", IntegerGen(lo=0, hi=4, nullable=False)),
+                              ("o", IntegerGen(lo=0, hi=10,
+                                               nullable=False)),
+                              ("v", IntegerGen(lo=0, hi=100,
+                                               nullable=False))],
+                    n=350, seed=80)
+    from spark_rapids_tpu.window import cume_dist, ntile, percent_rank
+    w = Window.partition_by("k").order_by("o")
+    out = df.select("k", "o",
+                    percent_rank().over(w).alias("pr"),
+                    cume_dist().over(w).alias("cd"),
+                    ntile(3).over(w).alias("nt")).to_arrow()
+    exp = []
+    for k, rows in _groups(at, 0, [1]).items():
+        rows = sorted(rows, key=lambda r: r[1])
+        cnt = len(rows)
+        for i, (k_, o, _) in enumerate(rows):
+            rk = sum(1 for r in rows if r[1] < o) + 1
+            pr = (rk - 1) / (cnt - 1) if cnt > 1 else 0.0
+            peers_end = max(j for j, r in enumerate(rows) if r[1] == o)
+            cd = (peers_end + 1) / cnt
+            q, rem = divmod(cnt, 3)
+            big = rem * (q + 1)
+            nt = (i // (q + 1) if i < big
+                  else rem + (i - big) // q if q else 0) + 1
+            exp.append((k_, o, pr, cd, nt))
+    assert_rows_equal(out, exp)
+
+
+def test_value_functions(session):
+    # unique order keys: first/nth value positions inside a peer group
+    # would otherwise be engine-order-dependent
+    import numpy as np
+    rng = np.random.default_rng(81)
+    n = 300
+    at = pa.table({"k": rng.integers(0, 4, n),
+                   "o": rng.permutation(n).astype(np.int64),
+                   "v": rng.integers(0, 100, n)})
+    df = session.create_dataframe(at)
+    from spark_rapids_tpu.window import first_value, last_value, nth_value
+    w = Window.partition_by("k").order_by("o")
+    wr = w.rows_between(-2, 1)
+    out = df.select("k", "o",
+                    first_value(col("v")).over(wr).alias("fv"),
+                    last_value(col("v")).over(w).alias("lv"),
+                    nth_value(col("v"), 3).over(wr).alias("nv")).to_arrow()
+    exp = []
+    for k, rows in _groups(at, 0, [1, 2]).items():
+        rows = sorted(rows, key=lambda r: r[1])
+        for i, (k_, o, v) in enumerate(rows):
+            lo, hi = max(0, i - 2), min(len(rows) - 1, i + 1)
+            fv = rows[lo][2]
+            # default frame: last_value lands on the end of the peer group
+            peers_end = max(j for j, r in enumerate(rows) if r[1] == o)
+            lv = rows[peers_end][2]
+            nv = rows[lo + 2][2] if lo + 2 <= hi else None
+            exp.append((k_, o, fv, lv, nv))
+    assert_rows_equal(out, exp)
+
+
+def test_multiple_window_specs_one_select(session):
+    df, at = gen_df(session, [("a", IntegerGen(lo=0, hi=4, nullable=False)),
+                              ("b", IntegerGen(lo=0, hi=4, nullable=False)),
+                              ("v", IntegerGen(lo=0, hi=100,
+                                               nullable=False))],
+                    n=300, seed=82)
+    wa = Window.partition_by("a").order_by("v", "b")
+    wb = Window.partition_by("b").order_by("v", "a")
+    out = df.select("a", "b", "v",
+                    row_number().over(wa).alias("ra"),
+                    row_number().over(wb).alias("rb")).to_arrow()
+    rows = list(zip(at.column(0).to_pylist(), at.column(1).to_pylist(),
+                    at.column(2).to_pylist()))
+    ra = {}
+    for a in set(r[0] for r in rows):
+        grp = sorted([r for r in rows if r[0] == a],
+                     key=lambda r: (r[2], r[1]))
+        for i, r in enumerate(grp):
+            ra.setdefault(r, []).append(i + 1)
+    rb = {}
+    for b in set(r[1] for r in rows):
+        grp = sorted([r for r in rows if r[1] == b],
+                     key=lambda r: (r[2], r[0]))
+        for i, r in enumerate(grp):
+            rb.setdefault(r, []).append(i + 1)
+    # duplicate (a,b,v) rows make exact per-row mapping ambiguous; compare
+    # multisets of (a,b,v,ra) and (a,b,v,rb) separately
+    from collections import Counter
+    got = list(zip(out.column(0).to_pylist(), out.column(1).to_pylist(),
+                   out.column(2).to_pylist(), out.column(3).to_pylist(),
+                   out.column(4).to_pylist()))
+    exp_ra = Counter()
+    for r, ranks in ra.items():
+        for rk in ranks:
+            exp_ra[r + (rk,)] += 1
+    exp_rb = Counter()
+    for r, ranks in rb.items():
+        for rk in ranks:
+            exp_rb[r + (rk,)] += 1
+    assert Counter((a, b, v, x) for a, b, v, x, _ in got) == exp_ra
+    assert Counter((a, b, v, y) for a, b, v, _, y in got) == exp_rb
