@@ -9,11 +9,31 @@ handle-based HBM->host->disk spill framework with split-and-retry
 out-of-core execution; and a partition-exchange shuffle with host-file and
 ICI-collective transports.
 """
+import os as _os
+
 import jax as _jax
 
 # SQL semantics require 64-bit ints/floats (LongType, DoubleType, decimal64,
 # timestamps); enable before any array is created.
 _jax.config.update("jax_enable_x64", True)
+
+# Persistent XLA compilation cache: query-shaped programs are large and
+# tunneled-TPU compiles are minutes; caching across processes turns cold
+# starts into seconds. SRTPU_COMPILE_CACHE overrides the location; set it
+# to "0" to disable.
+_cache = _os.environ.get("SRTPU_COMPILE_CACHE")
+if _cache != "0":
+    if not _cache:
+        _cache = _os.path.join(
+            _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
+            ".jax_cache")
+    try:
+        _os.makedirs(_cache, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _cache)
+        _jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                           0.5)
+    except Exception:
+        pass
 
 from .columnar import dtypes
 from .columnar.column import Column

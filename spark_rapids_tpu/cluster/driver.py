@@ -1,0 +1,309 @@
+"""Driver-side cluster manager: executor lifecycle, task scheduling,
+heartbeat liveness, task re-execution on executor loss.
+
+(reference: RapidsDriverPlugin Plugin.scala:463 — executor registration
+and RPC receive loop :469-504; RapidsShuffleHeartbeatManager.scala:33,169
+— registration + periodic heartbeats with lost-executor handling. The
+recovery model is §5.3's lineage re-execution: tasks are idempotent
+callables, so a lost executor's in-flight tasks simply requeue.)
+"""
+from __future__ import annotations
+
+import os
+import queue
+import socket
+import subprocess
+import sys
+import threading
+import time
+from concurrent.futures import Future
+from typing import Any, Callable, Dict, List, Optional
+
+from .rpc import RpcClosed, recv_msg, send_msg
+
+__all__ = ["ClusterManager", "ExecutorLostError"]
+
+HEARTBEAT_TIMEOUT_S = 3.0
+MAX_TASK_RETRIES = 3
+
+
+class ExecutorLostError(RuntimeError):
+    pass
+
+
+class _Executor:
+    def __init__(self, exec_id: int, proc: subprocess.Popen):
+        self.exec_id = exec_id
+        self.proc = proc
+        self.sock: Optional[socket.socket] = None
+        self.last_heartbeat = time.time()
+        self.inflight: Dict[int, "_Task"] = {}
+        self.lost = False
+
+
+class _Task:
+    __slots__ = ("task_id", "fn", "args", "future", "attempts")
+
+    def __init__(self, task_id, fn, args):
+        self.task_id = task_id
+        self.fn = fn
+        self.args = args
+        self.future: Future = Future()
+        self.attempts = 0
+
+
+class ClusterManager:
+    """Spawn N executor processes; schedule host-side tasks over them.
+
+    Usage:
+        cm = ClusterManager(2); cm.start()
+        results = cm.map(decode_fn, paths)
+        cm.shutdown()
+    """
+
+    def __init__(self, n_executors: int,
+                 heartbeat_timeout: float = HEARTBEAT_TIMEOUT_S):
+        self.n = n_executors
+        self.heartbeat_timeout = heartbeat_timeout
+        self._executors: Dict[int, _Executor] = {}
+        self._pending: "queue.Queue[_Task]" = queue.Queue()
+        self._idle: "queue.Queue[int]" = queue.Queue()
+        self._lock = threading.Lock()
+        self._next_task = 0
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._listener: Optional[socket.socket] = None
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self):
+        self._listener = socket.socket()
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind(("127.0.0.1", 0))
+        self._listener.listen(self.n * 2 + 2)
+        host, port = self._listener.getsockname()
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        # ship the driver's import environment so by-reference pickled
+        # task functions resolve in the executor (the Spark closure-ship
+        # analog)
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        paths = [repo_root] + [p for p in sys.path if os.path.isdir(p)]
+        env["PYTHONPATH"] = os.pathsep.join(
+            dict.fromkeys(paths + env.get("PYTHONPATH", "").split(
+                os.pathsep)))
+        for i in range(self.n):
+            proc = subprocess.Popen(
+                [sys.executable, "-m", "spark_rapids_tpu.cluster.executor",
+                 host, str(port), str(i)], env=env)
+            self._executors[i] = _Executor(i, proc)
+        accept = threading.Thread(target=self._accept_loop, daemon=True)
+        accept.start()
+        self._threads.append(accept)
+        mon = threading.Thread(target=self._monitor_loop, daemon=True)
+        mon.start()
+        self._threads.append(mon)
+        disp = threading.Thread(target=self._dispatch_loop, daemon=True)
+        disp.start()
+        self._threads.append(disp)
+        # wait for registrations
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            with self._lock:
+                if all(e.sock is not None
+                       for e in self._executors.values()):
+                    return
+            time.sleep(0.02)
+        raise RuntimeError("executors failed to register")
+
+    def shutdown(self):
+        self._stop.set()
+        with self._lock:
+            for e in self._executors.values():
+                try:
+                    if e.sock:
+                        send_msg(e.sock, "shutdown", {})
+                except OSError:
+                    pass
+        for e in self._executors.values():
+            try:
+                e.proc.wait(timeout=3)
+            except subprocess.TimeoutExpired:
+                e.proc.kill()
+        if self._listener:
+            self._listener.close()
+
+    # -- public API ----------------------------------------------------
+    def submit(self, fn: Callable, *args) -> Future:
+        t = _Task(self._alloc_id(), fn, args)
+        self._pending.put(t)
+        return t.future
+
+    def map(self, fn: Callable, items) -> List[Any]:
+        futures = [self.submit(fn, it) for it in items]
+        return [f.result() for f in futures]
+
+    @property
+    def alive_executors(self) -> List[int]:
+        with self._lock:
+            return [i for i, e in self._executors.items()
+                    if not e.lost and e.sock is not None]
+
+    # -- internals -----------------------------------------------------
+    def _alloc_id(self):
+        with self._lock:
+            self._next_task += 1
+            return self._next_task
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            try:
+                kind, payload = recv_msg(sock)
+            except (RpcClosed, OSError):
+                sock.close()
+                continue
+            eid = payload.get("executor")
+            if kind == "register":
+                with self._lock:
+                    ex = self._executors.get(eid)
+                    if ex is None:
+                        sock.close()
+                        continue
+                    ex.sock = sock
+                    ex.last_heartbeat = time.time()
+                rt = threading.Thread(target=self._recv_loop,
+                                      args=(eid, sock), daemon=True)
+                rt.start()
+                self._threads.append(rt)
+                self._idle.put(eid)
+            elif kind == "hb_register":
+                ht = threading.Thread(target=self._hb_loop,
+                                      args=(eid, sock), daemon=True)
+                ht.start()
+                self._threads.append(ht)
+            else:
+                sock.close()
+
+    def _hb_loop(self, eid: int, sock: socket.socket):
+        while not self._stop.is_set():
+            try:
+                kind, _ = recv_msg(sock)
+            except (RpcClosed, OSError):
+                return
+            if kind == "heartbeat":
+                with self._lock:
+                    ex = self._executors.get(eid)
+                    if ex:
+                        ex.last_heartbeat = time.time()
+
+    def _dispatch_loop(self):
+        while not self._stop.is_set():
+            try:
+                task = self._pending.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            while not self._stop.is_set():
+                try:
+                    eid = self._idle.get(timeout=0.2)
+                except queue.Empty:
+                    if not self.alive_executors:
+                        task.future.set_exception(ExecutorLostError(
+                            "no live executors"))
+                        task = None
+                    if task is None:
+                        break
+                    continue
+                with self._lock:
+                    ex = self._executors.get(eid)
+                    ok = ex and not ex.lost and ex.sock
+                if not ok:
+                    continue
+                task.attempts += 1
+                with self._lock:
+                    ex.inflight[task.task_id] = task
+                try:
+                    send_msg(ex.sock, "task", {
+                        "task_id": task.task_id, "fn": task.fn,
+                        "args": task.args})
+                    break
+                except OSError:
+                    # _mark_lost already requeued this task from the
+                    # executor's inflight map — do NOT also retry it here
+                    # (double dispatch would run it on two executors)
+                    self._mark_lost(eid)
+                    break
+                except Exception as e:   # unpicklable task: fail it, keep
+                    with self._lock:     # the dispatcher alive
+                        ex.inflight.pop(task.task_id, None)
+                    task.future.set_exception(e)
+                    self._idle.put(eid)
+                    break
+
+    def _recv_loop(self, eid: int, sock: socket.socket):
+        while not self._stop.is_set():
+            try:
+                kind, payload = recv_msg(sock)
+            except (RpcClosed, OSError):
+                self._mark_lost(eid)
+                return
+            task_id = payload.get("task_id")
+            with self._lock:
+                ex = self._executors.get(eid)
+                task = ex.inflight.pop(task_id, None) if ex else None
+            if task is None:
+                continue
+            try:
+                if kind == "result":
+                    task.future.set_result(payload["value"])
+                else:
+                    task.future.set_exception(RuntimeError(
+                        f"task failed on executor {eid}: "
+                        f"{payload.get('message')}\n"
+                        f"{payload.get('traceback', '')}"))
+            except Exception:
+                pass   # future already resolved by a retry path
+            self._idle.put(eid)
+
+    def _monitor_loop(self):
+        while not self._stop.is_set():
+            now = time.time()
+            with self._lock:
+                stale = [i for i, e in self._executors.items()
+                         if e.sock is not None and not e.lost
+                         and now - e.last_heartbeat
+                         > self.heartbeat_timeout]
+            for eid in stale:
+                self._mark_lost(eid)
+            time.sleep(0.2)
+
+    def _mark_lost(self, eid: int):
+        """Heartbeat timeout / socket death: requeue the executor's
+        in-flight tasks (idempotent re-execution) up to MAX_TASK_RETRIES."""
+        with self._lock:
+            ex = self._executors.get(eid)
+            if ex is None or ex.lost:
+                return
+            ex.lost = True
+            inflight = list(ex.inflight.values())
+            ex.inflight.clear()
+            try:
+                if ex.sock:
+                    ex.sock.close()
+            except OSError:
+                pass
+        try:
+            ex.proc.kill()
+        except OSError:
+            pass
+        for task in inflight:
+            if task.attempts >= MAX_TASK_RETRIES:
+                task.future.set_exception(ExecutorLostError(
+                    f"task {task.task_id} lost executor {eid} after "
+                    f"{task.attempts} attempts"))
+            else:
+                self._pending.put(task)

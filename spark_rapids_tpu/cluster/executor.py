@@ -1,0 +1,74 @@
+"""Executor worker process.
+
+(reference: RapidsExecutorPlugin, Plugin.scala:610 — init, heartbeat
+endpoint, task hooks.) Each executor is a separate OS process that
+connects back to the driver, registers, then serves tasks over one
+socket while a daemon thread heartbeats on a second. Tasks are pickled
+callables returning picklable results (host-side work only — the TPU
+client lives in the driver; JAX stays unimported here unless a task
+pulls it in, and then it is forced onto the CPU platform).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import sys
+import threading
+import time
+import traceback
+
+from .rpc import RpcClosed, recv_msg, send_msg
+
+__all__ = ["executor_main"]
+
+HEARTBEAT_PERIOD_S = 0.5
+
+
+def _heartbeat_loop(host: str, port: int, exec_id: int, stop):
+    try:
+        hb = socket.create_connection((host, port))
+        send_msg(hb, "hb_register", {"executor": exec_id,
+                                     "pid": os.getpid()})
+        while not stop.is_set():
+            send_msg(hb, "heartbeat", {"executor": exec_id,
+                                       "ts": time.time()})
+            stop.wait(HEARTBEAT_PERIOD_S)
+    except OSError:
+        pass  # driver gone; the task loop will exit too
+
+
+def executor_main(host: str, port: int, exec_id: int) -> None:
+    # any accidental JAX usage inside a task must not grab the TPU
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    stop = threading.Event()
+    t = threading.Thread(target=_heartbeat_loop,
+                         args=(host, port, exec_id, stop), daemon=True)
+    t.start()
+    sock = socket.create_connection((host, port))
+    send_msg(sock, "register", {"executor": exec_id, "pid": os.getpid()})
+    try:
+        while True:
+            kind, payload = recv_msg(sock)
+            if kind == "shutdown":
+                break
+            if kind != "task":
+                send_msg(sock, "error", {"message": f"bad kind {kind}"})
+                continue
+            task_id = payload["task_id"]
+            try:
+                fn = payload["fn"]
+                result = fn(*payload.get("args", ()))
+                send_msg(sock, "result", {"task_id": task_id,
+                                          "value": result})
+            except BaseException as e:  # report, don't die
+                send_msg(sock, "error", {
+                    "task_id": task_id, "message": repr(e),
+                    "traceback": traceback.format_exc()})
+    except RpcClosed:
+        pass
+    finally:
+        stop.set()
+
+
+if __name__ == "__main__":
+    executor_main(sys.argv[1], int(sys.argv[2]), int(sys.argv[3]))

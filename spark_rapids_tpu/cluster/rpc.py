@@ -1,0 +1,45 @@
+"""Length-prefixed binary RPC frames over TCP.
+
+(reference analog: the plugin RPC channel Plugin.scala:469-504 rides
+Spark's netty; here a dependency-free socket protocol.) Frame layout:
+8-byte big-endian payload length, then a pickled (kind, payload) tuple.
+Pickle is the task wire format by design — driver and executors run the
+same code tree, exactly like Spark shipping closures to executors.
+"""
+from __future__ import annotations
+
+import pickle
+import socket
+import struct
+from typing import Any, Tuple
+
+__all__ = ["send_msg", "recv_msg", "RpcClosed"]
+
+_LEN = struct.Struct(">Q")
+MAX_FRAME = 1 << 34
+
+
+class RpcClosed(Exception):
+    """Peer went away mid-frame."""
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray()
+    while len(buf) < n:
+        chunk = sock.recv(min(n - len(buf), 1 << 20))
+        if not chunk:
+            raise RpcClosed(f"connection closed ({len(buf)}/{n} bytes)")
+        buf.extend(chunk)
+    return bytes(buf)
+
+
+def send_msg(sock: socket.socket, kind: str, payload: Any) -> None:
+    data = pickle.dumps((kind, payload), protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(data)) + data)
+
+
+def recv_msg(sock: socket.socket) -> Tuple[str, Any]:
+    (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    if n > MAX_FRAME:
+        raise IOError(f"oversized RPC frame: {n} bytes")
+    return pickle.loads(_recv_exact(sock, n))

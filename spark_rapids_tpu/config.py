@@ -136,9 +136,26 @@ MULTITHREADED_READ_THREADS = _conf(
 PARQUET_READER_TYPE = _conf(
     "sql.format.parquet.reader.type", "MULTITHREADED",
     "PERFILE|COALESCING|MULTITHREADED (GpuParquetScan reader types).", str)
+CLUSTER_EXECUTORS = _conf(
+    "cluster.executors", 0,
+    "Executor worker processes for host-side scan decode (the "
+    "driver/executor split of Plugin.scala; 0 = in-process). The TPU "
+    "client stays in the driver — executors parallelize host decode and "
+    "ship Arrow IPC back; heartbeat loss requeues their tasks.", int)
+CLUSTER_HEARTBEAT_TIMEOUT = _conf(
+    "cluster.heartbeatTimeoutSeconds", 3.0,
+    "Executor liveness: no heartbeat for this long marks the executor "
+    "lost and re-executes its in-flight tasks "
+    "(RapidsShuffleHeartbeatManager analog).", float)
 MAX_READER_BATCH_SIZE_ROWS = _conf(
     "sql.reader.batchSizeRows", 1 << 21,
     "Soft limit on rows per scan batch.", int)
+AGG_OPTIMISTIC_GROUPS = _conf(
+    "sql.agg.optimisticGroups", 4096,
+    "HBM-cached grouped aggregations first try ONE fused device program "
+    "whose output is sized to this many groups (plus an overflow flag); "
+    "low-cardinality queries then cost a single device round trip. "
+    "On overflow the exact multi-pass path re-runs. 0 disables.", int)
 # (decimal128 is always-on: exact two-limb kernels in ops/decimal128.py;
 # the former sql.decimal128.enabled gate had no remaining effect and was
 # removed rather than shipped as a silent no-op)

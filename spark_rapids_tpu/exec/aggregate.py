@@ -560,6 +560,191 @@ class HashAggregateExec(TpuExec):
                 self._base, self._n_fused = self.children[0], 0
                 self._stages = lambda cvs, mask: (cvs, mask)
 
+    # -- whole-input fused path (HBM-cached child, one device program) --
+    def _whole_grouped_program(self, nchunks, opt_cap):
+        """ONE program for the entire cached input: per-batch fused
+        stages + key/input emit, concat, sort-segment aggregate, compact
+        live groups to opt_cap, finalize — plus (count, overflow) so the
+        host can detect optimistic-capacity misses in the same round trip
+        (the whole-stage answer to the reference's multi-pass
+        GpuAggregateExec when groups are few)."""
+        from ..ops.gather import take_strings
+        from ..ops.hash import murmur3_row_hash
+        key_dtypes = [k.dtype for k in self.keys]
+
+        def run(batches):
+            # per-batch fused stages + key/input emit, then concat
+            key_parts = [[] for _ in self.keys]
+            in_parts = [[] for _ in self.aggs]
+            masks = []
+            for cvs, bmask in batches:
+                cvs2, mask2 = self._stages(list(cvs), bmask)
+                cap_i = mask2.shape[0]
+                ectx = EmitCtx(cvs2, cap_i)
+                for ki, k in enumerate(self.keys):
+                    key_parts[ki].append(k.emit(ectx))
+                for ai, a in enumerate(self.aggs):
+                    if a.child is not None:
+                        in_parts[ai].append(a.child.emit(ectx))
+                    else:
+                        in_parts[ai].append(
+                            CV(jnp.zeros(cap_i, jnp.int8),
+                               jnp.ones(cap_i, jnp.bool_)))
+                masks.append(mask2)
+            key_cvs = [concat_cvs(ps, k.dtype)
+                       for ps, k in zip(key_parts, self.keys)]
+            mask = concat_masks(masks)
+            cap = mask.shape[0]
+            agg_inputs = []
+            for parts in in_parts:
+                vcat = jnp.concatenate([p.validity for p in parts])
+                if parts[0].offsets is not None:
+                    agg_inputs.append(CV(jnp.zeros(cap, jnp.int8), vcat))
+                else:
+                    agg_inputs.append(
+                        CV(jnp.concatenate([p.data for p in parts]),
+                           vcat))
+            # hash rounds (sort-free — XLA device sorts at input scale
+            # are the slow path on TPU; bucketed segment reduction is
+            # O(rounds * n))
+            eq_arrays = []
+            for kcv, kexpr, nc in zip(key_cvs, self.keys, nchunks):
+                arrs = [jnp.logical_not(kcv.validity).astype(jnp.uint8)]
+                arrs += sk.order_keys(kcv, kexpr.dtype, nc)
+                eq_arrays.append(arrs)
+            B = _HASH_BUCKETS
+            remaining = mask
+            rowidx = jnp.arange(cap, dtype=jnp.int32)
+            round_keys = [[] for _ in self.keys]
+            round_states = None
+            round_live = []
+            for r in range(_HASH_ROUNDS):
+                h = murmur3_row_hash(key_cvs, key_dtypes,
+                                     seed=42 + r * 1000003)
+                b = (h.astype(jnp.uint32)
+                     % jnp.uint32(B)).astype(jnp.int32)
+                repmin = jax.ops.segment_min(
+                    jnp.where(remaining, rowidx, cap), b, B)
+                has = repmin < cap
+                rep = jnp.clip(repmin, 0, cap - 1)
+                rep_of_row = rep[b]
+                match = remaining
+                for arrs in eq_arrays:
+                    for arr in arrs:
+                        match = match & (arr == arr[rep_of_row])
+                states_r = []
+                for a, icv in zip(self.aggs, agg_inputs):
+                    scv = (CV(jnp.zeros(cap, jnp.int8), icv.validity)
+                           if icv.offsets is not None else icv)
+                    states_r.append(a.g_update(scv, match, b, B))
+                flat_r = [c for st_ in states_r for c in st_]
+                round_states = ([[f] for f in flat_r]
+                                if round_states is None
+                                else [o + [f] for o, f in
+                                      zip(round_states, flat_r)])
+                for ki, (kcv, nc) in enumerate(zip(key_cvs, nchunks)):
+                    if kcv.offsets is not None:
+                        bcap = min(kcv.data.shape[0],
+                                   bucket_capacity(max(B * nc * 4, 4)))
+                        round_keys[ki].append(take_strings(
+                            kcv, rep, in_bounds=has,
+                            out_data_capacity=bcap))
+                    else:
+                        round_keys[ki].append(take(kcv, rep,
+                                                   in_bounds=has))
+                round_live.append(has)
+                remaining = remaining & ~match
+            leftover = jnp.sum(remaining.astype(jnp.int32))
+            hk = [concat_cvs(parts, kd)
+                  for parts, kd in zip(round_keys, key_dtypes)]
+            hflat = [jnp.concatenate(parts) for parts in round_states]
+            hlive = jnp.concatenate(round_live)
+            # same key can surface in several rounds: one small merge
+            # (sort over ROUNDS*BUCKETS rows only) unifies them and puts
+            # live groups first
+            mk, mflat, mlive = self._merge_body(hk, hflat, hlive,
+                                                nchunks)
+            sel = jnp.arange(opt_cap, dtype=jnp.int32)
+            count = jnp.sum(mlive.astype(jnp.int32))
+            overflow = (count > opt_cap) | (leftover > 0)
+            sl_c = mlive[sel] if mlive.shape[0] > opt_cap else \
+                jnp.pad(mlive, (0, opt_cap - mlive.shape[0]))
+            ks_c = []
+            for kcv, nc in zip(mk, nchunks):
+                if kcv.offsets is not None:
+                    bcap = min(kcv.data.shape[0],
+                               bucket_capacity(max(opt_cap * nc * 4, 4)))
+                    ks_c.append(take_strings(kcv, sel, in_bounds=sl_c,
+                                             out_data_capacity=bcap))
+                else:
+                    ks_c.append(take(kcv, sel, in_bounds=sl_c))
+            flat_c = [f[sel] for f in mflat]
+            outs = self._finalize_fn(ks_c, flat_c, sl_c)
+            return outs, sl_c, count, overflow
+        return run
+
+    def _merge_body(self, key_cvs, flat_states, mask, nchunks):
+        """In-trace merge (the body of _merge_fn without the jit
+        boundary): sort-segment the partial keys, reduce states; live
+        groups come out first."""
+        cap = mask.shape[0]
+        perm, seg_ids, live, seg_live, key_out = \
+            self._sort_and_segment(key_cvs, mask, nchunks)
+        out_flat = []
+        i = 0
+        for a in self.aggs:
+            width = self._state_width(a)
+            if "custom" in a.state_reducers:
+                cols = [flat_states[i + j][perm] for j in range(width)]
+                out_flat.extend(a.g_merge_custom(cols, live, seg_ids,
+                                                 cap))
+                i += width
+            else:
+                for r in a.state_reducers:
+                    arr = flat_states[i][perm]
+                    out_flat.append(_seg_reduce(r, arr, live, seg_ids,
+                                                cap))
+                    i += 1
+        return key_out, out_flat, seg_live
+
+    def _try_whole_input(self, ctx, m):
+        """Single-round-trip path: cached child, bounded batch count, no
+        retry pressure. Returns a DeviceBatch or None (overflow or
+        ineligible)."""
+        from ..config import AGG_OPTIMISTIC_GROUPS
+        from .nodes import CachedScanExec
+        opt_cap = ctx.conf.get(AGG_OPTIMISTIC_GROUPS)
+        if (self.mode != "complete" or opt_cap <= 0
+                or not self._hash_ok
+                or getattr(self, "_whole_disabled", False)
+                or not isinstance(self._base, CachedScanExec)):
+            return None
+        batches = self._base.batches
+        if not batches or len(batches) > 64:
+            return None
+        if not hasattr(self, "_whole_nchunks"):
+            ncs = [self._batch_nchunks(b) for b in batches]
+            self._whole_nchunks = tuple(max(t) for t in zip(*ncs))
+        key = ("whole", self._whole_nchunks, opt_cap,
+               tuple(b.capacity for b in batches))
+        fn = self._update_cache.get(key)
+        if fn is None:
+            fn = jax.jit(self._whole_grouped_program(
+                self._whole_nchunks, opt_cap))
+            self._update_cache[key] = fn
+        args = tuple((tuple(b.cvs()), b.row_mask) for b in batches)
+        with m.timer("opTime"):
+            outs, sl_c, count, overflow = fn(args)
+            from ..utils.transfer import fetch
+            cnt, ovf = fetch((count, overflow))
+        if bool(ovf):
+            self._whole_disabled = True
+            return None
+        tbl = make_table(self.schema, outs, int(cnt))
+        m.add("numOutputRows", int(cnt))
+        m.add("numOutputBatches", 1)
+        return DeviceBatch(tbl, int(cnt), sl_c, sl_c.shape[0])
+
     def execute_partition(self, ctx: ExecContext, pid: int):
         self._resolve_fusion()
         m = ctx.metrics_for(self._op_id)
@@ -572,6 +757,11 @@ class HashAggregateExec(TpuExec):
         if self.mode == "final":
             yield from self._execute_final(ctx, pid, m)
             return
+        if self.mode == "complete":
+            whole = self._try_whole_input(ctx, m)
+            if whole is not None:
+                yield whole
+                return
 
         def update_one(b):
             nchunks = self._batch_nchunks(b)
@@ -616,7 +806,14 @@ class HashAggregateExec(TpuExec):
                     0, jnp.zeros(128, jnp.bool_), 128)
             return
         with m.timer("opTime"):
+            never_merged = len(partials) == 1
             while len(partials) > 1:
+                partials = [self._merge_partials(partials)]
+            if (self.mode != "partial" and never_merged
+                    and partials[0][3] > 4096):
+                # single-partial finalize would fetch at input capacity;
+                # one merge pass sorts live groups first and compacts the
+                # output to the group count
                 partials = [self._merge_partials(partials)]
             ks, st, sl, cap = partials[0]
             if self.mode == "partial":

@@ -166,9 +166,31 @@ class ParquetScanExec(TpuExec):
             yield pa.table(rb)
 
     def execute_partition(self, ctx, pid) -> Iterator[DeviceBatch]:
-        from ..config import MULTITHREADED_READ_THREADS, PARQUET_READER_TYPE
+        from ..config import (CLUSTER_EXECUTORS,
+                              MULTITHREADED_READ_THREADS,
+                              PARQUET_READER_TYPE)
         m = ctx.metrics_for(self._op_id)
         path = self.paths[pid]
+        if (ctx.conf.get(CLUSTER_EXECUTORS) > 0
+                and ctx.session is not None):
+            # driver/executor split: host decode runs in an executor
+            # process, Arrow IPC ships back (cluster/driver.py)
+            cm = ctx.session.cluster_manager()
+            fut = cm.submit(_remote_decode_parquet, path, self.columns
+                            or [f.name for f in self.schema.fields],
+                            self.filters, max(1, ctx.conf.batch_size_rows))
+            import pyarrow as pa
+            blobs, skipped = fut.result()
+            m.add("skippedRowGroups", skipped)
+            for blob in blobs:
+                with pa.ipc.open_stream(blob) as rd:
+                    at = rd.read_all()
+                with m.timer("scanTime"):
+                    tbl = Table.from_arrow(at)
+                m.add("numOutputRows", at.num_rows)
+                m.add("numOutputBatches", 1)
+                yield DeviceBatch(tbl, num_rows=at.num_rows)
+            return
         reader_type = str(ctx.conf.get(PARQUET_READER_TYPE)).upper()
         host_iter = self._decoded_batches(ctx, path, m)
         if reader_type == "MULTITHREADED":
@@ -180,6 +202,33 @@ class ParquetScanExec(TpuExec):
             m.add("numOutputRows", at.num_rows)
             m.add("numOutputBatches", 1)
             yield DeviceBatch(tbl, num_rows=at.num_rows)
+
+
+def _remote_decode_parquet(path, columns, filters, batch_rows):
+    """Executor-side parquet decode task: returns (list of Arrow IPC
+    stream blobs — one per batch — , skipped row-group count). Pure
+    host-side, idempotent (safe to re-execute after executor loss)."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    pf = pq.ParquetFile(path)
+    skipped = 0
+    if filters:
+        kept = prune_row_groups(pf, filters)
+        skipped = pf.metadata.num_row_groups - len(kept)
+        if not kept:
+            return [], skipped
+        it = pf.iter_batches(batch_size=batch_rows, columns=columns,
+                             row_groups=kept)
+    else:
+        it = pf.iter_batches(batch_size=batch_rows, columns=columns)
+    blobs = []
+    for rb in it:
+        at = pa.table(rb)
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, at.schema) as w:
+            w.write_table(at)
+        blobs.append(sink.getvalue().to_pybytes())
+    return blobs, skipped
 
 
 def _prefetched(it: Iterator, depth: int):

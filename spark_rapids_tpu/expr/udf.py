@@ -70,15 +70,45 @@ class PyUDF(Expression):
         return f"{self.name}({', '.join(map(repr, self.children))})"
 
 
-def udf(fn: Callable, return_type: dt.DataType, null_safe: bool = True):
+def udf(fn: Callable, return_type: dt.DataType, null_safe: bool = True,
+        compile: bool = True):  # noqa: A002
     """Wrap a numpy-vectorized function as a columnar UDF factory:
 
         doubled = udf(lambda x: x * 2, dtypes.INT64)
         df.select(doubled(col("a")))
+
+    When `compile` is true the udf-compiler (expr/udf_compiler.py, the
+    reference's udf-compiler/ analog) first tries to translate the Python
+    source into a native expression tree — the UDF then fuses into the
+    XLA program instead of suspending it with a host callback. Fallback
+    is silent and exact: the pure_callback bridge.
     """
     def factory(*cols):
         from ..functions import _to_expr
-        return PyUDF(fn, return_type, [_to_expr(c) for c in cols],
-                     null_safe)
+        exprs = [_to_expr(c) for c in cols]
+        if compile:
+            from .expressions import Cast
+            from .udf_compiler import CompileError, compile_udf
+            try:
+                compiled = compile_udf(fn, exprs)
+                return Cast(compiled, return_type)
+            except CompileError:
+                pass
+        return PyUDF(fn, return_type, exprs, null_safe)
     factory.__name__ = getattr(fn, "__name__", "udf")
+    return factory
+
+
+def df_udf(fn: Callable):
+    """Dataframe-function UDF (reference: sql-plugin-api functions.scala
+    df_udf — UDFs expressed as Column->Column functions, expanded inline
+    at plan time). `fn` receives expression objects and returns one:
+
+        within = df_udf(lambda a, b: (a - b).cast("double") / b)
+        df.select(within(col("x"), col("y")).alias("r"))
+    """
+    def factory(*cols):
+        from ..functions import _to_expr
+        return fn(*[_to_expr(c) for c in cols])
+    factory.__name__ = getattr(fn, "__name__", "df_udf")
     return factory

@@ -9,7 +9,7 @@ from __future__ import annotations
 from .expr import aggregates as _agg
 from .expr import string_exprs as _se
 from .expr import datetime_exprs as _de
-from .expr.udf import udf  # noqa: F401  (public re-export)
+from .expr.udf import udf, df_udf  # noqa: F401  (public re-exports)
 from .expr.expressions import (Abs, CaseWhen, Cast, Coalesce, ColumnRef,
                                EqNullSafe, Expression, Greatest, If, In,
                                IsNaN, IsNull, Least, Literal, MathUnary,

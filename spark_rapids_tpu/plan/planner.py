@@ -236,6 +236,17 @@ def _agg(meta, conv, conf):
     mesh_n = conf.get(MESH_DEVICES)
     multi_input = child.num_partitions(
         ExecContext(conf, planning=True)) > 1
+    # Small HBM-cached input on a single host: complete mode can take
+    # the one-round-trip whole-input program; at scale the
+    # partial/exchange/final topology pipelines better
+    base = child
+    while len(base.children) == 1:
+        base = base.children[0]
+    from ..exec.nodes import CachedScanExec
+    if isinstance(base, CachedScanExec) and mesh_n <= 1:
+        total = sum(b.capacity for b in base.batches)
+        if total <= (1 << 21):
+            multi_input = False
     keys_ok = all(not (k.dtype.is_nested) for k in n.bound_keys)
     if keys_ok and ((multi_input and nparts > 1) or mesh_n > 1):
         from ..expr.expressions import BoundRef

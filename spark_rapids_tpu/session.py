@@ -40,6 +40,31 @@ class TpuSession:
     def set_conf(self, key, value):
         self.conf = self.conf.set(key, value)
 
+    def cluster_manager(self):
+        """Lazily start the driver/executor runtime (cluster/driver.py)
+        when spark.rapids.tpu.cluster.executors > 0."""
+        from .config import CLUSTER_EXECUTORS, CLUSTER_HEARTBEAT_TIMEOUT
+        cm = getattr(self, "_cluster", None)
+        if cm is None:
+            from .cluster import ClusterManager
+            cm = ClusterManager(
+                self.conf.get(CLUSTER_EXECUTORS),
+                heartbeat_timeout=self.conf.get(
+                    CLUSTER_HEARTBEAT_TIMEOUT))
+            cm.start()
+            self._cluster = cm
+            import atexit
+            atexit.register(cm.shutdown)
+        return cm
+
+    def stop(self):
+        cm = getattr(self, "_cluster", None)
+        if cm is not None:
+            cm.shutdown()
+            self._cluster = None
+        if TpuSession._active is self:
+            TpuSession._active = None
+
     # ------------------------------------------------------------------
     def create_dataframe(self, data, schema=None) -> "DataFrame":
         import pyarrow as pa
@@ -545,6 +570,39 @@ class DataFrame:
         """Per-operator metrics of the most recent action (GpuMetric
         analog; levels per spark.rapids.tpu.sql.metrics.level)."""
         return getattr(self, "_last_metrics", {})
+
+    def to_jax(self):
+        """Zero-copy export of the result as device arrays — the
+        ColumnarRdd analog (reference: sql-plugin-api ColumnarRdd,
+        zero-copy GPU handoff to ML/XGBoost). Returns
+        {column: (data, validity)} of jax Arrays already resident in
+        HBM; fixed-width columns only (strings keep Arrow export)."""
+        from .columnar import dtypes as _dt
+        from .ops.concat import concat_cvs, concat_masks
+        from .ops.gather import compact
+        for f in self.schema.fields:
+            if f.dtype.is_variable_width or f.dtype.is_nested:
+                raise TypeError(
+                    f"to_jax exports fixed-width columns; {f.name} is "
+                    f"{f.dtype.simple_name()} (use to_arrow)")
+        root, ctx = self._execute()
+        batches = []
+        for pid in range(root.num_partitions(ctx)):
+            batches.extend(root.execute_partition(ctx, pid))
+        if not batches:
+            import jax.numpy as jnp
+            return {f.name: (jnp.zeros(0, f.dtype.np_dtype),
+                             jnp.zeros(0, jnp.bool_))
+                    for f in self.schema.fields}
+        cvs = [concat_cvs([b.cvs()[i] for b in batches],
+                          self.schema.fields[i].dtype)
+               for i in range(len(self.schema.fields))]
+        mask = concat_masks([b.row_mask for b in batches])
+        from .utils.transfer import fetch_int
+        dense, count = compact(cvs, mask)
+        n = fetch_int(count)
+        return {f.name: (c.data[:n], c.validity[:n])
+                for f, c in zip(self.schema.fields, dense)}
 
     def collect(self) -> List[tuple]:
         at = self.to_arrow()

@@ -201,3 +201,62 @@ def test_grouped_first_last(session):
         nn = [v for v in vals if v is not None]
         assert got[k] == (vals[0], vals[-1], nn[0] if nn else None), \
             (k, got[k])
+
+
+def test_cached_whole_input_agg(session):
+    """HBM-cached small input takes the one-round-trip whole-input
+    program (complete mode, optimistic group capacity) and matches the
+    streaming path's results."""
+    import pyarrow as pa
+    from data_gen import IntegerGen, StringGen, gen_df
+    df, at = gen_df(session, [("k", StringGen(max_len=4, charset="abc")),
+                              ("g", IntegerGen(lo=0, hi=9)),
+                              ("v", IntegerGen(lo=-1000, hi=1000))],
+                    n=3000, seed=130)
+    cached = df.cache()
+    import spark_rapids_tpu.functions as F
+    out = cached.group_by("k", "g").agg(
+        F.sum("v").alias("s"), F.count("v").alias("c"),
+        F.avg("v").alias("a")).to_arrow()
+    from collections import defaultdict
+    acc = defaultdict(lambda: [0, 0])
+    for k, g, v in zip(at.column(0).to_pylist(),
+                       at.column(1).to_pylist(),
+                       at.column(2).to_pylist()):
+        if v is not None:
+            acc[(k, g)][0] += v
+            acc[(k, g)][1] += 1
+        else:
+            acc[(k, g)]
+    exp = []
+    for (k, g), (sv, c) in acc.items():
+        exp.append((k, g, sv if c else None, c,
+                    sv / c if c else None))
+    from asserts import assert_rows_equal
+    assert_rows_equal(out, exp)
+
+
+def test_cached_whole_input_agg_overflow_falls_back(session):
+    """More groups than the optimistic capacity: the overflow flag sends
+    execution down the exact multi-pass path with identical results."""
+    import numpy as np
+    import pyarrow as pa
+    import spark_rapids_tpu as st
+    import spark_rapids_tpu.functions as F
+    s2 = st.TpuSession({
+        "spark.rapids.tpu.sql.batchSizeRows": 4096,
+        "spark.rapids.tpu.sql.agg.optimisticGroups": 64,
+    })
+    rng = np.random.default_rng(131)
+    n = 2000
+    k = rng.integers(0, 500, n)   # 500 groups > 64
+    v = rng.integers(0, 100, n)
+    df = s2.create_dataframe({"k": pa.array(k),
+                              "v": pa.array(v)}).cache()
+    out = df.group_by("k").agg(F.sum("v").alias("s")).to_arrow()
+    from collections import defaultdict
+    acc = defaultdict(int)
+    for ki, vi in zip(k, v):
+        acc[ki] += vi
+    got = dict(zip(out.column(0).to_pylist(), out.column(1).to_pylist()))
+    assert got == {int(a): b for a, b in acc.items()}

@@ -1,0 +1,109 @@
+"""udf-compiler analog (reference: udf-compiler/ bytecode->Catalyst;
+here Python AST -> engine expressions), df_udf, and to_jax export."""
+import math
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.expressions import col
+from spark_rapids_tpu.expr.udf import PyUDF, df_udf, udf
+from spark_rapids_tpu.expr.udf_compiler import CompileError, compile_udf
+
+
+def _df(session, n=200, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.integers(-50, 50, n)
+    y = rng.integers(1, 20, n)
+    return (session.create_dataframe({"x": pa.array(x),
+                                      "y": pa.array(y)}),
+            x, y)
+
+
+def test_compiled_arith_lambda(session):
+    df, x, y = _df(session)
+    f = udf(lambda a, b: a * 2 + b - 1, dt.INT64)
+    e = f(col("x"), col("y"))
+    assert not isinstance(e, PyUDF)   # actually compiled
+    out = df.select(e.alias("r")).to_arrow()
+    assert out.column(0).to_pylist() == (x * 2 + y - 1).tolist()
+
+
+def test_compiled_conditional_and_compare(session):
+    df, x, y = _df(session, seed=1)
+
+    def clamped(a, b):
+        return a if a > b else b
+
+    f = udf(clamped, dt.INT64)
+    out = df.select(f(col("x"), col("y")).alias("r")).to_arrow()
+    assert out.column(0).to_pylist() == np.maximum(x, y).tolist()
+
+
+def test_compiled_builtins_and_math(session):
+    df, x, y = _df(session, seed=2)
+    f = udf(lambda a: abs(a) + 1, dt.INT64)
+    out = df.select(f(col("x")).alias("r")).to_arrow()
+    assert out.column(0).to_pylist() == (np.abs(x) + 1).tolist()
+    g = udf(lambda b: math.sqrt(b), dt.FLOAT64)
+    out = df.select(g(col("y")).alias("r")).to_arrow()
+    assert out.column(0).to_pylist() == pytest.approx(
+        np.sqrt(y).tolist())
+
+
+def test_compiled_closure_constant(session):
+    df, x, y = _df(session, seed=3)
+    k = 7
+    f = udf(lambda a: a + k, dt.INT64)
+    out = df.select(f(col("x")).alias("r")).to_arrow()
+    assert out.column(0).to_pylist() == (x + 7).tolist()
+
+
+def test_uncompilable_falls_back_to_pyudf(session):
+    df, x, y = _df(session, seed=4)
+
+    def weird(a):
+        return np.square(a)  # numpy call: outside the subset
+
+    f = udf(weird, dt.INT64)
+    e = f(col("x"))
+    assert isinstance(e, PyUDF)
+    out = df.select(e.alias("r")).to_arrow()
+    assert out.column(0).to_pylist() == (x * x).tolist()
+
+
+def test_compile_udf_string_methods(session):
+    df = session.create_dataframe(
+        {"s": pa.array(["Hello", "wOrLd", None, ""])})
+    f = udf(lambda s_: s_.upper(), dt.STRING)
+    out = df.select(f(col("s")).alias("r")).to_arrow()
+    assert out.column(0).to_pylist() == ["HELLO", "WORLD", None, ""]
+
+
+def test_compile_error_on_loops():
+    def loopy(a):
+        t = 0
+        for i in range(3):
+            t += a
+        return t
+    with pytest.raises(CompileError):
+        compile_udf(loopy, [col("x")])
+
+
+def test_df_udf_inline_expansion(session):
+    df, x, y = _df(session, seed=5)
+    rel = df_udf(lambda a, b: (a - b) * 10)
+    out = df.select(rel(col("x"), col("y")).alias("r")).to_arrow()
+    assert out.column(0).to_pylist() == ((x - y) * 10).tolist()
+
+
+def test_to_jax_export(session):
+    df, x, y = _df(session, seed=6)
+    out = df.filter(col("x") > 0).to_jax()
+    assert set(out) == {"x", "y"}
+    data, valid = out["x"]
+    keep = x > 0
+    assert np.asarray(data).tolist() == x[keep].tolist()
+    assert bool(np.asarray(valid).all())
