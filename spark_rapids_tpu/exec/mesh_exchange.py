@@ -1,18 +1,30 @@
-"""Mesh (ICI collective) shuffle exchange.
+"""Mesh (ICI collective) shuffle exchange — streaming, bounded-memory.
 
 The multi-chip execution heart: instead of the in-process file shuffle
-(shuffle/local.py — the MULTITHREADED-mode analog), the exchange runs as ONE
+(shuffle/local.py — the MULTITHREADED-mode analog), the exchange runs as a
 compiled SPMD program over a jax.sharding.Mesh: every shard computes target
 partition ids locally, then `jax.lax.all_to_all` moves row payloads (and
 string bytes) over ICI. Replaces the reference's UCX peer-to-peer transport
 (reference: RapidsShuffleInternalManagerBase.scala:56, shuffle-plugin
-UCXShuffleTransport.scala:49) with XLA collectives — no bounce buffers, no
-tag matching; XLA schedules the transfer.
+UCXShuffleTransport.scala:49) with XLA collectives.
 
-Downstream operators see one output partition per shard (device), each
-holding exactly the rows whose keys hash to that shard — the same ownership
-contract the hash file-shuffle provides, so per-partition aggregation/join
-run unchanged on top.
+Bounded memory (the bounce-buffer analog): the child is drained into
+per-shard input queues whose batches are registered as SPILLABLE handles,
+then exchanged in ROUNDS — each round every shard contributes at most one
+batch, padded to a fixed power-of-two row/byte capacity (the per-round
+"bounce buffer"), and ONE collective program (compiled once, reused every
+round) moves the rows. Received rows are compacted to a live prefix inside
+the program, sliced down to a bucketed capacity, and parked as spillable
+handles until the consumer pulls them. Peak device residency is therefore
+O(n_devices * round_capacity) for the in-flight round plus whatever the
+spill store lets accumulate — skew changes how many rounds a shard
+receives, not the padding (round-2's global-max padding multiplied memory
+by n_devices under skew).
+
+Downstream operators see `n` output partitions (one per shard/device), each
+yielding a stream of batches holding exactly the rows whose keys hash to
+that shard — the same ownership contract the hash file-shuffle provides, so
+per-partition aggregation/join run unchanged on top.
 """
 from __future__ import annotations
 
@@ -23,9 +35,11 @@ import jax
 import jax.numpy as jnp
 
 from ..columnar import dtypes as dt
+from ..columnar.column import bucket_capacity
 from ..columnar.table import Schema
 from ..expr.expressions import EmitCtx, Expression
-from ..ops.concat import concat_cvs, concat_masks, pad_cv, pad_mask
+from ..ops.concat import pad_cv, pad_mask
+from ..ops.gather import compact
 from ..ops.hash import partition_ids
 from ..ops.kernel_utils import CV
 from .base import ExecContext, TpuExec
@@ -36,7 +50,8 @@ __all__ = ["MeshExchangeExec"]
 
 
 class MeshExchangeExec(TpuExec):
-    """Hash partition exchange over a device mesh (one shard_map program)."""
+    """Hash partition exchange over a device mesh, in chunked collective
+    rounds with spillable accumulation on both sides."""
 
     def __init__(self, child: TpuExec, n_devices: int,
                  bound_keys: Sequence[Expression], schema: Schema,
@@ -46,7 +61,7 @@ class MeshExchangeExec(TpuExec):
         self.keys = list(bound_keys)
         self.axis_name = axis_name
         self._mesh = None
-        self._out: Optional[List[Optional[DeviceBatch]]] = None
+        self._out: Optional[List[List]] = None   # per shard: spill handles
         self._lock = threading.RLock()
         self._jit_cache = {}
 
@@ -64,7 +79,11 @@ class MeshExchangeExec(TpuExec):
         return self._mesh
 
     def _build_program(self, has_offsets):
-        """shard_map program: emit keys -> pids -> exchange_cvs."""
+        """shard_map program: emit keys -> pids -> exchange -> compact.
+
+        Per shard, returns the received rows compacted to a live prefix,
+        plus a stats vector [row_count, bytes_col0, bytes_col1, ...] so the
+        host can slice buffers down without extra device syncs."""
         from jax.sharding import PartitionSpec as P
         from ..parallel.collectives import exchange_cvs
 
@@ -80,7 +99,12 @@ class MeshExchangeExec(TpuExec):
             key_cvs = [k.emit(ectx) for k in self.keys]
             pids = partition_ids(key_cvs, key_dtypes, n)
             out_cvs, out_mask = exchange_cvs(cvs, mask, pids, n, axis)
-            return _flatten_cvs(out_cvs), out_mask
+            out_cvs, count = compact(out_cvs, out_mask)
+            stats = [count.astype(jnp.int64)]
+            for cv in out_cvs:
+                if cv.offsets is not None:
+                    stats.append(cv.offsets[count].astype(jnp.int64))
+            return _flatten_cvs(out_cvs), jnp.stack(stats)
 
         def step(flat, mask):
             return jax.shard_map(
@@ -96,116 +120,137 @@ class MeshExchangeExec(TpuExec):
         with self._lock:
             if self._out is not None:
                 return
+            from ..memory.spill import spill_store
+            store = spill_store(ctx.conf)
             m = ctx.metrics_for(self._op_id)
             mesh = self._get_mesh()
             child = self.children[0]
             n = self.n
 
-            # 1. drain the child, one input pile per shard (round-robin)
-            piles: List[List[DeviceBatch]] = [[] for _ in range(n)]
+            # 1. drain the child into per-shard round queues (round-robin
+            #    by batch); every queued batch is spillable immediately
+            piles: List[List] = [[] for _ in range(n)]
             i = 0
+            row_cap = 0
+            bcaps = [0] * len(self.schema.fields)
             for cpid in range(child.num_partitions(ctx)):
                 for b in child.execute_partition(ctx, cpid):
-                    piles[i % n].append(b)
+                    row_cap = max(row_cap, b.capacity)
+                    for ci, cv in enumerate(b.cvs()):
+                        if cv.offsets is not None:
+                            bcaps[ci] = max(bcaps[ci], cv.data.shape[0])
+                    piles[i % n].append(store.add_batch(b, priority=10))
                     i += 1
             if i == 0:
-                self._out = [None] * n
+                self._out = [[] for _ in range(n)]
                 return
 
-            # 2. concat each shard's pile; pad all shards to common shapes
-            with m.timer("partitionTime"):
-                shard_cvs, shard_masks = [], []
-                for pile in piles:
-                    if pile:
-                        cvs = [concat_cvs([b.cvs()[ci] for b in pile],
-                                          f.dtype)
-                               for ci, f in enumerate(self.schema.fields)]
-                        msk = concat_masks([b.row_mask for b in pile])
-                    else:
-                        cvs = [_empty_cv(f.dtype)
-                               for f in self.schema.fields]
-                        msk = jnp.zeros(128, jnp.bool_)
-                    shard_cvs.append(cvs)
-                    shard_masks.append(msk)
-                cap = max(mk.shape[0] for mk in shard_masks)
-                bcaps = [max(cvs[ci].data.shape[0]
-                             for cvs in shard_cvs)
-                         if f.dtype.is_variable_width else 0
-                         for ci, f in enumerate(self.schema.fields)]
-                for s in range(n):
-                    shard_cvs[s] = [
-                        _pad_shard_cv(cv, cap, bcaps[ci])
-                        for ci, cv in enumerate(shard_cvs[s])]
-                    shard_masks[s] = pad_mask(shard_masks[s], cap)
+            # fixed per-round capacities: power-of-two bucketed so padding
+            # amplification is a constant (<2x), not data-dependent
+            row_cap = bucket_capacity(row_cap)
+            has_offsets = [bc > 0 for bc in bcaps]
+            bcaps = [bucket_capacity(bc) if bc else 0 for bc in bcaps]
 
-                # 3. lay out globally: row-sharded [n*cap] per buffer
-                from jax.sharding import NamedSharding, PartitionSpec as P
-                sharding = NamedSharding(mesh, P(self.axis_name))
-                flat_global = []
-                ncols = len(self.schema.fields)
-                has_offsets = [cv.offsets is not None
-                               for cv in shard_cvs[0]]
-                for ci in range(ncols):
-                    parts = [shard_cvs[s][ci] for s in range(n)]
-                    flat_global.append(jax.device_put(
-                        jnp.concatenate([p.data for p in parts]), sharding))
-                    flat_global.append(jax.device_put(
-                        jnp.concatenate([p.validity for p in parts]),
-                        sharding))
-                    if has_offsets[ci]:
-                        flat_global.append(jax.device_put(
-                            jnp.concatenate([p.offsets for p in parts]),
-                            sharding))
-                mask_global = jax.device_put(
-                    jnp.concatenate(shard_masks), sharding)
-
-            # 4. one collective program
-            key = (tuple(has_offsets), cap,
-                   tuple(bc for bc in bcaps))
+            key = (tuple(has_offsets), row_cap, tuple(bcaps))
             prog = self._jit_cache.get(key)
             if prog is None:
                 prog = self._build_program(has_offsets)
                 self._jit_cache[key] = prog
-            with m.timer("exchangeTime"):
-                out_flat, out_mask = prog(flat_global, mask_global)
-                jax.block_until_ready(out_mask)
 
-            # 5. slice per-shard outputs into DeviceBatches
-            out_cap = n * cap
-            out = []
-            for s in range(n):
-                cvs = []
-                fi = 0
-                for ci, f in enumerate(self.schema.fields):
-                    if has_offsets[ci]:
-                        bc = n * bcaps[ci]
-                        data = out_flat[fi][s * bc:(s + 1) * bc]
-                        valid = out_flat[fi + 1][
-                            s * out_cap:(s + 1) * out_cap]
-                        offs = out_flat[fi + 2][
-                            s * (out_cap + 1):(s + 1) * (out_cap + 1)]
-                        cvs.append(CV(data, valid, offs))
-                        fi += 3
-                    else:
-                        data = out_flat[fi][s * out_cap:(s + 1) * out_cap]
-                        valid = out_flat[fi + 1][
-                            s * out_cap:(s + 1) * out_cap]
-                        cvs.append(CV(data, valid))
-                        fi += 2
-                msk = out_mask[s * out_cap:(s + 1) * out_cap]
-                nlive = int(jnp.sum(msk.astype(jnp.int32)))
-                # live rows are scattered (packed per SOURCE block), so the
-                # live-prefix length is the full capacity
-                tbl = make_table(self.schema, cvs, out_cap)
-                out.append(DeviceBatch(tbl, out_cap, msk, out_cap))
-                m.add("numOutputRows", nlive)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            sharding = NamedSharding(mesh, P(self.axis_name))
+            n_rounds = max(len(p) for p in piles)
+            out: List[List] = [[] for _ in range(n)]
+            n_str = sum(1 for h in has_offsets if h)
+            for rnd in range(n_rounds):
+                # 2. assemble this round's send buffers: one batch per
+                #    shard (or an empty pad), all at the fixed round caps
+                with m.timer("partitionTime"):
+                    shard_cvs, shard_masks = [], []
+                    for s in range(n):
+                        if rnd < len(piles[s]):
+                            h = piles[s][rnd]
+                            b = h.materialize()
+                            cvs = [_pad_round_cv(cv, row_cap, bcaps[ci])
+                                   for ci, cv in enumerate(b.cvs())]
+                            msk = pad_mask(b.row_mask, row_cap)
+                            h.close()
+                        else:
+                            cvs = [_empty_cv(f.dtype, row_cap, bcaps[ci])
+                                   for ci, f in
+                                   enumerate(self.schema.fields)]
+                            msk = jnp.zeros(row_cap, jnp.bool_)
+                        shard_cvs.append(cvs)
+                        shard_masks.append(msk)
+
+                    flat_global = []
+                    ncols = len(self.schema.fields)
+                    for ci in range(ncols):
+                        parts = [shard_cvs[s][ci] for s in range(n)]
+                        flat_global.append(jax.device_put(
+                            jnp.concatenate([p.data for p in parts]),
+                            sharding))
+                        flat_global.append(jax.device_put(
+                            jnp.concatenate([p.validity for p in parts]),
+                            sharding))
+                        if has_offsets[ci]:
+                            flat_global.append(jax.device_put(
+                                jnp.concatenate([p.offsets for p in parts]),
+                                sharding))
+                    mask_global = jax.device_put(
+                        jnp.concatenate(shard_masks), sharding)
+
+                # 3. one collective program per round (compiled once)
+                with m.timer("exchangeTime"):
+                    out_flat, stats = prog(flat_global, mask_global)
+                    stats_h = jax.device_get(stats).reshape(n, 1 + n_str)
+
+                # 4. slice each shard's live prefix to a bucketed capacity
+                #    and park it as a spillable handle
+                out_cap = n * row_cap
+                for s in range(n):
+                    nlive = int(stats_h[s, 0])
+                    if nlive == 0:
+                        continue
+                    # clamp to the shard's receive region: out_cap is not
+                    # a power of two when n_devices isn't
+                    new_cap = min(bucket_capacity(nlive), out_cap)
+                    cvs = []
+                    fi = 0
+                    si = 1
+                    for ci, f in enumerate(self.schema.fields):
+                        r0 = s * out_cap
+                        if has_offsets[ci]:
+                            bc = n * bcaps[ci]
+                            nbytes = int(stats_h[s, si])
+                            si += 1
+                            bcap_new = min(bucket_capacity(nbytes), bc)
+                            data = out_flat[fi][
+                                s * bc:s * bc + bcap_new]
+                            valid = out_flat[fi + 1][r0:r0 + new_cap]
+                            o0 = s * (out_cap + 1)
+                            offs = out_flat[fi + 2][
+                                o0:o0 + new_cap + 1]
+                            cvs.append(CV(data, valid, offs))
+                            fi += 3
+                        else:
+                            data = out_flat[fi][r0:r0 + new_cap]
+                            valid = out_flat[fi + 1][r0:r0 + new_cap]
+                            cvs.append(CV(data, valid))
+                            fi += 2
+                    tbl = make_table(self.schema, cvs, nlive)
+                    batch = DeviceBatch(tbl, nlive, None, new_cap)
+                    out[s].append(store.add_batch(batch, priority=5))
+                    m.add("numOutputRows", nlive)
             self._out = out
 
     def execute_partition(self, ctx: ExecContext, pid: int):
         self._ensure_exchanged(ctx)
-        b = self._out[pid]
-        if b is not None:
-            yield b
+        # handles stay open: the session caches exec trees, so a second
+        # action re-pulls the same partitions. Unused handles demote to
+        # host/disk under pressure instead of pinning HBM.
+        for h in self._out[pid]:
+            yield h.materialize()
 
 
 def _flatten_cvs(cvs: Sequence[CV]):
@@ -230,21 +275,24 @@ def _unflatten_cvs(flat, has_offsets):
     return cvs
 
 
-def _empty_cv(dtype: dt.DataType) -> CV:
+def _empty_cv(dtype: dt.DataType, cap: int, bcap: int) -> CV:
     if dtype.is_variable_width:
-        return CV(jnp.zeros(128, jnp.uint8), jnp.zeros(128, jnp.bool_),
-                  jnp.zeros(129, jnp.int32))
+        return CV(jnp.zeros(bcap, jnp.uint8), jnp.zeros(cap, jnp.bool_),
+                  jnp.zeros(cap + 1, jnp.int32))
     if isinstance(dtype, dt.DecimalType) and dtype.is_decimal128:
-        return CV(jnp.zeros((128, 2), jnp.int64), jnp.zeros(128, jnp.bool_))
-    return CV(jnp.zeros(128, dtype.np_dtype or jnp.int8),
-              jnp.zeros(128, jnp.bool_))
+        return CV(jnp.zeros((cap, 2), jnp.int64), jnp.zeros(cap, jnp.bool_))
+    return CV(jnp.zeros(cap, dtype.np_dtype or jnp.int8),
+              jnp.zeros(cap, jnp.bool_))
 
 
-def _pad_shard_cv(cv: CV, cap: int, byte_cap: int) -> CV:
+def _pad_round_cv(cv: CV, cap: int, byte_cap: int) -> CV:
     cv = pad_cv(cv, cap)
-    if cv.offsets is not None and cv.data.shape[0] < byte_cap:
-        extra = byte_cap - cv.data.shape[0]
-        cv = CV(jnp.concatenate([cv.data,
-                                 jnp.zeros(extra, jnp.uint8)]),
-                cv.validity, cv.offsets)
+    if cv.offsets is not None and cv.data.shape[0] != byte_cap:
+        if cv.data.shape[0] < byte_cap:
+            extra = byte_cap - cv.data.shape[0]
+            cv = CV(jnp.concatenate([cv.data,
+                                     jnp.zeros(extra, jnp.uint8)]),
+                    cv.validity, cv.offsets)
+        else:
+            cv = CV(cv.data[:byte_cap], cv.validity, cv.offsets)
     return cv
