@@ -37,19 +37,49 @@ def _best(fn, iters):
     return best
 
 
-def _backend_alive(timeout_s: int = 240) -> bool:
+def _probe_backend(timeout_s: int, env_extra=None):
     """Probe default-backend initialization in a SUBPROCESS: a broken TPU
     tunnel can hang jax.devices() forever, and a hung bench records
-    nothing. On timeout/failure the bench falls back to the CPU backend
-    (still one JSON line, flagged in extra)."""
+    nothing. Returns (ok, diagnostic-text)."""
     import subprocess
+    env = dict(os.environ)
+    env.update(env_extra or {})
+    here = os.path.dirname(os.path.abspath(__file__))
+    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
     try:
+        # import the package, not bare jax: spark_rapids_tpu/__init__.py is
+        # what reads SRTPU_COMPILE_CACHE, so the no-cache attempt actually
+        # exercises the no-cache configuration
         p = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True)
-        return p.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+            [sys.executable, "-c",
+             "import spark_rapids_tpu, jax; "
+             "print(jax.devices()[0].platform)"],
+            timeout=timeout_s, capture_output=True, env=env)
+        if p.returncode == 0:
+            return True, ""
+        tail = (p.stderr or b"")[-2000:].decode("utf-8", "replace")
+        return False, f"rc={p.returncode}: {tail}"
+    except subprocess.TimeoutExpired as e:
+        tail = (e.stderr or b"")[-2000:].decode("utf-8", "replace")
+        return False, f"timeout after {timeout_s}s: {tail}"
+
+
+def _backend_alive():
+    """Three-attempt probe with diagnosis (VERDICT r2: a fallback must
+    carry the exact TPU error, and the persistent compile cache must be
+    ruled out as the aggravator). Returns (ok, attempts)."""
+    attempts = []
+    for label, env, t in (
+            ("default", None, 240),
+            ("no-compile-cache", {"SRTPU_COMPILE_CACHE": "0"}, 240),
+            ("retry", None, 300)):
+        ok, err = _probe_backend(t, env)
+        if ok:
+            return True, attempts
+        attempts.append(f"[{label}] {err.strip()}")
+        print(f"bench: backend probe {label} failed: {err.strip()[:300]}",
+              file=sys.stderr)
+    return False, attempts
 
 
 def main():
@@ -59,11 +89,15 @@ def main():
     iters = int(os.environ.get("BENCH_ITERS", "5"))
     plat = os.environ.get("BENCH_PLATFORM")
     fellback = False
-    if not plat and not _backend_alive():
-        plat = "cpu"
-        fellback = True
-        print("bench: default backend unreachable; falling back to cpu",
-              file=sys.stderr)
+    tpu_errors = []
+    if not plat:
+        ok, tpu_errors = _backend_alive()
+        if not ok:
+            plat = "cpu"
+            fellback = True
+            print("bench: default backend unreachable after 3 probes; "
+                  "falling back to cpu — vs_baseline is NOT a TPU number",
+                  file=sys.stderr)
     if plat:
         # the axon site package overrides JAX_PLATFORMS; jax.config is the
         # only reliable way to pick a backend for local bench runs
@@ -180,6 +214,10 @@ def main():
         "value": round(rows_per_s, 1),
         "unit": "rows/s",
         "vs_baseline": round(cpu_q6 / tpu_q6, 3),
+        # LOUD top-level flag: a fallback run's vs_baseline is a CPU
+        # number, not a TPU number (VERDICT r2 weak #1)
+        **({"backend_fallback": "cpu (tpu unreachable)",
+            "tpu_probe_errors": tpu_errors} if fellback else {}),
         "extra": {
             "q6_hot_ms": round(tpu_q6 * 1e3, 2),
             "q6_cold_s": round(tpu_q6_cold, 3),

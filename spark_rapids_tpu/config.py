@@ -174,9 +174,24 @@ SORT_OOC_THRESHOLD = _conf(
     "sql.sort.outOfCore.thresholdBytes", 2 << 30,
     "Device bytes of sort input above which the out-of-core path "
     "activates.", int)
+AGG_MAX_MERGE_ROWS = _conf(
+    "sql.agg.maxMergeRows", 1 << 21,
+    "Upper bound on buffered partial-aggregate rows merged in one "
+    "concat pass. Buffered partials live in the spill store; when the "
+    "total group state exceeds this, the aggregation repartitions every "
+    "partial into hash buckets of disjoint keys and merges/finalizes "
+    "each bucket separately — the out-of-core fallback "
+    "(GpuAggregateExec.scala:863-894 repartition algorithm analog).", int)
 AGG_FORCE_MERGE_PASSES = _conf(
     "sql.agg.forceSinglePassMerge", False,
     "Testing: force aggregate merge in one concat pass.", bool, internal=True)
+JOIN_BUILD_BUDGET = _conf(
+    "sql.join.buildSideBudgetBytes", 2 << 30,
+    "When a join partition's build side exceeds this many bytes, both "
+    "sides are rehashed into disjoint-key sub-partitions (spillable "
+    "piles) joined one at a time, so builds bigger than device memory "
+    "complete instead of dying (GpuSubPartitionHashJoin.scala:617 "
+    "analog). 0 disables.", int)
 BROADCAST_THRESHOLD = _conf(
     "sql.autoBroadcastJoinThreshold", 10 * 1024 * 1024,
     "Build sides estimated at or below this many bytes use a broadcast "

@@ -32,9 +32,6 @@ from .nodes import make_table
 
 __all__ = ["UngroupedAggExec", "HashAggregateExec"]
 
-# Merge partial results eagerly once the buffered capacity crosses this.
-_MERGE_THRESHOLD_ROWS = 1 << 21
-
 # Hash-bucket first pass: O(n) scatter-reduce into this many buckets per
 # round (no sort), with exact per-bucket key verification; rows whose
 # bucket is owned by a different key retry the next round under a new
@@ -334,6 +331,77 @@ class HashAggregateExec(TpuExec):
         for si, npdt in enumerate(self._state_np_dtypes()):
             fields.append(Field(f"_s{si}", _dtype_for_np(npdt)))
         return Schema(fields)
+
+    @property
+    def _wire_schema(self) -> Schema:
+        """Partial-state wire schema — the format buffered partials take
+        when parked in the spill store, and the format partial mode
+        emits over the exchange."""
+        if getattr(self, "_wire_schema_c", None) is None:
+            self._wire_schema_c = (self.schema if self.mode == "partial"
+                                   else self._partial_schema(None))
+        return self._wire_schema_c
+
+    # -- spillable partial buffering (out-of-core aggregation) ----------
+    def _park(self, store, part):
+        """Wrap a (key_cvs, flat_states, seg_live, cap) partial as a
+        partial-format DeviceBatch and register it with the spill store,
+        so buffered group state demotes to host/disk under HBM pressure
+        instead of dying (reference: GpuAggregateExec buffered batches
+        are spillable)."""
+        ks, st, sl, cap = part
+        cvs = list(ks) + [CV(s, jnp.ones(cap, jnp.bool_)) for s in st]
+        tbl = make_table(self._wire_schema, cvs, cap)
+        return store.add_batch(DeviceBatch(tbl, cap, sl, cap), priority=8)
+
+    def _unpark(self, h, close=True):
+        b = h.materialize()
+        if close:
+            h.close()
+        cvs = b.cvs()
+        nkeys = len(self.keys)
+        return ([cv for cv in cvs[:nkeys]],
+                [cv.data for cv in cvs[nkeys:]], b.row_mask, b.capacity)
+
+    def _bucket_slice_fn(self, K: int):
+        """Device program extracting one of K disjoint-key hash buckets
+        from a partial: live rows whose key hashes to bucket `b` are
+        compacted to the front (the repartition half of the reference's
+        GpuAggregateExec.scala:863-894 fallback)."""
+        from ..ops.gather import compact
+        from ..ops.hash import partition_ids
+        key_dtypes = [k.dtype for k in self.keys]
+
+        def fn(ks, st, sl, b):
+            pids = partition_ids(ks, key_dtypes, K, seed=0x5EED)
+            mask_b = sl & (pids == b)
+            cvs_all = list(ks) + [CV(s, jnp.ones_like(sl)) for s in st]
+            out_cvs, count = compact(cvs_all, mask_b)
+            nkeys = len(ks)
+            return (out_cvs[:nkeys],
+                    [cv.data for cv in out_cvs[nkeys:]], count)
+        return jax.jit(fn)
+
+    def _shrink_to(self, ks, st, nlive: int):
+        """Slice a live-prefix partial down to a bucketed capacity."""
+        new_cap = bucket_capacity(max(nlive, 1))
+        cur = ks[0].validity.shape[0] if ks else (
+            st[0].shape[0] if st else new_cap)
+        new_cap = min(new_cap, cur)
+        idx = jnp.arange(new_cap)
+        in_bounds = idx < nlive
+        ks2 = []
+        for kcv in ks:
+            if kcv.offsets is not None:
+                nbytes = fetch_int(kcv.offsets[nlive]) if nlive else 0
+                byte_cap = bucket_capacity(max(nbytes, 1))
+                byte_cap = min(byte_cap, kcv.data.shape[0])
+                ks2.append(take_strings(kcv, idx, in_bounds=in_bounds,
+                                        out_data_capacity=byte_cap))
+            else:
+                ks2.append(CV(kcv.data[:new_cap], kcv.validity[:new_cap]))
+        st2 = [s[:new_cap] for s in st]
+        return (ks2, st2, idx < nlive, new_cap)
 
     def num_partitions(self, ctx):
         if self.mode in ("per_partition", "partial", "final"):
@@ -749,7 +817,6 @@ class HashAggregateExec(TpuExec):
         self._resolve_fusion()
         m = ctx.metrics_for(self._op_id)
         child = self._base
-        partials = []   # (key_cvs, flat_states, seg_live, capacity)
         child_pids = ([pid] if self.mode in ("per_partition", "partial",
                                              "final")
                       else range(child.num_partitions(ctx)))
@@ -784,18 +851,34 @@ class HashAggregateExec(TpuExec):
             ks, st, sl = fn(b.cvs(), b.row_mask)
             return (ks, st, sl, b.capacity)
 
+        from ..config import AGG_MAX_MERGE_ROWS
         from ..memory.retry import with_retry
+        from ..memory.spill import spill_store
+        store = spill_store(ctx.conf)
+        max_rows = ctx.conf.get(AGG_MAX_MERGE_ROWS)
+        handles = []            # (spill handle, capacity)
+        buffered = 0
+        compactable = True      # do eager merges still shrink the state?
         for cpid in child_pids:
             for batch in child.execute_partition(ctx, cpid):
                 with m.timer("opTime"):
                     # split-and-retry: idempotent per-batch first-pass agg
                     # re-executes on halves under memory pressure
                     for part in with_retry(batch, update_one):
-                        partials.append(part)
-                if sum(p[3] for p in partials) > _MERGE_THRESHOLD_ROWS \
-                        and len(partials) > 1:
-                    partials = [self._merge_partials(partials)]
-        if not partials:
+                        handles.append((self._park(store, part), part[3]))
+                        buffered += part[3]
+                if compactable and buffered > max_rows and len(handles) > 1:
+                    with m.timer("opTime"):
+                        parts = [self._unpark(h) for h, _ in handles]
+                        merged = self._merge_partials(parts)
+                        handles = [(self._park(store, merged), merged[3])]
+                        buffered = merged[3]
+                        if merged[3] > max_rows // 2:
+                            # high cardinality: merging no longer
+                            # compacts; buffer spillably and let the
+                            # bucket fallback split the final pass
+                            compactable = False
+        if not handles:
             if self.mode != "partial":
                 yield DeviceBatch(make_table(self.schema, [
                     CV(jnp.zeros(128, f.dtype.np_dtype or jnp.int8),
@@ -805,53 +888,83 @@ class HashAggregateExec(TpuExec):
                     for f in self.schema.fields], 0),
                     0, jnp.zeros(128, jnp.bool_), 128)
             return
-        with m.timer("opTime"):
-            never_merged = len(partials) == 1
-            while len(partials) > 1:
-                partials = [self._merge_partials(partials)]
-            if (self.mode != "partial" and never_merged
-                    and partials[0][3] > 4096):
-                # single-partial finalize would fetch at input capacity;
-                # one merge pass sorts live groups first and compacts the
-                # output to the group count
-                partials = [self._merge_partials(partials)]
-            ks, st, sl, cap = partials[0]
-            if self.mode == "partial":
-                cvs = list(ks) + [
-                    CV(s, jnp.ones(cap, jnp.bool_)) for s in st]
-                tbl = make_table(self.schema, cvs, cap)
-                m.add("numOutputBatches", 1)
-                yield DeviceBatch(tbl, cap, sl, cap)
-                return
-            outs = self._finalize_jit(ks, st, sl)
+        yield from self._emit_final(ctx, m, handles)
+
+    def _emit_final(self, ctx: ExecContext, m, handles,
+                    force_merge: bool = False):
+        """Merge parked partials and emit finalized (or partial-format)
+        batches under a bounded merge width: when the buffered group
+        state exceeds maxMergeRows, repartition every partial into K
+        hash buckets of disjoint keys and merge+emit per bucket — the
+        out-of-core fallback (GpuAggregateExec.scala:863-894)."""
+        from ..config import AGG_MAX_MERGE_ROWS
+        max_rows = ctx.conf.get(AGG_MAX_MERGE_ROWS)
+        total = sum(c for _, c in handles)
+        K = 1
+        while K < 16 and total > K * max_rows:
+            K *= 2
+        emit_partial = self.mode == "partial"
+        if K == 1:
+            with m.timer("opTime"):
+                parts = [self._unpark(h) for h, _ in handles]
+                if (len(parts) > 1 or force_merge
+                        or (not emit_partial and parts[0][3] > 4096)):
+                    # the merge pass also sorts live groups first and
+                    # compacts the output to the group count
+                    part = self._merge_partials(parts)
+                else:
+                    part = parts[0]
+                out = self._emit_batch(part, m, emit_partial)
+            yield out
+            return
+        fn = self._update_cache.get(("bslice", K))
+        if fn is None:
+            fn = self._bucket_slice_fn(K)
+            self._update_cache[("bslice", K)] = fn
+        for b in range(K):
+            with m.timer("opTime"):
+                parts_b = []
+                for h, _ in handles:
+                    ks, st, sl, cap = self._unpark(h, close=(b == K - 1))
+                    oks, ost, cnt = fn(ks, st, sl, jnp.int32(b))
+                    nlive = fetch_int(cnt)
+                    if nlive == 0:
+                        continue
+                    parts_b.append(self._shrink_to(oks, ost, nlive))
+                if not parts_b:
+                    continue
+                part = self._merge_partials(parts_b)
+                out = self._emit_batch(part, m, emit_partial)
+            yield out
+
+    def _emit_batch(self, part, m, emit_partial: bool) -> DeviceBatch:
+        ks, st, sl, cap = part
+        if emit_partial:
+            cvs = list(ks) + [CV(s, jnp.ones(cap, jnp.bool_)) for s in st]
+            tbl = make_table(self.schema, cvs, cap)
+            m.add("numOutputBatches", 1)
+            return DeviceBatch(tbl, cap, sl, cap)
+        outs = self._finalize_jit(ks, st, sl)
         tbl = make_table(self.schema, outs, cap)
         m.add("numOutputBatches", 1)
-        yield DeviceBatch(tbl, cap, sl, cap)
+        return DeviceBatch(tbl, cap, sl, cap)
 
     def _execute_final(self, ctx: ExecContext, pid: int, m):
         """Merge partial-format batches (keys + state columns) arriving
         from the exchange, then finalize — the final-mode half of the
-        partial/final split."""
-        nkeys = len(self.keys)
-        partials = []
+        partial/final split. Arriving batches buffer spillably; a merge
+        pass ALWAYS runs (a single exchanged batch still holds same-key
+        partial rows from different map partitions), bucket-split when
+        the combined state exceeds the merge bound."""
+        from ..memory.spill import spill_store
+        store = spill_store(ctx.conf)
+        handles = []
         for batch in self.children[0].execute_partition(ctx, pid):
-            cvs = batch.cvs()
-            ks = cvs[:nkeys]
-            st = [cv.data for cv in cvs[nkeys:]]
-            partials.append((ks, st, batch.row_mask, batch.capacity))
-        if not partials:
+            handles.append((store.add_batch(batch, priority=8),
+                            batch.capacity))
+        if not handles:
             return
-        with m.timer("opTime"):
-            # always run >= 1 merge pass: a single exchanged batch still
-            # holds same-key partial rows from different map partitions
-            partials = [self._merge_partials(partials)]
-            while len(partials) > 1:
-                partials = [self._merge_partials(partials)]
-            ks, st, sl, cap = partials[0]
-            outs = self._finalize_jit(ks, st, sl)
-        tbl = make_table(self.schema, outs, cap)
-        m.add("numOutputBatches", 1)
-        yield DeviceBatch(tbl, cap, sl, cap)
+        yield from self._emit_final(ctx, m, handles, force_merge=True)
 
     def _merge_partials(self, partials):
         if len(partials) == 1:

@@ -94,26 +94,30 @@ class HashJoinExec(TpuExec):
         return f"HashJoinExec[{self.how}, {mode}]"
 
     # ------------------------------------------------------------------
-    def _collect_side(self, ctx, child, key_exprs, pids=None):
-        batches = []
-        for pid in (pids if pids is not None
-                    else range(child.num_partitions(ctx))):
-            batches.extend(child.execute_partition(ctx, pid))
+    @staticmethod
+    def _concat_batches(batches, schema: Schema):
         if not batches:
             cvs = [CV(jnp.zeros(128, f.dtype.np_dtype or jnp.int8),
                       jnp.zeros(128, jnp.bool_),
                       jnp.zeros(129, jnp.int32)
                       if f.dtype.is_variable_width else None)
-                   for f in child.schema.fields]
+                   for f in schema.fields]
             return cvs, jnp.zeros(128, jnp.bool_)
         ncols = len(batches[0].table.columns)
         if len(batches) == 1:
             return batches[0].cvs(), batches[0].row_mask
         cvs = [concat_cvs([b.cvs()[i] for b in batches],
-                          child.schema.fields[i].dtype)
+                          schema.fields[i].dtype)
                for i in range(ncols)]
         mask = concat_masks([b.row_mask for b in batches])
         return cvs, mask
+
+    def _collect_side(self, ctx, child, key_exprs, pids=None):
+        batches = []
+        for pid in (pids if pids is not None
+                    else range(child.num_partitions(ctx))):
+            batches.extend(child.execute_partition(ctx, pid))
+        return self._concat_batches(batches, child.schema)
 
     def _key_nchunks(self, bkey_cvs, bmask, skey_cvs, smask):
         ncs = []
@@ -320,15 +324,40 @@ class HashJoinExec(TpuExec):
             return
         m = ctx.metrics_for(self._op_id)
         left, right = self.children
-        build_pids = [pid] if self.per_partition else None
+        build_pids = ([pid] if self.per_partition
+                      else range(right.num_partitions(ctx)))
         with m.timer("buildTime"):
-            bcvs, bmask = self._collect_side(ctx, right, self.rkeys,
-                                             pids=build_pids)
+            bbatches = []
+            for bpid in build_pids:
+                bbatches.extend(right.execute_partition(ctx, bpid))
+
+        from ..config import JOIN_BUILD_BUDGET
+        budget = ctx.conf.get(JOIN_BUILD_BUDGET)
+        total_bytes = sum(b.nbytes for b in bbatches)
+        if budget > 0 and total_bytes > budget and self.lkeys:
+            yield from self._execute_subpartitioned(
+                ctx, m, pid, bbatches, total_bytes, budget)
+            return
+
+        def stream():
+            for lpid in ([pid] if self.per_partition
+                         else range(left.num_partitions(ctx))):
+                yield from left.execute_partition(ctx, lpid)
+
+        yield from self._join_pass(ctx, m, bbatches, stream())
+
+    def _join_pass(self, ctx: ExecContext, m, bbatches, stream_batches):
+        """One complete hash-join pass: concat the given build batches,
+        probe every stream batch, emit unmatched build rows for
+        right/full. Called once normally; once per disjoint-key
+        sub-partition in the out-of-core path."""
+        left, right = self.children
+        with m.timer("buildTime"):
+            bcvs, bmask = self._concat_batches(bbatches, right.schema)
             cap_b = bmask.shape[0]
             bctx = EmitCtx(bcvs, cap_b)
             bkey_cvs = [k.emit(bctx) for k in self.rkeys]
         matched_b_acc = jnp.zeros(cap_b, jnp.bool_)
-        nl = len(left.schema.fields)
         fast = self._fast_path_ok()
         if fast:
             with m.timer("buildTime"):
@@ -349,15 +378,13 @@ class HashJoinExec(TpuExec):
                                          n_valid_b if fast else None))
             return out
 
-        for lpid in ([pid] if self.per_partition
-                     else range(left.num_partitions(ctx))):
-            for batch in left.execute_partition(ctx, lpid):
-                for results in with_retry(batch, probe_one):
-                    for kind, payload in results:
-                        if kind == "matched_b":
-                            matched_b_acc = matched_b_acc | payload
-                        else:
-                            yield payload
+        for batch in stream_batches:
+            for results in with_retry(batch, probe_one):
+                for kind, payload in results:
+                    if kind == "matched_b":
+                        matched_b_acc = matched_b_acc | payload
+                    else:
+                        yield payload
 
         if self.how in ("right", "full"):
             unmatched = bmask & ~matched_b_acc
@@ -369,6 +396,109 @@ class HashJoinExec(TpuExec):
                             for cv in bcvs]
                 tbl = make_table(self.schema, out_cvs, cap_b)
                 yield DeviceBatch(tbl, cap_b, unmatched, cap_b)
+
+    # ---- out-of-core: disjoint-key sub-partition loop ------------------
+    def _subpartition_fn(self, key_exprs, S: int):
+        """Device program extracting hash sub-partition `b` of a batch:
+        rows whose join-key hash lands in bucket b compact to the front
+        (GpuSubPartitionHashJoin.scala:617 rehash, TPU-style)."""
+        from ..ops.gather import compact
+        from ..ops.hash import partition_ids
+        key_dtypes = [k.dtype for k in key_exprs]
+
+        def fn(cvs, mask, b):
+            cap = mask.shape[0]
+            ectx = EmitCtx(cvs, cap)
+            key_cvs = [k.emit(ectx) for k in key_exprs]
+            pids = partition_ids(key_cvs, key_dtypes, S, seed=0xAB5)
+            mask_b = mask & (pids == b)
+            out_cvs, count = compact(cvs, mask_b)
+            return out_cvs, count
+        return jax.jit(fn)
+
+    def _shrink_batch(self, schema: Schema, out_cvs, nlive: int):
+        """Slice a compacted (live-prefix) batch down to a bucketed
+        capacity; nested columns keep their capacity (offset/child
+        re-slicing is not worth the complexity here)."""
+        from ..ops.gather import take_strings as _ts
+        cap = out_cvs[0].validity.shape[0] if out_cvs else 128
+        if any(cv.children for cv in out_cvs):
+            tbl = make_table(schema, out_cvs, nlive)
+            return DeviceBatch(tbl, nlive, jnp.arange(cap) < nlive, cap)
+        new_cap = min(bucket_capacity(max(nlive, 1)), cap)
+        cvs2 = []
+        idx = jnp.arange(new_cap)
+        inb = idx < nlive
+        for cv in out_cvs:
+            if cv.offsets is not None:
+                nbytes = fetch_int(cv.offsets[nlive]) if nlive else 0
+                bcap = min(bucket_capacity(max(nbytes, 1)),
+                           cv.data.shape[0])
+                cvs2.append(_ts(cv, idx, in_bounds=inb,
+                                out_data_capacity=bcap))
+            else:
+                cvs2.append(CV(cv.data[:new_cap], cv.validity[:new_cap]))
+        tbl = make_table(schema, cvs2, nlive)
+        return DeviceBatch(tbl, nlive, inb, new_cap)
+
+    def _execute_subpartitioned(self, ctx: ExecContext, m, pid, bbatches,
+                                total_bytes: int, budget: int):
+        """Build side exceeds its budget: rehash BOTH sides into S
+        disjoint-key sub-partitions parked as spillable piles, then run
+        an independent join pass per sub-partition. Keys are disjoint
+        across buckets, so every join type decomposes exactly
+        (reference: GpuSubPartitionHashJoin.scala:617 — 16-bucket
+        repartition-and-loop; here S scales with the overflow)."""
+        from ..memory.spill import spill_store
+        store = spill_store(ctx.conf)
+        left, right = self.children
+        S = 2
+        while S < 16 and total_bytes > S * budget:
+            S *= 2
+        m.add("numSubPartitions", S)
+
+        bfn = self._subpartition_fn(self.rkeys, S)
+        piles_b: List[List] = [[] for _ in range(S)]
+        with m.timer("buildTime"):
+            for b in bbatches:
+                for s in range(S):
+                    out_cvs, cnt = bfn(b.cvs(), b.row_mask, jnp.int32(s))
+                    nlive = fetch_int(cnt)
+                    if nlive == 0:
+                        continue
+                    sb = self._shrink_batch(right.schema, out_cvs, nlive)
+                    piles_b[s].append(store.add_batch(sb, priority=7))
+        del bbatches
+
+        sfn = self._subpartition_fn(self.lkeys, S)
+        piles_s: List[List] = [[] for _ in range(S)]
+        for lpid in ([pid] if self.per_partition
+                     else range(left.num_partitions(ctx))):
+            for batch in left.execute_partition(ctx, lpid):
+                with m.timer("opTime"):
+                    for s in range(S):
+                        out_cvs, cnt = sfn(batch.cvs(), batch.row_mask,
+                                           jnp.int32(s))
+                        nlive = fetch_int(cnt)
+                        if nlive == 0:
+                            continue
+                        sb = self._shrink_batch(left.schema, out_cvs,
+                                                nlive)
+                        piles_s[s].append(
+                            store.add_batch(sb, priority=7))
+
+        for s in range(S):
+            builds = []
+            for h in piles_b[s]:
+                builds.append(h.materialize())
+                h.close()
+
+            def stream_s(handles=piles_s[s]):
+                for h in handles:
+                    yield h.materialize()
+                    h.close()
+
+            yield from self._join_pass(ctx, m, builds, stream_s())
 
     def _probe_batch(self, ctx, m, batch, bcvs, bmask, bkey_cvs, cap_b,
                      fast, sorted_ukey, bperm, n_valid_b):

@@ -260,3 +260,64 @@ def test_cached_whole_input_agg_overflow_falls_back(session):
         acc[ki] += vi
     got = dict(zip(out.column(0).to_pylist(), out.column(1).to_pylist()))
     assert got == {int(a): b for a, b in acc.items()}
+
+
+def test_groupby_out_of_core_bucket_fallback(tmp_path, monkeypatch):
+    """Distinct-key groupby whose group state exceeds the merge bound AND
+    the device budget: partials park in the spill store, the final pass
+    repartitions into hash buckets of disjoint keys, and the answer is
+    exact (GpuAggregateExec.scala:863-894 repartition fallback analog)."""
+    import numpy as np
+    import pyarrow as pa
+    import spark_rapids_tpu as st
+    import spark_rapids_tpu.functions as F
+    import spark_rapids_tpu.memory.device as dev_mod
+    import spark_rapids_tpu.memory.spill as spill_mod
+
+    dm = dev_mod.DeviceManager(budget_bytes=256 << 10)
+    store = spill_mod.SpillStore(dm, spill_dir=str(tmp_path))
+    monkeypatch.setattr(dev_mod, "_GLOBAL", dm)
+    monkeypatch.setattr(spill_mod, "_STORE", store)
+
+    n = 20000
+    rng = np.random.default_rng(97)
+    keys = rng.permutation(n).astype(np.int64)      # every key distinct
+    vals = rng.integers(-100, 100, n).astype(np.int64)
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.batchSizeRows": 1024,
+        "spark.rapids.tpu.sql.agg.maxMergeRows": 2048,
+        "spark.rapids.tpu.sql.agg.optimisticGroups": 0,
+    })
+    out = s.create_dataframe({"k": pa.array(keys), "v": pa.array(vals)}) \
+        .group_by("k").agg(F.sum("v").alias("sv"),
+                           F.count("v").alias("c")).to_arrow()
+    got = {out.column(0)[i].as_py(): (out.column(1)[i].as_py(),
+                                      out.column(2)[i].as_py())
+           for i in range(out.num_rows)}
+    want = {int(k): (int(v), 1) for k, v in zip(keys, vals)}
+    assert got == want
+    assert store.metrics["spillToHost"] > 0, store.metrics
+
+
+def test_groupby_out_of_core_string_keys(tmp_path, monkeypatch):
+    """The bucket fallback with string keys: take_strings-based shrink
+    paths and per-bucket merges keep exact contents."""
+    import numpy as np
+    import pyarrow as pa
+    import spark_rapids_tpu as st
+    import spark_rapids_tpu.functions as F
+
+    n = 6000
+    rng = np.random.default_rng(99)
+    keys = [f"user-{i:05d}" for i in rng.permutation(n)]
+    vals = rng.integers(0, 50, n).astype(np.int64)
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.batchSizeRows": 512,
+        "spark.rapids.tpu.sql.agg.maxMergeRows": 1024,
+        "spark.rapids.tpu.sql.agg.optimisticGroups": 0,
+    })
+    out = s.create_dataframe({"k": pa.array(keys), "v": pa.array(vals)}) \
+        .group_by("k").agg(F.max("v").alias("mx")).to_arrow()
+    got = dict(zip(out.column(0).to_pylist(), out.column(1).to_pylist()))
+    want = {k: int(v) for k, v in zip(keys, vals)}
+    assert got == want
