@@ -40,28 +40,17 @@ def _best(fn, iters):
 def _probe_backend(timeout_s: int, env_extra=None):
     """Probe default-backend initialization in a SUBPROCESS: a broken TPU
     tunnel can hang jax.devices() forever, and a hung bench records
-    nothing. Returns (ok, diagnostic-text)."""
-    import subprocess
-    env = dict(os.environ)
-    env.update(env_extra or {})
-    here = os.path.dirname(os.path.abspath(__file__))
-    env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
-    try:
-        # import the package, not bare jax: spark_rapids_tpu/__init__.py is
-        # what reads SRTPU_COMPILE_CACHE, so the no-cache attempt actually
-        # exercises the no-cache configuration
-        p = subprocess.run(
-            [sys.executable, "-c",
-             "import spark_rapids_tpu, jax; "
-             "print(jax.devices()[0].platform)"],
-            timeout=timeout_s, capture_output=True, env=env)
-        if p.returncode == 0:
-            return True, ""
-        tail = (p.stderr or b"")[-2000:].decode("utf-8", "replace")
-        return False, f"rc={p.returncode}: {tail}"
-    except subprocess.TimeoutExpired as e:
-        tail = (e.stderr or b"")[-2000:].decode("utf-8", "replace")
-        return False, f"timeout after {timeout_s}s: {tail}"
+    nothing. Delegates to tools/tpu_probe.py (single implementation),
+    which arms faulthandler INSIDE the child so a hang yields the stack
+    of the blocked init (VERDICT r3 missing #1: "timeout" alone is not a
+    diagnosis). Returns (ok, diagnostic-text)."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "tools"))
+    from tpu_probe import probe
+    r = probe(float(timeout_s), env_extra)
+    if r.get("ok"):
+        return True, ""
+    return False, f"[{r.get('reason')}] {r.get('diagnosis', '')[:3000]}"
 
 
 def _backend_alive():
@@ -192,6 +181,9 @@ def main():
     c_seg = np.select(
         [cust.column("c_mktsegment").to_numpy(zero_copy_only=False) == s_
          for s_ in segs], [0, 1, 2, 3, 4])
+    # best-of-3 for the baseline too: r2 recorded a single 2.33s sample
+    # for a loop that takes 0.41s warm, and the resulting "4.49x" was an
+    # artifact that r3 then "regressed" from (VERDICT r3 missing #2)
     cpu_q3 = _best(lambda: tpch.q3_numpy_baseline(
         cust.column("c_custkey").to_numpy(), c_seg,
         orders.column("o_orderkey").to_numpy(),
@@ -200,7 +192,7 @@ def main():
         orders.column("o_shippriority").to_numpy(),
         at3.column("l_orderkey").to_numpy(),
         at3.column("l_shipdate").to_numpy(),
-        unscaled(at3, "l_extendedprice"), unscaled(at3, "l_discount")), 1)
+        unscaled(at3, "l_extendedprice"), unscaled(at3, "l_discount")), 3)
     df3 = s.create_dataframe(at3).cache()
     cust_df = s.create_dataframe(cust).cache()
     ord_df = s.create_dataframe(orders).cache()
@@ -209,6 +201,37 @@ def main():
     tpu_q3 = _best(lambda: q3.to_arrow(), 2)
 
     rows_per_s = n / tpu_q6
+    extra = {
+        "q6_hot_ms": round(tpu_q6 * 1e3, 2),
+        "q6_cold_s": round(tpu_q6_cold, 3),
+        "q6_cold_rows_per_sec": round(n / tpu_q6_cold, 1),
+        "q1_sf": sf_agg,
+        "q1_rows_per_sec": round(n1 / tpu_q1, 1),
+        "q1_vs_numpy": round(cpu_q1 / tpu_q1, 3),
+        "q3_sf": sf_join,
+        "q3_s": round(tpu_q3, 3),
+        "q3_vs_numpy": round(cpu_q3 / tpu_q3, 3),
+        **({"backend_fallback": "cpu (tpu unreachable)"}
+           if fellback else {}),
+    }
+    # ---- regression gate vs the previous round's JSON -------------------
+    # Engine-time metrics only (rows/s, q*_s): the *_vs_numpy ratios mix in
+    # the baseline sample and the host machine, which is exactly how the
+    # r2->r3 "Q3 regression" was misread (VERDICT r3 weak #9 / missing #2).
+    try:
+        regressions = _regression_gate({
+            "q6_rows_per_sec": rows_per_s,
+            "q1_rows_per_sec": n1 / tpu_q1,
+            "q3_s": tpu_q3,
+        }, fellback, {"q1_sf": sf_agg, "q3_sf": sf_join, "q6_sf": sf})
+    except Exception as e:  # advisory: never lose the bench result
+        regressions = []
+        extra["regression_gate_error"] = repr(e)
+        print(f"bench: regression gate failed: {e!r}", file=sys.stderr)
+    if regressions:
+        extra["regressions_vs_prev_round"] = regressions
+        for r in regressions:
+            print(f"bench: REGRESSION {r}", file=sys.stderr)
     print(json.dumps({
         "metric": f"tpch_q6_sf{sf}_rows_per_sec",
         "value": round(rows_per_s, 1),
@@ -218,20 +241,61 @@ def main():
         # number, not a TPU number (VERDICT r2 weak #1)
         **({"backend_fallback": "cpu (tpu unreachable)",
             "tpu_probe_errors": tpu_errors} if fellback else {}),
-        "extra": {
-            "q6_hot_ms": round(tpu_q6 * 1e3, 2),
-            "q6_cold_s": round(tpu_q6_cold, 3),
-            "q6_cold_rows_per_sec": round(n / tpu_q6_cold, 1),
-            "q1_sf": sf_agg,
-            "q1_rows_per_sec": round(n1 / tpu_q1, 1),
-            "q1_vs_numpy": round(cpu_q1 / tpu_q1, 3),
-            "q3_sf": sf_join,
-            "q3_s": round(tpu_q3, 3),
-            "q3_vs_numpy": round(cpu_q3 / tpu_q3, 3),
-            **({"backend_fallback": "cpu (tpu unreachable)"}
-               if fellback else {}),
-        },
+        "extra": extra,
     }))
+
+
+def _regression_gate(current: dict, fellback: bool, sfs: dict):
+    """Compare engine-time metrics against the newest BENCH_r*.json that
+    ran on the same backend class (fallback vs real). Returns a list of
+    human-readable regression strings for slips >15%."""
+    import glob
+    import re
+    here = os.path.dirname(os.path.abspath(__file__))
+    rounds = []  # (round_number, path) — advisory gate: never crash bench
+    for path in glob.glob(os.path.join(here, "BENCH_r*.json")):
+        m0 = re.fullmatch(r"BENCH_r(\d+)\.json", os.path.basename(path))
+        if m0:
+            rounds.append((int(m0.group(1)), path))
+    prev = None
+    for _, path in sorted(rounds, reverse=True):
+        try:
+            with open(path) as f:
+                parsed = json.load(f).get("parsed") or {}
+        except Exception:
+            continue
+        was_fallback = "backend_fallback" in parsed
+        if was_fallback != fellback:
+            continue  # cross-backend comparison is meaningless
+        prev = (os.path.basename(path), parsed)
+        break
+    if prev is None:
+        return []
+    name, parsed = prev
+    extra = parsed.get("extra") or {}
+    metric = parsed.get("metric", "")
+    m = re.search(r"sf([\d.]+)", metric)
+    prev_sfs = {"q6_sf": float(m.group(1)) if m else None,
+                "q1_sf": extra.get("q1_sf"), "q3_sf": extra.get("q3_sf")}
+    prev_vals = {
+        "q6_rows_per_sec": parsed.get("value"),
+        "q1_rows_per_sec": extra.get("q1_rows_per_sec"),
+        "q3_s": extra.get("q3_s"),
+    }
+    out = []
+    for k, cur in current.items():
+        old = prev_vals.get(k)
+        if not old or not cur:
+            continue
+        sf_key = k.split("_")[0] + "_sf"
+        if prev_sfs.get(sf_key) != sfs.get(sf_key):
+            continue  # different scale factor: not comparable
+        # q3_s is time (lower better); rows/s higher better
+        ratio = (old / cur) if k.endswith("_s") else (cur / old)
+        if ratio < 0.85:
+            out.append(f"{k}: {cur:.4g} vs {old:.4g} in {name} "
+                       f"({ratio:.2f}x)")
+    return out
 
 
 if __name__ == "__main__":

@@ -36,7 +36,6 @@ from ..columnar.table import Schema
 from ..expr.expressions import EmitCtx, Expression
 from ..ops import sortkeys as sk
 from ..ops.concat import concat_cvs, concat_masks
-from ..ops.gather import take
 from ..ops.kernel_utils import CV
 from ..utils.transfer import fetch_int
 from .base import ExecContext, TpuExec
@@ -298,24 +297,10 @@ class HashJoinExec(TpuExec):
         return fn
 
     def _gather_cols(self, cvs, idx, inb):
-        """Gather payload columns by idx; var-width columns (strings AND
-        nested lists, recursively) get output capacities sized from the
-        actual gathered unit totals — join expansion duplicates rows, so
-        source capacities are not upper bounds."""
-        from ..ops.gather import take_measures
-        var_cols = [i for i, cv in enumerate(cvs)
-                    if cv.offsets is not None or cv.children]
-        caps = {}
-        if var_cols:
-            measures = {i: take_measures(cvs[i], idx, inb)
-                        for i in var_cols}
-            from ..utils.transfer import fetch
-            got = fetch(measures)
-            caps = {i: tuple(bucket_capacity(max(int(v), 1)) for v in ms)
-                    for i, ms in got.items()}
-        return [take(cv, idx, in_bounds=inb,
-                     caps=iter(caps[i]) if i in caps and caps[i] else None)
-                for i, cv in enumerate(cvs)]
+        """Gather payload columns by idx — join expansion duplicates rows,
+        so var-width capacities are re-measured (ops.gather.gather_cols)."""
+        from ..ops.gather import gather_cols
+        return gather_cols(cvs, idx, inb)
 
     # ------------------------------------------------------------------
     def execute_partition(self, ctx: ExecContext, pid: int):

@@ -374,6 +374,7 @@ class LimitExec(TpuExec):
     def __init__(self, child: TpuExec, n: int):
         super().__init__([child], child.schema)
         self.n = n
+        self._ncap = bucket_capacity(max(n, 1))
 
         def _clip(mask, remaining):
             ranks = jnp.cumsum(mask.astype(jnp.int64))
@@ -381,6 +382,14 @@ class LimitExec(TpuExec):
             return new_mask, jnp.sum(new_mask.astype(jnp.int64))
 
         self._jit = jax.jit(_clip)
+        ncap = self._ncap
+
+        def _perm(mask):
+            from ..ops.gather import compaction_perm
+            perm, count = compaction_perm(mask)
+            return perm[:ncap], jnp.arange(ncap) < count
+
+        self._perm = jax.jit(_perm)
 
     def num_partitions(self, ctx):
         return 1
@@ -399,8 +408,18 @@ class LimitExec(TpuExec):
                 if took == 0:
                     continue
                 remaining -= took
-                yield DeviceBatch(batch.table, batch.num_rows, mask,
-                                  batch.capacity)
+                if batch.capacity > 2 * self._ncap:
+                    # the surviving rows are a sliver of the batch: compact
+                    # to a limit-sized capacity on device so collect fetches
+                    # O(n) bytes, not the full sorted input
+                    from ..ops.gather import gather_cols
+                    idx, inb = self._perm(mask)
+                    cvs = gather_cols(batch.cvs(), idx, inb)
+                    tbl = make_table(self.schema, cvs, took)
+                    yield DeviceBatch(tbl, took, inb, self._ncap)
+                else:
+                    yield DeviceBatch(batch.table, batch.num_rows, mask,
+                                      batch.capacity)
 
 
 class UnionExec(TpuExec):

@@ -199,3 +199,23 @@ def compact(cvs: List[CV], mask) -> Tuple[List[CV], jnp.ndarray]:
     in_bounds = jnp.arange(perm.shape[0]) < count
     out = [take(cv, perm, in_bounds) for cv in cvs]
     return out, count
+
+
+def gather_cols(cvs: List[CV], idx, inb) -> List[CV]:
+    """Gather a table's columns by idx (host-driven, eager). Var-width
+    columns (strings AND nested lists, recursively) get output capacities
+    sized from the actual gathered unit totals — gathers may replicate
+    rows, so source capacities are not upper bounds."""
+    from ..columnar.column import bucket_capacity
+    from ..utils.transfer import fetch
+    var_cols = [i for i, cv in enumerate(cvs)
+                if cv.offsets is not None or cv.children]
+    caps = {}
+    if var_cols:
+        measures = {i: take_measures(cvs[i], idx, inb) for i in var_cols}
+        got = fetch(measures)
+        caps = {i: tuple(bucket_capacity(max(int(v), 1)) for v in ms)
+                for i, ms in got.items()}
+    return [take(cv, idx, in_bounds=inb,
+                 caps=iter(caps[i]) if i in caps and caps[i] else None)
+            for i, cv in enumerate(cvs)]

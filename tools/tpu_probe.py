@@ -1,0 +1,118 @@
+"""TPU reachability probe with stack-dump diagnosis.
+
+Round-3's bench recorded three consecutive probe timeouts inside
+``jax.devices()`` on the experimental 'axon' platform with no insight into
+WHERE the init hung.  This probe runs the init in a subprocess with
+``faulthandler.dump_traceback_later`` armed, so a timeout yields a full
+Python-level stack of the hung thread(s) instead of a bare "timeout after
+Ns".  ``bench.py`` imports :func:`probe` (single implementation — no
+drift) and embeds the diagnosis in BENCH_rN.json.
+
+Round-4 finding (recorded for future rounds): the hang is inside
+``xla_client.make_c_api_client`` — the axon PJRT plugin's C-API client
+creation blocks indefinitely on the remote TPU tunnel:
+
+    Thread 0x... (most recent call first):
+      File "jaxlib/xla_client.py", line 161 in make_c_api_client
+      File "jax/_src/xla_bridge.py", line 553 in make_pjrt_c_api_client
+      ...
+      File "jax/_src/xla_bridge.py", line 1022 in devices
+
+Nothing above PJRT can time this out; the subprocess + watchdog here is
+the only safe way to probe it.
+
+Usage:  python tools/tpu_probe.py [timeout_seconds]
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# Import the PACKAGE, not bare jax: spark_rapids_tpu/__init__.py is what
+# reads SRTPU_COMPILE_CACHE, so a no-cache probe (env_extra) actually
+# exercises the no-cache configuration.
+CHILD = r"""
+import faulthandler, sys, os
+# Arm the watchdog FIRST: if jax init hangs, dump every thread's stack to
+# stderr shortly before the parent's kill deadline, then hard-exit.
+timeout = float(sys.argv[1])
+faulthandler.dump_traceback_later(max(timeout - 5.0, 1.0), exit=True)
+import time
+t0 = time.time()
+import spark_rapids_tpu
+import jax
+t_import = time.time() - t0
+devs = jax.devices()
+t_devices = time.time() - t0
+import json
+platform = devs[0].platform if devs else "none"
+# One tiny computation so "reachable" means "can execute", not just
+# "enumerates".
+import jax.numpy as jnp
+x = jnp.arange(8.0)
+y = float((x * 2).sum())
+t_exec = time.time() - t0
+print(json.dumps({
+    "ok": True, "platform": platform, "n_devices": len(devs),
+    "device_kind": devs[0].device_kind if devs else "none",
+    "t_import_s": round(t_import, 2), "t_devices_s": round(t_devices, 2),
+    "t_exec_s": round(t_exec, 2), "exec_result": y,
+}))
+"""
+
+# stderr markers proving the faulthandler watchdog fired (vs an ordinary
+# crash, which must NOT be labeled a hang)
+_HANG_MARKERS = ("Timeout (0:", "dump_traceback_later")
+
+
+def probe(timeout: float = 240.0, env_extra: dict | None = None) -> dict:
+    """Run the init probe in a subprocess. Returns a JSON-able dict:
+    ok=True with platform/timings, or ok=False with ``reason`` one of
+    "hang" (faulthandler stack in ``diagnosis``), "crash" (rc + stderr),
+    or "hard-timeout"."""
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let jax pick the accelerator
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if env_extra:
+        env.update(env_extra)
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(CHILD)
+        path = f.name
+    try:
+        proc = subprocess.run(
+            [sys.executable, path, str(timeout)],
+            capture_output=True, text=True, timeout=timeout + 10, env=env,
+        )
+        for line in reversed(proc.stdout.strip().splitlines()):
+            if line.startswith("{"):
+                try:
+                    return json.loads(line)
+                except ValueError:
+                    break  # non-JSON '{'-line: fall through to crash path
+        stderr = (proc.stderr or "")[-4000:]
+        hung = any(mk in stderr for mk in _HANG_MARKERS)
+        return {
+            "ok": False,
+            "reason": "hang" if hung else "crash",
+            "rc": proc.returncode,
+            "diagnosis": (f"stack of hung init: {stderr}" if hung
+                          else f"rc={proc.returncode}: {stderr}"),
+        }
+    except subprocess.TimeoutExpired as e:
+        stderr = e.stderr or b""
+        if isinstance(stderr, bytes):
+            stderr = stderr.decode("utf-8", "replace")
+        return {"ok": False, "reason": "hard-timeout",
+                "diagnosis": f"no output after {timeout}s: {stderr[-4000:]}"}
+    finally:
+        os.unlink(path)
+
+
+if __name__ == "__main__":
+    t = float(sys.argv[1]) if len(sys.argv) > 1 else 240.0
+    print(json.dumps(probe(t), indent=2))
