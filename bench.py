@@ -200,6 +200,9 @@ def main():
     q3.to_arrow()
     tpu_q3 = _best(lambda: q3.to_arrow(), 2)
 
+    # ---- full TPC-H sweep @ BENCH_SF_FULL (geomean over all 22) ---------
+    tpch_all = _tpch_sweep(s, float(os.environ.get("BENCH_SF_FULL", "0.1")))
+
     rows_per_s = n / tpu_q6
     extra = {
         "q6_hot_ms": round(tpu_q6 * 1e3, 2),
@@ -211,6 +214,7 @@ def main():
         "q3_sf": sf_join,
         "q3_s": round(tpu_q3, 3),
         "q3_vs_numpy": round(cpu_q3 / tpu_q3, 3),
+        **tpch_all,
         **({"backend_fallback": "cpu (tpu unreachable)"}
            if fellback else {}),
     }
@@ -243,6 +247,46 @@ def main():
             "tpu_probe_errors": tpu_errors} if fellback else {}),
         "extra": extra,
     }))
+
+
+def _tpch_sweep(s, sf: float):
+    """All 22 TPC-H queries once (hot, tables cached): per-query seconds,
+    geomean, and geomean speedup vs the pandas oracles on the same data
+    (the CPU single-core stand-in; VERDICT r3 next #2 'geomean
+    reported')."""
+    import math
+    from spark_rapids_tpu.workloads import tpch
+    from spark_rapids_tpu.workloads.tpch_oracle import ORACLES, to_pandas
+    tabs = tpch.gen_all(sf=sf, seed=7)
+    dfs = {k: s.create_dataframe(v).cache() for k, v in tabs.items()}
+    host = to_pandas(tabs)
+    reg = tpch.queries()
+    engine_s, oracle_s, errors = {}, {}, {}
+    for qn in range(1, 23):
+        # per-query guard: one failing query (unsupported op on a new
+        # backend, OOM) must not lose the whole bench result
+        try:
+            q = reg[qn](dfs)
+            engine_s[qn] = _best(lambda: q.to_arrow(), 2)
+            oracle_s[qn] = _best(lambda: ORACLES[qn](host), 2)
+        except Exception as e:
+            errors[f"q{qn}"] = repr(e)[:300]
+            print(f"bench: tpch q{qn} failed: {e!r}", file=sys.stderr)
+    out = {"tpch_all22_sf": sf}
+    if engine_s:
+        k = len(engine_s)
+        geo = math.exp(sum(math.log(v) for v in engine_s.values()) / k)
+        geo_speedup = math.exp(
+            sum(math.log(oracle_s[q] / engine_s[q]) for q in engine_s) / k)
+        out.update({
+            "tpch_all22_geomean_s": round(geo, 4),
+            "tpch_all22_vs_pandas_geomean": round(geo_speedup, 3),
+            "tpch_all22_per_query_ms": {
+                f"q{q}": round(v * 1e3, 1) for q, v in engine_s.items()},
+        })
+    if errors:
+        out["tpch_all22_errors"] = errors
+    return out
 
 
 def _regression_gate(current: dict, fellback: bool, sfs: dict):

@@ -61,6 +61,15 @@ def unflatten_bufs(flat):
 MIN_CAPACITY = 128
 
 
+def alloc_shape(dtype: "dt.DataType", cap: int):
+    """Data-buffer shape for a fixed-width column of `cap` rows.
+    decimal128 stores two int64 limbs per row — every allocation site
+    must use this (a flat buffer export-corrupts; see r4 q22 bug)."""
+    if isinstance(dtype, dt.DecimalType) and dtype.is_decimal128:
+        return (cap, 2)
+    return (cap,)
+
+
 def bucket_capacity(n: int) -> int:
     """Round n up to the next power of two, with a floor of MIN_CAPACITY."""
     if n <= MIN_CAPACITY:
@@ -286,7 +295,8 @@ class Column:
             return Column(dtype, n, jnp.zeros(0, jnp.int8),
                           jnp.zeros(cap, jnp.bool_), None, kids)
         np_dt = dtype.np_dtype or np.int8
-        col = Column(dtype, n, jnp.zeros(cap, np_dt), jnp.zeros(cap, jnp.bool_))
+        col = Column(dtype, n, jnp.zeros(alloc_shape(dtype, cap), np_dt),
+                     jnp.zeros(cap, jnp.bool_))
         if dtype.is_variable_width:
             col.offsets = jnp.zeros(cap + 1, jnp.int32)
         return col

@@ -49,10 +49,12 @@ __all__ = ["HashJoinExec", "NestedLoopJoinExec"]
 def _null_cvs(fields, cap):
     """All-null columns for outer-join extension rows (flat dtypes;
     nested children TODO alongside nested outer-join payload support)."""
+    from ..columnar.column import alloc_shape
     out = []
     for f in fields:
         np_dt = f.dtype.np_dtype or jnp.int8
-        out.append(CV(jnp.zeros(cap, np_dt), jnp.zeros(cap, jnp.bool_),
+        out.append(CV(jnp.zeros(alloc_shape(f.dtype, cap), np_dt),
+                      jnp.zeros(cap, jnp.bool_),
                       jnp.zeros(cap + 1, jnp.int32)
                       if f.dtype.is_variable_width else None))
     return out

@@ -279,9 +279,8 @@ def _empty_cv(dtype: dt.DataType, cap: int, bcap: int) -> CV:
     if dtype.is_variable_width:
         return CV(jnp.zeros(bcap, jnp.uint8), jnp.zeros(cap, jnp.bool_),
                   jnp.zeros(cap + 1, jnp.int32))
-    if isinstance(dtype, dt.DecimalType) and dtype.is_decimal128:
-        return CV(jnp.zeros((cap, 2), jnp.int64), jnp.zeros(cap, jnp.bool_))
-    return CV(jnp.zeros(cap, dtype.np_dtype or jnp.int8),
+    from ..columnar.column import alloc_shape
+    return CV(jnp.zeros(alloc_shape(dtype, cap), dtype.np_dtype or jnp.int8),
               jnp.zeros(cap, jnp.bool_))
 
 

@@ -288,12 +288,10 @@ class Literal(Expression):
     def emit(self, ctx: EmitCtx) -> CV:
         cap = ctx.capacity
         if self.value is None:
+            from ..columnar.column import alloc_shape
             np_dt = self.dtype.np_dtype or np.int8
-            if isinstance(self.dtype, dt.DecimalType) \
-                    and self.dtype.is_decimal128:
-                return CV(jnp.zeros((cap, 2), jnp.int64),
-                          jnp.zeros(cap, jnp.bool_))
-            return CV(jnp.zeros(cap, np_dt), jnp.zeros(cap, jnp.bool_))
+            return CV(jnp.zeros(alloc_shape(self.dtype, cap), np_dt),
+                      jnp.zeros(cap, jnp.bool_))
         if isinstance(self.dtype, dt.DecimalType) \
                 and self.dtype.is_decimal128:
             u = self.device_value() & ((1 << 128) - 1)

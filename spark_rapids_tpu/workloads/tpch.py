@@ -31,7 +31,60 @@ def dec_from_unscaled(vals: np.ndarray, precision: int, scale: int):
             pa.decimal128(precision, scale))
 
 
-def gen_lineitem(sf: float = 0.1, seed: int = 0) -> pa.Table:
+def day(s: str) -> int:
+    """Date literal as int32 days-since-epoch (the engine's date model in
+    this workload: TPC-H dates span 1992-01-01..1998-12-31 = 8036..10592)."""
+    return int((np.datetime64(s) - np.datetime64("1970-01-01"))
+               // np.timedelta64(1, "D"))
+
+
+# spec vocabularies (TPC-H v3 clause 4.2.2.13 / 4.2.3)
+SHIPMODES = ["REG AIR", "AIR", "RAIL", "SHIP", "TRUCK", "MAIL", "FOB"]
+SHIPINSTRUCT = ["DELIVER IN PERSON", "COLLECT COD", "NONE",
+                "TAKE BACK RETURN"]
+ORDERPRIORITIES = ["1-URGENT", "2-HIGH", "3-MEDIUM", "4-NOT SPECIFIED",
+                   "5-LOW"]
+SEGMENTS = ["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD", "MACHINERY"]
+TYPE_S1 = ["STANDARD", "SMALL", "MEDIUM", "LARGE", "ECONOMY", "PROMO"]
+TYPE_S2 = ["ANODIZED", "BURNISHED", "PLATED", "POLISHED", "BRUSHED"]
+TYPE_S3 = ["TIN", "NICKEL", "BRASS", "STEEL", "COPPER"]
+CONTAINER_S1 = ["SM", "MED", "LG", "JUMBO"]
+CONTAINER_S2 = ["CASE", "BOX", "BAG", "JAR", "PKG", "PACK", "CAN", "DRUM"]
+COLORS = ["almond", "antique", "aquamarine", "azure", "beige", "bisque",
+          "black", "blanched", "blue", "blush", "brown", "burlywood",
+          "burnished", "chartreuse", "chiffon", "chocolate", "coral",
+          "cornflower", "cornsilk", "cream", "cyan", "dark", "deep",
+          "dim", "dodger", "drab", "firebrick", "floral", "forest",
+          "frosted", "gainsboro", "ghost", "goldenrod", "green", "grey",
+          "honeydew", "hot", "hotpink", "indian", "ivory", "khaki",
+          "lace", "lavender", "lawn", "lemon", "light", "lime", "linen",
+          "magenta", "maroon", "medium", "metallic", "midnight", "mint",
+          "misty", "moccasin", "navajo", "navy", "olive", "orange",
+          "orchid", "pale", "papaya", "peach", "peru", "pink", "plum",
+          "powder", "puff", "purple", "red", "rose", "rosy", "royal",
+          "saddle", "salmon", "sandy", "seashell", "sienna", "sky",
+          "slate", "smoke", "snow", "spring", "steel", "tan", "thistle",
+          "tomato", "turquoise", "violet", "wheat", "white", "yellow"]
+NATIONS = [  # (name, regionkey) — spec nation table clause 4.2.3
+    ("ALGERIA", 0), ("ARGENTINA", 1), ("BRAZIL", 1), ("CANADA", 1),
+    ("EGYPT", 4), ("ETHIOPIA", 0), ("FRANCE", 3), ("GERMANY", 3),
+    ("INDIA", 2), ("INDONESIA", 2), ("IRAN", 4), ("IRAQ", 4),
+    ("JAPAN", 2), ("JORDAN", 4), ("KENYA", 0), ("MOROCCO", 0),
+    ("MOZAMBIQUE", 0), ("PERU", 1), ("CHINA", 2), ("ROMANIA", 3),
+    ("SAUDI ARABIA", 4), ("VIETNAM", 2), ("RUSSIA", 3),
+    ("UNITED KINGDOM", 3), ("UNITED STATES", 1)]
+REGIONS = ["AFRICA", "AMERICA", "ASIA", "EUROPE", "MIDDLE EAST"]
+
+PART_ROWS_PER_SF = 200_000
+SUPPLIER_ROWS_PER_SF = 10_000
+
+
+def _pick(rng, words, n):
+    return np.array(words, dtype=object)[rng.integers(0, len(words), n)]
+
+
+def gen_lineitem(sf: float = 0.1, seed: int = 0,
+                 full: bool = False) -> pa.Table:
     n = int(LINEITEM_ROWS_PER_SF * sf)
     rng = np.random.default_rng(seed)
     qty = rng.integers(1, 51, n).astype(np.int64) * 100          # dec(12,2)
@@ -44,7 +97,7 @@ def gen_lineitem(sf: float = 0.1, seed: int = 0) -> pa.Table:
     returnflag = pa.array(np.array(["A", "N", "R"])[rf])
     linestatus = pa.array(np.array(["F", "O"])[ls])
     okey = rng.integers(0, max(n // 4, 1), n).astype(np.int64)
-    return pa.table({
+    cols = {
         "l_orderkey": pa.array(okey, pa.int64()),
         "l_quantity": dec_from_unscaled(qty, 12, 2),
         "l_extendedprice": dec_from_unscaled(price, 12, 2),
@@ -53,7 +106,33 @@ def gen_lineitem(sf: float = 0.1, seed: int = 0) -> pa.Table:
         "l_returnflag": returnflag,
         "l_linestatus": linestatus,
         "l_shipdate": pa.array(shipdate, pa.int32()),
-    })
+    }
+    if full:
+        # independent stream: adding columns must not perturb the draws
+        # above (bench numbers stay comparable round-over-round)
+        r2 = np.random.default_rng(seed + 104729)
+        npart = max(int(PART_ROWS_PER_SF * sf), 1)
+        nsupp = max(int(SUPPLIER_ROWS_PER_SF * sf), 1)
+        commit = shipdate + r2.integers(-30, 31, n).astype(np.int32)
+        receipt = shipdate + r2.integers(1, 31, n).astype(np.int32)
+        # (l_partkey, l_suppkey) drawn FROM partsupp's pairs (spec: each
+        # part has 4 suppliers; lineitem references one of them), so
+        # q9/q20's partsupp joins hit
+        pk = r2.integers(0, npart, n)
+        si = r2.integers(0, 4, n)
+        sk = (pk * 4 + si * max(nsupp // 4, 1)) % nsupp
+        cols.update({
+            "l_partkey": pa.array(pk.astype(np.int64)),
+            "l_suppkey": pa.array(sk.astype(np.int64)),
+            "l_linenumber": pa.array(
+                r2.integers(1, 8, n).astype(np.int32), pa.int32()),
+            "l_commitdate": pa.array(commit, pa.int32()),
+            "l_receiptdate": pa.array(receipt, pa.int32()),
+            "l_shipinstruct": pa.array(_pick(r2, SHIPINSTRUCT, n),
+                                       pa.string()),
+            "l_shipmode": pa.array(_pick(r2, SHIPMODES, n), pa.string()),
+        })
+    return pa.table(cols)
 
 
 def q6(df):
@@ -134,7 +213,8 @@ def q3_numpy_baseline(c_key, c_seg, o_okey, o_ckey, o_date, o_prio,
 ORDERS_ROWS_PER_SF = 1_500_000
 
 
-def gen_orders(sf: float = 0.1, seed: int = 1) -> pa.Table:
+def gen_orders(sf: float = 0.1, seed: int = 1,
+               full: bool = False) -> pa.Table:
     n = int(ORDERS_ROWS_PER_SF * sf)
     rng = np.random.default_rng(seed)
     okey = np.arange(n, dtype=np.int64)
@@ -142,25 +222,172 @@ def gen_orders(sf: float = 0.1, seed: int = 1) -> pa.Table:
     odate = rng.integers(8036, 10591, n).astype(np.int32)
     seg = rng.integers(0, 5, n)
     total = rng.integers(100_000, 50_000_000, n).astype(np.int64)
-    return pa.table({
+    cols = {
         "o_orderkey": pa.array(okey),
         "o_custkey": pa.array(ckey),
         "o_orderdate": pa.array(odate, pa.int32()),
         "o_totalprice": dec_from_unscaled(total, 15, 2),
         "o_shippriority": pa.array(rng.integers(0, 2, n).astype(np.int32),
                                    pa.int32()),
-    })
+    }
+    if full:
+        r2 = np.random.default_rng(seed + 104729)
+        # spec clause 4.2.3: orders reference only custkeys NOT divisible
+        # by 3, so a third of customers have no orders (q13/q22 depend on
+        # this). Drawn from the r2 stream so the base (bench Q3) dataset
+        # keeps its round-over-round draws.
+        ncust = max(n // 10, 1)
+        j = r2.integers(0, max(2 * ncust // 3, 1), n)
+        cols["o_custkey"] = pa.array(
+            (3 * (j // 2) + 1 + (j % 2)).astype(np.int64))
+        status = np.array(["F", "O", "P"])[r2.integers(0, 3, n)]
+        comments = _pick(r2, COLORS, n)
+        # ~2% of comments carry the q13 exclusion pattern
+        special = r2.random(n) < 0.02
+        comments = np.where(
+            special, comments + np.array([" special requests"], object),
+            comments)
+        cols.update({
+            "o_orderstatus": pa.array(status, pa.string()),
+            "o_orderpriority": pa.array(_pick(r2, ORDERPRIORITIES, n),
+                                        pa.string()),
+            "o_comment": pa.array(comments.astype(object), pa.string()),
+        })
+    return pa.table(cols)
 
 
-def gen_customer(sf: float = 0.1, seed: int = 2) -> pa.Table:
+def gen_customer(sf: float = 0.1, seed: int = 2,
+                 full: bool = False) -> pa.Table:
     n = int(150_000 * sf)
     rng = np.random.default_rng(seed)
-    segs = np.array(["AUTOMOBILE", "BUILDING", "FURNITURE", "HOUSEHOLD",
-                     "MACHINERY"])
-    return pa.table({
+    segs = np.array(SEGMENTS)
+    cols = {
         "c_custkey": pa.array(np.arange(n, dtype=np.int64)),
         "c_mktsegment": pa.array(segs[rng.integers(0, 5, n)]),
+    }
+    if full:
+        r2 = np.random.default_rng(seed + 104729)
+        nk = r2.integers(0, 25, n)
+        # spec phone format: country code = 10 + nationkey
+        phones = np.array([f"{10 + k}-{r2.integers(100,1000)}-"
+                           f"{r2.integers(100,1000)}-{r2.integers(1000,10000)}"
+                           for k in nk], dtype=object)
+        acct = r2.integers(-99_999, 1_000_000, n).astype(np.int64)
+        cols.update({
+            "c_name": pa.array(
+                np.array([f"Customer#{i:09d}" for i in range(n)], object),
+                pa.string()),
+            "c_address": pa.array(_pick(r2, COLORS, n), pa.string()),
+            "c_nationkey": pa.array(nk.astype(np.int64)),
+            "c_phone": pa.array(phones, pa.string()),
+            "c_acctbal": dec_from_unscaled(acct, 12, 2),
+        })
+    return pa.table(cols)
+
+
+def gen_part(sf: float = 0.1, seed: int = 3) -> pa.Table:
+    n = max(int(PART_ROWS_PER_SF * sf), 1)
+    rng = np.random.default_rng(seed)
+    c1 = _pick(rng, COLORS, n)
+    c2 = _pick(rng, COLORS, n)
+    name = c1 + np.array([" "], object) + c2
+    ptype = (_pick(rng, TYPE_S1, n) + np.array([" "], object)
+             + _pick(rng, TYPE_S2, n) + np.array([" "], object)
+             + _pick(rng, TYPE_S3, n))
+    container = (_pick(rng, CONTAINER_S1, n) + np.array([" "], object)
+                 + _pick(rng, CONTAINER_S2, n))
+    brand = np.array([f"Brand#{i}{j}" for i, j in zip(
+        rng.integers(1, 6, n), rng.integers(1, 6, n))], dtype=object)
+    price = (90_000 + (np.arange(n) % 200_001) * 100
+             + rng.integers(0, 100, n)).astype(np.int64)
+    return pa.table({
+        "p_partkey": pa.array(np.arange(n, dtype=np.int64)),
+        "p_name": pa.array(name, pa.string()),
+        "p_mfgr": pa.array(np.array(
+            [f"Manufacturer#{i}" for i in rng.integers(1, 6, n)], object),
+            pa.string()),
+        "p_brand": pa.array(brand, pa.string()),
+        "p_type": pa.array(ptype, pa.string()),
+        "p_size": pa.array(rng.integers(1, 51, n).astype(np.int32),
+                           pa.int32()),
+        "p_container": pa.array(container, pa.string()),
+        "p_retailprice": dec_from_unscaled(price, 12, 2),
     })
+
+
+def gen_supplier(sf: float = 0.1, seed: int = 4) -> pa.Table:
+    n = max(int(SUPPLIER_ROWS_PER_SF * sf), 1)
+    rng = np.random.default_rng(seed)
+    nk = rng.integers(0, 25, n)
+    phones = np.array([f"{10 + k}-{rng.integers(100,1000)}-"
+                       f"{rng.integers(100,1000)}-{rng.integers(1000,10000)}"
+                       for k in nk], dtype=object)
+    comments = _pick(rng, COLORS, n)
+    # spec: SF*5 suppliers get "Customer Complaints" (q16 exclusion)
+    bad = rng.random(n) < 0.01
+    comments = np.where(
+        bad, comments + np.array([" Customer Complaints"], object),
+        comments)
+    acct = rng.integers(-99_999, 1_000_000, n).astype(np.int64)
+    return pa.table({
+        "s_suppkey": pa.array(np.arange(n, dtype=np.int64)),
+        "s_name": pa.array(np.array(
+            [f"Supplier#{i:09d}" for i in range(n)], object), pa.string()),
+        "s_address": pa.array(_pick(rng, COLORS, n), pa.string()),
+        "s_nationkey": pa.array(nk.astype(np.int64)),
+        "s_phone": pa.array(phones, pa.string()),
+        "s_acctbal": dec_from_unscaled(acct, 12, 2),
+        "s_comment": pa.array(comments.astype(object), pa.string()),
+    })
+
+
+def gen_partsupp(sf: float = 0.1, seed: int = 5) -> pa.Table:
+    npart = max(int(PART_ROWS_PER_SF * sf), 1)
+    nsupp = max(int(SUPPLIER_ROWS_PER_SF * sf), 1)
+    rng = np.random.default_rng(seed)
+    # spec: 4 rows per part, supplier spread deterministically
+    pk = np.repeat(np.arange(npart, dtype=np.int64), 4)
+    n = len(pk)
+    sk = ((pk * 4 + np.tile(np.arange(4), npart)
+           * max(nsupp // 4, 1)) % nsupp).astype(np.int64)
+    cost = rng.integers(100, 100_100, n).astype(np.int64)
+    return pa.table({
+        "ps_partkey": pa.array(pk),
+        "ps_suppkey": pa.array(sk),
+        "ps_availqty": pa.array(rng.integers(1, 10_000, n).astype(np.int32),
+                                pa.int32()),
+        "ps_supplycost": dec_from_unscaled(cost, 12, 2),
+    })
+
+
+def gen_nation() -> pa.Table:
+    return pa.table({
+        "n_nationkey": pa.array(np.arange(25, dtype=np.int64)),
+        "n_name": pa.array([n for n, _ in NATIONS], pa.string()),
+        "n_regionkey": pa.array(
+            np.array([r for _, r in NATIONS], np.int64)),
+    })
+
+
+def gen_region() -> pa.Table:
+    return pa.table({
+        "r_regionkey": pa.array(np.arange(5, dtype=np.int64)),
+        "r_name": pa.array(REGIONS, pa.string()),
+    })
+
+
+def gen_all(sf: float = 0.1, seed: int = 7) -> dict:
+    """All 8 TPC-H tables as pyarrow Tables, FK-consistent at this sf."""
+    return {
+        "lineitem": gen_lineitem(sf, seed, full=True),
+        "orders": gen_orders(sf, seed, full=True),
+        "customer": gen_customer(sf, seed, full=True),
+        "part": gen_part(sf),
+        "supplier": gen_supplier(sf),
+        "partsupp": gen_partsupp(sf),
+        "nation": gen_nation(),
+        "region": gen_region(),
+    }
 
 
 def q3(customer, orders, lineitem):
@@ -183,3 +410,19 @@ def q3(customer, orders, lineitem):
         SortOrder(col("revenue"), ascending=False),
         SortOrder(col("o_orderdate"), ascending=True)]))
     return sorted_df.limit(10)
+
+
+def queries() -> dict:
+    """Registry of all 22 TPC-H queries with the uniform signature
+    ``fn(tables: dict[str, DataFrame]) -> DataFrame``."""
+    from . import tpch_queries as Q
+
+    reg = {
+        1: lambda t: q1(t["lineitem"]),
+        3: lambda t: q3(t["customer"], t["orders"], t["lineitem"]),
+        6: lambda t: q6(t["lineitem"]),
+    }
+    for n in (2, 4, 5, 7, 8, 9, 10, 11, 12, 13, 14, 15, 16, 17, 18, 19,
+              20, 21, 22):
+        reg[n] = getattr(Q, f"q{n}")
+    return reg
