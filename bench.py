@@ -267,8 +267,11 @@ def _tpch_sweep(s, sf: float):
         # backend, OOM) must not lose the whole bench result
         try:
             q = reg[qn](dfs)
-            engine_s[qn] = _best(lambda: q.to_arrow(), 2)
-            oracle_s[qn] = _best(lambda: ORACLES[qn](host), 2)
+            e_t = _best(lambda: q.to_arrow(), 2)
+            o_t = _best(lambda: ORACLES[qn](host), 2)
+            # assign together: a failed oracle must not leave a dangling
+            # engine_s entry that KeyErrors the geomean below
+            engine_s[qn], oracle_s[qn] = e_t, o_t
         except Exception as e:
             errors[f"q{qn}"] = repr(e)[:300]
             print(f"bench: tpch q{qn} failed: {e!r}", file=sys.stderr)

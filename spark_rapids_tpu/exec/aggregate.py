@@ -363,17 +363,19 @@ class HashAggregateExec(TpuExec):
         return ([cv for cv in cvs[:nkeys]],
                 [cv.data for cv in cvs[nkeys:]], b.row_mask, b.capacity)
 
-    def _bucket_slice_fn(self, K: int):
+    def _bucket_slice_fn(self, K: int, seed: int = 0x5EED):
         """Device program extracting one of K disjoint-key hash buckets
         from a partial: live rows whose key hashes to bucket `b` are
         compacted to the front (the repartition half of the reference's
-        GpuAggregateExec.scala:863-894 fallback)."""
+        GpuAggregateExec.scala:863-894 fallback). `seed` varies per
+        recursion level — re-splitting an oversized bucket with the same
+        seed would put every row back in one bucket."""
         from ..ops.gather import compact
         from ..ops.hash import partition_ids
         key_dtypes = [k.dtype for k in self.keys]
 
         def fn(ks, st, sl, b):
-            pids = partition_ids(ks, key_dtypes, K, seed=0x5EED)
+            pids = partition_ids(ks, key_dtypes, K, seed=seed)
             mask_b = sl & (pids == b)
             cvs_all = list(ks) + [CV(s, jnp.ones_like(sl)) for s in st]
             out_cvs, count = compact(cvs_all, mask_b)
@@ -890,13 +892,21 @@ class HashAggregateExec(TpuExec):
             return
         yield from self._emit_final(ctx, m, handles)
 
+    # deepest bucket recursion (reference: 10 levels x 16 buckets,
+    # GpuAggregateExec.scala:863-894)
+    _MAX_BUCKET_DEPTH = 10
+
     def _emit_final(self, ctx: ExecContext, m, handles,
-                    force_merge: bool = False):
+                    force_merge: bool = False, depth: int = 0):
         """Merge parked partials and emit finalized (or partial-format)
         batches under a bounded merge width: when the buffered group
         state exceeds maxMergeRows, repartition every partial into K
-        hash buckets of disjoint keys and merge+emit per bucket — the
-        out-of-core fallback (GpuAggregateExec.scala:863-894)."""
+        hash buckets of disjoint keys and merge+emit per bucket,
+        RECURSING (fresh hash seed per level) on buckets that still
+        exceed the bound — the out-of-core fallback
+        (GpuAggregateExec.scala:863-894, 16 buckets x 10 levels).
+        Handles are closed on generator exit even when the consumer
+        abandons the stream (limit/error)."""
         from ..config import AGG_MAX_MERGE_ROWS
         max_rows = ctx.conf.get(AGG_MAX_MERGE_ROWS)
         total = sum(c for _, c in handles)
@@ -917,25 +927,53 @@ class HashAggregateExec(TpuExec):
                 out = self._emit_batch(part, m, emit_partial)
             yield out
             return
-        fn = self._update_cache.get(("bslice", K))
+        seed = (0x5EED ^ (depth * 0x9E3779B9)) & 0x7FFFFFFF
+        fn = self._update_cache.get(("bslice", K, seed))
         if fn is None:
-            fn = self._bucket_slice_fn(K)
-            self._update_cache[("bslice", K)] = fn
-        for b in range(K):
-            with m.timer("opTime"):
-                parts_b = []
-                for h, _ in handles:
-                    ks, st, sl, cap = self._unpark(h, close=(b == K - 1))
-                    oks, ost, cnt = fn(ks, st, sl, jnp.int32(b))
-                    nlive = fetch_int(cnt)
-                    if nlive == 0:
+            fn = self._bucket_slice_fn(K, seed)
+            self._update_cache[("bslice", K, seed)] = fn
+        from ..memory.spill import spill_store
+        store = spill_store(ctx.conf)
+        open_handles = {h for h, _ in handles}
+        try:
+            for b in range(K):
+                sub = None
+                with m.timer("opTime"):
+                    parts_b = []
+                    for h, _ in handles:
+                        close = (b == K - 1) and h in open_handles
+                        ks, st, sl, cap = self._unpark(h, close=close)
+                        if close:
+                            open_handles.discard(h)
+                        oks, ost, cnt = fn(ks, st, sl, jnp.int32(b))
+                        nlive = fetch_int(cnt)
+                        if nlive == 0:
+                            continue
+                        parts_b.append(self._shrink_to(oks, ost, nlive))
+                    if not parts_b:
                         continue
-                    parts_b.append(self._shrink_to(oks, ost, nlive))
-                if not parts_b:
-                    continue
-                part = self._merge_partials(parts_b)
-                out = self._emit_batch(part, m, emit_partial)
-            yield out
+                    bucket_rows = sum(p[3] for p in parts_b)
+                    if (bucket_rows > max_rows
+                            and depth + 1 < self._MAX_BUCKET_DEPTH
+                            and bucket_rows < total):
+                        # still oversized: park this bucket's parts and
+                        # recurse with a fresh seed. The bucket_rows <
+                        # total guard stops degenerate recursion when one
+                        # key dominates (re-splitting can't shrink it).
+                        sub = [(self._park(store, p), p[3])
+                               for p in parts_b]
+                        m.add("numBucketRecursions", 1)
+                    else:
+                        part = self._merge_partials(parts_b)
+                        out = self._emit_batch(part, m, emit_partial)
+                if sub is not None:
+                    yield from self._emit_final(
+                        ctx, m, sub, force_merge, depth + 1)
+                else:
+                    yield out
+        finally:
+            for h in open_handles:
+                h.close()
 
     def _emit_batch(self, part, m, emit_partial: bool) -> DeviceBatch:
         ks, st, sl, cap = part

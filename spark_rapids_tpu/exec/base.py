@@ -61,6 +61,15 @@ class TpuExec:
                           pid: int) -> Iterator[DeviceBatch]:
         raise NotImplementedError
 
+    def release(self):
+        """Free long-lived resources held by this operator (spill
+        handles parked for re-execution, cached device buffers).
+        Recurses; called when the owning plan/DataFrame is dropped
+        (ADVICE r3: exchange output handles must have a lifecycle hook
+        or every mesh query leaks budget accounting + spill files)."""
+        for c in self.children:
+            c.release()
+
     def fusable_stage(self):
         """Pure per-batch device transform (cvs, mask) -> (cvs, mask) when
         this operator can fuse into its parent's jitted program (the

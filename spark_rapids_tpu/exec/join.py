@@ -385,10 +385,12 @@ class HashJoinExec(TpuExec):
                 yield DeviceBatch(tbl, cap_b, unmatched, cap_b)
 
     # ---- out-of-core: disjoint-key sub-partition loop ------------------
-    def _subpartition_fn(self, key_exprs, S: int):
+    def _subpartition_fn(self, key_exprs, S: int, seed: int = 0xAB5):
         """Device program extracting hash sub-partition `b` of a batch:
         rows whose join-key hash lands in bucket b compact to the front
-        (GpuSubPartitionHashJoin.scala:617 rehash, TPU-style)."""
+        (GpuSubPartitionHashJoin.scala:617 rehash, TPU-style). `seed`
+        varies per recursion level — re-splitting with the same seed
+        would put every row back into one bucket."""
         from ..ops.gather import compact
         from ..ops.hash import partition_ids
         key_dtypes = [k.dtype for k in key_exprs]
@@ -397,11 +399,22 @@ class HashJoinExec(TpuExec):
             cap = mask.shape[0]
             ectx = EmitCtx(cvs, cap)
             key_cvs = [k.emit(ectx) for k in key_exprs]
-            pids = partition_ids(key_cvs, key_dtypes, S, seed=0xAB5)
+            pids = partition_ids(key_cvs, key_dtypes, S, seed=seed)
             mask_b = mask & (pids == b)
             out_cvs, count = compact(cvs, mask_b)
             return out_cvs, count
         return jax.jit(fn)
+
+    def _subpart_fns(self, S: int, seed: int):
+        """Cached (build-side, stream-side) sub-partition programs."""
+        kb = ("subpart", "b", S, seed)
+        ks = ("subpart", "s", S, seed)
+        if kb not in self._count_cache:
+            self._count_cache[kb] = self._subpartition_fn(
+                self.rkeys, S, seed)
+            self._count_cache[ks] = self._subpartition_fn(
+                self.lkeys, S, seed)
+        return self._count_cache[kb], self._count_cache[ks]
 
     def _shrink_batch(self, schema: Schema, out_cvs, nlive: int):
         """Slice a compacted (live-prefix) batch down to a bucketed
@@ -428,40 +441,37 @@ class HashJoinExec(TpuExec):
         tbl = make_table(schema, cvs2, nlive)
         return DeviceBatch(tbl, nlive, inb, new_cap)
 
-    def _execute_subpartitioned(self, ctx: ExecContext, m, pid, bbatches,
-                                total_bytes: int, budget: int):
-        """Build side exceeds its budget: rehash BOTH sides into S
-        disjoint-key sub-partitions parked as spillable piles, then run
-        an independent join pass per sub-partition. Keys are disjoint
-        across buckets, so every join type decomposes exactly
-        (reference: GpuSubPartitionHashJoin.scala:617 — 16-bucket
-        repartition-and-loop; here S scales with the overflow)."""
+    # deepest sub-partition recursion (reference allows repeated
+    # repartition, GpuSubPartitionHashJoin.scala:617)
+    _MAX_SUBPART_DEPTH = 10
+
+    def _split_both(self, ctx, m, S: int, seed: int, build_batches,
+                    stream_batches):
+        """Split build + stream batch iterators into S disjoint-key
+        spillable piles. On error, closes everything parked so far (the
+        OOC path must not leak under the very memory pressure it exists
+        to handle). Returns (piles_b, bytes_b, piles_s)."""
         from ..memory.spill import spill_store
         store = spill_store(ctx.conf)
         left, right = self.children
-        S = 2
-        while S < 16 and total_bytes > S * budget:
-            S *= 2
-        m.add("numSubPartitions", S)
-
-        bfn = self._subpartition_fn(self.rkeys, S)
+        bfn, sfn = self._subpart_fns(S, seed)
         piles_b: List[List] = [[] for _ in range(S)]
-        with m.timer("buildTime"):
-            for b in bbatches:
-                for s in range(S):
-                    out_cvs, cnt = bfn(b.cvs(), b.row_mask, jnp.int32(s))
-                    nlive = fetch_int(cnt)
-                    if nlive == 0:
-                        continue
-                    sb = self._shrink_batch(right.schema, out_cvs, nlive)
-                    piles_b[s].append(store.add_batch(sb, priority=7))
-        del bbatches
-
-        sfn = self._subpartition_fn(self.lkeys, S)
+        bytes_b = [0] * S
         piles_s: List[List] = [[] for _ in range(S)]
-        for lpid in ([pid] if self.per_partition
-                     else range(left.num_partitions(ctx))):
-            for batch in left.execute_partition(ctx, lpid):
+        try:
+            with m.timer("buildTime"):
+                for b in build_batches:
+                    for s in range(S):
+                        out_cvs, cnt = bfn(b.cvs(), b.row_mask,
+                                           jnp.int32(s))
+                        nlive = fetch_int(cnt)
+                        if nlive == 0:
+                            continue
+                        sb = self._shrink_batch(right.schema, out_cvs,
+                                                nlive)
+                        bytes_b[s] += sb.nbytes
+                        piles_b[s].append(store.add_batch(sb, priority=7))
+            for batch in stream_batches:
                 with m.timer("opTime"):
                     for s in range(S):
                         out_cvs, cnt = sfn(batch.cvs(), batch.row_mask,
@@ -471,21 +481,104 @@ class HashJoinExec(TpuExec):
                             continue
                         sb = self._shrink_batch(left.schema, out_cvs,
                                                 nlive)
-                        piles_s[s].append(
-                            store.add_batch(sb, priority=7))
+                        piles_s[s].append(store.add_batch(sb, priority=7))
+        except BaseException:
+            for pile in piles_b + piles_s:
+                for h in pile:
+                    h.close()
+            raise
+        return piles_b, bytes_b, piles_s
 
-        for s in range(S):
-            builds = []
-            for h in piles_b[s]:
-                builds.append(h.materialize())
-                h.close()
-
-            def stream_s(handles=piles_s[s]):
-                for h in handles:
-                    yield h.materialize()
+    def _run_buckets(self, ctx, m, piles_b, bytes_b, piles_s,
+                     budget: int, depth: int):
+        """Dispatch each disjoint-key bucket through _join_bucket,
+        closing every pile handle on generator exit (including consumer
+        abandonment — close() is idempotent with the per-bucket
+        finally)."""
+        try:
+            for s in range(len(piles_b)):
+                yield from self._join_bucket(ctx, m, piles_b[s],
+                                             piles_s[s], bytes_b[s],
+                                             budget, depth)
+        finally:
+            for pile in piles_b + piles_s:
+                for h in pile:
                     h.close()
 
+    @staticmethod
+    def _drain(handles):
+        for h in handles:
+            b = h.materialize()
+            h.close()
+            yield b
+
+    def _execute_subpartitioned(self, ctx: ExecContext, m, pid, bbatches,
+                                total_bytes: int, budget: int):
+        """Build side exceeds its budget: rehash BOTH sides into S
+        disjoint-key sub-partitions parked as spillable piles, then run
+        an independent join pass per sub-partition, RECURSIVELY
+        re-splitting any sub-partition whose build still exceeds the
+        budget (fresh hash seed per level). Keys are disjoint across
+        buckets, so every join type decomposes exactly (reference:
+        GpuSubPartitionHashJoin.scala:617 — 16-bucket
+        repartition-and-loop)."""
+        left, _ = self.children
+        S = 2
+        while S < 16 and total_bytes > S * budget:
+            S *= 2
+        m.add("numSubPartitions", S)
+
+        def stream():
+            for lpid in ([pid] if self.per_partition
+                         else range(left.num_partitions(ctx))):
+                yield from left.execute_partition(ctx, lpid)
+
+        piles_b, bytes_b, piles_s = self._split_both(
+            ctx, m, S, 0xAB5, bbatches, stream())
+        del bbatches
+        yield from self._run_buckets(ctx, m, piles_b, bytes_b, piles_s,
+                                     budget, depth=1)
+
+    def _join_bucket(self, ctx, m, bhandles, shandles, bbytes: int,
+                     budget: int, depth: int):
+        """Join one disjoint-key sub-partition held as spillable piles.
+        Re-splits recursively while the build exceeds the budget; build
+        handles stay OPEN (reservation counted) for the whole pass and
+        close in a finally, so accounting reflects resident memory and
+        abandoned generators leak nothing."""
+        if bbytes > budget and depth < self._MAX_SUBPART_DEPTH:
+            S = 2
+            while S < 16 and bbytes > S * budget:
+                S *= 2
+            seed = (0xAB5 ^ (depth * 0x9E3779B9)) & 0x7FFFFFFF
+            piles_b, bytes_b, piles_s = self._split_both(
+                ctx, m, S, seed, self._drain(bhandles),
+                self._drain(shandles))
+            if max(bytes_b) >= bbytes:
+                # degenerate (one dominant key): the split didn't shrink
+                # the biggest bucket — stop recursing below, join as-is
+                depth = self._MAX_SUBPART_DEPTH
+            m.add("numSubPartRecursions", 1)
+            yield from self._run_buckets(ctx, m, piles_b, bytes_b,
+                                         piles_s, budget, depth + 1)
+            return
+
+        # terminal: one join pass. Handles stay open while their batches
+        # are live (ADVICE r3: closing early releases the DeviceManager
+        # reservation during the most memory-intensive phase).
+        try:
+            builds = [h.materialize() for h in bhandles]
+
+            def stream_s():
+                for h in shandles:
+                    yield h.materialize()
+
             yield from self._join_pass(ctx, m, builds, stream_s())
+        finally:
+            for h in bhandles:
+                h.close()
+            for h in shandles:
+                h.close()
 
     def _probe_batch(self, ctx, m, batch, bcvs, bmask, bkey_cvs, cap_b,
                      fast, sorted_ukey, bperm, n_valid_b):

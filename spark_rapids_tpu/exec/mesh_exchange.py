@@ -116,141 +116,215 @@ class MeshExchangeExec(TpuExec):
         return jax.jit(step)
 
     # ------------------------------------------------------------------
+    def _assemble_global(self, pieces, sharding, devices):
+        """Build the round's global array from per-shard pieces WITHOUT a
+        host/single-device concatenate: each piece is device_put to its
+        target shard (D2D/DMA on hardware — the device-resident bounce
+        buffer, vs r3's jnp.concatenate + device_put which staged every
+        round through one device; reference keeps bounce buffers
+        device-resident too, UCXShuffleTransport.scala:49)."""
+        shape = ((len(pieces) * pieces[0].shape[0],)
+                 + tuple(pieces[0].shape[1:]))
+        arrs = [jax.device_put(p, d) for p, d in zip(pieces, devices)]
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, arrs)
+
+    def _dispatch_round(self, m, slot_handles, sharding, devices,
+                        has_offsets):
+        """Assemble one round's send buffers (≤ n batches, one per shard
+        slot) and dispatch the collective program asynchronously.
+        Returns (out_flat, stats, row_cap, bcaps) with stats NOT yet
+        fetched — the caller overlaps the next round's assembly with
+        this round's device execution (double buffering)."""
+        n = self.n
+        with m.timer("partitionTime"):
+            batches = [h.materialize() for h in slot_handles]
+            # per-round capacities: power-of-two bucketed so padding
+            # amplification is a constant (<2x) and the jit cache stays
+            # small under varying batch sizes
+            row_cap = bucket_capacity(max(b.capacity for b in batches))
+            bcaps = []
+            for ci, f in enumerate(self.schema.fields):
+                if has_offsets[ci]:
+                    bcaps.append(bucket_capacity(max(
+                        b.cvs()[ci].data.shape[0] for b in batches)))
+                else:
+                    bcaps.append(0)
+            shard_cvs, shard_masks = [], []
+            for s in range(n):
+                if s < len(batches):
+                    b = batches[s]
+                    cvs = [_pad_round_cv(cv, row_cap, bcaps[ci])
+                           for ci, cv in enumerate(b.cvs())]
+                    msk = pad_mask(b.row_mask, row_cap)
+                else:
+                    cvs = [_empty_cv(f.dtype, row_cap, bcaps[ci])
+                           for ci, f in enumerate(self.schema.fields)]
+                    msk = jnp.zeros(row_cap, jnp.bool_)
+                shard_cvs.append(cvs)
+                shard_masks.append(msk)
+            for h in slot_handles:
+                h.close()
+
+            flat_global = []
+            for ci in range(len(self.schema.fields)):
+                parts = [shard_cvs[s][ci] for s in range(n)]
+                flat_global.append(self._assemble_global(
+                    [p.data for p in parts], sharding, devices))
+                flat_global.append(self._assemble_global(
+                    [p.validity for p in parts], sharding, devices))
+                if has_offsets[ci]:
+                    flat_global.append(self._assemble_global(
+                        [p.offsets for p in parts], sharding, devices))
+            mask_global = self._assemble_global(shard_masks, sharding,
+                                                devices)
+
+        with m.timer("exchangeTime"):
+            key = tuple(has_offsets)
+            prog = self._jit_cache.get(key)
+            if prog is None:
+                prog = self._build_program(has_offsets)
+                self._jit_cache[key] = prog
+            out_flat, stats = prog(flat_global, mask_global)
+        return out_flat, stats, row_cap, bcaps
+
+    def _collect_round(self, m, store, out, rnd_state, has_offsets,
+                       n_str):
+        """Fetch a dispatched round's stats (blocks until the device
+        finishes it), slice each shard's live prefix to a bucketed
+        capacity, and park the output as spillable handles."""
+        out_flat, stats, row_cap, bcaps = rnd_state
+        n = self.n
+        with m.timer("exchangeTime"):
+            stats_h = jax.device_get(stats).reshape(n, 1 + n_str)
+        out_cap = n * row_cap
+        for s in range(n):
+            nlive = int(stats_h[s, 0])
+            if nlive == 0:
+                continue
+            # clamp to the shard's receive region: out_cap is not a
+            # power of two when n_devices isn't
+            new_cap = min(bucket_capacity(nlive), out_cap)
+            cvs = []
+            fi = 0
+            si = 1
+            for ci, f in enumerate(self.schema.fields):
+                r0 = s * out_cap
+                if has_offsets[ci]:
+                    bc = n * bcaps[ci]
+                    nbytes = int(stats_h[s, si])
+                    si += 1
+                    bcap_new = min(bucket_capacity(nbytes), bc)
+                    data = out_flat[fi][s * bc:s * bc + bcap_new]
+                    valid = out_flat[fi + 1][r0:r0 + new_cap]
+                    o0 = s * (out_cap + 1)
+                    offs = out_flat[fi + 2][o0:o0 + new_cap + 1]
+                    cvs.append(CV(data, valid, offs))
+                    fi += 3
+                else:
+                    data = out_flat[fi][r0:r0 + new_cap]
+                    valid = out_flat[fi + 1][r0:r0 + new_cap]
+                    cvs.append(CV(data, valid))
+                    fi += 2
+            tbl = make_table(self.schema, cvs, nlive)
+            batch = DeviceBatch(tbl, nlive, None, new_cap)
+            out[s].append(store.add_batch(batch, priority=5))
+            m.add("numOutputRows", nlive)
+
     def _ensure_exchanged(self, ctx: ExecContext):
         with self._lock:
             if self._out is not None:
                 return
+            from jax.sharding import NamedSharding, PartitionSpec as P
             from ..memory.spill import spill_store
             store = spill_store(ctx.conf)
             m = ctx.metrics_for(self._op_id)
             mesh = self._get_mesh()
             child = self.children[0]
             n = self.n
-
-            # 1. drain the child into per-shard round queues (round-robin
-            #    by batch); every queued batch is spillable immediately
-            piles: List[List] = [[] for _ in range(n)]
-            i = 0
-            row_cap = 0
-            bcaps = [0] * len(self.schema.fields)
-            for cpid in range(child.num_partitions(ctx)):
-                for b in child.execute_partition(ctx, cpid):
-                    row_cap = max(row_cap, b.capacity)
-                    for ci, cv in enumerate(b.cvs()):
-                        if cv.offsets is not None:
-                            bcaps[ci] = max(bcaps[ci], cv.data.shape[0])
-                    piles[i % n].append(store.add_batch(b, priority=10))
-                    i += 1
-            if i == 0:
-                self._out = [[] for _ in range(n)]
-                return
-
-            # fixed per-round capacities: power-of-two bucketed so padding
-            # amplification is a constant (<2x), not data-dependent
-            row_cap = bucket_capacity(row_cap)
-            has_offsets = [bc > 0 for bc in bcaps]
-            bcaps = [bucket_capacity(bc) if bc else 0 for bc in bcaps]
-
-            key = (tuple(has_offsets), row_cap, tuple(bcaps))
-            prog = self._jit_cache.get(key)
-            if prog is None:
-                prog = self._build_program(has_offsets)
-                self._jit_cache[key] = prog
-
-            from jax.sharding import NamedSharding, PartitionSpec as P
             sharding = NamedSharding(mesh, P(self.axis_name))
-            n_rounds = max(len(p) for p in piles)
-            out: List[List] = [[] for _ in range(n)]
+            devices = list(mesh.devices.reshape(-1))
+            # var-width-ness is a schema property (not observed bytes):
+            # every round runs the same program shape
+            has_offsets = [f.dtype.is_variable_width
+                           for f in self.schema.fields]
             n_str = sum(1 for h in has_offsets if h)
-            for rnd in range(n_rounds):
-                # 2. assemble this round's send buffers: one batch per
-                #    shard (or an empty pad), all at the fixed round caps
-                with m.timer("partitionTime"):
-                    shard_cvs, shard_masks = [], []
-                    for s in range(n):
-                        if rnd < len(piles[s]):
-                            h = piles[s][rnd]
-                            b = h.materialize()
-                            cvs = [_pad_round_cv(cv, row_cap, bcaps[ci])
-                                   for ci, cv in enumerate(b.cvs())]
-                            msk = pad_mask(b.row_mask, row_cap)
-                            h.close()
-                        else:
-                            cvs = [_empty_cv(f.dtype, row_cap, bcaps[ci])
-                                   for ci, f in
-                                   enumerate(self.schema.fields)]
-                            msk = jnp.zeros(row_cap, jnp.bool_)
-                        shard_cvs.append(cvs)
-                        shard_masks.append(msk)
 
-                    flat_global = []
-                    ncols = len(self.schema.fields)
-                    for ci in range(ncols):
-                        parts = [shard_cvs[s][ci] for s in range(n)]
-                        flat_global.append(jax.device_put(
-                            jnp.concatenate([p.data for p in parts]),
-                            sharding))
-                        flat_global.append(jax.device_put(
-                            jnp.concatenate([p.validity for p in parts]),
-                            sharding))
-                        if has_offsets[ci]:
-                            flat_global.append(jax.device_put(
-                                jnp.concatenate([p.offsets for p in parts]),
-                                sharding))
-                    mask_global = jax.device_put(
-                        jnp.concatenate(shard_masks), sharding)
-
-                # 3. one collective program per round (compiled once)
-                with m.timer("exchangeTime"):
-                    out_flat, stats = prog(flat_global, mask_global)
-                    stats_h = jax.device_get(stats).reshape(n, 1 + n_str)
-
-                # 4. slice each shard's live prefix to a bucketed capacity
-                #    and park it as a spillable handle
-                out_cap = n * row_cap
-                for s in range(n):
-                    nlive = int(stats_h[s, 0])
-                    if nlive == 0:
-                        continue
-                    # clamp to the shard's receive region: out_cap is not
-                    # a power of two when n_devices isn't
-                    new_cap = min(bucket_capacity(nlive), out_cap)
-                    cvs = []
-                    fi = 0
-                    si = 1
-                    for ci, f in enumerate(self.schema.fields):
-                        r0 = s * out_cap
-                        if has_offsets[ci]:
-                            bc = n * bcaps[ci]
-                            nbytes = int(stats_h[s, si])
-                            si += 1
-                            bcap_new = min(bucket_capacity(nbytes), bc)
-                            data = out_flat[fi][
-                                s * bc:s * bc + bcap_new]
-                            valid = out_flat[fi + 1][r0:r0 + new_cap]
-                            o0 = s * (out_cap + 1)
-                            offs = out_flat[fi + 2][
-                                o0:o0 + new_cap + 1]
-                            cvs.append(CV(data, valid, offs))
-                            fi += 3
-                        else:
-                            data = out_flat[fi][r0:r0 + new_cap]
-                            valid = out_flat[fi + 1][r0:r0 + new_cap]
-                            cvs.append(CV(data, valid))
-                            fi += 2
-                    tbl = make_table(self.schema, cvs, nlive)
-                    batch = DeviceBatch(tbl, nlive, None, new_cap)
-                    out[s].append(store.add_batch(batch, priority=5))
-                    m.add("numOutputRows", nlive)
+            # STREAMING: no full pre-drain (r3 buffered the entire child
+            # before round 1). Child batches fill an n-slot round; as
+            # soon as it's full the round dispatches, and the PREVIOUS
+            # round's results are collected while this one runs on
+            # device — child execution and round assembly overlap the
+            # in-flight collective (double buffering).
+            out: List[List] = [[] for _ in range(n)]
+            slot: List = []
+            pending = None
+            try:
+                for cpid in range(child.num_partitions(ctx)):
+                    for b in child.execute_partition(ctx, cpid):
+                        # waiting slot batches are spillable: a slow
+                        # child partition must not pin up to n-1 batches
+                        # in HBM
+                        slot.append(store.add_batch(b, priority=10))
+                        if len(slot) == n:
+                            cur = self._dispatch_round(
+                                m, slot, sharding, devices, has_offsets)
+                            slot = []
+                            if pending is not None:
+                                self._collect_round(
+                                    m, store, out, pending, has_offsets,
+                                    n_str)
+                            pending = cur
+                if slot:
+                    cur = self._dispatch_round(m, slot, sharding,
+                                               devices, has_offsets)
+                    slot = []
+                    if pending is not None:
+                        self._collect_round(m, store, out, pending,
+                                            has_offsets, n_str)
+                    pending = cur
+                if pending is not None:
+                    self._collect_round(m, store, out, pending,
+                                        has_offsets, n_str)
+            except BaseException:
+                # failing mid-stream (upstream OOM, bad data) must not
+                # leak: close waiting slot handles and everything parked
+                # so far; self._out stays None so a retried action
+                # re-runs the exchange from a clean slate
+                for h in slot:
+                    h.close()
+                for pile in out:
+                    for h in pile:
+                        h.close()
+                raise
             self._out = out
 
     def execute_partition(self, ctx: ExecContext, pid: int):
         self._ensure_exchanged(ctx)
         # handles stay open: the session caches exec trees, so a second
         # action re-pulls the same partitions. Unused handles demote to
-        # host/disk under pressure instead of pinning HBM.
+        # host/disk under pressure instead of pinning HBM; release()
+        # closes them when the owning plan is dropped.
         for h in self._out[pid]:
             yield h.materialize()
+
+    def release(self):
+        """Close parked exchange outputs (ADVICE r3 medium: without
+        this, every mesh query leaks device-budget accounting, host
+        memory, and spill files for the process lifetime)."""
+        with self._lock:
+            if self._out is not None:
+                for pile in self._out:
+                    for h in pile:
+                        h.close()
+                self._out = None
+        super().release()
+
+    def __del__(self):
+        try:
+            self.release()
+        except Exception:
+            pass
 
 
 def _flatten_cvs(cvs: Sequence[CV]):

@@ -276,6 +276,16 @@ class DataFrame:
         self._session = session
         self._plan = plan
 
+    def __del__(self):
+        # release long-lived plan resources (mesh-exchange output
+        # handles parked for re-execution) when the DataFrame goes away
+        try:
+            cached = getattr(self, "_cached", None)
+            if cached is not None:
+                cached[1].release()
+        except Exception:
+            pass
+
     # -- plan builders --------------------------------------------------
     @property
     def schema(self) -> Schema:

@@ -1,0 +1,131 @@
+"""Recursive out-of-core fallbacks + spill-handle lifecycle (round 4).
+
+- Aggregate bucket fan-out recurses with a fresh hash seed when a
+  bucket still exceeds maxMergeRows (reference: GpuAggregateExec 16
+  buckets x 10 levels, GpuAggregateExec.scala:863-894).
+- Sub-partitioned join re-splits a bucket whose build still exceeds the
+  budget (GpuSubPartitionHashJoin.scala:617).
+- Mesh exchange outputs are closed by plan release() (ADVICE r3 medium).
+- Abandoned generators (limit) close parked handles via try/finally.
+"""
+import numpy as np
+import pyarrow as pa
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+
+
+def _metric(df, exec_name, key):
+    total = 0
+    for op, snap in df.last_metrics().items():
+        if op.startswith(exec_name):
+            total += snap.get(key, 0)
+    return total
+
+
+def test_agg_bucket_recursion_two_levels():
+    """maxMergeRows=256 with ~10k groups forces K=16 at depth 0 and a
+    second split inside oversized buckets; results stay exact."""
+    rng = np.random.default_rng(21)
+    n = 40_000
+    keys = rng.integers(0, 10_000, n).astype(np.int64)
+    vals = rng.integers(-100, 100, n).astype(np.int64)
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.batchSizeRows": 4096,
+        "spark.rapids.tpu.sql.agg.maxMergeRows": 256,
+    })
+    df = s.create_dataframe({"k": pa.array(keys), "v": pa.array(vals)})
+    q = df.group_by("k").agg(F.sum("v").alias("sv"),
+                             F.count("*").alias("c"))
+    out = q.to_arrow()
+    got = {k: (sv, c) for k, sv, c in zip(out.column(0).to_pylist(),
+                                          out.column(1).to_pylist(),
+                                          out.column(2).to_pylist())}
+    want = {}
+    for k, v in zip(keys, vals):
+        sv, c = want.get(int(k), (0, 0))
+        want[int(k)] = (sv + int(v), c + 1)
+    assert got == want
+    assert _metric(q, "HashAggregateExec", "numBucketRecursions") >= 1, \
+        q.last_metrics()
+
+
+def test_join_subpartition_recursion():
+    """A 2 KiB build budget forces S=16 at depth 0 whose buckets still
+    exceed the budget, so the join re-splits them; equivalence vs the
+    in-core join."""
+    rng = np.random.default_rng(22)
+    n_l, n_r = 8000, 6000
+    lk = rng.integers(0, n_r * 2, n_l).astype(np.int64)
+    rk = rng.permutation(n_r * 2)[:n_r].astype(np.int64)
+    ldata = {"k": pa.array(lk), "lv": pa.array(np.arange(n_l))}
+    rdata = {"k": pa.array(rk), "rv": pa.array(np.arange(n_r) * 3)}
+
+    def run(extra):
+        s = st.TpuSession({
+            "spark.rapids.tpu.sql.batchSizeRows": 1024,
+            "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 16,
+            **extra})
+        q = (s.create_dataframe(ldata)
+             .join(s.create_dataframe(rdata), on=["k"], how="inner"))
+        out = q.to_arrow()
+        rows = sorted(zip(out.column(0).to_pylist(),
+                          out.column(1).to_pylist(),
+                          out.column(2).to_pylist()))
+        return rows, q
+
+    want, _ = run({})
+    got, q = run({"spark.rapids.tpu.sql.join.buildSideBudgetBytes": 2048})
+    assert got == want
+    assert _metric(q, "HashJoinExec", "numSubPartRecursions") >= 1, \
+        q.last_metrics()
+
+
+def test_mesh_exchange_release_closes_handles():
+    """release() on the plan closes the exchange's parked outputs and
+    returns the device-budget accounting to its baseline."""
+    from spark_rapids_tpu.memory.spill import spill_store
+    store = spill_store()
+    rng = np.random.default_rng(23)
+    n = 2048
+    data = {"k": pa.array(rng.integers(0, 50, n).astype(np.int64)),
+            "v": pa.array(rng.integers(0, 100, n).astype(np.int64))}
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 256,
+                       "spark.rapids.tpu.mesh.devices": 8})
+    q = s.create_dataframe(data).group_by("k").agg(F.sum("v").alias("sv"))
+    before = len(store._handles)
+    out = q.to_arrow()
+    assert out.num_rows == 50
+    held = len(store._handles)
+    assert held > before  # exchange parked outputs for re-execution
+    root = q._cached[1]
+    root.release()
+    assert len(store._handles) <= before, (before, held,
+                                           len(store._handles))
+
+
+def test_abandoned_generator_closes_handles():
+    """A limit over an OOC join abandons the join generators mid-stream;
+    the try/finally cleanup must close every parked pile handle."""
+    from spark_rapids_tpu.memory.spill import spill_store
+    store = spill_store()
+    rng = np.random.default_rng(24)
+    n_l, n_r = 6000, 5000
+    ldata = {"k": pa.array(rng.integers(0, n_r, n_l).astype(np.int64))}
+    rdata = {"k": pa.array(np.arange(n_r).astype(np.int64)),
+             "rv": pa.array(np.arange(n_r).astype(np.int64))}
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.batchSizeRows": 512,
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 16,
+        "spark.rapids.tpu.sql.join.buildSideBudgetBytes": 16 << 10,
+    })
+    q = (s.create_dataframe(ldata)
+         .join(s.create_dataframe(rdata), on=["k"], how="inner")
+         .limit(5))
+    before = len(store._handles)
+    out = q.to_arrow()
+    assert out.num_rows == 5
+    import gc
+    gc.collect()  # drop abandoned generators -> GeneratorExit -> finally
+    leaked = len(store._handles) - before
+    assert leaked == 0, f"{leaked} handles leaked: {store._handles}"
