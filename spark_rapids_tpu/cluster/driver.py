@@ -39,15 +39,20 @@ class _Executor:
         self.last_heartbeat = time.time()
         self.inflight: Dict[int, "_Task"] = {}
         self.lost = False
+        # per-executor outbound queue: Arrow-IPC encoding + sendall of
+        # large shuffle frames must not serialize on the one dispatcher
+        # thread (executors would idle while another's bucket uploads)
+        self.outbox: "queue.Queue[Optional[_Task]]" = queue.Queue()
 
 
 class _Task:
-    __slots__ = ("task_id", "fn", "args", "future", "attempts")
+    __slots__ = ("task_id", "fn", "args", "tables", "future", "attempts")
 
-    def __init__(self, task_id, fn, args):
+    def __init__(self, task_id, fn, args, tables=None):
         self.task_id = task_id
         self.fn = fn
         self.args = args
+        self.tables = tables
         self.future: Future = Future()
         self.attempts = 0
 
@@ -121,6 +126,7 @@ class ClusterManager:
         self._stop.set()
         with self._lock:
             for e in self._executors.values():
+                e.outbox.put(None)  # unblock the sender thread
                 try:
                     if e.sock:
                         send_msg(e.sock, "shutdown", {})
@@ -135,8 +141,12 @@ class ClusterManager:
             self._listener.close()
 
     # -- public API ----------------------------------------------------
-    def submit(self, fn: Callable, *args) -> Future:
-        t = _Task(self._alloc_id(), fn, args)
+    def submit(self, fn: Callable, *args, tables=None) -> Future:
+        """Schedule fn(*args) on an executor. When `tables` is given (a
+        possibly-empty list of pyarrow Tables), they ride the task frame
+        as Arrow IPC and arrive appended as the final positional
+        argument of fn — arity is stable even for an empty list."""
+        t = _Task(self._alloc_id(), fn, args, tables)
         self._pending.put(t)
         return t.future
 
@@ -179,7 +189,10 @@ class ClusterManager:
                 rt = threading.Thread(target=self._recv_loop,
                                       args=(eid, sock), daemon=True)
                 rt.start()
-                self._threads.append(rt)
+                st_ = threading.Thread(target=self._send_loop,
+                                       args=(eid, sock), daemon=True)
+                st_.start()
+                self._threads.extend([rt, st_])
                 self._idle.put(eid)
             elif kind == "hb_register":
                 ht = threading.Thread(target=self._hb_loop,
@@ -226,23 +239,41 @@ class ClusterManager:
                 task.attempts += 1
                 with self._lock:
                     ex.inflight[task.task_id] = task
-                try:
-                    send_msg(ex.sock, "task", {
-                        "task_id": task.task_id, "fn": task.fn,
-                        "args": task.args})
-                    break
-                except OSError:
-                    # _mark_lost already requeued this task from the
-                    # executor's inflight map — do NOT also retry it here
-                    # (double dispatch would run it on two executors)
-                    self._mark_lost(eid)
-                    break
-                except Exception as e:   # unpicklable task: fail it, keep
-                    with self._lock:     # the dispatcher alive
-                        ex.inflight.pop(task.task_id, None)
-                    task.future.set_exception(e)
-                    self._idle.put(eid)
-                    break
+                # hand off to the executor's sender thread: Arrow-IPC
+                # encoding + sendall of big frames must not stall
+                # dispatch to other idle executors
+                ex.outbox.put(task)
+                break
+
+    def _send_loop(self, eid: int, sock: socket.socket):
+        while not self._stop.is_set():
+            with self._lock:
+                ex = self._executors.get(eid)
+            if ex is None or ex.lost:
+                return
+            try:
+                task = ex.outbox.get(timeout=0.2)
+            except queue.Empty:
+                continue
+            if task is None:
+                return
+            try:
+                send_msg(sock, "task", {
+                    "task_id": task.task_id, "fn": task.fn,
+                    "args": task.args,
+                    "has_tables": task.tables is not None},
+                    tables=task.tables or ())
+            except OSError:
+                # _mark_lost requeues the executor's inflight tasks
+                # (including this one) — do NOT also retry here (double
+                # dispatch would run it on two executors)
+                self._mark_lost(eid)
+                return
+            except Exception as e:   # unpicklable task: fail it, keep
+                with self._lock:     # the executor alive
+                    ex.inflight.pop(task.task_id, None)
+                task.future.set_exception(e)
+                self._idle.put(eid)
 
     def _recv_loop(self, eid: int, sock: socket.socket):
         while not self._stop.is_set():
@@ -259,7 +290,12 @@ class ClusterManager:
                 continue
             try:
                 if kind == "result":
-                    task.future.set_result(payload["value"])
+                    if payload.get("arrow_result"):
+                        from .rpc import ArrowResult
+                        task.future.set_result(ArrowResult(
+                            payload["value"], payload.get("_arrow", [])))
+                    else:
+                        task.future.set_result(payload["value"])
                 else:
                     task.future.set_exception(RuntimeError(
                         f"task failed on executor {eid}: "

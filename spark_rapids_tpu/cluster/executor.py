@@ -40,6 +40,17 @@ def _heartbeat_loop(host: str, port: int, exec_id: int, stop):
 def executor_main(host: str, port: int, exec_id: int) -> None:
     # any accidental JAX usage inside a task must not grab the TPU
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # The env var alone is NOT enough: site packages can override
+    # JAX_PLATFORMS and hang backend init on a broken accelerator
+    # tunnel. Pin the platform via jax.config before any task runs a
+    # query fragment. SRTPU_EXECUTOR_PLATFORM=tpu opts an executor into
+    # the real chip on TPU hosts.
+    platform = os.environ.get("SRTPU_EXECUTOR_PLATFORM", "cpu")
+    try:
+        import jax
+        jax.config.update("jax_platforms", platform)
+    except ImportError:
+        pass
     stop = threading.Event()
     t = threading.Thread(target=_heartbeat_loop,
                          args=(host, port, exec_id, stop), daemon=True)
@@ -57,9 +68,22 @@ def executor_main(host: str, port: int, exec_id: int) -> None:
             task_id = payload["task_id"]
             try:
                 fn = payload["fn"]
-                result = fn(*payload.get("args", ()))
-                send_msg(sock, "result", {"task_id": task_id,
-                                          "value": result})
+                args = tuple(payload.get("args", ()))
+                # tasks submitted with tables=... get them appended as
+                # the final positional argument — ALWAYS when the flag
+                # is set, so an empty bucket list doesn't change arity
+                if payload.get("has_tables"):
+                    args = args + (payload.get("_arrow", []),)
+                result = fn(*args)
+                from .rpc import ArrowResult
+                if isinstance(result, ArrowResult):
+                    send_msg(sock, "result",
+                             {"task_id": task_id, "value": result.meta,
+                              "arrow_result": True},
+                             tables=result.tables)
+                else:
+                    send_msg(sock, "result", {"task_id": task_id,
+                                              "value": result})
             except BaseException as e:  # report, don't die
                 send_msg(sock, "error", {
                     "task_id": task_id, "message": repr(e),

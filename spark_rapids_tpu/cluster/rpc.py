@@ -1,19 +1,32 @@
-"""Length-prefixed binary RPC frames over TCP.
+"""Length-prefixed binary RPC frames over TCP, with native Arrow-IPC
+table payloads.
 
 (reference analog: the plugin RPC channel Plugin.scala:469-504 rides
-Spark's netty; here a dependency-free socket protocol.) Frame layout:
-8-byte big-endian payload length, then a pickled (kind, payload) tuple.
-Pickle is the task wire format by design — driver and executors run the
-same code tree, exactly like Spark shipping closures to executors.
+Spark's netty; shuffle blocks move as raw buffers through the block
+manager. Here: a dependency-free socket protocol whose frames carry an
+optional run of pyarrow tables serialized as Arrow IPC streams — columnar
+data never goes through pickle, so executors can ship query-fragment
+results (shuffle blocks) to the driver at memcpy cost.)
+
+Frame layout:
+  8-byte big-endian header length
+  pickled (kind, payload, [table_byte_len, ...]) header
+  for each table length: that many bytes of Arrow IPC stream
+
+Pickle remains the wire format for the control plane (task closures,
+small metadata) by design — driver and executors run the same code tree,
+exactly like Spark shipping closures to executors. Received tables are
+attached to a dict payload under the reserved key ``"_arrow"``.
 """
 from __future__ import annotations
 
 import pickle
 import socket
 import struct
-from typing import Any, Tuple
+from typing import Any, List, Sequence, Tuple
 
-__all__ = ["send_msg", "recv_msg", "RpcClosed"]
+__all__ = ["send_msg", "recv_msg", "RpcClosed", "ArrowResult",
+           "tables_to_ipc", "ipc_to_table"]
 
 _LEN = struct.Struct(">Q")
 MAX_FRAME = 1 << 34
@@ -21,6 +34,37 @@ MAX_FRAME = 1 << 34
 
 class RpcClosed(Exception):
     """Peer went away mid-frame."""
+
+
+class ArrowResult:
+    """A task result whose pyarrow tables ride the RPC as Arrow-IPC
+    frames instead of pickle. ``meta`` is any picklable metadata,
+    ``tables`` a list of pyarrow Tables."""
+
+    __slots__ = ("meta", "tables")
+
+    def __init__(self, meta: Any, tables: Sequence):
+        self.meta = meta
+        self.tables = list(tables)
+
+
+def tables_to_ipc(tables: Sequence) -> List:
+    """Serialize tables to Arrow IPC streams as pyarrow Buffers (buffer
+    protocol — sent zero-copy via memoryview, no bytes materialization)."""
+    import pyarrow as pa
+    blobs = []
+    for t in tables:
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, t.schema) as w:
+            w.write_table(t)
+        blobs.append(sink.getvalue())
+    return blobs
+
+
+def ipc_to_table(blob: bytes):
+    import pyarrow as pa
+    with pa.ipc.open_stream(pa.py_buffer(blob)) as r:
+        return r.read_all()
 
 
 def _recv_exact(sock: socket.socket, n: int) -> bytes:
@@ -33,13 +77,28 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
     return bytes(buf)
 
 
-def send_msg(sock: socket.socket, kind: str, payload: Any) -> None:
-    data = pickle.dumps((kind, payload), protocol=pickle.HIGHEST_PROTOCOL)
-    sock.sendall(_LEN.pack(len(data)) + data)
+def send_msg(sock: socket.socket, kind: str, payload: Any,
+             tables: Sequence = ()) -> None:
+    blobs = tables_to_ipc(tables) if tables else []
+    header = pickle.dumps(
+        (kind, payload, [len(memoryview(b)) for b in blobs]),
+        protocol=pickle.HIGHEST_PROTOCOL)
+    sock.sendall(_LEN.pack(len(header)) + header)
+    for b in blobs:
+        sock.sendall(memoryview(b))
 
 
 def recv_msg(sock: socket.socket) -> Tuple[str, Any]:
     (n,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
     if n > MAX_FRAME:
         raise IOError(f"oversized RPC frame: {n} bytes")
-    return pickle.loads(_recv_exact(sock, n))
+    kind, payload, lens = pickle.loads(_recv_exact(sock, n))
+    if lens:
+        if sum(lens) > MAX_FRAME:
+            raise IOError(f"oversized Arrow payload: {sum(lens)} bytes")
+        tables = [ipc_to_table(_recv_exact(sock, ln)) for ln in lens]
+        if isinstance(payload, dict):
+            payload["_arrow"] = tables
+        else:
+            payload = {"value": payload, "_arrow": tables}
+    return kind, payload

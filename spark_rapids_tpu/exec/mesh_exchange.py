@@ -259,6 +259,18 @@ class MeshExchangeExec(TpuExec):
             out: List[List] = [[] for _ in range(n)]
             slot: List = []
             pending = None
+
+            def flush(slot_handles):
+                """Dispatch a round; collect the PREVIOUS round while
+                this one runs on device (double buffering)."""
+                nonlocal pending
+                cur = self._dispatch_round(m, slot_handles, sharding,
+                                           devices, has_offsets)
+                if pending is not None:
+                    self._collect_round(m, store, out, pending,
+                                        has_offsets, n_str)
+                pending = cur
+
             try:
                 for cpid in range(child.num_partitions(ctx)):
                     for b in child.execute_partition(ctx, cpid):
@@ -267,22 +279,11 @@ class MeshExchangeExec(TpuExec):
                         # in HBM
                         slot.append(store.add_batch(b, priority=10))
                         if len(slot) == n:
-                            cur = self._dispatch_round(
-                                m, slot, sharding, devices, has_offsets)
+                            flush(slot)
                             slot = []
-                            if pending is not None:
-                                self._collect_round(
-                                    m, store, out, pending, has_offsets,
-                                    n_str)
-                            pending = cur
                 if slot:
-                    cur = self._dispatch_round(m, slot, sharding,
-                                               devices, has_offsets)
+                    flush(slot)
                     slot = []
-                    if pending is not None:
-                        self._collect_round(m, store, out, pending,
-                                            has_offsets, n_str)
-                    pending = cur
                 if pending is not None:
                     self._collect_round(m, store, out, pending,
                                         has_offsets, n_str)

@@ -1,0 +1,65 @@
+"""TPC-H Q3 as a distributed two-stage query (cluster/query.py).
+
+The multi-operator distributed benchmark shape the VERDICT asks for:
+each executor's MAP fragment runs scan -> filter -> join -> join ->
+partial grouped aggregation over its lineitem split (customer/orders are
+read in full on every executor — the broadcast-side model, exactly like
+Spark shipping broadcast tables to every node); the shuffle moves
+partial (group, revenue) rows as Arrow-IPC frames; REDUCE fragments
+re-aggregate (sum of partial sums is exact for decimal sums) and emit a
+per-bucket top-10; the driver's FINAL fragment merges bucket top-10s.
+
+All functions are module-level so the cluster RPC can pickle them by
+reference.
+"""
+from __future__ import annotations
+
+import decimal
+
+from .. import functions as F
+from ..expr.expressions import col, lit
+
+_CUT = 9204  # day("1995-03-15")
+
+
+def _sorted_top10(df):
+    from ..plan.logical import Sort, SortOrder
+    from ..session import DataFrame
+    return DataFrame(df._session, Sort(df._plan, [
+        SortOrder(col("revenue"), ascending=False),
+        SortOrder(col("o_orderdate"), ascending=True)])).limit(10)
+
+
+def q3_map(s, split):
+    """split: {"lineitem": path(s) of this executor's slice,
+    "customer": full path(s), "orders": full path(s)}."""
+    d = decimal.Decimal
+    li = s.read.parquet(*_as_list(split["lineitem"]))
+    cust = s.read.parquet(*_as_list(split["customer"]))
+    orders = s.read.parquet(*_as_list(split["orders"]))
+    rev = col("l_extendedprice") * (lit(d("1")) - col("l_discount"))
+    return (cust.filter(col("c_mktsegment") == lit("BUILDING"))
+            .join(orders.with_column("c_custkey", col("o_custkey")),
+                  on=["c_custkey"], how="inner")
+            .filter(col("o_orderdate") < _CUT)
+            .with_column("l_orderkey", col("o_orderkey"))
+            .join(li, on=["l_orderkey"], how="inner")
+            .filter(col("l_shipdate") > _CUT)
+            .group_by("l_orderkey", "o_orderdate", "o_shippriority")
+            .agg(F.sum(rev).alias("revenue")))
+
+
+def q3_reduce(s, df):
+    """Per-bucket final aggregation + local top-10."""
+    return _sorted_top10(
+        df.group_by("l_orderkey", "o_orderdate", "o_shippriority")
+        .agg(F.sum(col("revenue")).alias("revenue")))
+
+
+def q3_final(s, df):
+    """Driver-side merge of the buckets' top-10s."""
+    return _sorted_top10(df)
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
