@@ -43,6 +43,9 @@ class _Executor:
         # large shuffle frames must not serialize on the one dispatcher
         # thread (executors would idle while another's bucket uploads)
         self.outbox: "queue.Queue[Optional[_Task]]" = queue.Queue()
+        # guards sock writes: shutdown() must not splice its frame into
+        # the middle of a multi-sendall task frame from _send_loop
+        self.send_lock = threading.Lock()
 
 
 class _Task:
@@ -129,7 +132,8 @@ class ClusterManager:
                 e.outbox.put(None)  # unblock the sender thread
                 try:
                     if e.sock:
-                        send_msg(e.sock, "shutdown", {})
+                        with e.send_lock:
+                            send_msg(e.sock, "shutdown", {})
                 except OSError:
                     pass
         for e in self._executors.values():
@@ -258,11 +262,12 @@ class ClusterManager:
             if task is None:
                 return
             try:
-                send_msg(sock, "task", {
-                    "task_id": task.task_id, "fn": task.fn,
-                    "args": task.args,
-                    "has_tables": task.tables is not None},
-                    tables=task.tables or ())
+                with ex.send_lock:
+                    send_msg(sock, "task", {
+                        "task_id": task.task_id, "fn": task.fn,
+                        "args": task.args,
+                        "has_tables": task.tables is not None},
+                        tables=task.tables or ())
             except OSError:
                 # _mark_lost requeues the executor's inflight tasks
                 # (including this one) — do NOT also retry here (double

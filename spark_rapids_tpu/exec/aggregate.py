@@ -1180,7 +1180,7 @@ class CollectAggExec(TpuExec):
                 first_flag = jnp.zeros(cap, jnp.bool_).at[order2].set(
                     firsts2)
                 kind = type(a).__name__
-                if kind in ("CountDistinct", "ApproxCountDistinct"):
+                if kind == "CountDistinct":
                     keep = valid & first_flag
                     cnt = jax.ops.segment_sum(keep.astype(jnp.int64),
                                               seg_ids, cap)

@@ -611,18 +611,193 @@ class CountDistinct(_Collect):
         return f"count(DISTINCT {self.child})"
 
 
-class ApproxCountDistinct(CountDistinct):
-    """approx_count_distinct: implemented EXACTLY via the segmented sort
-    (a strict accuracy superset of the reference's HyperLogLog++;
-    the rsd argument is accepted and ignored — see docs/compatibility.md).
-    Reference: GpuHyperLogLogPlusPlus in aggregateFunctions.scala."""
+class _HllHash(Expression):
+    """Internal: murmur3(child) with the CHILD's validity (nulls skip —
+    unlike the user-facing Murmur3Hash whose null folds to the seed).
+    Makes the HLL agg input fixed-width int32, so strings/decimals ride
+    the grouped agg paths that strip var-width agg inputs."""
+
+    def __init__(self, child):
+        self.child = child
+        self.children = [child]
+        self.dtype = dt.INT32
+
+    def bind(self, schema):
+        return _HllHash(self.child.bind(schema))
+
+    def emit(self, ctx):
+        from ..ops.hash import murmur3_cv
+        cv = self.child.emit(ctx)
+        h = murmur3_cv(cv, self.child.dtype, jnp.int32(42))
+        return CV(h, cv.validity)
+
+    def __repr__(self):
+        return f"hll_hash({self.child})"
+
+
+def _clz32(x):
+    """Vectorized count-leading-zeros over uint32 (5-step binary
+    search; no clz primitive in XLA HLO)."""
+    x = x.astype(jnp.uint32)
+    zero = x == 0
+    c = jnp.zeros(x.shape, jnp.int32)
+    for sh in (16, 8, 4, 2, 1):
+        cond = x < (jnp.uint32(1) << (32 - sh))
+        c = c + jnp.where(cond, sh, 0)
+        x = jnp.where(cond, x << sh, x)
+    return jnp.where(zero, 32, c)
+
+
+class ApproxCountDistinct(AggExpr):
+    """approx_count_distinct as HyperLogLog++ with O(2^p) register state
+    — bounded across the exchange regardless of cardinality (reference:
+    GpuHyperLogLogPlusPlus in org/apache/spark/sql/rapids/aggregate/,
+    cuDF JNI HLLPP kernels).
+
+    TPU-first layout: the 2^p byte registers of every group pack 8-per-
+    int64 into W = 2^p / 8 ordinary state COLUMNS, so partial states ride
+    the existing partial/final wire schema, spill framework, and mesh
+    exchange like any other aggregate. update computes (register-index,
+    rho) per row from the engine's 32-bit murmur3 (via the bound _HllHash
+    child, so any input type arrives as int32) and runs ONE segment_max
+    over combined (segment * m + register) ids — output memory is
+    O(num_segments * 2^p), which on the FIRST per-batch update means
+    O(batch_cap * 2^p) int32 (e.g. 4096-row batches at p=9: 8 MB; size
+    batches accordingly for small rsd) and collapses to O(groups * 2^p)
+    after the first merge. Merge is a per-byte max of packed words
+    (custom segmented reducer). Estimation uses the HLL++ alpha with
+    linear counting below 2.5m and the 32-bit large-range correction;
+    the empirical bias table is omitted (documented in
+    docs/compatibility.md — worst case a few percent in the 2.5m..5m
+    band, still within typical rsd use).
+
+    rsd -> p via rsd = 1.04/sqrt(2^p), clamped to [4, 12].
+    """
+
+    state_reducers = ("custom",)
 
     def __init__(self, child, rsd: float = 0.05):
         super().__init__(child)
         self.rsd = rsd
+        import math
+        p = math.ceil(2 * math.log2(1.04 / rsd))
+        self.p = max(4, min(12, p))
+        self.m = 1 << self.p
+        self.W = self.m // 8
+
+    def bind(self, schema):
+        bc = self.child.bind(schema)
+        if bc.dtype.is_nested:
+            raise UnsupportedExpr("approx_count_distinct over nested")
+        b = type(self)(_HllHash(bc), self.rsd)
+        b._resolve_type()
+        return b
+
+    def _resolve_type(self):
+        self.dtype = dt.INT64
+
+    def num_state_cols(self):
+        return self.W
+
+    # -- hashing --------------------------------------------------------
+    def _idx_rho(self, cv: CV, mask):
+        # child is _HllHash: cv.data IS the 32-bit hash, validity is the
+        # original child's (nulls excluded)
+        hu = cv.data.astype(jnp.uint32)
+        valid = mask & cv.validity
+        idx = (hu >> (32 - self.p)).astype(jnp.int32)
+        w = hu << self.p
+        rho = _clz32(w) + 1          # 1..(32-p)+1; w==0 -> 33-p cap
+        rho = jnp.minimum(rho, 32 - self.p + 1)
+        rho = jnp.where(valid, rho, 0).astype(jnp.int32)
+        idx = jnp.where(valid, idx, 0)
+        return idx, rho
+
+    def _pack(self, regs2d):
+        """(nseg, m) int32 registers -> tuple of W packed int64 words."""
+        n = regs2d.shape[0]
+        r = regs2d.reshape(n, self.W, 8).astype(jnp.int64)
+        shifts = (jnp.arange(8, dtype=jnp.int64) * 8)[None, None, :]
+        words = jnp.sum(r << shifts, axis=2)      # (nseg, W)
+        return tuple(words[:, i] for i in range(self.W))
+
+    @staticmethod
+    def _unpack(words):
+        """list of W (n,) int64 -> (n, m) int32 registers."""
+        return ApproxCountDistinct._unpack_stacked(
+            jnp.stack(words, axis=1))
+
+    @staticmethod
+    def _unpack_stacked(stacked):
+        """(n, W) packed int64 -> (n, m) int32 registers."""
+        shifts = (jnp.arange(8, dtype=jnp.int64) * 8)[None, None, :]
+        bytes_ = (stacked[:, :, None] >> shifts) & jnp.int64(0xFF)
+        n = stacked.shape[0]
+        return bytes_.reshape(n, -1).astype(jnp.int32)
+
+    # -- grouped --------------------------------------------------------
+    def g_update(self, cv: CV, mask, seg_ids, num_segments):
+        idx, rho = self._idx_rho(cv, mask)
+        # combined (segment, register) key -> one segment_max over
+        # num_segments * m slots. Memory is O(cap + num_segments * m);
+        # the with_retry split bounds cap, and num_segments collapses to
+        # the actual group capacity after the first merge.
+        comb = seg_ids.astype(jnp.int64) * self.m + idx.astype(jnp.int64)
+        regs = jax.ops.segment_max(rho, comb, num_segments * self.m)
+        # empty (segment, register) slots come back as int32-min (the
+        # segment_max identity) — clamp to 0 before byte-packing
+        regs = jnp.maximum(regs, 0)
+        words = self._pack(regs.reshape(num_segments, self.m))
+        return tuple(words)
+
+    def g_merge_custom(self, cols_sorted, live, seg_ids, num_segments):
+        regs = self._unpack(list(cols_sorted))    # (cap, m)
+        regs = jnp.where(live[:, None], regs, 0)
+        merged = jax.ops.segment_max(regs, seg_ids, num_segments)
+        return self._pack(jnp.maximum(merged, 0))  # empty seg -> int-min
+
+    # -- ungrouped ------------------------------------------------------
+    # State is ONE (W,) vector (not W scalars: the runtime dedups
+    # aliased same-buffer args, and W slices of one packed array broke
+    # the compiled arg count).
+    def update(self, cv: CV, mask):
+        zeros = jnp.zeros(mask.shape[0], jnp.int32)
+        words = self.g_update(cv, mask, zeros, 1)
+        return (jnp.stack([w[0] for w in words]),)
+
+    def merge(self, s1, s2):
+        r1 = self._unpack_stacked(s1[0][None, :])
+        r2 = self._unpack_stacked(s2[0][None, :])
+        packed = self._pack(jnp.maximum(r1, r2))
+        return (jnp.stack([w[0] for w in packed]),)
+
+    def finalize(self, s):
+        arrs = list(s)
+        # ungrouped state is ONE (W,) vector; grouped is W >= 2 columns
+        ungrouped = len(arrs) == 1 and arrs[0].ndim == 1
+        if ungrouped:
+            regs = self._unpack_stacked(arrs[0][None, :])
+        else:
+            regs = self._unpack(arrs)             # (n, m)
+        m = float(self.m)
+        alpha = 0.7213 / (1.0 + 1.079 / m)
+        inv = jnp.sum(jnp.exp2(-regs.astype(jnp.float64)), axis=1)
+        e_raw = alpha * m * m / inv
+        zeros = jnp.sum((regs == 0).astype(jnp.float64), axis=1)
+        lin = m * jnp.log(m / jnp.maximum(zeros, 1.0))
+        est = jnp.where((e_raw <= 2.5 * m) & (zeros > 0), lin, e_raw)
+        two32 = 4294967296.0
+        est = jnp.where(
+            est > two32 / 30.0,
+            -two32 * jnp.log1p(-jnp.minimum(est, two32 * 0.999) / two32),
+            est)
+        out = jnp.round(est).astype(jnp.int64)
+        if ungrouped:
+            return out[0], jnp.bool_(True)
+        return out, jnp.ones(out.shape[0], jnp.bool_)
 
     def __repr__(self):
-        return f"approx_count_distinct({self.child})"
+        return f"approx_count_distinct({self.child}, rsd={self.rsd})"
 
 
 class Percentile(_Collect):

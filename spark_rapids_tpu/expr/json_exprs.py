@@ -110,14 +110,40 @@ class _HostOnlyExpr(Expression):
         raise UnsupportedExpr(self._reason)
 
 
-class GetJsonObject(_HostOnlyExpr):
-    _reason = "get_json_object runs on the CPU bridge"
+class GetJsonObject(Expression):
+    """SCALAR paths (field/index steps) evaluate ON DEVICE via the byte-
+    tape tokenizer (ops/json_tape.py — the analog of the reference's JNI
+    JSONUtils.getJsonObject kernel); wildcard paths route to the CPU
+    bridge like before. SRTPU_JSON_HOST=1 forces the host path (used by
+    tests to cross-check both)."""
+
     host_dtype = dt.STRING
 
     def __init__(self, child: Expression, path: str):
         self.children = [_wrap(child)]
         self.path = path
         self.steps = parse_json_path(path)
+
+    def bind(self, schema):
+        import os
+
+        from ..ops.json_tape import device_path_supported
+        if os.environ.get("SRTPU_JSON_HOST") == "1" \
+                or not device_path_supported(self.steps):
+            raise UnsupportedExpr(
+                "get_json_object wildcard path runs on the CPU bridge")
+        b = GetJsonObject(self.children[0].bind(schema), self.path)
+        if not isinstance(b.children[0].dtype, dt.StringType):
+            raise UnsupportedExpr("get_json_object over non-string")
+        b.dtype = dt.STRING
+        return b
+
+    def emit(self, ctx):
+        from ..ops.json_tape import get_json_object_tape
+        cv = self.children[0].emit(ctx)
+        # result is a slice of the input: input byte capacity bounds it
+        return get_json_object_tape(cv, self.steps,
+                                    out_data_capacity=cv.data.shape[0])
 
     @property
     def name(self):

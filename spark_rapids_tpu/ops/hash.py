@@ -22,8 +22,15 @@ from .kernel_utils import CV
 
 __all__ = ["murmur3_cv", "murmur3_row_hash", "partition_ids"]
 
-_C1 = jnp.int32(-862048943)    # 0xcc9e2d51
-_C2 = jnp.int32(461845907)     # 0x1b873593
+# numpy (NOT jnp) scalars: module-level eager jnp constants become
+# captured device buffers hoisted into executable parameters, and the
+# dispatch fast path drops them when an executable's own output is fed
+# back as an argument ("supplied N buffers but compiled program expected
+# N+2") — np constants bake into the HLO as literals instead
+import numpy as _np
+
+_C1 = _np.int32(-862048943)    # 0xcc9e2d51
+_C2 = _np.int32(461845907)     # 0x1b873593
 
 
 def _rotl(x, r):

@@ -111,10 +111,11 @@ for _n in ("MapKeys", "MapValues"):
 for _n in ("ArrayMin", "ArrayMax"):
     register(_n, TypeSig(dt.ArrayType),
              "numeric/temporal elements; decimal p<=18")
-for _n in ("CountDistinct", "ApproxCountDistinct"):
-    register(_n, ALL_COMMON,
-             "exact distinct count via segmented sort (accuracy superset "
-             "of HLL++)")
+register("CountDistinct", ALL_COMMON,
+         "exact distinct count via segmented sort")
+register("ApproxCountDistinct", ALL_COMMON,
+         "HyperLogLog++ sketch, O(2^p) state; rsd -> p in [4,12] "
+         "(docs/compatibility.md: 32-bit hash, no bias table)")
 for _n in ("Percentile", "ApproxPercentile", "Median"):
     register(_n, INTEGRAL + FLOATING,
              "exact rank selection via segmented sort (accuracy superset "
