@@ -174,6 +174,13 @@ SORT_OOC_THRESHOLD = _conf(
     "sql.sort.outOfCore.thresholdBytes", 2 << 30,
     "Device bytes of sort input above which the out-of-core path "
     "activates.", int)
+WINDOW_CHUNK_ROWS = _conf(
+    "sql.window.chunkRows", 1 << 22,
+    "Row count above which chunkable window specs (running frames + "
+    "ranking over fixed-width keys) stream chunk-by-chunk through the "
+    "out-of-core sort with carried per-partition state, so a window "
+    "partition no longer must fit device memory (reference: "
+    "GpuRunningWindowExec batched running windows). 0 disables.", int)
 AGG_MAX_MERGE_ROWS = _conf(
     "sql.agg.maxMergeRows", 1 << 21,
     "Upper bound on buffered partial-aggregate rows merged in one "

@@ -165,7 +165,24 @@ class WindowExec(TpuExec):
         return f"WindowExec[{[w.fn for w in self.wexprs]}]"
 
     # ------------------------------------------------------------------
-    def _compute(self, cvs, mask, nchunks):
+    def _compute(self, cvs, mask, nchunks, pk_nulls_first=False):
+        ctx, wctx = self._prepare(cvs, mask, nchunks, pk_nulls_first)
+        outs = []
+        for w in self.wexprs:
+            outs.append(self._one(w, ctx, wctx))
+        sorted_cols = [take(cv, wctx["perm"], in_bounds=wctx["live"])
+                       for cv in cvs]
+        return sorted_cols, outs, wctx["live"]
+
+    def _prepare(self, cvs, mask, nchunks, pk_nulls_first=False,
+                 presorted=False):
+        """Sort + segment the batch; returns (EmitCtx, window context).
+        pk_nulls_first=True matches the chunked stream order (the
+        internal OOC sort ranges with nulls first), so a null partition
+        stays contiguous across chunk boundaries. presorted=True skips
+        the multi-key lexsort (the chunked stream is already globally
+        sorted; only dead capacity-padding rows need compacting to the
+        back, a single-key stable sort)."""
         cap = mask.shape[0]
         ctx = EmitCtx(list(cvs), cap)
         pkeys = [k.emit(ctx) for k in self.spec.partition_keys]
@@ -175,7 +192,10 @@ class WindowExec(TpuExec):
         pk_arrays = []
         i = 0
         for kcv, kexpr in zip(pkeys, self.spec.partition_keys):
-            pk_arrays.append(jnp.logical_not(kcv.validity).astype(jnp.uint8))
+            nullkey = (kcv.validity.astype(jnp.uint8) if pk_nulls_first
+                       else jnp.logical_not(kcv.validity)
+                       .astype(jnp.uint8))
+            pk_arrays.append(nullkey)
             pk_arrays.extend(sk.order_keys(kcv, kexpr.dtype, nchunks[i]))
             i += 1
         ok_arrays = []
@@ -185,7 +205,10 @@ class WindowExec(TpuExec):
             ok_arrays.extend(sk.order_keys(kcv, o.expr.dtype, nchunks[i],
                                            descending=not o.ascending))
             i += 1
-        perm = sk.lexsort(arrays + pk_arrays + ok_arrays)
+        if presorted:
+            perm = jnp.argsort(arrays[0], stable=True).astype(jnp.int32)
+        else:
+            perm = sk.lexsort(arrays + pk_arrays + ok_arrays)
         live = mask[perm]
 
         pb = sk.group_boundaries([a[perm] for a in arrays + pk_arrays])
@@ -225,12 +248,250 @@ class WindowExec(TpuExec):
                     seg_start=seg_start, seg_end=seg_end, pos=pos,
                     pos_in_seg=pos_in_seg, cnt_row=cnt_row,
                     peer_start=peer_start, peer_end=peer_end, skey=skey,
-                    cap=cap)
-        outs = []
+                    cap=cap, pkeys=pkeys)
+        return ctx, wctx
+
+    # ---- chunked (out-of-core) windows --------------------------------
+    _CHUNK_RUNNING = ("sum", "avg", "count", "min", "max")
+    _CHUNK_RANKING = ("row_number", "rank", "dense_rank")
+
+    def _chunkable(self) -> bool:
+        """Running frames + ranking over fixed-width keys can stream
+        chunk-by-chunk with carried per-partition state (reference:
+        GpuRunningWindowExec.scala batched running windows). Everything
+        else needs the whole partition resident."""
+        if not self.spec.orders:
+            return False
+        fixed = lambda e: (not e.dtype.is_variable_width  # noqa: E731
+                           and not e.dtype.is_nested
+                           and not (isinstance(e.dtype, dt.DecimalType)
+                                    and e.dtype.is_decimal128))
+        if not all(fixed(k) for k in self.spec.partition_keys):
+            return False
+        if not all(fixed(o.expr) for o in self.spec.orders):
+            return False
         for w in self.wexprs:
-            outs.append(self._one(w, ctx, wctx))
+            if w.fn in self._CHUNK_RANKING:
+                continue
+            if (w.fn in self._CHUNK_RUNNING
+                    and w.spec.frame == (UNBOUNDED, CURRENT_ROW)
+                    and w.child is not None
+                    and fixed(w.child)):
+                continue
+            return False
+        return True
+
+    def _zero_carry(self):
+        pk = tuple((jnp.zeros((), k.dtype.np_dtype), jnp.zeros((), bool))
+                   for k in self.spec.partition_keys)
+        aggs = []
+        for w in self.wexprs:
+            if w.fn in self._CHUNK_RANKING:
+                # ranking fns carry via part_rows/dense, no agg state
+                aggs.append(None)
+            else:
+                acc = (jnp.float64 if jnp.issubdtype(
+                    jnp.dtype(w.child.dtype.np_dtype), jnp.floating)
+                    else jnp.int64)
+                aggs.append((jnp.zeros((), acc), jnp.zeros((), jnp.int64)))
+        return dict(valid=jnp.zeros((), bool), pk=pk,
+                    part_rows=jnp.zeros((), jnp.int64),
+                    dense=jnp.zeros((), jnp.int64), aggs=tuple(aggs))
+
+    def _one_chunked(self, w, ctx, wc, cont_first, carry_s, carry_c,
+                     carry_rows, carry_dense):
+        """Chunkable window fns with carried-state adjustment applied to
+        rows of the chunk's FIRST segment when it continues the previous
+        chunk's partition. Returns (out CV, end_s, end_c) where end_*
+        are the adjusted running states at arbitrary row index (gathered
+        later for the next carry); ranking fns return (cv, None, None)
+        since they carry via part_rows/dense instead."""
+        live, pos = wc["live"], wc["pos"]
+        seg_ids, pos_in_seg = wc["seg_ids"], wc["pos_in_seg"]
+        seg_start = wc["seg_start"]
+        first_seg = live & (seg_ids == seg_ids[0])
+        adj = first_seg & cont_first
+        if w.fn == "row_number":
+            out = (pos_in_seg + 1
+                   + jnp.where(adj, carry_rows, 0)).astype(jnp.int64)
+            return CV(out.astype(jnp.int32), live), None, None
+        if w.fn == "rank":
+            last_ob = jax.lax.associative_scan(
+                jnp.maximum, jnp.where(wc["ob"], pos, -1))
+            rk = (last_ob - seg_start + 1).astype(jnp.int64)
+            out = rk + jnp.where(adj, carry_rows, 0)
+            return CV(out.astype(jnp.int32), live), None, None
+        if w.fn == "dense_rank":
+            c2 = jnp.cumsum(wc["ob"].astype(jnp.int32))
+            base = c2[jnp.clip(seg_start, 0, wc["cap"] - 1)]
+            loc = (c2 - base + 1).astype(jnp.int64)
+            out = loc + jnp.where(adj, carry_dense, 0)
+            return CV(out.astype(jnp.int32), live), None, None
+        # running aggregate (UNBOUNDED PRECEDING .. CURRENT ROW)
+        cv = w.child.emit(ctx)
+        scv = take(cv, wc["perm"], in_bounds=live)
+        valid = scv.validity & live
+        x = scv.data
+        acc_dt = (jnp.float64 if jnp.issubdtype(x.dtype, jnp.floating)
+                  else jnp.int64)
+        xz = jnp.where(valid, x, 0).astype(acc_dt)
+        vz = valid.astype(jnp.int64)
+        at = (wc["peer_end"] if w.spec.frame_mode == "range" else pos)
+        if w.fn in ("min", "max"):
+            s = _seg_scan_minmax(x, valid, wc["pb"], w.fn == "min")[at]
+            c = _running(vz, wc["seg_start"])[at]
+            red = jnp.minimum if w.fn == "min" else jnp.maximum
+            have_carry = adj & (carry_c > 0)
+            s_adj = jnp.where(
+                have_carry,
+                jnp.where(c > 0, red(s, carry_s.astype(s.dtype)),
+                          carry_s.astype(s.dtype)), s)
+            c_adj = c + jnp.where(adj, carry_c, 0)
+            return (self._finish(w, s_adj, c_adj, live),
+                    s_adj.astype(jnp.float64)
+                    if jnp.issubdtype(s_adj.dtype, jnp.floating)
+                    else s_adj.astype(jnp.int64), c_adj)
+        s = _running(xz, wc["seg_start"])[at]
+        c = _running(vz, wc["seg_start"])[at]
+        s_adj = s + jnp.where(adj, carry_s.astype(s.dtype), 0)
+        c_adj = c + jnp.where(adj, carry_c, 0)
+        return self._finish(w, s_adj, c_adj, live), s_adj, c_adj
+
+    def _compute_chunk(self, cvs, mask, nchunks, carry, emit_all: bool):
+        """One streamed chunk: sort, compute adjusted window outputs,
+        split off the HOLDBACK (last peer group of the last partition —
+        possibly peer-incomplete until the next chunk arrives), and
+        produce the next carry. Returns (sorted_cols, outs, emitted,
+        n_emit, n_live, carry_next)."""
+        ctx, wc = self._prepare(cvs, mask, nchunks, pk_nulls_first=True,
+                                presorted=True)
+        live, pos, cap = wc["live"], wc["pos"], wc["cap"]
+        seg_ids = wc["seg_ids"]
+        perm = wc["perm"]
+        spkeys = [CV(kcv.data[perm], kcv.validity[perm])
+                  for kcv in wc["pkeys"]]
+
+        # does the first (sorted) row continue the carried partition?
+        cont = carry["valid"]
+        for (cd, cvl), kcv in zip(carry["pk"], spkeys):
+            eq = (kcv.data[0] == cd) & kcv.validity[0] & cvl
+            both_null = ~kcv.validity[0] & ~cvl
+            cont = cont & (eq | both_null)
+
+        outs, end_s, end_c = [], [], []
+        for w, agg in zip(self.wexprs, carry["aggs"]):
+            cs, cc = agg if agg is not None else (None, None)
+            o, es, ec = self._one_chunked(
+                w, ctx, wc, cont, cs, cc,
+                carry["part_rows"], carry["dense"])
+            outs.append(o)
+            end_s.append(es)
+            end_c.append(ec)
+
+        n_live = jnp.sum(live.astype(jnp.int32))
+        last_live = jnp.clip(n_live - 1, 0, cap - 1)
+        if emit_all:
+            emitted = live
+            n_emit = n_live
+        else:
+            last_seg = seg_ids[last_live]
+            holdback = live & (seg_ids == last_seg) \
+                & (wc["peer_end"] == wc["seg_end"])
+            emitted = live & ~holdback
+            n_emit = jnp.sum(emitted.astype(jnp.int32))
+
+        # next carry from the LAST EMITTED row (live rows are a sorted
+        # prefix; holdback is its contiguous tail)
+        e = jnp.clip(n_emit - 1, 0, cap - 1)
+        any_emit = n_emit > 0
+        same_seg = seg_ids[e] == seg_ids[0]
+        cont_e = cont & same_seg
+        pk_next = tuple(
+            (jnp.where(any_emit, kcv.data[e], cd),
+             jnp.where(any_emit, kcv.validity[e], cvl))
+            for kcv, (cd, cvl) in zip(spkeys, carry["pk"]))
+        part_rows_next = jnp.where(
+            any_emit,
+            wc["pos_in_seg"][e] + 1 + jnp.where(cont_e,
+                                                carry["part_rows"], 0),
+            carry["part_rows"])
+        c2 = jnp.cumsum(wc["ob"].astype(jnp.int32))
+        base = c2[jnp.clip(wc["seg_start"], 0, cap - 1)]
+        dense_next = jnp.where(
+            any_emit,
+            (c2[e] - base[e] + 1).astype(jnp.int64)
+            + jnp.where(cont_e, carry["dense"], 0),
+            carry["dense"])
+        aggs_next = tuple(
+            None if agg is None else
+            (jnp.where(any_emit, es[e], agg[0]).astype(agg[0].dtype),
+             jnp.where(any_emit, ec[e], agg[1]).astype(agg[1].dtype))
+            for (es, ec), agg in zip(zip(end_s, end_c), carry["aggs"]))
+        carry_next = dict(valid=carry["valid"] | any_emit, pk=pk_next,
+                          part_rows=part_rows_next, dense=dense_next,
+                          aggs=aggs_next)
         sorted_cols = [take(cv, perm, in_bounds=live) for cv in cvs]
-        return sorted_cols, outs, live
+        return (sorted_cols, outs, emitted, n_emit, n_live, carry_next)
+
+    def _execute_chunked(self, ctx: ExecContext, m, sorted_stream):
+        """Drive the chunk stream: carry state forward, emit per chunk,
+        re-queue each chunk's holdback in front of the next."""
+        from ..ops.gather import gather_cols
+        from ..columnar.column import bucket_capacity
+
+        carry = self._zero_carry()
+        hold_cvs, hold_mask = None, None
+        nchunks = tuple(0 for _ in (list(self.spec.partition_keys)
+                                    + list(self.spec.orders)))
+
+        def assembled(batch):
+            if hold_cvs is None:
+                return list(batch.cvs()), batch.row_mask
+            cvs = [concat_cvs([h, c], f.dtype) for h, c, f in
+                   zip(hold_cvs, batch.cvs(),
+                       self.children[0].schema.fields)]
+            return cvs, concat_masks([hold_mask, batch.row_mask])
+
+        stream = iter(sorted_stream)
+        nxt = next(stream, None)
+        while nxt is not None:
+            batch = nxt
+            nxt = next(stream, None)
+            is_last = nxt is None
+            cvs, mask = assembled(batch)
+            with m.timer("opTime"):
+                key = (mask.shape[0], is_last)
+                fn = self._jit_cache.get(("chunk", key))
+                if fn is None:
+                    fn = jax.jit(lambda c, mk, cr, _l=is_last:
+                                 self._compute_chunk(c, mk, nchunks,
+                                                     cr, _l))
+                    self._jit_cache[("chunk", key)] = fn
+                # this path runs under memory pressure by construction;
+                # retry-after-spill like the in-core window (no input
+                # split: the chunk is already the streaming unit)
+                from ..memory.retry import retry_no_split
+                (sorted_cols, outs, emitted, n_emit_d, n_live_d,
+                 carry) = retry_no_split(lambda: fn(cvs, mask, carry))
+                n_emit = fetch_int(n_emit_d)
+                n_live = fetch_int(n_live_d)
+            cap = mask.shape[0]
+            if n_emit > 0:
+                tbl = make_table(self.schema,
+                                 list(sorted_cols) + list(outs), cap)
+                m.add("numOutputBatches", 1)
+                m.add("numChunks", 1)
+                yield DeviceBatch(tbl, cap, emitted, cap)
+            # holdback rows [n_emit, n_live) re-enter before next chunk
+            if not is_last and n_live > n_emit:
+                nh = n_live - n_emit
+                hcap = bucket_capacity(nh)
+                idx = jnp.arange(hcap, dtype=jnp.int32) + n_emit
+                inb = jnp.arange(hcap) < nh
+                hold_cvs = gather_cols(sorted_cols, idx, inb)
+                hold_mask = inb
+            else:
+                hold_cvs, hold_mask = None, None
 
     def _frame_bounds(self, w: WindowExpr, wc):
         """Resolve the frame to per-row [lo, hi] index bounds over the
@@ -416,11 +677,57 @@ class WindowExec(TpuExec):
 
     # ------------------------------------------------------------------
     def execute_partition(self, ctx: ExecContext, pid: int):
+        from ..config import WINDOW_CHUNK_ROWS
         m = ctx.metrics_for(self._op_id)
         child = self.children[0]
+        chunk_rows = ctx.conf.get(WINDOW_CHUNK_ROWS)
+        if chunk_rows > 0 and self._chunkable():
+            yield from self._execute_spillable(ctx, m, chunk_rows)
+            return
         batches = []
         for cpid in range(child.num_partitions(ctx)):
             batches.extend(child.execute_partition(ctx, cpid))
+        yield from self._execute_incore(ctx, m, batches)
+
+    def _execute_spillable(self, ctx: ExecContext, m, chunk_rows: int):
+        """Collect the child into spillable handles (the SpillStore keeps
+        HBM bounded while the exact input size is measured — same pattern
+        as SortExec), then stream chunk-by-chunk through the internal
+        out-of-core sort when the input exceeds sql.window.chunkRows."""
+        from ..memory.spill import spill_store
+        from ..plan.logical import SortOrder
+        from .sort import SortExec, _HandleScanExec
+        child = self.children[0]
+        store = spill_store(ctx.conf)
+        handles = []
+        total_rows = 0
+        try:
+            for cpid in range(child.num_partitions(ctx)):
+                for b in child.execute_partition(ctx, cpid):
+                    total_rows += b.num_rows
+                    handles.append(store.add_batch(b))
+            if total_rows <= chunk_rows:
+                yield from self._execute_incore(
+                    ctx, m, [h.materialize() for h in handles])
+                return
+            orders = ([SortOrder(k, True, nulls_first=True)
+                       for k in self.spec.partition_keys]
+                      + list(self.spec.orders))
+            schema = child.schema
+            sorter = SortExec(_HandleScanExec(handles, schema), orders,
+                              schema)
+
+            def stream():
+                for spid in range(sorter.num_partitions(ctx)):
+                    yield from sorter.execute_partition(ctx, spid)
+
+            yield from self._execute_chunked(ctx, m, stream())
+        finally:
+            for h in handles:
+                h.close()
+
+    def _execute_incore(self, ctx: ExecContext, m, batches):
+        child = self.children[0]
         if not batches:
             return
         ncols = len(batches[0].table.columns)
