@@ -81,8 +81,11 @@ class UngroupedAggExec(TpuExec):
             out = []
             for a, s in zip(self.aggs, states):
                 v, ok = a.finalize(s)
-                out.append((jnp.reshape(v, (1,) + tuple(v.shape)),
-                            jnp.reshape(ok, (1,))))
+                if isinstance(v, CV):
+                    out.append((v, jnp.reshape(ok, (1,))))
+                else:
+                    out.append((jnp.reshape(v, (1,) + tuple(v.shape)),
+                                jnp.reshape(ok, (1,))))
             return out
 
         self._update_jit = jax.jit(_update)
@@ -126,8 +129,11 @@ class UngroupedAggExec(TpuExec):
             out = []
             for a, s in zip(self.aggs, acc):
                 v, ok = a.finalize(s)
-                out.append((jnp.reshape(v, (1,) + tuple(v.shape)),
-                            jnp.reshape(ok, (1,))))
+                if isinstance(v, CV):
+                    out.append((v, jnp.reshape(ok, (1,))))
+                else:
+                    out.append((jnp.reshape(v, (1,) + tuple(v.shape)),
+                                jnp.reshape(ok, (1,))))
             return out
         return jax.jit(run)
 
@@ -153,15 +159,7 @@ class UngroupedAggExec(TpuExec):
         child = self._base
         stacked_out = self._try_whole_input(ctx, m)
         if stacked_out is not None:
-            cvs = []
-            for (v, ok) in stacked_out:
-                pad = 128 - 1
-                data = jnp.concatenate(
-                    [v, jnp.zeros((pad,) + v.shape[1:], v.dtype)])
-                valid = jnp.concatenate([ok.astype(jnp.bool_),
-                                         jnp.zeros(pad, jnp.bool_)])
-                cvs.append(CV(data, valid))
-            tbl = make_table(self.schema, cvs, 1)
+            tbl = make_table(self.schema, _pad_one_row(stacked_out), 1)
             m.add("numOutputRows", 1)
             yield DeviceBatch(tbl, 1)
             return
@@ -184,18 +182,30 @@ class UngroupedAggExec(TpuExec):
                    for f in self._base.schema.fields]
             acc = self._update_jit(cvs, jnp.zeros(128, jnp.bool_))
         outs = self._finalize_jit(acc)
-        # build 1-row (padded) columns
-        cvs = []
-        for (v, ok) in outs:
-            pad = 128 - 1
-            data = jnp.concatenate(
-                [v, jnp.zeros((pad,) + v.shape[1:], v.dtype)])
-            valid = jnp.concatenate([ok.astype(jnp.bool_),
-                                     jnp.zeros(pad, jnp.bool_)])
-            cvs.append(CV(data, valid))
-        tbl = make_table(self.schema, cvs, 1)
+        tbl = make_table(self.schema, _pad_one_row(outs), 1)
         m.add("numOutputRows", 1)
         yield DeviceBatch(tbl, 1)
+
+
+def _pad_one_row(outs):
+    """1-row (capacity-128-padded) output columns from finalized
+    (value, ok) pairs; array-valued finalizes arrive as CVs with
+    offsets+child already built."""
+    cvs = []
+    pad = 128 - 1
+    for (v, ok) in outs:
+        valid = jnp.concatenate([jnp.reshape(ok, (1,)).astype(jnp.bool_),
+                                 jnp.zeros(pad, jnp.bool_)])
+        if isinstance(v, CV):
+            off = v.offsets
+            off_p = jnp.concatenate(
+                [off, jnp.full((pad,), off[-1], off.dtype)])
+            cvs.append(CV(v.data, valid, off_p, v.children))
+        else:
+            data = jnp.concatenate(
+                [v, jnp.zeros((pad,) + v.shape[1:], v.dtype)])
+            cvs.append(CV(data, valid))
+    return cvs
 
 
 def _gather_raw(arr, perm):
@@ -297,7 +307,12 @@ class HashAggregateExec(TpuExec):
                     dt.IntegerType, dt.DateType, dt.LongType,
                     dt.TimestampType, dt.DecimalType, dt.FloatType,
                     dt.DoubleType, dt.StringType, dt.BinaryType)
-        self._hash_ok = all(isinstance(k.dtype, hashable) for k in self.keys)
+        self._hash_ok = (all(isinstance(k.dtype, hashable)
+                             for k in self.keys)
+                         # an agg whose g_update sorts internally (t-digest)
+                         # would defeat the no-sort hash first pass
+                         and all(getattr(a, "sort_free_update", True)
+                                 for a in self.aggs))
         self._hash_disabled = False
 
     # -- partial-state wire schema --------------------------------------
@@ -573,7 +588,13 @@ class HashAggregateExec(TpuExec):
             s = tuple(flat_states[i:i + k])
             i += k
             v, ok = a.finalize(s)
-            outs.append(CV(v, ok & seg_live))
+            if isinstance(v, CV):
+                # array-valued finalize (t-digest percentile lists):
+                # the agg built offsets+child; AND in group liveness
+                outs.append(CV(v.data, v.validity & ok & seg_live,
+                               v.offsets, v.children))
+            else:
+                outs.append(CV(v, ok & seg_live))
         return outs
 
     # ------------------------------------------------------------------
@@ -1156,7 +1177,11 @@ class CollectAggExec(TpuExec):
                         scv = CV(cv.data[perm], cv.validity[perm])
                     st = a.g_update(scv, live, seg_ids, cap)
                     v, okv = a.finalize(st)
-                    outs.append(CV(v, okv & seg_live))
+                    if isinstance(v, CV):
+                        outs.append(CV(v.data, v.validity & okv & seg_live,
+                                       v.offsets, v.children))
+                    else:
+                        outs.append(CV(v, okv & seg_live))
                     continue
                 vcv = a.child.emit(ctx)
                 vs = take(vcv, perm)          # values in main (group) order
@@ -1185,7 +1210,7 @@ class CollectAggExec(TpuExec):
                     cnt = jax.ops.segment_sum(keep.astype(jnp.int64),
                                               seg_ids, cap)
                     outs.append(CV(cnt, seg_live))
-                elif kind in ("Percentile", "ApproxPercentile", "Median"):
+                elif kind in ("Percentile", "Median"):
                     outs.append(self._percentile_output(
                         a, vs, valid, seg_ids, order2, cap))
                 else:                          # CollectSet

@@ -842,10 +842,238 @@ class Percentile(_Collect):
 
 
 class ApproxPercentile(Percentile):
-    """percentile_approx: returns actual elements (no interpolation),
-    matching Spark's discrete semantics."""
+    """percentile_approx as a t-digest sketch with O(C) centroid state —
+    bounded across the exchange regardless of group size (reference:
+    GpuApproximatePercentile.scala + cuDF tdigest kernels; Spark CPU's
+    QuantileSummaries).
 
-    interpolate = False
+    TPU-first layout: C rank-bucketed centroids per group stored as
+    2C+2 ordinary float64 state COLUMNS (means..., weights..., min,
+    max), so partial digests ride the existing partial/final wire
+    schema, spill framework, and mesh exchange like any other
+    aggregate. update sorts the batch by (segment, validity, value) —
+    three stable argsorts, no data-dependent control flow — and bins
+    within-group ranks through the t-digest k1 scale function
+    k(q) = (C/pi)(asin(2q-1) + pi/2), then ONE segment_sum over
+    combined (segment * C + bin) ids. merge flattens buffered digests
+    to rows*C candidate centroids, re-sorts by (segment, mean), and
+    re-bins cumulative-weight midpoints through the same scale
+    function. finalize interpolates piecewise-linearly between centroid
+    midrank/mean points with min/max sharpening at the tails.
+
+    Like the reference (which returns cuDF t-digest doubles), results
+    are float64 approximations, NOT exact input elements as Spark CPU
+    returns (docs/compatibility.md); worst-case rank error per bucket
+    is ~pi/(2C) at the median and tighter toward the tails.
+    accuracy maps to C = clamp(accuracy // 50, 16, 128)."""
+
+    is_set = False
+    is_collect = False
+    state_reducers = ("custom",)
+    sort_free_update = False    # g_update sorts internally: keep it off
+                                # the no-sort hash-bucket first pass
+
+    def __init__(self, child, percentages, accuracy: int = 10000):
+        super().__init__(child, percentages, accuracy)
+        if int(accuracy) <= 0:
+            raise ValueError(
+                f"accuracy must be greater than 0 (got {accuracy})")
+        self.C = max(16, min(128, int(accuracy) // 50))
+
+    def num_state_cols(self):
+        return 2 * self.C + 2
+
+    def _kbin(self, q):
+        """k1 scale function -> centroid bin in [0, C-1]."""
+        C = self.C
+        t = ((jnp.arcsin(jnp.clip(2.0 * q - 1.0, -1.0, 1.0))
+              + (jnp.pi / 2)) * (C / jnp.pi))
+        return jnp.clip(t.astype(jnp.int32), 0, C - 1)
+
+    @staticmethod
+    def _sort3(minor, mid, major):
+        """Stable argsort by (major, mid, minor) via composed stable
+        single-key sorts (least-significant first)."""
+        p = jnp.argsort(minor, stable=True)
+        p = p[jnp.argsort(mid[p], stable=True)]
+        return p[jnp.argsort(major[p], stable=True)]
+
+    # -- grouped --------------------------------------------------------
+    def g_update(self, cv: CV, mask, seg_ids, num_segments):
+        C = self.C
+        cap = mask.shape[0]
+        valid = mask & cv.validity
+        x = cv.data.astype(jnp.float64)
+        # sort rows by (segment, invalid-last, value); NaN values sort
+        # after +inf (jnp.argsort NaN-last), i.e. NaN > everything —
+        # Java Double.compare ordering, like Spark CPU
+        perm = self._sort3(x, jnp.logical_not(valid).astype(jnp.uint8),
+                           seg_ids)
+        sseg = seg_ids[perm]
+        sval = x[perm]
+        svalid = valid[perm]
+        pos = jnp.arange(cap)
+        segstart = jax.ops.segment_min(pos, sseg, num_segments)[sseg]
+        rank = (pos - segstart).astype(jnp.float64)
+        ng = jax.ops.segment_sum(valid.astype(jnp.float64), seg_ids,
+                                 num_segments)
+        q = (rank + 0.5) / jnp.maximum(ng[sseg], 1.0)
+        b = self._kbin(q)
+        comb = sseg.astype(jnp.int64) * C + b.astype(jnp.int64)
+        w = svalid.astype(jnp.float64)
+        wsum = jax.ops.segment_sum(w, comb, num_segments * C)
+        xsum = jax.ops.segment_sum(jnp.where(svalid, sval, 0.0), comb,
+                                   num_segments * C)
+        means = jnp.where(wsum > 0, xsum / jnp.maximum(wsum, 1.0), 0.0)
+        # NaN is the GREATEST value (Java Double ordering): exclude it
+        # from vmin — the state identity stays +inf (all-NaN groups
+        # resolve to vmax at finalize) — but let it propagate via vmax
+        fin = valid & jnp.logical_not(jnp.isnan(x))
+        vmax = jax.ops.segment_max(jnp.where(valid, x, -jnp.inf),
+                                   seg_ids, num_segments)
+        vmin = jax.ops.segment_min(jnp.where(fin, x, jnp.inf),
+                                   seg_ids, num_segments)
+        mm = means.reshape(num_segments, C)
+        wm = wsum.reshape(num_segments, C)
+        return (tuple(mm[:, i] for i in range(C))
+                + tuple(wm[:, i] for i in range(C)) + (vmin, vmax))
+
+    def g_merge_custom(self, cols_sorted, live, seg_ids, num_segments):
+        C = self.C
+        means = jnp.stack(cols_sorted[:C], axis=1)          # (cap, C)
+        ws = jnp.stack(cols_sorted[C:2 * C], axis=1)
+        vmin = cols_sorted[2 * C]
+        vmax = cols_sorted[2 * C + 1]
+        ws = jnp.where(live[:, None], ws, 0.0)
+        fm = means.reshape(-1)
+        fw = ws.reshape(-1)
+        fseg = jnp.repeat(seg_ids, C)
+        nm, nw = self._recompress(fm, fw, fseg, num_segments)
+        nvmin = jax.ops.segment_min(
+            jnp.where(live, vmin, jnp.inf), seg_ids, num_segments)
+        nvmax = jax.ops.segment_max(
+            jnp.where(live, vmax, -jnp.inf), seg_ids, num_segments)
+        return (tuple(nm[:, i] for i in range(C))
+                + tuple(nw[:, i] for i in range(C)) + (nvmin, nvmax))
+
+    def _recompress(self, fm, fw, fseg, num_segments):
+        """Merge flat candidate centroids (mean fm, weight fw, segment
+        fseg) into (num_segments, C) digests: sort by (segment,
+        empty-last, mean), re-bin cumulative-weight midpoints through
+        the scale function, one combined segment_sum."""
+        C = self.C
+        n = fm.shape[0]
+        key = jnp.where(fw > 0, fm, jnp.inf)     # empty slots last
+        p = self._sort3(key, (fw <= 0).astype(jnp.uint8), fseg)
+        sseg = fseg[p]
+        sw = fw[p]
+        sm = jnp.where(fw[p] > 0, fm[p], 0.0)    # no 0*inf NaNs below
+        cumw = jnp.cumsum(sw)
+        pre = cumw - sw                           # exclusive prefix
+        pos = jnp.arange(n)
+        sstart = jax.ops.segment_min(pos, sseg, num_segments)
+        segbase = pre[jnp.clip(sstart, 0, n - 1)][sseg]
+        totw = jax.ops.segment_sum(fw, fseg, num_segments)
+        q = (pre - segbase + sw / 2) / jnp.maximum(totw[sseg], 1e-300)
+        b = self._kbin(q)
+        comb = sseg.astype(jnp.int64) * C + b.astype(jnp.int64)
+        nw = jax.ops.segment_sum(sw, comb, num_segments * C)
+        nx = jax.ops.segment_sum(sw * sm, comb, num_segments * C)
+        nm = jnp.where(nw > 0, nx / jnp.maximum(nw, 1e-300), 0.0)
+        return (nm.reshape(num_segments, C), nw.reshape(num_segments, C))
+
+    # -- ungrouped ------------------------------------------------------
+    # State: (means (C,), weights (C,), minmax (2,)) — three vectors.
+    def update(self, cv: CV, mask):
+        zeros = jnp.zeros(mask.shape[0], jnp.int32)
+        cols = self.g_update(cv, mask, zeros, 1)
+        C = self.C
+        return (jnp.stack([c[0] for c in cols[:C]]),
+                jnp.stack([c[0] for c in cols[C:2 * C]]),
+                jnp.stack([cols[2 * C][0], cols[2 * C + 1][0]]))
+
+    def merge(self, s1, s2):
+        fm = jnp.concatenate([s1[0], s2[0]])
+        fw = jnp.concatenate([s1[1], s2[1]])
+        fseg = jnp.zeros(fm.shape[0], jnp.int32)
+        nm, nw = self._recompress(fm, fw, fseg, 1)
+        mm = jnp.stack([jnp.minimum(s1[2][0], s2[2][0]),
+                        jnp.maximum(s1[2][1], s2[2][1])])
+        return (nm[0], nw[0], mm)
+
+    def finalize(self, s):
+        arrs = list(s)
+        ungrouped = len(arrs) == 3 and arrs[0].ndim == 1 \
+            and arrs[0].shape[0] == self.C
+        C = self.C
+        if ungrouped:
+            means = arrs[0][None, :]
+            ws = arrs[1][None, :]
+            vmin, vmax = arrs[2][0][None], arrs[2][1][None]
+        else:
+            means = jnp.stack(arrs[:C], axis=1)           # (n, C)
+            ws = jnp.stack(arrs[C:2 * C], axis=1)
+            vmin, vmax = arrs[2 * C], arrs[2 * C + 1]
+        n = means.shape[0]
+        # all-NaN groups kept vmin at its +inf identity: resolve to vmax
+        # (= NaN); a genuine all-+inf group has vmax = +inf and stands
+        vmin = jnp.where(jnp.isposinf(vmin)
+                         & jnp.logical_not(jnp.isposinf(vmax)),
+                         vmax, vmin)
+        # compact nonzero centroids to the front (stable: preserves the
+        # rank order); empty tail gets mid=+inf so it is never selected
+        order = jnp.argsort((ws <= 0).astype(jnp.uint8), axis=1,
+                            stable=True)
+        cm = jnp.take_along_axis(means, order, axis=1)
+        cw = jnp.take_along_axis(ws, order, axis=1)
+        nc = jnp.sum((cw > 0).astype(jnp.int32), axis=1)  # (n,)
+        totw = jnp.sum(cw, axis=1)
+        cumw = jnp.cumsum(cw, axis=1)
+        mid = jnp.where(cw > 0, cumw - cw / 2, jnp.inf)   # (n, C)
+        outs = []
+        for pq in self.percentages:
+            t = pq * totw                                  # (n,)
+            j = jnp.sum((mid <= t[:, None]).astype(jnp.int32), axis=1)
+            jl = jnp.clip(j - 1, 0, C - 1)
+            jr = jnp.clip(j, 0, C - 1)
+            lm = jnp.where(j > 0,
+                           jnp.take_along_axis(cm, jl[:, None],
+                                               axis=1)[:, 0], vmin)
+            lr = jnp.where(j > 0,
+                           jnp.take_along_axis(mid, jl[:, None],
+                                               axis=1)[:, 0], 0.0)
+            rm = jnp.where(j < nc,
+                           jnp.take_along_axis(cm, jr[:, None],
+                                               axis=1)[:, 0], vmax)
+            rr = jnp.where(j < nc,
+                           jnp.take_along_axis(mid, jr[:, None],
+                                               axis=1)[:, 0], totw)
+            frac = jnp.clip((t - lr) / jnp.maximum(rr - lr, 1e-300),
+                            0.0, 1.0)
+            # endpoint guards keep a NaN neighbor (NaN sorts greatest,
+            # Java Double ordering) from poisoning frac=0/1 answers; an
+            # interior frac with a NaN right neighbor snaps to the left
+            # centroid — NaN is returned only once t reaches the NaN
+            # centroid's own midpoint (docs/compatibility.md)
+            mid_v = lm + frac * (rm - lm)
+            mid_v = jnp.where(jnp.isnan(rm) & ~jnp.isnan(lm), lm, mid_v)
+            outs.append(jnp.where(frac <= 0.0, lm,
+                                  jnp.where(frac >= 1.0, rm, mid_v)))
+        ok = totw > 0
+        if self.scalar_out:
+            v = outs[0]
+            if ungrouped:
+                return v[0], ok[0]
+            return v, ok
+        P = len(self.percentages)
+        flat = jnp.stack(outs, axis=1).reshape(-1)         # (n*P,)
+        off = (jnp.arange(n + 1, dtype=jnp.int32) * P)
+        child = CV(flat, jnp.ones(n * P, jnp.bool_))
+        v = CV(jnp.zeros(0, jnp.int8), jnp.ones(n, jnp.bool_), off,
+               (child,))
+        if ungrouped:
+            return v, ok[0]
+        return v, ok
 
     def __repr__(self):
         return f"percentile_approx({self.child}, {self.percentages})"

@@ -44,7 +44,10 @@ def test_grouped_distinct_and_percentiles(df, data):
         exp_pct = [float(np.percentile(vals, q, method="linear"))
                    for q in (0, 50, 100)]
         assert np.allclose(r["pct"], exp_pct)
-        assert r["pa"] == vals[int(np.ceil(0.5 * len(vals)) - 1)]
+        # percentile_approx is a t-digest (float64, approximate): check
+        # the rank of the returned value, not element equality
+        rk = np.searchsorted(vals, r["pa"]) / len(vals)
+        assert abs(rk - 0.5) < 0.05
         assert np.isclose(r["md"], exp_pct[1])
 
 
