@@ -14,6 +14,7 @@ from ..columnar import dtypes as dt
 from ..columnar.table import Schema, Field
 from ..expr.expressions import Alias, Expression, ColumnRef
 from ..expr import aggregates as agg
+from .typesig import check_tree as _tsig
 
 __all__ = ["LogicalPlan", "InMemoryScan", "CachedScan", "ParquetScan", "Project", "Filter", "Expand",
            "Aggregate", "Join", "Sort", "SortOrder", "Limit", "Union",
@@ -146,7 +147,7 @@ class Project(LogicalPlan):
         fields = []
         for e in self.exprs:
             try:
-                b = e.bind(child.schema)
+                b = _tsig(e.bind(child.schema))
                 self.bound.append(b)
                 self.bind_errors.append(None)
                 fields.append(Field(e.name, b.dtype))
@@ -178,7 +179,7 @@ class Filter(LogicalPlan):
         self.condition = condition
         self.bind_error: Optional[str] = None
         try:
-            self.bound = condition.bind(child.schema)
+            self.bound = _tsig(condition.bind(child.schema))
         except UnsupportedExpr as err:
             self.bound = None
             self.bind_error = str(err)
@@ -203,8 +204,10 @@ class Aggregate(LogicalPlan):
         self.children = [child]
         self.keys = list(keys)
         self.aggs = list(aggs)
-        self.bound_keys = [k.bind(child.schema) for k in self.keys]
-        self.bound_aggs = [(n, a.bind(child.schema)) for n, a in self.aggs]
+        self.bound_keys = [_tsig(k.bind(child.schema))
+                           for k in self.keys]
+        self.bound_aggs = [(n, _tsig(a.bind(child.schema)))
+                           for n, a in self.aggs]
         fields = [Field(k.name, bk.dtype)
                   for k, bk in zip(self.keys, self.bound_keys)]
         fields += [Field(n, a.dtype) for n, a in self.bound_aggs]
@@ -232,7 +235,8 @@ class Expand(LogicalPlan):
         self.key_names = list(key_names)
         self.include_masks = [tuple(m) for m in include_masks]
         self.gid_name = gid_name
-        self.bound_keys = [k.bind(child.schema) for k in self.key_exprs]
+        self.bound_keys = [_tsig(k.bind(child.schema))
+                           for k in self.key_exprs]
         fields = list(child.schema.fields)
         fields += [Field(n, k.dtype)
                    for n, k in zip(self.key_names, self.bound_keys)]
@@ -260,15 +264,16 @@ class Join(LogicalPlan):
         self.how = how
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
-        self.bound_left_keys = [k.bind(left.schema) for k in self.left_keys]
-        self.bound_right_keys = [k.bind(right.schema)
+        self.bound_left_keys = [_tsig(k.bind(left.schema))
+                                for k in self.left_keys]
+        self.bound_right_keys = [_tsig(k.bind(right.schema))
                                  for k in self.right_keys]
         lf = list(left.schema.fields)
         rf = list(right.schema.fields)
         # non-equi condition binds over the COMBINED schema (the
         # reference's AST-compiled join conditions, AstUtil.scala)
         self.condition = condition
-        self.bound_condition = (condition.bind(Schema(lf + rf))
+        self.bound_condition = (_tsig(condition.bind(Schema(lf + rf)))
                                 if condition is not None else None)
         if how in ("left_semi", "left_anti"):
             fields = lf
@@ -305,8 +310,9 @@ class Sort(LogicalPlan):
         self.children = [child]
         self.orders = list(orders)
         self.global_sort = global_sort
-        self.bound_orders = [SortOrder(o.expr.bind(child.schema), o.ascending,
-                                       o.nulls_first) for o in self.orders]
+        self.bound_orders = [SortOrder(_tsig(o.expr.bind(child.schema)),
+                                       o.ascending, o.nulls_first)
+                             for o in self.orders]
 
     @property
     def schema(self):
@@ -354,6 +360,9 @@ class WindowOp(LogicalPlan):
         self.children = [child]
         self.wcols = list(wcols)          # (name, WindowExpr) unbound
         self.bound = [(n, w.bind(child.schema)) for n, w in self.wcols]
+        for _n, _w in self.bound:
+            if getattr(_w, 'child', None) is not None:
+                _tsig(_w.child)
         self._schema = Schema(list(child.schema.fields)
                               + [Field(n, w.dtype) for n, w in self.bound])
 
@@ -374,7 +383,7 @@ class Generate(LogicalPlan):
         self.child = child
         self.children = [child]
         self.generator = generator              # unbound Explode/PosExplode
-        self.bound = generator.bind(child.schema)
+        self.bound = _tsig(generator.bind(child.schema))
         self.out_names = list(out_names)
         gen_dt = self.bound.dtype
         gen_fields = []
@@ -403,7 +412,8 @@ class Repartition(LogicalPlan):
         self.children = [child]
         self.num_partitions = num_partitions
         self.keys = list(keys) if keys else None
-        self.bound_keys = ([k.bind(child.schema) for k in self.keys]
+        self.bound_keys = ([_tsig(k.bind(child.schema))
+                            for k in self.keys]
                            if self.keys else None)
 
     @property

@@ -63,6 +63,31 @@ def check(name: str, dtype: dt.DataType, what: str = ""):
             f"(supported: {ent[0].describe()})")
 
 
+def check_tree(expr):
+    """Uniform binder gate: walk a BOUND expression tree and check each
+    node's primary input (first child) dtype against its registered
+    signature (reference: TypeChecks.tagExprForGpu, TypeChecks.scala:716
+    — there per-parameter; here the subject input, with later params
+    enforced by the binders). Unregistered nodes pass — signatures are
+    deliberately no STRICTER than the binders, so this adds uniform
+    error text and the docs table without shadowing real support."""
+    if expr is None:
+        return expr
+    name = type(expr).__name__
+    ent = SIGS.get(name)
+    kids = getattr(expr, "children", None) or []
+    if ent is not None and kids:
+        cdt = getattr(kids[0], "dtype", None)
+        if cdt is not None and not ent[0].supports(cdt):
+            from ..expr.expressions import UnsupportedExpr
+            raise UnsupportedExpr(
+                f"{name} does not support input type {cdt} on TPU "
+                f"(supported: {ent[0].describe()})")
+    for c in kids:
+        check_tree(c)
+    return expr
+
+
 # -- registry (mirrors the expression surface; the binders stay the
 # source of truth for enforcement, this drives docs + uniform errors) ----
 for _n in ("Add", "Subtract", "Multiply", "Divide", "IntDivide",
@@ -72,8 +97,11 @@ for _n in ("Eq", "Ne", "Lt", "Le", "Gt", "Ge", "EqNullSafe"):
     register(_n, ALL_COMMON, "comparison")
 for _n in ("And", "Or", "Not"):
     register(_n, BOOL, "boolean")
-for _n in ("IsNull", "IsNotNull", "Coalesce", "If", "CaseWhen", "In"):
-    register(_n, ALL_COMMON, "conditional/null")
+NESTED = TypeSig(dt.ArrayType, dt.MapType, dt.StructType)
+for _n in ("IsNull", "IsNotNull"):
+    register(_n, ALL_COMMON + NESTED, "null test (validity only)")
+for _n in ("Coalesce", "If", "CaseWhen", "In"):
+    register(_n, ALL_COMMON, "conditional (no nested branches)")
 register("IsNaN", FLOATING, "NaN test")
 for _n in ("BitwiseAnd", "BitwiseOr", "BitwiseXor", "BitwiseNot",
            "ShiftLeft", "ShiftRight"):
@@ -88,9 +116,13 @@ for _n in ("RLike", "RegexpExtract", "RegexpReplace"):
     register(_n, STRING,
              "regex (NFA subset; others run via CPU fallback)")
 register("Cast", ALL_COMMON, "cast matrix per docs/compatibility.md")
-for _n in ("Sum", "Min", "Max", "Count", "CountStar", "First", "Last"):
-    register(_n, NUMERIC + DATETIME + BOOL,
-             "aggregate (Count: all types)")
+for _n in ("Sum", "Min", "Max"):
+    register(_n, NUMERIC + DATETIME + BOOL + NULL, "aggregate")
+for _n in ("Count", "CountStar"):
+    register(_n, ALL_COMMON + NESTED, "aggregate over any type")
+for _n in ("First", "Last"):
+    register(_n, NUMERIC + DATETIME + BOOL + STRING + NULL,
+             "aggregate; string/binary via the sort-collect path")
 DEC64 = TypeSig(dt.DecimalType, note="precision <= 18 only")
 for _n in ("Avg", "VarianceSamp", "StddevSamp", "Variance", "Stddev"):
     register(_n, INTEGRAL + FLOATING + DEC64 + BOOL + NULL,
@@ -98,7 +130,6 @@ for _n in ("Avg", "VarianceSamp", "StddevSamp", "Variance", "Stddev"):
              "(sum/count explicitly for p>18)")
 register("Greatest", NUMERIC + DATETIME + STRING, "n-ary minmax")
 register("Least", NUMERIC + DATETIME + STRING, "n-ary minmax")
-NESTED = TypeSig(dt.ArrayType, dt.MapType, dt.StructType)
 for _n in ("Size", "GetArrayItem", "ElementAt", "ArrayContains",
            "SortArray", "Explode", "PosExplode", "ArrayTransform",
            "ArrayFilter", "ArrayExists", "ArrayForAll", "ArrayAggregate"):
@@ -116,13 +147,54 @@ register("CountDistinct", ALL_COMMON,
 register("ApproxCountDistinct", ALL_COMMON,
          "HyperLogLog++ sketch, O(2^p) state; rsd -> p in [4,12] "
          "(docs/compatibility.md: 32-bit hash, no bias table)")
-for _n in ("Percentile", "ApproxPercentile", "Median"):
+for _n in ("Percentile", "Median"):
     register(_n, INTEGRAL + FLOATING,
-             "exact rank selection via segmented sort (accuracy superset "
-             "of t-digest)")
+             "exact rank selection via segmented sort")
+register("ApproxPercentile", INTEGRAL + FLOATING,
+         "t-digest sketch, O(C) centroid state; float64 interpolated "
+         "results (docs/compatibility.md)")
 for _n in ("CollectList", "CollectSet"):
     register(_n, ALL_COMMON,
              "aggregate -> array<T>; requires GROUP BY (sort-collect)")
+
+# -- datetime fields / arithmetic ---------------------------------------
+DATE = TypeSig(dt.DateType)
+TS = TypeSig(dt.TimestampType)
+for _n in ("Year", "Quarter", "Month", "DayOfMonth", "DayOfWeek",
+           "DayOfYear"):
+    register(_n, DATETIME, "datetime field extraction")
+for _n in ("Hour", "Minute", "Second"):
+    register(_n, TS, "time field extraction")
+for _n in ("DateAdd", "DateSub", "LastDay"):
+    register(_n, DATE, "date arithmetic")
+register("DateDiff", DATE, "day difference")
+register("ToDate", STRING + DATE + TS,
+         "string parse per format (docs/compatibility.md pattern subset)")
+register("ToTimestamp", STRING + DATE + TS,
+         "string parse per format (docs/compatibility.md pattern subset)")
+for _n in ("FromUTCTimestamp", "ToUTCTimestamp"):
+    register(_n, TS, "TZif-backed zone conversion (utils/tzdb)")
+
+# -- JSON / URL ---------------------------------------------------------
+register("GetJsonObject", STRING,
+         "device byte-tape for scalar paths; wildcard paths via CPU "
+         "bridge (docs/compatibility.md)")
+register("FromJson", STRING,
+         "schema-driven; runs via CPU bridge (host row interpreter)")
+register("ToJson", ALL_COMMON + NESTED,
+         "runs via CPU bridge (host row interpreter)")
+register("ParseUrl", STRING,
+         "runs via CPU bridge (host row interpreter)")
+
+# -- misc ---------------------------------------------------------------
+register("Murmur3Hash", ALL_COMMON,
+         "Spark-compatible murmur3_x86_32, device kernel")
+register("Literal", ALL_COMMON + NESTED, "constant")
+register("Alias", ALL_COMMON + NESTED, "name binding (pass-through)")
+register("ColumnRef", ALL_COMMON + NESTED, "column reference")
+register("PyUDF", ALL_COMMON,
+         "AST-compiled to expressions when possible, else "
+         "jax.pure_callback host evaluation (udf-compiler analog)")
 
 
 def generate_supported_ops() -> str:

@@ -1,0 +1,16 @@
+#!/usr/bin/env python
+"""Regenerate docs/supported_ops.md from the TypeSig registry (the
+analog of the reference's doc generation from TypeChecks into
+docs/supported_ops.md / tools/generated_files)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from spark_rapids_tpu.plan.typesig import generate_supported_ops  # noqa: E402
+
+out = os.path.join(os.path.dirname(__file__), "..", "docs",
+                   "supported_ops.md")
+with open(out, "w") as f:
+    f.write(generate_supported_ops())
+print(f"wrote {os.path.normpath(out)}")
