@@ -364,10 +364,15 @@ class HashAggregateExec(TpuExec):
         so buffered group state demotes to host/disk under HBM pressure
         instead of dying (reference: GpuAggregateExec buffered batches
         are spillable)."""
+        from ..memory.retry import retry_no_split
         ks, st, sl, cap = part
         cvs = list(ks) + [CV(s, jnp.ones(cap, jnp.bool_)) for s in st]
         tbl = make_table(self._wire_schema, cvs, cap)
-        return store.add_batch(DeviceBatch(tbl, cap, sl, cap), priority=8)
+        # parking reserves device budget: retry-after-spill covers the
+        # transient-OOM window (AllocationRetryCoverageTracker keeps
+        # this class of site inside the retry discipline)
+        return retry_no_split(lambda: store.add_batch(
+            DeviceBatch(tbl, cap, sl, cap), priority=8))
 
     def _unpark(self, h, close=True):
         b = h.materialize()
@@ -1018,9 +1023,11 @@ class HashAggregateExec(TpuExec):
         from ..memory.spill import spill_store
         store = spill_store(ctx.conf)
         handles = []
+        from ..memory.retry import retry_no_split
         for batch in self.children[0].execute_partition(ctx, pid):
-            handles.append((store.add_batch(batch, priority=8),
-                            batch.capacity))
+            handles.append((retry_no_split(
+                lambda b=batch: store.add_batch(b, priority=8)),
+                batch.capacity))
         if not handles:
             return
         yield from self._emit_final(ctx, m, handles, force_merge=True)
@@ -1186,6 +1193,17 @@ class CollectAggExec(TpuExec):
                 vcv = a.child.emit(ctx)
                 vs = take(vcv, perm)          # values in main (group) order
                 valid = live & vs.validity    # collect family skips nulls
+                from ..expr.aggregates import (_FirstLast,
+                                              _seg_extreme_pos)
+                if isinstance(a, _FirstLast):
+                    # var-width first/last: per-segment positional select
+                    # in input order (stable key sort preserves it)
+                    elig = valid if a.ignore_nulls else live
+                    sel, has = _seg_extreme_pos(elig, seg_ids, cap,
+                                                a.take_first)
+                    outs.append(take(vs, sel.astype(jnp.int32),
+                                     in_bounds=has & seg_live))
+                    continue
                 if not getattr(a, "is_set", False):
                     # collect_list: stable main order == input order
                     outs.append(self._list_output(vs, valid, seg_ids, cap,

@@ -412,8 +412,14 @@ class _FirstLast(AggExpr):
 
     def _resolve_type(self):
         ct = self.child.dtype
-        if ct.is_variable_width or ct.is_nested:
-            raise UnsupportedExpr("first/last on var-width round-1")
+        if ct.is_nested:
+            raise UnsupportedExpr("first/last on nested input")
+        if ct.is_variable_width:
+            # strings/binary can't ride the fixed-width state wire:
+            # route through the sort-collect path (raw rows exchanged on
+            # the grouping keys), where a per-segment positional select
+            # serves first/last in input order
+            self.is_collect = True
         self.dtype = ct
 
     def update(self, cv: CV, mask):

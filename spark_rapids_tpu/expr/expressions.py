@@ -844,16 +844,20 @@ class Cast(Expression):
                       and (from_t.is_integral
                            or isinstance(from_t, (dt.BooleanType,
                                                   dt.DecimalType,
-                                                  dt.DateType))))
+                                                  dt.DateType,
+                                                  dt.TimestampType))))
         ok = (from_t == self.to or
               (from_t.is_numeric and self.to.is_numeric) or
               isinstance(from_t, dt.NullType) or
               (isinstance(from_t, dt.BooleanType) and self.to.is_numeric) or
               (from_t.is_numeric and isinstance(self.to, dt.BooleanType)) or
               (isinstance(from_t, dt.TimestampType)
-               and isinstance(self.to, (dt.DateType, dt.LongType))) or
+               and (self.to.is_numeric
+                    or isinstance(self.to, dt.DateType))) or
               (isinstance(from_t, dt.DateType)
                and isinstance(self.to, (dt.TimestampType, dt.IntegerType))) or
+              (from_t.is_numeric
+               and isinstance(self.to, dt.TimestampType)) or
               str_src_ok or str_dst_ok)
         if not ok:
             raise UnsupportedExpr(f"cast {from_t} -> {self.to}")
@@ -878,8 +882,7 @@ class Cast(Expression):
             if isinstance(self.to, dt.TimestampType):
                 return cs.string_to_timestamp(cv)
             if isinstance(self.to, dt.DecimalType):
-                f = cs.string_to_float(cv)
-                return cast_ops.cast_cv(f, dt.FLOAT64, self.to)
+                return cs.string_to_decimal(cv, self.to)
         if isinstance(self.to, dt.StringType) and not isinstance(
                 from_t, dt.StringType):
             if isinstance(from_t, dt.NullType):
@@ -892,6 +895,8 @@ class Cast(Expression):
                 return cs.decimal_to_string(cv, from_t.scale)
             if isinstance(from_t, dt.DateType):
                 return cs.date_to_string(cv)
+            if isinstance(from_t, dt.TimestampType):
+                return cs.timestamp_to_string(cv)
             if from_t.is_integral:
                 return cs.int_to_string(cv)
             raise UnsupportedExpr(f"cast {from_t} -> string")

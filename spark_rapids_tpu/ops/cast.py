@@ -62,8 +62,23 @@ def cast_cv(cv: CV, from_t: dt.DataType, to_t: dt.DataType) -> CV:
     if isinstance(from_t, dt.TimestampType):
         if isinstance(to_t, dt.DateType):
             return CV(_floor_div(x, MICROS_PER_DAY).astype(jnp.int32), valid)
+        secs = _floor_div(x, MICROS_PER_SEC)
         if isinstance(to_t, dt.LongType):
-            return CV(_floor_div(x, MICROS_PER_SEC), valid)
+            return CV(secs, valid)
+        if to_t.is_integral:
+            # narrowing wraps like Java (Spark non-ANSI long -> int/...)
+            return CV(secs.astype(to_t.np_dtype), valid)
+        if to_t.is_floating:
+            return CV((x.astype(jnp.float64) / MICROS_PER_SEC)
+                      .astype(to_t.np_dtype), valid)
+        if isinstance(to_t, dt.DecimalType):
+            # seconds with 6 fractional digits, rescaled to the target
+            if to_t.is_decimal128:
+                from .decimal128 import dec_from_i64, dec_rescale
+                out, ovf = dec_rescale(dec_from_i64(x), 6, to_t.scale,
+                                       to_t.precision)
+                return CV(out, valid & ~ovf)
+            return _rescale_decimal(x, valid, 6, to_t)
         raise NotImplementedError(f"cast timestamp -> {to_t}")
     if isinstance(from_t, dt.DateType):
         if isinstance(to_t, dt.TimestampType):
@@ -71,8 +86,15 @@ def cast_cv(cv: CV, from_t: dt.DataType, to_t: dt.DataType) -> CV:
         if isinstance(to_t, dt.IntegerType):
             return CV(x.astype(jnp.int32), valid)
         raise NotImplementedError(f"cast date -> {to_t}")
-    if isinstance(to_t, dt.TimestampType) and from_t.is_integral:
-        return CV(x.astype(jnp.int64) * MICROS_PER_SEC, valid)
+    if isinstance(to_t, dt.TimestampType):
+        if from_t.is_integral:
+            return CV(x.astype(jnp.int64) * MICROS_PER_SEC, valid)
+        if from_t.is_floating:
+            # seconds (fraction -> micros); NaN/Inf -> null (Spark)
+            xf = x.astype(jnp.float64) * MICROS_PER_SEC
+            ok = jnp.isfinite(x.astype(jnp.float64))
+            return CV(jnp.where(ok, xf, 0.0).astype(jnp.int64),
+                      valid & ok)
 
     # ---- decimal source ------------------------------------------------
     if isinstance(from_t, dt.DecimalType):
@@ -93,6 +115,17 @@ def cast_cv(cv: CV, from_t: dt.DataType, to_t: dt.DataType) -> CV:
             lo, hi = _INT_RANGE[type(to_t)]
             ok = (q >= lo) & (q <= hi)
             return CV(q.astype(to_t.np_dtype), valid & ok)
+        if isinstance(to_t, dt.TimestampType):
+            # decimal seconds -> micros; sub-micro digits TRUNCATE
+            # toward zero (Spark decimalToTimestamp = longValue)
+            ds = 6 - s
+            if ds >= 0:
+                return CV(x.astype(jnp.int64) * (10 ** ds), valid)
+            p = 10 ** (-ds)
+            q = x // p
+            r = x - q * p
+            q = jnp.where((r != 0) & (x < 0), q + 1, q)
+            return CV(q.astype(jnp.int64), valid)
         raise NotImplementedError(f"cast decimal -> {to_t}")
 
     # ---- to decimal ----------------------------------------------------
@@ -182,6 +215,11 @@ def _cast_decimal128(cv: CV, from_t: dt.DecimalType,
         lo_b, hi_b = _INT_RANGE[type(to_t)]
         ok = (v64 >= lo_b) & (v64 <= hi_b) & fits & ~ovf
         return CV(v64.astype(to_t.np_dtype), valid & ok)
+    if isinstance(to_t, dt.TimestampType):
+        # sub-micro digits truncate toward zero (Spark longValue)
+        out, ovf = dec_rescale(wide, from_t.scale, 6, 38, half_up=False)
+        v64, fits = dec_to_i64(out)
+        return CV(v64, valid & ~ovf & fits)
     raise NotImplementedError(f"cast {from_t} -> {to_t}")
 
 

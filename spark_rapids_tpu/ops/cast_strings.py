@@ -21,8 +21,9 @@ from .kernel_utils import CV
 from .strings import str_len_bytes
 
 __all__ = ["string_to_int", "string_to_float", "string_to_bool",
-           "int_to_string", "bool_to_string", "decimal_to_string",
-           "date_to_string", "string_to_date", "string_to_timestamp"]
+           "string_to_decimal", "int_to_string", "bool_to_string",
+           "decimal_to_string", "date_to_string", "timestamp_to_string",
+           "string_to_date", "string_to_timestamp"]
 
 _MAX_DIGITS = 19
 
@@ -193,6 +194,164 @@ def string_to_float(cv: CV) -> CV:
     return CV(out, cv.validity & ok)
 
 
+def _dec_mul_pow10_dyn(v2, k, kmax: int):
+    """128-bit multiply by a per-row DYNAMIC power of ten 0 <= k <= kmax
+    via binary decomposition (at most 6 dec_muls). Returns (v2, ovf)."""
+    from .decimal128 import dec_from_i64, dec_mul, from_limbs, to_limbs
+    ovf = jnp.zeros(k.shape[0], jnp.bool_)
+    bit = 0
+    while (1 << bit) <= kmax:
+        e = 1 << bit
+        if e <= 18:
+            const = dec_from_i64(jnp.full(k.shape[0], 10 ** e, jnp.int64))
+        else:
+            # 10^32 exceeds int64: build from limbs of the magnitude
+            limbs = [(10 ** e >> (32 * i)) & 0xFFFFFFFF for i in range(4)]
+            const = from_limbs([jnp.full(k.shape[0], l, jnp.int64)
+                                for l in limbs])
+        prod, o = dec_mul(v2, const, 38)
+        on = (k & e) != 0
+        v2 = jnp.where(on[:, None], prod, v2)
+        ovf = ovf | (on & o)
+        bit += 1
+    return v2, ovf
+
+
+def string_to_decimal(cv: CV, to_t: dt.DecimalType) -> CV:
+    """EXACT string -> decimal(p, s): [sign] digits [. digits]
+    [eE [sign] digits]. Mantissa digits accumulate into 18-digit int64
+    chunks combined with 128-bit limb arithmetic, and the target scale
+    is applied positionally during the scan (the digit one place past
+    scale s drives HALF_UP) — up to 38 significant digits with no
+    float64 detour (reference: JNI CastStrings decimal parse,
+    GpuCast.scala:286)."""
+    from .decimal128 import (dec_add, dec_from_i64, dec_neg, dec_to_i64,
+                             fits_precision, to_limbs)
+    p_, s_ = to_t.precision, to_t.scale
+    tstart, tlen = _trim_bounds(cv)
+    dcap = cv.data.shape[0]
+    n = tlen.shape[0]
+
+    def byte_at(k):
+        idx = jnp.clip(tstart + k, 0, dcap - 1)
+        return jnp.where(k < tlen, cv.data[idx].astype(jnp.int32), -1)
+
+    b0 = byte_at(0)
+    neg = b0 == 45
+    skip = ((b0 == 45) | (b0 == 43)).astype(jnp.int32)
+
+    # pass 1: syntax + counts (mantissa digits, int-part digits, leading
+    # int-part zeros, exponent). lax.fori_loop keeps the compiled graph
+    # ~64x smaller than unrolling (XLA CPU chokes on big gather chains).
+    # 64 bytes covers sign + 38 significant digits + zero padding + dot
+    # + exponent; longer trimmed inputs -> null (docs/compatibility.md)
+    SCAN = 64
+    st0 = dict(nd=jnp.zeros(n, jnp.int32), nint=jnp.zeros(n, jnp.int32),
+               lead=jnp.zeros(n, jnp.int32),
+               lead_run=jnp.ones(n, jnp.bool_),
+               seen_dot=jnp.zeros(n, jnp.bool_),
+               in_exp=jnp.zeros(n, jnp.bool_),
+               exp_val=jnp.zeros(n, jnp.int32),
+               exp_neg=jnp.zeros(n, jnp.bool_),
+               exp_ndig=jnp.zeros(n, jnp.int32),
+               prev_was_e=jnp.zeros(n, jnp.bool_),
+               invalid=jnp.zeros(n, jnp.bool_))
+
+    def p1(k, s):
+        pos = skip + k
+        b = byte_at(pos)
+        active = pos < tlen
+        is_digit = (b >= 48) & (b <= 57)
+        m_dig = active & is_digit & ~s["in_exp"]
+        nd = jnp.where(m_dig, s["nd"] + 1, s["nd"])
+        nint = jnp.where(m_dig & ~s["seen_dot"], s["nint"] + 1, s["nint"])
+        is_lead0 = m_dig & ~s["seen_dot"] & s["lead_run"] & (b == 48)
+        lead = jnp.where(is_lead0, s["lead"] + 1, s["lead"])
+        lead_run = s["lead_run"] & (~m_dig | is_lead0)
+        newly_dot = active & (b == 46) & ~s["seen_dot"] & ~s["in_exp"]
+        seen_dot = s["seen_dot"] | newly_dot
+        newly_exp = (active & ((b == 101) | (b == 69)) & ~s["in_exp"]
+                     & (nd > 0))
+        nxt = byte_at(pos + 1)
+        exp_neg = jnp.where(newly_exp & (nxt == 45), True, s["exp_neg"])
+        in_exp = s["in_exp"] | newly_exp
+        e_dig = active & is_digit & in_exp & ~newly_exp
+        exp_val = jnp.where(e_dig,
+                            jnp.minimum(s["exp_val"] * 10 + (b - 48),
+                                        9999), s["exp_val"])
+        exp_ndig = jnp.where(e_dig, s["exp_ndig"] + 1, s["exp_ndig"])
+        sign_ok = s["prev_was_e"] & ((b == 45) | (b == 43))
+        invalid = s["invalid"] | (active & ~(is_digit | newly_dot
+                                             | newly_exp | sign_ok))
+        return dict(nd=nd, nint=nint, lead=lead, lead_run=lead_run,
+                    seen_dot=seen_dot, in_exp=in_exp, exp_val=exp_val,
+                    exp_neg=exp_neg, exp_ndig=exp_ndig,
+                    prev_was_e=newly_exp, invalid=invalid)
+
+    s1r = jax.lax.fori_loop(0, SCAN, p1, st0)
+    nd, nint, lead = s1r["nd"], s1r["nint"], s1r["lead"]
+    invalid = s1r["invalid"] | (tlen > skip + SCAN)
+    invalid = invalid | (nd == 0) | (tlen == 0)
+    invalid = invalid | (s1r["in_exp"] & (s1r["exp_ndig"] == 0))
+    exp = jnp.where(s1r["exp_neg"], -s1r["exp_val"], s1r["exp_val"])
+
+    # significant accept window in mantissa-digit index space:
+    # [lead, end) contributes, digit at `end` drives HALF_UP
+    point = nint + exp
+    end = point + s_
+    nsig = jnp.clip(jnp.minimum(end, nd) - lead, 0, 40)
+    invalid = invalid | ((end - lead) > 38)
+    pad = jnp.clip(end - jnp.maximum(nd, lead), 0, 38)
+
+    # pass 2: route digits into 18+18+2 chunks by significant index
+    st2 = dict(h0=jnp.zeros(n, jnp.int64), h1=jnp.zeros(n, jnp.int64),
+               h2=jnp.zeros(n, jnp.int64),
+               roundup=jnp.zeros(n, jnp.bool_),
+               mi=jnp.zeros(n, jnp.int32),
+               in_e2=jnp.zeros(n, jnp.bool_))
+
+    def p2(k, s):
+        pos = skip + k
+        b = byte_at(pos)
+        active = pos < tlen
+        in_e2 = s["in_e2"] | (active & ((b == 101) | (b == 69)))
+        is_digit = active & (b >= 48) & (b <= 57)
+        m_dig = is_digit & ~in_e2       # exponent digits excluded
+        d = (b - 48).astype(jnp.int64)
+        mi = s["mi"]
+        c = mi - lead
+        acc = m_dig & (mi >= lead) & (mi < end)
+        h0 = jnp.where(acc & (c < 18), s["h0"] * 10 + d, s["h0"])
+        h1 = jnp.where(acc & (c >= 18) & (c < 36), s["h1"] * 10 + d,
+                       s["h1"])
+        h2 = jnp.where(acc & (c >= 36), s["h2"] * 10 + d, s["h2"])
+        roundup = s["roundup"] | (m_dig & (mi == end) & (d >= 5))
+        return dict(h0=h0, h1=h1, h2=h2, roundup=roundup,
+                    mi=jnp.where(m_dig, mi + 1, mi), in_e2=in_e2)
+
+    s2r = jax.lax.fori_loop(0, SCAN, p2, st2)
+    h0, h1, h2, roundup = s2r["h0"], s2r["h1"], s2r["h2"], s2r["roundup"]
+    n1 = jnp.clip(nsig - 18, 0, 18)
+    n2 = jnp.clip(nsig - 36, 0, 2)
+
+    v = dec_from_i64(h0)
+    v, o1 = _dec_mul_pow10_dyn(v, n1, 18)
+    v, oa = dec_add(v, dec_from_i64(h1))
+    v, o2 = _dec_mul_pow10_dyn(v, n2, 2)
+    v, ob = dec_add(v, dec_from_i64(h2))
+    v, o3 = _dec_mul_pow10_dyn(v, pad, 38)
+    v, oc = dec_add(v, dec_from_i64(roundup.astype(jnp.int64)))
+    ovf = o1 | oa | o2 | ob | o3 | oc
+    ok = (~invalid & ~ovf & fits_precision(to_limbs(v), p_)
+          & cv.validity)
+    v = jnp.where(neg[:, None], dec_neg(v), v)
+    if to_t.is_decimal128:
+        return CV(jnp.where(ok[:, None], v, 0), ok)
+    v64, fits = dec_to_i64(v)
+    ok = ok & fits
+    return CV(jnp.where(ok, v64, 0), ok)
+
+
 def string_to_bool(cv: CV) -> CV:
     tstart, tlen = _trim_bounds(cv)
     dcap = cv.data.shape[0]
@@ -351,6 +510,65 @@ def date_to_string(cv: CV, out_capacity: Optional[int] = None) -> CV:
     lens = jnp.full(n, 10, jnp.int32)
     return _emit_from_staging(staging, lens,
                               out_capacity or max(n * 10, 128), cv.validity)
+
+
+def timestamp_to_string(cv: CV, out_capacity: Optional[int] = None) -> CV:
+    """micros-since-epoch -> 'YYYY-MM-DD HH:MM:SS[.f{1..6}]' (Spark's
+    default timestamp rendering: fractional seconds shown without
+    trailing zeros, omitted when zero)."""
+    from .datetime import civil_from_days
+    from .cast import MICROS_PER_DAY, MICROS_PER_SEC
+    x = cv.data.astype(jnp.int64)
+    days = x // MICROS_PER_DAY                    # floors negatives
+    tod = x - days * MICROS_PER_DAY               # always >= 0
+    y, mo, d = civil_from_days(days.astype(jnp.int32))
+    secs = tod // MICROS_PER_SEC
+    fr = (tod - secs * MICROS_PER_SEC).astype(jnp.int32)
+    hh = (secs // 3600).astype(jnp.int32)
+    mi = ((secs // 60) % 60).astype(jnp.int32)
+    ss = (secs % 60).astype(jnp.int32)
+    n = x.shape[0]
+    # fraction digits, least-significant first, and the trailing-zero run
+    fd = [(fr // (10 ** i)) % 10 for i in range(6)]
+    tz = jnp.full(n, 0, jnp.int32)
+    run = jnp.ones(n, jnp.bool_)
+    for i in range(6):
+        z = run & (fd[i] == 0)
+        tz = jnp.where(z, tz + 1, tz)
+        run = z
+    fl = jnp.where(fr == 0, 0, 6 - tz + 1)        # incl. '.', 0 if none
+    lens = 19 + fl
+    # years outside 1..9999 don't fit the fixed 4-digit layout (Spark
+    # renders '+10000-...'): null instead of silent mod-10000 garbage
+    validity = cv.validity & (y >= 1) & (y <= 9999)
+    W = 26
+    # positions from the RIGHT: fraction digits, '.', then the fixed
+    # 19-byte 'YYYY-MM-DD HH:MM:SS' layout — built fully vectorized over
+    # an [n, W] position grid (no scatter loop: cheap to compile)
+    fixed = [ss % 10, ss // 10, None, mi % 10, mi // 10, None,
+             hh % 10, hh // 10, None, d % 10, d // 10, None,
+             mo % 10, mo // 10, None, y % 10, (y // 10) % 10,
+             (y // 100) % 10, (y // 1000) % 10]
+    seps = {2: 58, 5: 58, 8: 32, 11: 45, 14: 45}  # ':' ':' ' ' '-' '-'
+    frac_mat = jnp.stack(fd, axis=1)              # [n, 6] lsd-first
+    fixed_vals = jnp.stack(
+        [jnp.full(n, seps[i], jnp.int32) if i in seps
+         else fixed[i].astype(jnp.int32) + 48
+         for i in range(19)], axis=1)             # [n, 19]
+    c = jnp.arange(W)[None, :]                    # position from right
+    flc = fl[:, None]
+    in_frac = c < (flc - 1)
+    is_dot = c == (flc - 1)
+    fi = jnp.clip(tz[:, None] + c, 0, 5)
+    fval = jnp.take_along_axis(frac_mat, fi, axis=1) + 48
+    cp = jnp.clip(c - flc, 0, 18)
+    fxv = jnp.take_along_axis(fixed_vals, cp, axis=1)
+    val = jnp.where(in_frac, fval,
+                    jnp.where(is_dot, 46,
+                              jnp.where(c - flc < 19, fxv, 0)))
+    out = val[:, ::-1].astype(jnp.uint8)          # to left-to-right
+    cap = out_capacity or max(n * W, 128)
+    return _emit_from_staging(out, lens, cap, validity)
 
 
 def _digits_at(cv: CV, tstart, tlen, pos: int, width: int):

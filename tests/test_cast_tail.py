@@ -1,0 +1,129 @@
+"""Round-4 cast-matrix tail: exact string->decimal (incl. decimal128),
+timestamp<->numeric/decimal/string device paths (reference:
+GpuCast.scala:286, JNI CastStrings)."""
+from decimal import Decimal
+import datetime as dtm
+UTC = dtm.timezone.utc
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.expr.expressions import col
+
+
+@pytest.fixture(scope="module")
+def session():
+    return st.TpuSession()
+
+
+def _cast_col(session, arr, to):
+    df = session.create_dataframe({"c": arr})
+    return df.select(col("c").cast(to).alias("o")).to_arrow() \
+        .column("o").to_pylist()
+
+
+def test_string_to_decimal128_exact(session):
+    """38 significant digits parse EXACTLY — the float64 detour this
+    replaces lost everything past ~15 digits."""
+    big = "12345678901234567890123456789012.345678"
+    got = _cast_col(session, pa.array([big]), "decimal(38,6)")
+    assert got[0] == Decimal(big)
+
+
+def test_string_to_decimal_forms(session):
+    vals = ["  -0.005 ", "1.25e3", "7", ".5", "-.5", "0.045", "1e-50",
+            "9" * 39, "abc", "", None,
+            "0000000000000000000000000000000000000001.5", "2.5E-2",
+            "+3.14", "1.", "Infinity", "NaN", "1e40"]
+    got = _cast_col(session, pa.array(vals, pa.string()), "decimal(38,2)")
+    exp = [Decimal("-0.01"), Decimal("1250.00"), Decimal("7.00"),
+           Decimal("0.50"), Decimal("-0.50"), Decimal("0.05"),
+           Decimal("0.00"), None, None, None, None, Decimal("1.50"),
+           Decimal("0.03"), Decimal("3.14"), Decimal("1.00"), None,
+           None, None]
+    assert got == exp, list(zip(vals, got, exp))
+
+
+def test_string_to_decimal64_half_up(session):
+    got = _cast_col(session, pa.array(["123.456", "-0.049", "99.995"]),
+                    "decimal(10,2)")
+    assert got == [Decimal("123.46"), Decimal("-0.05"), Decimal("100.00")]
+
+
+def test_string_to_decimal_precision_overflow_null(session):
+    # 10^8 needs 9 integer digits; decimal(10,2) allows 8 -> null
+    got = _cast_col(session, pa.array(["99999999.99", "100000000"]),
+                    "decimal(10,2)")
+    assert got == [Decimal("99999999.99"), None]
+
+
+def test_timestamp_to_string(session):
+    ts = pa.array([0, 1_600_000_000_123_456, -1, 86_399_999_999,
+                   1_600_000_000_120_000], pa.timestamp("us"))
+    got = _cast_col(session, ts, "string")
+    assert got == ["1970-01-01 00:00:00",
+                   "2020-09-13 12:26:40.123456",
+                   "1969-12-31 23:59:59.999999",
+                   "1970-01-01 23:59:59.999999",
+                   "2020-09-13 12:26:40.12"]   # trailing zeros trimmed
+
+
+def test_timestamp_to_numeric_and_back(session):
+    ts = pa.array([1_600_000_000_123_456, -1_000_001], pa.timestamp("us"))
+    assert _cast_col(session, ts, "double") == [1_600_000_000.123456,
+                                                -1.000001]
+    assert _cast_col(session, ts, "long") == [1_600_000_000, -2]  # floors
+    assert _cast_col(session, ts, "int") == [1_600_000_000, -2]
+    got = _cast_col(session, pa.array([1, -5]), "timestamp")
+    assert got == [dtm.datetime(1970, 1, 1, 0, 0, 1, tzinfo=UTC),
+                   dtm.datetime(1969, 12, 31, 23, 59, 55, tzinfo=UTC)]
+
+
+def test_float_to_timestamp_nan_null(session):
+    got = _cast_col(session, pa.array([1.5, float("nan"), float("inf")]),
+                    "timestamp")
+    assert got == [dtm.datetime(1970, 1, 1, 0, 0, 1, 500000, tzinfo=UTC), None, None]
+
+
+def test_timestamp_to_decimal(session):
+    ts = pa.array([1_500_000, -2_500_000], pa.timestamp("us"))
+    assert _cast_col(session, ts, "decimal(20,2)") == [
+        Decimal("1.50"), Decimal("-2.50")]
+    # decimal128 target
+    assert _cast_col(session, ts, "decimal(38,3)") == [
+        Decimal("1.500"), Decimal("-2.500")]
+
+
+def test_decimal_to_timestamp(session):
+    d = pa.array([Decimal("1.5"), Decimal("-2.25")],
+                 pa.decimal128(10, 2))
+    got = _cast_col(session, d, "timestamp")
+    assert got == [dtm.datetime(1970, 1, 1, 0, 0, 1, 500000, tzinfo=UTC),
+                   dtm.datetime(1969, 12, 31, 23, 59, 57, 750000, tzinfo=UTC)]
+
+
+def test_decimal_to_timestamp_truncates_sub_micro(session):
+    """Spark decimalToTimestamp is longValue: sub-microsecond digits
+    truncate toward zero, never round."""
+    d = pa.array([Decimal("0.0000005"), Decimal("-0.0000005")],
+                 pa.decimal128(18, 7))
+    got = _cast_col(session, d, "timestamp")
+    assert got == [dtm.datetime(1970, 1, 1, tzinfo=UTC)] * 2
+
+
+def test_string_to_decimal_long_zero_padded(session):
+    """45+ byte zero-padded forms must parse, not null (64-byte window)."""
+    v = "0" * 43 + "1.5"                          # 46 bytes
+    assert _cast_col(session, pa.array([v]), "decimal(10,2)") == [
+        Decimal("1.50")]
+    too_long = "0" * 70 + "1"                     # beyond the window
+    assert _cast_col(session, pa.array([too_long]),
+                     "decimal(10,2)") == [None]
+
+
+def test_timestamp_to_string_out_of_range_year_null(session):
+    big = pa.array([300_000_000_000_000_000, 0], pa.timestamp("us"))
+    got = _cast_col(session, big, "string")       # year ~11476
+    assert got == [None, "1970-01-01 00:00:00"]

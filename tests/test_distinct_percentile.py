@@ -86,6 +86,35 @@ def test_multiple_collect_sets_independent_ordering():
     assert sorted(out[0]["stt"]) == ["x", "y"]
 
 
+def test_first_last_over_strings_grouped():
+    """Var-width first/last route through the sort-collect path (r3
+    verdict weak #7): per-segment positional select in input order."""
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 4})
+    d = s.create_dataframe({
+        "k": pa.array([1, 1, 1, 2, 2, 3]),
+        "t": pa.array(["a", None, "c", None, "e", None]),
+    })
+    out = {r["k"]: r for r in d.group_by("k").agg(
+        F.first(col("t")).alias("f"),
+        F.last(col("t")).alias("l"),
+        F.first(col("t"), ignorenulls=True).alias("fn"),
+    ).to_arrow().to_pylist()}
+    assert out[1]["f"] == "a" and out[1]["l"] == "c"
+    assert out[1]["fn"] == "a"
+    assert out[2]["f"] is None          # first row's value is null
+    assert out[2]["l"] == "e"
+    assert out[2]["fn"] == "e"          # ignorenulls skips
+    assert out[3]["f"] is None and out[3]["fn"] is None
+
+
+def test_first_last_strings_ungrouped():
+    s = st.TpuSession()
+    d = s.create_dataframe({"t": pa.array([None, "x", "y"])})
+    u = d.agg(F.first(col("t"), ignorenulls=True).alias("f"),
+              F.last(col("t")).alias("l")).to_arrow().to_pylist()[0]
+    assert u == {"f": "x", "l": "y"}
+
+
 def test_distinct_with_nulls():
     s = st.TpuSession()
     d = s.create_dataframe({
