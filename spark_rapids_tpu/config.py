@@ -134,8 +134,17 @@ MULTITHREADED_READ_THREADS = _conf(
     "Thread pool for the multithreaded (cloud) parquet reader "
     "(analog of spark.rapids.sql.multiThreadedRead.numThreads).", int)
 PARQUET_READER_TYPE = _conf(
-    "sql.format.parquet.reader.type", "MULTITHREADED",
-    "PERFILE|COALESCING|MULTITHREADED (GpuParquetScan reader types).", str)
+    "sql.format.parquet.reader.type", "AUTO",
+    "AUTO|PERFILE|COALESCING|MULTITHREADED (GpuParquetScan reader "
+    "types). AUTO picks COALESCING when the scan has many files "
+    "smaller than the coalescing target (fewer host->device uploads), "
+    "else MULTITHREADED (decode prefetch overlapping device "
+    "compute).", str)
+PARQUET_COALESCING_TARGET = _conf(
+    "sql.format.parquet.coalescing.targetBytes", 128 << 20,
+    "COALESCING reader: files group until their on-disk size reaches "
+    "this target; each group's files decode in parallel and upload as "
+    "one batch stream (GpuParquetScan COALESCING analog).", int)
 CLUSTER_EXECUTORS = _conf(
     "cluster.executors", 0,
     "Executor worker processes for host-side scan decode (the "
@@ -166,6 +175,27 @@ LORE_DUMP_IDS = _conf(
 LORE_DUMP_PATH = _conf(
     "sql.lore.dumpPath", "/tmp/srtpu-lore",
     "Directory for LORE operator dumps.", str)
+RETRY_COVERAGE_ENABLED = _conf(
+    "memory.retryCoverage.enabled", False,
+    "Track, per engine call-site, whether device allocations happen "
+    "inside an OOM-retry scope (with_retry / retry_no_split) — the "
+    "allocations outside it are the ones that die instead of spilling "
+    "(reference: AllocationRetryCoverageTracker.scala). Debug tool; "
+    "report via memory.diagnostics.coverage_report().", bool)
+ASYNC_WRITE_ENABLED = _conf(
+    "sql.asyncWrite.enabled", True,
+    "Run file-part encode + disk I/O on a writer pool off the compute "
+    "thread (reference: io/async AsyncOutputStream; "
+    "spark.rapids.sql.asyncWrite.queryOutput.enabled).", bool)
+ASYNC_WRITE_MAX_IN_FLIGHT = _conf(
+    "sql.asyncWrite.maxInFlightHostMemoryBytes", 2 << 30,
+    "Upper bound on host bytes held by scheduled-but-unfinished async "
+    "writes; submissions block above it (always admitting one task), "
+    "so a slow disk cannot pile the query's output into host memory "
+    "(reference: TrafficController).", int)
+ASYNC_WRITE_THREADS = _conf(
+    "sql.asyncWrite.numThreads", 4,
+    "Writer-pool threads for the async write path.", int)
 SORT_OOC_ENABLED = _conf(
     "sql.sort.outOfCore.enabled", True,
     "Enable out-of-core sort (range-exchange to spill files + "

@@ -21,10 +21,23 @@ __all__ = ["HostFilterExec", "HostProjectExec"]
 
 
 def _batch_rows(batch: DeviceBatch):
+    import numpy as np
+    import pyarrow.types as pt
     from .nodes import _batch_to_arrow
     at = _batch_to_arrow(batch)
     names = at.schema.names
-    cols = [at.column(i).to_pylist() for i in range(at.num_columns)]
+    cols = []
+    for i in range(at.num_columns):
+        vals = at.column(i).to_pylist()
+        # integers ride as WIDTH-TYPED numpy scalars so interpreter
+        # arithmetic wraps like Java/device (int32*int32 wraps at 32
+        # bits); plain Python ints would widen unboundedly and diverge
+        # from the device result on overflow
+        t = at.schema.types[i]
+        if pt.is_integer(t):
+            np_t = np.dtype(t.to_pandas_dtype()).type
+            vals = [None if v is None else np_t(v) for v in vals]
+        cols.append(vals)
     rows = [dict(zip(names, vals)) for vals in zip(*cols)] \
         if at.num_rows else []
     return at, rows

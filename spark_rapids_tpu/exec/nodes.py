@@ -137,8 +137,66 @@ class ParquetScanExec(TpuExec):
         self.paths = list(paths)
         self.columns = list(columns) if columns else None
         self.filters = list(filters) if filters else None
+        self._groups_cache = None
+
+    def _reader_type(self, ctx) -> str:
+        # cached: AUTO must not re-stat files per call — a flipped
+        # decision mid-query would reinterpret partition indices (group
+        # vs file) and silently drop rows
+        rt = getattr(self, "_rt_cache", None)
+        if rt is not None:
+            return rt
+        from ..config import (CLUSTER_EXECUTORS,
+                              PARQUET_COALESCING_TARGET,
+                              PARQUET_READER_TYPE)
+        if ctx.conf.get(CLUSTER_EXECUTORS) > 0:
+            # executor offload decodes per file; grouping is the
+            # cluster scheduler's job there
+            rt = "MULTITHREADED"
+        else:
+            rt = str(ctx.conf.get(PARQUET_READER_TYPE)).upper()
+        if rt == "AUTO":
+            # AUTO: many files each below the coalescing target ->
+            # fewer uploads wins; else decode-prefetch overlap wins
+            rt = "MULTITHREADED"
+            if len(self.paths) >= 4:
+                import os as _os
+                target = ctx.conf.get(PARQUET_COALESCING_TARGET)
+                try:
+                    if all(_os.path.getsize(p) < target // 4
+                           for p in self.paths):
+                        rt = "COALESCING"
+                except OSError:
+                    pass
+        self._rt_cache = rt
+        return rt
+
+    def _groups(self, ctx):
+        """COALESCING reader: bin-pack files (in order) into groups of
+        ~targetBytes on-disk size; one output partition per group."""
+        if self._groups_cache is None:
+            import os as _os
+            from ..config import PARQUET_COALESCING_TARGET
+            target = max(1, ctx.conf.get(PARQUET_COALESCING_TARGET))
+            groups, cur, size = [], [], 0
+            for p in self.paths:
+                try:
+                    fsz = _os.path.getsize(p)
+                except OSError:
+                    fsz = target
+                if cur and size + fsz > target:
+                    groups.append(cur)
+                    cur, size = [], 0
+                cur.append(p)
+                size += fsz
+            if cur:
+                groups.append(cur)
+            self._groups_cache = groups
+        return self._groups_cache
 
     def num_partitions(self, ctx):
+        if self._reader_type(ctx) == "COALESCING":
+            return len(self._groups(ctx))    # 0 files -> 0 partitions
         return len(self.paths)
 
     def describe(self):
@@ -170,6 +228,11 @@ class ParquetScanExec(TpuExec):
                               MULTITHREADED_READ_THREADS,
                               PARQUET_READER_TYPE)
         m = ctx.metrics_for(self._op_id)
+        reader_type = self._reader_type(ctx)
+        if reader_type == "COALESCING":
+            # pid indexes file GROUPS here, not files
+            yield from self._execute_coalescing(ctx, pid, m)
+            return
         path = self.paths[pid]
         if (ctx.conf.get(CLUSTER_EXECUTORS) > 0
                 and ctx.session is not None):
@@ -191,7 +254,6 @@ class ParquetScanExec(TpuExec):
                 m.add("numOutputBatches", 1)
                 yield DeviceBatch(tbl, num_rows=at.num_rows)
             return
-        reader_type = str(ctx.conf.get(PARQUET_READER_TYPE)).upper()
         host_iter = self._decoded_batches(ctx, path, m)
         if reader_type == "MULTITHREADED":
             nthreads = max(1, ctx.conf.get(MULTITHREADED_READ_THREADS))
@@ -202,6 +264,63 @@ class ParquetScanExec(TpuExec):
             m.add("numOutputRows", at.num_rows)
             m.add("numOutputBatches", 1)
             yield DeviceBatch(tbl, num_rows=at.num_rows)
+
+    def _execute_coalescing(self, ctx, pid, m):
+        """COALESCING reader: the group's files decode IN PARALLEL on a
+        thread pool, concatenate host-side, and upload as full-target
+        batches — many small files cost one H2D per coalesced batch
+        instead of one per file (reference: GpuParquetScan COALESCING
+        reader, GpuMultiFileReader.scala)."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        from concurrent.futures import ThreadPoolExecutor
+        from ..config import MULTITHREADED_READ_THREADS
+        group = self._groups(ctx)[pid]
+        cols = (self.columns if self.columns is not None
+                else [f.name for f in self.schema.fields])
+        if not cols:
+            # count-style scan: pf.read(columns=[]) drops the row count
+            # (0-column Table), so stream per-file batches which keep it
+            for p in group:
+                for at in self._decoded_batches(ctx, p, m):
+                    with m.timer("scanTime"):
+                        tbl = Table.from_arrow(at)
+                    m.add("numOutputRows", at.num_rows)
+                    m.add("numOutputBatches", 1)
+                    yield DeviceBatch(tbl, num_rows=at.num_rows)
+            return
+
+        def read_one(p):
+            pf = pq.ParquetFile(p)
+            if self.filters:
+                kept = prune_row_groups(pf, self.filters)
+                skipped = pf.metadata.num_row_groups - len(kept)
+                if not kept:
+                    return None, skipped
+                return pf.read_row_groups(kept, columns=cols), skipped
+            return pf.read(columns=cols), 0
+
+        nthreads = max(1, ctx.conf.get(MULTITHREADED_READ_THREADS))
+        with ThreadPoolExecutor(max_workers=nthreads) as pool:
+            parts = list(pool.map(read_one, group))
+        tables = []
+        for at, skipped in parts:
+            m.add("skippedRowGroups", skipped)
+            if at is not None and at.num_rows:
+                tables.append(at)
+        if not tables:
+            return
+        combined = (pa.concat_tables(tables) if len(tables) > 1
+                    else tables[0])
+        m.add("coalescedFiles", len(group))
+        per = max(1, ctx.conf.batch_size_rows)
+        for start in range(0, combined.num_rows, per):
+            sl = combined.slice(start, min(per, combined.num_rows - start))
+            with m.timer("scanTime"):
+                tbl = Table.from_arrow(sl)
+            m.add("numOutputRows", sl.num_rows)
+            m.add("numOutputBatches", 1)
+            yield DeviceBatch(tbl, num_rows=sl.num_rows)
 
 
 def _remote_decode_parquet(path, columns, filters, batch_rows):

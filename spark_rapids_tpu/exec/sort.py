@@ -126,9 +126,11 @@ class SortExec(TpuExec):
         handles = []
         total = 0
         try:
+            from ..memory.retry import retry_no_split
             for cpid in range(child.num_partitions(ctx)):
                 for batch in child.execute_partition(ctx, cpid):
-                    handles.append(store.add_batch(batch))
+                    handles.append(retry_no_split(
+                        lambda b=batch: store.add_batch(b)))
                     total += batch.nbytes
             if not handles:
                 return

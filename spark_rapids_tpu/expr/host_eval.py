@@ -88,7 +88,16 @@ def _rule(*names):
 
 @_rule("Literal")
 def _lit(e, cv, env):
-    return e.value
+    import numpy as np
+    v = e.value
+    np_dt = getattr(getattr(e, "dtype", None), "np_dtype", None)
+    # typed numpy scalar so arithmetic wraps at the literal's width,
+    # matching the device (Java/Spark non-ANSI overflow)
+    if (np_dt is not None and isinstance(v, int)
+            and not isinstance(v, bool)
+            and np.issubdtype(np.dtype(np_dt), np.integer)):
+        return np.dtype(np_dt).type(v)
+    return v
 
 
 @_rule("ColumnRef")
@@ -147,7 +156,9 @@ def _cmp(op):
         a, b = cv
         if a is None or b is None:
             return None
-        return op(a, b)
+        # native bool: numpy comparison results (np.bool_) would break
+        # the And/Or rules' `is False` Kleene short-circuits
+        return bool(op(a, b))
     return fn
 
 
@@ -317,8 +328,15 @@ def _eval_one(e: Expression, env) -> Any:
 
 
 def host_eval_rows(expr: Expression, rows: List[dict]) -> List[Any]:
-    """Evaluate an UNBOUND expression tree over row dicts (name->value)."""
-    return [_eval_one(expr, row) for row in rows]
+    """Evaluate an UNBOUND expression tree over row dicts (name->value).
+    Integer inputs should be numpy width-typed scalars (see
+    host_fallback._batch_rows) so arithmetic wraps like the device;
+    overflow warnings from that wrapping are expected and silenced."""
+    import numpy as np
+    import warnings
+    with np.errstate(over="ignore"), warnings.catch_warnings():
+        warnings.simplefilter("ignore", RuntimeWarning)
+        return [_eval_one(expr, row) for row in rows]
 
 
 # output dtype WITHOUT capability checks, for planning around fallbacks

@@ -151,39 +151,64 @@ class DataFrameWriter:
                 raise KeyError(f"partition columns not in schema: "
                                f"{missing}")
 
-        seq = 0
-        for at in self._df._iter_partition_tables():
-            if at.num_rows == 0:
-                continue
-            if not pcols:
-                fname = os.path.join(path, f"part-{seq:05d}-{job}.{ext}")
-                write_fn(at, fname)
-                stats.num_files += 1
-                stats.num_rows += at.num_rows
-                stats.num_bytes += os.path.getsize(fname)
-                seq += 1
-                continue
-            # dynamic partitioning: split the batch by the partition-key
-            # tuple, one directory per distinct tuple
-            # (GpuDynamicPartitionDataSingleWriter)
-            keys = [at.column(c).to_pylist() for c in pcols]
-            groups: Dict[tuple, List[int]] = {}
-            for i, tup in enumerate(zip(*keys)):
-                groups.setdefault(tup, []).append(i)
-            body = at.select(out_names)
-            for tup, idxs in groups.items():
-                sub = body.take(pa.array(idxs, type=pa.int64()))
-                pdir = _partition_dir(pcols, tup)
-                full = os.path.join(path, pdir)
-                os.makedirs(full, exist_ok=True)
-                if pdir not in stats.partitions:
-                    stats.partitions.append(pdir)
-                fname = os.path.join(full, f"part-{seq:05d}-{job}.{ext}")
-                write_fn(sub, fname)
-                stats.num_files += 1
-                stats.num_rows += sub.num_rows
-                stats.num_bytes += os.path.getsize(fname)
-                seq += 1
+        # async path: encode + disk I/O on the writer pool, throttled by
+        # the session's TrafficController; the compute loop keeps
+        # producing batches (reference: io/async AsyncOutputStream)
+        from ..config import ASYNC_WRITE_ENABLED, ASYNC_WRITE_THREADS
+        conf = self._df._session.conf
+        queue = None
+        if conf.get(ASYNC_WRITE_ENABLED):
+            from .async_io import AsyncWriteQueue, controller_for
+            queue = AsyncWriteQueue(controller_for(conf),
+                                    conf.get(ASYNC_WRITE_THREADS))
+
+        def emit(tbl, fname):
+            def task(t=tbl, f=fname):
+                write_fn(t, f)
+                return t.num_rows, os.path.getsize(f)
+            if queue is None:
+                nrows, nbytes = task()
+                stats.num_rows += nrows
+                stats.num_bytes += nbytes
+            else:
+                queue.submit(tbl.nbytes, task)
+            stats.num_files += 1
+
+        try:
+            seq = 0
+            for at in self._df._iter_partition_tables():
+                if at.num_rows == 0:
+                    continue
+                if not pcols:
+                    emit(at, os.path.join(path,
+                                          f"part-{seq:05d}-{job}.{ext}"))
+                    seq += 1
+                    continue
+                # dynamic partitioning: split the batch by the
+                # partition-key tuple, one directory per distinct tuple
+                # (GpuDynamicPartitionDataSingleWriter)
+                keys = [at.column(c).to_pylist() for c in pcols]
+                groups: Dict[tuple, List[int]] = {}
+                for i, tup in enumerate(zip(*keys)):
+                    groups.setdefault(tup, []).append(i)
+                body = at.select(out_names)
+                for tup, idxs in groups.items():
+                    sub = body.take(pa.array(idxs, type=pa.int64()))
+                    pdir = _partition_dir(pcols, tup)
+                    full = os.path.join(path, pdir)
+                    os.makedirs(full, exist_ok=True)
+                    if pdir not in stats.partitions:
+                        stats.partitions.append(pdir)
+                    emit(sub, os.path.join(
+                        full, f"part-{seq:05d}-{job}.{ext}"))
+                    seq += 1
+            if queue is not None:
+                for nrows, nbytes in queue.drain():
+                    stats.num_rows += nrows
+                    stats.num_bytes += nbytes
+        finally:
+            if queue is not None:
+                queue.close()
         if stats.num_files == 0:
             # empty result still records the schema
             empty = self._df.schema.to_arrow().empty_table() \

@@ -52,7 +52,10 @@ class DeviceManager:
     def reserved(self) -> int:
         return self._reserved
 
-    def try_reserve(self, nbytes: int) -> bool:
+    def try_reserve(self, nbytes: int, _record: bool = True) -> bool:
+        if _record:
+            from .diagnostics import record_allocation
+            record_allocation()
         with self._lock:
             if self._reserved + nbytes <= self.budget:
                 self._reserved += nbytes
@@ -61,8 +64,12 @@ class DeviceManager:
 
     def reserve(self, nbytes: int):
         """Reserve, spilling as needed; raises BudgetExceeded if the spill
-        store cannot free enough."""
-        if self.try_reserve(nbytes):
+        store cannot free enough. Coverage records ONCE per logical
+        allocation: here at entry, with the spill-retry loop's repeat
+        try_reserve attempts unrecorded."""
+        from .diagnostics import record_allocation
+        record_allocation()
+        if self.try_reserve(nbytes, _record=False):
             return
         for hook in self._spill_hooks:
             # recompute the shortfall under the lock on every attempt:
@@ -71,7 +78,7 @@ class DeviceManager:
                 needed = nbytes - (self.budget - self._reserved)
             if needed > 0:
                 hook(needed)
-            if self.try_reserve(nbytes):
+            if self.try_reserve(nbytes, _record=False):
                 return
         raise BudgetExceeded(
             f"need {nbytes} bytes, reserved {self._reserved} of "

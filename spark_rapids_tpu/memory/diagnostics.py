@@ -1,0 +1,115 @@
+"""Allocation-discipline diagnostics: retry coverage + leak checking.
+
+Analog of the reference's AllocationRetryCoverageTracker.scala (which
+flags device allocations made OUTSIDE the OOM-retry framework — those
+are the allocations that kill a query instead of spilling) and the
+shutdown leak-check hooks (Plugin.scala:625 RapidsBufferCatalog leak
+assertions).
+
+Coverage tracking is opt-in (`memory.retryCoverage.enabled`): when on,
+every DeviceManager.reserve() records the engine call-site and whether
+a retry scope (with_retry / retry_no_split) was active on the thread.
+`coverage_report()` feeds the test that keeps operator allocations
+inside the retry discipline. Leak checking is always available:
+`leak_report()` snapshots open spill handles + reserved device bytes,
+and `assert_no_leaks()` is the teardown hook."""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+from typing import Dict
+
+__all__ = ["retry_scope", "in_retry_scope", "enable_retry_coverage",
+           "record_allocation", "coverage_report", "reset_coverage",
+           "leak_report", "assert_no_leaks"]
+
+_tls = threading.local()
+_enabled = False
+_lock = threading.Lock()
+# site -> [covered_count, uncovered_count]
+_sites: Dict[str, list] = defaultdict(lambda: [0, 0])
+
+
+class retry_scope:
+    """Marks the dynamic extent of an OOM-retry region on this thread."""
+
+    def __enter__(self):
+        _tls.depth = getattr(_tls, "depth", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _tls.depth = getattr(_tls, "depth", 1) - 1
+        return False
+
+
+def in_retry_scope() -> bool:
+    return getattr(_tls, "depth", 0) > 0
+
+
+def enable_retry_coverage(on: bool = True):
+    global _enabled
+    _enabled = on
+
+
+def _call_site() -> str:
+    import sys
+    f = sys._getframe(2)
+    pkg_sep = "spark_rapids_tpu"
+    while f is not None:
+        fn = f.f_code.co_filename
+        if pkg_sep in fn and "/memory/" not in fn:
+            short = fn.split(pkg_sep + "/", 1)[-1]
+            return f"{short}:{f.f_lineno}"
+        f = f.f_back
+    return "<external>"
+
+
+def record_allocation():
+    """Called by DeviceManager.reserve when coverage tracking is on."""
+    if not _enabled:
+        return
+    site = _call_site()
+    with _lock:
+        _sites[site][0 if in_retry_scope() else 1] += 1
+
+
+def coverage_report() -> Dict[str, dict]:
+    with _lock:
+        return {s: {"covered": c, "uncovered": u}
+                for s, (c, u) in sorted(_sites.items())}
+
+
+def reset_coverage():
+    with _lock:
+        _sites.clear()
+
+
+# -- leak checking ------------------------------------------------------
+def leak_report() -> dict:
+    """Open spill handles (count/bytes, by state and priority) plus the
+    DeviceManager's outstanding reservation."""
+    from .device import device_manager
+    from .spill import spill_store
+    store = spill_store()
+    with store._lock:
+        handles = list(store._handles.values())
+    by_state: Dict[str, int] = defaultdict(int)
+    by_prio: Dict[int, int] = defaultdict(int)
+    total = 0
+    for h in handles:
+        by_state[str(h.state)] += 1
+        by_prio[h.priority] += 1
+        total += h.nbytes
+    return {"openHandles": len(handles), "openBytes": total,
+            "byState": dict(by_state), "byPriority": dict(by_prio),
+            "deviceReservedBytes": device_manager().reserved}
+
+
+def assert_no_leaks(allow_reserved_bytes: int = 0):
+    """Teardown hook: raises when spill handles remain open or device
+    reservations exceed `allow_reserved_bytes` (cached plans that park
+    exchange outputs must be release()d first — ADVICE r3)."""
+    rep = leak_report()
+    if rep["openHandles"] or rep["deviceReservedBytes"] \
+            > allow_reserved_bytes:
+        raise AssertionError(f"resource leak: {rep}")

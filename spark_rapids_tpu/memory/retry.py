@@ -68,11 +68,17 @@ def with_retry(batch: DeviceBatch,
                max_splits: int = MAX_SPLITS) -> Iterator[object]:
     """Run `fn` (idempotent!) over `batch`, splitting in half and retrying
     on device OOM. Yields one result per final sub-batch, in row order."""
+    from .diagnostics import retry_scope
     stack: List[tuple] = [(batch, 0)]
     while stack:
         b, depth = stack.pop(0)
         try:
-            yield fn(b)
+            # compute INSIDE the scope, yield OUTSIDE: a generator
+            # suspended at yield would otherwise hold the scope open and
+            # misattribute the consumer's allocations as retry-covered
+            with retry_scope():
+                res = fn(b)
+            yield res
         except Exception as e:  # noqa: BLE001 - filtered below
             if not is_oom_error(e):
                 raise
@@ -89,10 +95,12 @@ def retry_no_split(fn: Callable[[], object], retries: int = 2):
     device OOM — for operators whose semantics forbid input splitting
     (e.g. window frames spanning the whole partition). The GpuRetryOOM
     half of the reference's retry framework without GpuSplitAndRetryOOM."""
+    from .diagnostics import retry_scope
     attempt = 0
     while True:
         try:
-            return fn()
+            with retry_scope():
+                return fn()
         except Exception as e:  # noqa: BLE001 - filtered below
             if not is_oom_error(e) or attempt >= retries:
                 raise

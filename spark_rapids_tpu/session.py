@@ -30,6 +30,9 @@ class TpuSession:
         self.conf = TpuConf(conf)
         self.read = DataFrameReader(self)
         TpuSession._active = self
+        from .config import RETRY_COVERAGE_ENABLED
+        from .memory.diagnostics import enable_retry_coverage
+        enable_retry_coverage(bool(self.conf.get(RETRY_COVERAGE_ENABLED)))
 
     @staticmethod
     def builder_get_or_create(conf: Optional[Dict] = None) -> "TpuSession":

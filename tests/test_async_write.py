@@ -1,0 +1,115 @@
+"""Async write path + TrafficController throttling (reference:
+io/async AsyncOutputStream/TrafficController,
+AsyncWriterThrottlingSuite)."""
+import os
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.io.async_io import (AsyncWriteQueue,
+                                          TrafficController)
+
+
+def test_traffic_controller_bounds_in_flight_bytes():
+    tc = TrafficController(100)
+    q = AsyncWriteQueue(tc, num_threads=4)
+    peak = [0]
+    lock = threading.Lock()
+
+    def slow_write():
+        with lock:
+            peak[0] = max(peak[0], tc.in_flight_bytes)
+        time.sleep(0.05)
+
+    for _ in range(12):
+        q.submit(40, slow_write)
+    q.close()
+    # 3 * 40 > 100: at most two 40-byte tasks admitted together
+    assert peak[0] <= 80, peak[0]
+    assert tc.in_flight_bytes == 0
+    assert tc.throttle_wait_seconds > 0   # submissions actually blocked
+
+
+def test_oversized_task_always_admitted():
+    tc = TrafficController(10)
+    q = AsyncWriteQueue(tc, num_threads=2)
+    done = []
+    q.submit(1000, lambda: done.append(1))   # > budget, must not block
+    q.close()
+    assert done == [1]
+
+
+def test_error_propagates_on_drain():
+    tc = TrafficController(1 << 20)
+    q = AsyncWriteQueue(tc, num_threads=2)
+
+    def boom():
+        raise ValueError("disk on fire")
+
+    q.submit(10, boom)
+    with pytest.raises(RuntimeError, match="disk on fire"):
+        q.drain()
+    assert tc.in_flight_bytes == 0           # budget released on failure
+
+
+def test_async_parquet_write_matches_sync(tmp_path):
+    rng = np.random.default_rng(21)
+    n = 20_000
+    data = {"k": pa.array(rng.integers(0, 50, n)),
+            "v": pa.array(rng.normal(0, 1, n)),
+            "t": pa.array([f"s{i%97}" for i in range(n)])}
+
+    def run(enabled, sub):
+        s = st.TpuSession({
+            "spark.rapids.tpu.sql.batchSizeRows": 2048,
+            "spark.rapids.tpu.sql.asyncWrite.enabled": str(enabled),
+        })
+        df = s.create_dataframe(data)
+        out = str(tmp_path / sub)
+        stats = df.write.mode("overwrite").parquet(out)
+        tbl = pq.read_table(out)
+        return stats, tbl.sort_by("k")
+
+    st_async, t_async = run(True, "a")
+    st_sync, t_sync = run(False, "b")
+    assert st_async.num_rows == st_sync.num_rows == n
+    assert st_async.num_files == st_sync.num_files
+    assert t_async.equals(t_sync)
+    assert os.path.exists(str(tmp_path / "a" / "_SUCCESS"))
+
+
+def test_async_partitioned_write(tmp_path):
+    s = st.TpuSession()
+    df = s.create_dataframe({
+        "p": pa.array([1, 1, 2, 2, 3]),
+        "v": pa.array([10.0, 11.0, 20.0, 21.0, 30.0])})
+    out = str(tmp_path / "part")
+    stats = df.write.mode("overwrite").partitionBy("p").parquet(out)
+    assert sorted(stats.partitions) == ["p=1", "p=2", "p=3"]
+    got = pq.read_table(out)
+    assert got.num_rows == 5
+
+
+def test_async_write_error_fails_job(tmp_path, monkeypatch):
+    """A failing part write surfaces on the job, never a silent
+    partial success (deferred-error contract)."""
+    import pyarrow.parquet as pqm
+    calls = [0]
+    orig = pqm.write_table
+
+    def flaky(tbl, fname, **kw):
+        calls[0] += 1
+        if calls[0] >= 2:
+            raise OSError("disk full")
+        return orig(tbl, fname, **kw)
+
+    monkeypatch.setattr(pqm, "write_table", flaky)
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 2})
+    df = s.create_dataframe({"v": pa.array(list(range(10)))})
+    with pytest.raises(Exception, match="disk full"):
+        df.write.mode("overwrite").parquet(str(tmp_path / "o"))

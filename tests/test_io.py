@@ -64,7 +64,14 @@ def test_many_small_files_coalesce(session, tmp_path):
                           ("s", StringGen(max_len=6))], n=900, seed=84)
     for i in range(9):
         pq.write_table(at.slice(i * 100, 100), tmp_path / f"s{i}.parquet")
-    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 400})
+    # exec-level CoalesceBatchesExec is what this test exercises: pin
+    # the per-file reader (AUTO would pick the reader-level COALESCING
+    # path, which pre-coalesces upstream — covered by
+    # test_multifile_reader.py)
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.batchSizeRows": 400,
+        "spark.rapids.tpu.sql.format.parquet.reader.type": "MULTITHREADED",
+    })
     df = s.read.parquet(str(tmp_path))
     out = df.to_arrow()
     assert_rows_equal(out, list(zip(at.column(0).to_pylist(),
