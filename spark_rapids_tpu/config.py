@@ -175,6 +175,37 @@ LORE_DUMP_IDS = _conf(
 LORE_DUMP_PATH = _conf(
     "sql.lore.dumpPath", "/tmp/srtpu-lore",
     "Directory for LORE operator dumps.", str)
+FILECACHE_ENABLED = _conf(
+    "filecache.enabled", False,
+    "Cache scan input files on local disk, keyed by (path, mtime, "
+    "size) with LRU eviction — repeated scans of network-mounted "
+    "inputs skip the fetch (reference: spark.rapids.filecache.enabled, "
+    "GpuFileCache). Off by default: pure overhead for local inputs.",
+    bool)
+FILECACHE_DIR = _conf(
+    "filecache.dir", "/tmp/srtpu-filecache",
+    "Local directory for cached input files.", str)
+FILECACHE_MAX_BYTES = _conf(
+    "filecache.maxBytes", 16 << 30,
+    "Upper bound on cached bytes; least-recently-used entries evict "
+    "past it.", int)
+CBO_ENABLED = _conf(
+    "sql.optimizer.cbo.enabled", False,
+    "Cost-based device-vs-host placement: tiny Project/Filter inputs "
+    "the host interpreter covers run on the CPU bridge instead of "
+    "paying a device dispatch (reference: CostBasedOptimizer.scala + "
+    "GpuCostModel, also default-off). Decisions show in explain as "
+    "'CBO: ...'.", bool)
+CBO_SMALL_INPUT_ROWS = _conf(
+    "sql.optimizer.cbo.smallInputRows", 64,
+    "CBO small-input bound: estimated input rows at or below this run "
+    "host-side when coverable.", int)
+PYTHON_CONCURRENT_WORKERS = _conf(
+    "python.concurrentPythonWorkers", 4,
+    "Worker-process slots for pandas transforms (mapInPandas); "
+    "acquisition blocks above it (reference: "
+    "spark.rapids.python.concurrentPythonWorkers, "
+    "PythonWorkerSemaphore).", int)
 RETRY_COVERAGE_ENABLED = _conf(
     "memory.retryCoverage.enabled", False,
     "Track, per engine call-site, whether device allocations happen "

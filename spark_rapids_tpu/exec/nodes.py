@@ -206,8 +206,9 @@ class ParquetScanExec(TpuExec):
     def _decoded_batches(self, ctx, path, m):
         import pyarrow as pa
         import pyarrow.parquet as pq
+        from ..io.file_cache import cached_local_path
         per = max(1, ctx.conf.batch_size_rows)
-        pf = pq.ParquetFile(path)
+        pf = pq.ParquetFile(cached_local_path(path, ctx.conf))
         cols = (self.columns if self.columns is not None
                 else [f.name for f in self.schema.fields])
         if self.filters:
@@ -290,8 +291,10 @@ class ParquetScanExec(TpuExec):
                     yield DeviceBatch(tbl, num_rows=at.num_rows)
             return
 
+        from ..io.file_cache import cached_local_path
+
         def read_one(p):
-            pf = pq.ParquetFile(p)
+            pf = pq.ParquetFile(cached_local_path(p, ctx.conf))
             if self.filters:
                 kept = prune_row_groups(pf, self.filters)
                 skipped = pf.metadata.num_row_groups - len(kept)

@@ -1,0 +1,247 @@
+"""Arrow-IPC python worker execs: pandas transforms in SEPARATE worker
+processes, batches crossing as Arrow IPC stream bytes.
+
+TPU-native analog of the reference's execution/python package
+(`GpuMapInPandasExec`, `GpuArrowEvalPythonExec`): device batches export
+to Arrow host-side, ship to a pooled python worker over a pipe, the
+user's pandas function runs there (its own GIL, its own memory), and
+the result streams back and re-uploads. A worker-slot semaphore bounds
+concurrent workers like the reference's PythonWorkerSemaphore
+(`PythonWorkerSemaphore.scala:44`) so a wide query cannot fork an
+unbounded python fleet.
+
+The user function must be picklable (module-level def or functools
+partial): workers start with the `spawn` method so they never inherit
+the parent's JAX/TPU state."""
+from __future__ import annotations
+
+import atexit
+import multiprocessing as mp
+import pickle
+import threading
+from typing import Callable, Iterator, List, Optional
+
+from ..columnar.table import Schema, Table
+from .base import ExecContext, TpuExec
+from .batch import DeviceBatch
+
+__all__ = ["ArrowEvalPythonExec", "PythonWorkerPool"]
+
+
+def _worker_main(conn):
+    """Worker loop: (pickled fn) once, then per message an Arrow IPC
+    stream -> fn(pandas DataFrame) -> Arrow IPC stream back. Protocol:
+    ("fn", bytes) | ("batch", bytes) -> ("ok", bytes) | ("err", str)
+    | ("stop",)."""
+    import pyarrow as pa
+    fn = None
+    while True:
+        try:
+            msg = conn.recv()
+        except EOFError:
+            return
+        kind = msg[0]
+        if kind == "stop":
+            return
+        try:
+            if kind == "fn":
+                fn = pickle.loads(msg[1])
+                conn.send(("ok", b""))
+                continue
+            with pa.ipc.open_stream(msg[1]) as rd:
+                at = rd.read_all()
+            out = fn(at.to_pandas())
+            res = pa.Table.from_pandas(out, preserve_index=False)
+            sink = pa.BufferOutputStream()
+            with pa.ipc.new_stream(sink, res.schema) as w:
+                w.write_table(res)
+            conn.send(("ok", sink.getvalue().to_pybytes()))
+        except BaseException as e:  # noqa: BLE001 — shipped to parent
+            try:
+                conn.send(("err", f"{type(e).__name__}: {e}"))
+            except Exception:
+                return
+
+
+class _Worker:
+    def __init__(self, fn_blob: bytes):
+        ctx = mp.get_context("spawn")
+        self.conn, child = ctx.Pipe()
+        self.proc = ctx.Process(target=_worker_main, args=(child,),
+                                daemon=True)
+        self.proc.start()
+        child.close()
+        self.conn.send(("fn", fn_blob))
+        kind, payload = self.conn.recv()
+        if kind != "ok":
+            raise RuntimeError(f"python worker init failed: {payload}")
+
+    def run(self, ipc_bytes: bytes) -> bytes:
+        self.conn.send(("batch", ipc_bytes))
+        kind, payload = self.conn.recv()
+        if kind != "ok":
+            raise RuntimeError(f"python worker failed: {payload}")
+        return payload
+
+    def stop(self):
+        try:
+            self.conn.send(("stop",))
+        except Exception:
+            pass
+        self.proc.join(timeout=2)
+        if self.proc.is_alive():
+            self.proc.terminate()
+
+
+# process-GLOBAL worker-slot accounting: the bound caps total python
+# workers across ALL pools/queries in this process, matching the
+# reference's one PythonWorkerSemaphore per executor (sized from the
+# first conf observed; later differing values keep the first bound)
+_slots_cv = threading.Condition()
+_slots_bound: List[int] = []            # [bound] once initialized
+_slots_used = [0]
+
+
+def _global_acquire(bound_hint: int):
+    with _slots_cv:
+        if not _slots_bound:
+            _slots_bound.append(max(1, bound_hint))
+        while _slots_used[0] >= _slots_bound[0]:
+            _slots_cv.wait(timeout=0.5)
+        _slots_used[0] += 1
+
+
+def _global_release():
+    with _slots_cv:
+        _slots_used[0] = max(0, _slots_used[0] - 1)
+        _slots_cv.notify()
+
+
+class PythonWorkerPool:
+    """Pool of persistent python workers for ONE function, drawing
+    spawn slots from the process-global bound (PythonWorkerSemaphore
+    analog); run() blocks while every slot is busy, and workers are
+    reused across batches."""
+
+    def __init__(self, fn: Callable, max_workers: int):
+        self._fn_blob = pickle.dumps(fn)
+        self.max_workers = max(1, max_workers)
+        self._idle: List[_Worker] = []
+        self._spawned = 0
+        self._cv = threading.Condition()
+        self._closed = False
+        atexit.register(self.close)
+
+    def run(self, ipc_bytes: bytes) -> bytes:
+        w = self._acquire()
+        try:
+            out = w.run(ipc_bytes)
+        except BaseException:
+            # failed worker is not returned to the pool
+            self._drop(w)
+            raise
+        self._release(w)
+        return out
+
+    def _drop(self, w: Optional[_Worker]):
+        with self._cv:
+            self._spawned -= 1
+            self._cv.notify()
+        _global_release()
+        if w is not None:
+            w.stop()
+
+    def _acquire(self) -> _Worker:
+        with self._cv:
+            while True:
+                if self._idle:
+                    return self._idle.pop()
+                if self._spawned < self.max_workers:
+                    self._spawned += 1
+                    break
+                self._cv.wait(timeout=0.5)
+        _global_acquire(self.max_workers)
+        try:
+            return _Worker(self._fn_blob)
+        except BaseException:
+            # failed spawn MUST return its slot or the pool deadlocks
+            with self._cv:
+                self._spawned -= 1
+                self._cv.notify()
+            _global_release()
+            raise
+
+    def _release(self, w: _Worker):
+        with self._cv:
+            if self._closed:
+                # checked out across close(): return its slot here
+                self._spawned -= 1
+            else:
+                self._idle.append(w)
+                self._cv.notify()
+                return
+        w.stop()
+        _global_release()
+
+    def close(self):
+        with self._cv:
+            self._closed = True
+            idle, self._idle = self._idle, []
+            n = len(idle)
+            self._spawned -= n
+        for w in idle:
+            w.stop()
+        for _ in range(n):
+            _global_release()
+
+
+class ArrowEvalPythonExec(TpuExec):
+    """mapInPandas: each input batch crosses to a python worker as an
+    Arrow IPC stream and the pandas result re-uploads (reference:
+    GpuMapInPandasExec / GpuArrowEvalPythonExec batch flow)."""
+
+    def __init__(self, child: TpuExec, fn: Callable, schema: Schema):
+        super().__init__([child], schema)
+        self.fn = fn
+        self._pool: Optional[PythonWorkerPool] = None
+
+    def describe(self):
+        name = getattr(self.fn, "__name__", "fn")
+        return f"ArrowEvalPythonExec[{name}]"
+
+    def _ensure_pool(self, ctx) -> PythonWorkerPool:
+        if self._pool is None:
+            from ..config import PYTHON_CONCURRENT_WORKERS
+            self._pool = PythonWorkerPool(
+                self.fn, ctx.conf.get(PYTHON_CONCURRENT_WORKERS))
+        return self._pool
+
+    def release(self):
+        if self._pool is not None:
+            self._pool.close()
+            self._pool = None
+        super().release()
+
+    def execute_partition(self, ctx: ExecContext,
+                          pid: int) -> Iterator[DeviceBatch]:
+        import pyarrow as pa
+        from .nodes import _batch_to_arrow
+        m = ctx.metrics_for(self._op_id)
+        pool = self._ensure_pool(ctx)
+        out_arrow = self.schema.to_arrow()
+        for batch in self.children[0].execute_partition(ctx, pid):
+            with m.timer("pythonEvalTime"):
+                at = _batch_to_arrow(batch)
+                sink = pa.BufferOutputStream()
+                with pa.ipc.new_stream(sink, at.schema) as w:
+                    w.write_table(at)
+                res_bytes = pool.run(sink.getvalue().to_pybytes())
+                with pa.ipc.open_stream(res_bytes) as rd:
+                    res = rd.read_all()
+            if res.num_rows == 0:
+                continue
+            res = res.cast(out_arrow)
+            tbl = Table.from_arrow(res)
+            m.add("numOutputRows", res.num_rows)
+            m.add("numOutputBatches", 1)
+            yield DeviceBatch(tbl, num_rows=res.num_rows)

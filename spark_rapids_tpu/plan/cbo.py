@@ -1,0 +1,98 @@
+"""Cost-based optimizer: device-vs-host placement from row estimates.
+
+Analog of the reference's CostBasedOptimizer.scala + GpuCostModel: the
+reference's CBO estimates operator cost and keeps a plan section on CPU
+when moving it to the GPU wouldn't pay for the row<->columnar
+transitions. The TPU translation: every jitted device dispatch costs a
+fixed overhead (trace/compile amortized, but dispatch + H2D/D2H for
+tiny batches is microseconds-to-milliseconds), so a TINY input is
+often faster through the host row interpreter than through XLA. When
+`sql.optimizer.cbo.enabled` is on, Project/Filter nodes whose
+estimated input is below `sql.optimizer.cbo.smallInputRows` AND whose
+expressions the host interpreter covers are tagged for the CPU bridge,
+with the decision visible in explain ("CBO: ...").
+
+Like the reference, the CBO defaults OFF — estimates are coarse and the
+device path is correct regardless; this is a latency tune for
+tiny-table workloads."""
+from __future__ import annotations
+
+from . import logical as L
+
+__all__ = ["apply_cbo", "estimate_rows_selective"]
+
+# rough per-conjunct selectivities (reference: spark CBO FilterEstimation)
+_SEL = {"Eq": 0.05, "EqNullSafe": 0.05, "In": 0.1,
+        "Lt": 0.33, "Le": 0.33, "Gt": 0.33, "Ge": 0.33,
+        "Like": 0.1, "RLike": 0.1, "Contains": 0.1,
+        "StartsWith": 0.1, "EndsWith": 0.1,
+        "IsNull": 0.1, "IsNotNull": 0.9}
+
+
+def _selectivity(e) -> float:
+    name = type(e).__name__
+    if name == "And":
+        a, b = e.children
+        return _selectivity(a) * _selectivity(b)
+    if name == "Or":
+        a, b = e.children
+        return min(1.0, _selectivity(a) + _selectivity(b))
+    if name == "Not":
+        return max(0.0, 1.0 - _selectivity(e.children[0]))
+    return _SEL.get(name, 0.5)
+
+
+def estimate_rows_selective(node: L.LogicalPlan):
+    """Row estimate WITH filter selectivities applied (the planner's
+    broadcast input stays conservative/upper-bound; the CBO wants the
+    expected size)."""
+    from .planner import _estimate_rows
+    if isinstance(node, L.Filter):
+        child = estimate_rows_selective(node.children[0])
+        if child is None:
+            return None
+        return child * _selectivity(node.condition)
+    if isinstance(node, (L.Project, L.Sort, L.Repartition, L.WindowOp)):
+        return estimate_rows_selective(node.children[0])
+    return _estimate_rows(node)
+
+
+def _host_covers(exprs) -> bool:
+    from ..expr.host_eval import _RULES
+
+    def covered(e):
+        if e is None:
+            return False
+        if type(e).__name__ not in _RULES:
+            return False
+        return all(covered(c) for c in e.children if c is not None)
+
+    return all(covered(e) for e in exprs)
+
+
+def apply_cbo(meta, conf):
+    """Walk the tagged PlanMeta tree; tag tiny host-coverable
+    Project/Filter nodes for the CPU bridge. Mutates meta in place.
+    No-op when CPU fallback is disallowed — a CBO tag must never turn
+    a valid device plan into a failure."""
+    from ..config import CBO_SMALL_INPUT_ROWS
+    if not conf.allow_cpu_fallback:
+        return
+    small = conf.get(CBO_SMALL_INPUT_ROWS)
+    _walk(meta, small)
+
+
+def _walk(meta, small: int):
+    node = meta.node
+    if isinstance(node, (L.Project, L.Filter)) \
+            and not meta.reasons and not meta.host_reasons:
+        est = estimate_rows_selective(node.children[0])
+        exprs = ([node.bound] if isinstance(node, L.Filter)
+                 else list(node.exprs))
+        if est is not None and est <= small and _host_covers(
+                [e for e in exprs if e is not None]):
+            meta.will_use_host(
+                f"CBO: ~{int(est)} input rows <= {small}; host "
+                f"interpreter beats device dispatch at this size")
+    for c in meta.children:
+        _walk(c, small)

@@ -405,6 +405,25 @@ class Generate(LogicalPlan):
         return f"Generate[{self.generator!r}]"
 
 
+class MapInPandas(LogicalPlan):
+    """Batch-wise pandas transform in a pooled python worker process
+    (reference: GpuMapInPandasExec)."""
+
+    def __init__(self, child: LogicalPlan, fn, schema: Schema):
+        self.child = child
+        self.children = [child]
+        self.fn = fn
+        self._schema = schema
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        name = getattr(self.fn, "__name__", "fn")
+        return f"MapInPandas[{name}]"
+
+
 class Repartition(LogicalPlan):
     def __init__(self, child: LogicalPlan, num_partitions: int,
                  keys: Optional[Sequence[Expression]] = None):

@@ -125,8 +125,10 @@ def _textscan(meta, conv, conf):
 def _project(meta, conv, conf):
     child = conv(meta.children[0])
     n = meta.node
-    if any(b is None for b in n.bound):
-        reason = "; ".join(e for e in n.bind_errors if e)
+    if any(b is None for b in n.bound) or meta.host_reasons:
+        # _tag already copied bind errors into host_reasons; dedupe
+        reason = "; ".join(dict.fromkeys(
+            [e for e in n.bind_errors if e] + meta.host_reasons))
         if not conf.allow_cpu_fallback:
             raise UnsupportedExpr(reason)
         from ..exec.host_fallback import HostProjectExec
@@ -138,11 +140,14 @@ def _project(meta, conv, conf):
 def _filter(meta, conv, conf):
     child = conv(meta.children[0])
     n = meta.node
-    if n.bound is None:
+    if n.bound is None or meta.host_reasons:
+        reason = "; ".join(dict.fromkeys(
+            ([n.bind_error] if n.bind_error else [])
+            + meta.host_reasons))
         if not conf.allow_cpu_fallback:
-            raise UnsupportedExpr(n.bind_error)
+            raise UnsupportedExpr(reason)
         from ..exec.host_fallback import HostFilterExec
-        return HostFilterExec(child, n.condition, n.bind_error)
+        return HostFilterExec(child, n.condition, reason)
     return x.FilterExec(child, n.bound)
 
 
@@ -461,6 +466,13 @@ def _generate(meta, conv, conf):
     return GenerateExec(conv(meta.children[0]), n.bound, n.schema)
 
 
+@_rule(L.MapInPandas)
+def _map_in_pandas(meta, conv, conf):
+    from ..exec.python_exec import ArrowEvalPythonExec
+    n = meta.node
+    return ArrowEvalPythonExec(conv(meta.children[0]), n.fn, n.schema)
+
+
 @_rule(L.Repartition)
 def _repart(meta, conv, conf):
     from ..config import MESH_DEVICES
@@ -487,6 +499,10 @@ class Planner:
         root = optimize(root)
         meta = PlanMeta(root)
         self._tag(meta)
+        from ..config import CBO_ENABLED
+        if self.conf.get(CBO_ENABLED):
+            from .cbo import apply_cbo
+            apply_cbo(meta, self.conf)
         explain_mode = self.conf.explain
         if explain_mode in ("ALL", "NOT_ON_TPU"):
             for line in meta.explain_lines(explain_mode == "NOT_ON_TPU"):

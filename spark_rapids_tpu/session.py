@@ -548,6 +548,26 @@ class DataFrame:
         ks = [_to_expr(k) for k in keys] or None
         return DataFrame(self._session, L.Repartition(self._plan, n, ks))
 
+    def map_in_pandas(self, fn, schema) -> "DataFrame":
+        """Apply `fn(pandas.DataFrame) -> pandas.DataFrame` batch-wise in
+        a pooled python WORKER PROCESS, batches crossing as Arrow IPC
+        (reference: DataFrame.mapInPandas / GpuMapInPandasExec). `fn`
+        must be picklable; `schema` is the output schema
+        (Schema | list[(name, DataType)] | arrow schema)."""
+        from .columnar.table import Field, Schema as _Schema
+        from .columnar import dtypes as _dt
+        if isinstance(schema, _Schema):
+            out = schema
+        elif isinstance(schema, (list, tuple)):
+            out = _Schema([Field(n, t) for n, t in schema])
+        else:  # arrow schema
+            out = _Schema([Field(f.name, _dt.from_arrow(f.type))
+                           for f in schema])
+        return DataFrame(self._session,
+                         L.MapInPandas(self._plan, fn, out))
+
+    mapInPandas = map_in_pandas
+
     def cache(self) -> "DataFrame":
         """Materialize this DataFrame into HBM-resident device batches
         (GpuInMemoryTableScan analog); later queries skip decode + H2D."""

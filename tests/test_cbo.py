@@ -1,0 +1,81 @@
+"""Cost-based device-vs-host placement (reference:
+CostBasedOptimizer.scala + GpuCostModel, default-off)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col
+from spark_rapids_tpu.exec.host_fallback import (HostFilterExec,
+                                                 HostProjectExec)
+from spark_rapids_tpu.exec.nodes import FilterExec, ProjectExec
+
+
+def _nodes(df):
+    root, ctx = df._execute()
+
+    def walk(e):
+        yield e
+        for c in e.children:
+            yield from walk(c)
+
+    return list(walk(root))
+
+
+def _tiny(session_conf):
+    s = st.TpuSession(session_conf)
+    return s.create_dataframe({"a": pa.array([1, 2, 3]),
+                               "b": pa.array([1.5, 2.5, None])})
+
+
+def test_cbo_off_by_default_stays_on_device():
+    df = _tiny({}).select((col("a") + 1).alias("x"))
+    assert any(isinstance(n, ProjectExec) for n in _nodes(df))
+    assert not any(isinstance(n, HostProjectExec) for n in _nodes(df))
+
+
+def test_cbo_routes_tiny_coverable_project_to_host():
+    df = _tiny({"spark.rapids.tpu.sql.optimizer.cbo.enabled": "true"})
+    q = df.select((col("a") + 1).alias("x"), col("b"))
+    nodes = _nodes(q)
+    assert any(isinstance(n, HostProjectExec) for n in nodes)
+    # results still correct through the host path
+    out = q.to_arrow().to_pylist()
+    assert [r["x"] for r in out] == [2, 3, 4]
+
+
+def test_cbo_tiny_filter_to_host_and_correct():
+    df = _tiny({"spark.rapids.tpu.sql.optimizer.cbo.enabled": "true"})
+    q = df.filter(col("a") >= 2)
+    assert any(isinstance(n, HostFilterExec) for n in _nodes(q))
+    assert sorted(r["a"] for r in q.to_arrow().to_pylist()) == [2, 3]
+
+
+def test_cbo_leaves_large_inputs_on_device():
+    rng = np.random.default_rng(1)
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.optimizer.cbo.enabled": "true"})
+    df = s.create_dataframe({"a": pa.array(rng.integers(0, 100, 50_000))})
+    q = df.select((col("a") * 2).alias("x"))
+    nodes = _nodes(q)
+    assert any(isinstance(n, ProjectExec) for n in nodes)
+    assert not any(isinstance(n, HostProjectExec) for n in nodes)
+
+
+def test_cbo_skips_host_uncoverable_exprs():
+    """Expressions without a host rule stay on device even when tiny."""
+    df = _tiny({"spark.rapids.tpu.sql.optimizer.cbo.enabled": "true"})
+    q = df.select(F.hash(col("a")).alias("h"))     # no host murmur3
+    nodes = _nodes(q)
+    assert not any(isinstance(n, HostProjectExec) for n in nodes)
+    assert q.to_arrow().num_rows == 3
+
+
+def test_cbo_selectivity_feeds_estimates():
+    from spark_rapids_tpu.plan.cbo import estimate_rows_selective
+    s = st.TpuSession()
+    df = s.create_dataframe({"a": pa.array(list(range(1000)))})
+    filt = df.filter(col("a") == 5)
+    est = estimate_rows_selective(filt._plan)
+    assert est == pytest.approx(1000 * 0.05)

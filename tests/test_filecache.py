@@ -1,0 +1,61 @@
+"""Local file cache for scan inputs (reference:
+spark.rapids.filecache.enabled, GpuFileCache)."""
+import os
+
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.io.file_cache import FileCache, file_cache
+
+
+def test_hit_miss_and_invalidation(tmp_path):
+    src = tmp_path / "a.parquet"
+    pq.write_table(pa.table({"x": pa.array([1, 2, 3])}), str(src))
+    fc = FileCache(str(tmp_path / "cache"), max_bytes=1 << 20)
+    p1 = fc.local_path(str(src))
+    p2 = fc.local_path(str(src))
+    assert p1 == p2 and os.path.exists(p1)
+    assert fc.metrics == {"hits": 1, "misses": 1, "evictions": 0}
+    # source changes -> new key, miss
+    pq.write_table(pa.table({"x": pa.array([9, 9])}), str(src))
+    os.utime(str(src), ns=(1, 2))       # force distinct mtime
+    p3 = fc.local_path(str(src))
+    assert p3 != p1
+    assert fc.metrics["misses"] == 2
+    assert pq.read_table(p3).column("x").to_pylist() == [9, 9]
+
+
+def test_lru_eviction(tmp_path):
+    fc = FileCache(str(tmp_path / "cache"), max_bytes=6000)
+    paths = []
+    for i in range(4):
+        p = tmp_path / f"f{i}.bin"
+        p.write_bytes(bytes(2000))
+        paths.append(str(p))
+    for p in paths:
+        fc.local_path(p)
+    assert fc.metrics["evictions"] >= 1
+    total = sum(os.path.getsize(os.path.join(fc.dir, n))
+                for n in os.listdir(fc.dir))
+    assert total <= 6000
+
+
+def test_scan_through_cache(tmp_path):
+    src_dir = tmp_path / "data"
+    src_dir.mkdir()
+    t = pa.table({"k": pa.array([1, 2, 3, 4]),
+                  "v": pa.array([1.0, 2.0, 3.0, 4.0])})
+    pq.write_table(t, str(src_dir / "p.parquet"))
+    s = st.TpuSession({
+        "spark.rapids.tpu.filecache.enabled": "true",
+        "spark.rapids.tpu.filecache.dir": str(tmp_path / "fc"),
+    })
+    df = s.read.parquet(str(src_dir))
+    assert df.to_arrow().num_rows == 4
+    fc = file_cache(s.conf)
+    assert fc.metrics["misses"] >= 1
+    before = fc.metrics["hits"]
+    assert s.read.parquet(str(src_dir)).to_arrow().num_rows == 4
+    assert fc.metrics["hits"] > before   # second scan served from cache

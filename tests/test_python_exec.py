@@ -1,0 +1,137 @@
+"""Arrow-IPC python worker execs: mapInPandas through pooled worker
+PROCESSES (reference: GpuMapInPandasExec, PythonWorkerSemaphore), and
+the zero-copy ML handoff (ColumnarRdd / XGBoost-ETL analog)."""
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.expr.expressions import col
+
+
+# module-level (picklable) pandas transforms
+def _double_and_tag(pdf: pd.DataFrame) -> pd.DataFrame:
+    return pd.DataFrame({"k": pdf["k"], "v2": pdf["v"] * 2.0,
+                         "tag": ["x" + str(int(k)) for k in pdf["k"]]})
+
+
+def _drop_all(pdf: pd.DataFrame) -> pd.DataFrame:
+    return pdf.iloc[0:0]
+
+
+def _boom(pdf: pd.DataFrame) -> pd.DataFrame:
+    raise ValueError("python says no")
+
+
+def test_map_in_pandas_end_to_end():
+    rng = np.random.default_rng(12)
+    n = 5000
+    k = rng.integers(0, 9, n)
+    v = rng.normal(0, 1, n)
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 512})
+    df = s.create_dataframe({"k": pa.array(k), "v": pa.array(v)})
+    out = df.map_in_pandas(
+        _double_and_tag,
+        [("k", dt.INT64), ("v2", dt.FLOAT64), ("tag", dt.STRING)])
+    # downstream DEVICE ops still run on the worker output
+    res = out.filter(col("v2") > 0).group_by("tag").agg(
+        F.sum(col("v2")).alias("s")).to_arrow().to_pylist()
+    pdf = pd.DataFrame({"k": k, "v2": v * 2.0,
+                        "tag": ["x" + str(int(x)) for x in k]})
+    exp = pdf[pdf["v2"] > 0].groupby("tag")["v2"].sum()
+    got = {r["tag"]: r["s"] for r in res}
+    assert set(got) == set(exp.index)
+    for t in got:
+        assert got[t] == pytest.approx(exp[t])
+
+
+def test_map_in_pandas_empty_result_batches():
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 64})
+    df = s.create_dataframe({"k": pa.array([1, 2, 3] * 50),
+                             "v": pa.array([0.5] * 150)})
+    out = df.map_in_pandas(_drop_all, [("k", dt.INT64),
+                                       ("v", dt.FLOAT64)]).to_arrow()
+    assert out.num_rows == 0
+
+
+def test_map_in_pandas_error_propagates():
+    s = st.TpuSession()
+    df = s.create_dataframe({"k": pa.array([1]), "v": pa.array([1.0])})
+    with pytest.raises(RuntimeError, match="python says no"):
+        df.map_in_pandas(_boom, [("k", dt.INT64),
+                                 ("v", dt.FLOAT64)]).to_arrow()
+
+
+def test_worker_pool_bounded_and_reused():
+    from spark_rapids_tpu.exec.python_exec import PythonWorkerPool
+    import pyarrow as pa
+
+    pool = PythonWorkerPool(_double_and_tag, max_workers=2)
+    t = pa.table({"k": pa.array([1, 2]), "v": pa.array([1.0, 2.0])})
+    sink = pa.BufferOutputStream()
+    with pa.ipc.new_stream(sink, t.schema) as w:
+        w.write_table(t)
+    blob = sink.getvalue().to_pybytes()
+    import threading
+    results = []
+
+    def go():
+        results.append(pool.run(blob))
+
+    threads = [threading.Thread(target=go) for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(results) == 8
+    assert pool._spawned <= 2          # semaphore bound held
+    pool.close()
+
+
+def test_ml_handoff_to_jax():
+    """The XGBoost-ETL analog (BASELINE.md config #3): ETL on the
+    engine, then zero-copy device handoff via to_jax() into a jax
+    training loop — no arrow round-trip between query and ML."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(5)
+    n = 8000
+    x1 = rng.normal(0, 1, n)
+    x2 = rng.normal(0, 1, n)
+    noise = rng.normal(0, 0.3, n)
+    label = (2.0 * x1 - 1.5 * x2 + noise > 0).astype(np.int64)
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 4096})
+    df = s.create_dataframe({
+        "x1": pa.array(x1), "x2": pa.array(x2),
+        "y": pa.array(label), "junk": pa.array(["z"] * n)})
+    # ETL: filter + project (feature engineering) on device
+    feat = df.filter(F.isnull(col("x1")) == False)  # noqa: E712
+    feat = feat.select(col("x1"), col("x2"),
+                       (col("x1") * col("x2")).alias("x3"),
+                       col("y"))
+    handoff = feat.to_jax()
+    X = jnp.stack([handoff["x1"][0], handoff["x2"][0],
+                   handoff["x3"][0]], axis=1)
+    y = handoff["y"][0].astype(jnp.float64)
+    assert isinstance(X, jax.Array)     # device-resident, no host copy
+
+    def loss(w):
+        logits = X @ w
+        p = jax.nn.sigmoid(logits)
+        eps = 1e-7
+        return -jnp.mean(y * jnp.log(p + eps)
+                         + (1 - y) * jnp.log(1 - p + eps))
+
+    g = jax.jit(jax.grad(loss))
+    w = jnp.zeros(3)
+    l0 = float(loss(w))
+    for _ in range(60):
+        w = w - 0.5 * g(w)
+    l1 = float(loss(w))
+    assert l1 < l0 * 0.6                # training actually converges
+    acc = float(jnp.mean(((X @ w) > 0) == (y > 0.5)))
+    assert acc > 0.85

@@ -1,0 +1,28 @@
+"""Scale-test harness smoke (datagen/ScaleTest.md analog): data
+generation is cached, every query shape runs, and the report carries
+cold/hot timings + throughput."""
+import spark_rapids_tpu  # noqa: F401  (platform forced by conftest)
+from spark_rapids_tpu.workloads.scale_test import QUERIES, run_scale_test
+
+
+def test_scale_harness_smoke(tmp_path):
+    rep = run_scale_test(scale=0.005, data_dir=str(tmp_path),
+                         iterations=2,
+                         queries=["scan_agg", "filter_project",
+                                  "sort_limit"])
+    assert rep["lineitem_rows"] > 1000
+    assert set(rep["queries"]) == {"scan_agg", "filter_project",
+                                   "sort_limit"}
+    for q, r in rep["queries"].items():
+        assert r["hot_s"] > 0 and r["cold_s"] >= r["hot_s"] * 0.5
+        assert r["input_rows_per_sec"] > 0
+        assert r["output_rows"] > 0
+    # second run reuses the generated data (marker present)
+    rep2 = run_scale_test(scale=0.005, data_dir=str(tmp_path),
+                          iterations=1, queries=["scan_agg"])
+    assert rep2["lineitem_rows"] == rep["lineitem_rows"]
+
+
+def test_all_query_shapes_defined():
+    assert set(QUERIES) == {"scan_agg", "filter_project", "join_agg",
+                            "window", "sort_limit"}
