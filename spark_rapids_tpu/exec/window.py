@@ -613,6 +613,13 @@ class WindowExec(TpuExec):
         if scv.offsets is not None:
             raise UnsupportedExpr(f"window {w.fn} over strings")
         x = scv.data
+        if w.fn == "count":
+            # count reads validity only: a dummy 1-D value keeps 2-limb
+            # decimal inputs off the value math entirely
+            x = jnp.zeros(cap, jnp.int8)
+        elif x.ndim == 2 or (isinstance(w.dtype, dt.DecimalType)
+                             and w.dtype.is_decimal128):
+            return self._one_d128(w, wc, scv, live)
         acc_dt = (jnp.float64 if jnp.issubdtype(x.dtype, jnp.floating)
                   else jnp.int64)
         xz = jnp.where(valid, x, 0).astype(acc_dt)
@@ -666,6 +673,133 @@ class WindowExec(TpuExec):
         empty = hi < lo
         c = jnp.where(empty, 0, c)
         return self._finish(w, s, c, live)
+
+    def _one_d128(self, w, wc, scv, live):
+        """Decimal128 window aggregates via LIMB arithmetic: values are
+        four 32-bit limbs in int64 lanes, per-limb segmented prefix
+        sums stay exact (cap * 2^32 < 2^63) and ONE carry-propagation
+        pass per output recovers the two's-complement 128-bit value —
+        no data-dependent loops, everything rides the same scans as the
+        64-bit path (reference: GpuWindowExec decimal windows over cuDF
+        DECIMAL128 columns)."""
+        from ..ops.decimal128 import (combine_limb_sums,
+                                      split_d128_limbs, split_i64_limbs)
+        valid = scv.validity & live
+        frame = w.spec.frame
+        mode = w.spec.frame_mode
+        seg_ids, pos, cap = wc["seg_ids"], wc["pos"], wc["cap"]
+        seg_start = wc["seg_start"]
+        x = scv.data
+        if x.ndim == 2:
+            limbs = split_d128_limbs(x)      # top limb SIGNED: per-limb
+        else:                                # prefix sums stay exact
+            limbs = split_i64_limbs(x.astype(jnp.int64))
+        lz = [jnp.where(valid, l, 0) for l in limbs]
+        vz = valid.astype(jnp.int64)
+
+        def finish_sum(slimbs, c):
+            # exact reconstruction + true overflow (no 2^128 wrap):
+            # the grouped Sum path's combine_limb_sums, per output row
+            prec = (38 if w.fn == "avg" else w.dtype.precision)
+            packed, ovf = combine_limb_sums(slimbs, prec)
+            ok = live & (c > 0) & ~ovf
+            packed = jnp.where(ok[:, None], packed, 0)
+            if w.fn == "avg":
+                f = (packed[:, 1].astype(jnp.float64) * (2.0 ** 64)
+                     + jnp.where(packed[:, 0] < 0,
+                                 packed[:, 0].astype(jnp.float64)
+                                 + 2.0 ** 64,
+                                 packed[:, 0].astype(jnp.float64)))
+                scale = 10.0 ** w.child.dtype.scale
+                safe = jnp.maximum(c, 1).astype(jnp.float64)
+                return CV(f / safe / scale, ok)
+            return CV(packed, ok)
+
+        def minmax_whole(is_min):
+            # lexicographic (hi signed, lo unsigned) in two passes
+            hi_id = (jnp.iinfo(jnp.int64).max if is_min
+                     else jnp.iinfo(jnp.int64).min)
+            hi_v = jnp.where(valid, x[:, 1], hi_id)
+            red = jax.ops.segment_min if is_min else jax.ops.segment_max
+            mhi = red(hi_v, seg_ids, cap)[seg_ids]
+            lo_u = x[:, 0] ^ jnp.int64(-2 ** 63)   # unsigned order
+            lo_id = (jnp.iinfo(jnp.int64).max if is_min
+                     else jnp.iinfo(jnp.int64).min)
+            lo_v = jnp.where(valid & (x[:, 1] == mhi), lo_u, lo_id)
+            mlo = red(lo_v, seg_ids, cap)[seg_ids] ^ jnp.int64(-2 ** 63)
+            return jnp.stack([mlo, mhi], axis=-1)
+
+        if frame == (UNBOUNDED, UNBOUNDED):
+            if w.fn in ("sum", "avg"):
+                s4 = [jax.ops.segment_sum(l, seg_ids, cap)[seg_ids]
+                      for l in lz]
+                c = jax.ops.segment_sum(vz, seg_ids, cap)[seg_ids]
+                return finish_sum(s4, c)
+            packed = minmax_whole(w.fn == "min")
+            c = jax.ops.segment_sum(vz, seg_ids, cap)[seg_ids]
+            ok = live & (c > 0)
+            return CV(jnp.where(ok[:, None], packed, 0), ok)
+
+        if frame == (UNBOUNDED, CURRENT_ROW):
+            at = (wc["peer_end"] if mode == "range" else pos)
+            if w.fn in ("sum", "avg"):
+                s4 = [_running(l, seg_start)[at] for l in lz]
+                c = _running(vz, seg_start)[at]
+                return finish_sum(s4, c)
+            packed = self._d128_scan_minmax(x, valid, wc["pb"],
+                                            w.fn == "min")[at]
+            c = _running(vz, seg_start)[at]
+            ok = live & (c > 0)
+            return CV(jnp.where(ok[:, None], packed, 0), ok)
+
+        # general bounded frame: prefix-difference per limb (signed
+        # diffs normalize exactly); bounded min/max needs a two-limb
+        # RMQ — not yet
+        if w.fn in ("min", "max"):
+            raise UnsupportedExpr(
+                f"bounded-frame window {w.fn} over decimal precision "
+                f"> 18 (cast to double or a narrower decimal first)")
+        lo_b, hi_b, _ = self._frame_bounds(w, wc)
+        lo_idx = jnp.clip(lo_b - 1, 0, cap - 1)
+        hi_idx = jnp.clip(hi_b, 0, cap - 1)
+        s4 = []
+        for l in lz:
+            pre = jnp.cumsum(l)
+            s4.append(pre[hi_idx]
+                      - jnp.where(lo_b > 0, pre[lo_idx], 0))
+        prev = jnp.cumsum(vz)
+        c = prev[hi_idx] - jnp.where(lo_b > 0, prev[lo_idx], 0)
+        c = jnp.where(hi_b < lo_b, 0, c)
+        s4 = [jnp.where(hi_b < lo_b, 0, s) for s in s4]
+        return finish_sum(s4, c)
+
+    @staticmethod
+    def _d128_scan_minmax(x2, valid, boundary, is_min: bool):
+        """Segmented running min/max over [cap,2] decimal128 via an
+        associative scan on (flag, lo, hi) with lexicographic
+        (hi signed, lo unsigned) compare."""
+        hi_id = (jnp.iinfo(jnp.int64).max if is_min
+                 else jnp.iinfo(jnp.int64).min)
+        lo_id = jnp.int64(-1) if is_min else jnp.int64(0)
+        lo = jnp.where(valid, x2[:, 0], lo_id)
+        hi = jnp.where(valid, x2[:, 1], hi_id)
+
+        def lt(al, ah, bl, bh):
+            ul = al ^ jnp.int64(-2 ** 63)
+            vl = bl ^ jnp.int64(-2 ** 63)
+            return (ah < bh) | ((ah == bh) & (ul < vl))
+
+        def combine(a, b):
+            af, al, ah = a
+            bf, bl, bh = b
+            a_wins = lt(al, ah, bl, bh) if is_min else lt(bl, bh, al, ah)
+            out_l = jnp.where(bf, bl, jnp.where(a_wins, al, bl))
+            out_h = jnp.where(bf, bh, jnp.where(a_wins, ah, bh))
+            return (af | bf, out_l, out_h)
+
+        _, sl, sh = jax.lax.associative_scan(
+            combine, (boundary, lo, hi))
+        return jnp.stack([sl, sh], axis=-1)
 
     def _finish(self, w, s, c, live):
         if w.fn == "count":

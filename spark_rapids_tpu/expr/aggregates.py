@@ -806,6 +806,105 @@ class ApproxCountDistinct(AggExpr):
         return f"approx_count_distinct({self.child}, rsd={self.rsd})"
 
 
+class BloomFilterAggregate(AggExpr):
+    """bloom_filter_agg: builds an m-bit Bloom filter over the input
+    (reference: GpuBloomFilterAggregate.scala + JNI BloomFilter kernels
+    — there the filter feeds InSubqueryExec runtime filtering; here the
+    companion expression is BloomFilterMightContain).
+
+    TPU-first layout: the filter lives as ONE device bool vector of
+    num_bits (update is a scatter of k=hash positions per row — no
+    byte-packing in the hot loop); finalize packs little-endian bytes
+    (BinaryType), 'k|num_bits' prefixed, which BloomFilterMightContain
+    unpacks back to a device vector. Hash scheme: two 32-bit murmur3
+    passes (seed 0 / seed 0x97B3AA8C) combine as h1 + i*h2 like Spark's
+    split-64 scheme. Ungrouped only, matching Spark (the agg returns
+    ONE filter for the build side)."""
+
+    state_reducers = None            # grouped path unsupported
+
+    def __init__(self, child, estimated_items: int = 1_000_000,
+                 num_bits: int = None):
+        super().__init__(child)
+        if num_bits is None:
+            # Spark default sizing: ~8 bits/item
+            num_bits = max(64, int(estimated_items) * 8)
+        # cap below 2^31: positions are int32 on device, and Spark caps
+        # runtime.bloomFilter.maxNumBits similarly
+        num_bits = min(int(num_bits), 1 << 30)
+        self.num_bits = 1 << max(6, int(num_bits - 1).bit_length())
+        self.k = 5
+
+    def bind(self, schema):
+        b = type(self)(self.child.bind(schema), num_bits=self.num_bits)
+        b._resolve_type()
+        return b
+
+    def _resolve_type(self):
+        ct = self.child.dtype
+        if ct.is_nested:
+            raise UnsupportedExpr("bloom_filter_agg over nested input")
+        self.dtype = dt.BINARY
+
+    def _positions(self, cv: CV, mask):
+        from ..ops.hash import murmur3_cv
+        h1 = murmur3_cv(cv, self.child.dtype, jnp.int32(0)) \
+            .astype(jnp.uint32)
+        h2 = murmur3_cv(cv, self.child.dtype,
+                        jnp.int32(-1749833076)).astype(jnp.uint32)
+        valid = mask & cv.validity
+        m = jnp.uint32(self.num_bits)
+        idxs = []
+        for i in range(self.k):
+            p = (h1 + jnp.uint32(i) * h2) % m
+            # dead rows park on bit 0 of a scratch... route them to a
+            # real position but masked out via where below
+            idxs.append(jnp.where(valid, p.astype(jnp.int32), -1))
+        return idxs
+
+    def update(self, cv: CV, mask):
+        # dead rows route to a SACRIFICIAL slot (num_bits) rather than
+        # clipping onto bit 0 — a duplicate-index scatter .set() picks
+        # arbitrarily, so a dead row's False could clobber a real True
+        bits = jnp.zeros(self.num_bits + 1, jnp.bool_)
+        for p in self._positions(cv, mask):
+            tgt = jnp.where(p >= 0, p, self.num_bits)
+            bits = bits.at[tgt].set(True)
+        return (bits[:self.num_bits],)
+
+    def merge(self, s1, s2):
+        return (s1[0] | s2[0],)
+
+    def finalize(self, s):
+        # pack bool bits -> little-endian uint8 bytes on device and emit
+        # as ONE BinaryType value: 'BF1|k|num_bits|' + packed
+        import numpy as np
+        bits = s[0].reshape(-1, 8).astype(jnp.uint8)
+        shifts = jnp.arange(8, dtype=jnp.uint8)
+        packed = jnp.sum(bits << shifts, axis=1).astype(jnp.uint8)
+        head = np.frombuffer(
+            f"BF1|{self.k}|{self.num_bits}|".encode(), np.uint8)
+        data = jnp.concatenate([jnp.asarray(head), packed])
+        off = jnp.array([0, data.shape[0]], jnp.int32)
+        v = CV(data, jnp.ones(1, jnp.bool_), off)
+        return v, jnp.bool_(True)
+
+    def __repr__(self):
+        return f"bloom_filter_agg({self.child}, bits={self.num_bits})"
+
+
+def parse_bloom_filter(blob: bytes):
+    """'BF1|k|num_bits|'-prefixed packed filter -> (k, num_bits,
+    numpy bool bit vector)."""
+    import numpy as np
+    if not blob.startswith(b"BF1|"):
+        raise ValueError("not a bloom filter payload")
+    _, k, m, rest = blob.split(b"|", 3)
+    bits = np.unpackbits(np.frombuffer(rest, np.uint8),
+                         bitorder="little")
+    return int(k), int(m), bits.astype(bool)
+
+
 class Percentile(_Collect):
     """percentile / percentile_approx / median over the segmented value
     sort: values of each group are contiguous and ordered after the

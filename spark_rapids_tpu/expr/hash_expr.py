@@ -11,7 +11,54 @@ from ..ops.hash import murmur3_row_hash
 from ..ops.kernel_utils import CV
 from .expressions import Expression
 
-__all__ = ["Murmur3Hash"]
+__all__ = ["Murmur3Hash", "BloomFilterMightContain"]
+
+
+class BloomFilterMightContain(Expression):
+    """might_contain(filter, value): membership probe against a
+    bloom_filter_agg result (reference: GpuBloomFilterMightContain.scala
+    — there driving InSubqueryExec runtime join filtering). The filter
+    must be FOLDABLE (a binary literal, like Spark's scalar-subquery
+    result): its bit vector unpacks once at bind and rides the jitted
+    probe as a device constant; k positions (h1 + i*h2 murmur3 scheme,
+    matching BloomFilterAggregate) must all be set."""
+
+    def __init__(self, filter_expr: Expression, value: Expression):
+        self.filter_expr = filter_expr
+        self.value = value
+        self.children = [filter_expr, value]
+
+    def bind(self, schema):
+        from .expressions import Literal, UnsupportedExpr
+        f = self.filter_expr.bind(schema)
+        v = self.value.bind(schema)
+        if not isinstance(f, Literal) or not isinstance(f.value, bytes):
+            raise UnsupportedExpr(
+                "might_contain requires a foldable binary filter "
+                "(collect bloom_filter_agg first)")
+        from .aggregates import parse_bloom_filter
+        b = BloomFilterMightContain(f, v)
+        b._k, b._m, bits = parse_bloom_filter(f.value)
+        b._bits = jnp.asarray(bits)
+        b.dtype = dt.BOOL
+        return b
+
+    def emit(self, ctx):
+        from ..ops.hash import murmur3_cv
+        cv = self.value.emit(ctx)
+        h1 = murmur3_cv(cv, self.value.dtype, jnp.int32(0)) \
+            .astype(jnp.uint32)
+        h2 = murmur3_cv(cv, self.value.dtype,
+                        jnp.int32(-1749833076)).astype(jnp.uint32)
+        m = jnp.uint32(self._m)
+        hit = jnp.ones(ctx.capacity, jnp.bool_)
+        for i in range(self._k):
+            pos = ((h1 + jnp.uint32(i) * h2) % m).astype(jnp.int32)
+            hit = hit & self._bits[pos]
+        return CV(hit, cv.validity)
+
+    def __repr__(self):
+        return f"might_contain(<filter>, {self.value})"
 
 
 class Murmur3Hash(Expression):

@@ -54,6 +54,22 @@ def percentile(e, percentages, frequency=1):
     return _agg.Percentile(_to_expr(e), percentages)
 
 
+def bloom_filter_agg(e, estimated_items: int = 1_000_000,
+                     num_bits: int = None):
+    """Builds a Bloom filter over the column (reference:
+    GpuBloomFilterAggregate); returns BinaryType. Probe with
+    might_contain."""
+    return _agg.BloomFilterAggregate(_to_expr(e), estimated_items,
+                                     num_bits)
+
+
+def might_contain(filter_e, value_e):
+    """Membership probe against a bloom_filter_agg result (reference:
+    GpuBloomFilterMightContain)."""
+    from .expr.hash_expr import BloomFilterMightContain
+    return BloomFilterMightContain(_to_expr(filter_e), _to_expr(value_e))
+
+
 def percentile_approx(e, percentages, accuracy: int = 10000):
     return _agg.ApproxPercentile(_to_expr(e), percentages, accuracy)
 

@@ -157,6 +157,12 @@ for _n in ("CollectList", "CollectSet"):
     register(_n, ALL_COMMON,
              "aggregate -> array<T>; requires GROUP BY (sort-collect)")
 
+register("BloomFilterAggregate", ALL_COMMON,
+         "Bloom filter build, fixed num_bits bit-vector state "
+         "(ungrouped; reference GpuBloomFilterAggregate)")
+register("BloomFilterMightContain", ALL_COMMON,
+         "membership probe against a foldable bloom_filter_agg result")
+
 # -- datetime fields / arithmetic ---------------------------------------
 DATE = TypeSig(dt.DateType)
 TS = TypeSig(dt.TimestampType)

@@ -128,15 +128,16 @@ class WindowExpr(Expression):
                            frame, mode),
                        self.offset, self.default)
         from .columnar import dtypes as dt
-        if (self.fn in ("sum", "min", "max", "avg", "count")
-                and b.child is not None
-                and getattr(b.child.dtype, "is_decimal128", False)):
-            # the window scan machinery is single-limb; two-limb
-            # decimal128 state is future work — reject at plan time
-            # instead of corrupt limb arithmetic at runtime
+        if (self.fn in ("min", "max") and b.child is not None
+                and getattr(b.child.dtype, "is_decimal128", False)
+                and b.spec.frame not in ((UNBOUNDED, UNBOUNDED),
+                                         (UNBOUNDED, CURRENT_ROW))):
+            # limb scans cover whole-partition + running frames; a
+            # bounded-frame decimal128 min/max needs a two-limb RMQ
             raise UnsupportedExpr(
-                f"window {self.fn} over decimal precision > 18 "
-                f"(cast to double or a narrower decimal first)")
+                f"bounded-frame window {self.fn} over decimal "
+                f"precision > 18 (cast to double or a narrower "
+                f"decimal first)")
         if self.fn in self.RANKING:
             if not b.spec.orders:
                 raise UnsupportedExpr(f"{self.fn} requires ORDER BY")
@@ -154,12 +155,6 @@ class WindowExpr(Expression):
             proto = {"sum": Sum, "min": Min, "max": Max}[self.fn](b.child)
             proto._resolve_type()
             b.dtype = proto.dtype
-            if getattr(b.dtype, "is_decimal128", False):
-                # d64 input whose RESULT widens past 18 digits (sum)
-                raise UnsupportedExpr(
-                    f"window {self.fn} over decimal with result "
-                    f"precision > 18 (cast to double or a narrower "
-                    f"decimal first)")
         return b
 
     @property

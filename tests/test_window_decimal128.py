@@ -1,0 +1,137 @@
+"""Decimal128 window aggregates via limb scans (round 4; reference:
+GpuWindowExec over cuDF DECIMAL128): exact running/whole-partition
+sum/min/max/avg/count and bounded-frame sums, validated against Python
+Decimal arithmetic."""
+from decimal import Decimal
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.expr.expressions import col
+from spark_rapids_tpu.window import (Window, win_avg, win_count, win_max,
+                                     win_min, win_sum)
+
+
+@pytest.fixture(scope="module")
+def data():
+    rng = np.random.default_rng(23)
+    n = 600
+    k = rng.integers(0, 4, n)
+    # ~20-digit magnitudes: far past int64, exercises both limbs, signed
+    vals = [Decimal(int(rng.integers(-10**18, 10**18)) * 17) / 100
+            for _ in range(n)]
+    vals = [None if i % 11 == 0 else v for i, v in enumerate(vals)]
+    o = rng.permutation(n)
+    return k, o, vals
+
+
+def _rows(k, o, vals):
+    return sorted(((int(kk), int(oo), v) for kk, oo, v in
+                   zip(k, o, vals)), key=lambda t: (t[0], t[1]))
+
+
+def test_running_sum_min_max_exact(data):
+    k, o, vals = data
+    s = st.TpuSession()
+    df = s.create_dataframe({
+        "k": pa.array(k), "o": pa.array(o),
+        "v": pa.array(vals, pa.decimal128(23, 2))})
+    w = Window.partition_by("k").order_by("o")
+    out = df.select(
+        col("k"), col("o"),
+        win_sum(col("v")).over(w).alias("rs"),
+        win_min(col("v")).over(w).alias("rm"),
+        win_max(col("v")).over(w).alias("rx"),
+        win_count(col("v")).over(w).alias("rc"),
+    ).to_arrow().to_pylist()
+    got = {(r["k"], r["o"]): r for r in out}
+    run = {}
+    for kk, oo, v in _rows(k, o, vals):
+        ssum, smin, smax, cnt = run.get(kk, (Decimal(0), None, None, 0))
+        if v is not None:
+            ssum += v
+            smin = v if smin is None else min(smin, v)
+            smax = v if smax is None else max(smax, v)
+            cnt += 1
+        run[kk] = (ssum, smin, smax, cnt)
+        g = got[(kk, oo)]
+        assert g["rc"] == cnt
+        if cnt == 0:
+            assert g["rs"] is None and g["rm"] is None
+            continue
+        assert g["rs"] == ssum, (kk, oo, g["rs"], ssum)
+        assert g["rm"] == smin and g["rx"] == smax
+
+
+def test_whole_partition_and_avg(data):
+    k, o, vals = data
+    s = st.TpuSession()
+    df = s.create_dataframe({
+        "k": pa.array(k), "o": pa.array(o),
+        "v": pa.array(vals, pa.decimal128(23, 2))})
+    w = Window.partition_by("k")        # unordered -> whole partition
+    out = df.select(
+        col("k"),
+        win_sum(col("v")).over(w).alias("ts"),
+        win_avg(col("v")).over(w).alias("ta"),
+    ).to_arrow().to_pylist()
+    exp = {}
+    for kk, _, v in _rows(k, o, vals):
+        t, c = exp.get(kk, (Decimal(0), 0))
+        if v is not None:
+            t, c = t + v, c + 1
+        exp[kk] = (t, c)
+    for r in out:
+        t, c = exp[r["k"]]
+        assert r["ts"] == t
+        assert r["ta"] == pytest.approx(float(t / c), rel=1e-12)
+
+
+def test_bounded_frame_sum(data):
+    k, o, vals = data
+    s = st.TpuSession()
+    df = s.create_dataframe({
+        "k": pa.array(k), "o": pa.array(o),
+        "v": pa.array(vals, pa.decimal128(23, 2))})
+    w = Window.partition_by("k").order_by("o").rows_between(-2, 0)
+    out = df.select(col("k"), col("o"),
+                    win_sum(col("v")).over(w).alias("s3")
+                    ).to_arrow().to_pylist()
+    got = {(r["k"], r["o"]): r["s3"] for r in out}
+    per_key = {}
+    for kk, oo, v in _rows(k, o, vals):
+        per_key.setdefault(kk, []).append((oo, v))
+    for kk, rows in per_key.items():
+        for i, (oo, _) in enumerate(rows):
+            window_vals = [v for _, v in rows[max(0, i - 2):i + 1]
+                           if v is not None]
+            exp = sum(window_vals, Decimal(0)) if window_vals else None
+            assert got[(kk, oo)] == exp, (kk, oo)
+
+
+def test_d64_sum_widening_to_d128():
+    """sum over decimal(15,2) widens to decimal(25,2): the limb path
+    sign-extends the 64-bit input."""
+    s = st.TpuSession()
+    vals = [Decimal("9999999999999.99"), Decimal("-0.01"),
+            Decimal("8888888888888.88")]
+    df = s.create_dataframe({
+        "k": pa.array([1, 1, 1]), "o": pa.array([1, 2, 3]),
+        "v": pa.array(vals, pa.decimal128(15, 2))})
+    w = Window.partition_by("k").order_by("o")
+    out = df.select(win_sum(col("v")).over(w).alias("rs")) \
+        .to_arrow().column("rs").to_pylist()
+    assert out == [vals[0], vals[0] + vals[1],
+                   vals[0] + vals[1] + vals[2]]
+
+
+def test_bounded_minmax_d128_still_rejected():
+    s = st.TpuSession({"spark.rapids.tpu.sql.allowCpuFallback": "false"})
+    df = s.create_dataframe({
+        "k": pa.array([1]), "o": pa.array([1]),
+        "v": pa.array([Decimal("1.00")], pa.decimal128(23, 2))})
+    w = Window.partition_by("k").order_by("o").rows_between(-1, 0)
+    with pytest.raises(Exception, match="bounded-frame"):
+        df.select(win_min(col("v")).over(w).alias("m")).to_arrow()
