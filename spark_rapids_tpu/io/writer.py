@@ -119,6 +119,13 @@ class DataFrameWriter:
 
         return self._write(path, wfn, "txt")
 
+    def iceberg(self, path: str):
+        from .iceberg_write import write_iceberg
+        if self._partition_by:
+            raise NotImplementedError(
+                "partitionBy is not supported for iceberg writes yet")
+        return write_iceberg(self._df, path, mode=self._mode)
+
     def delta(self, path: str):
         from .delta import write_delta
         exists = os.path.exists(os.path.join(path, "_delta_log"))
