@@ -175,6 +175,12 @@ LORE_DUMP_IDS = _conf(
 LORE_DUMP_PATH = _conf(
     "sql.lore.dumpPath", "/tmp/srtpu-lore",
     "Directory for LORE operator dumps.", str)
+DELTA_DV_ENABLED = _conf(
+    "delta.deletionVectors.enabled", False,
+    "DELETE writes a deletion-vector (roaring bitmap) file marking "
+    "dead rows instead of rewriting the data file (reference: Delta "
+    "DV support in delta-33x GpuDeltaParquetFileFormat/GpuDeleteCommand"
+    "). Reads apply DVs regardless of this flag.", bool)
 FILECACHE_ENABLED = _conf(
     "filecache.enabled", False,
     "Cache scan input files on local disk, keyed by (path, mtime, "

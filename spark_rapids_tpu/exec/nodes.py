@@ -132,11 +132,16 @@ class ParquetScanExec(TpuExec):
 
     def __init__(self, paths: Sequence[str], schema: Schema,
                  columns: Optional[Sequence[str]] = None,
-                 filters=None):
+                 filters=None, dv=None):
         super().__init__([], schema)
         self.paths = list(paths)
         self.columns = list(columns) if columns else None
         self.filters = list(filters) if filters else None
+        # {path: (table_root, deletionVector descriptor)} — dead-row
+        # masks applied lazily per batch (Delta DVs); loaded once per
+        # file at exec time, never at plan construction
+        self.dv = dict(dv) if dv else None
+        self._dv_cache = {}
         self._groups_cache = None
 
     def _reader_type(self, ctx) -> str:
@@ -203,6 +208,18 @@ class ParquetScanExec(TpuExec):
         f = f", filters={self.filters}" if self.filters else ""
         return f"ParquetScanExec[{len(self.paths)} files{f}]"
 
+    def _dead_positions(self, path):
+        """Dead row set for a DV-carrying file (cached per exec)."""
+        if self.dv is None or path not in self.dv:
+            return None
+        got = self._dv_cache.get(path)
+        if got is None:
+            from ..io.dv import load_dv_positions
+            root, desc = self.dv[path]
+            got = set(load_dv_positions(root, desc))
+            self._dv_cache[path] = got
+        return got
+
     def _decoded_batches(self, ctx, path, m):
         import pyarrow as pa
         import pyarrow.parquet as pq
@@ -211,7 +228,9 @@ class ParquetScanExec(TpuExec):
         pf = pq.ParquetFile(cached_local_path(path, ctx.conf))
         cols = (self.columns if self.columns is not None
                 else [f.name for f in self.schema.fields])
-        if self.filters:
+        dead = self._dead_positions(path)
+        # row-group pruning would shift file-row positions under a DV
+        if self.filters and dead is None:
             kept = prune_row_groups(pf, self.filters)
             m.add("skippedRowGroups",
                   pf.metadata.num_row_groups - len(kept))
@@ -221,8 +240,19 @@ class ParquetScanExec(TpuExec):
                                  row_groups=kept)
         else:
             it = pf.iter_batches(batch_size=per, columns=cols)
+        off = 0
         for rb in it:
-            yield pa.table(rb)
+            at = pa.table(rb)
+            if dead is not None:
+                from ..io.dv import apply_dv_to_table
+                n0 = at.num_rows
+                batch_dead = {d - off for d in dead
+                              if off <= d < off + n0}
+                at = apply_dv_to_table(at, batch_dead)
+                off += n0
+                if at.num_rows == 0:
+                    continue
+            yield at
 
     def execute_partition(self, ctx, pid) -> Iterator[DeviceBatch]:
         from ..config import (CLUSTER_EXECUTORS,
@@ -236,7 +266,8 @@ class ParquetScanExec(TpuExec):
             return
         path = self.paths[pid]
         if (ctx.conf.get(CLUSTER_EXECUTORS) > 0
-                and ctx.session is not None):
+                and ctx.session is not None
+                and not (self.dv and path in self.dv)):
             # driver/executor split: host decode runs in an executor
             # process, Arrow IPC ships back (cluster/driver.py)
             cm = ctx.session.cluster_manager()
@@ -295,13 +326,18 @@ class ParquetScanExec(TpuExec):
 
         def read_one(p):
             pf = pq.ParquetFile(cached_local_path(p, ctx.conf))
-            if self.filters:
+            dead = self._dead_positions(p)
+            if self.filters and dead is None:
                 kept = prune_row_groups(pf, self.filters)
                 skipped = pf.metadata.num_row_groups - len(kept)
                 if not kept:
                     return None, skipped
                 return pf.read_row_groups(kept, columns=cols), skipped
-            return pf.read(columns=cols), 0
+            at = pf.read(columns=cols)
+            if dead is not None:
+                from ..io.dv import apply_dv_to_table
+                at = apply_dv_to_table(at, dead)
+            return at, 0
 
         nthreads = max(1, ctx.conf.get(MULTITHREADED_READ_THREADS))
         with ThreadPoolExecutor(max_workers=nthreads) as pool:

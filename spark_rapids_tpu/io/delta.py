@@ -100,21 +100,28 @@ class DeltaTable:
     def _actions(self, version: int) -> List[dict]:
         return self._replay_actions(version)
 
-    def snapshot_files(self, version: Optional[int] = None) -> List[str]:
-        """Live data files at a version (add minus remove)."""
+    def snapshot_adds(self, version: Optional[int] = None) -> List[dict]:
+        """Live add actions at a version (add minus remove; a re-add of
+        the same path — e.g. attaching a deletion vector — replaces the
+        earlier entry)."""
         latest = self.latest_version()
         if latest < 0:
             raise FileNotFoundError(f"not a delta table: {self.path}")
         v = latest if version is None else version
         if v > latest:
             raise ValueError(f"version {v} > latest {latest}")
-        live: Dict[str, bool] = {}
+        live: Dict[str, dict] = {}
         for a in self._actions(v):
             if "add" in a:
-                live[a["add"]["path"]] = True
+                live[a["add"]["path"]] = a["add"]
             elif "remove" in a:
                 live.pop(a["remove"]["path"], None)
-        return [os.path.join(self.path, p) for p in live]
+        return list(live.values())
+
+    def snapshot_files(self, version: Optional[int] = None) -> List[str]:
+        """Live data file paths at a version."""
+        return [os.path.join(self.path, a["path"])
+                for a in self.snapshot_adds(version)]
 
     def try_commit(self, actions: List[dict], version: int) -> bool:
         """Optimistic commit of a SPECIFIC version: atomically create the
@@ -190,13 +197,37 @@ def write_delta(df, path: str, mode: str = "append"):
 
 
 def read_delta(session, path: str, version: Optional[int] = None):
-    """Read a delta table snapshot (optionally time travel)."""
-    from ..plan.logical import ParquetScan
+    """Read a delta table snapshot (optionally time travel). Files
+    carrying deletion vectors host-filter their dead positions (the
+    reference applies DVs as row filters in
+    GpuDeltaParquetFileFormat)."""
+    from ..plan.logical import InMemoryScan, ParquetScan, Union
     from ..session import DataFrame
-    files = DeltaTable(path).snapshot_files(version)
-    if not files:
+    table = DeltaTable(path)
+    adds = table.snapshot_adds(version)
+    if not adds:
         raise ValueError(f"delta table {path} has no live files")
-    return DataFrame(session, ParquetScan(files))
+    plain = [os.path.join(path, a["path"]) for a in adds
+             if not a.get("deletionVector")]
+    with_dv = [a for a in adds if a.get("deletionVector")]
+    if not with_dv:
+        return DataFrame(session, ParquetScan(plain))
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from .dv import read_dv_file
+    tables = []
+    for a in with_dv:
+        dv = a["deletionVector"]
+        dv_path = os.path.join(path, dv["pathOrInlineDv"])
+        dead = set(read_dv_file(dv_path, dv.get("offset", 1)))
+        t = pq.read_table(os.path.join(path, a["path"]))
+        keep = [i for i in range(t.num_rows) if i not in dead]
+        tables.append(t.take(pa.array(keep, type=pa.int64())))
+    dv_tbl = pa.concat_tables(tables)
+    if not plain:
+        return DataFrame(session, InMemoryScan(dv_tbl))
+    return DataFrame(session, Union([
+        ParquetScan(plain), InMemoryScan(dv_tbl)]))
 
 
 # ----------------------------------------------------------------------
@@ -215,6 +246,25 @@ def _write_rows(session, at, path: str) -> Optional[dict]:
                     "size": os.path.getsize(os.path.join(path, fname)),
                     "modificationTime": int(time.time() * 1000),
                     "dataChange": True}}
+
+
+def _file_df(session, table: "DeltaTable", add: dict):
+    """DataFrame over ONE live file with its deletion vector (if any)
+    applied — DML rewrites must not resurrect DV-dead rows."""
+    fpath = os.path.join(table.path, add["path"])
+    dv = add.get("deletionVector")
+    if not dv:
+        return session.read.parquet(fpath)
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from .dv import read_dv_file
+    dead = set(read_dv_file(
+        os.path.join(table.path, dv["pathOrInlineDv"]),
+        dv.get("offset", 1)))
+    t = pq.read_table(fpath)
+    keep = [i for i in range(t.num_rows) if i not in dead]
+    return session.create_dataframe(t.take(
+        pa.array(keep, type=pa.int64())))
 
 
 def _remove_action(f: str) -> dict:
@@ -238,18 +288,29 @@ def _commit_dml(table: DeltaTable, build_actions, op: str) -> int:
 
 
 def delete_delta(session, path: str, condition) -> int:
-    """DELETE FROM <path> WHERE condition. Returns the new version."""
+    """DELETE FROM <path> WHERE condition. Returns the new version.
+    With delta.deletionVectors.enabled, matching files get a roaring-
+    bitmap DV marking dead rows instead of a rewrite (the descriptor's
+    pathOrInlineDv is table-relative with storageType 'p')."""
     table = DeltaTable(path)
 
+    from ..config import DELTA_DV_ENABLED
     from ..expr.expressions import IsNull, Not, Or
+    use_dv = session.conf.get(DELTA_DV_ENABLED)
 
     def build():
         actions: List[dict] = []
         keep_cond = Or(Not(condition), IsNull(condition))  # NULL -> keep
-        for f in table.snapshot_files():
-            df = session.read.parquet(f)
-            n_match = df.filter(condition).count()
-            if n_match == 0:
+        for a in table.snapshot_adds():
+            f = os.path.join(path, a["path"])
+            if use_dv:
+                # ONE read + ONE predicate evaluation per file: the hit
+                # positions drive both the skip decision and the DV
+                actions.extend(_dv_delete_actions(session, table, a, f,
+                                                  condition))
+                continue
+            df = _file_df(session, table, a)
+            if df.filter(condition).count() == 0:
                 continue        # untouched file, no rewrite
             kept = df.filter(keep_cond)
             actions.append(_remove_action(f))
@@ -261,17 +322,51 @@ def delete_delta(session, path: str, condition) -> int:
     return _commit_dml(table, build, "DELETE")
 
 
+def _dv_delete_actions(session, table, add, fpath, condition):
+    """Re-add `add` with a deletion vector covering old + new dead
+    rows; no new hits -> no actions; a fully-dead file becomes a plain
+    remove."""
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    from .dv import load_dv_positions, write_dv_file
+    t = pq.read_table(fpath)
+    old_dead = set()
+    dv0 = add.get("deletionVector")
+    if dv0:
+        old_dead = set(load_dv_positions(table.path, dv0))
+    t2 = t.append_column("__pos", pa.array(range(t.num_rows),
+                                           pa.int64()))
+    hits = session.create_dataframe(t2).filter(condition) \
+        .to_arrow().column("__pos").to_pylist()
+    if not set(hits) - old_dead:
+        return []                          # nothing newly dead
+    dead = old_dead | set(hits)
+    if len(dead) >= t.num_rows:
+        return [_remove_action(fpath)]
+    dv_name = f"deletion_vector_{uuid.uuid4().hex[:12]}.bin"
+    desc = write_dv_file(os.path.join(table.path, dv_name), dead)
+    new_add = dict(add)
+    new_add["deletionVector"] = {
+        "storageType": "p", "pathOrInlineDv": dv_name,
+        "offset": desc["offset"], "sizeInBytes": desc["sizeInBytes"],
+        "cardinality": desc["cardinality"]}
+    new_add["dataChange"] = True
+    return [_remove_action(fpath), {"add": new_add}]
+
+
 def update_delta(session, path: str, condition,
                  assignments: Dict[str, object]) -> int:
     """UPDATE <path> SET col=expr WHERE condition. Expressions reference
     the table's columns; returns the new version."""
-    from ..expr.expressions import Expression, If, Literal, col as col_
+    from ..expr.expressions import (Cast, Expression, If, Literal,
+                                    col as col_)
     table = DeltaTable(path)
 
     def build():
         actions: List[dict] = []
-        for f in table.snapshot_files():
-            df = session.read.parquet(f)
+        for a in table.snapshot_adds():
+            f = os.path.join(path, a["path"])
+            df = _file_df(session, table, a)
             if df.filter(condition).count() == 0:
                 continue
             exprs = []
@@ -279,6 +374,9 @@ def update_delta(session, path: str, condition,
                 if fld.name in assignments:
                     v = assignments[fld.name]
                     ve = v if isinstance(v, Expression) else Literal(v)
+                    # Spark casts the assignment to the COLUMN's type;
+                    # an int literal must not narrow int64 -> int32
+                    ve = Cast(ve, fld.dtype)
                     exprs.append(If(condition, ve,
                                     col_(fld.name)).alias(fld.name))
                 else:
@@ -330,8 +428,9 @@ def merge_delta(session, path: str, source_df, on: List[str],
             [col_(k) for k in on]
             + [col_(c).alias(f"__src_{c}") for c in src_df.columns
                if c not in on]))
-        for f in table.snapshot_files():
-            tdf = session.read.parquet(f)
+        for a_ in table.snapshot_adds():
+            f = os.path.join(path, a_["path"])
+            tdf = _file_df(session, table, a_)
             if tdf.join(src_df, on=on, how="left_semi").count() == 0:
                 continue
             if when_matched == "delete":
@@ -344,10 +443,12 @@ def merge_delta(session, path: str, source_df, on: List[str],
                 for fld in tdf.schema.fields:
                     if matched_assignments and \
                             fld.name in matched_assignments:
+                        from ..expr.expressions import Cast as _Cast
                         v = matched_assignments[fld.name]
                         ve = (v if isinstance(v, Expression)
                               else Literal(v))
-                        exprs.append(ve.alias(fld.name))
+                        exprs.append(_Cast(ve, fld.dtype)
+                                     .alias(fld.name))
                     elif matched_assignments is None \
                             and fld.name not in on \
                             and f"__src_{fld.name}" in hit.columns:

@@ -78,13 +78,17 @@ class CachedScan(LogicalPlan):
 
 class ParquetScan(LogicalPlan):
     def __init__(self, paths: Sequence[str], schema: Optional[Schema] = None,
-                 columns: Optional[Sequence[str]] = None, filters=None):
+                 columns: Optional[Sequence[str]] = None, filters=None,
+                 dv=None):
         import pyarrow.parquet as pq
         self.paths = list(paths)
         self.columns = list(columns) if columns is not None else None
         # (name, op, value) conjuncts for row-group pruning, attached by
         # the optimizer from a Filter directly above the scan
         self.filters = list(filters) if filters else None
+        # {path: (table_root, deletionVector descriptor)}: dead-row
+        # masks applied lazily inside the scan (Delta DVs)
+        self.dv = dict(dv) if dv else None
         if schema is None:
             schema = Schema.from_arrow(pq.read_schema(self.paths[0]))
             if self.columns is not None:

@@ -92,7 +92,8 @@ def _pq(meta, conv, conf):
     from ..exec.coalesce import CoalesceBatchesExec
     n = meta.node
     scan = x.ParquetScanExec(n.paths, n.schema, n.columns,
-                             filters=n.filters)
+                             filters=n.filters,
+                             dv=getattr(n, "dv", None))
     if len(n.paths) > 1:
         # many-small-files: coalesce toward the batch target
         # (GpuCoalesceBatches after scans, GpuTransitionOverrides.scala:77);

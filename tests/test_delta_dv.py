@@ -1,0 +1,124 @@
+"""Delta deletion vectors: roaring-bitmap DV files replacing DELETE
+rewrites (reference: delta-33x GpuDeltaParquetFileFormat /
+GpuDeleteCommand DV support)."""
+import os
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.expr.expressions import col
+from spark_rapids_tpu.io.delta import DeltaTable, delete_delta
+
+
+@pytest.fixture()
+def session():
+    return st.TpuSession({
+        "spark.rapids.tpu.delta.deletionVectors.enabled": "true",
+        "spark.rapids.tpu.sql.batchSizeRows": 512})
+
+
+def _mk(session, path, n=2000, seed=2):
+    rng = np.random.default_rng(seed)
+    df = session.create_dataframe({
+        "k": pa.array(rng.integers(0, 50, n)),
+        "v": pa.array(np.arange(n, dtype=np.int64))})
+    df.write.mode("overwrite").delta(path)
+    return n
+
+
+def test_dv_delete_keeps_data_file(session, tmp_path):
+    p = str(tmp_path / "t")
+    n = _mk(session, p)
+    files_before = set(DeltaTable(p).snapshot_files())
+    delete_delta(session, p, col("v") % 7 == 0)
+    adds = DeltaTable(p).snapshot_adds()
+    # same data files, now carrying DVs — no rewrite
+    assert set(os.path.join(p, a["path"]) for a in adds) == files_before
+    assert any(a.get("deletionVector") for a in adds)
+    got = sorted(session.read.delta(p).to_arrow()
+                 .column("v").to_pylist())
+    assert got == [v for v in range(n) if v % 7 != 0]
+    # a DV file exists on disk
+    assert any(f.startswith("deletion_vector_")
+               for f in os.listdir(p))
+
+
+def test_second_delete_merges_dv(session, tmp_path):
+    p = str(tmp_path / "t")
+    n = _mk(session, p)
+    delete_delta(session, p, col("v") < 100)
+    delete_delta(session, p, col("v") >= n - 100)
+    got = sorted(session.read.delta(p).to_arrow()
+                 .column("v").to_pylist())
+    assert got == list(range(100, n - 100))
+    adds = DeltaTable(p).snapshot_adds()
+    cards = sum(a["deletionVector"]["cardinality"] for a in adds
+                if a.get("deletionVector"))
+    assert cards == 200
+
+
+def test_delete_all_rows_removes_file(session, tmp_path):
+    p = str(tmp_path / "t")
+    _mk(session, p, n=500)
+    delete_delta(session, p, col("v") >= 0)
+    with pytest.raises(ValueError, match="no live files"):
+        session.read.delta(p).to_arrow()
+
+
+def test_update_does_not_resurrect_dv_rows(session, tmp_path):
+    from spark_rapids_tpu.io.delta import update_delta
+    p = str(tmp_path / "t")
+    n = _mk(session, p, n=800)
+    delete_delta(session, p, col("v") < 400)
+    update_delta(session, p, col("v") >= 700, {"k": 99})
+    out = session.read.delta(p).to_arrow()
+    vs = sorted(out.column("v").to_pylist())
+    assert vs == list(range(400, n))      # deleted rows stay deleted
+    ks = {r["v"]: r["k"] for r in out.to_pylist()}
+    assert all(ks[v] == 99 for v in range(700, n))
+
+
+def test_update_literal_keeps_column_type(session, tmp_path):
+    """UPDATE SET k=<python int> must cast to the COLUMN type (int64),
+    not narrow to the literal's int32 — later DML would die on the
+    mixed-type concat (caught by the verification drive)."""
+    from spark_rapids_tpu.io.delta import update_delta
+    import pyarrow.parquet as pq
+    p = str(tmp_path / "t")
+    _mk(session, p, n=300)
+    update_delta(session, p, col("v") < 10, {"k": 7})
+    t = DeltaTable(p)
+    types = set()
+    for a in t.snapshot_adds():
+        types.add(str(pq.read_schema(os.path.join(p, a["path"]))
+                      .field("k").type))
+    assert types == {"int64"}, types
+
+
+def test_time_travel_before_dv_delete(session, tmp_path):
+    p = str(tmp_path / "t")
+    n = _mk(session, p, n=600)
+    v0 = DeltaTable(p).latest_version()
+    delete_delta(session, p, col("v") % 2 == 0)
+    old = session.read.delta(p, version=v0).to_arrow()
+    assert old.num_rows == n              # pre-DV snapshot intact
+    assert session.read.delta(p).to_arrow().num_rows == n // 2
+
+
+def test_dv_survives_checkpoint(session, tmp_path):
+    from spark_rapids_tpu.io.delta import CHECKPOINT_INTERVAL
+    p = str(tmp_path / "t")
+    n = _mk(session, p, n=400)
+    delete_delta(session, p, col("v") < 50)
+    # force commits past the checkpoint interval
+    for i in range(CHECKPOINT_INTERVAL + 1):
+        session.create_dataframe({"k": pa.array([0]),
+                                  "v": pa.array([10_000 + i])}) \
+            .write.mode("append").delta(p)
+    t = DeltaTable(p)
+    assert t._last_checkpoint_version() >= 0
+    got = session.read.delta(p).to_arrow()
+    vs = [v for v in got.column("v").to_pylist() if v < 10_000]
+    assert sorted(vs) == list(range(50, n))
