@@ -175,6 +175,19 @@ LORE_DUMP_IDS = _conf(
 LORE_DUMP_PATH = _conf(
     "sql.lore.dumpPath", "/tmp/srtpu-lore",
     "Directory for LORE operator dumps.", str)
+JOIN_BLOOM_ENABLED = _conf(
+    "sql.join.bloomFilter.enabled", False,
+    "Runtime bloom-filter join pruning: shuffled inner/left_semi/right "
+    "equi-joins with a small scan-shaped build side run the build once "
+    "into a device bloom filter and mask the stream side BEFORE its "
+    "exchange (reference: GpuBloomFilterAggregate + "
+    "GpuBloomFilterMightContain via InSubqueryExec runtime filters). "
+    "Off by default pending broader production soak.", bool)
+JOIN_BLOOM_MAX_BUILD_ROWS = _conf(
+    "sql.join.bloomFilter.maxBuildRows", 4_000_000,
+    "Upper bound on the ESTIMATED build-side rows for runtime "
+    "bloom-filter creation (filter memory is ~1 byte/bit at 8 "
+    "bits/row).", int)
 DELTA_DV_ENABLED = _conf(
     "delta.deletionVectors.enabled", False,
     "DELETE writes a deletion-vector (roaring bitmap) file marking "

@@ -1,0 +1,128 @@
+"""Runtime bloom-filter join pruning.
+
+Reference: the plugin's runtime filter path — GpuBloomFilterAggregate
+feeding GpuBloomFilterMightContain through InSubqueryExec so the fact
+side of a join drops non-matching rows BEFORE the shuffle. Standalone
+analog: the planner wraps the STREAM side of a shuffled equi-join in
+RuntimeBloomFilterExec, which on first execution runs the (simple,
+scan-shaped) build subtree once, folds the build keys into a device
+bloom-filter bit vector, and then masks every stream batch by k-hash
+membership — rows that cannot match never reach the exchange.
+
+Only sound for join types where a stream row WITHOUT a build match
+contributes nothing (inner, left_semi, right); the planner enforces
+that plus a scan-shaped build subtree (re-executing it is cheap and
+side-effect-free)."""
+from __future__ import annotations
+
+import threading
+from typing import Iterator
+
+import jax
+import jax.numpy as jnp
+
+from ..expr.expressions import EmitCtx
+from .base import ExecContext, TpuExec
+from .batch import DeviceBatch
+
+__all__ = ["RuntimeBloomFilterExec"]
+
+
+class RuntimeBloomFilterExec(TpuExec):
+    def __init__(self, stream: TpuExec, build: TpuExec, stream_key,
+                 build_key, estimated_items: int):
+        super().__init__([stream], stream.schema)
+        self.build = build
+        self.stream_key = stream_key
+        self.build_key = build_key
+        from ..expr.aggregates import BloomFilterAggregate
+        self._agg = BloomFilterAggregate(build_key,
+                                         estimated_items=estimated_items)
+        self._agg._resolve_type()
+        self._bits = None
+        self._lock = threading.Lock()
+        self._probe_jit = None
+
+    def describe(self):
+        return (f"RuntimeBloomFilterExec[{self.stream_key!r} IN "
+                f"bloom({self.build_key!r}), "
+                f"bits={self._agg.num_bits}]")
+
+    def release(self):
+        self._bits = None
+        self.build.release()
+        super().release()
+
+    # -- build ---------------------------------------------------------
+    def _ensure_filter(self, ctx: ExecContext):
+        if self._bits is not None:
+            return self._bits
+        with self._lock:
+            if self._bits is not None:
+                return self._bits
+            m = ctx.metrics_for(self._op_id)
+            a = self._agg
+            state = None
+
+            def upd(cvs, mask):
+                ectx = EmitCtx(list(cvs), mask.shape[0])
+                return a.update(a.child.emit(ectx), mask)
+
+            upd_jit = jax.jit(upd)
+            merge_jit = jax.jit(a.merge)
+            with m.timer("bloomBuildTime"):
+                for b in self.build.execute_all(ctx):
+                    st = upd_jit(b.cvs(), b.row_mask)
+                    state = st if state is None else merge_jit(state, st)
+                if state is None:          # empty build: nothing matches
+                    state = (jnp.zeros(a.num_bits, jnp.bool_),)
+            self._bits = state[0]
+        return self._bits
+
+    def _probe(self, bits, cvs, mask):
+        from ..ops.hash import bloom_positions
+        ectx = EmitCtx(list(cvs), mask.shape[0])
+        cv = self.stream_key.emit(ectx)
+        nb = self._agg.num_bits
+        hit = cv.validity
+        for pos in bloom_positions(cv, self.stream_key.dtype,
+                                   self._agg.k, nb):
+            hit = hit & bits[jnp.clip(pos, 0, nb - 1)]
+        return mask & hit
+
+    def execute_partition(self, ctx: ExecContext,
+                          pid: int) -> Iterator[DeviceBatch]:
+        m = ctx.metrics_for(self._op_id)
+        bits = self._ensure_filter(ctx)
+        if self._probe_jit is None:
+            self._probe_jit = jax.jit(self._probe)
+        for batch in self.children[0].execute_partition(ctx, pid):
+            with m.timer("bloomProbeTime"):
+                new_mask = self._probe_jit(bits, batch.cvs(),
+                                           batch.row_mask)
+            m.add("numOutputBatches", 1)
+            yield DeviceBatch(batch.table, batch.num_rows, new_mask,
+                              batch.capacity)
+
+
+_SIMPLE_BUILD = None
+
+
+def is_simple_build(e: TpuExec) -> bool:
+    """True when re-executing the subtree is cheap and side-effect-free
+    (scan/filter/project/coalesce chains only — no exchanges, joins,
+    aggregates, or window state)."""
+    global _SIMPLE_BUILD
+    if _SIMPLE_BUILD is None:
+        from .coalesce import CoalesceBatchesExec
+        from .nodes import (CachedScanExec, FilterExec, InMemoryScanExec,
+                            LimitExec, ParquetScanExec, ProjectExec)
+        from .text_scan import (AvroScanExec, CsvScanExec, JsonScanExec,
+                                OrcScanExec)
+        _SIMPLE_BUILD = (CachedScanExec, FilterExec, InMemoryScanExec,
+                         LimitExec, ParquetScanExec, ProjectExec,
+                         CoalesceBatchesExec, AvroScanExec, CsvScanExec,
+                         JsonScanExec, OrcScanExec)
+    if not isinstance(e, _SIMPLE_BUILD):
+        return False
+    return all(is_simple_build(c) for c in e.children)

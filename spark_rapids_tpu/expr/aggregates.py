@@ -847,20 +847,11 @@ class BloomFilterAggregate(AggExpr):
         self.dtype = dt.BINARY
 
     def _positions(self, cv: CV, mask):
-        from ..ops.hash import murmur3_cv
-        h1 = murmur3_cv(cv, self.child.dtype, jnp.int32(0)) \
-            .astype(jnp.uint32)
-        h2 = murmur3_cv(cv, self.child.dtype,
-                        jnp.int32(-1749833076)).astype(jnp.uint32)
-        valid = mask & cv.validity
-        m = jnp.uint32(self.num_bits)
-        idxs = []
-        for i in range(self.k):
-            p = (h1 + jnp.uint32(i) * h2) % m
-            # dead rows park on bit 0 of a scratch... route them to a
-            # real position but masked out via where below
-            idxs.append(jnp.where(valid, p.astype(jnp.int32), -1))
-        return idxs
+        from ..ops.hash import bloom_positions
+        masked = CV(cv.data, mask & cv.validity, cv.offsets,
+                    cv.children)
+        return bloom_positions(masked, self.child.dtype, self.k,
+                               self.num_bits)
 
     def update(self, cv: CV, mask):
         # dead rows route to a SACRIFICIAL slot (num_bits) rather than

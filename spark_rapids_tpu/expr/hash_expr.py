@@ -44,17 +44,12 @@ class BloomFilterMightContain(Expression):
         return b
 
     def emit(self, ctx):
-        from ..ops.hash import murmur3_cv
+        from ..ops.hash import bloom_positions
         cv = self.value.emit(ctx)
-        h1 = murmur3_cv(cv, self.value.dtype, jnp.int32(0)) \
-            .astype(jnp.uint32)
-        h2 = murmur3_cv(cv, self.value.dtype,
-                        jnp.int32(-1749833076)).astype(jnp.uint32)
-        m = jnp.uint32(self._m)
         hit = jnp.ones(ctx.capacity, jnp.bool_)
-        for i in range(self._k):
-            pos = ((h1 + jnp.uint32(i) * h2) % m).astype(jnp.int32)
-            hit = hit & self._bits[pos]
+        for pos in bloom_positions(cv, self.value.dtype, self._k,
+                                   self._m):
+            hit = hit & self._bits[jnp.clip(pos, 0, self._m - 1)]
         return CV(hit, cv.validity)
 
     def __repr__(self):

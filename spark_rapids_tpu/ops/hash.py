@@ -173,3 +173,28 @@ def partition_ids(cvs, dtypes, num_partitions: int, seed: int = 42):
     h = murmur3_row_hash(cvs, dtypes, seed)
     m = h % jnp.int32(num_partitions)
     return jnp.where(m < 0, m + num_partitions, m).astype(jnp.int32)
+
+
+# bloom-filter hash scheme shared by BloomFilterAggregate (build),
+# BloomFilterMightContain (foldable probe), and RuntimeBloomFilterExec
+# (runtime join filter): TWO murmur3 passes combined as h1 + i*h2 over
+# a power-of-two bit count. ONE definition — a drifted copy would
+# build and probe mismatched positions (silent false negatives).
+BLOOM_SEED1 = 0
+BLOOM_SEED2 = -1749833076
+
+
+def bloom_positions(cv, dtype, k: int, num_bits: int):
+    """Per-row bloom bit positions: k int32 arrays; invalid rows get
+    -1 in every position."""
+    import jax.numpy as jnp
+    h1 = murmur3_cv(cv, dtype, jnp.int32(BLOOM_SEED1)) \
+        .astype(jnp.uint32)
+    h2 = murmur3_cv(cv, dtype, jnp.int32(BLOOM_SEED2)) \
+        .astype(jnp.uint32)
+    m = jnp.uint32(num_bits)
+    out = []
+    for i in range(k):
+        p = ((h1 + jnp.uint32(i) * h2) % m).astype(jnp.int32)
+        out.append(jnp.where(cv.validity, p, -1))
+    return out

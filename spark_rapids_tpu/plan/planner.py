@@ -399,6 +399,7 @@ def _join(meta, conv, conf):
         from ..exec.exchange import ShuffleExchangeExec
         nparts = conf.get(SHUFFLE_PARTITIONS)
         if nparts > 1:
+            left = _maybe_bloom_prefilter(left, right, n, meta, conf)
             lex = ShuffleExchangeExec(left, nparts, n.bound_left_keys,
                                       left.schema)
             rex = ShuffleExchangeExec(right, nparts, n.bound_right_keys,
@@ -421,6 +422,32 @@ def _join(meta, conv, conf):
     return HashJoinExec(left, right, n.bound_left_keys,
                         n.bound_right_keys, n.how, n.schema,
                         condition=cond)
+
+
+def _maybe_bloom_prefilter(left, right, n, meta, conf):
+    """Wrap the stream (left) side of a shuffled equi-join in a runtime
+    bloom filter built from the (small, scan-shaped) build side, so
+    non-matching rows never reach the exchange (reference:
+    GpuBloomFilter* runtime filters via InSubqueryExec). Only for join
+    types where an unmatched stream row contributes nothing."""
+    from ..config import (JOIN_BLOOM_ENABLED, JOIN_BLOOM_MAX_BUILD_ROWS)
+    if not conf.get(JOIN_BLOOM_ENABLED):
+        return left
+    if n.how not in ("inner", "left_semi", "right"):
+        return left
+    if len(n.bound_left_keys or []) != 1:
+        return left                      # single-key filters only
+    from ..exec.runtime_filter import (RuntimeBloomFilterExec,
+                                       is_simple_build)
+    if not is_simple_build(right):
+        return left
+    est_rows = _estimate_rows(meta.children[1].node)
+    if est_rows is None or est_rows > conf.get(
+            JOIN_BLOOM_MAX_BUILD_ROWS):
+        return left
+    return RuntimeBloomFilterExec(left, right, n.bound_left_keys[0],
+                                  n.bound_right_keys[0],
+                                  max(64, int(est_rows)))
 
 
 @_rule(L.WindowOp)

@@ -1,0 +1,106 @@
+"""Runtime bloom-filter join pruning (reference: GpuBloomFilter*
+runtime filters via InSubqueryExec)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.exec.runtime_filter import RuntimeBloomFilterExec
+from spark_rapids_tpu.expr.expressions import col
+
+BASE = {
+    "spark.rapids.tpu.sql.batchSizeRows": 2048,
+    "spark.rapids.tpu.sql.shuffle.partitions": 4,
+    # force the shuffled (non-broadcast) join path
+    "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 1,
+}
+
+
+def _data(seed=3, n=30_000, dim=200):
+    rng = np.random.default_rng(seed)
+    fact_k = rng.integers(0, 50_000, n).astype(np.int64)
+    fact_v = rng.normal(0, 1, n)
+    dim_k = (np.arange(dim) * 13).astype(np.int64)
+    return fact_k, fact_v, dim_k
+
+
+def _nodes(df):
+    root, ctx = df._execute()
+
+    def walk(e):
+        yield e
+        for c in e.children:
+            yield from walk(c)
+
+    return list(walk(root)), ctx
+
+
+def _run(conf_extra, how="inner"):
+    fact_k, fact_v, dim_k = _data()
+    s = st.TpuSession({**BASE, **conf_extra})
+    fact = s.create_dataframe({"k": pa.array(fact_k),
+                               "v": pa.array(fact_v)})
+    dim = s.create_dataframe({"k": pa.array(dim_k),
+                              "d": pa.array(dim_k * 2)})
+    q = fact.join(dim, on=["k"], how=how)
+    rows = sorted((r["k"],
+                   None if r["v"] is None else round(r["v"], 9))
+                  for r in q.to_arrow().to_pylist())
+    return q, rows
+
+
+@pytest.mark.parametrize("how", ["inner", "left_semi", "right"])
+def test_bloom_on_off_same_results(how):
+    q_off, rows_off = _run(
+        {"spark.rapids.tpu.sql.join.bloomFilter.enabled": "false"}, how)
+    q_on, rows_on = _run(
+        {"spark.rapids.tpu.sql.join.bloomFilter.enabled": "true"}, how)
+    assert rows_on == rows_off
+    nodes_off, _ = _nodes(q_off)
+    nodes_on, _ = _nodes(q_on)
+    assert not any(isinstance(x, RuntimeBloomFilterExec)
+                   for x in nodes_off)
+    assert any(isinstance(x, RuntimeBloomFilterExec) for x in nodes_on)
+
+
+def test_unsound_join_types_not_filtered():
+    for how in ("left", "left_anti", "full"):
+        q, _ = _run(
+            {"spark.rapids.tpu.sql.join.bloomFilter.enabled": "true"},
+            how)
+        nodes, _ = _nodes(q)
+        assert not any(isinstance(x, RuntimeBloomFilterExec)
+                       for x in nodes), how
+
+
+def test_filter_actually_prunes_stream_rows():
+    fact_k, fact_v, dim_k = _data()
+    s = st.TpuSession({
+        **BASE,
+        "spark.rapids.tpu.sql.join.bloomFilter.enabled": "true"})
+    fact = s.create_dataframe({"k": pa.array(fact_k),
+                               "v": pa.array(fact_v)})
+    dim = s.create_dataframe({"k": pa.array(dim_k),
+                              "d": pa.array(dim_k * 2)})
+    q = fact.join(dim, on=["k"])
+    nodes, ctx = _nodes(q)
+    rf = next(x for x in nodes if isinstance(x, RuntimeBloomFilterExec))
+    kept = 0
+    for pid in range(rf.num_partitions(ctx)):
+        for b in rf.execute_partition(ctx, pid):
+            kept += int(b.row_mask.sum())
+    # ~200 of 50k key values live: >90% of stream rows must drop
+    assert kept < len(fact_k) * 0.1, (kept, len(fact_k))
+
+
+def test_empty_build_filters_everything():
+    s = st.TpuSession({
+        **BASE,
+        "spark.rapids.tpu.sql.join.bloomFilter.enabled": "true"})
+    fact = s.create_dataframe({"k": pa.array([1, 2, 3]),
+                               "v": pa.array([1.0, 2.0, 3.0])})
+    dim = s.create_dataframe({"k": pa.array([], pa.int64()),
+                              "d": pa.array([], pa.int64())})
+    out = fact.join(dim, on=["k"]).to_arrow()
+    assert out.num_rows == 0
