@@ -227,7 +227,13 @@ def main():
             "q6_rows_per_sec": rows_per_s,
             "q1_rows_per_sec": n1 / tpu_q1,
             "q3_s": tpu_q3,
-        }, fellback, {"q1_sf": sf_agg, "q3_sf": sf_join, "q6_sf": sf})
+            # cold + whole-suite metrics: the r3->r4 2.3x cold-Q6
+            # regression slipped through a gate that only watched hot
+            # paths (VERDICT r4 weak #2)
+            "q6_cold_s": extra.get("q6_cold_s"),
+            "tpch_all22_geomean_s": tpch_all.get("tpch_all22_geomean_s"),
+        }, fellback, {"q1_sf": sf_agg, "q3_sf": sf_join, "q6_sf": sf,
+                      "tpch_sf": tpch_all.get("tpch_all22_sf")})
     except Exception as e:  # advisory: never lose the bench result
         regressions = []
         extra["regression_gate_error"] = repr(e)
@@ -323,18 +329,24 @@ def _regression_gate(current: dict, fellback: bool, sfs: dict):
     metric = parsed.get("metric", "")
     m = re.search(r"sf([\d.]+)", metric)
     prev_sfs = {"q6_sf": float(m.group(1)) if m else None,
-                "q1_sf": extra.get("q1_sf"), "q3_sf": extra.get("q3_sf")}
+                "q1_sf": extra.get("q1_sf"), "q3_sf": extra.get("q3_sf"),
+                "tpch_sf": extra.get("tpch_all22_sf")}
     prev_vals = {
         "q6_rows_per_sec": parsed.get("value"),
         "q1_rows_per_sec": extra.get("q1_rows_per_sec"),
         "q3_s": extra.get("q3_s"),
+        "q6_cold_s": extra.get("q6_cold_s"),
+        "tpch_all22_geomean_s": extra.get("tpch_all22_geomean_s"),
     }
+    sf_key_of = {"q6_rows_per_sec": "q6_sf", "q1_rows_per_sec": "q1_sf",
+                 "q3_s": "q3_sf", "q6_cold_s": "q6_sf",
+                 "tpch_all22_geomean_s": "tpch_sf"}
     out = []
     for k, cur in current.items():
         old = prev_vals.get(k)
         if not old or not cur:
             continue
-        sf_key = k.split("_")[0] + "_sf"
+        sf_key = sf_key_of.get(k, k.split("_")[0] + "_sf")
         if prev_sfs.get(sf_key) != sfs.get(sf_key):
             continue  # different scale factor: not comparable
         # q3_s is time (lower better); rows/s higher better

@@ -140,6 +140,14 @@ PARQUET_READER_TYPE = _conf(
     "smaller than the coalescing target (fewer host->device uploads), "
     "else MULTITHREADED (decode prefetch overlapping device "
     "compute).", str)
+PARQUET_DEVICE_DECODE = _conf(
+    "sql.format.parquet.deviceDecode.enabled", True,
+    "Decode eligible Parquet column chunks ON DEVICE (uncompressed "
+    "flat INT32/INT64/FLOAT/DOUBLE chunks, PLAIN or dictionary "
+    "encoded): raw bytes upload once, PLAIN lane assembly + RLE "
+    "run expansion + def-level masking run as XLA programs "
+    "(GpuParquetScan.scala:3364 Table.readParquet analog). "
+    "Ineligible columns fall back to host pyarrow per column.", bool)
 PARQUET_COALESCING_TARGET = _conf(
     "sql.format.parquet.coalescing.targetBytes", 128 << 20,
     "COALESCING reader: files group until their on-disk size reaches "

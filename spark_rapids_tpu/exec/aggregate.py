@@ -859,6 +859,8 @@ class HashAggregateExec(TpuExec):
                 return
 
         def update_one(b):
+            from .batch import maybe_compact
+            b = maybe_compact(b, child.schema)
             nchunks = self._batch_nchunks(b)
             if self._hash_ok and not self._hash_disabled:
                 hfn = self._update_cache.get(("hash", nchunks))

@@ -47,3 +47,31 @@ class DeviceBatch:
     def __repr__(self):
         return (f"DeviceBatch(rows<={self.num_rows}, cap={self.capacity}, "
                 f"cols={self.table.num_columns})")
+
+
+def maybe_compact(batch: DeviceBatch, schema, factor: int = 4):
+    """Compact a sparse batch (live rows << capacity) down to
+    bucket_capacity(live). Holey masks ride through filters and FK joins
+    for free, but sort-based consumers (aggregate, sort, exchange, join
+    build) pay O(capacity log capacity) — one gather here collapses that.
+    Costs one scalar fetch + one gather; skipped unless the capacity
+    shrinks by `factor` or more."""
+    import jax.numpy as jnp
+
+    from ..columnar.column import MIN_CAPACITY, bucket_capacity
+    from ..ops.gather import compaction_perm, gather_cols
+    from ..utils.transfer import fetch_int
+    from .nodes import make_table
+
+    if batch.capacity <= MIN_CAPACITY * factor:
+        return batch
+    live = fetch_int(jnp.sum(batch.row_mask.astype(jnp.int32)))
+    new_cap = bucket_capacity(max(live, 1))
+    if new_cap * factor > batch.capacity:
+        return batch
+    perm, _ = compaction_perm(batch.row_mask)
+    idx = perm[:new_cap]
+    inb = jnp.arange(new_cap) < live
+    out_cvs = gather_cols(batch.cvs(), idx, inb)
+    return DeviceBatch(make_table(schema, out_cvs, live), live, inb,
+                       new_cap)

@@ -186,6 +186,78 @@ class HashJoinExec(TpuExec):
             self._count_cache[key] = fn
         return fn(bkey_cvs[0], bmask)
 
+    # ---- direct-address (perfect-hash) build: no sort at all -----------
+    # When the single int key's value span fits a bounded table (TPC-H
+    # surrogate keys are dense), build = two scatters, probe = two
+    # gathers: O(n) linear passes instead of XLA's single-threaded
+    # O(n log n) sort (~0.5s at 1M rows on CPU). Falls back to the sorted
+    # path per-batch only when a stream row has >1 match AND the join
+    # needs pair enumeration.
+    _DIRECT_SPAN_FACTOR = 8
+    _DIRECT_SPAN_MIN = 1 << 22
+
+    def _try_build_direct(self, bkey_cvs, bmask, cap_b):
+        """Returns {'R', 'kmin', 'kmax', 'cnt_t', 'idx_t'} or None."""
+        from ..utils.transfer import fetch
+        key = ("keyrange", cap_b)
+        rfn = self._count_cache.get(key)
+        if rfn is None:
+            def rfn_(kcv, mask):
+                ukey = self._single_key_u64(kcv, self.rkeys[0].dtype)
+                valid = mask & kcv.validity
+                kmin = jnp.min(jnp.where(valid, ukey,
+                                         jnp.uint64(0xFFFFFFFFFFFFFFFF)))
+                kmax = jnp.max(jnp.where(valid, ukey, jnp.uint64(0)))
+                return kmin, kmax, jnp.sum(valid.astype(jnp.int32))
+            rfn = jax.jit(rfn_)
+            self._count_cache[key] = rfn
+        kmin_d, kmax_d, nv_d = rfn(bkey_cvs[0], bmask)
+        kmin, kmax, nv = (int(v) for v in fetch((kmin_d, kmax_d, nv_d)))
+        if nv == 0:
+            return None
+        span = kmax - kmin + 1
+        if span > max(self._DIRECT_SPAN_FACTOR * cap_b,
+                      self._DIRECT_SPAN_MIN):
+            return None
+        R = bucket_capacity(span)
+        bkey = ("directbuild", R, cap_b)
+        bfn = self._count_cache.get(bkey)
+        if bfn is None:
+            def bfn_(kcv, mask, kmin_dev):
+                ukey = self._single_key_u64(kcv, self.rkeys[0].dtype)
+                valid = mask & kcv.validity
+                d = (ukey - kmin_dev).astype(jnp.int64)
+                off = jnp.where(valid, jnp.clip(d, 0, R), R)
+                cnt_t = jnp.zeros(R + 1, jnp.int32).at[off].add(
+                    valid.astype(jnp.int32))
+                idx_t = jnp.zeros(R + 1, jnp.int32).at[off].max(
+                    jnp.arange(cap_b, dtype=jnp.int32))
+                return cnt_t, idx_t
+            bfn = jax.jit(bfn_, static_argnums=())
+            self._count_cache[bkey] = bfn
+        cnt_t, idx_t = bfn(bkey_cvs[0], bmask, kmin_d)
+        return {"R": R, "kmin": kmin_d, "kmax": kmax_d,
+                "cnt_t": cnt_t, "idx_t": idx_t}
+
+    def _direct_probe(self, direct, skcv, smask, cap_s):
+        R = direct["R"]
+        key = ("directprobe", R, cap_s)
+        fn = self._count_cache.get(key)
+        if fn is None:
+            def fn_(cnt_t, idx_t, kmin, kmax, skcv, smask):
+                ukey_s = self._single_key_u64(skcv, self.lkeys[0].dtype)
+                joinable = smask & skcv.validity
+                in_r = joinable & (ukey_s >= kmin) & (ukey_s <= kmax)
+                d = (ukey_s - kmin).astype(jnp.int64)
+                poff = jnp.where(in_r, jnp.clip(d, 0, R), R)
+                cnt = cnt_t[poff].astype(jnp.int64)
+                bidx = idx_t[poff]
+                return cnt, bidx
+            fn = jax.jit(fn_)
+            self._count_cache[key] = fn
+        return fn(direct["cnt_t"], direct["idx_t"], direct["kmin"],
+                  direct["kmax"], skcv, smask)
+
     def _probe_fn(self, cap_b, cap_s):
         """Per-stream-batch count phase against the sorted build keys."""
         def fn(sorted_ukey, n_valid, skcv, smask):
@@ -214,6 +286,28 @@ class HashJoinExec(TpuExec):
         pos_ok = jnp.arange(touched.shape[0]) < n_valid
         upd = jnp.zeros_like(acc).at[bperm].max(touched & pos_ok)
         return acc | upd
+
+    # ---- single-match (FK-join) output stats + gather index -----------
+    # When no stream row has more than one match — every build-unique
+    # dimension join (TPC-H's dominant shape) — the expand phase is a
+    # no-op permutation: the stream side passes through UNTOUCHED (zero
+    # copy, mask update only) and the build payload gathers at stream
+    # capacity. One probe-stat fetch decides the path per batch.
+    @staticmethod
+    @jax.jit
+    def _probe_stats(cnt, smask):
+        matched = (cnt > 0) & smask
+        eff = jnp.where(smask & (cnt == 0), 1, cnt)
+        return (jnp.sum(cnt), jnp.sum(eff),
+                jnp.sum(matched.astype(jnp.int64)), jnp.max(cnt))
+
+    @staticmethod
+    @jax.jit
+    def _fk_gather_idx(cnt, bstart, perm, smask, n_build):
+        matched = (cnt > 0) & smask
+        pos = jnp.clip(bstart, 0, perm.shape[0] - 1).astype(jnp.int32)
+        rg = jnp.clip(perm[pos], 0, n_build - 1).astype(jnp.int32)
+        return rg, matched
 
     # ---- phase 1+2: combined sort & count (jitted) --------------------
     def _count_fn(self, nchunks, cap_b, cap_s):
@@ -267,16 +361,19 @@ class HashJoinExec(TpuExec):
 
     # ---- phase 3: expansion (jitted, keyed by out capacity) ------------
     def _expand_fn(self, out_cap, cap_b, with_left_nulls):
+        from ..ops.gather import row_of_unit
+
         def fn(cnt, offsets, bstart_of_stream, perm, smask):
             t = jnp.arange(out_cap, dtype=jnp.int64)
-            # stream row for each output slot
-            i = jnp.searchsorted(offsets + cnt, t, side="right")
             cap_s = cnt.shape[0]
+            # stream row for each output slot (scatter+cummax, not
+            # searchsorted — see ops.gather.row_of_unit)
+            i = row_of_unit(offsets, cap_s, out_cap).astype(jnp.int64)
             if with_left_nulls:
                 # left/full: unmatched live stream rows produce one row
                 eff_cnt = jnp.where(smask & (cnt == 0), 1, cnt)
                 offs = jnp.cumsum(eff_cnt) - eff_cnt
-                i = jnp.searchsorted(offs + eff_cnt, t, side="right")
+                i = row_of_unit(offs, cap_s, out_cap).astype(jnp.int64)
                 i = jnp.clip(i, 0, cap_s - 1)
                 j = t - offs[i]
                 matched = cnt[i] > 0
@@ -338,18 +435,29 @@ class HashJoinExec(TpuExec):
         probe every stream batch, emit unmatched build rows for
         right/full. Called once normally; once per disjoint-key
         sub-partition in the out-of-core path."""
+        from .batch import maybe_compact
         left, right = self.children
         with m.timer("buildTime"):
+            bbatches = [maybe_compact(b, right.schema) for b in bbatches]
             bcvs, bmask = self._concat_batches(bbatches, right.schema)
             cap_b = bmask.shape[0]
             bctx = EmitCtx(bcvs, cap_b)
             bkey_cvs = [k.emit(bctx) for k in self.rkeys]
         matched_b_acc = jnp.zeros(cap_b, jnp.bool_)
         fast = self._fast_path_ok()
-        if fast:
+        direct = None
+        if fast and self.condition is None and self.how in (
+                "inner", "left", "left_semi", "left_anti"):
+            with m.timer("buildTime"):
+                direct = self._try_build_direct(bkey_cvs, bmask, cap_b)
+        if fast and direct is None:
             with m.timer("buildTime"):
                 sorted_ukey, bperm, n_valid_b = self._build_sorted(
                     bkey_cvs, bmask)
+        elif direct is not None:
+            # sorted structures built lazily only if a stream batch needs
+            # pair enumeration (duplicate build keys)
+            sorted_ukey = bperm = n_valid_b = None
 
         from ..memory.retry import with_retry
 
@@ -362,10 +470,12 @@ class HashJoinExec(TpuExec):
                                          bkey_cvs, cap_b, fast,
                                          sorted_ukey if fast else None,
                                          bperm if fast else None,
-                                         n_valid_b if fast else None))
+                                         n_valid_b if fast else None,
+                                         direct))
             return out
 
         for batch in stream_batches:
+            batch = maybe_compact(batch, left.schema, factor=8)
             for results in with_retry(batch, probe_one):
                 for kind, payload in results:
                     if kind == "matched_b":
@@ -581,7 +691,7 @@ class HashJoinExec(TpuExec):
                 h.close()
 
     def _probe_batch(self, ctx, m, batch, bcvs, bmask, bkey_cvs, cap_b,
-                     fast, sorted_ukey, bperm, n_valid_b):
+                     fast, sorted_ukey, bperm, n_valid_b, direct=None):
         """One stream batch through count/probe + expand. Yields
         ("matched_b", mask) and ("batch", DeviceBatch) items. Idempotent
         (retry/split safe): all semantics are stream-row-local and
@@ -591,6 +701,42 @@ class HashJoinExec(TpuExec):
             cap_s = batch.capacity
             sctx = EmitCtx(scvs, cap_s)
             skey_cvs = [k.emit(sctx) for k in self.lkeys]
+            if direct is not None:
+                from ..utils.transfer import fetch
+                cnt, bidx = self._direct_probe(direct, skey_cvs[0], smask,
+                                               cap_s)
+                if self.how == "left_semi":
+                    yield ("batch", DeviceBatch(
+                        batch.table, batch.num_rows,
+                        smask & (cnt > 0), cap_s))
+                    return
+                if self.how == "left_anti":
+                    yield ("batch", DeviceBatch(
+                        batch.table, batch.num_rows,
+                        smask & (cnt == 0), cap_s))
+                    return
+                n_total, n_eff, n_matched, max_cnt = (
+                    int(v) for v in fetch(self._probe_stats(cnt, smask)))
+                if max_cnt <= 1:
+                    if self.how == "inner" and n_matched == 0:
+                        return
+                    matched = (cnt > 0) & smask
+                    rg = jnp.clip(bidx, 0, cap_b - 1)
+                    new_mask = matched if self.how == "inner" else smask
+                    out_cvs = list(scvs) + self._gather_cols(bcvs, rg,
+                                                             matched)
+                    tbl = make_table(self.schema, out_cvs, batch.num_rows)
+                    m.add("numOutputRows",
+                          n_matched if self.how == "inner" else n_eff)
+                    m.add("numOutputBatches", 1)
+                    yield ("batch", DeviceBatch(tbl, batch.num_rows,
+                                                new_mask, cap_s))
+                    return
+                # duplicate build keys in this batch's match set: promote
+                # to the sorted fast path (built once, reused)
+                if "sorted" not in direct:
+                    direct["sorted"] = self._build_sorted(bkey_cvs, bmask)
+                sorted_ukey, bperm, n_valid_b = direct["sorted"]
             if fast:
                 pkey = ("probe", cap_b, cap_s)
                 pfn = self._count_cache.get(pkey)
@@ -632,12 +778,28 @@ class HashJoinExec(TpuExec):
                 yield ("batch", DeviceBatch(batch.table, batch.num_rows,
                                             smask & (cnt == 0), cap_s))
                 return
+            from ..utils.transfer import fetch
+            n_total, n_eff, n_matched, max_cnt = (
+                int(v) for v in fetch(self._probe_stats(cnt, smask)))
             with_left_nulls = self.how in ("left", "full")
-            if with_left_nulls:
-                eff = jnp.where(smask & (cnt == 0), 1, cnt)
-                n_out = fetch_int((jnp.sum(eff)))
-            else:
-                n_out = fetch_int((total))
+            if max_cnt <= 1 and self.how in ("inner", "left"):
+                # FK fast path: stream columns pass through unchanged
+                if self.how == "inner" and n_matched == 0:
+                    return
+                rg, matched = self._fk_gather_idx(cnt, bstart, perm,
+                                                  smask, cap_b)
+                new_mask = matched if self.how == "inner" else smask
+                # live rows stay IN PLACE (holey mask): num_rows remains
+                # the positional upper bound, not the live count
+                out_cvs = list(scvs) + self._gather_cols(bcvs, rg, matched)
+                tbl = make_table(self.schema, out_cvs, batch.num_rows)
+                m.add("numOutputRows",
+                      n_matched if self.how == "inner" else n_eff)
+                m.add("numOutputBatches", 1)
+                yield ("batch", DeviceBatch(tbl, batch.num_rows, new_mask,
+                                            cap_s))
+                return
+            n_out = n_eff if with_left_nulls else n_total
             if n_out == 0:
                 return
             out_cap = bucket_capacity(n_out)

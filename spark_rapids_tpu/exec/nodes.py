@@ -220,12 +220,100 @@ class ParquetScanExec(TpuExec):
             self._dv_cache[path] = got
         return got
 
+    def _device_decoded_batches(self, ctx, path, m):
+        """Device-decode path (GpuParquetScan.scala:3364 analog): per
+        row group, eligible column chunks decode ON DEVICE from one raw
+        byte upload; remaining columns ride the host pyarrow path and
+        merge into the same DeviceBatch. Returns None when nothing in
+        the file is device-decodable (caller uses the host path)."""
+        import pyarrow.parquet as pq
+
+        from ..columnar.column import Column, bucket_capacity
+        from ..io.file_cache import cached_local_path
+        from ..io.parquet_device import (chunk_device_plan,
+                                         decode_chunk_device,
+                                         eligible_chunks)
+        try:
+            lp = cached_local_path(path, ctx.conf)
+            pf = pq.ParquetFile(lp)
+        except FileNotFoundError:
+            lp = path
+            pf = pq.ParquetFile(path)
+        cols = (self.columns if self.columns is not None
+                else [f.name for f in self.schema.fields])
+        if pf.metadata.num_row_groups == 0:
+            return None
+        if not eligible_chunks(pf, 0, cols):
+            return None
+        kept = (prune_row_groups(pf, self.filters) if self.filters
+                else list(range(pf.metadata.num_row_groups)))
+        m.add("skippedRowGroups", pf.metadata.num_row_groups - len(kept))
+        field_by_name = {f.name: f for f in self.schema.fields}
+
+        def gen():
+            import pyarrow as pa
+            for rg in kept:
+                nrows = pf.metadata.row_group(rg).num_rows
+                if nrows == 0:
+                    continue
+                cap = bucket_capacity(nrows)
+                elig = eligible_chunks(pf, rg, cols)
+                dev_cols = {}
+                with m.timer("scanTime"):
+                    for name, ci in list(elig.items()):
+                        import numpy as _np
+
+                        import pyarrow as _pa
+                        fld = field_by_name[name]
+                        np_dt = fld.dtype.np_dtype
+                        if np_dt is None:
+                            continue
+                        af = pf.schema_arrow.field(name)
+                        if (_pa.types.is_timestamp(af.type)
+                                and af.type.unit != "us"):
+                            continue     # non-micros: host path converts
+                        c = chunk_device_plan(pf, lp, rg, ci, name,
+                                              af.nullable)
+                        got = decode_chunk_device(c, cap) if c else None
+                        if got is None:
+                            continue
+                        vals, valid = got
+                        if str(vals.dtype) != _np.dtype(np_dt).name:
+                            vals = vals.astype(np_dt)
+                        dev_cols[name] = Column(fld.dtype, nrows, vals,
+                                                valid)
+                    rest = [n for n in cols if n not in dev_cols]
+                    if rest:
+                        at = pf.read_row_group(rg, columns=rest)
+                        host_tbl = Table.from_arrow(at)
+                        host_by_name = dict(zip(at.schema.names,
+                                                host_tbl.columns))
+                    else:
+                        host_by_name = {}
+                    out_cols = []
+                    for n in cols:
+                        if n in dev_cols:
+                            out_cols.append(dev_cols[n])
+                        else:
+                            out_cols.append(host_by_name[n])
+                    tbl = Table(list(cols), out_cols)
+                m.add("numOutputRows", nrows)
+                m.add("numOutputBatches", 1)
+                m.add("deviceDecodedChunks", len(dev_cols))
+                yield DeviceBatch(tbl, num_rows=nrows)
+        return gen()
+
     def _decoded_batches(self, ctx, path, m):
         import pyarrow as pa
         import pyarrow.parquet as pq
         from ..io.file_cache import cached_local_path
         per = max(1, ctx.conf.batch_size_rows)
-        pf = pq.ParquetFile(cached_local_path(path, ctx.conf))
+        try:
+            pf = pq.ParquetFile(cached_local_path(path, ctx.conf))
+        except FileNotFoundError:
+            # LRU eviction can unlink the cached copy between
+            # local_path() and open; the source path is always valid
+            pf = pq.ParquetFile(path)
         cols = (self.columns if self.columns is not None
                 else [f.name for f in self.schema.fields])
         dead = self._dead_positions(path)
@@ -286,6 +374,13 @@ class ParquetScanExec(TpuExec):
                 m.add("numOutputBatches", 1)
                 yield DeviceBatch(tbl, num_rows=at.num_rows)
             return
+        from ..config import PARQUET_DEVICE_DECODE
+        if (ctx.conf.get(PARQUET_DEVICE_DECODE)
+                and not (self.dv and path in self.dv)):
+            dev_iter = self._device_decoded_batches(ctx, path, m)
+            if dev_iter is not None:
+                yield from dev_iter
+                return
         host_iter = self._decoded_batches(ctx, path, m)
         if reader_type == "MULTITHREADED":
             nthreads = max(1, ctx.conf.get(MULTITHREADED_READ_THREADS))
@@ -325,7 +420,11 @@ class ParquetScanExec(TpuExec):
         from ..io.file_cache import cached_local_path
 
         def read_one(p):
-            pf = pq.ParquetFile(cached_local_path(p, ctx.conf))
+            try:
+                pf = pq.ParquetFile(cached_local_path(p, ctx.conf))
+            except FileNotFoundError:
+                # cache-eviction race: fall back to the source path
+                pf = pq.ParquetFile(p)
             dead = self._dead_positions(p)
             if self.filters and dead is None:
                 kept = prune_row_groups(pf, self.filters)

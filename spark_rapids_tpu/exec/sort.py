@@ -127,8 +127,10 @@ class SortExec(TpuExec):
         total = 0
         try:
             from ..memory.retry import retry_no_split
+            from .batch import maybe_compact
             for cpid in range(child.num_partitions(ctx)):
                 for batch in child.execute_partition(ctx, cpid):
+                    batch = maybe_compact(batch, child.schema)
                     handles.append(retry_no_split(
                         lambda b=batch: store.add_batch(b)))
                     total += batch.nbytes

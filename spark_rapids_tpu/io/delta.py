@@ -344,10 +344,14 @@ def _dv_delete_actions(session, table, add, fpath, condition):
     if len(dead) >= t.num_rows:
         return [_remove_action(fpath)]
     dv_name = f"deletion_vector_{uuid.uuid4().hex[:12]}.bin"
-    desc = write_dv_file(os.path.join(table.path, dv_name), dead)
+    dv_abs = os.path.abspath(os.path.join(table.path, dv_name))
+    desc = write_dv_file(dv_abs, dead)
     new_add = dict(add)
+    # storageType 'p' means an ABSOLUTE path per the Delta protocol
+    # (the reference resolves descriptor.absolutePath); table-relative
+    # names here would break spec-conformant external readers
     new_add["deletionVector"] = {
-        "storageType": "p", "pathOrInlineDv": dv_name,
+        "storageType": "p", "pathOrInlineDv": dv_abs,
         "offset": desc["offset"], "sizeInBytes": desc["sizeInBytes"],
         "cardinality": desc["cardinality"]}
     new_add["dataChange"] = True

@@ -166,10 +166,14 @@ def read_dv_file(path: str, offset: int = 1,
 
 def load_dv_positions(table_root: str, descriptor: dict) -> List[int]:
     """Dead row positions from an add action's deletionVector
-    descriptor (table-relative pathOrInlineDv)."""
+    descriptor. storageType 'p' carries an absolute path per the Delta
+    protocol; tolerate legacy table-relative names too (tables written
+    by earlier versions of this engine)."""
+    p = descriptor["pathOrInlineDv"]
+    if not os.path.isabs(p):
+        p = os.path.join(table_root, p)
     return read_dv_file(
-        os.path.join(table_root, descriptor["pathOrInlineDv"]),
-        descriptor.get("offset", 1), descriptor.get("sizeInBytes"))
+        p, descriptor.get("offset", 1), descriptor.get("sizeInBytes"))
 
 
 def apply_dv_to_table(t, dead) -> "object":

@@ -1,12 +1,18 @@
 """Iceberg write path: append/overwrite commits with Avro manifests.
 
 Reference: the plugin's iceberg module write support (GpuIcebergWrite /
-SparkWrite shimming). Commit flow per Iceberg v1 spec: data parquet
-files under data/, a manifest Avro listing the added files, a
-manifest-list Avro naming every live manifest, a new
+SparkWrite shimming). Commit flow follows the SHAPE of the Iceberg v1
+layout: data parquet files under data/, a manifest Avro listing the
+added files, a manifest-list Avro naming every live manifest, a new
 vN.metadata.json appending the snapshot, and version-hint.text
 pointing at it. Appends reuse the previous snapshot's manifests and
-add one more; overwrite starts a fresh manifest list."""
+add one more; overwrite starts a fresh manifest list.
+
+COMPATIBILITY: the manifest records carry a SUBSET of the v1 required
+fields (no added_snapshot_id, partition data, or sequence numbers) and
+embed absolute local file paths, so these commits are self-readable
+(by this engine's Iceberg reader) but are NOT guaranteed to load in
+standard Iceberg readers. See docs/compatibility.md."""
 from __future__ import annotations
 
 import json

@@ -177,9 +177,11 @@ class DataFrameWriter:
                 nrows, nbytes = task()
                 stats.num_rows += nrows
                 stats.num_bytes += nbytes
+                stats.num_files += 1
             else:
+                # num_files counted from drain() results: a part whose
+                # async write later fails must not be counted
                 queue.submit(tbl.nbytes, task)
-            stats.num_files += 1
 
         try:
             seq = 0
@@ -213,7 +215,17 @@ class DataFrameWriter:
                 for nrows, nbytes in queue.drain():
                     stats.num_rows += nrows
                     stats.num_bytes += nbytes
-        finally:
+                    stats.num_files += 1
+        except BaseException:
+            # close() re-raises deferred write errors via drain(); an
+            # exception already unwinding here must not be replaced by it
+            if queue is not None:
+                try:
+                    queue.close()
+                except Exception:
+                    pass
+            raise
+        else:
             if queue is not None:
                 queue.close()
         if stats.num_files == 0:

@@ -10,6 +10,7 @@ per-row loops.
 """
 from __future__ import annotations
 
+import functools
 from typing import List, Optional, Tuple
 
 import jax
@@ -20,15 +21,52 @@ from .kernel_utils import CV
 __all__ = ["take", "compact", "compaction_perm", "take_strings"]
 
 
+@functools.partial(jax.jit, static_argnames=("caps_all",))
+def _gather_table_jit(cvs, idx, inb, caps_all):
+    """Whole-table gather as ONE compiled program. Eager per-op dispatch
+    here cost ~0.6ms/primitive on the hot join path (hundreds of ops per
+    probe); a single jit turns that into one dispatch + lets XLA fuse."""
+    its = [iter(c) if c else None for c in caps_all]
+    return [take(cv, idx, inb, it) for cv, it in zip(cvs, its)]
+
+
+@jax.jit
+def _compact_table_jit(cvs, mask):
+    perm, count = compaction_perm(mask)
+    in_bounds = jnp.arange(perm.shape[0]) < count
+    return [take(cv, perm, in_bounds) for cv in cvs], count
+
+
 def compaction_perm(mask) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Stable permutation moving live rows to the front.
 
     Returns (perm, count). perm[i] = source row for dense output slot i.
+    Cumsum + scatter, NOT argsort: XLA's sort is O(n log n) single-threaded
+    scalar code on CPU (~0.5s at 1M rows) while this is three linear passes.
     """
-    # stable argsort on (!mask) keeps relative order of live rows
-    perm = jnp.argsort(jnp.logical_not(mask), stable=True)
-    count = jnp.sum(mask.astype(jnp.int32))
+    n = mask.shape[0]
+    m = mask.astype(jnp.int32)
+    count = jnp.sum(m)
+    live_pos = jnp.cumsum(m) - m              # dense slot for live rows
+    dead_pos = count + jnp.cumsum(1 - m) - (1 - m)
+    pos = jnp.where(mask, live_pos, dead_pos)  # dest slot of source row i
+    perm = jnp.zeros(n, jnp.int32).at[pos].set(
+        jnp.arange(n, dtype=jnp.int32))
     return perm, count
+
+
+def row_of_unit(new_off, n_out: int, out_cap: int):
+    """For var-width layouts: map each output unit position (byte /
+    element) to its owning row. scatter(row start) + cummax — two linear
+    passes instead of searchsorted's O(units * log rows) scalar loop
+    (~25x faster at 4M units on XLA:CPU, and gather/scan vectorize on
+    TPU where searchsorted does not)."""
+    starts = new_off[:n_out].astype(jnp.int32)
+    safe = jnp.minimum(starts, out_cap)
+    rob = jnp.zeros(out_cap + 1, jnp.int32).at[safe].max(
+        jnp.arange(n_out, dtype=jnp.int32))
+    rob = jax.lax.cummax(rob)[:out_cap]
+    return rob
 
 
 def take_fixed(cv: CV, idx, in_bounds=None) -> CV:
@@ -58,8 +96,7 @@ def take_strings(cv: CV, idx, in_bounds=None,
                                jnp.cumsum(lens).astype(jnp.int32)])
     out_cap = out_data_capacity or cv.data.shape[0]
     pos = jnp.arange(out_cap, dtype=jnp.int32)
-    row = jnp.searchsorted(new_off[1:], pos, side="right").astype(jnp.int32)
-    row = jnp.clip(row, 0, n_out - 1)
+    row = row_of_unit(new_off, n_out, out_cap)
     src = starts[row] + (pos - new_off[row])
     src = jnp.clip(src, 0, cv.data.shape[0] - 1)
     data = cv.data[src]
@@ -160,8 +197,7 @@ def take_array(cv: CV, idx, in_bounds=None,
                                jnp.cumsum(lens).astype(jnp.int32)])
     out_cap = out_elem_capacity or cv.child.capacity
     pos = jnp.arange(out_cap, dtype=jnp.int32)
-    row = jnp.searchsorted(new_off[1:], pos, side="right").astype(jnp.int32)
-    row = jnp.clip(row, 0, n_out - 1)
+    row = row_of_unit(new_off, n_out, out_cap)
     src = starts[row] + (pos - new_off[row])
     elem_ok = pos < new_off[n_out]
     child = take(cv.child, src, elem_ok, caps)
@@ -195,27 +231,36 @@ def take(cv: CV, idx, in_bounds=None, caps=None) -> CV:
 
 def compact(cvs: List[CV], mask) -> Tuple[List[CV], jnp.ndarray]:
     """Move live rows to the front of every column; returns (cvs, count)."""
-    perm, count = compaction_perm(mask)
-    in_bounds = jnp.arange(perm.shape[0]) < count
-    out = [take(cv, perm, in_bounds) for cv in cvs]
-    return out, count
+    if any(cv.offsets is not None or cv.children for cv in cvs):
+        # var-width columns trace per-column (source capacities reused —
+        # compaction never replicates rows)
+        perm, count = compaction_perm(mask)
+        in_bounds = jnp.arange(perm.shape[0]) < count
+        out = [take(cv, perm, in_bounds) for cv in cvs]
+        return out, count
+    return _compact_table_jit(cvs, mask)
+
+
+@jax.jit
+def _measures_jit(var_cvs, idx, inb):
+    return {i: take_measures(cv, idx, inb) for i, cv in var_cvs.items()}
 
 
 def gather_cols(cvs: List[CV], idx, inb) -> List[CV]:
-    """Gather a table's columns by idx (host-driven, eager). Var-width
-    columns (strings AND nested lists, recursively) get output capacities
-    sized from the actual gathered unit totals — gathers may replicate
-    rows, so source capacities are not upper bounds."""
+    """Gather a table's columns by idx. Var-width columns (strings AND
+    nested lists, recursively) get output capacities sized from the actual
+    gathered unit totals — gathers may replicate rows, so source
+    capacities are not upper bounds. The gather itself runs as one jitted
+    program per (schema, caps) shape."""
     from ..columnar.column import bucket_capacity
     from ..utils.transfer import fetch
     var_cols = [i for i, cv in enumerate(cvs)
                 if cv.offsets is not None or cv.children]
     caps = {}
     if var_cols:
-        measures = {i: take_measures(cvs[i], idx, inb) for i in var_cols}
+        measures = _measures_jit({i: cvs[i] for i in var_cols}, idx, inb)
         got = fetch(measures)
         caps = {i: tuple(bucket_capacity(max(int(v), 1)) for v in ms)
                 for i, ms in got.items()}
-    return [take(cv, idx, in_bounds=inb,
-                 caps=iter(caps[i]) if i in caps and caps[i] else None)
-            for i, cv in enumerate(cvs)]
+    caps_all = tuple(caps.get(i, ()) for i in range(len(cvs)))
+    return _gather_table_jit(cvs, idx, inb, caps_all)

@@ -32,7 +32,9 @@ def local_group_sum(keys, vals, mask):
     kcv = CV(keys, mask)
     arrays = [jnp.logical_not(mask).astype(jnp.uint8)]
     arrays += sk.order_keys(kcv, dt.INT64)
-    perm = sk.lexsort(arrays)
+    # allow_host=False: this traces under shard_map, where the CPU
+    # host-callback sort deadlocks (see ops.sortkeys.lexsort)
+    perm = sk.lexsort(arrays, allow_host=False)
     sorted_arrays = [a[perm] for a in arrays]
     boundary = sk.group_boundaries(sorted_arrays)
     seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1

@@ -437,6 +437,11 @@ def _maybe_bloom_prefilter(left, right, n, meta, conf):
         return left
     if len(n.bound_left_keys or []) != 1:
         return left                      # single-key filters only
+    if n.bound_left_keys[0].dtype != n.bound_right_keys[0].dtype:
+        # murmur3 hashes int32/int64 representations of equal values
+        # differently: a mixed-width equi-join through the bloom filter
+        # would silently drop matching stream rows
+        return left
     from ..exec.runtime_filter import (RuntimeBloomFilterExec,
                                        is_simple_build)
     if not is_simple_build(right):
