@@ -39,6 +39,19 @@ __all__ = ["UngroupedAggExec", "HashAggregateExec"]
 # cudf's hash groupby (reference: GpuAggregateExec first pass).
 _HASH_BUCKETS = 4096
 _HASH_ROUNDS = 2
+_HASH_BUCKETS_MAX = 1 << 18
+
+
+def _hash_buckets_for(cap: int) -> int:
+    """Adaptive bucket count: ~cap/4 buckets keeps the load factor low
+    enough that two rep-verify rounds absorb high-cardinality batches
+    (fixed 4096 buckets sent every >8k-group batch to the sort path —
+    q10's 15k customer groups cost 3s/batch there)."""
+    b = _HASH_BUCKETS
+    target = min(cap // 4, _HASH_BUCKETS_MAX)
+    while b < target:
+        b <<= 1
+    return b
 
 
 class UngroupedAggExec(TpuExec):
@@ -474,7 +487,22 @@ class HashAggregateExec(TpuExec):
             for kcv, kexpr, nc in zip(key_cvs, self.keys, nchunks):
                 arrs = [jnp.logical_not(kcv.validity).astype(jnp.uint8)]
                 arrs += sk.order_keys(kcv, kexpr.dtype, nc)
-                eq_arrays.append(arrs)
+                # pack adjacent uint32 chunk keys into uint64: halves the
+                # rep-gather + compare count in the verify step (long
+                # string keys dominate high-card groupbys, e.g. q10)
+                packed = []
+                i = 0
+                while i < len(arrs):
+                    a = arrs[i]
+                    if (a.dtype == jnp.uint32 and i + 1 < len(arrs)
+                            and arrs[i + 1].dtype == jnp.uint32):
+                        packed.append((a.astype(jnp.uint64) << 32)
+                                      | arrs[i + 1].astype(jnp.uint64))
+                        i += 2
+                    else:
+                        packed.append(a)
+                        i += 1
+                eq_arrays.append(packed)
             agg_inputs = []
             for a in self.aggs:
                 if a.child is not None:
@@ -482,15 +510,28 @@ class HashAggregateExec(TpuExec):
                 else:
                     agg_inputs.append(CV(jnp.zeros(cap, jnp.int8),
                                          jnp.ones(cap, jnp.bool_)))
-            B = _HASH_BUCKETS
             remaining = mask
             rowidx = jnp.arange(cap, dtype=jnp.int32)
-            round_keys = [[] for _ in self.keys]
+            round_keys = []          # per-round rep ROW indices
             round_states = None
             round_live = []
+            # hash the full (possibly var-width) keys ONCE; later rounds
+            # re-bucket by mixing the base hash with an integer
+            # finalizer — O(bytes) work happens a single time
+            h1 = murmur3_row_hash(key_cvs, key_dtypes, seed=42)
             for r in range(_HASH_ROUNDS):
-                h = murmur3_row_hash(key_cvs, key_dtypes,
-                                     seed=42 + r * 1000003)
+                # escalating buckets: round 0 small (low-cardinality
+                # batches — the common case — pay only 4096-slot segment
+                # ops), later rounds big enough for high-card batches
+                B = _HASH_BUCKETS if r == 0 else _hash_buckets_for(cap)
+                if r == 0:
+                    h = h1
+                else:
+                    hm = h1.astype(jnp.uint32) ^ jnp.uint32(
+                        0x9E3779B9 * r)
+                    hm = hm * jnp.uint32(0x85EBCA6B)
+                    hm = hm ^ (hm >> 13)
+                    h = (hm * jnp.uint32(0xC2B2AE35)).astype(jnp.int32)
                 b = (h.astype(jnp.uint32) % jnp.uint32(B)).astype(jnp.int32)
                 repmin = jax.ops.segment_min(
                     jnp.where(remaining, rowidx, cap), b, B)
@@ -512,25 +553,49 @@ class HashAggregateExec(TpuExec):
                 round_states = ([[f] for f in flat_r] if round_states is None
                                 else [o + [f] for o, f in
                                       zip(round_states, flat_r)])
-                for ki, (kcv, nc) in enumerate(zip(key_cvs, nchunks)):
-                    if kcv.offsets is not None:
-                        bcap = min(kcv.data.shape[0],
-                                   bucket_capacity(B * nc * 4))
-                        round_keys[ki].append(take_strings(
-                            kcv, rep, in_bounds=has,
-                            out_data_capacity=bcap))
-                    else:
-                        round_keys[ki].append(take(kcv, rep,
-                                                   in_bounds=has))
+                # keys are NOT gathered here: only the rep's original ROW
+                # INDEX is kept — key materialization (expensive for
+                # string keys at B slots) happens once, post-compaction,
+                # at live-group scale in update_one
+                round_keys.append(rep)
                 round_live.append(has)
                 remaining = remaining & ~match
-            key_out = [concat_cvs(parts, kd)
-                       for parts, kd in zip(round_keys, key_dtypes)]
+            rep_rows = jnp.concatenate(round_keys)
             flat = [jnp.concatenate(parts) for parts in round_states]
             live = jnp.concatenate(round_live)
             leftover = jnp.sum(remaining.astype(jnp.int32))
-            return key_out, flat, live, leftover
+            n_live = jnp.sum(live.astype(jnp.int32))
+            return rep_rows, flat, live, leftover, n_live
         return fn
+
+    def _materialize_hash_partial(self, b, rep_rows, st, sl,
+                                  n_live: int):
+        """Turn a hash-pass result (rep ROW indices + states + live
+        mask over rounds*B slots) into a (keys, states, live, cap)
+        partial at bucket_capacity(live). Key columns — expensive for
+        strings — gather from the ORIGINAL batch only here, at
+        live-group scale, never at bucket scale."""
+        from ..ops.gather import compaction_perm, gather_cols
+        cap_part = sl.shape[0]
+        new_cap = min(bucket_capacity(max(n_live, 1)), cap_part)
+        # gather_cols fetches var-width measures internally (host sync),
+        # so this stays host-driven; the gathers themselves are jitted
+        perm, _ = compaction_perm(sl)
+        idx = perm[:new_cap]
+        inb = jnp.arange(new_cap) < n_live
+        kfn = self._update_cache.get("keyemit")
+        if kfn is None:
+            def kfn_(cvs, mask):
+                cvs2, mask2 = self._stages(cvs, mask)
+                ctx = EmitCtx(cvs2, mask2.shape[0])
+                return [k.emit(ctx) for k in self.keys]
+            kfn = jax.jit(kfn_)
+            self._update_cache["keyemit"] = kfn
+        key_cvs = kfn(b.cvs(), b.row_mask)
+        rep2 = rep_rows[idx]
+        ks2 = gather_cols(key_cvs, rep2, inb)
+        st2 = [s[idx] for s in st]
+        return (ks2, st2, inb, new_cap)
 
     def _update_fn(self, nchunks):
         def fn(cvs, mask):
@@ -867,9 +932,13 @@ class HashAggregateExec(TpuExec):
                 if hfn is None:
                     hfn = jax.jit(self._hash_update_fn(nchunks))
                     self._update_cache[("hash", nchunks)] = hfn
-                ks, st, sl, leftover = hfn(b.cvs(), b.row_mask)
-                if fetch_int(leftover) == 0:
-                    return (ks, st, sl, sl.shape[0])
+                rep_rows, st, sl, leftover, n_live = hfn(b.cvs(),
+                                                         b.row_mask)
+                from ..utils.transfer import fetch
+                lo, nl = (int(v) for v in fetch((leftover, n_live)))
+                if lo == 0:
+                    return self._materialize_hash_partial(
+                        b, rep_rows, st, sl, nl)
                 # bucket-collision overflow (high-cardinality batch):
                 # fall back to the exact sort path, and stop trying the
                 # hash pass for the rest of this query

@@ -309,6 +309,20 @@ class HashJoinExec(TpuExec):
         rg = jnp.clip(perm[pos], 0, n_build - 1).astype(jnp.int32)
         return rg, matched
 
+    def _fk_output(self, m, batch, scvs, bcvs, rg, matched, smask,
+                   n_matched, n_eff, cap_s):
+        """Single-match join output: stream columns pass through IN
+        PLACE (holey mask — num_rows stays the positional upper bound),
+        build payload gathered by the per-row match index."""
+        new_mask = matched if self.how == "inner" else smask
+        out_cvs = list(scvs) + self._gather_cols(bcvs, rg, matched)
+        tbl = make_table(self.schema, out_cvs, batch.num_rows)
+        m.add("numOutputRows",
+              n_matched if self.how == "inner" else n_eff)
+        m.add("numOutputBatches", 1)
+        return ("batch", DeviceBatch(tbl, batch.num_rows, new_mask,
+                                     cap_s))
+
     # ---- phase 1+2: combined sort & count (jitted) --------------------
     def _count_fn(self, nchunks, cap_b, cap_s):
         def fn(bkeys, bmask, skeys, smask):
@@ -722,15 +736,9 @@ class HashJoinExec(TpuExec):
                         return
                     matched = (cnt > 0) & smask
                     rg = jnp.clip(bidx, 0, cap_b - 1)
-                    new_mask = matched if self.how == "inner" else smask
-                    out_cvs = list(scvs) + self._gather_cols(bcvs, rg,
-                                                             matched)
-                    tbl = make_table(self.schema, out_cvs, batch.num_rows)
-                    m.add("numOutputRows",
-                          n_matched if self.how == "inner" else n_eff)
-                    m.add("numOutputBatches", 1)
-                    yield ("batch", DeviceBatch(tbl, batch.num_rows,
-                                                new_mask, cap_s))
+                    yield self._fk_output(m, batch, scvs, bcvs, rg,
+                                          matched, smask, n_matched,
+                                          n_eff, cap_s)
                     return
                 # duplicate build keys in this batch's match set: promote
                 # to the sorted fast path (built once, reused)
@@ -788,16 +796,8 @@ class HashJoinExec(TpuExec):
                     return
                 rg, matched = self._fk_gather_idx(cnt, bstart, perm,
                                                   smask, cap_b)
-                new_mask = matched if self.how == "inner" else smask
-                # live rows stay IN PLACE (holey mask): num_rows remains
-                # the positional upper bound, not the live count
-                out_cvs = list(scvs) + self._gather_cols(bcvs, rg, matched)
-                tbl = make_table(self.schema, out_cvs, batch.num_rows)
-                m.add("numOutputRows",
-                      n_matched if self.how == "inner" else n_eff)
-                m.add("numOutputBatches", 1)
-                yield ("batch", DeviceBatch(tbl, batch.num_rows, new_mask,
-                                            cap_s))
+                yield self._fk_output(m, batch, scvs, bcvs, rg, matched,
+                                      smask, n_matched, n_eff, cap_s)
                 return
             n_out = n_eff if with_left_nulls else n_total
             if n_out == 0:

@@ -250,8 +250,18 @@ class ParquetScanExec(TpuExec):
         m.add("skippedRowGroups", pf.metadata.num_row_groups - len(kept))
         field_by_name = {f.name: f for f in self.schema.fields}
 
+        import numpy as _np
+        import pyarrow as _pa
+
+        # the decode unit is a whole row group; cap the batch-size blowup
+        # vs the host path (which slices to batch_size_rows) to bound the
+        # device-memory spike on huge row groups
+        per = max(1, ctx.conf.batch_size_rows)
+        if any(pf.metadata.row_group(rg).num_rows > 4 * per
+               for rg in kept):
+            return None
+
         def gen():
-            import pyarrow as pa
             for rg in kept:
                 nrows = pf.metadata.row_group(rg).num_rows
                 if nrows == 0:
@@ -261,9 +271,6 @@ class ParquetScanExec(TpuExec):
                 dev_cols = {}
                 with m.timer("scanTime"):
                     for name, ci in list(elig.items()):
-                        import numpy as _np
-
-                        import pyarrow as _pa
                         fld = field_by_name[name]
                         np_dt = fld.dtype.np_dtype
                         if np_dt is None:

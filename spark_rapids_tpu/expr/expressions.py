@@ -681,6 +681,24 @@ class _Comparison(_BinaryOp):
         self.dtype = dt.BOOL
 
     def emit(self, ctx):
+        # literal string equality: chunked compare, not the byte-domain
+        # walk (ops.strings.equals_literal)
+        if (isinstance(self.left.dtype, (dt.StringType, dt.BinaryType))
+                and type(self) in (Eq, Ne)):
+            lit = col = None
+            if isinstance(self.right, Literal):
+                lit, col = self.right, self.left
+            elif isinstance(self.left, Literal):
+                lit, col = self.left, self.right
+            if lit is not None and isinstance(lit.value, (str, bytes)):
+                from ..ops import strings as ops_str
+                cv = col.emit(ctx)
+                raw = (lit.value.encode() if isinstance(lit.value, str)
+                       else lit.value)
+                eq = ops_str.equals_literal(cv, raw)
+                if type(self) is Ne:
+                    eq = jnp.logical_not(eq)
+                return CV(eq, cv.validity)
         l, r = self.left.emit(ctx), self.right.emit(ctx)
         if isinstance(self.left.dtype, (dt.StringType, dt.BinaryType)):
             from ..ops import strings as ops_str

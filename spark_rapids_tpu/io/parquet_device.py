@@ -137,7 +137,11 @@ def _parse_sections(c: DeviceChunk):
         if p.encoding == pt.PLAIN:
             plain_pages.append((off, row))
         else:                                  # dictionary indices
-            bw = c.raw[off]
+            bw = c.raw[off] if off < len(c.raw) else 255
+            if bw > 32:
+                # spec max is 32; a corrupt/hostile byte here must route
+                # to the host fallback, not overflow the run tables
+                raise pt.ThriftError(f"dict index bit width {bw}")
             runs = pt.parse_hybrid_runs(c.raw, off + 1, end,
                                         p.num_values, bw)
             # index runs address the PACKED (non-null) value stream;
@@ -155,7 +159,11 @@ def decode_chunk_device(c: DeviceChunk, cap: int):
 
     from ..ops import parquet_decode as pd
 
-    def_runs, plain_pages, dict_idx_pages, dict_page = _parse_sections(c)
+    try:
+        def_runs, plain_pages, dict_idx_pages, dict_page = \
+            _parse_sections(c)
+    except pt.ThriftError:
+        return None                   # malformed page section: fallback
     if plain_pages and dict_idx_pages:
         return None                   # mixed-encoding chunk: fallback
     width = _PHYS_WIDTH[c.physical]

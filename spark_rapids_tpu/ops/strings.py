@@ -147,6 +147,30 @@ def concat_strings(cvs: List[CV], out_data_capacity: int) -> CV:
     return CV(out, valid, new_off)
 
 
+def equals_literal(cv: CV, raw: bytes):
+    """Row == constant-string: length check + big-endian 4-byte chunk
+    compares — O(rows * len/4) gathers. The general `compare` walks the
+    column's whole BYTE domain with a segment_min (O(bytes)), which is
+    ~30x more work for a short literal against a long column; XLA also
+    CSEs the chunk extraction across many literal compares on the same
+    column (q19's 12 container compares cost one extraction). Exact for
+    any byte content: equal length + equal zero-padded chunks <=> equal
+    bytes."""
+    from .sortkeys import string_chunk_keys
+    n = cv.offsets.shape[0] - 1
+    lens = cv.offsets[1:] - cv.offsets[:-1]
+    L = len(raw)
+    ok = lens == L
+    nch = (L + 3) // 4
+    if nch:
+        ks = string_chunk_keys(cv, nch)
+        for i in range(nch):
+            word = int.from_bytes(raw[i * 4:(i + 1) * 4].ljust(4, b"\0"),
+                                  "big")
+            ok = ok & (ks[i] == jnp.uint32(word))
+    return ok
+
+
 def compare(a: CV, b: CV):
     """Per-row byte-lexicographic compare: returns int8 in {-1,0,1}.
     Works over a's byte domain + a length tiebreak."""

@@ -204,8 +204,52 @@ def _passthrough_names(project: L.Project) -> Set[str]:
     return out
 
 
+def _split_conjuncts(cond: Expression):
+    from ..expr.expressions import And
+    if isinstance(cond, And):
+        return (_split_conjuncts(cond.children[0])
+                + _split_conjuncts(cond.children[1]))
+    return [cond]
+
+
+def _and_all(preds):
+    from ..expr.expressions import And
+    out = preds[0]
+    for p in preds[1:]:
+        out = And(out, p)
+    return out
+
+
+def extract_within(cond: Expression, names: Set[str]):
+    """Weaker predicate IMPLIED by `cond` that references only `names`,
+    or None (Spark's extractPredicatesWithinOutputSet): And keeps either
+    side, Or needs both. Lets an OR-of-ANDs filter like TPC-H q7's
+    (supp='FR' AND cust='DE') OR (supp='DE' AND cust='FR') push
+    supp IN ('FR','DE') below the join."""
+    from ..expr.expressions import And, Or
+    if isinstance(cond, And):
+        a = extract_within(cond.children[0], names)
+        b = extract_within(cond.children[1], names)
+        if a is not None and b is not None:
+            return And(a, b)
+        return a if a is not None else b
+    if isinstance(cond, Or):
+        a = extract_within(cond.children[0], names)
+        b = extract_within(cond.children[1], names)
+        if a is not None and b is not None:
+            return Or(a, b)
+        return None
+    refs = refs_of(cond)
+    if refs is not None and refs <= names:
+        return cond
+    return None
+
+
 def push_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
-    """Sink Filters below pass-through Projects and into Join sides."""
+    """Sink Filters below pass-through Projects and into Join sides:
+    whole one-sided conjuncts move (and are removed above); derived
+    OR-extracted predicates are ADDED below while the original filter
+    stays (necessary-not-sufficient)."""
     kids = [push_filters(c) for c in plan.children]
     plan = _rebuild(plan, kids)
     if not isinstance(plan, L.Filter):
@@ -221,19 +265,44 @@ def push_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
     if isinstance(child, L.Join):
         lnames = set(child.left.schema.names)
         rnames = set(child.right.schema.names)
-        if not (refs & lnames & rnames):
-            if refs <= lnames and child.how in ("inner", "left",
-                                                "left_semi", "left_anti"):
-                return L.Join(
-                    push_filters(L.Filter(child.left, plan.condition)),
-                    child.right, child.left_keys, child.right_keys,
-                    child.how, condition=child.condition)
-            if refs <= rnames and child.how in ("inner", "right"):
-                return L.Join(
-                    child.left,
-                    push_filters(L.Filter(child.right, plan.condition)),
-                    child.left_keys, child.right_keys, child.how,
-                    condition=child.condition)
+        if lnames & rnames:
+            return plan
+        left_ok = child.how in ("inner", "left", "left_semi", "left_anti")
+        right_ok = child.how in ("inner", "right")
+        lparts, rparts, rest = [], [], []
+        for c in _split_conjuncts(plan.condition):
+            r = refs_of(c)
+            if r is not None and r <= lnames and left_ok:
+                lparts.append(c)
+            elif r is not None and r <= rnames and right_ok:
+                rparts.append(c)
+            else:
+                rest.append(c)
+        # derived one-sided weakenings of the residual conjuncts
+        for c in rest:
+            if left_ok:
+                d = extract_within(c, lnames)
+                if d is not None and refs_of(d) != refs_of(c):
+                    lparts.append(d)
+            if right_ok:
+                d = extract_within(c, rnames)
+                if d is not None and refs_of(d) != refs_of(c):
+                    rparts.append(d)
+        if not lparts and not rparts:
+            return plan
+        new_left = child.left
+        new_right = child.right
+        if lparts:
+            new_left = push_filters(L.Filter(new_left, _and_all(lparts)))
+        if rparts:
+            new_right = push_filters(L.Filter(new_right,
+                                              _and_all(rparts)))
+        out = L.Join(new_left, new_right, child.left_keys,
+                     child.right_keys, child.how,
+                     condition=child.condition)
+        if rest:
+            return L.Filter(out, _and_all(rest))
+        return out
     return plan
 
 

@@ -222,9 +222,12 @@ def test_exchange_map_retry_splits(monkeypatch):
     import spark_rapids_tpu.functions as F
     from spark_rapids_tpu.exec.exchange import ShuffleExchangeExec
     s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 512})
+    # 900 groups: agg partials compact to bucket(groups) before the
+    # exchange, so the map batch is 1024-cap — big enough that the
+    # injected OOM leaves room for a capacity split (128 is unsplittable)
     n = 2000
     df = s.create_dataframe({
-        "k": pa.array([i % 9 for i in range(n)], pa.int64()),
+        "k": pa.array([i % 900 for i in range(n)], pa.int64()),
         "v": pa.array(list(range(n)), pa.int64())})
     orig = ShuffleExchangeExec._map_fn
     state = {"fired": 0}
@@ -240,7 +243,7 @@ def test_exchange_map_retry_splits(monkeypatch):
     got = dict(zip(out.column(0).to_pylist(), out.column(1).to_pylist()))
     want = {}
     for i in range(n):
-        want[i % 9] = want.get(i % 9, 0) + i
+        want[i % 900] = want.get(i % 900, 0) + i
     assert got == want
     assert state["fired"] == 1
 

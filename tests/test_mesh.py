@@ -329,7 +329,10 @@ def test_mesh_skewed_shard_spills_and_completes(tmp_path, monkeypatch):
         .create_dataframe(data).group_by("k").agg(
             F.sum("v").alias("sv"), F.count("t").alias("c")).to_arrow()
 
-    dm = dev_mod.DeviceManager(budget_bytes=512 << 10)  # 512 KiB << input
+    # 64 KiB << input: compaction (maybe_compact + hash-partial shrink)
+    # cut resident bytes enough that the old 512 KiB budget no longer
+    # forced any spill
+    dm = dev_mod.DeviceManager(budget_bytes=64 << 10)
     store = spill_mod.SpillStore(dm, spill_dir=str(tmp_path))
     monkeypatch.setattr(dev_mod, "_GLOBAL", dm)
     monkeypatch.setattr(spill_mod, "_STORE", store)
