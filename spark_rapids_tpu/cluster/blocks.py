@@ -1,0 +1,178 @@
+"""Executor-local shuffle block store + peer-to-peer block server.
+
+The reference's shuffle keeps map outputs ON the executors (served by
+the block manager / UCX transport — RapidsShuffleInternalManagerBase
+.scala:56, shuffle/RapidsShuffleTransport.scala:44); the driver moves
+only locations. Same topology here: map fragments park their shuffle
+buckets in this process-local store (Arrow-IPC files under a temp dir),
+a daemon server thread serves `fetch` requests from peer executors over
+the same length-prefixed Arrow-IPC frame protocol as the cluster RPC,
+and reducers dial mappers directly. The driver never touches a data
+byte — O(metadata) driver memory at any scale.
+
+Store lifetime: keyed by shuffle_id; an LRU cap of `MAX_SHUFFLES`
+evicts the oldest shuffle's files (runs are short-lived; a dropped
+shuffle's re-fetch fails like a lost executor and re-executes lineage).
+"""
+from __future__ import annotations
+
+import os
+import socket
+import tempfile
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Sequence, Tuple
+
+from .rpc import RpcClosed, recv_msg, send_msg
+
+__all__ = ["BlockStore", "ensure_server", "fetch_blocks", "FetchFailed"]
+
+MAX_SHUFFLES = 4
+
+
+class FetchFailed(RuntimeError):
+    """A peer block fetch failed (dead executor / evicted shuffle);
+    the driver re-executes the producing map task (lineage)."""
+
+
+class BlockStore:
+    def __init__(self):
+        self.dir = tempfile.mkdtemp(prefix="srtpu-shuffle-")
+        self._lock = threading.Lock()
+        # shuffle_id -> {(map_id, pid): path}
+        self._shuffles: "OrderedDict[str, Dict[Tuple[int, int], str]]" = \
+            OrderedDict()
+
+    def put(self, shuffle_id: str, map_id: int, pid: int, table) -> int:
+        import pyarrow as pa
+        path = os.path.join(self.dir,
+                            f"{shuffle_id}-{map_id}-{pid}.arrow")
+        with pa.OSFile(path, "wb") as f:
+            with pa.ipc.new_stream(f, table.schema) as w:
+                w.write_table(table)
+        with self._lock:
+            if shuffle_id not in self._shuffles:
+                self._shuffles[shuffle_id] = {}
+            # true LRU: every put refreshes recency before evicting
+            self._shuffles.move_to_end(shuffle_id)
+            while len(self._shuffles) > MAX_SHUFFLES:
+                _, old = self._shuffles.popitem(last=False)
+                for p in old.values():
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+            self._shuffles[shuffle_id][(map_id, pid)] = path
+        return os.path.getsize(path)
+
+    def get(self, shuffle_id: str, map_id: int, pid: int):
+        import pyarrow as pa
+        with self._lock:
+            if shuffle_id in self._shuffles:
+                self._shuffles.move_to_end(shuffle_id)   # LRU touch
+            path = self._shuffles.get(shuffle_id, {}).get((map_id, pid))
+        if path is None:
+            return None
+        with pa.OSFile(path, "rb") as f:
+            with pa.ipc.open_stream(f) as r:
+                return r.read_all()
+
+    def drop(self, shuffle_id: str):
+        with self._lock:
+            old = self._shuffles.pop(shuffle_id, None)
+        for p in (old or {}).values():
+            try:
+                os.unlink(p)
+            except OSError:
+                pass
+
+
+_STORE: BlockStore = None
+_SERVER_ADDR: Tuple[str, int] = None
+_INIT_LOCK = threading.Lock()
+
+
+def store() -> BlockStore:
+    global _STORE
+    with _INIT_LOCK:
+        if _STORE is None:
+            _STORE = BlockStore()
+    return _STORE
+
+
+def _serve_conn(sock: socket.socket):
+    try:
+        while True:
+            kind, payload = recv_msg(sock)
+            if kind == "fetch":
+                sid = payload["shuffle_id"]
+                tabs, missing = [], []
+                for map_id in payload["map_ids"]:
+                    t = store().get(sid, map_id, payload["pid"])
+                    if t is None:
+                        missing.append(map_id)
+                    else:
+                        tabs.append(t)
+                if missing:
+                    send_msg(sock, "missing", {"map_ids": missing})
+                else:
+                    send_msg(sock, "blocks", {"n": len(tabs)},
+                             tables=tabs)
+            elif kind == "drop":
+                store().drop(payload["shuffle_id"])
+                send_msg(sock, "ok", {})
+            else:
+                return
+    except (RpcClosed, OSError):
+        pass
+    finally:
+        sock.close()
+
+
+def ensure_server() -> Tuple[str, int]:
+    """Start (once) the block server in this process; returns its
+    address for shuffle-map metadata."""
+    global _SERVER_ADDR
+    with _INIT_LOCK:
+        if _SERVER_ADDR is not None:
+            return _SERVER_ADDR
+        listener = socket.socket()
+        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        listener.bind(("127.0.0.1", 0))
+        listener.listen(16)
+        _SERVER_ADDR = listener.getsockname()
+
+        def accept_loop():
+            while True:
+                try:
+                    conn, _ = listener.accept()
+                except OSError:
+                    return
+                threading.Thread(target=_serve_conn, args=(conn,),
+                                 daemon=True).start()
+
+        threading.Thread(target=accept_loop, daemon=True).start()
+        return _SERVER_ADDR
+
+
+def fetch_blocks(addr: Tuple[str, int], shuffle_id: str,
+                 map_ids: Sequence[int], pid: int) -> List:
+    """Fetch this reduce partition's blocks from one mapper executor."""
+    addr = tuple(addr)   # canonical form: failure messages must match
+    #                      the driver's dead-mapper substring check
+    try:
+        sock = socket.create_connection(addr, timeout=10)
+    except OSError as e:
+        raise FetchFailed(f"connect {addr}: {e!r}") from e
+    try:
+        send_msg(sock, "fetch", {"shuffle_id": shuffle_id,
+                                 "map_ids": list(map_ids), "pid": pid})
+        kind, payload = recv_msg(sock)
+    except (RpcClosed, OSError) as e:
+        raise FetchFailed(f"fetch from {addr}: {e!r}") from e
+    finally:
+        sock.close()
+    if kind != "blocks":
+        raise FetchFailed(
+            f"mapper {addr} missing blocks: {payload}")
+    return payload.get("_arrow", [])

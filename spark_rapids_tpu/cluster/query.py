@@ -39,10 +39,15 @@ __all__ = ["DistributedRunner", "map_fragment_task", "reduce_fragment_task"]
 
 
 def map_fragment_task(map_fn, split, conf, n_reduce: int,
-                      part_keys: Sequence[str]):
+                      part_keys: Sequence[str], shuffle_id: str = None,
+                      map_id: int = 0):
     """Executor-side map stage: build + run the fragment over this
-    split, hash-partition its output into n_reduce buckets, return the
-    non-empty buckets as Arrow tables (shuffle blocks)."""
+    split, hash-partition its output into n_reduce buckets. With a
+    shuffle_id (P2P mode, the default runner path), buckets park in
+    this executor's local block store and only METADATA returns —
+    the reference's map-output-tracker shape
+    (RapidsShuffleInternalManagerBase.scala:56). Without one (legacy),
+    buckets ride back to the driver as Arrow tables."""
     import pyarrow as pa
 
     import spark_rapids_tpu as st
@@ -61,7 +66,16 @@ def map_fragment_task(map_fn, split, conf, n_reduce: int,
         if parts:
             pids.append(pid)
             tables.append(pa.concat_tables(parts))
-    return ArrowResult({"pids": pids}, tables)
+    if shuffle_id is None:
+        return ArrowResult({"pids": pids}, tables)
+    from . import blocks
+    addr = blocks.ensure_server()
+    st_ = blocks.store()
+    sizes = {}
+    for pid, t in zip(pids, tables):
+        sizes[pid] = st_.put(shuffle_id, map_id, pid, t)
+    return {"pids": pids, "sizes": sizes, "addr": addr,
+            "map_id": map_id}
 
 
 def reduce_fragment_task(reduce_fn, conf, tables):
@@ -72,6 +86,26 @@ def reduce_fragment_task(reduce_fn, conf, tables):
 
     import spark_rapids_tpu as st
 
+    s = st.TpuSession(conf)
+    at = pa.concat_tables(tables)
+    out = reduce_fn(s, s.create_dataframe(at)).to_arrow()
+    return ArrowResult({}, [out])
+
+
+def reduce_fetch_task(reduce_fn, conf, shuffle_id: str, pid: int,
+                      sources):
+    """Executor-side reduce stage (P2P): fetch this partition's blocks
+    DIRECTLY from the mapper executors' block servers, then run the
+    reduce fragment. `sources` = [(addr, [map_id, ...]), ...]."""
+    import pyarrow as pa
+
+    import spark_rapids_tpu as st
+    from . import blocks
+
+    tables = []
+    for addr, map_ids in sources:
+        tables.extend(blocks.fetch_blocks(addr, shuffle_id, map_ids,
+                                          pid))
     s = st.TpuSession(conf)
     at = pa.concat_tables(tables)
     out = reduce_fn(s, s.create_dataframe(at)).to_arrow()
@@ -94,31 +128,76 @@ class DistributedRunner:
             part_keys: Sequence[str], reduce_fn: Callable,
             n_reduce: Optional[int] = None,
             final_fn: Optional[Callable] = None):
-        """Execute map fragments over `splits`, Arrow-shuffle on
+        """Execute map fragments over `splits`, peer-to-peer shuffle on
         `part_keys` into `n_reduce` buckets, run reduce fragments, and
         (optionally) a driver-side final fragment over the concatenated
-        reduce outputs. Returns a pyarrow Table."""
+        reduce outputs. Returns a pyarrow Table.
+
+        P2P topology (RapidsShuffleInternalManagerBase.scala:56 /
+        RapidsShuffleTransport.scala:44 analog): map outputs stay on
+        the mapper executors (cluster/blocks.py); the driver moves only
+        block METADATA {pid -> (addr, sizes)}; reducers fetch blocks
+        directly from mappers. A reduce whose fetch fails (dead mapper
+        / evicted shuffle) triggers lineage re-execution of the
+        affected map splits, then one reduce retry."""
+        import uuid
+
         import pyarrow as pa
 
         import spark_rapids_tpu as st
 
         n_reduce = n_reduce or max(len(self.cm.alive_executors), 1)
-        futs = [self.cm.submit(map_fragment_task, map_fn, sp, self.conf,
-                               n_reduce, list(part_keys))
-                for sp in splits]
-        buckets: Dict[int, List] = {}
-        for f in futs:
-            res = f.result()
-            for pid, t in zip(res.meta["pids"], res.tables):
-                buckets.setdefault(pid, []).append(t)
+        shuffle_id = uuid.uuid4().hex[:12]
 
-        rfuts = [(pid, self.cm.submit(reduce_fragment_task, reduce_fn,
-                                      self.conf, tables=tabs))
-                 for pid, tabs in sorted(buckets.items())]
-        outs = [f.result().tables[0] for _, f in rfuts]
-        if not outs:
+        def run_maps(idxs):
+            futs = {i: self.cm.submit(
+                map_fragment_task, map_fn, splits[i], self.conf,
+                n_reduce, list(part_keys), shuffle_id, i)
+                for i in idxs}
+            return {i: f.result() for i, f in futs.items()}
+
+        metas = run_maps(range(len(splits)))
+        done: Dict[int, object] = {}     # pid -> reduce output table
+
+        for attempt in range(3):
+            # per-pid fetch plan: mapper addr -> map ids that produced
+            # blocks for that pid
+            all_pids = sorted({p for m2 in metas.values()
+                               for p in m2["pids"]})
+            rfuts = []
+            for pid in all_pids:
+                if pid in done:          # keep completed partitions
+                    continue
+                by_addr: Dict[tuple, List[int]] = {}
+                for i, m2 in metas.items():
+                    if pid in m2["pids"]:
+                        by_addr.setdefault(tuple(m2["addr"]),
+                                           []).append(m2["map_id"])
+                sources = [(list(a), ids)
+                           for a, ids in sorted(by_addr.items())]
+                rfuts.append((pid, self.cm.submit(
+                    reduce_fetch_task, reduce_fn, self.conf,
+                    shuffle_id, pid, sources)))
+            refetch = set()
+            for pid, f in rfuts:
+                try:
+                    done[pid] = f.result().tables[0]
+                except Exception as e:
+                    if "FetchFailed" not in repr(e) or attempt == 2:
+                        raise
+                    # lineage: re-execute the map splits whose mapper
+                    # address appears in the failure (idempotent
+                    # fragments); if the address can't be parsed out,
+                    # re-execute everything
+                    dead = {i for i, m2 in metas.items()
+                            if f"{tuple(m2['addr'])}" in repr(e)}
+                    refetch |= dead or set(metas)
+            if not refetch:
+                break
+            metas.update(run_maps(sorted(refetch)))
+        if not done:
             return None
-        result = pa.concat_tables(outs)
+        result = pa.concat_tables([done[p] for p in sorted(done)])
         if final_fn is not None:
             s = st.TpuSession(self.conf)
             result = final_fn(s, s.create_dataframe(result)).to_arrow()

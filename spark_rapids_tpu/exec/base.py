@@ -32,6 +32,20 @@ class ExecContext:
         self.planning = planning
         self.metrics: Dict[str, MetricSet] = {}
         self._metrics_lock = threading.Lock()
+        # SharedBuildExec's per-run materialization cache:
+        # {id(node): {pid: [spill handles]}} — closed by close()
+        self.shared_handles: Dict[int, dict] = {}
+
+    def close(self):
+        """Release per-run resources (shared-build spill handles)."""
+        for per_node in self.shared_handles.values():
+            for handles in per_node.values():
+                for h in handles:
+                    try:
+                        h.close()
+                    except Exception:
+                        pass
+        self.shared_handles.clear()
 
     def metrics_for(self, op_id: str) -> MetricSet:
         with self._metrics_lock:

@@ -247,19 +247,20 @@ class ParquetScanExec(TpuExec):
             return None
         kept = (prune_row_groups(pf, self.filters) if self.filters
                 else list(range(pf.metadata.num_row_groups)))
+
+        # the decode unit is a whole row group; cap the batch-size blowup
+        # vs the host path (which slices to batch_size_rows) to bound the
+        # device-memory spike on huge row groups. Checked BEFORE any
+        # metric: the host fallback records skippedRowGroups itself.
+        per = max(1, ctx.conf.batch_size_rows)
+        if any(pf.metadata.row_group(rg).num_rows > 4 * per
+               for rg in kept):
+            return None
         m.add("skippedRowGroups", pf.metadata.num_row_groups - len(kept))
         field_by_name = {f.name: f for f in self.schema.fields}
 
         import numpy as _np
         import pyarrow as _pa
-
-        # the decode unit is a whole row group; cap the batch-size blowup
-        # vs the host path (which slices to batch_size_rows) to bound the
-        # device-memory spike on huge row groups
-        per = max(1, ctx.conf.batch_size_rows)
-        if any(pf.metadata.row_group(rg).num_rows > 4 * per
-               for rg in kept):
-            return None
 
         def gen():
             for rg in kept:

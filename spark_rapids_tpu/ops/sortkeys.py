@@ -115,18 +115,25 @@ def lexsort(keys: Sequence[jnp.ndarray],
     less sort work than the chained-argsort (LSD) formulation.
 
     On the CPU fallback backend, XLA's comparator sort is single-threaded
-    scalar code (~10x slower than numpy's radix-ish sorts at 1M rows), so
-    the sort itself runs as a host callback into np.lexsort — same
-    memory space, no transfer. The TPU backend keeps the pure XLA sort.
+    scalar code (~10x slower than numpy's radix-ish sorts at 1M rows). A
+    host-callback into np.lexsort recovers that — but jax.pure_callback
+    proved unsafe under CONCURRENT executions (deadlocks inside
+    shard_map; intermittent multi-minute stalls when several programs
+    with callbacks run at once, XLA callback-queue starvation), so it is
+    OPT-IN via SRTPU_HOST_SORT=1 for single-threaded batch workloads
+    only. The default is the always-correct pure XLA sort; the hot
+    paths that used to need big sorts (join builds, groupbys) now use
+    the sort-free direct/hash paths instead.
 
-    allow_host=False forces the pure XLA path: callers tracing under
-    shard_map/pmap MUST pass it — pure_callback deadlocks inside
-    multi-device shard_map on the CPU backend (all shard callback
-    threads block in np.lexsort).
+    allow_host=False force-disables the callback regardless (shard_map
+    callers).
     """
+    import os
+
     import jax
     n = keys[0].shape[0]
-    if allow_host and jax.default_backend() == "cpu" and n >= 1 << 15:
+    if (allow_host and os.environ.get("SRTPU_HOST_SORT") == "1"
+            and jax.default_backend() == "cpu" and n >= 1 << 15):
         import numpy as np
 
         def _host_lexsort(*ks):

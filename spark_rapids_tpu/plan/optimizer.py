@@ -265,14 +265,20 @@ def push_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
     if isinstance(child, L.Join):
         lnames = set(child.left.schema.names)
         rnames = set(child.right.schema.names)
-        if lnames & rnames:
-            return plan
+        # names present on BOTH sides are ambiguous in the join output:
+        # a conjunct touching one stays above; one-side-only conjuncts
+        # still push (the common on=['k'] natural-join shape)
+        shared = lnames & rnames
+        lonly = lnames - shared
+        ronly = rnames - shared
         left_ok = child.how in ("inner", "left", "left_semi", "left_anti")
         right_ok = child.how in ("inner", "right")
         lparts, rparts, rest = [], [], []
         for c in _split_conjuncts(plan.condition):
             r = refs_of(c)
-            if r is not None and r <= lnames and left_ok:
+            if r is not None and r & shared:
+                rest.append(c)
+            elif r is not None and r <= lnames and left_ok:
                 lparts.append(c)
             elif r is not None and r <= rnames and right_ok:
                 rparts.append(c)
@@ -280,12 +286,14 @@ def push_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
                 rest.append(c)
         # derived one-sided weakenings of the residual conjuncts
         for c in rest:
+            if refs_of(c) is not None and refs_of(c) & shared:
+                continue
             if left_ok:
-                d = extract_within(c, lnames)
+                d = extract_within(c, lonly)
                 if d is not None and refs_of(d) != refs_of(c):
                     lparts.append(d)
             if right_ok:
-                d = extract_within(c, rnames)
+                d = extract_within(c, ronly)
                 if d is not None and refs_of(d) != refs_of(c):
                     rparts.append(d)
         if not lparts and not rparts:

@@ -399,7 +399,8 @@ def _join(meta, conv, conf):
         from ..exec.exchange import ShuffleExchangeExec
         nparts = conf.get(SHUFFLE_PARTITIONS)
         if nparts > 1:
-            left = _maybe_bloom_prefilter(left, right, n, meta, conf)
+            left, right = _maybe_bloom_prefilter(left, right, n, meta,
+                                                 conf)
             lex = ShuffleExchangeExec(left, nparts, n.bound_left_keys,
                                       left.schema)
             rex = ShuffleExchangeExec(right, nparts, n.bound_right_keys,
@@ -426,33 +427,39 @@ def _join(meta, conv, conf):
 
 def _maybe_bloom_prefilter(left, right, n, meta, conf):
     """Wrap the stream (left) side of a shuffled equi-join in a runtime
-    bloom filter built from the (small, scan-shaped) build side, so
-    non-matching rows never reach the exchange (reference:
-    GpuBloomFilter* runtime filters via InSubqueryExec). Only for join
-    types where an unmatched stream row contributes nothing."""
+    bloom filter built from the join's OWN build side, so non-matching
+    rows never reach the exchange (reference: GpuBloomFilter* runtime
+    filters via InSubqueryExec). The build subtree is wrapped in
+    SharedBuildExec so the filter and the join's build exchange consume
+    ONE materialization — no double scan, and no scan-shape
+    restriction. Only for join types where an unmatched stream row
+    contributes nothing. Returns (left', right')."""
     from ..config import (JOIN_BLOOM_ENABLED, JOIN_BLOOM_MAX_BUILD_ROWS)
     if not conf.get(JOIN_BLOOM_ENABLED):
-        return left
+        return left, right
     if n.how not in ("inner", "left_semi", "right"):
-        return left
+        return left, right
     if len(n.bound_left_keys or []) != 1:
-        return left                      # single-key filters only
+        return left, right               # single-key filters only
     if n.bound_left_keys[0].dtype != n.bound_right_keys[0].dtype:
         # murmur3 hashes int32/int64 representations of equal values
         # differently: a mixed-width equi-join through the bloom filter
         # would silently drop matching stream rows
-        return left
+        return left, right
     from ..exec.runtime_filter import (RuntimeBloomFilterExec,
-                                       is_simple_build)
-    if not is_simple_build(right):
-        return left
+                                       SharedBuildExec)
+    max_rows = conf.get(JOIN_BLOOM_MAX_BUILD_ROWS)
     est_rows = _estimate_rows(meta.children[1].node)
-    if est_rows is None or est_rows > conf.get(
-            JOIN_BLOOM_MAX_BUILD_ROWS):
-        return left
-    return RuntimeBloomFilterExec(left, right, n.bound_left_keys[0],
+    if est_rows is None or est_rows > max_rows:
+        # no estimate (unknown-cardinality shapes): a filter sized
+        # blind can saturate (FPR ~1) and charge k probes per stream
+        # row for zero pruning — skip. Aggregates/filters/scans DO
+        # estimate (upper bounds), so non-scan builds stay eligible.
+        return left, right
+    shared = SharedBuildExec(right)
+    return RuntimeBloomFilterExec(left, shared, n.bound_left_keys[0],
                                   n.bound_right_keys[0],
-                                  max(64, int(est_rows)))
+                                  max(64, int(est_rows))), shared
 
 
 @_rule(L.WindowOp)

@@ -572,7 +572,10 @@ class DataFrame:
         """Materialize this DataFrame into HBM-resident device batches
         (GpuInMemoryTableScan analog); later queries skip decode + H2D."""
         root, ctx = self._execute()
-        batches = list(root.execute_all(ctx))
+        try:
+            batches = list(root.execute_all(ctx))
+        finally:
+            ctx.close()
         return DataFrame(self._session,
                          L.CachedScan(batches, self._plan.schema))
 
@@ -594,7 +597,10 @@ class DataFrame:
 
     def to_arrow(self):
         root, ctx = self._execute()
-        out = collect_to_arrow(root, ctx)
+        try:
+            out = collect_to_arrow(root, ctx)
+        finally:
+            ctx.close()
         self._last_metrics = {op: ms.snapshot()
                               for op, ms in ctx.metrics.items()}
         return out
@@ -619,9 +625,12 @@ class DataFrame:
                     f"to_jax exports fixed-width columns; {f.name} is "
                     f"{f.dtype.simple_name()} (use to_arrow)")
         root, ctx = self._execute()
-        batches = []
-        for pid in range(root.num_partitions(ctx)):
-            batches.extend(root.execute_partition(ctx, pid))
+        try:
+            batches = []
+            for pid in range(root.num_partitions(ctx)):
+                batches.extend(root.execute_partition(ctx, pid))
+        finally:
+            ctx.close()
         if not batches:
             import jax.numpy as jnp
             return {f.name: (jnp.zeros(0, f.dtype.np_dtype),
@@ -678,8 +687,11 @@ class DataFrame:
         import pyarrow as pa
         from .exec.nodes import _batch_to_arrow
         root, ctx = self._execute()
-        for pid in range(root.num_partitions(ctx)):
-            tables = [_batch_to_arrow(b)
-                      for b in root.execute_partition(ctx, pid)]
-            if tables:
-                yield pa.concat_tables(tables)
+        try:
+            for pid in range(root.num_partitions(ctx)):
+                tables = [_batch_to_arrow(b)
+                          for b in root.execute_partition(ctx, pid)]
+                if tables:
+                    yield pa.concat_tables(tables)
+        finally:
+            ctx.close()

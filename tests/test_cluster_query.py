@@ -108,3 +108,85 @@ def test_empty_tables_keeps_arity():
         assert res.meta == {"tag": "empty", "n": 0}
     finally:
         cm.shutdown()
+
+
+def test_p2p_shuffle_driver_moves_metadata_only(tmp_path):
+    """P2P shuffle (RapidsShuffleInternalManagerBase.scala:56 analog):
+    map tasks return dict METADATA (addr + sizes), never Arrow tables
+    through the driver; reducers fetch blocks peer-to-peer."""
+    from spark_rapids_tpu.cluster import query as qmod
+
+    splits, tables = _write_splits(tmp_path, n_splits=3)
+    want = _rows(_local_q3(tables))
+    seen = []
+    orig = qmod.map_fragment_task
+
+    cm = ClusterManager(2)
+    cm.start()
+    try:
+        runner = DistributedRunner(
+            cm, {"spark.rapids.tpu.sql.batchSizeRows": 8192})
+        # wrap submit to capture every map-task RESULT the driver sees
+        real_submit = cm.submit
+
+        def spy_submit(fn, *args, **kw):
+            fut = real_submit(fn, *args, **kw)
+            if fn is qmod.map_fragment_task:
+                seen.append(fut)
+            return fut
+
+        cm.submit = spy_submit
+        got = runner.run(splits, tpch_cluster.q3_map,
+                         part_keys=["l_orderkey"],
+                         reduce_fn=tpch_cluster.q3_reduce,
+                         n_reduce=3, final_fn=tpch_cluster.q3_final)
+    finally:
+        cm.shutdown()
+    assert _rows(got)[:3] == want[:3]
+    assert len(seen) == 3
+    for f in seen:
+        meta = f.result()
+        # metadata dict, NOT an ArrowResult carrying shuffle bytes
+        assert isinstance(meta, dict) and "addr" in meta
+        assert "sizes" in meta and all(
+            isinstance(v, int) for v in meta["sizes"].values())
+
+
+def test_p2p_fetch_failure_reexecutes_lineage(tmp_path):
+    """A reducer that cannot reach a mapper's block server triggers
+    re-execution of the affected map splits (idempotent lineage), and
+    the query still answers correctly."""
+    from spark_rapids_tpu.cluster import query as qmod
+
+    splits, tables = _write_splits(tmp_path, n_splits=2)
+    want = _rows(_local_q3(tables))
+
+    cm = ClusterManager(2)
+    cm.start()
+    try:
+        runner = DistributedRunner(
+            cm, {"spark.rapids.tpu.sql.batchSizeRows": 8192})
+        real_submit = cm.submit
+        state = {"broken": False}
+
+        def breaking_submit(fn, *args, **kw):
+            if fn is qmod.reduce_fetch_task and not state["broken"]:
+                # corrupt the first reduce's sources: unreachable addr
+                state["broken"] = True
+                args = list(args)
+                # args = (reduce_fn, conf, shuffle_id, pid, sources)
+                args[4] = [(["127.0.0.1", 1], ids)
+                           for _a, ids in args[4]]
+                args = tuple(args)
+            return real_submit(fn, *args, **kw)
+
+        cm.submit = breaking_submit
+        got = runner.run(splits, tpch_cluster.q3_map,
+                         part_keys=["l_orderkey"],
+                         reduce_fn=tpch_cluster.q3_reduce,
+                         n_reduce=2, final_fn=tpch_cluster.q3_final)
+    finally:
+        cm.shutdown()
+    assert state["broken"]
+    got_rows = _rows(got)
+    assert [r[:3] for r in got_rows] == [r[:3] for r in want]
