@@ -233,6 +233,21 @@ PYTHON_CONCURRENT_WORKERS = _conf(
     "acquisition blocks above it (reference: "
     "spark.rapids.python.concurrentPythonWorkers, "
     "PythonWorkerSemaphore).", int)
+DELTA_AUTOCOMPACT_MIN_FILES = _conf(
+    "delta.autoCompact.minFiles", 0,
+    "When > 0, a Delta append auto-compacts once the table holds at "
+    "least this many live files smaller than half the target size "
+    "(reference: delta auto-compaction / "
+    "GpuOptimizeWriteExchangeExec). 0 disables.", int)
+DELTA_AUTOCOMPACT_TARGET_BYTES = _conf(
+    "delta.autoCompact.targetBytes", 128 << 20,
+    "Target output file size for Delta OPTIMIZE / auto-compaction.",
+    int)
+PYTHON_GROUPED_CHUNK_BYTES = _conf(
+    "python.groupedChunkBytes", 64 << 20,
+    "applyInPandas/aggregate-in-pandas partitions larger than this "
+    "many host bytes ship to the python worker in chunks cut at GROUP "
+    "boundaries (OOM-safe: a group is never split).", int)
 RETRY_COVERAGE_ENABLED = _conf(
     "memory.retryCoverage.enabled", False,
     "Track, per engine call-site, whether device allocations happen "

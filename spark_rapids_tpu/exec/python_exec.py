@@ -195,6 +195,87 @@ class PythonWorkerPool:
             _global_release()
 
 
+class _GroupApply:
+    """Picklable worker-side wrapper: whole groups arrive inside one
+    partition (repartitioned by key); the worker groups the pandas frame
+    and applies the user fn per group (FlatMapGroupsInPandas semantics —
+    reference: GpuFlatMapGroupsInPandasExec)."""
+
+    def __init__(self, fn, keys, drop_keys: bool = False):
+        self.fn = fn
+        self.keys = list(keys)
+        self.drop_keys = drop_keys
+
+    def __call__(self, pdf):
+        import pandas as pd
+        outs = []
+        for _k, g in pdf.groupby(self.keys, dropna=False, sort=False):
+            if self.drop_keys:
+                g = g.drop(columns=self.keys)
+            out = self.fn(g)
+            if out is not None and len(out):
+                outs.append(out)
+        if not outs:
+            return pd.DataFrame()
+        return pd.concat(outs, ignore_index=True)
+
+
+class _AggApply:
+    """Picklable worker-side wrapper for AggregateInPandas: one output
+    row per group — key columns + one scalar per named aggregate
+    (reference: GpuAggregateInPandasExec.scala:51)."""
+
+    def __init__(self, aggs, keys):
+        self.aggs = aggs       # {out_name: (fn, [col, ...])}
+        self.keys = list(keys)
+
+    def __call__(self, pdf):
+        import pandas as pd
+        rows = []
+        for kv, g in pdf.groupby(self.keys, dropna=False, sort=False):
+            if not isinstance(kv, tuple):
+                kv = (kv,)
+            row = dict(zip(self.keys, kv))
+            for name, (fn, cols) in self.aggs.items():
+                row[name] = fn(*[g[c] for c in cols])
+            rows.append(row)
+        if not rows:
+            return pd.DataFrame()
+        return pd.DataFrame(rows)
+
+
+class _CoGroupApply:
+    """Picklable worker-side wrapper for FlatMapCoGroupsInPandas: the
+    two sides arrive concatenated with a __side marker; groups match on
+    key EQUALITY across sides (missing side -> empty frame)."""
+
+    def __init__(self, fn, lkeys, rkeys, lcols, rcols):
+        self.fn = fn
+        self.lkeys = list(lkeys)
+        self.rkeys = list(rkeys)
+        self.lcols = list(lcols)
+        self.rcols = list(rcols)
+
+    def __call__(self, pdf):
+        import pandas as pd
+        left = pdf[pdf["__side"] == 0][self.lcols]
+        right = pdf[pdf["__side"] == 1][self.rcols]
+        lg = {k: g for k, g in left.groupby(self.lkeys, dropna=False,
+                                            sort=False)}
+        rg = {k: g for k, g in right.groupby(self.rkeys, dropna=False,
+                                             sort=False)}
+        outs = []
+        for k in list(lg.keys()) + [k for k in rg if k not in lg]:
+            gl = lg.get(k, left.iloc[0:0])
+            gr = rg.get(k, right.iloc[0:0])
+            out = self.fn(gl, gr)
+            if out is not None and len(out):
+                outs.append(out)
+        if not outs:
+            return pd.DataFrame()
+        return pd.concat(outs, ignore_index=True)
+
+
 class ArrowEvalPythonExec(TpuExec):
     """mapInPandas: each input batch crosses to a python worker as an
     Arrow IPC stream and the pandas result re-uploads (reference:
@@ -222,6 +303,129 @@ class ArrowEvalPythonExec(TpuExec):
             self._pool = None
         super().release()
 
+    def _ship(self, pool, at, m, out_arrow):
+        import pyarrow as pa
+        sink = pa.BufferOutputStream()
+        with pa.ipc.new_stream(sink, at.schema) as w:
+            w.write_table(at)
+        res_bytes = pool.run(sink.getvalue().to_pybytes())
+        with pa.ipc.open_stream(res_bytes) as rd:
+            res = rd.read_all()
+        if res.num_rows == 0:
+            return None
+        res = res.cast(out_arrow)
+        tbl = Table.from_arrow(res)
+        m.add("numOutputRows", res.num_rows)
+        m.add("numOutputBatches", 1)
+        return DeviceBatch(tbl, num_rows=res.num_rows)
+
+    def execute_partition(self, ctx: ExecContext,
+                          pid: int) -> Iterator[DeviceBatch]:
+        from .nodes import _batch_to_arrow
+        m = ctx.metrics_for(self._op_id)
+        pool = self._ensure_pool(ctx)
+        out_arrow = self.schema.to_arrow()
+        for batch in self.children[0].execute_partition(ctx, pid):
+            with m.timer("pythonEvalTime"):
+                out = self._ship(pool, _batch_to_arrow(batch), m,
+                                 out_arrow)
+            if out is not None:
+                yield out
+
+
+class GroupedMapPythonExec(ArrowEvalPythonExec):
+    """applyInPandas / aggregate-in-pandas: the child is repartitioned
+    by the grouping keys so every group is whole within one partition;
+    the partition ships to a python worker as ONE frame (the wrapper
+    does the per-group apply). Oversized partitions chunk at GROUP
+    boundaries — OOM-safe without splitting a group (reference:
+    GpuFlatMapGroupsInPandasExec / GpuAggregateInPandasExec)."""
+
+    def __init__(self, child: TpuExec, fn: Callable, schema: Schema,
+                 key_names):
+        super().__init__(child, fn, schema)
+        self.key_names = list(key_names)
+
+    def describe(self):
+        name = getattr(getattr(self.fn, "fn", self.fn), "__name__", "fn")
+        return f"GroupedMapPythonExec[{name}, keys={self.key_names}]"
+
+    def execute_partition(self, ctx: ExecContext,
+                          pid: int) -> Iterator[DeviceBatch]:
+        import pyarrow as pa
+        from ..config import PYTHON_GROUPED_CHUNK_BYTES
+        from .nodes import _batch_to_arrow
+        m = ctx.metrics_for(self._op_id)
+        pool = self._ensure_pool(ctx)
+        out_arrow = self.schema.to_arrow()
+        parts = [_batch_to_arrow(b) for b in
+                 self.children[0].execute_partition(ctx, pid)]
+        parts = [p for p in parts if p.num_rows]
+        if not parts:
+            return
+        at = pa.concat_tables(parts)
+        limit = ctx.conf.get(PYTHON_GROUPED_CHUNK_BYTES)
+        with m.timer("pythonEvalTime"):
+            if at.nbytes <= limit:
+                chunks = [at]
+            else:
+                # chunk at group boundaries: sort host rows by key so
+                # each group is contiguous, then greedy-pack whole
+                # groups under the byte limit
+                keys = [at.column(k) for k in self.key_names]
+                order = pa.compute.sort_indices(
+                    pa.table({f"k{i}": c for i, c in enumerate(keys)}),
+                    sort_keys=[(f"k{i}", "ascending")
+                               for i in range(len(keys))])
+                at = at.take(order)
+                import pandas as pd
+                kdf = at.select(self.key_names).to_pandas()
+                import numpy as np
+                prev = kdf.shift()
+                # NaN != NaN would split the null-key group (dropna=False
+                # groups) at every row — treat both-null as equal
+                diff = (kdf != prev) & ~(kdf.isna() & prev.isna())
+                new_grp = np.array(diff.any(axis=1).to_numpy())
+                new_grp[0] = True
+                starts = np.flatnonzero(new_grp)
+                bpr = max(1, at.nbytes // max(at.num_rows, 1))
+                rows_per_chunk = max(1, limit // bpr)
+                chunks = []
+                lo = 0
+                while lo < at.num_rows:
+                    target = lo + rows_per_chunk
+                    nxt = starts[starts > lo]
+                    cut = (at.num_rows if target >= at.num_rows
+                           else int(nxt[nxt >= target][0])
+                           if (nxt >= target).any() else at.num_rows)
+                    chunks.append(at.slice(lo, cut - lo))
+                    lo = cut
+            m.add("numGroupChunks", len(chunks))
+            for c in chunks:
+                out = self._ship(pool, c, m, out_arrow)
+                if out is not None:
+                    yield out
+
+
+class CoGroupPythonExec(ArrowEvalPythonExec):
+    """FlatMapCoGroupsInPandas: both (key-repartitioned) sides of a
+    cogroup ship together with a __side marker; the worker wrapper
+    matches groups by key equality and applies fn(left_df, right_df)
+    (reference: GpuFlatMapCoGroupsInPandasExec)."""
+
+    def __init__(self, left: TpuExec, right: TpuExec, fn: Callable,
+                 schema: Schema):
+        TpuExec.__init__(self, [left, right], schema)
+        self.fn = fn
+        self._pool = None
+
+    def describe(self):
+        name = getattr(getattr(self.fn, "fn", self.fn), "__name__", "fn")
+        return f"CoGroupPythonExec[{name}]"
+
+    def num_partitions(self, ctx):
+        return self.children[0].num_partitions(ctx)
+
     def execute_partition(self, ctx: ExecContext,
                           pid: int) -> Iterator[DeviceBatch]:
         import pyarrow as pa
@@ -229,19 +433,24 @@ class ArrowEvalPythonExec(TpuExec):
         m = ctx.metrics_for(self._op_id)
         pool = self._ensure_pool(ctx)
         out_arrow = self.schema.to_arrow()
-        for batch in self.children[0].execute_partition(ctx, pid):
-            with m.timer("pythonEvalTime"):
-                at = _batch_to_arrow(batch)
-                sink = pa.BufferOutputStream()
-                with pa.ipc.new_stream(sink, at.schema) as w:
-                    w.write_table(at)
-                res_bytes = pool.run(sink.getvalue().to_pybytes())
-                with pa.ipc.open_stream(res_bytes) as rd:
-                    res = rd.read_all()
-            if res.num_rows == 0:
-                continue
-            res = res.cast(out_arrow)
-            tbl = Table.from_arrow(res)
-            m.add("numOutputRows", res.num_rows)
-            m.add("numOutputBatches", 1)
-            yield DeviceBatch(tbl, num_rows=res.num_rows)
+
+        def side_table(child, side):
+            parts = [_batch_to_arrow(b)
+                     for b in child.execute_partition(ctx, pid)]
+            parts = [p for p in parts if p.num_rows]
+            if not parts:
+                return None
+            t = pa.concat_tables(parts)
+            return t.append_column(
+                "__side", pa.array([side] * t.num_rows, pa.int8()))
+
+        with m.timer("pythonEvalTime"):
+            lt = side_table(self.children[0], 0)
+            rt = side_table(self.children[1], 1)
+            tabs = [t for t in (lt, rt) if t is not None]
+            if not tabs:
+                return
+            at = pa.concat_tables(tabs, promote_options="default")
+            out = self._ship(pool, at, m, out_arrow)
+        if out is not None:
+            yield out

@@ -4,15 +4,15 @@ Reference: the plugin's runtime filter path — GpuBloomFilterAggregate
 feeding GpuBloomFilterMightContain through InSubqueryExec so the fact
 side of a join drops non-matching rows BEFORE the shuffle. Standalone
 analog: the planner wraps the STREAM side of a shuffled equi-join in
-RuntimeBloomFilterExec, which on first execution runs the (simple,
-scan-shaped) build subtree once, folds the build keys into a device
-bloom-filter bit vector, and then masks every stream batch by k-hash
+RuntimeBloomFilterExec, which folds the build keys into a device
+bloom-filter bit vector and then masks every stream batch by k-hash
 membership — rows that cannot match never reach the exchange.
 
 Only sound for join types where a stream row WITHOUT a build match
-contributes nothing (inner, left_semi, right); the planner enforces
-that plus a scan-shaped build subtree (re-executing it is cheap and
-side-effect-free)."""
+contributes nothing (inner, left_semi, right). The build subtree is
+wrapped in SharedBuildExec, so the filter and the join's build exchange
+consume ONE materialization — any build shape with a row-count estimate
+is eligible (no re-execution, no scan-shape restriction)."""
 from __future__ import annotations
 
 import threading
@@ -143,26 +143,3 @@ class RuntimeBloomFilterExec(TpuExec):
             m.add("numOutputBatches", 1)
             yield DeviceBatch(batch.table, batch.num_rows, new_mask,
                               batch.capacity)
-
-
-_SIMPLE_BUILD = None
-
-
-def is_simple_build(e: TpuExec) -> bool:
-    """True when re-executing the subtree is cheap and side-effect-free
-    (scan/filter/project/coalesce chains only — no exchanges, joins,
-    aggregates, or window state)."""
-    global _SIMPLE_BUILD
-    if _SIMPLE_BUILD is None:
-        from .coalesce import CoalesceBatchesExec
-        from .nodes import (CachedScanExec, FilterExec, InMemoryScanExec,
-                            LimitExec, ParquetScanExec, ProjectExec)
-        from .text_scan import (AvroScanExec, CsvScanExec, JsonScanExec,
-                                OrcScanExec)
-        _SIMPLE_BUILD = (CachedScanExec, FilterExec, InMemoryScanExec,
-                         LimitExec, ParquetScanExec, ProjectExec,
-                         CoalesceBatchesExec, AvroScanExec, CsvScanExec,
-                         JsonScanExec, OrcScanExec)
-    if not isinstance(e, _SIMPLE_BUILD):
-        return False
-    return all(is_simple_build(c) for c in e.children)

@@ -97,6 +97,62 @@ def _rmq(x, valid, lo, hi, is_min: bool, nlev: int):
     return out, oa & nonempty
 
 
+def _d128_lt(al, ah, bl, bh):
+    """Lexicographic two's-complement 128-bit compare: signed hi limb,
+    lo limb mapped to unsigned order via a sign-bit flip."""
+    ul = al ^ jnp.int64(-2 ** 63)
+    vl = bl ^ jnp.int64(-2 ** 63)
+    return (ah < bh) | ((ah == bh) & (ul < vl))
+
+
+def _rmq_d128(x2, valid, lo, hi, is_min: bool, nlev: int):
+    """Two-limb sparse-table RMQ (the decimal128 analog of `_rmq`):
+    T[j][i] = min/max over [i, i+2^j) under the lexicographic
+    (hi signed, lo sign-flipped) order. Returns ((cap,2) packed limbs,
+    any_valid). Reference: cudf rolling min/max windows over DECIMAL128
+    (window/GpuWindowExec family)."""
+    cap = x2.shape[0]
+    hi_id = jnp.int64(jnp.iinfo(jnp.int64).max if is_min
+                      else jnp.iinfo(jnp.int64).min)
+    lo_id = jnp.int64(-1) if is_min else jnp.int64(0)
+    cl = jnp.where(valid, x2[:, 0], lo_id)
+    ch = jnp.where(valid, x2[:, 1], hi_id)
+    cok = valid
+
+    def red(al, ah, bl, bh):
+        a_wins = _d128_lt(al, ah, bl, bh) if is_min \
+            else _d128_lt(bl, bh, al, ah)
+        return (jnp.where(a_wins, al, bl), jnp.where(a_wins, ah, bh))
+
+    levels = [(cl, ch)]
+    oks = [cok]
+    for j in range(1, nlev):
+        sh = 1 << (j - 1)
+        if sh >= cap:
+            levels.append((cl, ch))
+            oks.append(cok)
+            continue
+        sl = jnp.concatenate([cl[sh:], jnp.full((sh,), lo_id)])
+        shh = jnp.concatenate([ch[sh:], jnp.full((sh,), hi_id)])
+        sok = jnp.concatenate([cok[sh:], jnp.zeros(sh, jnp.bool_)])
+        cl, ch = red(cl, ch, sl, shh)
+        cok = cok | sok
+        levels.append((cl, ch))
+        oks.append(cok)
+    TL = jnp.stack([a for a, _ in levels]).reshape(-1)
+    TH = jnp.stack([b for _, b in levels]).reshape(-1)
+    TO = jnp.stack(oks).reshape(-1)
+    length = jnp.maximum(hi - lo + 1, 1)
+    j = jnp.clip(_floor_log2(length), 0, nlev - 1)
+    a_idx = jnp.clip(lo, 0, cap - 1)
+    b_idx = jnp.clip(hi - (1 << j.astype(jnp.int64)) + 1, 0, cap - 1)
+    ja = j.astype(jnp.int64) * cap
+    rl, rh = red(TL[ja + a_idx], TH[ja + a_idx],
+                 TL[ja + b_idx], TH[ja + b_idx])
+    ok = (TO[ja + a_idx] | TO[ja + b_idx]) & (hi >= lo)
+    return jnp.stack([rl, rh], axis=1), ok
+
+
 def _bsearch(skey, q, lo0, hi0, nbits: int, left: bool,
              descending: bool):
     """Per-row binary search over the (segment-)sorted key array: returns
@@ -753,12 +809,20 @@ class WindowExec(TpuExec):
             return CV(jnp.where(ok[:, None], packed, 0), ok)
 
         # general bounded frame: prefix-difference per limb (signed
-        # diffs normalize exactly); bounded min/max needs a two-limb
-        # RMQ — not yet
+        # diffs normalize exactly); min/max via the two-limb sparse
+        # table RMQ
         if w.fn in ("min", "max"):
-            raise UnsupportedExpr(
-                f"bounded-frame window {w.fn} over decimal precision "
-                f"> 18 (cast to double or a narrower decimal first)")
+            import math
+            lo_b, hi_b, max_len = self._frame_bounds(w, wc)
+            x2 = (x if x.ndim == 2
+                  else jnp.stack([x.astype(jnp.int64),
+                                  x.astype(jnp.int64) >> 63], axis=1))
+            nlev = max(1, int(math.ceil(math.log2(
+                max(2, min(max_len, cap))))) + 1)
+            packed, ok = _rmq_d128(x2, valid, lo_b, hi_b,
+                                   w.fn == "min", nlev)
+            ok = ok & live
+            return CV(jnp.where(ok[:, None], packed, 0), ok)
         lo_b, hi_b, _ = self._frame_bounds(w, wc)
         lo_idx = jnp.clip(lo_b - 1, 0, cap - 1)
         hi_idx = jnp.clip(hi_b, 0, cap - 1)

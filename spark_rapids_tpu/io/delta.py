@@ -18,7 +18,8 @@ import uuid
 from typing import Dict, List, Optional
 
 __all__ = ["DeltaTable", "write_delta", "read_delta", "delete_delta",
-           "update_delta", "merge_delta", "CHECKPOINT_INTERVAL"]
+           "update_delta", "merge_delta", "optimize_delta",
+           "maybe_auto_compact", "CHECKPOINT_INTERVAL"]
 
 CHECKPOINT_INTERVAL = 10
 
@@ -193,6 +194,8 @@ def write_delta(df, path: str, mode: str = "append"):
             "operation": op, "timestamp": int(time.time() * 1000)}})
         if table.try_commit(actions, latest + 1):
             table.maybe_checkpoint(latest + 1)
+            if mode == "append":
+                maybe_auto_compact(df._session, path, df._session.conf)
             return latest + 1
 
 
@@ -484,3 +487,124 @@ def merge_delta(session, path: str, source_df, on: List[str],
         return actions
 
     return _commit_dml(table, build, "MERGE")
+
+
+# ---- OPTIMIZE / auto-compaction / z-order -----------------------------
+def _zorder_indices(at, zorder_by: List[str]):
+    """Row order by interleaved-bit (Morton) z-value over the given
+    numeric columns: each column min-max normalizes to 16 bits, bits
+    interleave MSB-first (reference: sql-plugin zorder/ZOrderRules.scala
+    + JNI ZOrder interleave_bits)."""
+    import numpy as np
+    cols = []
+    for name in zorder_by:
+        v = at.column(name).to_numpy(zero_copy_only=False).astype(
+            np.float64)
+        v = np.where(np.isnan(v), 0.0, v)
+        lo, hi = float(v.min()), float(v.max())
+        span = (hi - lo) or 1.0
+        cols.append(((v - lo) / span * 65535.0).astype(np.uint64))
+    z = np.zeros(at.num_rows, np.uint64)
+    for bit in range(15, -1, -1):
+        for c in cols:
+            z = (z << np.uint64(1)) | ((c >> np.uint64(bit))
+                                       & np.uint64(1))
+    return np.argsort(z, kind="stable")
+
+
+def optimize_delta(session, path: str, zorder_by: Optional[List[str]]
+                   = None, target_file_bytes: int = 128 << 20,
+                   min_files: int = 2) -> dict:
+    """OPTIMIZE: bin-pack small live files into ~target-sized files
+    (deletion vectors applied — survivors carry forward, DV files
+    retire), optionally z-order clustering rows by interleaved bits.
+    One commit, operation OPTIMIZE, dataChange=False (the rewrite
+    changes layout, not content — downstream streaming readers skip
+    it). Returns {filesRemoved, filesAdded, version} (reference:
+    delta-lake GpuOptimizeWriteExchangeExec + zorder/ZOrderRules).
+
+    Auto-compaction (write_delta with
+    spark.rapids.tpu.delta.autoCompact.minFiles) calls this after
+    appends once the small-file count crosses the threshold."""
+    import pyarrow as pa
+
+    table = DeltaTable(path)
+    latest = table.latest_version()
+    if latest < 0:
+        raise FileNotFoundError(f"not a delta table: {path}")
+
+    def plan_groups():
+        """Snapshot + grouping — recomputed INSIDE every commit
+        attempt: a race-loss retry must not replay remove/rewrite
+        actions against a stale snapshot (a concurrent DELETE's
+        rewrite would be resurrected)."""
+        adds = table.snapshot_adds()
+        # z-order rewrites everything; plain compaction only groups of
+        # small files (or DV-carrying files, which fold their DVs in)
+        if zorder_by:
+            return [adds] if adds else []
+        small = [a for a in adds
+                 if a.get("size", 0) < target_file_bytes // 2
+                 or a.get("deletionVector")]
+        return [small] if len(small) >= min_files else []
+
+    if not plan_groups():
+        return {"filesRemoved": 0, "filesAdded": 0,
+                "version": latest}
+
+    def build_actions():
+        actions: List[dict] = []
+        removed = 0
+        added = 0
+        for group in plan_groups():
+            tabs = []
+            for add in group:
+                t = _file_df(session, table, add).to_arrow()
+                if t.num_rows:
+                    tabs.append(t)
+                actions.append(_remove_action(add["path"]))
+                removed += 1
+            if not tabs:
+                continue
+            at = pa.concat_tables(tabs)
+            if zorder_by:
+                import pyarrow as _pa
+                idx = _zorder_indices(at, zorder_by)
+                at = at.take(_pa.array(idx, type=_pa.int64()))
+            # slice into ~target-byte output files
+            bpr = max(1, at.nbytes // max(at.num_rows, 1))
+            rows_per_file = max(1, target_file_bytes // bpr)
+            off = 0
+            while off < at.num_rows:
+                part = at.slice(off, rows_per_file)
+                a = _write_rows(session, part, path)
+                if a:
+                    a["add"]["dataChange"] = False
+                    actions.append(a)
+                    added += 1
+                off += rows_per_file
+        build_actions.stats = (removed, added)
+        return actions
+
+    v = _commit_dml(table, build_actions, "OPTIMIZE")
+    removed, added = build_actions.stats
+    return {"filesRemoved": removed, "filesAdded": added, "version": v}
+
+
+def maybe_auto_compact(session, path: str, conf) -> Optional[dict]:
+    """Post-append auto-compaction: when the table has >= minFiles live
+    files smaller than half the target, compact them (reference:
+    delta auto-compaction / GpuOptimizeWriteExchangeExec)."""
+    from ..config import (DELTA_AUTOCOMPACT_MIN_FILES,
+                          DELTA_AUTOCOMPACT_TARGET_BYTES)
+    min_files = conf.get(DELTA_AUTOCOMPACT_MIN_FILES)
+    if min_files <= 0:
+        return None
+    target = conf.get(DELTA_AUTOCOMPACT_TARGET_BYTES)
+    table = DeltaTable(path)
+    adds = table.snapshot_adds()
+    small = [a for a in adds if a.get("size", 0) < target // 2]
+    if len(small) < min_files:
+        return None
+    return optimize_delta(session, path, target_file_bytes=target,
+                          min_files=min_files)

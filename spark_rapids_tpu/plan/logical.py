@@ -428,6 +428,50 @@ class MapInPandas(LogicalPlan):
         return f"MapInPandas[{name}]"
 
 
+class GroupedMapInPandas(LogicalPlan):
+    """Per-group pandas transform (applyInPandas / AggregateInPandas):
+    the planner repartitions by key so groups are whole per partition
+    (reference: GpuFlatMapGroupsInPandasExec,
+    GpuAggregateInPandasExec.scala:51). `fn` is the worker-side wrapper
+    (already closed over the user function + keys)."""
+
+    def __init__(self, child: LogicalPlan, fn, schema: Schema,
+                 key_names):
+        self.child = child
+        self.children = [child]
+        self.fn = fn
+        self._schema = schema
+        self.key_names = list(key_names)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"GroupedMapInPandas[keys={self.key_names}]"
+
+
+class CoGroupInPandas(LogicalPlan):
+    """Cogrouped pandas transform (reference:
+    GpuFlatMapCoGroupsInPandasExec): both children repartition by their
+    keys; fn is the worker-side _CoGroupApply wrapper."""
+
+    def __init__(self, left: LogicalPlan, right: LogicalPlan, fn,
+                 schema: Schema, lkeys, rkeys):
+        self.children = [left, right]
+        self.fn = fn
+        self._schema = schema
+        self.lkeys = list(lkeys)
+        self.rkeys = list(rkeys)
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def describe(self):
+        return f"CoGroupInPandas[{self.lkeys} x {self.rkeys}]"
+
+
 class Repartition(LogicalPlan):
     def __init__(self, child: LogicalPlan, num_partitions: int,
                  keys: Optional[Sequence[Expression]] = None):

@@ -513,6 +513,41 @@ def _map_in_pandas(meta, conv, conf):
     return ArrowEvalPythonExec(conv(meta.children[0]), n.fn, n.schema)
 
 
+@_rule(L.GroupedMapInPandas)
+def _grouped_map_in_pandas(meta, conv, conf):
+    from ..config import SHUFFLE_PARTITIONS
+    from ..exec.exchange import ShuffleExchangeExec
+    from ..exec.python_exec import GroupedMapPythonExec
+    from ..expr.expressions import col as _col
+    n = meta.node
+    child = conv(meta.children[0])
+    nparts = max(1, conf.get(SHUFFLE_PARTITIONS))
+    keys = [_col(k).bind(n.children[0].schema) for k in n.key_names]
+    # ALWAYS exchange: even at nparts=1 a multi-partition child must
+    # gather so a key spanning source partitions stays one group
+    child = ShuffleExchangeExec(child, nparts, keys, child.schema)
+    return GroupedMapPythonExec(child, n.fn, n.schema, n.key_names)
+
+
+@_rule(L.CoGroupInPandas)
+def _cogroup_in_pandas(meta, conv, conf):
+    from ..config import SHUFFLE_PARTITIONS
+    from ..exec.exchange import ShuffleExchangeExec
+    from ..exec.python_exec import CoGroupPythonExec
+    from ..expr.expressions import col as _col
+    n = meta.node
+    left = conv(meta.children[0])
+    right = conv(meta.children[1])
+    nparts = max(1, conf.get(SHUFFLE_PARTITIONS))
+    # ALWAYS exchange (even nparts=1): aligns partition counts across
+    # the two sides and gathers split groups
+    lkeys = [_col(k).bind(n.children[0].schema) for k in n.lkeys]
+    rkeys = [_col(k).bind(n.children[1].schema) for k in n.rkeys]
+    left = ShuffleExchangeExec(left, nparts, lkeys, left.schema)
+    right = ShuffleExchangeExec(right, nparts, rkeys, right.schema)
+    return CoGroupPythonExec(left, right, n.fn, n.schema)
+
+
 @_rule(L.Repartition)
 def _repart(meta, conv, conf):
     from ..config import MESH_DEVICES

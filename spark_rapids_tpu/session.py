@@ -219,6 +219,69 @@ class GroupedData:
         # GROUP BY; reference: GpuExpandExec.scala projections
         self._grouping_sets = grouping_sets
 
+    def _key_names(self):
+        names = [getattr(k, "name", None) for k in self._keys]
+        if any(n is None for n in names):
+            raise ValueError("pandas group transforms need plain column "
+                             "keys (got computed expressions)")
+        return names
+
+    @staticmethod
+    def _out_schema(schema):
+        from .columnar import dtypes as _dt
+        from .columnar.table import Field, Schema as _Schema
+        if isinstance(schema, _Schema):
+            return schema
+        if isinstance(schema, (list, tuple)):
+            return _Schema([Field(n, t) for n, t in schema])
+        return _Schema([Field(f.name, _dt.from_arrow(f.type))
+                        for f in schema])
+
+    def apply_in_pandas(self, fn, schema) -> "DataFrame":
+        """Per-group pandas transform: `fn(pandas.DataFrame) ->
+        pandas.DataFrame` runs once per group in a pooled python worker
+        (reference: GroupedData.applyInPandas /
+        GpuFlatMapGroupsInPandasExec). Groups are repartitioned whole;
+        oversized partitions chunk at group boundaries."""
+        from .exec.python_exec import _GroupApply
+        out = self._out_schema(schema)
+        names = self._key_names()
+        return DataFrame(self._df._session, L.GroupedMapInPandas(
+            self._df._plan, _GroupApply(fn, names), out, names))
+
+    applyInPandas = apply_in_pandas
+
+    def agg_in_pandas(self, _types=None, **named) -> "DataFrame":
+        """AggregateInPandas (reference:
+        GpuAggregateInPandasExec.scala:51): each kwarg is
+        name=(fn, col[, col...]); fn receives pandas Series (one per
+        col) for ONE group and returns a scalar. Output: key columns +
+        one row per group. Aggregate outputs default to FLOAT64;
+        non-float results declare their dtype via
+        `_types={name: DataType}`."""
+        from .columnar import dtypes as _dt
+        from .columnar.table import Field, Schema as _Schema
+        from .exec.python_exec import _AggApply
+        names = self._key_names()
+        aggs = {}
+        for out_name, spec in named.items():
+            fn = spec[0]
+            cols = [getattr(c, "name", c) for c in spec[1:]]
+            aggs[out_name] = (fn, cols)
+        child_schema = self._df._plan.schema
+        fields = [Field(n, child_schema[child_schema.index_of(n)].dtype)
+                  for n in names]
+        fields += [Field(n, (_types or {}).get(n, _dt.FLOAT64))
+                   for n in aggs]
+        out = _Schema(fields)
+        return DataFrame(self._df._session, L.GroupedMapInPandas(
+            self._df._plan, _AggApply(aggs, names), out, names))
+
+    def cogroup(self, other: "GroupedData") -> "CoGroupedData":
+        """Pair two grouped frames for applyInPandas over matching key
+        groups (reference: GpuFlatMapCoGroupsInPandasExec)."""
+        return CoGroupedData(self, other)
+
     def agg(self, *aggs, **named_aggs) -> "DataFrame":
         pairs = []
         gid_cols = []
@@ -272,6 +335,30 @@ class GroupedData:
     def count(self) -> "DataFrame":
         from .expr.aggregates import CountStar
         return self.agg(CountStar().alias("count"))
+
+
+class CoGroupedData:
+    def __init__(self, left: GroupedData, right: GroupedData):
+        self._left = left
+        self._right = right
+
+    def apply_in_pandas(self, fn, schema) -> "DataFrame":
+        """`fn(left_df, right_df) -> pandas.DataFrame` per matching key
+        group (either side may be empty)."""
+        from .exec.python_exec import _CoGroupApply
+        out = GroupedData._out_schema(schema)
+        lnames = self._left._key_names()
+        rnames = self._right._key_names()
+        if len(lnames) != len(rnames):
+            raise ValueError("cogroup key counts differ")
+        lcols = list(self._left._df.schema.names)
+        rcols = list(self._right._df.schema.names)
+        wrapper = _CoGroupApply(fn, lnames, rnames, lcols, rcols)
+        return DataFrame(self._left._df._session, L.CoGroupInPandas(
+            self._left._df._plan, self._right._df._plan, wrapper, out,
+            lnames, rnames))
+
+    applyInPandas = apply_in_pandas
 
 
 class DataFrame:

@@ -38,7 +38,7 @@ _KEYWORDS = {"select", "from", "where", "group", "by", "order", "limit",
              "case", "when", "then", "else", "end", "cast", "join",
              "inner", "left", "right", "full", "outer", "on", "using",
              "asc", "desc", "distinct", "like", "true", "false", "semi",
-             "anti", "cross", "having"}
+             "anti", "cross", "having", "exists"}
 
 _TYPES = {"int": dt.INT32, "integer": dt.INT32, "bigint": dt.INT64,
           "long": dt.INT64, "smallint": dt.INT16, "tinyint": dt.INT8,
@@ -78,10 +78,74 @@ def _tokenize(sql: str):
     return out
 
 
+class OuterRef(ColumnRef):
+    """A column reference that resolves in the ENCLOSING query's scope
+    (correlated subquery predicate, Spark's OuterReference)."""
+
+
+class _Exists:
+    """Marker conjunct: [NOT] EXISTS (subquery) — rewritten to a
+    left_semi / left_anti join (the reference rides Spark's
+    RewritePredicateSubquery; InSubqueryExec analog)."""
+
+    def __init__(self, sub, negated=False):
+        self.sub = sub
+        self.negated = negated
+
+    def __invert__(self):
+        return _Exists(self.sub, not self.negated)
+
+
+class _InSub:
+    """Marker conjunct: expr [NOT] IN (subquery) -> semi/anti join."""
+
+    def __init__(self, left, sub, negated=False):
+        self.left = left
+        self.sub = sub
+        self.negated = negated
+
+    def __invert__(self):
+        return _InSub(self.left, self.sub, not self.negated)
+
+
+class _ScalarSub:
+    """Marker operand: (SELECT <agg expr> ...) inside a comparison.
+    Uncorrelated -> executed to a Literal; correlated -> decorrelated
+    into a grouped-aggregate left join."""
+
+    def __init__(self, sub):
+        self.sub = sub
+
+
+class _SubCompare:
+    """Marker conjunct: comparison with a _ScalarSub operand."""
+
+    def __init__(self, op, left, right):
+        self.op = op
+        self.left = left
+        self.right = right
+
+
+class _SubInfo:
+    """A parsed (correlated) subquery: the inner DataFrame with
+    inner-only filters applied, the correlation conjuncts (containing
+    OuterRef nodes), and the projection info."""
+
+    def __init__(self, df, corr, projs, group_keys, having):
+        self.df = df
+        self.corr = corr          # list[Expression with OuterRefs]
+        self.projs = projs        # [(expr-or-'*', alias)]
+        self.group_keys = group_keys
+        self.having = having
+
+
 class _Parser:
-    def __init__(self, tokens):
+    def __init__(self, tokens, session=None, outer_aliases=()):
         self.toks = tokens
         self.i = 0
+        self.session = session
+        self.outer_aliases = set(outer_aliases)
+        self.local_aliases = set()
 
     def peek(self):
         return self.toks[self.i]
@@ -125,11 +189,19 @@ class _Parser:
         return self.comparison()
 
     def comparison(self):
+        if self.accept("kw", "exists"):
+            self.expect("op", "(")
+            sub = self._subquery()
+            self.expect("op", ")")
+            return _Exists(sub)
         left = self.additive()
         k, v = self.peek()
         if k == "op" and v in ("=", "!=", "<>", "<", "<=", ">", ">="):
             self.next()
             right = self.additive()
+            if isinstance(left, _ScalarSub) or isinstance(right,
+                                                          _ScalarSub):
+                return _SubCompare(v, left, right)
             return {"=": lambda: left == right,
                     "!=": lambda: left != right,
                     "<>": lambda: left != right,
@@ -158,6 +230,10 @@ class _Parser:
         if k == "kw" and v == "in":
             self.next()
             self.expect("op", "(")
+            if self.peek() == ("kw", "select"):
+                sub = self._subquery()
+                self.expect("op", ")")
+                return _InSub(left, sub)
             vals = [self.expr()]
             while self.accept("op", ","):
                 vals.append(self.expr())
@@ -187,6 +263,10 @@ class _Parser:
         if v == "in":
             self.next()
             self.expect("op", "(")
+            if self.peek() == ("kw", "select"):
+                sub = self._subquery()
+                self.expect("op", ")")
+                return _InSub(left, sub)
             vals = [self.expr()]
             while self.accept("op", ","):
                 vals.append(self.expr())
@@ -261,20 +341,121 @@ class _Parser:
             self.expect("op", ")")
             return Cast(e, typ)
         if k == "op" and v == "(":
+            if self.peek() == ("kw", "select"):
+                sub = self._subquery()
+                self.expect("op", ")")
+                return _ScalarSub(sub)
             e = self.expr()
             self.expect("op", ")")
             return e
         if k == "id":
             if self.accept("op", "("):
                 return self._call(v)
-            # qualified name a.b -> use last part (round-1 single scope)
+            # qualified name a.b: an alias bound in the ENCLOSING query
+            # (and not shadowed locally) makes this an OuterRef —
+            # correlated-subquery scoping; otherwise use the last part
+            qualifier = None
             while self.accept("op", "."):
+                qualifier = v
                 _, v2 = self.expect("id")
                 v = v2
+            if (qualifier is not None
+                    and qualifier.lower() in self.outer_aliases
+                    and qualifier.lower() not in self.local_aliases):
+                return OuterRef(v)
             return ColumnRef(v)
         if k == "op" and v == "*":
             return "*"
         raise ValueError(f"unexpected token {k} {v}")
+
+    # ---- WHERE with subquery-marker conjuncts -------------------------
+    def _and_level(self):
+        parts = [self.not_expr()]
+        while self.accept("kw", "and"):
+            parts.append(self.not_expr())
+        plains = [x for x in parts
+                  if not isinstance(x, (_Exists, _InSub, _SubCompare))]
+        marks = [x for x in parts
+                 if isinstance(x, (_Exists, _InSub, _SubCompare))]
+        return plains, marks
+
+    def where_parts(self):
+        """Parse a WHERE body honoring SQL precedence (OR lowest):
+        returns (plain_predicate_or_None, [subquery marker conjuncts]).
+        Subquery predicates under OR are unsupported."""
+        plains, marks = self._and_level()
+
+        def combine(ps):
+            out = ps[0]
+            for x in ps[1:]:
+                out = out & x
+            return out
+        if self.peek() == ("kw", "or"):
+            if marks:
+                raise UnsupportedExpr("subquery predicate under OR")
+            left = combine(plains)
+            while self.accept("kw", "or"):
+                p2, m2 = self._and_level()
+                if m2:
+                    raise UnsupportedExpr("subquery predicate under OR")
+                left = left | combine(p2)
+            return left, []
+        return (combine(plains) if plains else None), marks
+
+    # ---- subquery parse (at 'select', stops before ')') ---------------
+    def _subquery(self) -> "_SubInfo":
+        saved_outer = self.outer_aliases
+        saved_local = self.local_aliases
+        self.outer_aliases = saved_outer | saved_local
+        self.local_aliases = set()
+        try:
+            self.expect("kw", "select")
+            self.accept("kw", "distinct")
+            projs = self._select_list()
+            self.expect("kw", "from")
+            df = _parse_from(self, self.session)
+            sub_names = set(df.schema.names)
+            corr = []
+            if self.accept("kw", "where"):
+                plain, marks = self.where_parts()
+                if marks:
+                    raise UnsupportedExpr("nested subquery predicates")
+                conjs = _split_and(plain) if plain is not None else []
+                for c in conjs:
+                    c2 = _mark_outer(c, sub_names)
+                    if _has_outer(c2):
+                        corr.append(c2)
+                    else:
+                        df = df.filter(c2)
+            group_keys = None
+            having = None
+            if self.accept("kw", "group"):
+                self.expect("kw", "by")
+                group_keys = [self.expr()]
+                while self.accept("op", ","):
+                    group_keys.append(self.expr())
+            if self.accept("kw", "having"):
+                having = self.expr()
+            return _SubInfo(df, corr, projs, group_keys, having)
+        finally:
+            self.outer_aliases = saved_outer
+            self.local_aliases = saved_local
+
+    def _select_list(self):
+        projs = []
+        while True:
+            e = self.expr()
+            alias = None
+            if self.accept("kw", "as"):
+                alias = self.expect("id")[1]
+            else:
+                t = self.accept("id")
+                if t:
+                    alias = t[1]
+            projs.append((e, alias))
+            if not self.accept("op", ","):
+                break
+        return projs
 
     def _call(self, name):
         name_l = name.lower()
@@ -295,12 +476,21 @@ class _Parser:
         fn = getattr(F, name_l, None)
         if fn is None or name_l in ("col", "lit"):
             raise UnsupportedExpr(f"unknown function {name}")
+        # numeric literals past the first argument pass as python
+        # scalars (substring start/len, round digits, ...): many F
+        # functions consume them numerically at build time, and a
+        # deferred emit-time failure is not catchable here
+        conv = [args[0]] + [
+            a.value if (isinstance(a, Literal)
+                        and isinstance(a.value, (int, float))
+                        and not isinstance(a.value, bool)) else a
+            for a in args[1:]]
         try:
-            return fn(*args)
+            return fn(*conv)
         except TypeError:
-            # functions taking python scalars (e.g. substring start/len)
-            conv = [a.value if isinstance(a, Literal) else a for a in args]
-            return fn(conv[0], *conv[1:])
+            conv2 = [a.value if isinstance(a, Literal) else a
+                     for a in args]
+            return fn(conv2[0], *conv2[1:])
 
 
 def register_view(session, name: str, df):
@@ -309,28 +499,223 @@ def register_view(session, name: str, df):
     session._views[name.lower()] = df
 
 
-def parse_sql(session, sql: str):
-    from ..session import DataFrame
-    from ..plan import logical as L
+# ---- scoping / decorrelation helpers ----------------------------------
+def _split_and(e):
+    from ..expr.expressions import And
+    if isinstance(e, And):
+        return _split_and(e.children[0]) + _split_and(e.children[1])
+    return [e]
 
-    p = _Parser(_tokenize(sql))
-    p.expect("kw", "select")
-    distinct = bool(p.accept("kw", "distinct"))
-    # projections
-    projs = []
-    while True:
-        e = p.expr()
-        alias = None
-        if p.accept("kw", "as"):
-            alias = p.expect("id")[1]
-        else:
-            t = p.accept("id")
-            if t:
-                alias = t[1]
-        projs.append((e, alias))
-        if not p.accept("op", ","):
-            break
-    p.expect("kw", "from")
+
+def _walk_replace(e, fn):
+    """Rebuild an expression tree bottom-up through fn (children first,
+    then the node itself)."""
+    for attr in ("left", "right", "child", "pred", "t", "f"):
+        c = getattr(e, attr, None)
+        if c is not None and hasattr(c, "bind"):
+            setattr(e, attr, _walk_replace(c, fn))
+    kids = getattr(e, "children", None)
+    if kids:
+        e.children = [(_walk_replace(c, fn) if hasattr(c, "bind") else c)
+                      for c in kids]
+    return fn(e)
+
+
+def _mark_outer(e, sub_names):
+    """ColumnRefs not resolvable in the subquery's schema (and not
+    already alias-qualified OuterRefs) become OuterRefs."""
+    def fn(x):
+        if type(x) is ColumnRef and x.name not in sub_names:
+            return OuterRef(x.name)
+        return x
+    return _walk_replace(e, fn)
+
+
+def _has_outer(e) -> bool:
+    found = []
+
+    def fn(x):
+        if isinstance(x, OuterRef):
+            found.append(x)
+        return x
+    _walk_replace(e, fn)
+    return bool(found)
+
+
+def _resolve_scopes(e, rename):
+    """OuterRef(n) -> ColumnRef(n) (enclosing scope); inner
+    ColumnRef(n) -> ColumnRef(rename[n]) — builds the join condition
+    over the combined (outer ++ renamed inner) schema."""
+    def fn(x):
+        if isinstance(x, OuterRef):
+            return ColumnRef(x.name)
+        if type(x) is ColumnRef:
+            return ColumnRef(rename[x.name])
+        return x
+    return _walk_replace(e, fn)
+
+
+_SQ_COUNTER = [0]
+
+
+def _rename_all(df, prefix=None):
+    """Project every column to a collision-proof name; returns
+    (renamed_df, {old: new})."""
+    _SQ_COUNTER[0] += 1
+    tag = prefix or f"__sq{_SQ_COUNTER[0]}"
+    mapping = {n: f"{tag}_{n}" for n in df.schema.names}
+    out = df.select(*[ColumnRef(n).alias(m) for n, m in mapping.items()])
+    return out, mapping
+
+
+def _extract_aggs(e, aggs):
+    """Replace aggregate nodes inside a projection expression with
+    references to hidden agg output columns (collected into `aggs`)."""
+    def fn(x):
+        if isinstance(x, agg.AggExpr):
+            nm = f"__sqa{len(aggs)}"
+            aggs.append((nm, x))
+            return ColumnRef(nm)
+        return x
+    return _walk_replace(e, fn)
+
+
+def _finalize_sub_output(session, info: "_SubInfo", extra_keys=()):
+    """Build the subquery's output DataFrame: GROUP BY (declared keys
+    plus decorrelation keys) + hidden aggregates + HAVING + the single
+    projection. Returns (df, out_col_name)."""
+    from ..session import DataFrame  # noqa: F401 (type only)
+    df = info.df
+    if len(info.projs) != 1 or isinstance(info.projs[0][0], str):
+        raise UnsupportedExpr(
+            "subquery must select exactly one expression")
+    proj, alias = info.projs[0]
+    aggs = []
+    proj = _extract_aggs(proj, aggs)
+    having = info.having
+    if having is not None:
+        having = _extract_aggs(having, aggs)
+    keys = list(info.group_keys or []) + [ColumnRef(k)
+                                          for k in extra_keys]
+    if aggs:
+        gp = df.group_by(*keys)
+        df = gp.agg(*[a.alias(n) for n, a in aggs])
+        if having is not None:
+            df = df.filter(having)
+        out_name = alias or "__sqout"
+        df = df.select(*(list(keys) + [proj.alias(out_name)]))
+        return df, out_name
+    if having is not None:
+        raise UnsupportedExpr("HAVING without aggregates in subquery")
+    out_name = alias or (proj.name if isinstance(proj, ColumnRef)
+                         else "__sqout")
+    df = df.select(*(list(keys) + [proj.alias(out_name)]))
+    return df, out_name
+
+
+def _corr_inner_names(corr):
+    """Inner (non-outer) column names referenced by correlation
+    conjuncts — the columns the decorrelated subquery must keep."""
+    names = []
+
+    def fn(x):
+        if type(x) is ColumnRef and not isinstance(x, OuterRef):
+            names.append(x.name)
+        return x
+    for c in corr:
+        _walk_replace(c, fn)
+    return list(dict.fromkeys(names))
+
+
+def _apply_marker(session, df, m):
+    """Rewrite one WHERE subquery conjunct into joins/filters on `df`
+    (Spark's RewritePredicateSubquery / scalar-subquery decorrelation;
+    reference: these arrive pre-rewritten from Catalyst, and runtime
+    filters ride InSubqueryExec)."""
+    from ..expr.expressions import Literal as Lit
+    if isinstance(m, _Exists):
+        info = m.sub
+        if info.group_keys or info.having:
+            raise UnsupportedExpr("EXISTS over grouped subquery")
+        if not info.corr:
+            rows = info.df.limit(1).to_arrow().num_rows
+            keep = (rows > 0) != m.negated
+            return df if keep else df.filter(Lit(False))
+        sdf, rename = _rename_all(info.df)
+        cond = None
+        for c in info.corr:
+            c2 = _resolve_scopes(c, rename)
+            cond = c2 if cond is None else (cond & c2)
+        return df.join(sdf, on=cond,
+                       how="left_anti" if m.negated else "left_semi")
+    if isinstance(m, _InSub):
+        info = m.sub
+        # correlation columns must survive the subquery's projection so
+        # the join condition can reference them post-rename
+        extra = [n for n in _corr_inner_names(info.corr)]
+        sub_out, out_name = _finalize_sub_output(session, info,
+                                                 extra_keys=extra)
+        sdf, rename = _rename_all(sub_out)
+        cond = m.left == ColumnRef(rename[out_name])
+        for c in info.corr:
+            cond = cond & _resolve_scopes(c, rename)
+        return df.join(sdf, on=cond,
+                       how="left_anti" if m.negated else "left_semi")
+    if isinstance(m, _SubCompare):
+        sub = m.left if isinstance(m.left, _ScalarSub) else m.right
+        other = m.right if sub is m.left else m.left
+        info = sub.sub
+        ops = {"=": lambda a, b: a == b, "!=": lambda a, b: a != b,
+               "<>": lambda a, b: a != b, "<": lambda a, b: a < b,
+               "<=": lambda a, b: a <= b, ">": lambda a, b: a > b,
+               ">=": lambda a, b: a >= b}
+        flip = {"<": ">", "<=": ">=", ">": "<", ">=": "<=",
+                "=": "=", "!=": "!=", "<>": "<>"}
+        op = m.op if sub is m.right else flip[m.op]
+        # now the comparison reads: other <op> subquery-value
+        if not info.corr:
+            val_df, out_name = _finalize_sub_output(session, info)
+            rows = val_df.to_arrow().to_pylist()
+            val = rows[0][out_name] if rows else None
+            return df.filter(ops[op](other, Lit(val)))
+        # correlated: every corr conjunct must be outer == inner
+        from ..expr.expressions import Eq
+        inner_keys = []
+        outer_keys = []
+        for c in info.corr:
+            if not isinstance(c, Eq):
+                raise UnsupportedExpr(
+                    "correlated scalar subquery needs equality "
+                    "correlation")
+            a, b = c.children
+            if isinstance(a, OuterRef) and type(b) is ColumnRef:
+                outer_keys.append(a.name)
+                inner_keys.append(b.name)
+            elif isinstance(b, OuterRef) and type(a) is ColumnRef:
+                outer_keys.append(b.name)
+                inner_keys.append(a.name)
+            else:
+                raise UnsupportedExpr(
+                    "correlated scalar subquery needs col = col "
+                    "correlation")
+        sub_out, out_name = _finalize_sub_output(
+            session, info, extra_keys=inner_keys)
+        sdf, rename = _rename_all(sub_out)
+        cond = None
+        for ok, ik in zip(outer_keys, inner_keys):
+            c2 = ColumnRef(ok) == ColumnRef(rename[ik])
+            cond = c2 if cond is None else (cond & c2)
+        joined = df.join(sdf, on=cond, how="inner")
+        return joined.filter(ops[op](other,
+                                     ColumnRef(rename[out_name])))
+    raise UnsupportedExpr(f"unhandled subquery marker {m!r}")
+
+
+def _parse_from(p: "_Parser", session):
+    """FROM item [alias] + JOIN chain -> DataFrame (derived tables via
+    parenthesized subselects); records aliases in p.local_aliases."""
+    from ..plan import logical as L
+    from ..session import DataFrame
     views = getattr(session, "_views", {})
 
     def get_view(nm):
@@ -338,10 +723,25 @@ def parse_sql(session, sql: str):
             raise ValueError(f"unknown table/view {nm}")
         return views[nm.lower()]
 
-    base = get_view(p.expect("id")[1])
-    p.accept("id")  # optional table alias (names are global round-1)
+    def from_item():
+        if p.accept("op", "("):
+            sub = p._subquery()
+            if sub.corr:
+                raise UnsupportedExpr("correlated derived table")
+            p.expect("op", ")")
+            d = _finalize_derived(session, sub)
+        else:
+            nm = p.expect("id")[1]
+            d = get_view(nm)
+            # the TABLE NAME is itself a scope alias: a correlated
+            # predicate may qualify by it (t1.k) with no explicit alias
+            p.local_aliases.add(nm.lower())
+        t = p.accept("id")
+        if t:
+            p.local_aliases.add(t[1].lower())
+        return d
 
-    # joins
+    base = from_item()
     while True:
         how = None
         if p.accept("kw", "join") or (p.accept("kw", "inner")
@@ -369,8 +769,7 @@ def parse_sql(session, sql: str):
             how = "cross"
         else:
             break
-        other = get_view(p.expect("id")[1])
-        p.accept("id")
+        other = from_item()
         if how == "cross":
             base = DataFrame(session, L.Join(base._plan, other._plan, [],
                                              [], "cross"))
@@ -385,20 +784,63 @@ def parse_sql(session, sql: str):
         else:
             p.expect("kw", "on")
             cond = p.expr()
-            from ..expr.expressions import Eq
-            if not isinstance(cond, Eq) or not isinstance(
-                    cond.left, ColumnRef) or not isinstance(
-                    cond.right, ColumnRef):
+            base = base.join(other, on=cond, how=how)
+    return base
+
+
+def _finalize_derived(session, info: "_SubInfo"):
+    """Materialize a derived table (FROM (SELECT ...) t): projection +
+    optional grouping, no correlation."""
+    df = info.df
+    if len(info.projs) == 1 and isinstance(info.projs[0][0], str):
+        return df          # SELECT *
+    aggs_present = any(isinstance(e, agg.AggExpr)
+                       for e, _ in info.projs
+                       if not isinstance(e, str))
+    if info.group_keys is not None or aggs_present:
+        keys = info.group_keys or []
+        out_aggs = []
+        sel = []
+        for e, alias in info.projs:
+            if isinstance(e, agg.AggExpr):
+                nm = alias or f"__d{len(out_aggs)}"
+                out_aggs.append((nm, e))
+                sel.append(ColumnRef(nm))
+            else:
+                sel.append(e.alias(alias) if alias else e)
+        gp = df.group_by(*keys)
+        df = gp.agg(*[a.alias(n) for n, a in out_aggs])
+        if info.having is not None:
+            hv_aggs = []
+            hv = _extract_aggs(info.having, hv_aggs)
+            if hv_aggs:
                 raise UnsupportedExpr(
-                    "JOIN ON supports single equi-conditions round-1")
-            if cond.left.name != cond.right.name:
-                raise UnsupportedExpr(
-                    "JOIN ON a.x = b.y with x != y: use USING or rename")
-            base = base.join(other, on=[cond.left.name], how=how)
+                    "derived-table HAVING over new aggregates")
+            df = df.filter(hv)
+        return df.select(*sel)
+    if info.having is not None:
+        raise UnsupportedExpr("HAVING without aggregation")
+    return df.select(*[e.alias(a) if a else e for e, a in info.projs])
+
+
+def parse_sql(session, sql: str):
+    from ..session import DataFrame
+    from ..plan import logical as L
+
+    p = _Parser(_tokenize(sql), session=session)
+    p.expect("kw", "select")
+    distinct = bool(p.accept("kw", "distinct"))
+    projs = p._select_list()
+    p.expect("kw", "from")
+    base = _parse_from(p, session)
 
     df = base
     if p.accept("kw", "where"):
-        df = df.filter(p.expr())
+        plain, marks = p.where_parts()
+        for m in marks:
+            df = _apply_marker(session, df, m)
+        if plain is not None:
+            df = df.filter(plain)
 
     group_keys = None
     having_expr = None
@@ -414,16 +856,39 @@ def parse_sql(session, sql: str):
     def is_agg(e):
         return isinstance(e, agg.AggExpr)
 
-    has_agg = any(is_agg(e) for e, _ in projs
+    def contains_agg(e):
+        found = []
+
+        def fn(x):
+            if is_agg(x):
+                found.append(x)
+            return x
+        _walk_replace(e, fn)
+        return bool(found)
+
+    has_agg = any(contains_agg(e) for e, _ in projs
                   if not isinstance(e, str))
     if group_keys is not None or has_agg:
         keys = group_keys or []
         aggs = []
+        # expressions CONTAINING aggregates (sum(x)/7.0) extract the agg
+        # nodes into hidden columns and project over them afterwards
+        new_projs = []
         for j, (e, alias) in enumerate(projs):
             if isinstance(e, str):
                 raise ValueError("SELECT * with GROUP BY")
             if is_agg(e):
                 aggs.append((alias or f"{e!r}", e))
+                new_projs.append((e, alias))
+            elif contains_agg(e):
+                inner = []
+                e2 = _extract_aggs(e, inner)
+                for k, (nm, a) in enumerate(inner):
+                    aggs.append((nm, a))
+                new_projs.append((e2, alias))
+            else:
+                new_projs.append((e, alias))
+        projs = new_projs
         # HAVING: rewrite aggregate calls to (possibly hidden) agg columns
         # BEFORE projection (SQL applies HAVING pre-projection)
         if having_expr is not None:

@@ -128,16 +128,8 @@ class WindowExpr(Expression):
                            frame, mode),
                        self.offset, self.default)
         from .columnar import dtypes as dt
-        if (self.fn in ("min", "max") and b.child is not None
-                and getattr(b.child.dtype, "is_decimal128", False)
-                and b.spec.frame not in ((UNBOUNDED, UNBOUNDED),
-                                         (UNBOUNDED, CURRENT_ROW))):
-            # limb scans cover whole-partition + running frames; a
-            # bounded-frame decimal128 min/max needs a two-limb RMQ
-            raise UnsupportedExpr(
-                f"bounded-frame window {self.fn} over decimal "
-                f"precision > 18 (cast to double or a narrower "
-                f"decimal first)")
+        # bounded-frame decimal128 min/max: two-limb sparse-table RMQ
+        # (exec/window.py _rmq_d128) — no plan-time gate needed anymore
         if self.fn in self.RANKING:
             if not b.spec.orders:
                 raise UnsupportedExpr(f"{self.fn} requires ORDER BY")

@@ -1,5 +1,7 @@
 """Delta-log behavior: versioned commits, time travel, overwrite."""
+import spark_rapids_tpu as st
 import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col
 
 from asserts import assert_rows_equal
 from data_gen import IntegerGen, gen_df
@@ -122,3 +124,88 @@ def test_delta_checkpoint_roundtrip(session, tmp_path):
     assert got == list(range(CHECKPOINT_INTERVAL + 2))
     # time travel BEFORE the checkpoint still works (JSON replay)
     assert session.read.delta(p, version=3).count() == 4
+
+
+def test_optimize_compaction_with_dv_survivors(tmp_path, session):
+    """OPTIMIZE bin-packs small files into one, folding deletion
+    vectors in: DV-dead rows stay dead, survivors carry forward, file
+    count drops, and time travel still sees the old layout
+    (r4 verdict next #9; reference: GpuOptimizeWriteExchangeExec)."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.io.delta import (DeltaTable, delete_delta,
+                                           optimize_delta)
+    p = str(tmp_path / "t")
+    s = session
+    for i in range(4):
+        s.create_dataframe({
+            "k": pa.array(range(i * 10, i * 10 + 10), pa.int64()),
+            "v": pa.array([i] * 10, pa.int64()),
+        }).write_delta(p)
+    dv_conf = st.TpuSession({
+        "spark.rapids.tpu.delta.deletionVectors.enabled": "true"})
+    delete_delta(dv_conf, p, col("k") % 4 == 0)
+    t = DeltaTable(p)
+    files_before = len(t.snapshot_adds())
+    assert files_before == 4
+    ver_before = t.latest_version()
+
+    stats = optimize_delta(s, p, target_file_bytes=1 << 20)
+    assert stats["filesRemoved"] == 4
+    assert stats["filesAdded"] == 1
+    assert len(t.snapshot_adds()) == 1
+    # content identical: DV-dead rows stay dead
+    got = sorted(r["k"] for r in s.read.delta(p).to_arrow().to_pylist())
+    want = [k for k in range(40) if k % 4 != 0]
+    assert got == want
+    # time travel to the pre-OPTIMIZE version still works
+    old = sorted(r["k"] for r in
+                 s.read.delta(p, version=ver_before).to_arrow()
+                 .to_pylist())
+    assert old == want
+
+
+def test_optimize_zorder_clusters_rows(tmp_path, session):
+    """Z-ORDER BY (x, y): after OPTIMIZE the per-file (here per-slice)
+    row order follows the interleaved-bit curve — nearby (x, y) points
+    land together (reference: zorder/ZOrderRules.scala + JNI ZOrder)."""
+    import numpy as np
+    import pyarrow as pa
+
+    from spark_rapids_tpu.io.delta import optimize_delta
+    rng = np.random.default_rng(11)
+    p = str(tmp_path / "t")
+    n = 4000
+    x = rng.integers(0, 1000, n).astype(np.int64)
+    y = rng.integers(0, 1000, n).astype(np.int64)
+    session.create_dataframe({"x": pa.array(x),
+                              "y": pa.array(y)}).write_delta(p)
+    optimize_delta(session, p, zorder_by=["x", "y"])
+    at = session.read.delta(p).to_arrow()
+    xs = np.asarray(at.column("x"))
+    ys = np.asarray(at.column("y"))
+    # z-ordered rows: mean adjacent (x,y) manhattan distance is far
+    # below the random-order expectation (~666 for uniform 0..1000)
+    d = np.abs(np.diff(xs)) + np.abs(np.diff(ys))
+    assert d.mean() < 300, d.mean()
+
+
+def test_auto_compact_after_append(tmp_path):
+    import pyarrow as pa
+
+    from spark_rapids_tpu.io.delta import DeltaTable
+    s = st.TpuSession({
+        "spark.rapids.tpu.delta.autoCompact.minFiles": 3,
+        "spark.rapids.tpu.delta.autoCompact.targetBytes": 1 << 20})
+    p = str(tmp_path / "t")
+    for i in range(4):
+        s.create_dataframe({"k": pa.array([i] * 5, pa.int64())}) \
+            .write_delta(p)
+    t = DeltaTable(p)
+    # the 3rd append crossed minFiles and compacted 3 -> 1; the 4th
+    # append adds one more (below threshold): 2 live files, not 4
+    assert len(t.snapshot_adds()) == 2
+    ops = [h["operation"] for h in t.history()]
+    assert "OPTIMIZE" in ops
+    got = sorted(r["k"] for r in s.read.delta(p).to_arrow().to_pylist())
+    assert got == sorted([i for i in range(4) for _ in range(5)])

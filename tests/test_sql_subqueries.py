@@ -1,0 +1,208 @@
+"""SQL subqueries: scalar (correlated + uncorrelated), IN/EXISTS
+semi/anti rewrites, derived tables — exercised by running real TPC-H
+query TEXT through session.sql and checking against the engine's own
+DataFrame-built results (r4 verdict next #8; the reference rides
+Spark's parser + RewritePredicateSubquery)."""
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.sql.parser import register_view
+from spark_rapids_tpu.workloads import tpch
+from spark_rapids_tpu.workloads.tpch_oracle import ORACLES, to_pandas
+
+
+@pytest.fixture(scope="module")
+def env():
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 1 << 20})
+    tabs = tpch.gen_all(sf=0.01, seed=7)
+    for name, t in tabs.items():
+        register_view(s, name, s.create_dataframe(t).cache())
+    host = to_pandas(tabs)
+    return s, host
+
+
+def _rows(at):
+    return [tuple(at.column(i)[j].as_py() for i in range(at.num_columns))
+            for j in range(at.num_rows)]
+
+
+def test_q4_exists(env):
+    s, host = env
+    d0, d1 = tpch.day('1993-07-01'), tpch.day('1993-10-01')
+    got = s.sql(f"""
+        select o_orderpriority, count(*) as order_count
+        from orders
+        where o_orderdate >= {d0}
+          and o_orderdate < {d1}
+          and exists (
+            select * from lineitem
+            where l_orderkey = o_orderkey
+              and l_commitdate < l_receiptdate)
+        group by o_orderpriority
+        order by o_orderpriority
+    """).to_arrow()
+    want = ORACLES[4](host)
+    assert [r[0] for r in _rows(got)] == list(want["o_orderpriority"])
+    assert [r[1] for r in _rows(got)] == list(want["order_count"])
+
+
+def test_q17_correlated_scalar(env):
+    s, host = env
+    got = s.sql("""
+        select sum(l_extendedprice) / 7.0 as avg_yearly
+        from lineitem join part on l_partkey = p_partkey
+        where p_brand = 'Brand#23' and p_container = 'MED BOX'
+          and l_quantity < (
+            select 0.2 * avg(l_quantity) from lineitem
+            where l_partkey = p_partkey)
+    """).to_arrow()
+    want = ORACLES[17](host)
+    g = got.column(0)[0].as_py()
+    w = float(want["avg_yearly"].iloc[0])
+    if g is None:
+        assert w == 0 or want.empty
+    else:
+        assert abs(float(g) - w) < 1e-6
+
+
+def test_q18_in_grouped_subquery(env):
+    s, host = env
+    got = s.sql("""
+        select c_name, c_custkey, o_orderkey, o_orderdate,
+               o_totalprice, sum(l_quantity) as sq
+        from customer
+          join orders on c_custkey = o_custkey
+          join lineitem on o_orderkey = l_orderkey
+        where o_orderkey in (
+            select l_orderkey from lineitem
+            group by l_orderkey having sum(l_quantity) > 250)
+        group by c_name, c_custkey, o_orderkey, o_orderdate,
+                 o_totalprice
+        order by o_totalprice desc, o_orderdate
+        limit 100
+    """).to_arrow()
+    want = ORACLES[18](host, qty=250)
+    assert got.num_rows == len(want)
+    got_keys = [r[2] for r in _rows(got)]
+    assert got_keys == list(want["o_orderkey"])
+
+
+def test_q21_not_exists_self_join(env):
+    s, host = env
+    got = s.sql("""
+        select s_name, count(*) as numwait
+        from supplier
+          join lineitem l1 on s_suppkey = l_suppkey
+          join orders on o_orderkey = l_orderkey
+          join nation on s_nationkey = n_nationkey
+        where o_orderstatus = 'F'
+          and l1.l_receiptdate > l1.l_commitdate
+          and n_name = 'SAUDI ARABIA'
+          and exists (
+            select * from lineitem l2
+            where l2.l_orderkey = l1.l_orderkey
+              and l2.l_suppkey <> l1.l_suppkey)
+          and not exists (
+            select * from lineitem l3
+            where l3.l_orderkey = l1.l_orderkey
+              and l3.l_suppkey <> l1.l_suppkey
+              and l3.l_receiptdate > l3.l_commitdate)
+        group by s_name
+        order by numwait desc, s_name
+        limit 100
+    """).to_arrow()
+    want = ORACLES[21](host)
+    assert got.num_rows == len(want)
+    if len(want):
+        assert [r[0] for r in _rows(got)] == list(want["s_name"])
+        assert [r[1] for r in _rows(got)] == list(want["numwait"])
+
+
+def test_q22_uncorrelated_scalar_and_not_exists(env):
+    s, host = env
+    got = s.sql("""
+        select cntrycode, count(*) as numcust,
+               sum(c_acctbal) as totacctbal
+        from (select substring(c_phone, 1, 2) as cntrycode,
+                     c_acctbal, c_custkey
+              from customer
+              where substring(c_phone, 1, 2)
+                    in ('13','31','23','29','30','18','17'))
+        where c_acctbal > (
+            select avg(c_acctbal) from customer
+            where c_acctbal > 0.00
+              and substring(c_phone, 1, 2)
+                  in ('13','31','23','29','30','18','17'))
+          and not exists (
+            select * from orders where o_custkey = c_custkey)
+        group by cntrycode
+        order by cntrycode
+    """).to_arrow()
+    want = ORACLES[22](host)
+    rows = _rows(got)
+    assert [r[0] for r in rows] == list(want["cntrycode"])
+    assert [r[1] for r in rows] == list(want["numcust"])
+
+
+def test_q2_correlated_min(env):
+    s, host = env
+    got = s.sql("""
+        select s_acctbal, s_name, n_name, p_partkey, p_mfgr,
+               s_address, s_phone, s_comment
+        from part
+          join partsupp on p_partkey = ps_partkey
+          join supplier on ps_suppkey = s_suppkey
+          join nation on s_nationkey = n_nationkey
+          join region on n_regionkey = r_regionkey
+        where p_size = 15 and endswith(p_type, 'BRASS')
+          and r_name = 'EUROPE'
+          and ps_supplycost = (
+            select min(ps_supplycost)
+            from partsupp
+              join supplier on ps_suppkey = s_suppkey
+              join nation on s_nationkey = n_nationkey
+              join region on n_regionkey = r_regionkey
+            where p_partkey = ps_partkey and r_name = 'EUROPE')
+        order by s_acctbal desc, n_name, s_name, p_partkey
+        limit 100
+    """).to_arrow()
+    want = ORACLES[2](host)
+    assert got.num_rows == len(want)
+    if len(want):
+        assert [r[3] for r in _rows(got)] == list(want["p_partkey"])
+
+
+def test_correlation_via_table_name_qualifier():
+    """A correlated predicate qualified by the outer TABLE NAME (no
+    explicit alias) must correlate, not silently degrade into an inner
+    tautology filter (review finding: Filter[(k = k)])."""
+    import pyarrow as pa
+    s = st.TpuSession()
+    register_view(s, "t1", s.create_dataframe(
+        {"a": pa.array([1, 2], pa.int64()),
+         "k": pa.array([10, 20], pa.int64())}))
+    register_view(s, "t2", s.create_dataframe(
+        {"b": pa.array([1, 2], pa.int64()),
+         "k": pa.array([10, 99], pa.int64())}))
+    got = s.sql("select a from t1 where a in "
+                "(select b from t2 where t2.k = t1.k)") \
+        .to_arrow().to_pylist()
+    # a=1 correlates (k 10 == 10); a=2 does not (20 vs 99)
+    assert [r["a"] for r in got] == [1]
+
+
+def test_correlated_in_subquery_keeps_corr_columns():
+    """Correlated IN: the correlation column must survive the
+    subquery's projection (review finding: KeyError on rename)."""
+    import pyarrow as pa
+    s = st.TpuSession()
+    register_view(s, "t1", s.create_dataframe(
+        {"a": pa.array([1, 2, 3], pa.int64()),
+         "k": pa.array([10, 20, 30], pa.int64())}))
+    register_view(s, "t2", s.create_dataframe(
+        {"b": pa.array([1, 2, 3], pa.int64()),
+         "k": pa.array([10, 99, 30], pa.int64())}))
+    got = s.sql("select a from t1 x where a in "
+                "(select b from t2 where t2.k = x.k)") \
+        .to_arrow().to_pylist()
+    assert sorted(r["a"] for r in got) == [1, 3]
