@@ -68,6 +68,13 @@ HBM_POOL_BYTES = _conf(
     "memory.tpu.poolBytes", None,
     "Explicit HBM budget in bytes; overrides allocFraction when set.",
     int)
+HOST_MEMORY_LIMIT = _conf(
+    "memory.host.limitBytes", 0,
+    "GLOBAL host-DRAM byte budget shared by the spill store's host "
+    "tier, async write buffers, and shuffle-assembly arenas "
+    "(HostAlloc.scala:36 analog; limits RapidsConf.scala:337-353). "
+    "Reservations over budget fire the host->disk pressure cascade; "
+    "0 = unlimited.", int)
 HOST_SPILL_LIMIT = _conf(
     "memory.host.spillStorageSize", 32 * 1024 * 1024 * 1024,
     "Bytes of host DRAM usable for spilled device buffers before "
@@ -233,6 +240,14 @@ PYTHON_CONCURRENT_WORKERS = _conf(
     "acquisition blocks above it (reference: "
     "spark.rapids.python.concurrentPythonWorkers, "
     "PythonWorkerSemaphore).", int)
+MESH_COMPRESS = _conf(
+    "mesh.shuffle.compress", False,
+    "Compress mesh-exchange round buffers ON DEVICE before the "
+    "cross-shard move (byte-plane packing - the TPU-native nvcomp-LZ4 "
+    "analog, NvcompLZ4CompressionCodec.scala; LZ4 itself is a "
+    "sequential match chain that does not vectorize on the VPU). "
+    "~4x on int-dominated payloads; incompressible buffers move raw "
+    "when packing would not shrink them.", bool)
 DELTA_AUTOCOMPACT_MIN_FILES = _conf(
     "delta.autoCompact.minFiles", 0,
     "When > 0, a Delta append auto-compacts once the table holds at "

@@ -79,6 +79,15 @@ class ShuffleExchangeExec(TpuExec):
         out = [take(cv, order, in_bounds=live_sorted) for cv in cvs]
         return out, counts
 
+    def release(self):
+        sh, self._shuffle = self._shuffle, None
+        if sh is not None:
+            try:
+                sh.cleanup()   # frees map files + the arena's host-
+            except Exception:  # budget reservation
+                pass
+        super().release()
+
     # ---- map phase ------------------------------------------------------
     def _ensure_shuffled(self, ctx: ExecContext):
         with self._lock:

@@ -64,6 +64,7 @@ class MeshExchangeExec(TpuExec):
         self._out: Optional[List[List]] = None   # per shard: spill handles
         self._lock = threading.RLock()
         self._jit_cache = {}
+        self._compress = False    # set per-execution from conf
 
     def describe(self):
         return f"MeshExchangeExec[hash, devices={self.n}]"
@@ -116,16 +117,49 @@ class MeshExchangeExec(TpuExec):
         return jax.jit(step)
 
     # ------------------------------------------------------------------
-    def _assemble_global(self, pieces, sharding, devices):
+    def _assemble_global(self, pieces, sharding, devices, m=None):
         """Build the round's global array from per-shard pieces WITHOUT a
         host/single-device concatenate: each piece is device_put to its
         target shard (D2D/DMA on hardware — the device-resident bounce
         buffer, vs r3's jnp.concatenate + device_put which staged every
         round through one device; reference keeps bounce buffers
-        device-resident too, UCXShuffleTransport.scala:49)."""
+        device-resident too, UCXShuffleTransport.scala:49).
+
+        With mesh.shuffle.compress on, each piece plane-pack-compresses
+        on its SOURCE device, the bucketed compressed bytes make the
+        move, and the TARGET device decompresses — the device-side
+        shuffle-compression analog of NvcompLZ4CompressionCodec."""
         shape = ((len(pieces) * pieces[0].shape[0],)
                  + tuple(pieces[0].shape[1:]))
-        arrs = [jax.device_put(p, d) for p, d in zip(pieces, devices)]
+        if self._compress:
+            from ..columnar.column import bucket_capacity
+            from ..ops.device_codec import (compress_array,
+                                            decompress_array)
+            from ..utils.transfer import fetch
+            # compress everything first, then ONE batched size fetch —
+            # a per-piece sync would serialize every column of every
+            # shard and undo the round's async pipelining
+            packed = [compress_array(p) for p in pieces]
+            totals = [int(v) for v in fetch([t for _, t, _ in packed])]
+            arrs = []
+            for (comp, _t, nbytes), t, p, d in zip(packed, totals,
+                                                   pieces, devices):
+                if nbytes and t < nbytes:           # worth moving packed
+                    cap = min(bucket_capacity(max(t, 1)),
+                              comp.shape[0])
+                    moved = jax.device_put(comp[:cap], d)
+                    arrs.append(decompress_array(moved, nbytes, p.shape,
+                                                 p.dtype))
+                    if m is not None:
+                        m.add("compressedBytes", t)
+                        m.add("rawBytes", nbytes)
+                else:
+                    arrs.append(jax.device_put(p, d))
+                    if m is not None:
+                        m.add("compressedBytes", nbytes)
+                        m.add("rawBytes", nbytes)
+        else:
+            arrs = [jax.device_put(p, d) for p, d in zip(pieces, devices)]
         return jax.make_array_from_single_device_arrays(
             shape, sharding, arrs)
 
@@ -170,14 +204,15 @@ class MeshExchangeExec(TpuExec):
             for ci in range(len(self.schema.fields)):
                 parts = [shard_cvs[s][ci] for s in range(n)]
                 flat_global.append(self._assemble_global(
-                    [p.data for p in parts], sharding, devices))
+                    [p.data for p in parts], sharding, devices, m))
                 flat_global.append(self._assemble_global(
-                    [p.validity for p in parts], sharding, devices))
+                    [p.validity for p in parts], sharding, devices, m))
                 if has_offsets[ci]:
                     flat_global.append(self._assemble_global(
-                        [p.offsets for p in parts], sharding, devices))
+                        [p.offsets for p in parts], sharding, devices,
+                        m))
             mask_global = self._assemble_global(shard_masks, sharding,
-                                                devices)
+                                                devices, m)
 
         with m.timer("exchangeTime"):
             key = tuple(has_offsets)
@@ -236,8 +271,10 @@ class MeshExchangeExec(TpuExec):
             if self._out is not None:
                 return
             from jax.sharding import NamedSharding, PartitionSpec as P
+            from ..config import MESH_COMPRESS
             from ..memory.spill import spill_store
             store = spill_store(ctx.conf)
+            self._compress = bool(ctx.conf.get(MESH_COMPRESS))
             m = ctx.metrics_for(self._op_id)
             mesh = self._get_mesh()
             child = self.children[0]

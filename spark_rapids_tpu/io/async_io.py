@@ -32,8 +32,9 @@ class TrafficController:
     (reference: TrafficController's ThrottlingAppender always admits
     the first buffer)."""
 
-    def __init__(self, max_in_flight_bytes: int):
+    def __init__(self, max_in_flight_bytes: int, host_mgr=None):
         self.max_bytes = int(max_in_flight_bytes)
+        self.host_mgr = host_mgr
         self._bytes = 0
         self._tasks = 0
         self._wait_s = 0.0
@@ -49,8 +50,28 @@ class TrafficController:
             self._bytes += nbytes
             self._tasks += 1
             self._wait_s += time.monotonic() - t0
+        if self.host_mgr is not None:
+            # in-flight write buffers draw from the GLOBAL host budget
+            # (HostAlloc analog): pressure demotes the spill store's
+            # host tier to disk; bounded wait, then soft-admit (a
+            # deferred write error must never deadlock the pipeline)
+            from ..memory.host import HostBudgetExceeded
+            deadline = time.monotonic() + 30
+            while True:
+                try:
+                    self.host_mgr.reserve(nbytes)
+                    return
+                except HostBudgetExceeded:
+                    if time.monotonic() > deadline:
+                        # soft-admit: charge anyway so every release
+                        # pairs; later reservations see the pressure
+                        self.host_mgr.force_reserve(nbytes)
+                        return
+                    time.sleep(0.1)
 
     def release(self, nbytes: int):
+        if self.host_mgr is not None:
+            self.host_mgr.release(nbytes)
         with self._cv:
             self._bytes -= nbytes
             self._tasks -= 1
@@ -144,7 +165,9 @@ def controller_for(conf) -> TrafficController:
     with _controllers_lock:
         c = getattr(conf, "_srtpu_async_controller", None)
         if c is None:
-            c = TrafficController(conf.get(ASYNC_WRITE_MAX_IN_FLIGHT))
+            from ..memory.host import host_manager
+            c = TrafficController(conf.get(ASYNC_WRITE_MAX_IN_FLIGHT),
+                                  host_mgr=host_manager(conf))
             try:
                 conf._srtpu_async_controller = c
             except AttributeError:

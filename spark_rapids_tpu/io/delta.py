@@ -496,6 +496,11 @@ def _zorder_indices(at, zorder_by: List[str]):
     interleave MSB-first (reference: sql-plugin zorder/ZOrderRules.scala
     + JNI ZOrder interleave_bits)."""
     import numpy as np
+    # bits per column capped so the interleaved key fits uint64 (>4
+    # z-order columns would otherwise shift the leading columns' high
+    # bits out and scramble the curve)
+    bits = min(16, 64 // max(1, len(zorder_by)))
+    top = float((1 << bits) - 1)
     cols = []
     for name in zorder_by:
         v = at.column(name).to_numpy(zero_copy_only=False).astype(
@@ -503,9 +508,9 @@ def _zorder_indices(at, zorder_by: List[str]):
         v = np.where(np.isnan(v), 0.0, v)
         lo, hi = float(v.min()), float(v.max())
         span = (hi - lo) or 1.0
-        cols.append(((v - lo) / span * 65535.0).astype(np.uint64))
+        cols.append(((v - lo) / span * top).astype(np.uint64))
     z = np.zeros(at.num_rows, np.uint64)
-    for bit in range(15, -1, -1):
+    for bit in range(bits - 1, -1, -1):
         for c in cols:
             z = (z << np.uint64(1)) | ((c >> np.uint64(bit))
                                        & np.uint64(1))

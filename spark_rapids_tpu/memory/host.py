@@ -1,0 +1,114 @@
+"""Global host-memory budget (the HostAlloc analog).
+
+The reference bounds executor host DRAM with one HostAlloc pool
+(HostAlloc.scala:36; limits RapidsConf.scala:337-353): pinned pool +
+non-pinned limit, allocations past the limit blocking or spilling the
+host store. Standalone analog: ONE process-wide byte budget that every
+host-resident consumer draws from —
+
+  - the spill store's HOST tier (device batches demoted to host DRAM)
+  - async write buffers (TrafficController in-flight bytes)
+  - shuffle-assembly arenas (HostArena reservations)
+
+Pressure hooks (the spill store registers its host->disk cascade) free
+host bytes when a reservation would overflow; a reservation that still
+cannot fit raises HostBudgetExceeded so the caller can route around
+host DRAM entirely (spill_to_host falls through to disk). Like the
+TrafficController, ONE outstanding reservation is always admitted so a
+single oversized buffer cannot wedge the process.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional
+
+__all__ = ["HostMemoryManager", "HostBudgetExceeded", "host_manager"]
+
+
+class HostBudgetExceeded(MemoryError):
+    pass
+
+
+class HostMemoryManager:
+    def __init__(self, budget_bytes: int):
+        self.budget = int(budget_bytes)
+        self._reserved = 0
+        self._holders = 0
+        self._lock = threading.RLock()
+        self._hooks: List[Callable[[int], int]] = []
+        self.metrics = {"pressureCalls": 0, "pressureFreed": 0}
+
+    def register_pressure_hook(self, fn: Callable[[int], int]):
+        """fn(bytes_needed) -> bytes freed (e.g. host->disk demotion)."""
+        with self._lock:
+            if fn not in self._hooks:
+                self._hooks.append(fn)
+
+    @property
+    def reserved(self) -> int:
+        with self._lock:
+            return self._reserved
+
+    def try_reserve(self, nbytes: int) -> bool:
+        with self._lock:
+            if self.budget <= 0 \
+                    or self._reserved + nbytes <= self.budget \
+                    or self._holders == 0:
+                self._reserved += nbytes
+                self._holders += 1
+                return True
+        return False
+
+    def reserve(self, nbytes: int):
+        """Reserve host bytes, firing pressure hooks when over budget.
+        Raises HostBudgetExceeded when hooks cannot make room and other
+        reservations are outstanding."""
+        if self.try_reserve(nbytes):
+            return
+        need = nbytes
+        self.metrics["pressureCalls"] += 1
+        for fn in list(self._hooks):
+            try:
+                freed = fn(need)
+            except Exception:
+                freed = 0
+            self.metrics["pressureFreed"] += int(freed or 0)
+            if self.try_reserve(nbytes):
+                return
+        raise HostBudgetExceeded(
+            f"host reservation of {nbytes} bytes over budget "
+            f"{self.budget} ({self._reserved} reserved)")
+
+    def force_reserve(self, nbytes: int):
+        """Unconditional reservation (soft-admit): accounting may
+        exceed the budget; later reservations see the pressure."""
+        with self._lock:
+            self._reserved += nbytes
+            self._holders += 1
+
+    def release(self, nbytes: int):
+        with self._lock:
+            self._reserved = max(0, self._reserved - nbytes)
+            self._holders = max(0, self._holders - 1)
+
+
+_GLOBAL: Optional[HostMemoryManager] = None
+_LOCK = threading.Lock()
+
+
+def host_manager(conf=None) -> HostMemoryManager:
+    global _GLOBAL
+    with _LOCK:
+        if _GLOBAL is None:
+            budget = 0
+            if conf is not None:
+                from ..config import HOST_MEMORY_LIMIT
+                budget = conf.get(HOST_MEMORY_LIMIT)
+            _GLOBAL = HostMemoryManager(budget)
+        elif conf is not None and _GLOBAL.budget == 0:
+            # a conf-less caller (e.g. shuffle arena) may have created
+            # the singleton unlimited; the first configured limit
+            # upgrades it rather than being silently ignored
+            from ..config import HOST_MEMORY_LIMIT
+            _GLOBAL.budget = conf.get(HOST_MEMORY_LIMIT)
+        return _GLOBAL

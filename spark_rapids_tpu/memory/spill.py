@@ -46,9 +46,23 @@ class SpillableBatchHandle:
         self._pinned = 0
 
     # -- spill path ----------------------------------------------------
-    def spill_to_host(self) -> int:
+    def spill_to_host(self, charge_budget: bool = True) -> int:
         if self.state != DEVICE or self._pinned:
             return 0
+        # draw from the GLOBAL host budget (HostAlloc analog); denied ->
+        # cascade straight to disk instead of growing host RSS. The
+        # disk path re-enters with charge_budget=False (transient host
+        # staging, not a host-tier residency).
+        hm = getattr(self.store, "host_mgr", None)
+        if charge_budget and hm is not None:
+            from .host import HostBudgetExceeded
+            try:
+                hm.reserve(self.nbytes)
+            except HostBudgetExceeded:
+                if self.store.spill_dir:
+                    return self.spill_to_disk(self.store.spill_dir)
+                return 0
+            self._host_reserved = True
         b = self._batch
         tree = {
             "cols": [c.device_buffers() for c in b.table.columns],
@@ -61,13 +75,21 @@ class SpillableBatchHandle:
         self.state = HOST
         return self.nbytes
 
+    def _release_host(self):
+        if getattr(self, "_host_reserved", False):
+            hm = getattr(self.store, "host_mgr", None)
+            if hm is not None:
+                hm.release(self.nbytes)
+            self._host_reserved = False
+
     def spill_to_disk(self, spill_dir: str) -> int:
         if self._pinned:
             return 0
         if self.state == DEVICE:
-            self.spill_to_host()
+            self.spill_to_host(charge_budget=False)
         if self.state != HOST:
             return 0
+        self._release_host()
         os.makedirs(spill_dir, exist_ok=True)
         path = os.path.join(spill_dir, f"spill-{self.id}.npz")
         flat = {}
@@ -110,6 +132,7 @@ class SpillableBatchHandle:
                                 capacity)
             self._batch = batch
             self._host = None
+            self._release_host()
             self.state = DEVICE
             return batch
         finally:
@@ -126,6 +149,7 @@ class SpillableBatchHandle:
             os.unlink(self._disk_path)
         if self.state == DEVICE and self._batch is not None:
             self.store.dm.release(self.nbytes)
+        self._release_host()
         self._batch = None
         self._host = None
         self.store._remove(self)
@@ -137,15 +161,36 @@ class SpillStore:
 
     def __init__(self, dm: Optional[DeviceManager] = None,
                  spill_dir: str = "/tmp/srtpu-spill",
-                 host_limit: int = 32 << 30):
+                 host_limit: int = 32 << 30, host_mgr=None):
         self.dm = dm or device_manager()
         self.spill_dir = spill_dir
         self.host_limit = host_limit
+        self.host_mgr = host_mgr
         self._lock = threading.RLock()
         self._handles: Dict[str, SpillableBatchHandle] = {}
         self.dm.register_spill_hook(self.spill)
+        if host_mgr is not None:
+            # global host pressure (async writes / arenas over budget)
+            # demotes this store's host tier to disk
+            host_mgr.register_pressure_hook(self.host_pressure)
         self.metrics = {"spillToHost": 0, "spillToDisk": 0,
                         "spillBytes": 0}
+
+    def host_pressure(self, bytes_needed: int) -> int:
+        """HostMemoryManager hook: demote host-tier handles to disk."""
+        freed = 0
+        with self._lock:
+            for h in sorted((h for h in self._handles.values()
+                             if h.state == HOST),
+                            key=lambda h: (h.priority, -h.nbytes)):
+                if freed >= bytes_needed:
+                    break
+                got = h.spill_to_disk(self.spill_dir)
+                if got:
+                    self.metrics["spillToDisk"] += 1
+                    self.metrics["spillBytes"] += got
+                    freed += got
+        return freed
 
     def add_batch(self, batch: DeviceBatch,
                   priority: int = 0) -> SpillableBatchHandle:
@@ -203,7 +248,9 @@ def spill_store(conf=None) -> SpillStore:
             kw = {}
             if conf is not None:
                 from ..config import HOST_SPILL_LIMIT, SPILL_DIR
+                from .host import host_manager
                 kw = {"spill_dir": conf.get(SPILL_DIR),
-                      "host_limit": conf.get(HOST_SPILL_LIMIT)}
+                      "host_limit": conf.get(HOST_SPILL_LIMIT),
+                      "host_mgr": host_manager(conf)}
             _STORE = SpillStore(device_manager(conf), **kw)
         return _STORE

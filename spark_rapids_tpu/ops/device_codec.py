@@ -1,0 +1,112 @@
+"""Device-side shuffle-payload compression (nvcomp analog, TPU-native).
+
+The reference compresses shuffle batches on the GPU with nvcomp LZ4
+(NvcompLZ4CompressionCodec.scala, TableCompressionCodec.scala). LZ4's
+greedy match-finding is a sequential dependency chain — a scalar loop
+on a TPU core — so the TPU-native codec here is BYTE-PLANE PACKING:
+
+  view the buffer as 64-bit words, chunk into 128-word (1 KiB) tiles,
+  and per tile keep only the byte planes that contain any non-zero
+  byte (an 8-bit mask per tile + the surviving planes).
+
+Columnar shuffle payloads are dominated by int64/int32 lanes whose high
+bytes are zero (keys, offsets, small measures), where this reaches
+2-6x, fully vectorized in BOTH directions (transpose + cumsum +
+gather/scatter — no data-dependent control flow). Incompressible bytes
+cost only the per-tile mask (128 bytes per 128 KiB). Exactly
+invertible for any byte content.
+
+Layout: [u8 mask per tile | concatenated surviving 128-byte planes].
+Compressed size = ntiles + 128 * popcount(masks).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["plane_compress", "plane_decompress", "TILE_BYTES"]
+
+TILE_WORDS = 128
+TILE_BYTES = TILE_WORDS * 8
+
+
+def _pad_to_tiles(nbytes: int) -> int:
+    return ((nbytes + TILE_BYTES - 1) // TILE_BYTES) * TILE_BYTES
+
+
+@jax.jit
+def plane_compress(buf):
+    """uint8[N] (N a multiple of TILE_BYTES) -> (uint8[ntiles + N],
+    compressed_nbytes). The output buffer is worst-case sized; the
+    caller slices to a bucket of compressed_nbytes before moving it."""
+    n = buf.shape[0]
+    ntiles = n // TILE_BYTES
+    tiles = buf.reshape(ntiles, TILE_WORDS, 8)
+    planes = jnp.transpose(tiles, (0, 2, 1))      # (ntiles, 8, 128)
+    nonzero = jnp.any(planes != 0, axis=2)        # (ntiles, 8)
+    masks = jnp.sum(nonzero.astype(jnp.uint8)
+                    << jnp.arange(8, dtype=jnp.uint8), axis=1)
+    keep = nonzero.reshape(-1)                    # (ntiles*8,)
+    kept_before = jnp.cumsum(keep.astype(jnp.int32)) - keep
+    dest = ntiles + kept_before * TILE_WORDS      # byte offset per plane
+    flat_planes = planes.reshape(ntiles * 8, TILE_WORDS)
+    idx = (dest[:, None]
+           + jnp.arange(TILE_WORDS, dtype=jnp.int32)[None, :])
+    idx = jnp.where(keep[:, None], idx, ntiles + n)   # OOB drop slot
+    out = jnp.zeros(ntiles + n + 1, jnp.uint8) \
+        .at[:ntiles].set(masks) \
+        .at[idx.reshape(-1)].set(flat_planes.reshape(-1))[:ntiles + n]
+    total = ntiles + (jnp.sum(keep.astype(jnp.int32)) * TILE_WORDS)
+    return out, total
+
+
+@functools.partial(jax.jit, static_argnames=("nbytes",))
+def plane_decompress(comp, nbytes: int):
+    """Inverse of plane_compress: comp (uint8, any capacity >= the
+    compressed size) -> uint8[nbytes]."""
+    ntiles = nbytes // TILE_BYTES
+    cap = comp.shape[0]
+    masks = comp[:ntiles]
+    keep = ((masks[:, None]
+             >> jnp.arange(8, dtype=jnp.uint8)[None, :]) & 1) \
+        .astype(jnp.bool_).reshape(-1)            # (ntiles*8,)
+    kept_before = jnp.cumsum(keep.astype(jnp.int32)) - keep
+    src = ntiles + kept_before * TILE_WORDS
+    idx = (src[:, None]
+           + jnp.arange(TILE_WORDS, dtype=jnp.int32)[None, :])
+    idx = jnp.clip(idx, 0, cap - 1)
+    flat = jnp.where(keep[:, None], comp[idx], 0)  # (ntiles*8, 128)
+    planes = flat.reshape(ntiles, 8, TILE_WORDS)
+    tiles = jnp.transpose(planes, (0, 2, 1))       # (ntiles, 128, 8)
+    return tiles.reshape(nbytes)
+
+
+def compress_array(arr):
+    """Any-dtype device array -> (uint8 comp buffer, total_bytes device
+    scalar, orig_nbytes). Pads to tile size; caller keeps shape/dtype."""
+    nbytes = arr.size * arr.dtype.itemsize
+    padded = _pad_to_tiles(max(nbytes, TILE_BYTES))
+    if arr.dtype == jnp.bool_:
+        u8 = arr.reshape(-1).astype(jnp.uint8)
+    else:
+        u8 = jax.lax.bitcast_convert_type(
+            arr.reshape(-1), jnp.uint8).reshape(-1)
+    if u8.shape[0] < padded:
+        u8 = jnp.pad(u8, (0, padded - u8.shape[0]))
+    comp, total = plane_compress(u8)
+    return comp, total, nbytes
+
+
+def decompress_array(comp, orig_nbytes: int, shape, dtype):
+    """Inverse of compress_array on (possibly sliced) comp bytes."""
+    padded = _pad_to_tiles(max(orig_nbytes, TILE_BYTES))
+    u8 = plane_decompress(comp, padded)[:]
+    itemsize = jnp.dtype(dtype).itemsize
+    n = orig_nbytes // itemsize
+    if jnp.dtype(dtype) == jnp.bool_:
+        return u8[:n].astype(jnp.bool_).reshape(shape)
+    words = u8[:n * itemsize].reshape(n, itemsize)
+    out = jax.lax.bitcast_convert_type(words, dtype)
+    return out.reshape(shape)

@@ -317,7 +317,20 @@ class LocalShuffle:
             return np.zeros(count, np.dtype(np_dt))
         if self._arena is None and native_lib() is not None:
             try:
-                self._arena = HostArena(256 << 20)
+                # the shuffle-assembly arena draws from the GLOBAL host
+                # budget (HostAlloc analog); denied -> heap fallback
+                from ..memory.host import HostBudgetExceeded, host_manager
+                hm = host_manager()
+                try:
+                    hm.reserve(256 << 20)
+                except HostBudgetExceeded:
+                    raise MemoryError("host budget")
+                try:
+                    self._arena = HostArena(256 << 20)
+                    self._arena_reserved = True
+                except MemoryError:
+                    hm.release(256 << 20)
+                    raise
             except MemoryError:
                 self._arena = None
         if self._arena is not None:
@@ -329,4 +342,16 @@ class LocalShuffle:
 
     def cleanup(self):
         import shutil
+        if getattr(self, "_arena_reserved", False):
+            # return the arena's host-budget reservation (one per
+            # shuffle exchange; leaking it would starve the budget)
+            from ..memory.host import host_manager
+            host_manager().release(256 << 20)
+            self._arena_reserved = False
+        if self._arena is not None:
+            try:
+                self._arena.close()
+            except Exception:
+                pass
+            self._arena = None
         shutil.rmtree(self.dir, ignore_errors=True)

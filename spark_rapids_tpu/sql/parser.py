@@ -507,18 +507,26 @@ def _split_and(e):
     return [e]
 
 
-def _walk_replace(e, fn):
+def _walk_replace(e, fn, _memo=None):
     """Rebuild an expression tree bottom-up through fn (children first,
-    then the node itself)."""
+    then the node itself). Memoized by node identity: the same child is
+    commonly referenced from BOTH an attr (.left/.child) and the
+    .children list — it must be visited (and replaced) exactly once."""
+    if _memo is None:
+        _memo = {}
+    if id(e) in _memo:
+        return _memo[id(e)]
     for attr in ("left", "right", "child", "pred", "t", "f"):
         c = getattr(e, attr, None)
         if c is not None and hasattr(c, "bind"):
-            setattr(e, attr, _walk_replace(c, fn))
+            setattr(e, attr, _walk_replace(c, fn, _memo))
     kids = getattr(e, "children", None)
     if kids:
-        e.children = [(_walk_replace(c, fn) if hasattr(c, "bind") else c)
-                      for c in kids]
-    return fn(e)
+        e.children = [(_walk_replace(c, fn, _memo)
+                       if hasattr(c, "bind") else c) for c in kids]
+    out = fn(e)
+    _memo[id(e)] = out
+    return out
 
 
 def _mark_outer(e, sub_names):
@@ -655,6 +663,19 @@ def _apply_marker(session, df, m):
         extra = [n for n in _corr_inner_names(info.corr)]
         sub_out, out_name = _finalize_sub_output(session, info,
                                                  extra_keys=extra)
+        if m.negated:
+            # NOT IN is null-AWARE (three-valued logic): any NULL in
+            # the subquery makes every comparison UNKNOWN -> empty
+            # result, and outer rows with a NULL probe drop too
+            if info.corr:
+                raise UnsupportedExpr(
+                    "correlated NOT IN (use NOT EXISTS)")
+            has_null = sub_out.filter(
+                ColumnRef(out_name).isNull()).limit(1) \
+                .to_arrow().num_rows
+            if has_null:
+                return df.filter(Lit(False))
+            df = df.filter(m.left.isNotNull())
         sdf, rename = _rename_all(sub_out)
         cond = m.left == ColumnRef(rename[out_name])
         for c in info.corr:
@@ -676,6 +697,9 @@ def _apply_marker(session, df, m):
         if not info.corr:
             val_df, out_name = _finalize_sub_output(session, info)
             rows = val_df.to_arrow().to_pylist()
+            if len(rows) > 1:
+                raise ValueError(
+                    f"scalar subquery returned {len(rows)} rows")
             val = rows[0][out_name] if rows else None
             return df.filter(ops[op](other, Lit(val)))
         # correlated: every corr conjunct must be outer == inner
@@ -881,10 +905,9 @@ def parse_sql(session, sql: str):
                 aggs.append((alias or f"{e!r}", e))
                 new_projs.append((e, alias))
             elif contains_agg(e):
-                inner = []
-                e2 = _extract_aggs(e, inner)
-                for k, (nm, a) in enumerate(inner):
-                    aggs.append((nm, a))
+                # extract into the SHARED list: hidden names are
+                # __sqa{len(aggs)} so they stay unique across projections
+                e2 = _extract_aggs(e, aggs)
                 new_projs.append((e2, alias))
             else:
                 new_projs.append((e, alias))

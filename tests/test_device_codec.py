@@ -1,0 +1,69 @@
+"""Device-side shuffle compression: byte-plane packing (the TPU-native
+nvcomp-LZ4 analog, NvcompLZ4CompressionCodec.scala; r4 verdict next #7)
+— codec parity + the mesh exchange moving measurably fewer bytes."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.ops.device_codec import (compress_array,
+                                               decompress_array)
+
+N_DEV = min(8, jax.device_count())
+
+
+@pytest.mark.parametrize("arr", [
+    np.random.default_rng(0).integers(0, 50_000, 100_000).astype(
+        np.int64),
+    np.random.default_rng(1).integers(-2**62, 2**62, 5000).astype(
+        np.int64),
+    np.random.default_rng(2).standard_normal(30_000),
+    (np.random.default_rng(3).random(20_000) > 0.5),
+    np.random.default_rng(4).integers(0, 100, 7777).astype(np.int32),
+], ids=["small-i64", "full-i64", "f64", "bool", "odd-i32"])
+def test_roundtrip_exact(arr):
+    a = jnp.asarray(arr)
+    comp, total, nb = compress_array(a)
+    t = int(total)
+    sliced = jnp.pad(comp[:t], (0, comp.shape[0] - t))
+    back = decompress_array(sliced, nb, a.shape, a.dtype)
+    np.testing.assert_array_equal(np.asarray(back), arr)
+
+
+def test_small_ints_compress_4x():
+    a = jnp.asarray(np.random.default_rng(0).integers(
+        0, 50_000, 1 << 17).astype(np.int64))
+    _, total, nb = compress_array(a)
+    assert nb / int(total) > 3.5
+
+
+@pytest.mark.skipif(N_DEV < 2, reason="needs multiple devices")
+def test_mesh_groupby_with_compression():
+    rng = np.random.default_rng(7)
+    n = 1 << 15
+    data = {"k": pa.array(rng.integers(0, 500, n).astype(np.int64)),
+            "v": pa.array(rng.integers(-50, 50, n).astype(np.int64))}
+    plain = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 2048}) \
+        .create_dataframe(data).group_by("k") \
+        .agg(F.sum("v").alias("sv")).to_arrow()
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 2048,
+                       "spark.rapids.tpu.mesh.devices": N_DEV,
+                       "spark.rapids.tpu.mesh.shuffle.compress": "true"})
+    q = s.create_dataframe(data).group_by("k") \
+        .agg(F.sum("v").alias("sv"))
+    meshed = q.to_arrow()
+
+    def to_map(t):
+        return {t.column(0)[i].as_py(): t.column(1)[i].as_py()
+                for i in range(t.num_rows)}
+    assert to_map(meshed) == to_map(plain)
+    mets = {k: v for _op, ms in q.last_metrics().items()
+            for k, v in ms.items()
+            if k in ("compressedBytes", "rawBytes")}
+    assert mets.get("rawBytes", 0) > 0
+    # int64 keys/values < 2^32: packing must shed at least a third
+    assert mets["compressedBytes"] < mets["rawBytes"] * 0.67, mets

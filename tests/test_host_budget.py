@@ -1,0 +1,86 @@
+"""Global host-memory budget (HostAlloc.scala:36 analog; limits
+RapidsConf.scala:337-353): the spill store's host tier, async write
+buffers, and shuffle arenas draw from ONE byte budget; overcommit
+cascades host->disk instead of growing RSS (r4 verdict next #10)."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.memory.host import (HostBudgetExceeded,
+                                          HostMemoryManager)
+
+
+def test_reserve_release_and_always_admit_one():
+    hm = HostMemoryManager(1000)
+    hm.reserve(800)
+    with pytest.raises(HostBudgetExceeded):
+        hm.reserve(300)
+    hm.release(800)
+    # a single oversized reservation is always admitted
+    hm.reserve(5000)
+    hm.release(5000)
+    assert hm.reserved == 0
+
+
+def test_pressure_hook_frees_room():
+    hm = HostMemoryManager(1000)
+    state = {"held": 900}
+    hm.reserve(900)
+
+    def hook(need):
+        if state["held"]:
+            hm.release(state["held"])
+            freed, state["held"] = state["held"], 0
+            return freed
+        return 0
+
+    hm.register_pressure_hook(hook)
+    hm.reserve(500)            # fires the hook, then fits
+    assert hm.metrics["pressureCalls"] == 1
+    assert state["held"] == 0
+
+
+def test_spill_overcommit_cascades_to_disk(tmp_path, monkeypatch):
+    """Device pressure demotes batches to host; a tiny HOST budget sends
+    the overflow to DISK instead of growing host memory unbounded."""
+    import spark_rapids_tpu.memory.device as dev_mod
+    import spark_rapids_tpu.memory.host as host_mod
+    import spark_rapids_tpu.memory.spill as spill_mod
+
+    dm = dev_mod.DeviceManager(budget_bytes=4 << 20)
+    hm = HostMemoryManager(128 << 10)        # 128 KiB host tier
+    store = spill_mod.SpillStore(dm, spill_dir=str(tmp_path),
+                                 host_mgr=hm)
+    monkeypatch.setattr(dev_mod, "_GLOBAL", dm)
+    monkeypatch.setattr(spill_mod, "_STORE", store)
+    monkeypatch.setattr(host_mod, "_GLOBAL", hm)
+
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 4096})
+    n = 200_000
+    rng = np.random.default_rng(3)
+    df = s.create_dataframe({
+        "k": pa.array(rng.integers(0, 50_000, n).astype(np.int64)),
+        "v": pa.array(rng.standard_normal(n)),
+    })
+    out = df.group_by("k").agg(F.sum("v").alias("sv")).to_arrow()
+    assert out.num_rows == len(set(np.asarray(
+        df.to_arrow().column("k"))))
+    # the cascade went through: host tier stayed within ~budget and
+    # disk received the overflow
+    assert store.metrics["spillToDisk"] > 0, store.metrics
+    assert hm.reserved <= (128 << 10) * 2, hm.reserved
+
+
+def test_async_writes_draw_from_host_budget(tmp_path, monkeypatch):
+    import spark_rapids_tpu.memory.host as host_mod
+
+    hm = HostMemoryManager(1 << 30)
+    monkeypatch.setattr(host_mod, "_GLOBAL", hm)
+    s = st.TpuSession({
+        "spark.rapids.tpu.io.asyncWrite.enabled": "true"})
+    df = s.create_dataframe({"a": pa.array(range(10_000), pa.int64())})
+    df.write.parquet(str(tmp_path / "out"))
+    # all reservations released after the write completes
+    assert hm.reserved == 0
