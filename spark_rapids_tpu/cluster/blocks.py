@@ -25,14 +25,25 @@ from typing import Dict, List, Sequence, Tuple
 
 from .rpc import RpcClosed, recv_msg, send_msg
 
-__all__ = ["BlockStore", "ensure_server", "fetch_blocks", "FetchFailed"]
+__all__ = ["BlockStore", "ensure_server", "fetch_blocks",
+           "drop_shuffle", "FetchFailed"]
 
 MAX_SHUFFLES = 4
 
 
 class FetchFailed(RuntimeError):
-    """A peer block fetch failed (dead executor / evicted shuffle);
-    the driver re-executes the producing map task (lineage)."""
+    """A peer block fetch failed (dead executor / evicted shuffle).
+
+    Carries the observed mapper `addr` and `shuffle_id` as STRUCTURED
+    fields — the driver's lineage re-execution targets the failed
+    mapper from these, never by parsing exception text (the old repr
+    substring match silently degraded to full re-execution whenever a
+    message format drifted)."""
+
+    def __init__(self, msg: str, addr=None, shuffle_id: str = None):
+        super().__init__(msg)
+        self.addr = tuple(addr) if addr else None
+        self.shuffle_id = shuffle_id
 
 
 class BlockStore:
@@ -42,6 +53,18 @@ class BlockStore:
         # shuffle_id -> {(map_id, pid): path}
         self._shuffles: "OrderedDict[str, Dict[Tuple[int, int], str]]" = \
             OrderedDict()
+        # in-flight shuffles are pinned: the LRU never evicts them (an
+        # eviction mid-reduce forces full lineage re-execution). put()
+        # pins implicitly; drop() unpins + deletes.
+        self._pinned: set = set()
+
+    def pin(self, shuffle_id: str):
+        with self._lock:
+            self._pinned.add(shuffle_id)
+
+    def unpin(self, shuffle_id: str):
+        with self._lock:
+            self._pinned.discard(shuffle_id)
 
     def put(self, shuffle_id: str, map_id: int, pid: int, table) -> int:
         import pyarrow as pa
@@ -53,10 +76,16 @@ class BlockStore:
         with self._lock:
             if shuffle_id not in self._shuffles:
                 self._shuffles[shuffle_id] = {}
-            # true LRU: every put refreshes recency before evicting
+            self._pinned.add(shuffle_id)     # in-flight until drop()
+            # true LRU: every put refreshes recency before evicting;
+            # pinned (in-flight) shuffles are skipped — only completed
+            # ones whose owner never dropped them age out
             self._shuffles.move_to_end(shuffle_id)
-            while len(self._shuffles) > MAX_SHUFFLES:
-                _, old = self._shuffles.popitem(last=False)
+            evictable = [sid for sid in self._shuffles
+                         if sid not in self._pinned]
+            while len(self._shuffles) > MAX_SHUFFLES and evictable:
+                sid = evictable.pop(0)
+                old = self._shuffles.pop(sid)
                 for p in old.values():
                     try:
                         os.unlink(p)
@@ -79,6 +108,7 @@ class BlockStore:
 
     def drop(self, shuffle_id: str):
         with self._lock:
+            self._pinned.discard(shuffle_id)
             old = self._shuffles.pop(shuffle_id, None)
         for p in (old or {}).values():
             try:
@@ -158,21 +188,41 @@ def ensure_server() -> Tuple[str, int]:
 def fetch_blocks(addr: Tuple[str, int], shuffle_id: str,
                  map_ids: Sequence[int], pid: int) -> List:
     """Fetch this reduce partition's blocks from one mapper executor."""
-    addr = tuple(addr)   # canonical form: failure messages must match
-    #                      the driver's dead-mapper substring check
+    addr = tuple(addr)
     try:
         sock = socket.create_connection(addr, timeout=10)
     except OSError as e:
-        raise FetchFailed(f"connect {addr}: {e!r}") from e
+        raise FetchFailed(f"connect {addr}: {e!r}", addr=addr,
+                          shuffle_id=shuffle_id) from e
     try:
         send_msg(sock, "fetch", {"shuffle_id": shuffle_id,
                                  "map_ids": list(map_ids), "pid": pid})
         kind, payload = recv_msg(sock)
     except (RpcClosed, OSError) as e:
-        raise FetchFailed(f"fetch from {addr}: {e!r}") from e
+        raise FetchFailed(f"fetch from {addr}: {e!r}", addr=addr,
+                          shuffle_id=shuffle_id) from e
     finally:
         sock.close()
     if kind != "blocks":
         raise FetchFailed(
-            f"mapper {addr} missing blocks: {payload}")
+            f"mapper {addr} missing blocks: {payload}", addr=addr,
+            shuffle_id=shuffle_id)
     return payload.get("_arrow", [])
+
+
+def drop_shuffle(addr: Tuple[str, int], shuffle_id: str) -> bool:
+    """Ask one mapper's block server to unpin + delete a shuffle's
+    blocks (end-of-query cleanup; best-effort — a dead mapper's files
+    died with it)."""
+    try:
+        sock = socket.create_connection(tuple(addr), timeout=5)
+    except OSError:
+        return False
+    try:
+        send_msg(sock, "drop", {"shuffle_id": shuffle_id})
+        kind, _ = recv_msg(sock)
+        return kind == "ok"
+    except (RpcClosed, OSError):
+        return False
+    finally:
+        sock.close()

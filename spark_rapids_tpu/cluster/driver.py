@@ -302,10 +302,17 @@ class ClusterManager:
                     else:
                         task.future.set_result(payload["value"])
                 else:
-                    task.future.set_exception(RuntimeError(
-                        f"task failed on executor {eid}: "
-                        f"{payload.get('message')}\n"
-                        f"{payload.get('traceback', '')}"))
+                    msg = (f"task failed on executor {eid}: "
+                           f"{payload.get('message')}\n"
+                           f"{payload.get('traceback', '')}")
+                    ef = payload.get("error_fields") or {}
+                    if ef.get("type") == "FetchFailed":
+                        from .blocks import FetchFailed
+                        err = FetchFailed(msg, addr=ef.get("addr"),
+                                          shuffle_id=ef.get("shuffle_id"))
+                    else:
+                        err = RuntimeError(msg)
+                    task.future.set_exception(err)
             except Exception:
                 pass   # future already resolved by a retry path
             self._idle.put(eid)

@@ -85,9 +85,18 @@ def executor_main(host: str, port: int, exec_id: int) -> None:
                     send_msg(sock, "result", {"task_id": task_id,
                                               "value": result})
             except BaseException as e:  # report, don't die
-                send_msg(sock, "error", {
-                    "task_id": task_id, "message": repr(e),
-                    "traceback": traceback.format_exc()})
+                payload = {"task_id": task_id, "message": repr(e),
+                           "traceback": traceback.format_exc()}
+                from .blocks import FetchFailed
+                if isinstance(e, FetchFailed):
+                    # structured fields survive the wire so the driver
+                    # re-raises a typed FetchFailed (lineage targeting
+                    # without exception-text parsing)
+                    payload["error_fields"] = {
+                        "type": "FetchFailed",
+                        "addr": list(e.addr) if e.addr else None,
+                        "shuffle_id": e.shuffle_id}
+                send_msg(sock, "error", payload)
     except RpcClosed:
         pass
     finally:

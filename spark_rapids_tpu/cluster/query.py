@@ -146,6 +146,8 @@ class DistributedRunner:
 
         import spark_rapids_tpu as st
 
+        from .blocks import FetchFailed, drop_shuffle
+
         n_reduce = n_reduce or max(len(self.cm.alive_executors), 1)
         shuffle_id = uuid.uuid4().hex[:12]
 
@@ -159,42 +161,53 @@ class DistributedRunner:
         metas = run_maps(range(len(splits)))
         done: Dict[int, object] = {}     # pid -> reduce output table
 
-        for attempt in range(3):
-            # per-pid fetch plan: mapper addr -> map ids that produced
-            # blocks for that pid
-            all_pids = sorted({p for m2 in metas.values()
-                               for p in m2["pids"]})
-            rfuts = []
-            for pid in all_pids:
-                if pid in done:          # keep completed partitions
-                    continue
-                by_addr: Dict[tuple, List[int]] = {}
-                for i, m2 in metas.items():
-                    if pid in m2["pids"]:
-                        by_addr.setdefault(tuple(m2["addr"]),
-                                           []).append(m2["map_id"])
-                sources = [(list(a), ids)
-                           for a, ids in sorted(by_addr.items())]
-                rfuts.append((pid, self.cm.submit(
-                    reduce_fetch_task, reduce_fn, self.conf,
-                    shuffle_id, pid, sources)))
-            refetch = set()
-            for pid, f in rfuts:
-                try:
-                    done[pid] = f.result().tables[0]
-                except Exception as e:
-                    if "FetchFailed" not in repr(e) or attempt == 2:
-                        raise
-                    # lineage: re-execute the map splits whose mapper
-                    # address appears in the failure (idempotent
-                    # fragments); if the address can't be parsed out,
-                    # re-execute everything
-                    dead = {i for i, m2 in metas.items()
-                            if f"{tuple(m2['addr'])}" in repr(e)}
-                    refetch |= dead or set(metas)
-            if not refetch:
-                break
-            metas.update(run_maps(sorted(refetch)))
+        try:
+            for attempt in range(3):
+                # per-pid fetch plan: mapper addr -> map ids that
+                # produced blocks for that pid
+                all_pids = sorted({p for m2 in metas.values()
+                                   for p in m2["pids"]})
+                rfuts = []
+                for pid in all_pids:
+                    if pid in done:      # keep completed partitions
+                        continue
+                    by_addr: Dict[tuple, List[int]] = {}
+                    for i, m2 in metas.items():
+                        if pid in m2["pids"]:
+                            by_addr.setdefault(tuple(m2["addr"]),
+                                               []).append(m2["map_id"])
+                    sources = [(list(a), ids)
+                               for a, ids in sorted(by_addr.items())]
+                    rfuts.append((pid, self.cm.submit(
+                        reduce_fetch_task, reduce_fn, self.conf,
+                        shuffle_id, pid, sources)))
+                refetch = set()
+                for pid, f in rfuts:
+                    try:
+                        done[pid] = f.result().tables[0]
+                    except FetchFailed as e:
+                        if attempt == 2:
+                            raise
+                        # lineage: re-execute the map splits of the
+                        # FAILED mapper, identified by the typed
+                        # exception's structured addr (idempotent
+                        # fragments); an addr-less failure re-executes
+                        # everything
+                        dead = set()
+                        if e.addr is not None:
+                            dead = {i for i, m2 in metas.items()
+                                    if tuple(m2["addr"]) == e.addr}
+                        refetch |= dead or set(metas)
+                if not refetch:
+                    break
+                metas.update(run_maps(sorted(refetch)))
+        finally:
+            # the shuffle's blocks are pinned on the mappers (the
+            # MAX_SHUFFLES LRU never evicts in-flight shuffles); drop
+            # them explicitly now the query is done (best-effort —
+            # a dead mapper's files died with its temp dir)
+            for addr in {tuple(m2["addr"]) for m2 in metas.values()}:
+                drop_shuffle(addr, shuffle_id)
         if not done:
             return None
         result = pa.concat_tables([done[p] for p in sorted(done)])

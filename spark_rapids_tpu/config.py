@@ -234,6 +234,30 @@ CBO_SMALL_INPUT_ROWS = _conf(
     "sql.optimizer.cbo.smallInputRows", 64,
     "CBO small-input bound: estimated input rows at or below this run "
     "host-side when coverable.", int)
+DISTINCT_AGG_REWRITE = _conf(
+    "sql.optimizer.distinctAggRewrite.enabled", True,
+    "Rewrite count(DISTINCT x) into a two-level hash aggregation (the "
+    "single-distinct-child case of Catalyst's "
+    "RewriteDistinctAggregates): an inner DISTINCT group-by over "
+    "(keys..., x) then an outer Count. Both levels run the bucketed "
+    "hash-aggregate pass (incl. hash-once string keying) instead of "
+    "CollectAggExec's full multi-chunk lexsort.", bool)
+JOIN_REORDER_ENABLED = _conf(
+    "sql.optimizer.joinReorder.enabled", True,
+    "Cost-based join reordering (analog of Catalyst's "
+    "CostBasedJoinReorder / spark.sql.cbo.joinReorder.enabled): maximal "
+    "chains of INNER equi-joins are reordered into the left-deep order "
+    "minimizing estimated intermediate cardinalities, from bottom-up "
+    "row/NDV estimates (sampled scan statistics, Chao1 extrapolation). "
+    "Outer/semi/anti/cross joins and non-equi conditions are never "
+    "reordered across. The smaller estimated side of every join lands "
+    "on the build side, keeping broadcast decisions consistent.", bool)
+JOIN_REORDER_DP_RELATIONS = _conf(
+    "sql.optimizer.joinReorder.maxDpRelations", 8,
+    "Join chains with at most this many relations are ordered by exact "
+    "dynamic programming over left-deep orders (Selinger); larger "
+    "chains use a greedy min-intermediate-cardinality extension "
+    "(analog of spark.sql.cbo.joinReorder.dp.threshold).", int)
 PYTHON_CONCURRENT_WORKERS = _conf(
     "python.concurrentPythonWorkers", 4,
     "Worker-process slots for pandas transforms (mapInPandas); "
@@ -299,6 +323,17 @@ WINDOW_CHUNK_ROWS = _conf(
     "out-of-core sort with carried per-partition state, so a window "
     "partition no longer must fit device memory (reference: "
     "GpuRunningWindowExec batched running windows). 0 disables.", int)
+AGG_STRING_HASH_KEYS = _conf(
+    "sql.agg.stringHashKeys.enabled", True,
+    "Hash-once 64-bit keying of string group-by columns: the "
+    "aggregation hash pass derives its bucket hashes from the same "
+    "packed order-key chunk words the exact verify step compares "
+    "(xxhash64-style fold), so string keys are read once per batch "
+    "instead of twice (murmur3 walk + chunk build). Collisions stay "
+    "exact — a row joins a bucket only when the chunk compare against "
+    "the bucket representative passes; colliding rows retry the next "
+    "round and survivors take the sort path (cudf hash-based string "
+    "keying analog).", bool)
 AGG_MAX_MERGE_ROWS = _conf(
     "sql.agg.maxMergeRows", 1 << 21,
     "Upper bound on buffered partial-aggregate rows merged in one "

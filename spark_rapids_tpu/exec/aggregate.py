@@ -265,6 +265,41 @@ def _dtype_for_np(npdt) -> dt.DataType:
     return _NP2DT[np.dtype(npdt)]
 
 
+def _packed_eq_arrays(key_cvs, keys, nchunks):
+    """Per-column equality key arrays (null flag + order keys) with
+    adjacent uint32 chunk words packed into uint64: halves the
+    rep-gather + compare count in the hash-pass verify step."""
+    out = []
+    for kcv, kexpr, nc in zip(key_cvs, keys, nchunks):
+        arrs = [jnp.logical_not(kcv.validity).astype(jnp.uint8)]
+        arrs += sk.order_keys(kcv, kexpr.dtype, nc)
+        packed = []
+        i = 0
+        while i < len(arrs):
+            a = arrs[i]
+            if (a.dtype == jnp.uint32 and i + 1 < len(arrs)
+                    and arrs[i + 1].dtype == jnp.uint32):
+                packed.append((a.astype(jnp.uint64) << 32)
+                              | arrs[i + 1].astype(jnp.uint64))
+                i += 2
+            else:
+                packed.append(a)
+                i += 1
+        out.append(packed)
+    return out
+
+
+def _remix_round(h1, r: int):
+    """Round-r bucket hash from the base row hash: integer finalizer
+    mix, so only round 0 pays the O(bytes) key walk."""
+    if r == 0:
+        return h1
+    hm = h1.astype(jnp.uint32) ^ jnp.uint32(0x9E3779B9 * r)
+    hm = hm * jnp.uint32(0x85EBCA6B)
+    hm = hm ^ (hm >> 13)
+    return (hm * jnp.uint32(0xC2B2AE35)).astype(jnp.int32)
+
+
 class HashAggregateExec(TpuExec):
     """Grouped aggregation via segmented reduction over sorted keys.
 
@@ -468,14 +503,22 @@ class HashAggregateExec(TpuExec):
                    for kcv in key_cvs]
         return perm, seg_ids, live_sorted, seg_live, key_out
 
-    def _hash_update_fn(self, nchunks):
+    def _hash_update_fn(self, nchunks, hash_once: bool = False):
         """Sort-free first pass: bucket rows by key hash, verify each row's
         key against its bucket's representative (canonical order-key
         equality — NaN/-0.0/null exact), segment-reduce matching rows, and
         leave collisions to the next round / sort fallback. Returns
         (key_cvs, flat_states, live, n_leftover) with capacity
-        _HASH_ROUNDS * _HASH_BUCKETS."""
-        from ..ops.hash import murmur3_row_hash
+        _HASH_ROUNDS * _HASH_BUCKETS.
+
+        With `hash_once` (string keys, sql.agg.stringHashKeys.enabled)
+        the bucket hash derives from the SAME packed chunk words the
+        verify step compares (xxhash64-style fold, ops/hash.py) — one
+        byte pass over the string keys total, instead of murmur3's
+        second independent walk. Collisions stay exact: a row matches a
+        bucket only when the chunk compare against the representative
+        passes; hash collisions fall to the next round / sort path."""
+        from ..ops.hash import hash_once_rows, murmur3_row_hash
 
         def fn(cvs, mask):
             cvs, mask = self._stages(cvs, mask)
@@ -483,26 +526,7 @@ class HashAggregateExec(TpuExec):
             ctx = EmitCtx(cvs, cap)
             key_cvs = [k.emit(ctx) for k in self.keys]
             key_dtypes = [k.dtype for k in self.keys]
-            eq_arrays = []
-            for kcv, kexpr, nc in zip(key_cvs, self.keys, nchunks):
-                arrs = [jnp.logical_not(kcv.validity).astype(jnp.uint8)]
-                arrs += sk.order_keys(kcv, kexpr.dtype, nc)
-                # pack adjacent uint32 chunk keys into uint64: halves the
-                # rep-gather + compare count in the verify step (long
-                # string keys dominate high-card groupbys, e.g. q10)
-                packed = []
-                i = 0
-                while i < len(arrs):
-                    a = arrs[i]
-                    if (a.dtype == jnp.uint32 and i + 1 < len(arrs)
-                            and arrs[i + 1].dtype == jnp.uint32):
-                        packed.append((a.astype(jnp.uint64) << 32)
-                                      | arrs[i + 1].astype(jnp.uint64))
-                        i += 2
-                    else:
-                        packed.append(a)
-                        i += 1
-                eq_arrays.append(packed)
+            eq_arrays = _packed_eq_arrays(key_cvs, self.keys, nchunks)
             agg_inputs = []
             for a in self.aggs:
                 if a.child is not None:
@@ -518,20 +542,16 @@ class HashAggregateExec(TpuExec):
             # hash the full (possibly var-width) keys ONCE; later rounds
             # re-bucket by mixing the base hash with an integer
             # finalizer — O(bytes) work happens a single time
-            h1 = murmur3_row_hash(key_cvs, key_dtypes, seed=42)
+            if hash_once:
+                h1 = hash_once_rows(eq_arrays)
+            else:
+                h1 = murmur3_row_hash(key_cvs, key_dtypes, seed=42)
             for r in range(_HASH_ROUNDS):
                 # escalating buckets: round 0 small (low-cardinality
                 # batches — the common case — pay only 4096-slot segment
                 # ops), later rounds big enough for high-card batches
                 B = _HASH_BUCKETS if r == 0 else _hash_buckets_for(cap)
-                if r == 0:
-                    h = h1
-                else:
-                    hm = h1.astype(jnp.uint32) ^ jnp.uint32(
-                        0x9E3779B9 * r)
-                    hm = hm * jnp.uint32(0x85EBCA6B)
-                    hm = hm ^ (hm >> 13)
-                    h = (hm * jnp.uint32(0xC2B2AE35)).astype(jnp.int32)
+                h = _remix_round(h1, r)
                 b = (h.astype(jnp.uint32) % jnp.uint32(B)).astype(jnp.int32)
                 repmin = jax.ops.segment_min(
                     jnp.where(remaining, rowidx, cap), b, B)
@@ -722,15 +742,18 @@ class HashAggregateExec(TpuExec):
                 self._stages = lambda cvs, mask: (cvs, mask)
 
     # -- whole-input fused path (HBM-cached child, one device program) --
-    def _whole_grouped_program(self, nchunks, opt_cap):
+    def _whole_grouped_program(self, nchunks, opt_cap,
+                               hash_once: bool = False):
         """ONE program for the entire cached input: per-batch fused
         stages + key/input emit, concat, sort-segment aggregate, compact
         live groups to opt_cap, finalize — plus (count, overflow) so the
         host can detect optimistic-capacity misses in the same round trip
         (the whole-stage answer to the reference's multi-pass
-        GpuAggregateExec when groups are few)."""
+        GpuAggregateExec when groups are few). `hash_once` derives the
+        per-round bucket hashes from the equality chunk words (one byte
+        pass over string keys; see _hash_update_fn)."""
         from ..ops.gather import take_strings
-        from ..ops.hash import murmur3_row_hash
+        from ..ops.hash import hash_once_rows, murmur3_row_hash
         key_dtypes = [k.dtype for k in self.keys]
 
         def run(batches):
@@ -768,11 +791,11 @@ class HashAggregateExec(TpuExec):
             # hash rounds (sort-free — XLA device sorts at input scale
             # are the slow path on TPU; bucketed segment reduction is
             # O(rounds * n))
-            eq_arrays = []
-            for kcv, kexpr, nc in zip(key_cvs, self.keys, nchunks):
-                arrs = [jnp.logical_not(kcv.validity).astype(jnp.uint8)]
-                arrs += sk.order_keys(kcv, kexpr.dtype, nc)
-                eq_arrays.append(arrs)
+            eq_arrays = _packed_eq_arrays(key_cvs, self.keys, nchunks)
+            if hash_once:
+                h1 = hash_once_rows(eq_arrays)
+            else:
+                h1 = murmur3_row_hash(key_cvs, key_dtypes, seed=42)
             B = _HASH_BUCKETS
             remaining = mask
             rowidx = jnp.arange(cap, dtype=jnp.int32)
@@ -780,8 +803,7 @@ class HashAggregateExec(TpuExec):
             round_states = None
             round_live = []
             for r in range(_HASH_ROUNDS):
-                h = murmur3_row_hash(key_cvs, key_dtypes,
-                                     seed=42 + r * 1000003)
+                h = _remix_round(h1, r)
                 b = (h.astype(jnp.uint32)
                      % jnp.uint32(B)).astype(jnp.int32)
                 repmin = jax.ops.segment_min(
@@ -886,12 +908,15 @@ class HashAggregateExec(TpuExec):
         if not hasattr(self, "_whole_nchunks"):
             ncs = [self._batch_nchunks(b) for b in batches]
             self._whole_nchunks = tuple(max(t) for t in zip(*ncs))
-        key = ("whole", self._whole_nchunks, opt_cap,
+        from ..config import AGG_STRING_HASH_KEYS
+        hash_once = (self._has_string_keys()
+                     and bool(ctx.conf.get(AGG_STRING_HASH_KEYS)))
+        key = ("whole", self._whole_nchunks, opt_cap, hash_once,
                tuple(b.capacity for b in batches))
         fn = self._update_cache.get(key)
         if fn is None:
             fn = jax.jit(self._whole_grouped_program(
-                self._whole_nchunks, opt_cap))
+                self._whole_nchunks, opt_cap, hash_once))
             self._update_cache[key] = fn
         args = tuple((tuple(b.cvs()), b.row_mask) for b in batches)
         with m.timer("opTime"):
@@ -923,15 +948,20 @@ class HashAggregateExec(TpuExec):
                 yield whole
                 return
 
+        from ..config import AGG_STRING_HASH_KEYS
+        hash_once = (self._has_string_keys()
+                     and bool(ctx.conf.get(AGG_STRING_HASH_KEYS)))
+
         def update_one(b):
             from .batch import maybe_compact
             b = maybe_compact(b, child.schema)
             nchunks = self._batch_nchunks(b)
             if self._hash_ok and not self._hash_disabled:
-                hfn = self._update_cache.get(("hash", nchunks))
+                hfn = self._update_cache.get(("hash", nchunks, hash_once))
                 if hfn is None:
-                    hfn = jax.jit(self._hash_update_fn(nchunks))
-                    self._update_cache[("hash", nchunks)] = hfn
+                    hfn = jax.jit(self._hash_update_fn(nchunks,
+                                                       hash_once))
+                    self._update_cache[("hash", nchunks, hash_once)] = hfn
                 rep_rows, st, sl, leftover, n_live = hfn(b.cvs(),
                                                          b.row_mask)
                 from ..utils.transfer import fetch

@@ -20,7 +20,8 @@ import jax.numpy as jnp
 from ..columnar import dtypes as dt
 from .kernel_utils import CV
 
-__all__ = ["murmur3_cv", "murmur3_row_hash", "partition_ids"]
+__all__ = ["murmur3_cv", "murmur3_row_hash", "partition_ids",
+           "fold64", "avalanche32", "hash_once_rows"]
 
 # numpy (NOT jnp) scalars: module-level eager jnp constants become
 # captured device buffers hoisted into executable parameters, and the
@@ -173,6 +174,63 @@ def partition_ids(cvs, dtypes, num_partitions: int, seed: int = 42):
     h = murmur3_row_hash(cvs, dtypes, seed)
     m = h % jnp.int32(num_partitions)
     return jnp.where(m < 0, m + num_partitions, m).astype(jnp.int32)
+
+
+# ----------------------------------------------------------------------
+# Hash-once 64-bit keying (xxhash64-style) for the aggregation fast path
+# ----------------------------------------------------------------------
+# The grouped-aggregation hash pass needs a bucket hash AND exact
+# equality keys for every grouping column. For string keys the equality
+# keys are the padded 4-byte chunk words (ops/sortkeys.py) — already an
+# O(bytes) read of the column. Hashing the SAME words with xxhash64-style
+# mixing gives the bucket hash for free: one byte pass total, instead of
+# murmur3's second independent walk over the string bytes (the reference
+# leans on cudf's hash-based string keying the same way; xxhash64 is the
+# jni Hash kernel family's second algorithm). Engine-internal only —
+# exchanges keep Spark-compatible murmur3.
+
+_P64_1 = 0x9E3779B185EBCA87
+_P64_2 = 0xC2B2AE3D27D4EB4F
+_P64_3 = 0x165667B19E3779F9
+
+
+def fold64(h, a):
+    """One xxhash64-style accumulation round folding integer array `a`
+    into the uint64 accumulator `h` (element-wise, vectorized)."""
+    a64 = (a.astype(jnp.uint64) * jnp.uint64(_P64_2))
+    a64 = (a64 << 31) | (a64 >> 33)
+    a64 = a64 * jnp.uint64(_P64_1)
+    h = h ^ a64
+    h = ((h << 27) | (h >> 37)) * jnp.uint64(_P64_1) \
+        + jnp.uint64(_P64_3)
+    return h
+
+
+def avalanche32(h):
+    """Finalize a uint64 accumulator into a well-mixed int32 (bucket
+    index source)."""
+    h = h ^ (h >> 33)
+    h = h * jnp.uint64(_P64_2)
+    h = h ^ (h >> 29)
+    h = h * jnp.uint64(_P64_3)
+    h = h ^ (h >> 32)
+    return (h & jnp.uint64(0xFFFFFFFF)).astype(jnp.uint32) \
+        .astype(jnp.int32)
+
+
+def hash_once_rows(eq_arrays, seed: int = 0x9E3779B1):
+    """Row bucket hash derived from the already-built equality key
+    arrays (null flags + order-key chunk words, possibly uint64-packed):
+    every column's every key array folds into one 64-bit accumulator,
+    avalanched to int32. Equal rows hash equal by construction (the
+    arrays ARE the equality definition); no second pass over string
+    bytes. `eq_arrays` is a list (per column) of lists of arrays."""
+    n = eq_arrays[0][0].shape[0] if eq_arrays and eq_arrays[0] else 0
+    h = jnp.full(n, seed, jnp.uint64)
+    for arrs in eq_arrays:
+        for a in arrs:
+            h = fold64(h, a)
+    return avalanche32(h)
 
 
 # bloom-filter hash scheme shared by BloomFilterAggregate (build),

@@ -314,7 +314,53 @@ def push_filters(plan: L.LogicalPlan) -> L.LogicalPlan:
     return plan
 
 
-def optimize(plan: L.LogicalPlan) -> L.LogicalPlan:
+def rewrite_distinct_aggs(plan: L.LogicalPlan) -> L.LogicalPlan:
+    """count(DISTINCT x) -> two-level hash aggregation (the
+    single-distinct-child case of Catalyst's RewriteDistinctAggregates):
+    an inner DISTINCT Aggregate over (keys..., x) deduplicates, an outer
+    Count over the deduped value finishes. Both levels ride
+    HashAggregateExec's bucketed hash pass (incl. the hash-once string
+    keying) instead of CollectAggExec's full multi-chunk lexsort — the
+    q16 straggler shape. Count skips nulls, so the inner null-x group
+    drops out in the outer Count exactly like count(DISTINCT)."""
+    from ..expr.aggregates import Count, CountDistinct
+
+    def rewrite(node):
+        kids = [rewrite(c) for c in node.children]
+        node = _rebuild(node, kids)
+        if not (isinstance(node, L.Aggregate) and node.aggs
+                and all(type(a) is CountDistinct for _, a in node.aggs)):
+            return node
+        # one shared distinct child only (multiple distinct children
+        # need an Expand; keep those on the sort path)
+        if len({repr(a.child) for _, a in node.aggs}) != 1:
+            return node
+        key_names = [k.name for k in node.keys]
+        if len(set(key_names)) != len(key_names):
+            return node
+        val = "__cd_val"
+        if val in key_names:
+            return node
+        x = node.aggs[0][1].child
+        inner = L.Aggregate(node.children[0],
+                            node.keys + [Alias(x, val)], [])
+        outer = L.Aggregate(inner, [ColumnRef(nm) for nm in key_names],
+                            [(nm, Count(ColumnRef(val)))
+                             for nm, _ in node.aggs])
+        return outer
+
+    return rewrite(plan)
+
+
+def optimize(plan: L.LogicalPlan, conf=None) -> L.LogicalPlan:
     # Aggregate/Project at the root define their own required set; start
     # unconstrained and let node rules narrow it.
-    return prune(push_filters(plan), None)
+    plan = push_filters(plan)
+    if conf is not None:
+        from ..config import DISTINCT_AGG_REWRITE, JOIN_REORDER_ENABLED
+        if conf.get(DISTINCT_AGG_REWRITE):
+            plan = rewrite_distinct_aggs(plan)
+        if conf.get(JOIN_REORDER_ENABLED):
+            from .cbo import reorder_joins
+            plan = reorder_joins(plan, conf)
+    return prune(plan, None)

@@ -571,7 +571,7 @@ class Planner:
 
     def plan(self, root: L.LogicalPlan) -> TpuExec:
         from .optimizer import optimize
-        root = optimize(root)
+        root = optimize(root, self.conf)
         meta = PlanMeta(root)
         self._tag(meta)
         from ..config import CBO_ENABLED

@@ -1,0 +1,229 @@
+"""Bottom-up row / NDV statistics over logical plans.
+
+The Catalyst-CBO analog (reference: spark.sql.cbo.* statistics +
+FilterEstimation/JoinEstimation): every logical node gets an estimated
+row count and a per-column number-of-distinct-values (NDV) estimate,
+propagated bottom-up. Scans sample their first ~64K rows once (cached on
+the scan node, so repeated plans of a cached DataFrame pay nothing) and
+extrapolate NDV with a Chao1-style estimator; filters scale rows by the
+same per-conjunct selectivities the placement CBO uses; joins apply the
+classic |L|*|R| / max(ndv(lk), ndv(rk)) equi-join formula; aggregates
+shrink to the product of key NDVs.
+
+Consumers: the join-reorder pass (plan/cbo.py) ranks left-deep join
+orders by these estimates. Estimates are advisory — a bad estimate can
+cost performance, never correctness.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+from . import logical as L
+
+__all__ = ["Stats", "compute_stats", "scan_column_ndv"]
+
+# Rows sampled (from the first batch / the arrow table head) for NDV.
+SAMPLE_ROWS = 1 << 16
+
+
+class Stats:
+    """Row estimate + lazy per-column NDV lookup. `rows` is None when the
+    subtree has no estimable source. `ndv_of(name)` returns an NDV
+    estimate for an output column or None when unknown."""
+
+    __slots__ = ("rows", "_ndv_of")
+
+    def __init__(self, rows: Optional[float],
+                 ndv_of: Optional[Callable[[str], Optional[float]]] = None):
+        self.rows = rows
+        self._ndv_of = ndv_of or (lambda name: None)
+
+    def ndv_of(self, name: str) -> Optional[float]:
+        nd = self._ndv_of(name)
+        if nd is None:
+            return None
+        if self.rows is not None:
+            nd = min(nd, self.rows)
+        return max(nd, 1.0)
+
+
+def _chao1(counts, sample_n: int, total_rows: float) -> float:
+    """Extrapolate sample distinct count to the full column: Chao1
+    lower-bound estimator d + f1^2/(2*f2); an all-singleton sample is
+    read as a unique(-ish) column."""
+    import numpy as np
+    d = int(counts.shape[0])
+    if sample_n >= total_rows:
+        return float(d)
+    f1 = int(np.count_nonzero(counts == 1))
+    f2 = int(np.count_nonzero(counts == 2))
+    if f1 >= sample_n or (f1 == d and f2 == 0):
+        return float(total_rows)        # every sampled value unique
+    est = d + (f1 * f1) / (2.0 * max(f2, 1))
+    return float(min(max(est, d), total_rows))
+
+
+def _sample_arrow_column(node: L.LogicalPlan, name: str):
+    """First-SAMPLE_ROWS slice of a scan column as a pyarrow array, or
+    None when the scan cannot serve one cheaply."""
+    if isinstance(node, L.InMemoryScan):
+        if name not in node.arrow.schema.names:
+            return None
+        return node.arrow.column(name).slice(0, SAMPLE_ROWS)
+    if isinstance(node, L.CachedScan):
+        if not node.batches or name not in node.schema.names:
+            return None
+        from ..exec.nodes import _batch_to_arrow
+        at = getattr(node, "_stats_sample", None)
+        if at is None:
+            at = _batch_to_arrow(node.batches[0]).slice(0, SAMPLE_ROWS)
+            node._stats_sample = at
+        if name not in at.schema.names:
+            return None
+        return at.column(name)
+    return None
+
+
+def scan_column_ndv(node: L.LogicalPlan, name: str) -> Optional[float]:
+    """NDV estimate for one scan column, sampled once and cached on the
+    node (leaf nodes survive re-planning, so the sample is paid once per
+    DataFrame, not once per query execution)."""
+    cache: Dict[str, Optional[float]] = getattr(node, "_ndv_cache", None)
+    if cache is None:
+        cache = node._ndv_cache = {}
+    if name in cache:
+        return cache[name]
+    ndv: Optional[float] = None
+    try:
+        from .planner import _estimate_rows
+        rows = _estimate_rows(node)
+        arr = _sample_arrow_column(node, name)
+        if arr is not None and rows:
+            import numpy as np
+            import pyarrow.compute as pc
+            vc = pc.value_counts(arr)
+            counts = np.asarray(vc.field("counts"))
+            ndv = _chao1(counts, len(arr), float(rows))
+    except Exception:
+        ndv = None
+    cache[name] = ndv
+    return ndv
+
+
+def _proj_ndv_map(exprs) -> Dict[str, Optional[str]]:
+    """Output name -> source column name for pass-through / renamed
+    columns; computed expressions map to None (NDV unknown)."""
+    from ..expr.expressions import Alias, ColumnRef
+    out: Dict[str, Optional[str]] = {}
+    for e in exprs:
+        if isinstance(e, ColumnRef):
+            out[e.name] = e.name
+        elif isinstance(e, Alias) and isinstance(e.child, ColumnRef):
+            out[e.name] = e.child.name
+        else:
+            out[getattr(e, "name", "?")] = None
+    return out
+
+
+def _key_name(expr) -> Optional[str]:
+    """Single column name a join/group key resolves to, else None."""
+    from .optimizer import refs_of
+    refs = refs_of(expr)
+    if refs is not None and len(refs) == 1:
+        return next(iter(refs))
+    return None
+
+
+def _join_rows(node: L.Join, ls: Stats, rs: Stats) -> Optional[float]:
+    if ls.rows is None or rs.rows is None:
+        return None
+    if node.how in ("left_semi", "left_anti"):
+        return ls.rows * (0.5 if node.how == "left_semi" else 0.5)
+    rows = ls.rows * rs.rows
+    for lk, rk in zip(node.left_keys, node.right_keys):
+        ln, rn = _key_name(lk), _key_name(rk)
+        ndv_l = (ls.ndv_of(ln) if ln else None) or ls.rows
+        ndv_r = (rs.ndv_of(rn) if rn else None) or rs.rows
+        rows /= max(ndv_l, ndv_r, 1.0)
+    if node.how in ("left", "full"):
+        rows = max(rows, ls.rows)
+    if node.how in ("right", "full"):
+        rows = max(rows, rs.rows)
+    return rows
+
+
+def compute_stats(node: L.LogicalPlan) -> Stats:
+    """Bottom-up (rows, ndv) estimate for a logical subtree."""
+    from .cbo import _selectivity
+    from .planner import _estimate_rows
+
+    if isinstance(node, (L.InMemoryScan, L.CachedScan, L.ParquetScan,
+                         L.TextScan)):
+        rows = _estimate_rows(node)
+        return Stats(None if rows is None else float(rows),
+                     lambda n, _nd=node: scan_column_ndv(_nd, n))
+
+    if isinstance(node, L.Filter):
+        cs = compute_stats(node.children[0])
+        rows = (None if cs.rows is None
+                else cs.rows * _selectivity(node.condition))
+        return Stats(rows, cs._ndv_of)
+
+    if isinstance(node, L.Project):
+        cs = compute_stats(node.children[0])
+        m = _proj_ndv_map(node.exprs)
+
+        def ndv(n, _m=m, _cs=cs):
+            src = _m.get(n)
+            return None if src is None else _cs.ndv_of(src)
+        return Stats(cs.rows, ndv)
+
+    if isinstance(node, L.Join):
+        ls = compute_stats(node.children[0])
+        rs = compute_stats(node.children[1])
+        rows = _join_rows(node, ls, rs)
+        lnames = set(node.left.schema.names)
+
+        def ndv(n, _ls=ls, _rs=rs, _ln=lnames):
+            return _ls.ndv_of(n) if n in _ln else _rs.ndv_of(n)
+        return Stats(rows, ndv)
+
+    if isinstance(node, L.Aggregate):
+        cs = compute_stats(node.children[0])
+        if cs.rows is None:
+            return Stats(None)
+        groups = 1.0
+        known = True
+        for k in node.keys:
+            kn = _key_name(k)
+            nd = cs.ndv_of(kn) if kn else None
+            if nd is None:
+                known = False
+                break
+            groups *= nd
+        rows = min(groups, cs.rows) if known else \
+            min(cs.rows, max(cs.rows ** 0.75, 1.0))
+        key_names = {k.name for k in node.keys}
+
+        def ndv(n, _cs=cs, _keys=key_names):
+            return _cs.ndv_of(n) if n in _keys else None
+        return Stats(max(rows, 1.0), ndv)
+
+    if isinstance(node, L.Limit):
+        cs = compute_stats(node.children[0])
+        rows = (node.n if cs.rows is None
+                else min(float(node.n), cs.rows))
+        return Stats(float(rows), cs._ndv_of)
+
+    if isinstance(node, L.Union):
+        parts = [compute_stats(c) for c in node.children]
+        if any(p.rows is None for p in parts):
+            return Stats(None)
+        return Stats(sum(p.rows for p in parts))
+
+    if isinstance(node, (L.Sort, L.Repartition, L.WindowOp)):
+        cs = compute_stats(node.children[0])
+        return Stats(cs.rows, cs._ndv_of)
+
+    rows = _estimate_rows(node)
+    return Stats(None if rows is None else float(rows))

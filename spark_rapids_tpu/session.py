@@ -22,6 +22,10 @@ from .plan.planner import Planner
 
 __all__ = ["TpuSession", "DataFrame"]
 
+# per-process counter uniquifying the hidden right-side key renames of
+# name-based joins (see DataFrame.join)
+_JOIN_RENAME_COUNTER = [0]
+
 
 class TpuSession:
     _active: Optional["TpuSession"] = None
@@ -538,7 +542,12 @@ class DataFrame:
         # column pruning and filter pushdown working above joins.
         from .expr.expressions import Coalesce
         lnames = set(self._plan.schema.names)
-        rename = {f.name: f"__join_r_{f.name}"
+        # collision-proof internal names: a unique counter per join keeps
+        # the hidden key columns of DIFFERENT joins in one chain distinct,
+        # which the join-reorder pass relies on when it flattens a chain
+        _JOIN_RENAME_COUNTER[0] += 1
+        tag = _JOIN_RENAME_COUNTER[0]
+        rename = {f.name: f"__join_r{tag}_{f.name}"
                   for f in other._plan.schema.fields if f.name in lnames}
         rplan = other._plan
         if rename:

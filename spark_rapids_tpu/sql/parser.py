@@ -83,7 +83,53 @@ class OuterRef(ColumnRef):
     (correlated subquery predicate, Spark's OuterReference)."""
 
 
-class _Exists:
+class _SubqueryMarker:
+    """Base for the parser-internal subquery markers. Markers are only
+    consumable as top-level AND-connected WHERE conjuncts (where_parts /
+    _apply_marker); combining one into any larger expression — HAVING,
+    SELECT list, JOIN ON, OR trees, arithmetic — raises a clear
+    UnsupportedExpr here instead of leaking a non-Expression object that
+    dies later with an opaque AttributeError (ADVICE r5 low)."""
+
+    _CTX = ("subquery predicates are only supported as top-level "
+            "AND-connected WHERE conjuncts")
+
+    def _reject(self, *_a, **_k):
+        raise UnsupportedExpr(self._CTX)
+
+    __and__ = __rand__ = __or__ = __ror__ = _reject
+    __add__ = __radd__ = __sub__ = __rsub__ = _reject
+    __mul__ = __rmul__ = __truediv__ = __rtruediv__ = _reject
+    __mod__ = __rmod__ = __neg__ = __invert__ = _reject
+
+    def __getattr__(self, name):
+        # .alias/.bind/.isNull/.between/... — anything an Expression
+        # would support — means the marker escaped its WHERE context
+        raise UnsupportedExpr(
+            f"{self._CTX} (attempted .{name} on a subquery marker)")
+
+
+def _no_subquery(e, where: str):
+    """Reject a subquery marker escaping into a non-WHERE context with a
+    clear message — including markers buried inside an expression tree
+    (an Expression operator wraps unknown operands as Literals)."""
+    def bad():
+        raise UnsupportedExpr(
+            f"subquery in {where} is not supported; "
+            + _SubqueryMarker._CTX)
+    if isinstance(e, _SubqueryMarker):
+        bad()
+    if isinstance(e, Literal) and isinstance(e.value, _SubqueryMarker):
+        bad()
+    if isinstance(e, str):                       # '*' projection
+        return e
+    for c in (getattr(e, "children", None) or []):
+        if c is not None and not isinstance(c, (int, float, str, bool)):
+            _no_subquery(c, where)
+    return e
+
+
+class _Exists(_SubqueryMarker):
     """Marker conjunct: [NOT] EXISTS (subquery) — rewritten to a
     left_semi / left_anti join (the reference rides Spark's
     RewritePredicateSubquery; InSubqueryExec analog)."""
@@ -96,7 +142,7 @@ class _Exists:
         return _Exists(self.sub, not self.negated)
 
 
-class _InSub:
+class _InSub(_SubqueryMarker):
     """Marker conjunct: expr [NOT] IN (subquery) -> semi/anti join."""
 
     def __init__(self, left, sub, negated=False):
@@ -108,16 +154,16 @@ class _InSub:
         return _InSub(self.left, self.sub, not self.negated)
 
 
-class _ScalarSub:
+class _ScalarSub(_SubqueryMarker):
     """Marker operand: (SELECT <agg expr> ...) inside a comparison.
     Uncorrelated -> executed to a Literal; correlated -> decorrelated
-    into a grouped-aggregate left join."""
+    into a grouped-aggregate LEFT join."""
 
     def __init__(self, sub):
         self.sub = sub
 
 
-class _SubCompare:
+class _SubCompare(_SubqueryMarker):
     """Marker conjunct: comparison with a _ScalarSub operand."""
 
     def __init__(self, op, left, right):
@@ -373,6 +419,11 @@ class _Parser:
         parts = [self.not_expr()]
         while self.accept("kw", "and"):
             parts.append(self.not_expr())
+        for x in parts:
+            if isinstance(x, _ScalarSub):
+                raise UnsupportedExpr(
+                    "scalar subquery must be used inside a comparison "
+                    "(e.g. col = (SELECT ...))")
         plains = [x for x in parts
                   if not isinstance(x, (_Exists, _InSub, _SubCompare))]
         marks = [x for x in parts
@@ -431,11 +482,12 @@ class _Parser:
             having = None
             if self.accept("kw", "group"):
                 self.expect("kw", "by")
-                group_keys = [self.expr()]
+                group_keys = [_no_subquery(self.expr(), "GROUP BY")]
                 while self.accept("op", ","):
-                    group_keys.append(self.expr())
+                    group_keys.append(_no_subquery(self.expr(),
+                                                   "GROUP BY"))
             if self.accept("kw", "having"):
-                having = self.expr()
+                having = _no_subquery(self.expr(), "HAVING")
             return _SubInfo(df, corr, projs, group_keys, having)
         finally:
             self.outer_aliases = saved_outer
@@ -444,7 +496,7 @@ class _Parser:
     def _select_list(self):
         projs = []
         while True:
-            e = self.expr()
+            e = _no_subquery(self.expr(), "the SELECT list")
             alias = None
             if self.accept("kw", "as"):
                 alias = self.expect("id")[1]
@@ -588,10 +640,19 @@ def _extract_aggs(e, aggs):
     return _walk_replace(e, fn)
 
 
-def _finalize_sub_output(session, info: "_SubInfo", extra_keys=()):
+def _finalize_sub_output(session, info: "_SubInfo", extra_keys=(),
+                         require_agg: bool = False):
     """Build the subquery's output DataFrame: GROUP BY (declared keys
     plus decorrelation keys) + hidden aggregates + HAVING + the single
-    projection. Returns (df, out_col_name)."""
+    projection. Returns (df, out_col_name, count_shaped) where
+    count_shaped marks a projection that is exactly a COUNT aggregate —
+    its empty-group value is 0, not NULL (Spark scalar-subquery
+    semantics; the LEFT-join decorrelation coalesces it).
+
+    `require_agg` (correlated scalar subqueries): a subquery with no
+    aggregate cannot guarantee at most one row per correlation key —
+    duplicate inner rows would silently multiply outer rows — so it is
+    rejected instead of decorrelated (ADVICE r5 medium)."""
     from ..session import DataFrame  # noqa: F401 (type only)
     df = info.df
     if len(info.projs) != 1 or isinstance(info.projs[0][0], str):
@@ -606,19 +667,29 @@ def _finalize_sub_output(session, info: "_SubInfo", extra_keys=()):
     keys = list(info.group_keys or []) + [ColumnRef(k)
                                           for k in extra_keys]
     if aggs:
+        count_shaped = (isinstance(proj, ColumnRef) and len(aggs) == 1
+                        and proj.name == aggs[0][0]
+                        and isinstance(aggs[0][1],
+                                       (agg.Count, agg.CountStar)))
         gp = df.group_by(*keys)
         df = gp.agg(*[a.alias(n) for n, a in aggs])
         if having is not None:
             df = df.filter(having)
         out_name = alias or "__sqout"
         df = df.select(*(list(keys) + [proj.alias(out_name)]))
-        return df, out_name
+        return df, out_name, count_shaped
+    if require_agg:
+        raise UnsupportedExpr(
+            "correlated scalar subquery without an aggregate: cannot "
+            "guarantee a single row per correlation key (duplicate "
+            "inner rows would multiply outer rows); aggregate the "
+            "subquery output (e.g. min/max/count)")
     if having is not None:
         raise UnsupportedExpr("HAVING without aggregates in subquery")
     out_name = alias or (proj.name if isinstance(proj, ColumnRef)
                          else "__sqout")
     df = df.select(*(list(keys) + [proj.alias(out_name)]))
-    return df, out_name
+    return df, out_name, False
 
 
 def _corr_inner_names(corr):
@@ -661,8 +732,8 @@ def _apply_marker(session, df, m):
         # correlation columns must survive the subquery's projection so
         # the join condition can reference them post-rename
         extra = [n for n in _corr_inner_names(info.corr)]
-        sub_out, out_name = _finalize_sub_output(session, info,
-                                                 extra_keys=extra)
+        sub_out, out_name, _ = _finalize_sub_output(session, info,
+                                                    extra_keys=extra)
         if m.negated:
             # NOT IN is null-AWARE (three-valued logic): any NULL in
             # the subquery makes every comparison UNKNOWN -> empty
@@ -695,7 +766,7 @@ def _apply_marker(session, df, m):
         op = m.op if sub is m.right else flip[m.op]
         # now the comparison reads: other <op> subquery-value
         if not info.corr:
-            val_df, out_name = _finalize_sub_output(session, info)
+            val_df, out_name, _ = _finalize_sub_output(session, info)
             rows = val_df.to_arrow().to_pylist()
             if len(rows) > 1:
                 raise ValueError(
@@ -722,16 +793,25 @@ def _apply_marker(session, df, m):
                 raise UnsupportedExpr(
                     "correlated scalar subquery needs col = col "
                     "correlation")
-        sub_out, out_name = _finalize_sub_output(
-            session, info, extra_keys=inner_keys)
+        sub_out, out_name, count_shaped = _finalize_sub_output(
+            session, info, extra_keys=inner_keys, require_agg=True)
         sdf, rename = _rename_all(sub_out)
         cond = None
         for ok, ik in zip(outer_keys, inner_keys):
             c2 = ColumnRef(ok) == ColumnRef(rename[ik])
             cond = c2 if cond is None else (cond & c2)
-        joined = df.join(sdf, on=cond, how="inner")
-        return joined.filter(ops[op](other,
-                                     ColumnRef(rename[out_name])))
+        # LEFT join (not inner): outer rows whose correlation group is
+        # EMPTY survive with a NULL subquery value. NULL comparisons
+        # drop the row — Spark's scalar-subquery semantics for
+        # sum/min/max/avg — while COUNT-shaped aggregates read 0 for
+        # empty groups, so `0 = (SELECT count(*) ...)` keeps unmatched
+        # outer rows (ADVICE r5 medium).
+        val = ColumnRef(rename[out_name])
+        if count_shaped:
+            from ..expr.expressions import Coalesce
+            val = Coalesce(val, Literal(0))
+        joined = df.join(sdf, on=cond, how="left")
+        return joined.filter(ops[op](other, val))
     raise UnsupportedExpr(f"unhandled subquery marker {m!r}")
 
 
@@ -807,7 +887,7 @@ def _parse_from(p: "_Parser", session):
             base = base.join(other, on=cols, how=how)
         else:
             p.expect("kw", "on")
-            cond = p.expr()
+            cond = _no_subquery(p.expr(), "JOIN ON")
             base = base.join(other, on=cond, how=how)
     return base
 
@@ -870,11 +950,11 @@ def parse_sql(session, sql: str):
     having_expr = None
     if p.accept("kw", "group"):
         p.expect("kw", "by")
-        group_keys = [p.expr()]
+        group_keys = [_no_subquery(p.expr(), "GROUP BY")]
         while p.accept("op", ","):
-            group_keys.append(p.expr())
+            group_keys.append(_no_subquery(p.expr(), "GROUP BY"))
     if p.accept("kw", "having"):
-        having_expr = p.expr()
+        having_expr = _no_subquery(p.expr(), "HAVING")
 
     # build select
     def is_agg(e):
@@ -965,7 +1045,7 @@ def parse_sql(session, sql: str):
         p.expect("kw", "by")
         orders = []
         while True:
-            e = p.expr()
+            e = _no_subquery(p.expr(), "ORDER BY")
             asc = True
             if p.accept("kw", "desc"):
                 asc = False

@@ -1,0 +1,45 @@
+"""BlockStore shuffle lifecycle (cluster/blocks.py): in-flight pinning
+against the MAX_SHUFFLES LRU, explicit end-of-query drop, and the
+structured (addr, shuffle_id) fields on FetchFailed."""
+import pyarrow as pa
+
+from spark_rapids_tpu.cluster.blocks import (BlockStore, FetchFailed,
+                                             MAX_SHUFFLES)
+
+
+def _table(i):
+    return pa.table({"x": pa.array([i, i + 1])})
+
+
+def test_pinned_shuffles_survive_lru_pressure():
+    bs = BlockStore()
+    bs.put("live", 0, 0, _table(0))          # put() pins implicitly
+    for i in range(MAX_SHUFFLES + 3):        # flood the LRU
+        bs.put(f"s{i}", 0, 0, _table(i))
+        bs.drop(f"s{i - 1}") if i else None  # completed ones unpinned
+    # the in-flight shuffle outlived every eviction wave
+    assert bs.get("live", 0, 0)
+    bs.drop("live")
+    assert not bs.get("live", 0, 0)
+
+
+def test_drop_unpins_and_deletes():
+    bs = BlockStore()
+    bs.put("q1", 0, 0, _table(1))
+    assert bs.get("q1", 0, 0)
+    bs.drop("q1")
+    assert not bs.get("q1", 0, 0)
+    # dropped shuffles no longer pin: LRU pressure evicts normally
+    for i in range(MAX_SHUFFLES + 2):
+        bs.put(f"t{i}", 0, 0, _table(i))
+        bs.unpin(f"t{i}")
+    assert not bs.get("t0", 0, 0)          # aged out
+
+
+def test_fetch_failed_structured_fields():
+    e = FetchFailed("connect refused", addr=["10.0.0.1", 7337],
+                    shuffle_id="abc123")
+    assert e.addr == ("10.0.0.1", 7337)
+    assert e.shuffle_id == "abc123"
+    e2 = FetchFailed("no addr")
+    assert e2.addr is None and e2.shuffle_id is None

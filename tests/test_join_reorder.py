@@ -1,0 +1,206 @@
+"""Cost-based join reordering (plan/cbo.py reorder_joins + plan/stats.py
+estimates; Catalyst CostBasedJoinReorder analog): estimate-driven order
+on a q5-shaped star chain, reorder-validity across join types, the
+conf gate, and on/off result equivalence."""
+import numpy as np
+import pyarrow as pa
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.plan import logical as L
+from spark_rapids_tpu.plan.optimizer import optimize
+
+REORDER_OFF = {"spark.rapids.tpu.sql.optimizer.joinReorder.enabled":
+               "false"}
+
+
+def _leaves(plan):
+    """Leaf relation signatures (sorted column names) in left-deep
+    order."""
+    out = []
+
+    def walk(n):
+        if isinstance(n, L.Join):
+            walk(n.left)
+            walk(n.right)
+            return
+        if isinstance(n, (L.Project, L.Filter)):
+            walk(n.children[0])
+            return
+        out.append(tuple(sorted(n.schema.names)))
+    walk(plan)
+    return out
+
+
+def _innermost_join(plan):
+    """The deepest Join node (the first join executed)."""
+    found = [None]
+
+    def walk(n):
+        if isinstance(n, L.Join):
+            found[0] = n
+        for c in n.children:
+            walk(c)
+    walk(plan)
+    return found[0]
+
+
+def _rows_set(at):
+    cols = sorted(at.schema.names)
+    return sorted(map(tuple, at.select(cols).to_pylist()), key=str)
+
+
+def _star_tables(s, n=10_000):
+    """q5-shaped cardinality cliff: fact A joins B on a low-NDV key
+    (~100x row blowup) and C on a high-NDV key against a 10-row dim
+    (massively selective). The written order joins A-B first — the
+    straggler shape; the cost-based order must join A-C first."""
+    rng = np.random.default_rng(0)
+    a = s.create_dataframe({"j": pa.array(rng.integers(0, 100, n)),
+                            "c_k": pa.array(np.arange(n))})
+    b = s.create_dataframe({"j": pa.array(rng.integers(0, 100, n)),
+                            "b_v": pa.array(rng.random(n))})
+    c = s.create_dataframe({"c_k": pa.array(np.arange(10)),
+                            "c_v": pa.array(rng.random(10))})
+    return a, b, c
+
+
+def test_reorder_changes_q5_shaped_chain():
+    s = st.TpuSession({})
+    a, b, c = _star_tables(s)
+    q = a.join(b, on=["j"]).join(c, on=["c_k"])
+    pre = _leaves(q._plan)
+    opt = optimize(q._plan, s.conf)
+    post = _leaves(opt)
+    assert post != pre, "reorder must change the straggler join order"
+    # the selective A><C join must run FIRST (innermost), not the
+    # blowup A><B pair the written order starts with
+    inner = _innermost_join(opt)
+    sides = {_leaves(inner.left)[0], _leaves(inner.right)[0]}
+    assert ("b_v", "j") not in sides
+    # and the rewrite is invisible: same rows as the unreordered run
+    s_off = st.TpuSession(REORDER_OFF)
+    a2 = s_off.create_dataframe(a.to_arrow())
+    b2 = s_off.create_dataframe(b.to_arrow())
+    c2 = s_off.create_dataframe(c.to_arrow())
+    want = a2.join(b2, on=["j"]).join(c2, on=["c_k"]).to_arrow()
+    got = q.to_arrow()
+    assert got.num_rows == want.num_rows
+    assert _rows_set(got) == _rows_set(want)
+
+
+def test_reorder_conf_gate_off_keeps_written_order():
+    s = st.TpuSession(REORDER_OFF)
+    a, b, c = _star_tables(s)
+    q = a.join(b, on=["j"]).join(c, on=["c_k"])
+    assert _leaves(optimize(q._plan, s.conf)) == _leaves(q._plan)
+
+
+def test_greedy_path_beyond_dp_bound_reorders_and_matches():
+    # maxDpRelations=2 forces the greedy min-intermediate extension on a
+    # 3-relation chain; it must make the same straggler-avoiding choice
+    s = st.TpuSession(
+        {"spark.rapids.tpu.sql.optimizer.joinReorder.maxDpRelations":
+         "2"})
+    a, b, c = _star_tables(s)
+    q = a.join(b, on=["j"]).join(c, on=["c_k"])
+    opt = optimize(q._plan, s.conf)
+    assert _leaves(opt) != _leaves(q._plan)
+    inner = _innermost_join(opt)
+    sides = {_leaves(inner.left)[0], _leaves(inner.right)[0]}
+    assert ("b_v", "j") not in sides
+
+
+def _typed_chain(s, how):
+    rng = np.random.default_rng(1)
+    a = s.create_dataframe({"j": pa.array(rng.integers(0, 50, 2000)),
+                            "c_k": pa.array(np.arange(2000))})
+    b = s.create_dataframe({"j": pa.array(rng.integers(0, 50, 2000)),
+                            "b_v": pa.array(rng.random(2000))})
+    c = s.create_dataframe({"c_k": pa.array(np.arange(10)),
+                            "c_v": pa.array(rng.random(10))})
+    return a.join(b, on=["j"], how=how).join(c, on=["c_k"])
+
+
+def test_left_join_bounds_the_reorderable_chain():
+    # a LEFT join inside the chain must never be reordered across: the
+    # written leaf order survives optimization, and results match the
+    # reorder-off run exactly (including the null-extended rows)
+    s = st.TpuSession({})
+    q = _typed_chain(s, "left")
+    assert _leaves(optimize(q._plan, s.conf)) == _leaves(q._plan)
+    s_off = st.TpuSession(REORDER_OFF)
+    want = _typed_chain(s_off, "left").to_arrow()
+    got = q.to_arrow()
+    assert _rows_set(got) == _rows_set(want)
+
+
+def test_semi_join_bounds_the_reorderable_chain():
+    s = st.TpuSession({})
+    q = _typed_chain(s, "left_semi")
+    assert _leaves(optimize(q._plan, s.conf)) == _leaves(q._plan)
+    s_off = st.TpuSession(REORDER_OFF)
+    want = _typed_chain(s_off, "left_semi").to_arrow()
+    assert _rows_set(q.to_arrow()) == _rows_set(want)
+
+
+def test_inner_chain_above_semi_still_reorders():
+    # chains BOUND by a semi join still reorder within the inner
+    # segment: (semi ><) A >< B >< C where A >< C is selective
+    s = st.TpuSession({})
+    a, b, c = _star_tables(s)
+    rng = np.random.default_rng(2)
+    f = s.create_dataframe({"c_k": pa.array(rng.integers(0, 10_000,
+                                                         500))})
+    q = (a.join(f, on=["c_k"], how="left_semi")
+          .join(b, on=["j"]).join(c, on=["c_k"]))
+    opt = optimize(q._plan, s.conf)
+    # the semi join itself is a chain relation (never flattened), but
+    # the inner joins around it may still move; results must match
+    s_off = st.TpuSession(REORDER_OFF)
+    a2 = s_off.create_dataframe(a.to_arrow())
+    b2 = s_off.create_dataframe(b.to_arrow())
+    c2 = s_off.create_dataframe(c.to_arrow())
+    f2 = s_off.create_dataframe(f.to_arrow())
+    want = (a2.join(f2, on=["c_k"], how="left_semi")
+              .join(b2, on=["j"]).join(c2, on=["c_k"])).to_arrow()
+    assert _rows_set(q.to_arrow()) == _rows_set(want)
+    # validity: the semi join must still be BELOW the inner chain —
+    # no inner relation slipped under it
+    def semi_nodes(n):
+        out = []
+
+        def walk(x):
+            if isinstance(x, L.Join) and x.how == "left_semi":
+                out.append(x)
+            for ch in x.children:
+                walk(ch)
+        walk(n)
+        return out
+    semis = semi_nodes(opt)
+    assert len(semis) == 1
+    assert _leaves(semis[0].left) == [("c_k", "j")]
+
+
+def test_four_relation_chain_on_off_equivalence():
+    rng = np.random.default_rng(3)
+    n = 3000
+    tabs = {
+        "t1": {"k1": rng.integers(0, 30, n), "k2": rng.integers(0, 8, n),
+               "v1": rng.random(n)},
+        "t2": {"k1": np.arange(30), "v2": rng.random(30)},
+        "t3": {"k2": np.arange(8), "k3": rng.integers(0, 4, 8)},
+        "t4": {"k3": np.arange(4), "v4": rng.random(4)},
+    }
+
+    def run(s):
+        d = {name: s.create_dataframe(
+            {c: pa.array(v) for c, v in cols.items()})
+            for name, cols in tabs.items()}
+        return (d["t1"].join(d["t2"], on=["k1"])
+                       .join(d["t3"], on=["k2"])
+                       .join(d["t4"], on=["k3"])).to_arrow()
+
+    got = run(st.TpuSession({}))
+    want = run(st.TpuSession(REORDER_OFF))
+    assert got.num_rows == want.num_rows
+    assert _rows_set(got) == _rows_set(want)

@@ -1,0 +1,99 @@
+"""Correlated scalar-subquery decorrelation semantics (sql/parser.py):
+LEFT-join decorrelation with COUNT-shaped empty-group = 0 (Spark
+scalar-subquery semantics), the guarded no-aggregate rejection, and
+clear UnsupportedExpr errors for subquery markers escaping their
+WHERE-conjunct context (HAVING / SELECT list / JOIN ON / GROUP BY)."""
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.expr.expressions import UnsupportedExpr
+from spark_rapids_tpu.sql.parser import register_view
+
+
+@pytest.fixture()
+def env():
+    s = st.TpuSession({})
+    a = s.create_dataframe({"k": pa.array([1, 2, 3, 4, 5]),
+                            "av": pa.array([10, 20, 30, 40, 50])})
+    b = s.create_dataframe({"bk": pa.array([1, 1, 2]),
+                            "bv": pa.array([7, 8, 9])})
+    register_view(s, "a", a)
+    register_view(s, "b", b)
+    return s
+
+
+def test_count_star_empty_group_keeps_outer_rows(env):
+    # the anti-join-via-count shape: outer rows with an EMPTY
+    # correlation group read count 0 — the old INNER-join decorrelation
+    # silently dropped k=3,4,5
+    got = env.sql("""
+        select k from a
+        where (select count(*) from b where bk = k) = 0
+        order by k
+    """).to_arrow()
+    assert got.column("k").to_pylist() == [3, 4, 5]
+
+
+def test_count_nonzero_comparison_matches(env):
+    got = env.sql("""
+        select k from a
+        where (select count(bv) from b where bk = k) = 2
+        order by k
+    """).to_arrow()
+    assert got.column("k").to_pylist() == [1]
+
+
+def test_non_count_aggregate_null_drops_unmatched(env):
+    # sum/min/max read NULL for an empty group; NULL comparisons drop
+    # the row (Spark semantics) — only matched outer rows survive
+    got = env.sql("""
+        select k from a
+        where (select sum(bv) from b where bk = k) > 0
+        order by k
+    """).to_arrow()
+    assert got.column("k").to_pylist() == [1, 2]
+
+
+def test_unguarded_no_aggregate_subquery_rejected(env):
+    with pytest.raises(UnsupportedExpr, match="aggregate"):
+        env.sql("""
+            select k from a
+            where (select bv from b where bk = k) > 0
+        """)
+
+
+def test_bare_scalar_subquery_conjunct_rejected(env):
+    with pytest.raises(UnsupportedExpr, match="comparison"):
+        env.sql("select k from a where (select max(bk) from b)")
+
+
+def test_subquery_in_select_list_rejected(env):
+    with pytest.raises(UnsupportedExpr):
+        env.sql("select exists (select bk from b where bk = k) from a")
+
+
+def test_subquery_in_having_rejected(env):
+    with pytest.raises(UnsupportedExpr):
+        env.sql("""
+            select k, count(*) as c from a group by k
+            having k in (select bk from b)
+        """)
+
+
+def test_subquery_in_join_on_rejected(env):
+    with pytest.raises(UnsupportedExpr):
+        env.sql("""
+            select * from a join b
+            on k = (select max(bk) from b)
+        """)
+
+
+def test_marker_in_or_tree_rejected(env):
+    # OR-connected subquery predicates are not top-level AND conjuncts;
+    # must raise cleanly rather than AttributeError
+    with pytest.raises(UnsupportedExpr):
+        env.sql("""
+            select k from a
+            where k = 9 or exists (select bk from b where bk = k)
+        """)

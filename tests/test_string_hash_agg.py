@@ -1,0 +1,144 @@
+"""Hash-once 64-bit string keying for grouped aggregation
+(sql.agg.stringHashKeys.enabled; ops/hash.py hash_once_rows +
+exec/aggregate.py): result equivalence vs the murmur3 chunk-key path,
+exactness under FORCED total hash collision, and multi-key mixes."""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col
+
+HASH_ONCE_OFF = {"spark.rapids.tpu.sql.agg.stringHashKeys.enabled":
+                 "false"}
+
+
+def _strings(n, card, rng, width=24):
+    pool = [f"key-{'x' * (i % width)}-{i:06d}" for i in range(card)]
+    return pa.array([pool[i] for i in rng.integers(0, card, n)])
+
+
+def _group_sum(s, tab):
+    df = s.create_dataframe(tab)
+    out = (df.group_by(col("k"))
+             .agg(F.sum(col("v")).alias("sv"),
+                  F.count(col("v")).alias("cv"))
+             .to_arrow())
+    return sorted(map(tuple, out.to_pylist()), key=str)
+
+
+def _tab(n=50_000, card=5_000, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"k": _strings(n, card, rng),
+            "v": pa.array(rng.integers(0, 1000, n))}
+
+
+def test_hash_once_matches_murmur3_path_high_cardinality():
+    # the q2/q16 shape: high-cardinality string group-by keys
+    tab = _tab()
+    got = _group_sum(st.TpuSession({}), tab)
+    want = _group_sum(st.TpuSession(HASH_ONCE_OFF), tab)
+    assert got == want
+
+
+def test_hash_once_low_cardinality_and_nulls():
+    rng = np.random.default_rng(1)
+    vals = [None, "", "a", "aa" * 30, "b"]
+    tab = {"k": pa.array([vals[i] for i in rng.integers(0, 5, 10_000)]),
+           "v": pa.array(rng.integers(0, 100, 10_000))}
+    got = _group_sum(st.TpuSession({}), tab)
+    want = _group_sum(st.TpuSession(HASH_ONCE_OFF), tab)
+    assert got == want
+
+
+def test_forced_total_hash_collision_stays_exact(monkeypatch):
+    # degenerate bucket hash: EVERY row lands in bucket 0. Only the
+    # chunk-compare verify against the bucket representative may admit a
+    # row to a group, so results must stay exact — the collided rows
+    # retry later rounds / the sort fallback.
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops import hash as H
+
+    def all_collide(eq_arrays, seed=0):
+        n = eq_arrays[0][0].shape[0]
+        return jnp.zeros(n, jnp.int32)
+
+    monkeypatch.setattr(H, "hash_once_rows", all_collide)
+    tab = _tab(n=8_000, card=300, seed=2)
+    got = _group_sum(st.TpuSession({}), tab)
+    want = _group_sum(st.TpuSession(HASH_ONCE_OFF), tab)
+    assert got == want
+
+
+def test_mixed_string_and_int_keys():
+    rng = np.random.default_rng(3)
+    n = 20_000
+    tab = {"k": _strings(n, 500, rng),
+           "k2": pa.array(rng.integers(0, 7, n)),
+           "v": pa.array(rng.random(n))}
+
+    def run(s):
+        df = s.create_dataframe(tab)
+        out = (df.group_by(col("k"), col("k2"))
+                 .agg(F.sum(col("v")).alias("sv"))
+                 .to_arrow())
+        return sorted(
+            ((r["k"], r["k2"], round(r["sv"], 9))
+             for r in out.to_pylist()), key=str)
+
+    assert run(st.TpuSession({})) == run(st.TpuSession(HASH_ONCE_OFF))
+
+
+def test_count_distinct_rewrite_matches_sort_path():
+    # count(DISTINCT x) group by string keys: the two-level hash-agg
+    # rewrite (sql.optimizer.distinctAggRewrite.enabled) must produce
+    # exactly the CollectAggExec sort path's results — the q16 shape
+    rng = np.random.default_rng(5)
+    n = 20_000
+    tab = {"k": _strings(n, 400, rng),
+           "x": pa.array([None if i % 11 == 0 else int(i)
+                          for i in rng.integers(0, 900, n)])}
+
+    def run(conf):
+        s = st.TpuSession(conf)
+        df = s.create_dataframe(tab)
+        out = (df.group_by(col("k"))
+                 .agg(F.countDistinct(col("x")).alias("cd"))
+                 .to_arrow())
+        return sorted(map(tuple, out.to_pylist()), key=str)
+
+    got = run({})
+    want = run({"spark.rapids.tpu.sql.optimizer."
+                "distinctAggRewrite.enabled": "false"})
+    assert got == want
+
+
+def test_count_distinct_rewrite_ungrouped():
+    tab = {"x": pa.array([1, 2, 2, None, 3, 3, 3, None])}
+
+    def run(conf):
+        s = st.TpuSession(conf)
+        df = s.create_dataframe(tab)
+        return (df.group_by()
+                  .agg(F.countDistinct(col("x")).alias("cd"))
+                  .to_arrow().to_pylist())
+
+    assert run({}) == [{"cd": 3}]
+    assert run({"spark.rapids.tpu.sql.optimizer."
+                "distinctAggRewrite.enabled": "false"}) == [{"cd": 3}]
+
+
+def test_hash_once_cached_whole_input_path():
+    # the fused whole-input program (HBM-cached child) has its own
+    # hash_once wiring; exercise it through .cache()
+    tab = _tab(n=30_000, card=2_000, seed=4)
+
+    def run(conf):
+        s = st.TpuSession(conf)
+        df = s.create_dataframe(tab).cache()
+        out = (df.group_by(col("k"))
+                 .agg(F.sum(col("v")).alias("sv")).to_arrow())
+        return sorted(map(tuple, out.to_pylist()), key=str)
+
+    assert run({}) == run(HASH_ONCE_OFF)
