@@ -38,6 +38,12 @@ _BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "780"))
 _QUERY_BUDGET_S = float(os.environ.get("BENCH_QUERY_BUDGET_S", "60"))
 _T0 = time.monotonic()
 
+# --profile: embed the per-query top-5 operator breakdown (from the
+# engine's per-op MetricSets) in the emitted JSON, so the bench
+# trajectory carries attribution, not just totals
+_PROFILE = ("--profile" in sys.argv[1:]
+            or os.environ.get("BENCH_PROFILE", "") == "1")
+
 # milestone metrics flushed verbatim when the budget expires mid-run
 _partial = {"extra": {}}
 
@@ -379,6 +385,7 @@ def _tpch_sweep(s, sf: float):
         host = to_pandas(tabs)
     reg = tpch.queries()
     engine_s, oracle_s, errors = {}, {}, {}
+    profile = {}
     for qn in range(1, 23):
         # per-query guard: one failing OR straggling query (unsupported
         # op on a new backend, OOM, runaway plan) must not lose the whole
@@ -400,6 +407,17 @@ def _tpch_sweep(s, sf: float):
             # assign together: a failed oracle must not leave a dangling
             # engine_s entry that KeyErrors the geomean below
             engine_s[qn], oracle_s[qn] = e_t, o_t
+            if _PROFILE:
+                try:
+                    from spark_rapids_tpu.profiler.event_log import (
+                        op_metrics_records, top_operators)
+                    root = getattr(q, "_last_root", None)
+                    if root is not None:
+                        profile[f"q{qn}"] = top_operators(
+                            op_metrics_records(root, q.last_metrics()),
+                            5)
+                except Exception as pe:  # attribution is advisory
+                    profile[f"q{qn}"] = f"profile failed: {pe!r}"
         except _BenchTimeout as e:
             errors[f"q{qn}"] = f"timeout: {e}"
             print(f"bench: tpch q{qn} timed out: {e}", file=sys.stderr)
@@ -418,6 +436,8 @@ def _tpch_sweep(s, sf: float):
             "tpch_all22_per_query_ms": {
                 f"q{q}": round(v * 1e3, 1) for q, v in engine_s.items()},
         })
+    if profile:
+        out["tpch_profile"] = profile
     if errors:
         out["tpch_all22_errors"] = errors
     return out

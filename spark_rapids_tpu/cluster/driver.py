@@ -295,6 +295,11 @@ class ClusterManager:
                 continue
             try:
                 if kind == "result":
+                    # executor MetricSet snapshots ride the result frame;
+                    # deliver them ON the future (set before resolving so
+                    # a waiter never observes the result without them)
+                    task.future.task_metrics = payload.get(
+                        "task_metrics")
                     if payload.get("arrow_result"):
                         from .rpc import ArrowResult
                         task.future.set_result(ArrowResult(

@@ -75,16 +75,26 @@ def executor_main(host: str, port: int, exec_id: int) -> None:
                 if payload.get("has_tables"):
                     args = args + (payload.get("_arrow", []),)
                 result = fn(*args)
+                # metric snapshots the task recorded (fragment op
+                # metrics) ride the result frame back to the driver —
+                # without this, executor MetricSets die with the process
+                from .task_metrics import drain_task_metrics
+                tm = drain_task_metrics()
+                extra = {"task_metrics": tm} if tm else {}
                 from .rpc import ArrowResult
                 if isinstance(result, ArrowResult):
                     send_msg(sock, "result",
                              {"task_id": task_id, "value": result.meta,
-                              "arrow_result": True},
+                              "arrow_result": True, **extra},
                              tables=result.tables)
                 else:
                     send_msg(sock, "result", {"task_id": task_id,
-                                              "value": result})
+                                              "value": result, **extra})
             except BaseException as e:  # report, don't die
+                # drain partial metric records so they can't leak into
+                # the NEXT task's result frame
+                from .task_metrics import drain_task_metrics
+                drain_task_metrics()
                 payload = {"task_id": task_id, "message": repr(e),
                            "traceback": traceback.format_exc()}
                 from .blocks import FetchFailed

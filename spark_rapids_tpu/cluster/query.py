@@ -30,12 +30,34 @@ the driver keys buckets by reduce-partition id.
 """
 from __future__ import annotations
 
+import time
 from typing import Callable, Dict, List, Optional, Sequence
 
 from .driver import ClusterManager
 from .rpc import ArrowResult
 
 __all__ = ["DistributedRunner", "map_fragment_task", "reduce_fragment_task"]
+
+
+def _record_fragment_profile(root, ctx, stage: str, **extra):
+    """Snapshot this fragment's physical plan + per-operator metrics
+    into the task-metric side channel (task_metrics.py). Keys are
+    lore ids — stable for the same fragment plan in every executor
+    process, unlike the id()-based _op_ids — so the driver can sum
+    across executors. Profiling must never fail a query."""
+    try:
+        from ..memory import diagnostics
+        from ..profiler.event_log import op_metrics_records, plan_tree
+        from .task_metrics import record_task_metrics
+        record_task_metrics({
+            "stage": stage,
+            "plan": plan_tree(root),
+            "ops": op_metrics_records(root, ctx.metrics,
+                                      ctx.metrics_level),
+            "watermarks": diagnostics.watermarks_snapshot(),
+            **extra})
+    except Exception:
+        pass
 
 
 def map_fragment_task(map_fn, split, conf, n_reduce: int,
@@ -66,6 +88,7 @@ def map_fragment_task(map_fn, split, conf, n_reduce: int,
         if parts:
             pids.append(pid)
             tables.append(pa.concat_tables(parts))
+    _record_fragment_profile(root, ctx, "map", map_id=map_id)
     if shuffle_id is None:
         return ArrowResult({"pids": pids}, tables)
     from . import blocks
@@ -78,18 +101,34 @@ def map_fragment_task(map_fn, split, conf, n_reduce: int,
             "map_id": map_id}
 
 
+def _run_reduce_fragment(reduce_fn, conf, tables, pid):
+    """Shared reduce-fragment body: concat the bucket's blocks, run the
+    fragment via the execution internals (not DataFrame.to_arrow, which
+    would open a session-level event log IN the executor — the driver
+    owns the query's log), snapshot its metrics for the driver."""
+    import pyarrow as pa
+
+    import spark_rapids_tpu as st
+    from ..exec.nodes import collect_to_arrow
+
+    s = st.TpuSession(conf)
+    at = pa.concat_tables(tables)
+    df = reduce_fn(s, s.create_dataframe(at))
+    root, ctx = df._execute()
+    try:
+        out = collect_to_arrow(root, ctx)
+    finally:
+        ctx.close()
+    _record_fragment_profile(root, ctx, "reduce", reduce_pid=pid)
+    return out
+
+
 def reduce_fragment_task(reduce_fn, conf, tables):
     """Executor-side reduce stage: concatenate this bucket's shuffle
     blocks into a DataFrame, run the reduce fragment, return its result
     as one Arrow table."""
-    import pyarrow as pa
-
-    import spark_rapids_tpu as st
-
-    s = st.TpuSession(conf)
-    at = pa.concat_tables(tables)
-    out = reduce_fn(s, s.create_dataframe(at)).to_arrow()
-    return ArrowResult({}, [out])
+    return ArrowResult({}, [_run_reduce_fragment(reduce_fn, conf,
+                                                 tables, None)])
 
 
 def reduce_fetch_task(reduce_fn, conf, shuffle_id: str, pid: int,
@@ -97,18 +136,21 @@ def reduce_fetch_task(reduce_fn, conf, shuffle_id: str, pid: int,
     """Executor-side reduce stage (P2P): fetch this partition's blocks
     DIRECTLY from the mapper executors' block servers, then run the
     reduce fragment. `sources` = [(addr, [map_id, ...]), ...]."""
-    import pyarrow as pa
-
-    import spark_rapids_tpu as st
     from . import blocks
 
     tables = []
+    fetched_bytes = 0
     for addr, map_ids in sources:
-        tables.extend(blocks.fetch_blocks(addr, shuffle_id, map_ids,
-                                          pid))
-    s = st.TpuSession(conf)
-    at = pa.concat_tables(tables)
-    out = reduce_fn(s, s.create_dataframe(at)).to_arrow()
+        got = blocks.fetch_blocks(addr, shuffle_id, map_ids, pid)
+        fetched_bytes += sum(t.nbytes for t in got)
+        tables.extend(got)
+    out = _run_reduce_fragment(reduce_fn, conf, tables, pid)
+    try:
+        from .task_metrics import record_task_metrics
+        record_task_metrics({"stage": "reduce", "reduce_pid": pid,
+                             "fetch_bytes": fetched_bytes})
+    except Exception:
+        pass
     return ArrowResult({}, [out])
 
 
@@ -123,6 +165,32 @@ class DistributedRunner:
     def __init__(self, cm: ClusterManager, conf: Optional[dict] = None):
         self.cm = cm
         self.conf = dict(conf or {})
+        # driver-side aggregation of the executor MetricSet snapshots
+        # that ride back with task results; shape:
+        # {"query_id", "stages": {stage: {"plan", "ops", "tasks",
+        #  "wall_s", "watermarks"}}} — rendered by explain_analyze()
+        self.last_profile: Dict[str, object] = {}
+        self.last_event_log: Optional[str] = None
+
+    # -- driver-side metric aggregation --------------------------------
+    def _absorb(self, fut, stages: Dict[str, dict]):
+        """Fold one task's shipped metric records into the per-stage
+        accumulators (plan kept from the first task; op records
+        concatenated for a later lore-keyed merge)."""
+        for rec in getattr(fut, "task_metrics", None) or []:
+            acc = stages.setdefault(rec.get("stage") or "map", {
+                "plan": None, "ops": [], "tasks": 0, "wall_s": 0.0,
+                "watermarks": {}, "fetch_bytes": 0})
+            if rec.get("plan") is not None:
+                acc["tasks"] += 1
+                if acc["plan"] is None:
+                    acc["plan"] = rec["plan"]
+            acc["ops"].extend(rec.get("ops") or [])
+            acc["fetch_bytes"] += rec.get("fetch_bytes") or 0
+            for k, v in (rec.get("watermarks") or {}).items():
+                if isinstance(v, (int, float)):
+                    acc["watermarks"][k] = max(
+                        acc["watermarks"].get(k, 0), v)
 
     def run(self, splits: Sequence, map_fn: Callable,
             part_keys: Sequence[str], reduce_fn: Callable,
@@ -146,72 +214,172 @@ class DistributedRunner:
 
         import spark_rapids_tpu as st
 
+        from ..config import TpuConf
+        from ..profiler import event_log as EL
         from .blocks import FetchFailed, drop_shuffle
 
         n_reduce = n_reduce or max(len(self.cm.alive_executors), 1)
         shuffle_id = uuid.uuid4().hex[:12]
 
-        def run_maps(idxs):
+        # driver-side query event log (the Spark event-log analog for
+        # the distributed topology): stage submit/complete, aggregated
+        # executor op metrics, fetch retries
+        qid = EL.next_query_id("dist")
+        w = EL.open_query_log(TpuConf(self.conf), qid)
+        self.last_event_log = w.path if w is not None else None
+        stages: Dict[str, dict] = {}
+        self.last_profile = {"query_id": qid, "stages": stages}
+        t_query = time.perf_counter()
+
+        def emit(event, **kw):
+            if w is not None:
+                w.emit(event, **kw)
+
+        def run_maps(idxs, attempt=0):
+            emit("stage_submit", stage="map", n_tasks=len(idxs),
+                 attempt=attempt)
+            t0 = time.perf_counter()
             futs = {i: self.cm.submit(
                 map_fragment_task, map_fn, splits[i], self.conf,
                 n_reduce, list(part_keys), shuffle_id, i)
                 for i in idxs}
-            return {i: f.result() for i, f in futs.items()}
+            out = {}
+            for i, f in futs.items():
+                out[i] = f.result()
+                self._absorb(f, stages)
+            wall = time.perf_counter() - t0
+            stages.setdefault("map", {}).setdefault("wall_s", 0.0)
+            stages["map"]["wall_s"] = stages["map"].get("wall_s",
+                                                        0.0) + wall
+            emit("stage_complete", stage="map", n_tasks=len(idxs),
+                 attempt=attempt, wall_s=round(wall, 6),
+                 shuffle_bytes=sum(sum(m2["sizes"].values())
+                                   for m2 in out.values()))
+            return out
 
-        metas = run_maps(range(len(splits)))
-        done: Dict[int, object] = {}     # pid -> reduce output table
-
+        status, err = "ok", None
+        emit("query_start", action="distributed_run",
+             n_splits=len(splits), n_reduce=n_reduce,
+             shuffle_id=shuffle_id)
         try:
-            for attempt in range(3):
-                # per-pid fetch plan: mapper addr -> map ids that
-                # produced blocks for that pid
-                all_pids = sorted({p for m2 in metas.values()
-                                   for p in m2["pids"]})
-                rfuts = []
-                for pid in all_pids:
-                    if pid in done:      # keep completed partitions
-                        continue
-                    by_addr: Dict[tuple, List[int]] = {}
-                    for i, m2 in metas.items():
-                        if pid in m2["pids"]:
-                            by_addr.setdefault(tuple(m2["addr"]),
-                                               []).append(m2["map_id"])
-                    sources = [(list(a), ids)
-                               for a, ids in sorted(by_addr.items())]
-                    rfuts.append((pid, self.cm.submit(
-                        reduce_fetch_task, reduce_fn, self.conf,
-                        shuffle_id, pid, sources)))
-                refetch = set()
-                for pid, f in rfuts:
-                    try:
-                        done[pid] = f.result().tables[0]
-                    except FetchFailed as e:
-                        if attempt == 2:
-                            raise
-                        # lineage: re-execute the map splits of the
-                        # FAILED mapper, identified by the typed
-                        # exception's structured addr (idempotent
-                        # fragments); an addr-less failure re-executes
-                        # everything
-                        dead = set()
-                        if e.addr is not None:
-                            dead = {i for i, m2 in metas.items()
-                                    if tuple(m2["addr"]) == e.addr}
-                        refetch |= dead or set(metas)
-                if not refetch:
-                    break
-                metas.update(run_maps(sorted(refetch)))
+            metas = run_maps(range(len(splits)))
+            done: Dict[int, object] = {}     # pid -> reduce output table
+
+            try:
+                for attempt in range(3):
+                    # per-pid fetch plan: mapper addr -> map ids that
+                    # produced blocks for that pid
+                    all_pids = sorted({p for m2 in metas.values()
+                                       for p in m2["pids"]})
+                    t0 = time.perf_counter()
+                    rfuts = []
+                    for pid in all_pids:
+                        if pid in done:      # keep completed partitions
+                            continue
+                        by_addr: Dict[tuple, List[int]] = {}
+                        for i, m2 in metas.items():
+                            if pid in m2["pids"]:
+                                by_addr.setdefault(
+                                    tuple(m2["addr"]),
+                                    []).append(m2["map_id"])
+                        sources = [(list(a), ids)
+                                   for a, ids in sorted(by_addr.items())]
+                        rfuts.append((pid, self.cm.submit(
+                            reduce_fetch_task, reduce_fn, self.conf,
+                            shuffle_id, pid, sources)))
+                    emit("stage_submit", stage="reduce",
+                         n_tasks=len(rfuts), attempt=attempt)
+                    refetch = set()
+                    for pid, f in rfuts:
+                        try:
+                            done[pid] = f.result().tables[0]
+                            self._absorb(f, stages)
+                        except FetchFailed as e:
+                            emit("fetch_retry", stage="reduce", pid=pid,
+                                 shuffle_id=shuffle_id,
+                                 addr=list(e.addr) if e.addr else None,
+                                 attempt=attempt)
+                            if attempt == 2:
+                                raise
+                            # lineage: re-execute the map splits of the
+                            # FAILED mapper, identified by the typed
+                            # exception's structured addr (idempotent
+                            # fragments); an addr-less failure
+                            # re-executes everything
+                            dead = set()
+                            if e.addr is not None:
+                                dead = {i for i, m2 in metas.items()
+                                        if tuple(m2["addr"]) == e.addr}
+                            refetch |= dead or set(metas)
+                    wall = time.perf_counter() - t0
+                    if "reduce" in stages:
+                        stages["reduce"]["wall_s"] = \
+                            stages["reduce"].get("wall_s", 0.0) + wall
+                    emit("stage_complete", stage="reduce",
+                         attempt=attempt, wall_s=round(wall, 6))
+                    if not refetch:
+                        break
+                    metas.update(run_maps(sorted(refetch),
+                                          attempt=attempt + 1))
+            finally:
+                # the shuffle's blocks are pinned on the mappers (the
+                # MAX_SHUFFLES LRU never evicts in-flight shuffles); drop
+                # them explicitly now the query is done (best-effort —
+                # a dead mapper's files died with its temp dir)
+                for addr in {tuple(m2["addr"]) for m2 in metas.values()}:
+                    drop_shuffle(addr, shuffle_id)
+            if not done:
+                return None
+            result = pa.concat_tables([done[p] for p in sorted(done)])
+            if final_fn is not None:
+                s = st.TpuSession(self.conf)
+                result = final_fn(s,
+                                  s.create_dataframe(result)).to_arrow()
+            return result
+        except BaseException as e:
+            status, err = "error", repr(e)
+            raise
         finally:
-            # the shuffle's blocks are pinned on the mappers (the
-            # MAX_SHUFFLES LRU never evicts in-flight shuffles); drop
-            # them explicitly now the query is done (best-effort —
-            # a dead mapper's files died with its temp dir)
-            for addr in {tuple(m2["addr"]) for m2 in metas.values()}:
-                drop_shuffle(addr, shuffle_id)
-        if not done:
-            return None
-        result = pa.concat_tables([done[p] for p in sorted(done)])
-        if final_fn is not None:
-            s = st.TpuSession(self.conf)
-            result = final_fn(s, s.create_dataframe(result)).to_arrow()
-        return result
+            # merge each stage's op records lore-keyed (stable across
+            # executors) and close out the event log
+            for name, acc in stages.items():
+                acc["ops"] = EL.aggregate_ops(acc.get("ops") or [])
+                emit("op_metrics", stage=name,
+                     ops=list(acc["ops"].values()))
+                if acc.get("watermarks"):
+                    emit("watermarks", stage=name, **acc["watermarks"])
+            end = {"status": status,
+                   "wall_s": round(time.perf_counter() - t_query, 6)}
+            if err is not None:
+                end["error"] = err
+            emit("query_end", **end)
+            if w is not None:
+                w.close()
+
+    def explain_analyze(self) -> str:
+        """Render the last run()'s stages as annotated plan trees (the
+        EXPLAIN ANALYZE surface for the distributed topology): each
+        stage's fragment plan with per-operator rows/batches/op-time
+        summed across every executor that ran it."""
+        from ..profiler.analyze import render_analyze
+        prof = self.last_profile or {}
+        parts = []
+        for name in ("map", "reduce"):
+            acc = (prof.get("stages") or {}).get(name)
+            if not acc or not acc.get("plan"):
+                continue
+            ops = acc.get("ops") or {}
+            if isinstance(ops, list):    # pre-aggregation shape
+                from ..profiler.event_log import aggregate_ops
+                ops = aggregate_ops(ops)
+            by_lore = {v["lore_id"]: v["metrics"] for v in ops.values()}
+            wall = acc.get("wall_s", 0.0)
+            parts.append(f"== {name} stage: {acc.get('tasks', 0)} tasks,"
+                         f" wall {wall * 1e3:.0f}ms ==")
+            parts.append(render_analyze(acc["plan"], by_lore))
+        if not parts:
+            return ("no profile collected (run() a query first; "
+                    "executor metric snapshots ride task results)")
+        text = "\n".join(parts)
+        print(text)
+        return text

@@ -1,0 +1,42 @@
+"""Executor-side task-metric side channel.
+
+Before this channel existed, executor `MetricSet`s died with the worker
+process — the driver saw task VALUES but never task METRICS (ISSUE 2:
+"executor metrics die in the worker process"). Fragment tasks
+(cluster/query.py) record per-operator snapshots here while they run;
+the executor loop (executor.py) drains the buffer after each task and
+attaches it to the result frame as `task_metrics`; the driver
+(driver.py) delivers it on the task's Future, where the
+DistributedRunner aggregates across executors into the query event log.
+
+The buffer is process-global: the executor runs tasks sequentially on
+one thread, so records between two drains belong to the task in between
+(the lock only guards against in-task helper threads).
+"""
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+__all__ = ["record_task_metrics", "drain_task_metrics"]
+
+_LOCK = threading.Lock()
+_BUF: List[dict] = []
+
+
+def record_task_metrics(record: dict):
+    """Append one metrics record (picklable dict) for the running task.
+    Fragment records carry {stage, plan, ops, watermarks, ...}."""
+    with _LOCK:
+        _BUF.append(record)
+
+
+def drain_task_metrics() -> Optional[List[dict]]:
+    """Take everything recorded since the last drain (None when empty,
+    so result frames of metric-less tasks don't grow a field)."""
+    with _LOCK:
+        if not _BUF:
+            return None
+        out = list(_BUF)
+        _BUF.clear()
+    return out

@@ -136,6 +136,26 @@ ALLOW_CPU_FALLBACK = _conf(
 METRICS_LEVEL = _conf(
     "sql.metrics.level", "MODERATE",
     "Metric verbosity: ESSENTIAL|MODERATE|DEBUG.", str)
+METRICS_SYNC = _conf(
+    "sql.metrics.sync", False,
+    "Synchronize the device stream at batch boundaries inside operator "
+    "timers (a trivial op is enqueued and block_until_ready'd before "
+    "the timer stops). OFF by default: jax dispatch is async, so "
+    "default op-time metrics measure DISPATCH time and actual kernel "
+    "execution is attributed to whichever downstream operator first "
+    "blocks (usually the D2H fetch at the plan root) — see "
+    "docs/observability.md. Turning this on yields debug-grade "
+    "per-operator execution times at the cost of pipelining.", bool)
+EVENT_LOG_ENABLED = _conf(
+    "sql.eventLog.enabled", False,
+    "Write a structured per-query JSONL event log (the Spark event-log "
+    "analog): plan with lore ids, per-operator MetricSet snapshots, "
+    "memory watermarks, shuffle bytes, XLA compile stats. Consumed by "
+    "tools/profile_report.py and EXPLAIN ANALYZE post-processing.",
+    bool)
+EVENT_LOG_DIR = _conf(
+    "sql.eventLog.dir", "/tmp/srtpu-events",
+    "Directory for per-query event-log JSONL files.", str)
 MULTITHREADED_READ_THREADS = _conf(
     "sql.format.parquet.multiThreadedRead.numThreads", 4,
     "Thread pool for the multithreaded (cloud) parquet reader "

@@ -24,7 +24,8 @@ class ExecContext:
 
     def __init__(self, conf=None, session=None, planning: bool = False):
         import threading
-        from ..config import TpuConf
+        from ..config import METRICS_LEVEL, METRICS_SYNC, TpuConf
+        from ..utils.metrics import DEBUG, ESSENTIAL, MODERATE
         self.conf = conf or TpuConf()
         self.session = session
         # planning probes (num_partitions during plan construction) must
@@ -32,6 +33,12 @@ class ExecContext:
         self.planning = planning
         self.metrics: Dict[str, MetricSet] = {}
         self._metrics_lock = threading.Lock()
+        # metric verbosity + the conf-gated stream-sync timers (see
+        # utils/metrics.py on async-dispatch timer skew)
+        self.metrics_level = {"ESSENTIAL": ESSENTIAL, "MODERATE": MODERATE,
+                              "DEBUG": DEBUG}.get(
+            str(self.conf.get(METRICS_LEVEL)).upper(), MODERATE)
+        self.metrics_sync = bool(self.conf.get(METRICS_SYNC))
         # SharedBuildExec's per-run materialization cache:
         # {id(node): {pid: [spill handles]}} — closed by close()
         self.shared_handles: Dict[int, dict] = {}
@@ -50,7 +57,7 @@ class ExecContext:
     def metrics_for(self, op_id: str) -> MetricSet:
         with self._metrics_lock:
             if op_id not in self.metrics:
-                self.metrics[op_id] = MetricSet()
+                self.metrics[op_id] = MetricSet(sync=self.metrics_sync)
             return self.metrics[op_id]
 
 

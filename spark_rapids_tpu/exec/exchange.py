@@ -39,6 +39,7 @@ class ShuffleExchangeExec(TpuExec):
         self.n = num_partitions
         self.keys = list(bound_keys) if bound_keys else None
         self._shuffle: Optional[LocalShuffle] = None
+        self._pstats: Optional[List[int]] = None
         self._lock = threading.RLock()
         self._jit = jax.jit(self._map_fn)
 
@@ -81,6 +82,7 @@ class ShuffleExchangeExec(TpuExec):
 
     def release(self):
         sh, self._shuffle = self._shuffle, None
+        self._pstats = None
         if sh is not None:
             try:
                 sh.cleanup()   # frees map files + the arena's host-
@@ -136,6 +138,11 @@ class ShuffleExchangeExec(TpuExec):
                             pieces[rp].append(HostSubBatch(cols, cnt))
                 with m.timer("writeTime"):
                     sh.write_map_partition(mpid, pieces)
+            # data-movement visibility (the Theseus point PAPERS.md
+            # makes): serialized bytes through this exchange, for the
+            # event log / EXPLAIN ANALYZE
+            m.set("shuffleBytesWritten", sh.metrics["bytesWritten"])
+            self._pstats = sh.partition_stats()
             self._shuffle = sh
 
     # ---- adaptive stage API (GpuCustomShuffleReaderExec inputs) --------
@@ -143,13 +150,16 @@ class ShuffleExchangeExec(TpuExec):
         """Materialize the map stage and return serialized bytes per
         reduce partition (MapOutputStatistics analog)."""
         self._ensure_shuffled(ctx)
-        return self._shuffle.partition_stats()
+        return self._pstats
 
     def read_slice(self, ctx: ExecContext, rpid: int, chunk: int = 0,
                    nchunks: int = 1):
         self._ensure_shuffled(ctx)
         m = ctx.metrics_for(self._op_id)
         from ..memory.retry import retry_no_split
+        pstats = getattr(self, "_pstats", None)
+        if pstats is not None and rpid < len(pstats):
+            m.add("shuffleBytesRead", pstats[rpid] // max(nchunks, 1))
         with m.timer("fetchAndMergeTime"):
             if nchunks == 1:
                 return retry_no_split(

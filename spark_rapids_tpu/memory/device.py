@@ -59,8 +59,12 @@ class DeviceManager:
         with self._lock:
             if self._reserved + nbytes <= self.budget:
                 self._reserved += nbytes
-                return True
-        return False
+                cur = self._reserved
+            else:
+                return False
+        from .diagnostics import record_device_watermark
+        record_device_watermark(cur)
+        return True
 
     def reserve(self, nbytes: int):
         """Reserve, spilling as needed; raises BudgetExceeded if the spill

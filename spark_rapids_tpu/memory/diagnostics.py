@@ -21,7 +21,9 @@ from typing import Dict
 
 __all__ = ["retry_scope", "in_retry_scope", "enable_retry_coverage",
            "record_allocation", "coverage_report", "reset_coverage",
-           "leak_report", "assert_no_leaks"]
+           "leak_report", "assert_no_leaks", "record_device_watermark",
+           "record_host_watermark", "reset_watermarks",
+           "watermarks_snapshot"]
 
 _tls = threading.local()
 _enabled = False
@@ -82,6 +84,54 @@ def coverage_report() -> Dict[str, dict]:
 def reset_coverage():
     with _lock:
         _sites.clear()
+
+
+# -- memory watermarks ---------------------------------------------------
+# Peak device/host reservation gauges for the query event log: the
+# managers record every successful reservation here, so the profiler can
+# report how close a query came to its budgets even when nothing OOMed
+# (previously these numbers only surfaced in OOM error text).
+_WM_LOCK = threading.Lock()
+_wm = {"devicePeakBytes": 0, "hostPeakBytes": 0}
+
+
+def record_device_watermark(reserved_bytes: int):
+    with _WM_LOCK:
+        if reserved_bytes > _wm["devicePeakBytes"]:
+            _wm["devicePeakBytes"] = reserved_bytes
+
+
+def record_host_watermark(reserved_bytes: int):
+    with _WM_LOCK:
+        if reserved_bytes > _wm["hostPeakBytes"]:
+            _wm["hostPeakBytes"] = reserved_bytes
+
+
+def reset_watermarks():
+    """Re-arm the peak gauges (the profiler calls this at query start;
+    concurrent queries in one process share the gauges — peaks are then
+    attributed to whichever query's log closes them out)."""
+    with _WM_LOCK:
+        _wm["devicePeakBytes"] = 0
+        _wm["hostPeakBytes"] = 0
+
+
+def watermarks_snapshot() -> dict:
+    """Peak device/host reservation since the last reset, plus the spill
+    store's cumulative counters and the host manager's pressure metrics
+    (only for singletons that already exist — reading a gauge must not
+    instantiate a memory manager)."""
+    with _WM_LOCK:
+        out = dict(_wm)
+    from . import host as _host
+    from . import spill as _spill
+    store = _spill._STORE
+    if store is not None:
+        out["spill"] = dict(store.metrics)
+    hm = _host._GLOBAL
+    if hm is not None:
+        out["hostPressure"] = dict(hm.metrics)
+    return out
 
 
 # -- leak checking ------------------------------------------------------

@@ -56,8 +56,12 @@ class HostMemoryManager:
                     or self._holders == 0:
                 self._reserved += nbytes
                 self._holders += 1
-                return True
-        return False
+                cur = self._reserved
+            else:
+                return False
+        from .diagnostics import record_host_watermark
+        record_host_watermark(cur)
+        return True
 
     def reserve(self, nbytes: int):
         """Reserve host bytes, firing pressure hooks when over budget.
@@ -85,6 +89,9 @@ class HostMemoryManager:
         with self._lock:
             self._reserved += nbytes
             self._holders += 1
+            cur = self._reserved
+        from .diagnostics import record_host_watermark
+        record_host_watermark(cur)
 
     def release(self, nbytes: int):
         with self._lock:

@@ -36,6 +36,10 @@ class PlanMeta:
         self.children = [PlanMeta(c) for c in node.children]
         self.reasons: List[str] = []
         self.host_reasons: List[str] = []
+        # the physical subtree this node converted to (set by _convert);
+        # its lore_id surfaces in explain so a hot operator in a profile
+        # report maps directly to a lore.idsToDump replay id
+        self.exec_node: Optional[TpuExec] = None
 
     def will_not_work(self, reason: str):
         self.reasons.append(reason)
@@ -51,7 +55,9 @@ class PlanMeta:
         lines = []
         tag = ("!cpu" if self.host_reasons and not self.reasons
                else "*" if self.can_run_on_tpu else "!")
-        desc = f"{'  ' * indent}{tag} {self.node.describe()}"
+        lore = getattr(self.exec_node, "lore_id", None)
+        lore_tag = f" [loreId={lore}]" if lore is not None else ""
+        desc = f"{'  ' * indent}{tag}{lore_tag} {self.node.describe()}"
         if self.reasons:
             desc += "  <-- cannot run on TPU because " + "; ".join(
                 self.reasons)
@@ -569,6 +575,10 @@ class Planner:
     def __init__(self, conf: Optional[TpuConf] = None):
         self.conf = conf or TpuConf()
 
+    # explain lines of the most recent plan() call (set whenever the
+    # explain mode requests them; DataFrame.explain returns them)
+    last_explain: List[str] = []
+
     def plan(self, root: L.LogicalPlan) -> TpuExec:
         from .optimizer import optimize
         root = optimize(root, self.conf)
@@ -579,12 +589,26 @@ class Planner:
             from .cbo import apply_cbo
             apply_cbo(meta, self.conf)
         explain_mode = self.conf.explain
-        if explain_mode in ("ALL", "NOT_ON_TPU"):
-            for line in meta.explain_lines(explain_mode == "NOT_ON_TPU"):
-                print(line)
-        root_exec = self._convert(meta)
+        # convert BEFORE printing explain: lore ids live on the physical
+        # nodes, and explain surfaces them ([loreId=N]) so profile-report
+        # sinks map straight to lore.idsToDump replay ids. A conversion
+        # failure still prints the tagged tree first, then re-raises.
+        root_exec, conv_err = None, None
+        try:
+            root_exec = self._convert(meta)
+        except UnsupportedExpr as e:
+            conv_err = e
         from ..utils.lore import apply_lore_dump, assign_lore_ids
-        assign_lore_ids(root_exec)
+        if root_exec is not None:
+            assign_lore_ids(root_exec)
+        self.last_explain = []
+        if explain_mode in ("ALL", "NOT_ON_TPU"):
+            self.last_explain = meta.explain_lines(
+                explain_mode == "NOT_ON_TPU")
+            for line in self.last_explain:
+                print(line)
+        if conv_err is not None:
+            raise conv_err
         return apply_lore_dump(root_exec, self.conf)
 
     def _tag(self, meta: PlanMeta):
@@ -612,7 +636,8 @@ class Planner:
             raise UnsupportedExpr("; ".join(meta.reasons))
         rule = _RULES[type(meta.node)]
         try:
-            return rule(meta, self._convert, self.conf)
+            meta.exec_node = rule(meta, self._convert, self.conf)
+            return meta.exec_node
         except ModuleNotFoundError as e:
             raise UnsupportedExpr(
                 f"{meta.node.node_name()} not yet implemented on TPU "

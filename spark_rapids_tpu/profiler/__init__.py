@@ -1,0 +1,26 @@
+"""Query profiler: the CONSUMER half of the operator-metric story.
+
+The engine has always produced per-operator `MetricSet`s (utils/metrics.py,
+the GpuMetric analog) — this package aggregates, persists, and renders
+them, mirroring the reference's two consumer surfaces:
+
+  - the structured per-query event log (`event_log.py`), the Spark
+    event-log analog a standalone Profiling Tool can post-process;
+  - the `EXPLAIN ANALYZE` plan renderer (`analyze.py`), the SQL-UI
+    per-node metric display analog (GpuExec metric wiring);
+  - XLA compile-cache counters (`xla_stats.py`), the reference's
+    spark.rapids.sql.debug compile-time accounting analog.
+
+`tools/profile_report.py` is the standalone Profiling Tool analog built
+on `read_event_log` + `aggregate_ops`.
+"""
+from .analyze import render_analyze
+from .event_log import (EventLogWriter, aggregate_ops, next_query_id,
+                        op_metrics_records, op_time_seconds,
+                        open_query_log, plan_tree, profile_query,
+                        read_event_log, top_operators)
+
+__all__ = ["EventLogWriter", "aggregate_ops", "next_query_id",
+           "op_metrics_records", "op_time_seconds", "open_query_log",
+           "plan_tree", "profile_query", "read_event_log",
+           "render_analyze", "top_operators"]

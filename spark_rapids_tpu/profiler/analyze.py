@@ -1,0 +1,83 @@
+"""EXPLAIN ANALYZE renderer: the plan tree annotated with runtime
+metrics per node, top time sinks flagged.
+
+(reference: the SQL-UI per-node metric display wired by GpuExec /
+GpuMetrics.scala — here rendered as text, since the standalone engine
+has no UI process.) Works from the JSON plan tree + lore-keyed metric
+dicts of profiler.event_log, so the same renderer serves the local
+DataFrame path, the distributed runner's driver-side aggregation, and
+the profiling-tool CLI reading an event log after the fact.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .event_log import op_time_seconds
+
+__all__ = ["render_analyze", "fmt_bytes"]
+
+_SHUFFLE_BYTE_KEYS = ("shuffleBytesWritten", "shuffleBytesRead",
+                      "rawBytes")
+
+
+def fmt_bytes(n) -> str:
+    n = float(n)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024 or unit == "GiB":
+            return (f"{n:.0f}{unit}" if unit == "B"
+                    else f"{n:.1f}{unit}")
+        n /= 1024
+    return f"{n:.1f}GiB"
+
+
+def render_analyze(tree: dict, metrics_by_lore: Dict[Optional[int], dict],
+                   top_n: int = 3, title: Optional[str] = None) -> str:
+    """Render the plan tree with per-node rows/batches/op-time/shuffle/
+    spill annotations; the `top_n` largest time sinks are flagged with
+    their share of total attributed operator time."""
+    times = []
+
+    def collect(node):
+        m = metrics_by_lore.get(node.get("lore_id")) or {}
+        times.append((node.get("lore_id"), op_time_seconds(m)))
+        for c in node.get("children", ()):
+            collect(c)
+
+    collect(tree)
+    total = sum(t for _, t in times)
+    sinks = sorted((e for e in times if e[1] > 0), key=lambda e: -e[1])
+    rank = {lid: i + 1 for i, (lid, _) in enumerate(sinks[:top_n])}
+
+    lines = [] if title is None else [title]
+
+    def walk(node, indent):
+        lid = node.get("lore_id")
+        m = metrics_by_lore.get(lid) or {}
+        t = op_time_seconds(m)
+        line = f"{'  ' * indent}[loreId={lid}] {node.get('describe')}"
+        ann = []
+        if "numOutputRows" in m:
+            ann.append(f"rows={int(m['numOutputRows'])}")
+        if "numOutputBatches" in m:
+            ann.append(f"batches={int(m['numOutputBatches'])}")
+        if t > 0:
+            ann.append(f"time={t * 1e3:.1f}ms")
+        shuffle = sum(m.get(k, 0) for k in _SHUFFLE_BYTE_KEYS)
+        if shuffle:
+            ann.append(f"shuffle={fmt_bytes(shuffle)}")
+        if m.get("spillBytes"):
+            ann.append(f"spill={fmt_bytes(m['spillBytes'])}")
+        if ann:
+            line += "  " + " ".join(ann)
+        if lid in rank:
+            pct = (100.0 * t / total) if total > 0 else 0.0
+            line += (f"  <-- time sink #{rank[lid]} "
+                     f"({pct:.0f}% of op time)")
+        lines.append(line)
+        for c in node.get("children", ()):
+            walk(c, indent + 1)
+
+    walk(tree, 0)
+    if total > 0:
+        lines.append(f"total attributed op time: {total * 1e3:.1f}ms")
+    return "\n".join(lines)

@@ -1,0 +1,234 @@
+"""Structured per-query event log (the Spark event-log analog).
+
+One JSONL file per query under `spark.rapids.tpu.sql.eventLog.dir`, with
+typed events the profiling tool post-processes:
+
+  query_start   {query_id, action, ts}
+  plan          {plan: nested {lore_id, name, describe, children}}
+  stage_submit  {stage, n_tasks, attempt}        (distributed runner)
+  stage_complete{stage, wall_s, shuffle_bytes}   (distributed runner)
+  fetch_retry   {stage, pid, shuffle_id}         (distributed runner)
+  op_metrics    {ops: [{lore_id, name, describe, metrics}], stage?}
+  watermarks    {devicePeakBytes, hostPeakBytes, spill?, hostPressure?}
+  xla_compile   {compiles, compile_secs, cache_hits, cache_misses}
+  query_end     {status, wall_s, error?}
+
+Locally `session.py` wraps every action (`profile_query`); the
+distributed runner (cluster/query.py) writes one log driver-side from
+the executor `MetricSet` snapshots that ride back with task results.
+Metric values honor `spark.rapids.tpu.sql.metrics.level`; op time is the
+sum of the operator's `*Time` timers (see docs/observability.md for the
+async-dispatch skew caveat and the `sql.metrics.sync` gate).
+"""
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import threading
+import time
+from contextlib import contextmanager
+from typing import Dict, List, Optional
+
+from ..utils.metrics import DEBUG
+
+__all__ = ["EventLogWriter", "open_query_log", "read_event_log",
+           "next_query_id", "plan_tree", "op_metrics_records",
+           "aggregate_ops", "op_time_seconds", "top_operators",
+           "profile_query"]
+
+_QUERY_SEQ = itertools.count()
+
+
+def next_query_id(prefix: str = "query") -> str:
+    """Process-unique query id (also the event-log file stem)."""
+    return f"{prefix}-{os.getpid()}-{next(_QUERY_SEQ)}"
+
+
+def _json_default(o):
+    try:
+        return float(o)
+    except Exception:
+        return str(o)
+
+
+class EventLogWriter:
+    """Append-only JSONL writer; one file per query, flushed per event
+    so a crashed query still leaves a readable prefix."""
+
+    def __init__(self, path: str, query_id: str):
+        self.path = path
+        self.query_id = query_id
+        self._lock = threading.Lock()
+        self._f = open(path, "a", encoding="utf-8")
+
+    def emit(self, event: str, **fields):
+        rec = {"event": event, "ts": round(time.time(), 6),
+               "query_id": self.query_id}
+        rec.update(fields)
+        line = json.dumps(rec, default=_json_default)
+        with self._lock:
+            if self._f is None:
+                return
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self):
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+
+def open_query_log(conf, query_id: str) -> Optional[EventLogWriter]:
+    """EventLogWriter for this query, or None when logging is off."""
+    from ..config import EVENT_LOG_DIR, EVENT_LOG_ENABLED
+    if not conf.get(EVENT_LOG_ENABLED):
+        return None
+    d = conf.get(EVENT_LOG_DIR)
+    try:
+        os.makedirs(d, exist_ok=True)
+        return EventLogWriter(os.path.join(d, f"{query_id}.jsonl"),
+                              query_id)
+    except OSError:
+        return None
+
+
+def read_event_log(path: str) -> List[dict]:
+    """Parse a JSONL event log; tolerates a torn trailing line."""
+    out = []
+    with open(path, encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out
+
+
+# ---------------------------------------------------------------------
+# plan / metric snapshots (shared by session, cluster runner, tools)
+# ---------------------------------------------------------------------
+def plan_tree(root) -> dict:
+    """Physical plan as a JSON-able tree keyed by lore_id (stable across
+    processes for the same plan — the cross-executor aggregation key)."""
+    return {"lore_id": getattr(root, "lore_id", None),
+            "name": root.node_name(),
+            "describe": root.describe(),
+            "children": [plan_tree(c) for c in root.children]}
+
+
+def op_metrics_records(root, metrics_by_opid: Dict[str, object],
+                       max_level: int = DEBUG) -> List[dict]:
+    """Flatten the physical tree into per-operator metric records.
+    `metrics_by_opid` maps `node._op_id` to a MetricSet OR an already
+    snapshotted dict (DataFrame.last_metrics shape)."""
+    recs = []
+
+    def walk(node):
+        ms = metrics_by_opid.get(node._op_id)
+        if hasattr(ms, "snapshot"):
+            ms = ms.snapshot(max_level)
+        recs.append({"lore_id": getattr(node, "lore_id", None),
+                     "name": node.node_name(),
+                     "describe": node.describe(),
+                     "metrics": dict(ms or {})})
+        for c in node.children:
+            walk(c)
+
+    walk(root)
+    return recs
+
+
+def aggregate_ops(records: List[dict]) -> Dict[str, dict]:
+    """Merge operator records across tasks/executors/queries, keyed by
+    `lore_id:name` (stable for the same fragment plan in every worker
+    process — id()-based _op_ids are NOT). Numeric metrics sum."""
+    out: Dict[str, dict] = {}
+    for r in records:
+        key = f"{r.get('lore_id')}:{r.get('name')}"
+        cur = out.setdefault(key, {"lore_id": r.get("lore_id"),
+                                   "name": r.get("name"),
+                                   "describe": r.get("describe"),
+                                   "metrics": {}})
+        for k, v in (r.get("metrics") or {}).items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)):
+                cur["metrics"][k] = v
+            else:
+                cur["metrics"][k] = cur["metrics"].get(k, 0) + v
+    return out
+
+
+def op_time_seconds(metrics: dict) -> float:
+    """An operator's attributed time: the sum of its `*Time` timers
+    (opTime, scanTime, buildTime, partitionTime, writeTime, ...)."""
+    t = 0.0
+    for k, v in (metrics or {}).items():
+        if k.endswith("Time") and isinstance(v, (int, float)) \
+                and not isinstance(v, bool):
+            t += float(v)
+    return t
+
+
+def top_operators(records: List[dict], n: int = 5) -> List[dict]:
+    """Top-n operators by attributed time (the bench --profile and
+    EXPLAIN ANALYZE sink list)."""
+    rows = []
+    for r in records:
+        m = r.get("metrics") or {}
+        t = op_time_seconds(m)
+        if t <= 0 and not m:
+            continue
+        rows.append({"op": r.get("describe"),
+                     "loreId": r.get("lore_id"),
+                     "time_ms": round(t * 1e3, 3),
+                     "rows": m.get("numOutputRows")})
+    rows.sort(key=lambda r: r["time_ms"], reverse=True)
+    return rows[:n]
+
+
+# ---------------------------------------------------------------------
+# the per-action wrapper session.py runs every query inside
+# ---------------------------------------------------------------------
+@contextmanager
+def profile_query(session, root, ctx, action: str):
+    """Emit the full event sequence for one local query action. No-op
+    (beyond a cheap conf check) when event logging is disabled."""
+    w = open_query_log(ctx.conf, next_query_id())
+    if w is None:
+        yield None
+        return
+    from ..memory import diagnostics
+    from . import xla_stats
+    if session is not None:
+        session.last_event_log = w.path
+    xla0 = xla_stats.snapshot()
+    diagnostics.reset_watermarks()
+    t0 = time.perf_counter()
+    w.emit("query_start", action=action)
+    w.emit("plan", plan=plan_tree(root))
+    status, err = "ok", None
+    try:
+        yield w
+    except BaseException as e:
+        status, err = "error", repr(e)
+        raise
+    finally:
+        try:
+            w.emit("op_metrics", ops=op_metrics_records(
+                root, ctx.metrics, ctx.metrics_level))
+            w.emit("watermarks", **diagnostics.watermarks_snapshot())
+            x1 = xla_stats.snapshot()
+            w.emit("xla_compile",
+                   **{k: round(x1[k] - xla0.get(k, 0), 6)
+                      for k in x1})
+            end = {"status": status,
+                   "wall_s": round(time.perf_counter() - t0, 6)}
+            if err is not None:
+                end["error"] = err
+            w.emit("query_end", **end)
+        finally:
+            w.close()

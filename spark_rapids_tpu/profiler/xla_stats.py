@@ -1,0 +1,66 @@
+"""XLA compile accounting via jax.monitoring listeners.
+
+Counts backend compiles + compile seconds
+(`/jax/core/compile/backend_compile_duration`) and persistent
+compilation-cache hits/misses (`/jax/compilation_cache/cache_*`), so the
+query event log can attribute cold-start time to compilation — the
+"untracked compile overhead" PAPERS.md ("Rethinking Analytical
+Processing in the GPU Era") calls out as a dominant hidden cost.
+
+Note: jax's in-memory jit tracing cache emits no events; `cache_hits`
+counts PERSISTENT cache retrievals only, so on a warm process most
+queries show zero compiles and zero cache traffic — that is the success
+case, not a gap. Listeners register once per process and are
+version-tolerant (no-ops when jax.monitoring is absent).
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["install", "snapshot"]
+
+_lock = threading.Lock()
+_stats = {"compiles": 0, "compile_secs": 0.0,
+          "cache_hits": 0, "cache_misses": 0}
+_installed = False
+
+
+def install():
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    try:
+        from jax import monitoring
+    except Exception:
+        return
+
+    def _on_duration(event, secs, **kw):
+        if event.endswith("backend_compile_duration"):
+            with _lock:
+                _stats["compiles"] += 1
+                _stats["compile_secs"] += float(secs)
+
+    def _on_event(event, **kw):
+        if event.endswith("cache_hits"):
+            with _lock:
+                _stats["cache_hits"] += 1
+        elif event.endswith("cache_misses"):
+            with _lock:
+                _stats["cache_misses"] += 1
+
+    try:
+        monitoring.register_event_duration_secs_listener(_on_duration)
+        monitoring.register_event_listener(_on_event)
+    except Exception:
+        pass
+
+
+def snapshot() -> Dict[str, float]:
+    """Current cumulative counters (install()s the listeners on first
+    use; callers diff two snapshots to scope a query)."""
+    install()
+    with _lock:
+        return dict(_stats)

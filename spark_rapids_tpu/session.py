@@ -33,6 +33,9 @@ class TpuSession:
     def __init__(self, conf: Optional[Dict] = None):
         self.conf = TpuConf(conf)
         self.read = DataFrameReader(self)
+        # path of the most recent query's event log (set by the profiler
+        # wrapper when sql.eventLog.enabled)
+        self.last_event_log: Optional[str] = None
         TpuSession._active = self
         from .config import RETRY_COVERAGE_ENABLED
         from .memory.diagnostics import enable_retry_coverage
@@ -677,6 +680,7 @@ class DataFrame:
 
     # -- actions --------------------------------------------------------
     _cached: Optional[tuple] = None
+    _last_root = None
 
     def _execute(self):
         # Cache the physical plan: exec nodes own their jitted kernels, so
@@ -691,15 +695,27 @@ class DataFrame:
         ctx = ExecContext(self._session.conf, self._session)
         return root, ctx
 
-    def to_arrow(self):
+    def _run_action(self, action: str, body):
+        """Run one query action inside the profiler wrapper: the event
+        log (when sql.eventLog.enabled) gets query_start/plan/
+        op_metrics/watermarks/xla_compile/query_end events, and the
+        DataFrame keeps the physical root + metric snapshots for
+        last_metrics() / explain("ANALYZE")."""
+        from .profiler.event_log import profile_query
         root, ctx = self._execute()
-        try:
-            out = collect_to_arrow(root, ctx)
-        finally:
-            ctx.close()
-        self._last_metrics = {op: ms.snapshot()
+        with profile_query(self._session, root, ctx, action):
+            try:
+                out = body(root, ctx)
+            finally:
+                ctx.close()
+        self._last_root = root
+        self._last_metrics = {op: ms.snapshot(ctx.metrics_level)
                               for op, ms in ctx.metrics.items()}
         return out
+
+    def to_arrow(self):
+        return self._run_action(
+            "collect", lambda root, ctx: collect_to_arrow(root, ctx))
 
     def last_metrics(self):
         """Per-operator metrics of the most recent action (GpuMetric
@@ -720,13 +736,13 @@ class DataFrame:
                 raise TypeError(
                     f"to_jax exports fixed-width columns; {f.name} is "
                     f"{f.dtype.simple_name()} (use to_arrow)")
-        root, ctx = self._execute()
-        try:
-            batches = []
+        def body(root, ctx):
+            out = []
             for pid in range(root.num_partitions(ctx)):
-                batches.extend(root.execute_partition(ctx, pid))
-        finally:
-            ctx.close()
+                out.extend(root.execute_partition(ctx, pid))
+            return out
+
+        batches = self._run_action("to_jax", body)
         if not batches:
             import jax.numpy as jnp
             return {f.name: (jnp.zeros(0, f.dtype.np_dtype),
@@ -757,9 +773,42 @@ class DataFrame:
         return df.collect()[0][0]
 
     def explain(self, mode: str = "ALL"):
+        """Print (and return) the plan. Modes: ALL / NOT_ON_TPU show
+        TPU-placement tagging with per-node lore ids; ANALYZE runs the
+        query and renders the tree annotated with runtime metrics
+        (rows/batches/op-time/shuffle/spill per node, top time sinks
+        flagged) — the SQL-UI metric display analog."""
+        mode_u = str(mode).upper()
+        if mode_u == "ANALYZE":
+            return self._explain_analyze()
         old = self._session.conf
-        planner = Planner(old.set("spark.rapids.tpu.sql.explain", mode))
+        planner = Planner(old.set("spark.rapids.tpu.sql.explain", mode_u))
         planner.plan(self._plan)
+        return "\n".join(planner.last_explain)
+
+    def _explain_analyze(self) -> str:
+        from .profiler.analyze import render_analyze
+        from .profiler.event_log import op_metrics_records, plan_tree
+        # drop (and release) any cached physical plan: stateful operators
+        # in a previously executed plan (a materialized
+        # ShuffleExchangeExec) would short-circuit re-execution, leaving
+        # every operator below them metric-less — ANALYZE must measure a
+        # full fresh run
+        cached = self._cached
+        if cached is not None:
+            try:
+                cached[1].release()
+            except Exception:
+                pass
+            self._cached = None
+        self.to_arrow()
+        root = self._last_root
+        recs = op_metrics_records(root, self._last_metrics)
+        by_lore = {r["lore_id"]: r["metrics"] for r in recs}
+        text = render_analyze(plan_tree(root), by_lore,
+                              title="== EXPLAIN ANALYZE ==")
+        print(text)
+        return text
 
     def write_parquet(self, path: str, **kw):
         from .io.parquet import write_parquet
@@ -782,12 +831,17 @@ class DataFrame:
         arrow tables (shared by every file writer)."""
         import pyarrow as pa
         from .exec.nodes import _batch_to_arrow
+        from .profiler.event_log import profile_query
         root, ctx = self._execute()
-        try:
-            for pid in range(root.num_partitions(ctx)):
-                tables = [_batch_to_arrow(b)
-                          for b in root.execute_partition(ctx, pid)]
-                if tables:
-                    yield pa.concat_tables(tables)
-        finally:
-            ctx.close()
+        with profile_query(self._session, root, ctx, "write"):
+            try:
+                for pid in range(root.num_partitions(ctx)):
+                    tables = [_batch_to_arrow(b)
+                              for b in root.execute_partition(ctx, pid)]
+                    if tables:
+                        yield pa.concat_tables(tables)
+            finally:
+                ctx.close()
+        self._last_root = root
+        self._last_metrics = {op: ms.snapshot(ctx.metrics_level)
+                              for op, ms in ctx.metrics.items()}

@@ -931,6 +931,18 @@ def parse_sql(session, sql: str):
     from ..session import DataFrame
     from ..plan import logical as L
 
+    # EXPLAIN [ANALYZE] <select>: ANALYZE runs the query and renders the
+    # plan annotated with runtime metrics; plain EXPLAIN renders the
+    # TPU-placement tagging. Either way the result is a one-row `plan`
+    # column DataFrame (the Spark EXPLAIN output shape).
+    m = re.match(r"\s*explain\b(\s+analyze\b)?", sql, re.IGNORECASE)
+    if m:
+        inner = parse_sql(session, sql[m.end():])
+        text = inner.explain("ANALYZE" if m.group(1) else "ALL")
+        import pyarrow as pa
+        return DataFrame(session,
+                         L.InMemoryScan(pa.table({"plan": [text or ""]})))
+
     p = _Parser(_tokenize(sql), session=session)
     p.expect("kw", "select")
     distinct = bool(p.accept("kw", "distinct"))

@@ -1,5 +1,15 @@
 """Operator metrics with levels, analog of GpuMetric
 (reference: sql-plugin/.../GpuMetrics.scala:377 ESSENTIAL/MODERATE/DEBUG).
+
+Timer-skew caveat: jax dispatch is ASYNC — by default `timer` measures
+the time to *enqueue* device work, not to execute it; execution lands on
+whichever downstream operator first blocks (usually the D2H fetch at the
+plan root). With `spark.rapids.tpu.sql.metrics.sync` on (ExecContext
+passes `sync=True`), the timer joins the device stream before stopping:
+it enqueues a trivial op and `block_until_ready`s it, which on an
+in-order compute stream waits for everything the timed block dispatched.
+That yields debug-grade per-operator execution times at the cost of
+pipelining; see docs/observability.md.
 """
 from __future__ import annotations
 
@@ -14,13 +24,26 @@ DEBUG = 2
 __all__ = ["MetricSet", "ESSENTIAL", "MODERATE", "DEBUG"]
 
 
+def _stream_barrier():
+    """Join the device stream: dispatch a trivial op and block on it.
+    Device execution streams are in-order, so this returns only after
+    every previously dispatched kernel completes."""
+    try:
+        import jax
+        import jax.numpy as jnp
+        jax.block_until_ready(jnp.zeros((), jnp.int32) + 1)
+    except Exception:
+        pass
+
+
 class MetricSet:
     """Thread-safe: partitions update operator metrics concurrently."""
 
-    def __init__(self):
+    def __init__(self, sync: bool = False):
         self._values = {}
         self._levels = {}
         self._lock = threading.Lock()
+        self._sync = sync
 
     def add(self, name: str, amount, level: int = MODERATE):
         with self._lock:
@@ -41,6 +64,8 @@ class MetricSet:
         try:
             yield
         finally:
+            if self._sync:
+                _stream_barrier()
             self.add(name, time.perf_counter() - t0, level)
 
     def snapshot(self, max_level: int = DEBUG):

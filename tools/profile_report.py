@@ -1,0 +1,224 @@
+#!/usr/bin/env python
+"""Profiling Tool CLI (the spark-rapids user-tools Profiling Tool analog):
+post-process query event logs into per-operator breakdowns, and diff two
+runs to attribute a regression to the operator that got slower.
+
+Usage:
+    # per-query operator breakdown of one or more logs
+    python tools/profile_report.py /tmp/srtpu-events/query-123-0.jsonl
+
+    # every log in a directory
+    python tools/profile_report.py /tmp/srtpu-events
+
+    # A/B regression attribution: which operator got slower in B?
+    python tools/profile_report.py --diff a.jsonl b.jsonl
+
+    # BENCH_*.json emitted with --profile also parses
+    python tools/profile_report.py BENCH_r06.json
+
+Inputs: per-query JSONL event logs written by the engine
+(`spark.rapids.tpu.sql.eventLog.enabled`, see docs/observability.md) or
+`BENCH_*.json` files whose `extra.tpch_profile` section was produced by
+`bench.py --profile`. Operators are keyed `lore_id:name` — stable for
+the same plan across runs and across executor processes — so the diff
+lines up operators even when absolute times moved.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from spark_rapids_tpu.profiler.analyze import fmt_bytes, render_analyze  # noqa: E402
+from spark_rapids_tpu.profiler.event_log import (  # noqa: E402
+    aggregate_ops, op_time_seconds, read_event_log)
+
+
+def load_events(path: str) -> List[dict]:
+    """Load one artifact as a flat event list. Detects BENCH_*.json
+    (single JSON document; its extra.tpch_profile section becomes
+    synthetic op_metrics events) vs JSONL event logs."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError:
+        return read_event_log(path)
+    if not isinstance(doc, dict):
+        return read_event_log(path)
+    # bench artifact: either the raw one-line JSON or the archived
+    # {"parsed": {...}} wrapper
+    parsed = doc.get("parsed", doc)
+    extra = parsed.get("extra") or {}
+    prof = extra.get("tpch_profile") or {}
+    events = [{"event": "bench", "query_id": path,
+               "metric": parsed.get("metric"),
+               "value": parsed.get("value")}]
+    for qname, rows in prof.items():
+        if not isinstance(rows, list):
+            continue
+        events.append({"event": "op_metrics", "query_id": qname, "ops": [
+            {"lore_id": r.get("loreId"), "name": r.get("op"),
+             "describe": r.get("op"),
+             "metrics": {"opTime": (r.get("time_ms") or 0) / 1e3,
+                         **({"numOutputRows": r["rows"]}
+                            if r.get("rows") is not None else {})}}
+            for r in rows]})
+    return events
+
+
+def _expand(paths: List[str]) -> List[str]:
+    out = []
+    for p in paths:
+        if os.path.isdir(p):
+            out.extend(sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.endswith(".jsonl")))
+        else:
+            out.append(p)
+    return out
+
+
+def _ops_of(events: List[dict]) -> List[dict]:
+    recs = []
+    for e in events:
+        if e.get("event") == "op_metrics":
+            recs.extend(e.get("ops") or [])
+    return recs
+
+
+def report(events: List[dict], top: int = 0) -> str:
+    """Per-query operator breakdown: the annotated plan tree when a plan
+    event exists, else a flat time-sorted table."""
+    by_query: Dict[str, List[dict]] = {}
+    for e in events:
+        by_query.setdefault(e.get("query_id", "?"), []).append(e)
+    lines = []
+    for qid, evs in by_query.items():
+        start = next((e for e in evs if e["event"] == "query_start"), {})
+        end = next((e for e in evs if e["event"] == "query_end"), {})
+        hdr = f"== query {qid}"
+        if start.get("action"):
+            hdr += f" (action={start['action']}"
+            if end.get("wall_s") is not None:
+                hdr += f", wall {end['wall_s'] * 1e3:.0f}ms"
+            hdr += f", status={end.get('status', '?')})"
+        lines.append(hdr + " ==")
+        plan = next((e["plan"] for e in evs if e["event"] == "plan"),
+                    None)
+        ops = _ops_of(evs)
+        agg = aggregate_ops(ops)
+        if plan is not None:
+            by_lore = {v["lore_id"]: v["metrics"] for v in agg.values()}
+            lines.append(render_analyze(plan, by_lore))
+        else:
+            rows = sorted(agg.values(),
+                          key=lambda r: -op_time_seconds(r["metrics"]))
+            if top:
+                rows = rows[:top]
+            for r in rows:
+                m = r["metrics"]
+                t = op_time_seconds(m)
+                extra = ""
+                if m.get("numOutputRows") is not None:
+                    extra = f"  rows={int(m['numOutputRows'])}"
+                lines.append(f"  {t * 1e3:9.1f}ms  [loreId="
+                             f"{r['lore_id']}] {r['describe']}{extra}")
+        for e in evs:
+            if e["event"] == "stage_complete":
+                sb = e.get("shuffle_bytes")
+                lines.append(
+                    f"  stage {e.get('stage')}: wall "
+                    f"{e.get('wall_s', 0) * 1e3:.0f}ms"
+                    + (f", shuffle {fmt_bytes(sb)}"
+                       if sb is not None else ""))
+            elif e["event"] == "fetch_retry":
+                lines.append(f"  FETCH RETRY pid={e.get('pid')} "
+                             f"addr={e.get('addr')}")
+            elif e["event"] == "watermarks":
+                lines.append(
+                    f"  watermarks: device peak "
+                    f"{fmt_bytes(e.get('devicePeakBytes', 0))}, host "
+                    f"peak {fmt_bytes(e.get('hostPeakBytes', 0))}")
+            elif e["event"] == "xla_compile" and e.get("compiles"):
+                lines.append(
+                    f"  xla: {e['compiles']} compiles, "
+                    f"{e.get('compile_secs', 0):.2f}s compiling, "
+                    f"{e.get('cache_hits', 0)} persistent-cache hits")
+        lines.append("")
+    return "\n".join(lines)
+
+
+def diff_ops(a_events: List[dict], b_events: List[dict]) -> List[dict]:
+    """A/B regression attribution: per `lore_id:name` operator key, the
+    op-time delta B-A, sorted worst regression first. The top entry is
+    'which operator got slower'."""
+    a = aggregate_ops(_ops_of(a_events))
+    b = aggregate_ops(_ops_of(b_events))
+    out = []
+    for key in sorted(set(a) | set(b)):
+        ta = op_time_seconds((a.get(key) or {}).get("metrics") or {})
+        tb = op_time_seconds((b.get(key) or {}).get("metrics") or {})
+        rec = a.get(key) or b.get(key)
+        out.append({"key": key, "name": rec.get("name"),
+                    "describe": rec.get("describe"),
+                    "a_time_s": round(ta, 6), "b_time_s": round(tb, 6),
+                    "delta_s": round(tb - ta, 6),
+                    "ratio": round(tb / ta, 3) if ta > 0 else None})
+    out.sort(key=lambda r: -r["delta_s"])
+    return out
+
+
+def diff_report(a_events: List[dict], b_events: List[dict],
+                top: int = 10) -> str:
+    rows = diff_ops(a_events, b_events)
+    lines = ["== A/B operator regression attribution (B - A, worst "
+             "first) ==",
+             f"{'delta':>10} {'A':>9} {'B':>9} {'ratio':>7}  operator"]
+    for r in rows[:top] if top else rows:
+        ratio = f"{r['ratio']:.2f}x" if r["ratio"] else "new"
+        lines.append(f"{r['delta_s'] * 1e3:+9.1f}ms "
+                     f"{r['a_time_s'] * 1e3:8.1f}ms "
+                     f"{r['b_time_s'] * 1e3:8.1f}ms {ratio:>7}  "
+                     f"[{r['key']}] {r['describe']}")
+    regressed = [r for r in rows if r["delta_s"] > 0]
+    if regressed:
+        w = regressed[0]
+        lines.append(f"most regressed operator: [{w['key']}] "
+                     f"{w['describe']} "
+                     f"(+{w['delta_s'] * 1e3:.1f}ms)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Post-process query event logs / bench profiles "
+                    "into per-operator breakdowns and A/B diffs.")
+    ap.add_argument("paths", nargs="+",
+                    help="event-log .jsonl files, directories of them, "
+                         "or BENCH_*.json files")
+    ap.add_argument("--diff", action="store_true",
+                    help="treat the two paths as runs A and B and "
+                         "attribute the regression")
+    ap.add_argument("--top", type=int, default=10,
+                    help="rows to show in diff / flat listings")
+    args = ap.parse_args(argv)
+    paths = _expand(args.paths)
+    if args.diff:
+        if len(paths) != 2:
+            ap.error("--diff needs exactly two logs (A and B)")
+        print(diff_report(load_events(paths[0]), load_events(paths[1]),
+                          args.top))
+        return 0
+    for p in paths:
+        print(report(load_events(p), args.top))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
