@@ -1,0 +1,16 @@
+"""Static analysis for the engine: the plan-time auditor (NOT_ON_TPU
+verdict tagging, analysis/audit.py) and the AST rules behind the
+`tpulint` engine linter (analysis/lint_rules.py).
+
+Both passes make the engine's safety contracts machine-checked instead
+of reviewer folklore: the auditor walks a bound physical plan BEFORE
+execution and predicts where it will fall back, fail, or recompile; the
+linter walks the engine's own source and flags sync/recompile hazards
+(implicit device->host syncs, shape-baking jit closures, dtype-promotion
+traps, missing buffer donation).
+"""
+from .audit import (AuditReport, Verdict, audit_plan, OK, WILL_FALLBACK,
+                    WILL_NOT_WORK, RECOMPILE_RISK)
+
+__all__ = ["AuditReport", "Verdict", "audit_plan", "OK", "WILL_FALLBACK",
+           "WILL_NOT_WORK", "RECOMPILE_RISK"]

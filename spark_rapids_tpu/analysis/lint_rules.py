@@ -1,0 +1,551 @@
+"""tpulint: AST rules for the engine's own JAX discipline.
+
+The engine's performance contract is enforced by convention at ~60
+hand-audited call sites: device->host syncs go through
+`utils/transfer.fetch` (async-overlapped, the single D2H chokepoint),
+`block_until_ready` lives only inside the conf-gated metric timers, and
+jit-traced code keeps shapes bucketed and literals weak-typed. This
+module turns those conventions into machine-checked rules (consumed by
+`tools/tpulint.py` and the tier-1 `tests/test_lint_clean.py`):
+
+  host-sync        np.asarray / jax.device_get / .item() in a module
+                   that imports jax — an implicit device->host sync that
+                   bypasses the fetch() chokepoint and serializes the
+                   dispatch pipeline
+  block-sync       block_until_ready outside the conf-gated metric
+                   timers (utils/metrics.py `sql.metrics.sync`)
+  jit-static-shape a jit-traced function building shapes from a traced
+                   parameter (missing static_argnums) or from a closure
+                   capture (every distinct value compiles a fresh XLA
+                   program)
+  strong-literal   numpy-typed scalar constants materialized inside
+                   traced code (jnp.array(0.5), np.float32(2)): strong
+                   dtypes defeat weak-type promotion and can split the
+                   compile cache — plain Python literals stay weak
+  donate-missing   a jit-traced consume-and-replace function (returns
+                   `param.at[...].set(...)`) without donate_argnums:
+                   XLA cannot reuse the input buffer
+  allow-no-reason  a `# tpulint: allow[...]` marker without a reason —
+                   every accepted violation must say why
+
+Intentional sites carry an inline marker on the flagged line (or the
+line above):
+
+    x = np.asarray(buf)  # tpulint: allow[host-sync] buf is already host
+
+Everything else lands in the committed baseline
+(`tools/tpulint_baseline.json`) or fails the run.
+"""
+from __future__ import annotations
+
+import ast
+import builtins
+import json
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+__all__ = ["Violation", "RULES", "lint_source", "lint_file",
+           "lint_paths", "load_baseline", "diff_baseline",
+           "baseline_entries"]
+
+MARKER_RE = re.compile(
+    r"#\s*tpulint:\s*allow\[([A-Za-z0-9_,\- ]+)\]\s*(.*)")
+
+_BUILTINS = set(dir(builtins))
+
+# shape-constructing callables whose first positional argument is a shape
+_SHAPE_CTORS = {"zeros", "ones", "full", "empty", "arange"}
+# numpy scalar-dtype constructors that produce strong-typed constants
+_STRONG_CTORS = {"float16", "float32", "float64", "int8", "int16",
+                 "int32", "int64", "uint8", "uint16", "uint32", "uint64",
+                 "bool_", "array", "asarray"}
+
+
+class Violation:
+    __slots__ = ("path", "line", "col", "rule", "message", "snippet")
+
+    def __init__(self, path: str, line: int, col: int, rule: str,
+                 message: str, snippet: str):
+        self.path = path
+        self.line = line
+        self.col = col
+        self.rule = rule
+        self.message = message
+        self.snippet = snippet
+
+    def key(self) -> Tuple[str, str, str]:
+        """Line numbers shift; identity is (file, rule, code text)."""
+        return (self.path, self.rule, self.snippet)
+
+    def describe(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule}: "
+                f"{self.message}")
+
+    def to_dict(self) -> dict:
+        return {"path": self.path, "line": self.line, "col": self.col,
+                "rule": self.rule, "message": self.message,
+                "snippet": self.snippet}
+
+    def __repr__(self):
+        return f"Violation({self.describe()})"
+
+
+class _ModuleCtx:
+    """Per-module facts the rules share: import aliases + markers."""
+
+    def __init__(self, tree: ast.Module, lines: List[str], path: str):
+        self.tree = tree
+        self.lines = lines
+        self.path = path
+        self.np_aliases: Set[str] = set()
+        self.jnp_aliases: Set[str] = set()
+        self.jax_aliases: Set[str] = set()
+        self.from_jax: Set[str] = set()       # from jax import jit, ...
+        self.module_names: Set[str] = set()
+        for node in tree.body:
+            self._top_level(node)
+        # alias collection must also see function-local imports
+        # (several engine modules do `import jax` inside a method)
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                self._collect_imports(node)
+        self.imports_jax = bool(self.jax_aliases or self.jnp_aliases
+                                or self.from_jax)
+        # line -> (set of allowed rules, has_reason)
+        self.markers: Dict[int, Tuple[Set[str], bool]] = {}
+        for i, raw in enumerate(lines, start=1):
+            m = MARKER_RE.search(raw)
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")
+                         if r.strip()}
+                self.markers[i] = (rules, bool(m.group(2).strip()))
+
+    def _collect_imports(self, node):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = a.asname or a.name.split(".")[0]
+                self.module_names.add(name)
+                if a.name == "numpy":
+                    self.np_aliases.add(a.asname or "numpy")
+                elif a.name == "jax.numpy":
+                    self.jnp_aliases.add(a.asname or "jax")
+                elif a.name == "jax" or a.name.startswith("jax."):
+                    self.jax_aliases.add(a.asname or "jax")
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                self.module_names.add(a.asname or a.name)
+            if node.module == "jax":
+                self.from_jax.update(a.asname or a.name
+                                     for a in node.names)
+            elif node.module == "jax.numpy":
+                self.jnp_aliases.update(
+                    a.asname or a.name for a in node.names
+                    if a.name == "numpy")
+
+    def _top_level(self, node):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            pass          # handled by the _collect_imports walk
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                               ast.ClassDef)):
+            self.module_names.add(node.name)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        self.module_names.add(n.id)
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign)):
+            if isinstance(node.target, ast.Name):
+                self.module_names.add(node.target.id)
+        elif isinstance(node, (ast.If, ast.Try)):
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, ast.stmt):
+                    self._top_level(sub)
+
+    def allowed(self, rule: str, line: int) -> bool:
+        for ln in (line, line - 1):
+            ent = self.markers.get(ln)
+            if ent and (rule in ent[0] or "all" in ent[0]):
+                return True
+        return False
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+
+def _call_root(func) -> Optional[str]:
+    """'np' for np.asarray, 'jax' for jax.device_get, None otherwise."""
+    if isinstance(func, ast.Attribute) and isinstance(func.value,
+                                                      ast.Name):
+        return func.value.id
+    return None
+
+
+class _JitInfo:
+    __slots__ = ("is_jit", "static_names", "has_donate")
+
+    def __init__(self, is_jit, static_names, has_donate):
+        self.is_jit = is_jit
+        self.static_names = static_names
+        self.has_donate = has_donate
+
+
+def _jit_info(fn: ast.FunctionDef, ctx: _ModuleCtx) -> _JitInfo:
+    """Detect @jax.jit / @jit / @partial(jax.jit, ...) decoration and
+    resolve static/donated parameter names."""
+    params = [a.arg for a in (fn.args.posonlyargs + fn.args.args)]
+
+    def is_jit_ref(e) -> bool:
+        if isinstance(e, ast.Name):
+            return e.id == "jit" and ("jit" in ctx.from_jax
+                                      or "jit" in ctx.module_names)
+        return (isinstance(e, ast.Attribute) and e.attr == "jit"
+                and isinstance(e.value, ast.Name)
+                and e.value.id in ctx.jax_aliases)
+
+    for dec in fn.decorator_list:
+        call = None
+        if is_jit_ref(dec):
+            return _JitInfo(True, set(), False)
+        if isinstance(dec, ast.Call):
+            if is_jit_ref(dec.func):
+                call = dec
+            elif (isinstance(dec.func, ast.Name)
+                  and dec.func.id == "partial" and dec.args
+                  and is_jit_ref(dec.args[0])):
+                call = dec
+            elif (isinstance(dec.func, ast.Attribute)
+                  and dec.func.attr == "partial" and dec.args
+                  and is_jit_ref(dec.args[0])):
+                call = dec
+        if call is None:
+            continue
+        static: Set[str] = set()
+        has_donate = False
+        for kw in call.keywords:
+            if kw.arg in ("static_argnums", "static_argnames"):
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant):
+                        if isinstance(n.value, int) \
+                                and 0 <= n.value < len(params):
+                            static.add(params[n.value])
+                        elif isinstance(n.value, str):
+                            static.add(n.value)
+            elif kw.arg in ("donate_argnums", "donate_argnames"):
+                has_donate = True
+        return _JitInfo(True, static, has_donate)
+    return _JitInfo(False, set(), False)
+
+
+def _local_names(fn: ast.FunctionDef) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                     ast.Store):
+            out.add(node.id)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.add(node.name)
+            out.update(a.arg for a in (node.args.posonlyargs
+                                       + node.args.args
+                                       + node.args.kwonlyargs))
+            if node.args.vararg:
+                out.add(node.args.vararg.arg)
+            if node.args.kwarg:
+                out.add(node.args.kwarg.arg)
+        elif isinstance(node, ast.comprehension):
+            for n in ast.walk(node.target):
+                if isinstance(n, ast.Name):
+                    out.add(n.id)
+        elif isinstance(node, ast.ExceptHandler) and node.name:
+            out.add(node.name)
+    return out
+
+
+def _shape_position_names(fn: ast.FunctionDef,
+                          ctx: _ModuleCtx) -> Iterator[Tuple[str, int,
+                                                             int]]:
+    """Names appearing where a value becomes a SHAPE inside `fn`: the
+    first argument of jnp.zeros/ones/full/empty/arange (and .reshape
+    args), or slice bounds."""
+    ctors = ctx.jnp_aliases | ctx.np_aliases
+
+    def names_in(e):
+        # `x.shape[0]`-derived values are static under jit — skip the
+        # whole subtree of shape-like attribute accesses
+        if isinstance(e, ast.Attribute) and e.attr in (
+                "shape", "size", "ndim", "dtype"):
+            return
+        if isinstance(e, ast.Name):
+            yield e
+            return
+        for child in ast.iter_child_nodes(e):
+            yield from names_in(child)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and isinstance(f.value, ast.Name)
+                    and f.value.id in ctors
+                    and f.attr in _SHAPE_CTORS and node.args):
+                for n in names_in(node.args[0]):
+                    yield n.id, n.lineno, n.col_offset
+            elif isinstance(f, ast.Attribute) and f.attr == "reshape":
+                for a in node.args:
+                    for n in names_in(a):
+                        yield n.id, n.lineno, n.col_offset
+        elif isinstance(node, ast.Slice):
+            for part in (node.lower, node.upper):
+                if part is not None:
+                    for n in names_in(part):
+                        yield n.id, n.lineno, n.col_offset
+
+
+# ---------------------------------------------------------------------
+# rules: fn(ctx) -> iterator of (line, col, rule, message)
+# ---------------------------------------------------------------------
+def rule_host_sync(ctx: _ModuleCtx):
+    if not ctx.imports_jax:
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        root = _call_root(f)
+        if isinstance(f, ast.Attribute) and f.attr == "asarray" \
+                and root in ctx.np_aliases:
+            yield (node.lineno, node.col_offset, "host-sync",
+                   "np.asarray on a (potential) device array is an "
+                   "implicit blocking D2H sync — route through "
+                   "utils/transfer.fetch (async-overlapped) or mark "
+                   "the site if the input is already host memory")
+        elif ((isinstance(f, ast.Attribute) and f.attr == "device_get"
+               and root in ctx.jax_aliases)
+              or (isinstance(f, ast.Name)
+                  and f.id == "device_get"
+                  and "device_get" in ctx.from_jax)):
+            yield (node.lineno, node.col_offset, "host-sync",
+                   "jax.device_get blocks without overlapping the D2H "
+                   "copies — use utils/transfer.fetch")
+        elif isinstance(f, ast.Attribute) and f.attr == "item" \
+                and not node.args and not node.keywords:
+            yield (node.lineno, node.col_offset, "host-sync",
+                   ".item() on a device array is a per-element "
+                   "blocking sync — use utils/transfer.fetch_int or "
+                   "batch the fetch")
+
+
+def rule_block_sync(ctx: _ModuleCtx):
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        name = f.attr if isinstance(f, ast.Attribute) else (
+            f.id if isinstance(f, ast.Name) else None)
+        if name == "block_until_ready":
+            yield (node.lineno, node.col_offset, "block-sync",
+                   "block_until_ready stalls the dispatch pipeline; it "
+                   "belongs only inside the conf-gated metric timers "
+                   "(utils/metrics.py, sql.metrics.sync)")
+
+
+def rule_jit_static_shape(ctx: _ModuleCtx):
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        info = _jit_info(fn, ctx)
+        if not info.is_jit:
+            continue
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args
+                                  + fn.args.kwonlyargs)}
+        locals_ = _local_names(fn) | params
+        seen: Set[Tuple[str, int]] = set()
+        for name, line, col in _shape_position_names(fn, ctx):
+            if (name, line) in seen:
+                continue
+            seen.add((name, line))
+            if name in info.static_names:
+                continue
+            if name in params:
+                yield (line, col, "jit-static-shape",
+                       f"jit-traced function {fn.name!r} builds a shape "
+                       f"from parameter {name!r} without declaring it "
+                       f"in static_argnums/static_argnames")
+            elif name not in locals_ and name not in ctx.module_names \
+                    and name not in _BUILTINS:
+                yield (line, col, "jit-static-shape",
+                       f"jit-traced function {fn.name!r} bakes closure "
+                       f"capture {name!r} into a shape: every distinct "
+                       f"value compiles a fresh XLA program (acceptable "
+                       f"only for power-of-two-bucketed capacities — "
+                       f"mark the site with the bucketing reason)")
+
+
+def rule_strong_literal(ctx: _ModuleCtx):
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        if not _jit_info(fn, ctx).is_jit:
+            continue
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            root = _call_root(f)
+            if not (isinstance(f, ast.Attribute)
+                    and f.attr in _STRONG_CTORS
+                    and root in (ctx.jnp_aliases | ctx.np_aliases)):
+                continue
+            if any(kw.arg == "dtype" for kw in node.keywords):
+                continue
+            if (len(node.args) == 1
+                    and isinstance(node.args[0], ast.Constant)
+                    and isinstance(node.args[0].value, (int, float))
+                    and not isinstance(node.args[0].value, bool)):
+                yield (node.lineno, node.col_offset, "strong-literal",
+                       f"strong-typed scalar constant "
+                       f"{ctx.snippet(node.lineno)[:40]!r} inside "
+                       f"jit-traced {fn.name!r}: defeats weak-type "
+                       f"promotion and can split the compile cache — "
+                       f"use a plain Python literal")
+
+
+def rule_donate_missing(ctx: _ModuleCtx):
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        info = _jit_info(fn, ctx)
+        if not info.is_jit or info.has_donate:
+            continue
+        params = {a.arg for a in (fn.args.posonlyargs + fn.args.args)}
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Return) or node.value is None:
+                continue
+            v = node.value
+            # return <param>.at[...].set/add/...(...)
+            if (isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Attribute)
+                    and v.func.attr in ("set", "add", "max", "min",
+                                        "multiply")
+                    and isinstance(v.func.value, ast.Subscript)
+                    and isinstance(v.func.value.value, ast.Attribute)
+                    and v.func.value.value.attr == "at"
+                    and isinstance(v.func.value.value.value, ast.Name)
+                    and v.func.value.value.value.id in params):
+                p = v.func.value.value.value.id
+                yield (node.lineno, node.col_offset, "donate-missing",
+                       f"jit-traced {fn.name!r} consumes and replaces "
+                       f"parameter {p!r} (returns {p}.at[...]."
+                       f"{v.func.attr}) without donate_argnums: XLA "
+                       f"allocates a second buffer instead of updating "
+                       f"in place")
+
+
+RULES = {
+    "host-sync": rule_host_sync,
+    "block-sync": rule_block_sync,
+    "jit-static-shape": rule_jit_static_shape,
+    "strong-literal": rule_strong_literal,
+    "donate-missing": rule_donate_missing,
+}
+
+
+def lint_source(src: str, path: str = "<string>",
+                rules=None) -> List[Violation]:
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:
+        return [Violation(path, e.lineno or 0, 0, "parse-error", str(e),
+                          "")]
+    lines = src.splitlines()
+    ctx = _ModuleCtx(tree, lines, path)
+    out: List[Violation] = []
+    for name, fn in (rules or RULES).items():
+        for line, col, rule, msg in (fn(ctx) or ()):
+            if ctx.allowed(rule, line):
+                continue
+            out.append(Violation(path, line, col, rule, msg,
+                                 ctx.snippet(line)))
+    # a bare allow marker hides a violation without saying why
+    for ln, (rnames, has_reason) in sorted(ctx.markers.items()):
+        if not has_reason:
+            out.append(Violation(
+                path, ln, 0, "allow-no-reason",
+                f"allow[{','.join(sorted(rnames))}] marker without a "
+                f"reason — say why the site is intentional",
+                ctx.snippet(ln)))
+    out.sort(key=lambda v: (v.line, v.col, v.rule))
+    return out
+
+
+def lint_file(path: str, rel_to: Optional[str] = None) -> List[Violation]:
+    with open(path, encoding="utf-8") as f:
+        src = f.read()
+    rel = os.path.relpath(path, rel_to) if rel_to else path
+    return lint_source(src, rel.replace(os.sep, "/"))
+
+
+def lint_paths(paths: List[str],
+               rel_to: Optional[str] = None) -> List[Violation]:
+    out: List[Violation] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        else:
+            out.append(p)
+    violations: List[Violation] = []
+    for f in out:
+        violations.extend(lint_file(f, rel_to))
+    return violations
+
+
+# ---------------------------------------------------------------------
+# baseline: accepted pre-existing violations, each with a reason
+# ---------------------------------------------------------------------
+def load_baseline(path: str) -> List[dict]:
+    if not os.path.exists(path):
+        return []
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    return list(data.get("entries", []))
+
+
+def baseline_entries(violations: List[Violation],
+                     reason: str = "") -> dict:
+    return {"version": 1,
+            "entries": [{"path": v.path, "rule": v.rule,
+                         "snippet": v.snippet, "reason": reason}
+                        for v in violations]}
+
+
+def diff_baseline(violations: List[Violation],
+                  baseline: List[dict]
+                  ) -> Tuple[List[Violation], List[dict]]:
+    """(new violations not in the baseline, stale baseline entries no
+    longer observed). Matching is by (path, rule, snippet) with
+    multiplicity, so line drift does not churn the baseline."""
+    budget: Dict[Tuple[str, str, str], int] = {}
+    for e in baseline:
+        k = (e.get("path", ""), e.get("rule", ""), e.get("snippet", ""))
+        budget[k] = budget.get(k, 0) + 1
+    new: List[Violation] = []
+    for v in violations:
+        k = v.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            new.append(v)
+    stale = []
+    for e in baseline:
+        k = (e.get("path", ""), e.get("rule", ""), e.get("snippet", ""))
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            stale.append(e)
+    return new, stale

@@ -127,8 +127,19 @@ SHUFFLE_COMPRESS = _conf(
     str)
 EXPLAIN = _conf(
     "sql.explain", "NONE",
-    "Explain TPU planning: NONE|NOT_ON_TPU|ALL "
-    "(analog of spark.rapids.sql.explain).", str)
+    "Explain TPU planning: NONE|NOT_ON_TPU|ALL|VALIDATE "
+    "(analog of spark.rapids.sql.explain). NOT_ON_TPU/ALL print the "
+    "tagged plan plus static-audit findings; VALIDATE prints the full "
+    "plan-audit verdict tree (ok / will_fallback / will_not_work / "
+    "recompile_risk per node, see docs/static_analysis.md).", str)
+AUDIT_STRICT = _conf(
+    "sql.audit.strict", False,
+    "Fail at PLAN time when the static plan auditor finds a "
+    "will_not_work verdict (unregistered expression, dtype the device "
+    "kernels cannot actually run): raises UnsupportedExpr carrying the "
+    "lore id + node path of every blocked site instead of dying "
+    "mid-query with an opaque XLA error. will_fallback and "
+    "recompile_risk verdicts never fail the plan.", bool)
 ALLOW_CPU_FALLBACK = _conf(
     "sql.allowCpuFallback", True,
     "Allow operators that cannot run on TPU to fall back to the host CPU "

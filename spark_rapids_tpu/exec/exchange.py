@@ -124,6 +124,7 @@ class ShuffleExchangeExec(TpuExec):
                 pieces = [[] for _ in range(self.n)]
                 for batch in child.execute_partition(ctx, mpid):
                     for host in with_retry(batch, map_one):
+                        # tpulint: allow[host-sync] `host` is map_one's fetch output (numpy views)
                         counts_h = np.asarray(host["counts"])
                         starts = np.concatenate(
                             [[0], np.cumsum(counts_h)]).astype(np.int64)

@@ -237,7 +237,8 @@ class MeshExchangeExec(TpuExec):
         out_flat, stats, row_cap, bcaps = rnd_state
         n = self.n
         with m.timer("exchangeTime"):
-            stats_h = jax.device_get(stats).reshape(n, 1 + n_str)
+            from ..utils.transfer import fetch
+            stats_h = fetch(stats).reshape(n, 1 + n_str)
         out_cap = n * row_cap
         for s in range(n):
             nlive = int(stats_h[s, 0])

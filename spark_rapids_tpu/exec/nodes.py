@@ -715,6 +715,7 @@ def _batch_to_arrow(batch: DeviceBatch):
     # fetch the mask together with all column buffers: ONE device_get
     host = fetch([c.device_buffers() for c in batch.table.columns]
                  + [batch.row_mask])
+    # tpulint: allow[host-sync] `host` is fetched above — numpy view
     mask = np.asarray(host[-1])[:batch.num_rows]
     arrs = [Column.arrow_from_host(c.dtype, c.length, b)
             for c, b in zip(batch.table.columns, host[:-1])]

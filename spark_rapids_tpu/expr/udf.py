@@ -54,6 +54,7 @@ class PyUDF(Expression):
         np_dt = self.return_type.np_dtype
 
         def host_fn(*arrays):
+            # tpulint: allow[host-sync] pure_callback hands host arrays
             out = self.fn(*[np.asarray(a) for a in arrays])
             return np.ascontiguousarray(out, dtype=np_dt)
 

@@ -95,6 +95,7 @@ class SpillableBatchHandle:
         flat = {}
         for i, bufs in enumerate(self._host["cols"]):
             flatten_bufs(bufs, f"c{i}_", flat)
+        # tpulint: allow[host-sync] _host tier is already on the host
         flat["mask"] = np.asarray(self._host["mask"])
         np.savez(path, **flat)
         self._disk_path = path

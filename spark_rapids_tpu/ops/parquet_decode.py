@@ -79,8 +79,9 @@ def expand_hybrid(chunk, run_start, run_count, run_packed, run_value,
     for b in range(min(nbytes_needed, 8)):
         byte = chunk[jnp.clip(byte0 + b, 0, nb - 1)].astype(jnp.uint64)
         word = word | (byte << jnp.uint64(8 * b))
-    mask = jnp.uint64((1 << bit_width) - 1) if bit_width < 64 \
-        else jnp.uint64(0xFFFFFFFFFFFFFFFF)
+    mask = (jnp.uint64((1 << bit_width) - 1) if bit_width < 64
+            # tpulint: allow[strong-literal] uint64 mask must be strong:
+            else jnp.uint64(0xFFFFFFFFFFFFFFFF))
     packed = ((word >> shift) & mask).astype(jnp.int32)
     rle = run_value[rid]
     out = jnp.where(run_packed[rid].astype(jnp.bool_), packed, rle)

@@ -151,7 +151,8 @@ class Project(LogicalPlan):
         fields = []
         for e in self.exprs:
             try:
-                b = _tsig(e.bind(child.schema))
+                b = _tsig(e.bind(child.schema),
+                          where=f"Project expr {e.name!r}")
                 self.bound.append(b)
                 self.bind_errors.append(None)
                 fields.append(Field(e.name, b.dtype))
@@ -183,7 +184,8 @@ class Filter(LogicalPlan):
         self.condition = condition
         self.bind_error: Optional[str] = None
         try:
-            self.bound = _tsig(condition.bind(child.schema))
+            self.bound = _tsig(condition.bind(child.schema),
+                               where="Filter condition")
         except UnsupportedExpr as err:
             self.bound = None
             self.bind_error = str(err)
@@ -208,9 +210,11 @@ class Aggregate(LogicalPlan):
         self.children = [child]
         self.keys = list(keys)
         self.aggs = list(aggs)
-        self.bound_keys = [_tsig(k.bind(child.schema))
+        self.bound_keys = [_tsig(k.bind(child.schema),
+                                 where=f"Aggregate key {k.name!r}")
                            for k in self.keys]
-        self.bound_aggs = [(n, _tsig(a.bind(child.schema)))
+        self.bound_aggs = [(n, _tsig(a.bind(child.schema),
+                                     where=f"Aggregate agg {n!r}"))
                            for n, a in self.aggs]
         fields = [Field(k.name, bk.dtype)
                   for k, bk in zip(self.keys, self.bound_keys)]
@@ -239,7 +243,8 @@ class Expand(LogicalPlan):
         self.key_names = list(key_names)
         self.include_masks = [tuple(m) for m in include_masks]
         self.gid_name = gid_name
-        self.bound_keys = [_tsig(k.bind(child.schema))
+        self.bound_keys = [_tsig(k.bind(child.schema),
+                                 where=f"Expand key {k.name!r}")
                            for k in self.key_exprs]
         fields = list(child.schema.fields)
         fields += [Field(n, k.dtype)
@@ -268,16 +273,19 @@ class Join(LogicalPlan):
         self.how = how
         self.left_keys = list(left_keys)
         self.right_keys = list(right_keys)
-        self.bound_left_keys = [_tsig(k.bind(left.schema))
+        self.bound_left_keys = [_tsig(k.bind(left.schema),
+                                      where=f"Join left key {k.name!r}")
                                 for k in self.left_keys]
-        self.bound_right_keys = [_tsig(k.bind(right.schema))
+        self.bound_right_keys = [_tsig(k.bind(right.schema),
+                                       where=f"Join right key {k.name!r}")
                                  for k in self.right_keys]
         lf = list(left.schema.fields)
         rf = list(right.schema.fields)
         # non-equi condition binds over the COMBINED schema (the
         # reference's AST-compiled join conditions, AstUtil.scala)
         self.condition = condition
-        self.bound_condition = (_tsig(condition.bind(Schema(lf + rf)))
+        self.bound_condition = (_tsig(condition.bind(Schema(lf + rf)),
+                                      where="Join condition")
                                 if condition is not None else None)
         if how in ("left_semi", "left_anti"):
             fields = lf
@@ -314,8 +322,10 @@ class Sort(LogicalPlan):
         self.children = [child]
         self.orders = list(orders)
         self.global_sort = global_sort
-        self.bound_orders = [SortOrder(_tsig(o.expr.bind(child.schema)),
-                                       o.ascending, o.nulls_first)
+        self.bound_orders = [SortOrder(
+            _tsig(o.expr.bind(child.schema),
+                  where=f"Sort key {o.expr!r}"),
+            o.ascending, o.nulls_first)
                              for o in self.orders]
 
     @property
@@ -366,7 +376,7 @@ class WindowOp(LogicalPlan):
         self.bound = [(n, w.bind(child.schema)) for n, w in self.wcols]
         for _n, _w in self.bound:
             if getattr(_w, 'child', None) is not None:
-                _tsig(_w.child)
+                _tsig(_w.child, where=f"WindowOp column {_n!r}")
         self._schema = Schema(list(child.schema.fields)
                               + [Field(n, w.dtype) for n, w in self.bound])
 
@@ -387,7 +397,8 @@ class Generate(LogicalPlan):
         self.child = child
         self.children = [child]
         self.generator = generator              # unbound Explode/PosExplode
-        self.bound = _tsig(generator.bind(child.schema))
+        self.bound = _tsig(generator.bind(child.schema),
+                           where="Generate generator")
         self.out_names = list(out_names)
         gen_dt = self.bound.dtype
         gen_fields = []
@@ -479,7 +490,8 @@ class Repartition(LogicalPlan):
         self.children = [child]
         self.num_partitions = num_partitions
         self.keys = list(keys) if keys else None
-        self.bound_keys = ([_tsig(k.bind(child.schema))
+        self.bound_keys = ([_tsig(k.bind(child.schema),
+                                  where=f"Repartition key {k.name!r}")
                             for k in self.keys]
                            if self.keys else None)
 

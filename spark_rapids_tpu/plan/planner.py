@@ -578,6 +578,8 @@ class Planner:
     # explain lines of the most recent plan() call (set whenever the
     # explain mode requests them; DataFrame.explain returns them)
     last_explain: List[str] = []
+    # AuditReport of the most recent plan() call (analysis/audit.py)
+    last_audit = None
 
     def plan(self, root: L.LogicalPlan) -> TpuExec:
         from .optimizer import optimize
@@ -601,14 +603,32 @@ class Planner:
         from ..utils.lore import apply_lore_dump, assign_lore_ids
         if root_exec is not None:
             assign_lore_ids(root_exec)
+        # static plan audit: a pure tree walk predicting fallback /
+        # will-not-work / recompile-risk per node BEFORE any execution
+        # (analysis/audit.py; the NOT_ON_TPU tagging discipline)
+        from ..analysis.audit import audit_plan
+        report = audit_plan(meta, self.conf)
+        self.last_audit = report
+        if root_exec is not None:
+            # ride the physical root so the profiler wrapper can emit
+            # the plan_audit event without re-walking
+            root_exec.audit_report = report
         self.last_explain = []
-        if explain_mode in ("ALL", "NOT_ON_TPU"):
-            self.last_explain = meta.explain_lines(
-                explain_mode == "NOT_ON_TPU")
+        if explain_mode in ("ALL", "NOT_ON_TPU", "VALIDATE"):
+            if explain_mode == "VALIDATE":
+                self.last_explain = report.lines()
+            else:
+                self.last_explain = meta.explain_lines(
+                    explain_mode == "NOT_ON_TPU")
+                self.last_explain.extend(
+                    v.describe() for v in report.findings)
             for line in self.last_explain:
                 print(line)
         if conv_err is not None:
             raise conv_err
+        from ..config import AUDIT_STRICT
+        if self.conf.get(AUDIT_STRICT):
+            report.raise_if_blocked()
         return apply_lore_dump(root_exec, self.conf)
 
     def _tag(self, meta: PlanMeta):

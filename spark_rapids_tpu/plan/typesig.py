@@ -8,11 +8,13 @@ uniform error text, and `generate_supported_ops()` emits the docs table.
 """
 from __future__ import annotations
 
-from typing import Dict, Tuple, Type
+from typing import Callable, Dict, Optional, Tuple, Type
 
 from ..columnar import dtypes as dt
 
-__all__ = ["TypeSig", "SIGS", "register", "check", "generate_supported_ops"]
+__all__ = ["TypeSig", "SIGS", "register", "check", "check_tree",
+           "AUDIT_CHECKS", "audit_register", "audit_check",
+           "generate_supported_ops"]
 
 
 class TypeSig:
@@ -54,23 +56,37 @@ def register(name: str, sig: TypeSig, desc: str = ""):
     SIGS[name] = (sig, desc)
 
 
-def check(name: str, dtype: dt.DataType, what: str = ""):
+def _at(where: str = "", lore_id=None) -> str:
+    """Render the bind-site context suffix for check errors: the node
+    path of the failing bind site (and, when the audit pass supplies
+    one, the lore id) instead of just the expression name."""
+    parts = []
+    if lore_id is not None:
+        parts.append(f"loreId={lore_id}")
+    if where:
+        parts.append(f"at {where}")
+    return f" [{', '.join(parts)}]" if parts else ""
+
+
+def check(name: str, dtype: dt.DataType, what: str = "",
+          where: str = "", lore_id=None):
     from ..expr.expressions import UnsupportedExpr
     ent = SIGS.get(name)
     if ent is not None and not ent[0].supports(dtype):
         raise UnsupportedExpr(
             f"{what or name} does not support input type {dtype} on TPU "
-            f"(supported: {ent[0].describe()})")
+            f"(supported: {ent[0].describe()})" + _at(where, lore_id))
 
 
-def check_tree(expr):
+def check_tree(expr, where: str = ""):
     """Uniform binder gate: walk a BOUND expression tree and check each
     node's primary input (first child) dtype against its registered
     signature (reference: TypeChecks.tagExprForGpu, TypeChecks.scala:716
     — there per-parameter; here the subject input, with later params
     enforced by the binders). Unregistered nodes pass — signatures are
     deliberately no STRICTER than the binders, so this adds uniform
-    error text and the docs table without shadowing real support."""
+    error text and the docs table without shadowing real support.
+    `where` names the bind site (logical node + role) for error text."""
     if expr is None:
         return expr
     name = type(expr).__name__
@@ -82,10 +98,36 @@ def check_tree(expr):
             from ..expr.expressions import UnsupportedExpr
             raise UnsupportedExpr(
                 f"{name} does not support input type {cdt} on TPU "
-                f"(supported: {ent[0].describe()})")
+                f"(supported: {ent[0].describe()})" + _at(where))
     for c in kids:
-        check_tree(c)
+        check_tree(c, where)
     return expr
+
+
+# -- audit checks -------------------------------------------------------
+# Kernel-truth refinements NARROWER than the bind-time signatures: the
+# binders accept these shapes, but the device kernels cannot actually run
+# them (dtype layouts the emit path mishandles, decimal/timezone edges).
+# The plan auditor (analysis/audit.py) evaluates them pre-execution and
+# turns what used to be an opaque mid-query XLA/Arrow error into a
+# plan-time `will_not_work` verdict. Each entry: expression class name
+# -> (fn(dtype) -> reason-or-None, doc note).
+AUDIT_CHECKS: Dict[str, Tuple[Callable[[dt.DataType], Optional[str]],
+                              str]] = {}
+
+
+def audit_register(name: str, fn: Callable[[dt.DataType], Optional[str]],
+                   note: str = ""):
+    AUDIT_CHECKS[name] = (fn, note)
+
+
+def audit_check(name: str, dtype: dt.DataType) -> Optional[str]:
+    """Reason this (expression, primary-input dtype) pair will NOT work
+    at runtime despite binding, or None when no audit rule objects."""
+    ent = AUDIT_CHECKS.get(name)
+    if ent is None or dtype is None:
+        return None
+    return ent[0](dtype)
 
 
 # -- registry (mirrors the expression surface; the binders stay the
@@ -201,6 +243,25 @@ register("ColumnRef", ALL_COMMON + NESTED, "column reference")
 register("PyUDF", ALL_COMMON,
          "AST-compiled to expressions when possible, else "
          "jax.pure_callback host evaluation (udf-compiler analog)")
+register("WindowExpr", NUMERIC + DATETIME + STRING + BOOL + NULL,
+         "window function over its input column; ranking functions "
+         "take no input (per-function frame rules enforced at bind)")
+
+
+# -- audit refinements (see AUDIT_CHECKS above) -------------------------
+def _no_decimal128(path_desc: str):
+    def chk(d: dt.DataType) -> Optional[str]:
+        if isinstance(d, dt.DecimalType) and d.is_decimal128:
+            return (f"{path_desc} reads the flat unscaled int64 buffer, "
+                    f"but decimal precision > 18 travels as two-limb "
+                    f"[cap, 2] pairs (ops/decimal128.py) — the result "
+                    f"shape breaks downstream kernels")
+        return None
+    return chk
+
+
+audit_register("MathUnary", _no_decimal128("the double-math path"),
+               "decimal limited to precision <= 18")
 
 
 def generate_supported_ops() -> str:

@@ -5,6 +5,8 @@ typed events the profiling tool post-processes:
 
   query_start   {query_id, action, ts}
   plan          {plan: nested {lore_id, name, describe, children}}
+  plan_audit    {ok, nodes, findings: [{kind, reason, node, path,
+                 lore_id}]}   (static auditor, analysis/audit.py)
   stage_submit  {stage, n_tasks, attempt}        (distributed runner)
   stage_complete{stage, wall_s, shuffle_bytes}   (distributed runner)
   fetch_retry   {stage, pid, shuffle_id}         (distributed runner)
@@ -210,6 +212,12 @@ def profile_query(session, root, ctx, action: str):
     t0 = time.perf_counter()
     w.emit("query_start", action=action)
     w.emit("plan", plan=plan_tree(root))
+    audit = getattr(root, "audit_report", None)
+    if audit is not None:
+        # static-audit verdicts keyed by lore id (analysis/audit.py):
+        # which nodes fall back, cannot run, or risk recompiles
+        w.emit("plan_audit", ok=audit.ok, nodes=audit.node_count,
+               findings=audit.to_events())
     status, err = "ok", None
     try:
         yield w

@@ -774,10 +774,13 @@ class DataFrame:
 
     def explain(self, mode: str = "ALL"):
         """Print (and return) the plan. Modes: ALL / NOT_ON_TPU show
-        TPU-placement tagging with per-node lore ids; ANALYZE runs the
-        query and renders the tree annotated with runtime metrics
-        (rows/batches/op-time/shuffle/spill per node, top time sinks
-        flagged) — the SQL-UI metric display analog."""
+        TPU-placement tagging with per-node lore ids (plus static-audit
+        findings); VALIDATE renders the plan auditor's full verdict tree
+        (ok / will_fallback / will_not_work / recompile_risk per node,
+        docs/static_analysis.md) WITHOUT executing anything; ANALYZE
+        runs the query and renders the tree annotated with runtime
+        metrics (rows/batches/op-time/shuffle/spill per node, top time
+        sinks flagged) — the SQL-UI metric display analog."""
         mode_u = str(mode).upper()
         if mode_u == "ANALYZE":
             return self._explain_analyze()

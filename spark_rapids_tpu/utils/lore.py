@@ -57,6 +57,7 @@ class LoreDumpExec(TpuExec):
             from ..utils.transfer import fetch
             host = fetch([c.device_buffers()
                           for c in batch.table.columns] + [batch.row_mask])
+            # tpulint: allow[host-sync] `host` is fetched above
             mask = np.asarray(host[-1])[:batch.num_rows]
             arrs = [Column.arrow_from_host(c.dtype, c.length, b)
                     for c, b in zip(batch.table.columns, host[:-1])]

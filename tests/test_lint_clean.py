@@ -1,0 +1,54 @@
+"""Tier-1 static-check gate: tpulint runs clean over the engine against
+the committed baseline, and the generated docs cannot silently drift.
+
+This is the CI lane for both static passes — it executes on every
+tier-1 run, so a new unguarded host sync, shape-baking jit closure, or
+stale docs table fails the suite immediately."""
+import os
+import subprocess
+import sys
+
+from spark_rapids_tpu.analysis.lint_rules import (diff_baseline,
+                                                  lint_paths,
+                                                  load_baseline)
+
+_ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+_BASELINE = os.path.join(_ROOT, "tools", "tpulint_baseline.json")
+
+
+def test_tpulint_clean_against_committed_baseline():
+    violations = lint_paths([os.path.join(_ROOT, "spark_rapids_tpu")],
+                            rel_to=_ROOT)
+    baseline = load_baseline(_BASELINE)
+    new, stale = diff_baseline(violations, baseline)
+    assert not new, (
+        "new tpulint violations (fix them, add a "
+        "`# tpulint: allow[<rule>] <reason>` marker, or baseline with "
+        "a reason):\n" + "\n".join(v.describe() for v in new))
+    assert not stale, (
+        "stale tpulint baseline entries (the violation is gone — "
+        "remove the entry):\n"
+        + "\n".join(f"{e['path']}: {e['rule']}: {e.get('snippet', '')}"
+                    for e in stale))
+
+
+def test_every_baseline_entry_carries_a_reason():
+    for e in load_baseline(_BASELINE):
+        assert e.get("reason", "").strip(), (
+            f"baseline entry without a reason: {e}")
+
+
+def test_tpulint_cli_clean():
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "tpulint.py")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_supported_ops_doc_in_sync():
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(_ROOT, "tools", "gen_supported_ops.py"),
+         "--check"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr

@@ -1,0 +1,377 @@
+"""tpulint engine-linter rules (analysis/lint_rules.py): each rule
+fires on a minimal synthetic snippet, the allow marker suppresses it
+(and demands a reason), and baseline diffing tolerates line drift."""
+import json
+import subprocess
+import sys
+import os
+import textwrap
+
+from spark_rapids_tpu.analysis.lint_rules import (baseline_entries,
+                                                  diff_baseline,
+                                                  lint_source,
+                                                  load_baseline)
+
+_ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _lint(src):
+    return lint_source(textwrap.dedent(src), "snippet.py")
+
+
+def _rules(src):
+    return [v.rule for v in _lint(src)]
+
+
+# ----------------------------------------------------------------------
+# host-sync
+# ----------------------------------------------------------------------
+def test_host_sync_np_asarray_fires():
+    vs = _lint("""
+        import jax
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x)
+    """)
+    assert [v.rule for v in vs] == ["host-sync"]
+    assert "np.asarray" in vs[0].message
+    assert vs[0].snippet == "return np.asarray(x)"
+
+
+def test_host_sync_silent_without_jax_import():
+    """A module with no jax import has no device arrays to sync."""
+    assert _rules("""
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x)
+    """) == []
+
+
+def test_host_sync_function_local_jax_import_counts():
+    assert _rules("""
+        import numpy as np
+
+        def f(x):
+            import jax
+            return np.asarray(x)
+    """) == ["host-sync"]
+
+
+def test_host_sync_device_get_and_item_fire():
+    assert _rules("""
+        import jax
+
+        def f(x):
+            return jax.device_get(x), x.item()
+    """) == ["host-sync", "host-sync"]
+
+
+def test_jnp_asarray_is_h2d_not_flagged():
+    assert _rules("""
+        import jax.numpy as jnp
+
+        def f(x):
+            return jnp.asarray(x)
+    """) == []
+
+
+# ----------------------------------------------------------------------
+# block-sync
+# ----------------------------------------------------------------------
+def test_block_sync_fires():
+    assert _rules("""
+        import jax
+
+        def f(x):
+            jax.block_until_ready(x)
+            return x.block_until_ready()
+    """) == ["block-sync", "block-sync"]
+
+
+# ----------------------------------------------------------------------
+# jit-static-shape
+# ----------------------------------------------------------------------
+def test_jit_param_shape_fires():
+    vs = _lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(n):
+            return jnp.zeros(n)
+    """)
+    assert [v.rule for v in vs] == ["jit-static-shape"]
+    assert "static_argnums" in vs[0].message
+
+
+def test_jit_static_argnums_suppresses():
+    assert _rules("""
+        from functools import partial
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnums=(0,))
+        def f(n, x):
+            return jnp.zeros(n) + x
+    """) == []
+
+
+def test_jit_static_argnames_suppresses():
+    assert _rules("""
+        from functools import partial
+        import jax
+        import jax.numpy as jnp
+
+        @partial(jax.jit, static_argnames=("n",))
+        def f(x, n=8):
+            return x.reshape(n)
+    """) == []
+
+
+def test_jit_closure_capture_shape_fires():
+    vs = _lint("""
+        import jax
+        import jax.numpy as jnp
+
+        def make(cap):
+            @jax.jit
+            def k(x):
+                return x + jnp.zeros(cap)
+            return k
+    """)
+    assert [v.rule for v in vs] == ["jit-static-shape"]
+    assert "closure capture 'cap'" in vs[0].message
+
+
+def test_jit_shape_attribute_is_static_and_clean():
+    """x.shape[0]-derived sizes are static under jit — no finding."""
+    assert _rules("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            n = x.shape[0]
+            return jnp.zeros(x.shape[0]) + x[:n]
+    """) == []
+
+
+def test_unjitted_function_not_checked():
+    assert _rules("""
+        import jax
+        import jax.numpy as jnp
+
+        def f(n):
+            return jnp.zeros(n)
+    """) == []
+
+
+# ----------------------------------------------------------------------
+# strong-literal
+# ----------------------------------------------------------------------
+def test_strong_literal_fires_in_jit():
+    vs = _lint("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return x + jnp.array(0.5)
+    """)
+    assert [v.rule for v in vs] == ["strong-literal"]
+
+
+def test_strong_literal_dtype_kwarg_and_plain_literal_clean():
+    assert _rules("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x):
+            return x + jnp.array(0.5, dtype=x.dtype) + 0.5
+    """) == []
+
+
+# ----------------------------------------------------------------------
+# donate-missing
+# ----------------------------------------------------------------------
+def test_donate_missing_fires():
+    vs = _lint("""
+        import jax
+
+        @jax.jit
+        def bump(acc, idx):
+            return acc.at[idx].add(1)
+    """)
+    assert [v.rule for v in vs] == ["donate-missing"]
+    assert "'acc'" in vs[0].message
+
+
+def test_donate_argnums_suppresses():
+    assert _rules("""
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def bump(acc, idx):
+            return acc.at[idx].add(1)
+    """) == []
+
+
+# ----------------------------------------------------------------------
+# allow markers
+# ----------------------------------------------------------------------
+def test_marker_on_line_suppresses():
+    assert _rules("""
+        import jax
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x)  # tpulint: allow[host-sync] x is host
+    """) == []
+
+
+def test_marker_above_line_suppresses():
+    assert _rules("""
+        import jax
+        import numpy as np
+
+        def f(x):
+            # tpulint: allow[host-sync] x is already host memory
+            return np.asarray(x)
+    """) == []
+
+
+def test_marker_for_other_rule_does_not_suppress():
+    assert _rules("""
+        import jax
+        import numpy as np
+
+        def f(x):
+            # tpulint: allow[block-sync] wrong rule
+            return np.asarray(x)
+    """) == ["host-sync"]
+
+
+def test_marker_without_reason_is_itself_flagged():
+    rules = _rules("""
+        import jax
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x)  # tpulint: allow[host-sync]
+    """)
+    assert rules == ["allow-no-reason"]
+
+
+# ----------------------------------------------------------------------
+# baseline diffing
+# ----------------------------------------------------------------------
+def test_baseline_diff_matches_on_snippet_not_line():
+    src_a = """
+        import jax
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x)
+    """
+    vs = _lint(src_a)
+    baseline = baseline_entries(vs, reason="accepted for the test")
+    # same violation, shifted 5 lines down: still baselined
+    src_b = "\n" * 5 + textwrap.dedent(src_a)
+    vs_b = lint_source(src_b, "snippet.py")
+    new, stale = diff_baseline(vs_b, baseline["entries"])
+    assert new == [] and stale == []
+    # a second, different violation is NEW; fixing the first goes stale
+    src_c = textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def g(y):
+            return y.item()
+    """)
+    new_c, stale_c = diff_baseline(lint_source(src_c, "snippet.py"),
+                                   baseline["entries"])
+    assert [v.rule for v in new_c] == ["host-sync"]
+    assert ".item()" in new_c[0].snippet
+    assert len(stale_c) == 1
+
+
+def test_baseline_multiplicity():
+    src = """
+        import jax
+        import numpy as np
+
+        def f(x, y):
+            return np.asarray(x), np.asarray(y)
+    """
+    vs = _lint(src)
+    assert len(vs) == 2
+    baseline = baseline_entries(vs[:1], reason="one accepted")
+    new, stale = diff_baseline(vs, baseline["entries"])
+    assert len(new) == 1 and stale == []
+
+
+# ----------------------------------------------------------------------
+# CLI
+# ----------------------------------------------------------------------
+def test_cli_reports_and_exits_nonzero(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x)
+    """))
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "tpulint.py"),
+         str(bad), "--no-baseline", "--json"],
+        capture_output=True, text=True)
+    assert r.returncode == 1
+    out = json.loads(r.stdout)
+    assert [v["rule"] for v in out["new"]] == ["host-sync"]
+
+
+def test_cli_write_baseline_roundtrip(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x)
+    """))
+    bl = tmp_path / "baseline.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "tpulint.py"),
+         str(bad), "--baseline", str(bl), "--write-baseline",
+         "--reason", "test acceptance"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stderr
+    entries = load_baseline(str(bl))
+    assert len(entries) == 1 and entries[0]["reason"]
+    r2 = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "tpulint.py"),
+         str(bad), "--baseline", str(bl)],
+        capture_output=True, text=True)
+    assert r2.returncode == 0, r2.stdout + r2.stderr
+
+
+def test_cli_write_baseline_requires_reason(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text(textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        def f(x):
+            return np.asarray(x)
+    """))
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "tpulint.py"),
+         str(bad), "--baseline", str(tmp_path / "b.json"),
+         "--write-baseline"],
+        capture_output=True, text=True)
+    assert r.returncode == 2

@@ -1,7 +1,11 @@
 #!/usr/bin/env python
 """Regenerate docs/supported_ops.md from the TypeSig registry (the
 analog of the reference's doc generation from TypeChecks into
-docs/supported_ops.md / tools/generated_files)."""
+docs/supported_ops.md / tools/generated_files).
+
+`--check` exits non-zero when the committed doc is stale relative to the
+registry (run by the tier-1 tests/test_lint_clean.py, so the doc can
+never silently drift again)."""
 import os
 import sys
 
@@ -9,8 +13,31 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 from spark_rapids_tpu.plan.typesig import generate_supported_ops  # noqa: E402
 
-out = os.path.join(os.path.dirname(__file__), "..", "docs",
+OUT = os.path.join(os.path.dirname(__file__), "..", "docs",
                    "supported_ops.md")
-with open(out, "w") as f:
-    f.write(generate_supported_ops())
-print(f"wrote {os.path.normpath(out)}")
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    generated = generate_supported_ops()
+    if "--check" in argv:
+        try:
+            with open(OUT, encoding="utf-8") as f:
+                committed = f.read()
+        except OSError:
+            committed = ""
+        if committed != generated:
+            print(f"{os.path.normpath(OUT)} is stale relative to the "
+                  f"TypeSig registry; run tools/gen_supported_ops.py",
+                  file=sys.stderr)
+            return 1
+        print(f"{os.path.normpath(OUT)} is in sync")
+        return 0
+    with open(OUT, "w", encoding="utf-8") as f:
+        f.write(generated)
+    print(f"wrote {os.path.normpath(OUT)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

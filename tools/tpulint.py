@@ -1,0 +1,98 @@
+#!/usr/bin/env python
+"""tpulint: lint the engine's own code for JAX sync/recompile hazards.
+
+Rules live in spark_rapids_tpu/analysis/lint_rules.py (host-sync,
+block-sync, jit-static-shape, strong-literal, donate-missing,
+allow-no-reason). Accepted sites carry inline
+`# tpulint: allow[<rule>] <reason>` markers; anything else must be in
+the committed baseline (tools/tpulint_baseline.json) or the run fails.
+
+Usage:
+  python tools/tpulint.py                       # lint spark_rapids_tpu/
+  python tools/tpulint.py path/ file.py         # explicit targets
+  python tools/tpulint.py --json                # machine-readable
+  python tools/tpulint.py --no-baseline         # report everything
+  python tools/tpulint.py --write-baseline --reason "accepted: ..."
+                                                # accept current state
+
+Exit codes: 0 clean, 1 new violations (or baseline entries without a
+reason), 2 usage error.
+"""
+import argparse
+import json
+import os
+import sys
+
+_ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
+sys.path.insert(0, _ROOT)
+
+from spark_rapids_tpu.analysis.lint_rules import (  # noqa: E402
+    baseline_entries, diff_baseline, lint_paths, load_baseline)
+
+DEFAULT_BASELINE = os.path.join(_ROOT, "tools", "tpulint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="tpulint",
+                                 description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: spark_rapids_tpu/)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+                    help="baseline JSON of accepted violations")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline; report every violation")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the current violations into --baseline")
+    ap.add_argument("--reason", default="",
+                    help="reason recorded on entries by --write-baseline")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit JSON instead of text")
+    args = ap.parse_args(argv)
+
+    paths = args.paths or [os.path.join(_ROOT, "spark_rapids_tpu")]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"tpulint: no such path: {p}", file=sys.stderr)
+            return 2
+    violations = lint_paths(paths, rel_to=_ROOT)
+
+    if args.write_baseline:
+        if violations and not args.reason:
+            print("tpulint: --write-baseline needs --reason (every "
+                  "baselined entry must say why it is accepted)",
+                  file=sys.stderr)
+            return 2
+        with open(args.baseline, "w", encoding="utf-8") as f:
+            json.dump(baseline_entries(violations, args.reason), f,
+                      indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"tpulint: wrote {len(violations)} entries to "
+              f"{args.baseline}")
+        return 0
+
+    baseline = [] if args.no_baseline else load_baseline(args.baseline)
+    unreasoned = [e for e in baseline if not e.get("reason", "").strip()]
+    new, stale = diff_baseline(violations, baseline)
+
+    if args.as_json:
+        print(json.dumps(
+            {"new": [v.to_dict() for v in new], "stale": stale,
+             "baseline_without_reason": unreasoned,
+             "total_observed": len(violations)}, indent=2))
+    else:
+        for v in new:
+            print(v.describe())
+        for e in stale:
+            print(f"tpulint: stale baseline entry (no longer observed): "
+                  f"{e.get('path')}: {e.get('rule')}: "
+                  f"{e.get('snippet', '')[:60]}")
+        for e in unreasoned:
+            print(f"tpulint: baseline entry without a reason: "
+                  f"{e.get('path')}: {e.get('rule')}")
+        print(f"tpulint: {len(violations)} observed, {len(new)} new, "
+              f"{len(baseline)} baselined, {len(stale)} stale")
+    return 1 if (new or unreasoned) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
