@@ -316,8 +316,22 @@ def _main_impl():
     # ---- full TPC-H sweep @ BENCH_SF_FULL (geomean over all 22) ---------
     # default SF1: the round-4 verdict's bar is
     # tpch_all22_vs_pandas_geomean >= 1.0 at SF >= 1
-    tpch_all = _tpch_sweep(s, float(os.environ.get("BENCH_SF_FULL", "1.0")))
+    sf_full = float(os.environ.get("BENCH_SF_FULL", "1.0"))
+    tpch_all = _tpch_sweep(s, sf_full)
     _partial["extra"].update(tpch_all)
+
+    # ---- scan profile: device-decode eligibility + time split ----------
+    # (ISSUE 4 acceptance: eligibility fraction of the snappy bench
+    # dataset's column-chunk bytes, and where scan wall time goes)
+    try:
+        _arm("scan profile")
+        _partial["extra"]["scan_profile"] = _scan_profile(st, sf_full)
+        _disarm()
+    except _BenchTimeout as e:
+        _partial["extra"]["scan_profile"] = {"error": f"timeout: {e}"}
+    except Exception as e:  # advisory: never lose the bench result
+        _partial["extra"]["scan_profile"] = {"error": repr(e)[:300]}
+        print(f"bench: scan profile failed: {e!r}", file=sys.stderr)
 
     rows_per_s = n / tpu_q6
     extra = {
@@ -441,6 +455,129 @@ def _tpch_sweep(s, sf: float):
     if errors:
         out["tpch_all22_errors"] = errors
     return out
+
+
+def _scan_profile(st, sf: float) -> dict:
+    """Write the SF`sf` TPC-H tables as SNAPPY parquet (the bench
+    dataset layout: decimals stored as integers so they take INT32/
+    INT64 physical types), then report
+
+      - device-decode eligibility: fraction of column chunks and of
+        column-chunk BYTES the device path can decode, plus fallback
+        bytes by reason (codec/type/encoding/nested),
+      - the scan/decompress/upload/prefetch-wait time split of a
+        device-decoded q6-shaped scan over lineitem, vs the host path,
+      - result parity between the two paths (byte-identical collect).
+    """
+    import shutil
+    import tempfile
+
+    import pyarrow.parquet as pq_mod
+    from spark_rapids_tpu.io.parquet_device import (eligible_chunks,
+                                                    fallback_reasons)
+    from spark_rapids_tpu.workloads import tpch
+
+    d = tempfile.mkdtemp(prefix="srtpu-scanprof-")
+    out = {"sf": sf, "compression": "snappy"}
+    try:
+        tabs = tpch.gen_all(sf=sf, seed=7)
+        paths = {}
+        for name, t in tabs.items():
+            p = os.path.join(d, f"{name}.parquet")
+            try:
+                pq_mod.write_table(t, p, compression="snappy",
+                                   store_decimal_as_integer=True)
+            except TypeError:  # older pyarrow: FLBA decimals fall back
+                pq_mod.write_table(t, p, compression="snappy")
+            paths[name] = p
+
+        elig_cols = total_cols = 0
+        elig_bytes = total_bytes = 0
+        reason_bytes = {}
+        per_table = {}
+        for name, p in paths.items():
+            pf = pq_mod.ParquetFile(p)
+            md = pf.metadata
+            cols = list(pf.schema_arrow.names)
+            tb = eb = 0
+            for rg in range(md.num_row_groups):
+                elig = eligible_chunks(pf, rg, cols)
+                reasons = fallback_reasons(pf, rg, cols)
+                name_of = {}
+                for ci in range(md.num_columns):
+                    col = md.row_group(rg).column(ci)
+                    name_of[ci] = ".".join(
+                        col.path_in_schema.split("."))
+                for ci in range(md.num_columns):
+                    col = md.row_group(rg).column(ci)
+                    b = col.total_compressed_size
+                    total_cols += 1
+                    tb += b
+                    if name_of[ci] in elig:
+                        elig_cols += 1
+                        eb += b
+                    else:
+                        cat = reasons.get(name_of[ci],
+                                          ("other", ""))[0]
+                        reason_bytes[cat] = reason_bytes.get(cat, 0) + b
+            total_bytes += tb
+            elig_bytes += eb
+            per_table[name] = round(eb / tb, 4) if tb else None
+        out.update({
+            "eligible_column_chunk_frac":
+                round(elig_cols / total_cols, 4) if total_cols else None,
+            "eligible_byte_frac":
+                round(elig_bytes / total_bytes, 4) if total_bytes
+                else None,
+            "fallback_bytes_by_reason": reason_bytes,
+            "per_table_eligible_byte_frac": per_table,
+        })
+
+        # q6-shaped scan over parquet lineitem: device path vs host path
+        def run(device: bool):
+            conf = {"spark.rapids.tpu.sql.batchSizeRows": 1 << 22,
+                    "spark.rapids.tpu.sql.format.parquet."
+                    "deviceDecode.enabled": device}
+            s2 = st.TpuSession(conf)
+            q = tpch.q6(s2.read.parquet(paths["lineitem"]))
+            q.to_arrow()      # warm: XLA compiles must not land in the
+            t0 = time.perf_counter()   # timers of the measured run
+            res = q.to_arrow()
+            return res, time.perf_counter() - t0, q.last_metrics()
+
+        dev_res, dev_s, dev_m = run(True)
+        host_res, host_s, _ = run(False)
+        out["device_matches_host"] = dev_res.equals(host_res)
+        scan = {}
+        for _op, ms in dev_m.items():
+            if "deviceDecodedChunks" in ms or "scanTime" in ms:
+                for k in ("scanTime", "decompressBusySecs",
+                          "uploadSecs", "prefetchWaitSecs",
+                          "deviceDecodedChunks", "deviceDecodeBytes",
+                          "stagingPoolHits", "stagingPoolMisses"):
+                    if k in ms:
+                        scan[k] = scan.get(k, 0) + ms[k]
+        out["q6_scan"] = {
+            "device_wall_s": round(dev_s, 3),
+            "host_wall_s": round(host_s, 3),
+            "scan_s": round(scan.get("scanTime", 0), 4),
+            "decompress_s": round(scan.get("decompressBusySecs", 0), 4),
+            "upload_s": round(scan.get("uploadSecs", 0), 4),
+            "prefetch_wait_s": round(scan.get("prefetchWaitSecs", 0),
+                                     4),
+            "device_decoded_chunks":
+                int(scan.get("deviceDecodedChunks", 0)),
+            "staging_pool_hits": int(scan.get("stagingPoolHits", 0)),
+            # the off-thread proof: the compute side waited less than
+            # the decode work took
+            "prefetch_wait_lt_decode":
+                scan.get("prefetchWaitSecs", 0)
+                < (scan.get("scanTime", 0)
+                   + scan.get("decompressBusySecs", 0)),
+        }
+        return out
+    finally:
+        shutil.rmtree(d, ignore_errors=True)
 
 
 def _regression_gate(current: dict, fellback: bool, sfs: dict):

@@ -227,6 +227,31 @@ def _bound_exprs(node):
             yield f"Repartition key {k.name!r}", k
 
 
+def _audit_parquet_scan(node, add: Callable[[str, str], None]):
+    """Plan-time device-decode audit of a ParquetScan: read the FIRST
+    file's footer (cheap, metadata only) and report, per selected
+    column, why the device decode path would fall back to host pyarrow
+    — codec / physical type / encoding / nested — so 'why did this
+    scan fall back' is answerable before running anything. Best-effort:
+    unreadable files stay silent (the runtime path re-checks)."""
+    try:
+        import pyarrow.parquet as pq
+
+        from ..io.parquet_device import fallback_reasons
+        pf = pq.ParquetFile(node.paths[0])
+        if pf.metadata.num_row_groups == 0:
+            return
+        cols = (node.columns if node.columns is not None
+                else [f.name for f in node.schema.fields])
+        for name, (cat, detail) in fallback_reasons(pf, 0,
+                                                    cols).items():
+            add(WILL_FALLBACK,
+                f"scan device-decode fallback ({cat}): column "
+                f"'{name}' decodes on host pyarrow — {detail}")
+    except Exception:
+        return
+
+
 def _audit_node(meta, path: str, depth: int, findings: List[Verdict],
                 tree_lines: List[str], conf, counter: List[int]):
     from ..plan import logical as L
@@ -249,6 +274,8 @@ def _audit_node(meta, path: str, depth: int, findings: List[Verdict],
         add(WILL_FALLBACK,
             "python_exec: rows cross to a pooled python worker process "
             "as Arrow IPC (device pipeline breaks at this node)")
+    if isinstance(node, L.ParquetScan):
+        _audit_parquet_scan(node, add)
     # every bound expression the node carries
     for role, b in _bound_exprs(node):
         _audit_expr(b, role, add)

@@ -180,12 +180,36 @@ PARQUET_READER_TYPE = _conf(
     "compute).", str)
 PARQUET_DEVICE_DECODE = _conf(
     "sql.format.parquet.deviceDecode.enabled", True,
-    "Decode eligible Parquet column chunks ON DEVICE (uncompressed "
-    "flat INT32/INT64/FLOAT/DOUBLE chunks, PLAIN or dictionary "
-    "encoded): raw bytes upload once, PLAIN lane assembly + RLE "
-    "run expansion + def-level masking run as XLA programs "
-    "(GpuParquetScan.scala:3364 Table.readParquet analog). "
-    "Ineligible columns fall back to host pyarrow per column.", bool)
+    "Decode eligible Parquet column chunks ON DEVICE (flat "
+    "INT32/INT64/FLOAT/DOUBLE/BYTE_ARRAY chunks; UNCOMPRESSED or "
+    "SNAPPY; PLAIN or dictionary encoded; v1 and v2 data pages): raw "
+    "bytes upload once, PLAIN lane assembly + RLE run expansion + "
+    "string offset extraction + def-level masking run as XLA programs "
+    "(GpuParquetScan.scala:3364 Table.readParquet analog). Snappy "
+    "pages decompress per-page on the multithreaded prefetch pool, "
+    "off the compute thread. Ineligible columns fall back to host "
+    "pyarrow per column (reason counters in EXPLAIN ANALYZE). On the "
+    "CPU backend the path only fires when this conf is set "
+    "explicitly: host pyarrow decode and the 'device' kernels share "
+    "the same silicon there, and pyarrow's native decoder wins.", bool)
+PARQUET_DEVICE_SNAPPY = _conf(
+    "sql.parquet.deviceSnappy", False,
+    "Decompress qualifying snappy pages ON DEVICE (jitted XLA scan "
+    "over the parsed literal/copy element table: run-ownership map + "
+    "log-depth pointer doubling resolves every output byte to a "
+    "literal source — the nvcomp-snappy analog). Applies to v1 PLAIN "
+    "pages of non-nullable chunks whose element table fits a "
+    "static-shape bucket; the host walks only the tag bytes. Other "
+    "pages keep the host prefetch-pool decompress. Off by default: "
+    "per-page output shapes vary, so cold scans pay extra XLA "
+    "compiles.", bool)
+HOST_STAGING_POOL_BYTES = _conf(
+    "memory.host.stagingPoolBytes", 256 * 1024 * 1024,
+    "Byte cap on the pinned staging pool: reusable pow2-bucketed host "
+    "buffers for raw-chunk reads, snappy decompression targets, and "
+    "H2D upload staging in the device parquet scan (HostAlloc pinned "
+    "pool analog). Cached buffers draw from memory.host.limitBytes; "
+    "leases past the cap are transient (freed on release).", int)
 PARQUET_COALESCING_TARGET = _conf(
     "sql.format.parquet.coalescing.targetBytes", 128 << 20,
     "COALESCING reader: files group until their on-disk size reaches "
@@ -407,6 +431,11 @@ class TpuConf:
 
     def get(self, entry: ConfEntry):
         return entry.get(self)
+
+    def is_set(self, entry: ConfEntry) -> bool:
+        """Whether the user supplied this key (vs the registry default).
+        Lets auto policies defer to an explicit setting."""
+        return entry.key in self._settings
 
     def set(self, key: str, value) -> "TpuConf":
         s = dict(self._settings)

@@ -79,9 +79,15 @@ class InMemoryScanExec(TpuExec):
 
 def _rg_survives(stats, op: str, value) -> bool:
     """Can a row group with these column stats contain a matching row?"""
-    if stats is None or not stats.has_min_max:
+    try:
+        if stats is None or not stats.has_min_max:
+            return True
+        # pyarrow raises ArrowNotImplementedError extracting stats for
+        # some logical types (e.g. decimals stored as integers): keep
+        # the group rather than die
+        lo, hi = stats.min, stats.max
+    except Exception:
         return True
-    lo, hi = stats.min, stats.max
     try:
         if op == ">=":
             return hi >= value
@@ -220,19 +226,38 @@ class ParquetScanExec(TpuExec):
             self._dv_cache[path] = got
         return got
 
+    def _device_decode_on(self, ctx) -> bool:
+        """Device parquet decode applies when enabled AND the backend
+        is a real accelerator; on the CPU backend pyarrow's native
+        decoder shares the silicon with the 'device' kernels and wins,
+        so there it only fires when the conf is set explicitly (tests,
+        parity fuzzing, scan profiling)."""
+        from ..config import PARQUET_DEVICE_DECODE
+        if not ctx.conf.get(PARQUET_DEVICE_DECODE):
+            return False
+        if jax.default_backend() == "cpu":
+            return ctx.conf.is_set(PARQUET_DEVICE_DECODE)
+        return True
+
     def _device_decoded_batches(self, ctx, path, m):
         """Device-decode path (GpuParquetScan.scala:3364 analog): per
         row group, eligible column chunks decode ON DEVICE from one raw
-        byte upload; remaining columns ride the host pyarrow path and
-        merge into the same DeviceBatch. Returns None when nothing in
-        the file is device-decodable (caller uses the host path)."""
+        byte upload (staged through the pinned pool; snappy pages
+        decompress in parallel on the prefetch thread pool); remaining
+        columns ride the host pyarrow path and merge into the same
+        DeviceBatch. Returns None when nothing in the file is
+        device-decodable (caller uses the host path)."""
         import pyarrow.parquet as pq
 
+        from ..columnar import dtypes as dt
         from ..columnar.column import Column, bucket_capacity
+        from ..config import PARQUET_DEVICE_SNAPPY
         from ..io.file_cache import cached_local_path
         from ..io.parquet_device import (chunk_device_plan,
                                          decode_chunk_device,
-                                         eligible_chunks)
+                                         eligible_chunks,
+                                         fallback_reasons)
+        from ..memory.host import staging_pool
         try:
             lp = cached_local_path(path, ctx.conf)
             pf = pq.ParquetFile(lp)
@@ -244,6 +269,9 @@ class ParquetScanExec(TpuExec):
         if pf.metadata.num_row_groups == 0:
             return None
         if not eligible_chunks(pf, 0, cols):
+            for name, (cat, _detail) in fallback_reasons(
+                    pf, 0, cols).items():
+                m.add(f"deviceDecodeFallback.{cat}", 1)
             return None
         kept = (prune_row_groups(pf, self.filters) if self.filters
                 else list(range(pf.metadata.num_row_groups)))
@@ -258,38 +286,74 @@ class ParquetScanExec(TpuExec):
             return None
         m.add("skippedRowGroups", pf.metadata.num_row_groups - len(kept))
         field_by_name = {f.name: f for f in self.schema.fields}
+        pool = staging_pool(ctx.conf)
+        decomp = _decompress_pool(ctx)
+        dev_snappy = ctx.conf.get(PARQUET_DEVICE_SNAPPY)
 
         import numpy as _np
         import pyarrow as _pa
 
         def gen():
+            pool0 = dict(pool.metrics)
             for rg in kept:
                 nrows = pf.metadata.row_group(rg).num_rows
                 if nrows == 0:
                     continue
                 cap = bucket_capacity(nrows)
                 elig = eligible_chunks(pf, rg, cols)
+                for name, (cat, _detail) in fallback_reasons(
+                        pf, rg, cols).items():
+                    m.add(f"deviceDecodeFallback.{cat}", 1)
                 dev_cols = {}
+                chunks = []
+                rgmd = pf.metadata.row_group(rg)
                 with m.timer("scanTime"):
                     for name, ci in list(elig.items()):
                         fld = field_by_name[name]
                         np_dt = fld.dtype.np_dtype
-                        if np_dt is None:
+                        if np_dt is None or (
+                                isinstance(fld.dtype, dt.DecimalType)
+                                and fld.dtype.is_decimal128):
+                            # decimal128 needs the two-limb buffer the
+                            # fixed-width decode does not produce
+                            m.add("deviceDecodeFallback.type", 1)
                             continue
                         af = pf.schema_arrow.field(name)
                         if (_pa.types.is_timestamp(af.type)
                                 and af.type.unit != "us"):
-                            continue     # non-micros: host path converts
-                        c = chunk_device_plan(pf, lp, rg, ci, name,
-                                              af.nullable)
-                        got = decode_chunk_device(c, cap) if c else None
-                        if got is None:
+                            # non-micros: host path converts
+                            m.add("deviceDecodeFallback.type", 1)
                             continue
-                        vals, valid = got
-                        if str(vals.dtype) != _np.dtype(np_dt).name:
-                            vals = vals.astype(np_dt)
-                        dev_cols[name] = Column(fld.dtype, nrows, vals,
-                                                valid)
+                        c = chunk_device_plan(
+                            pf, lp, rg, ci, name, af.nullable,
+                            pool=pool, decomp_pool=decomp,
+                            device_snappy=dev_snappy, metrics=m)
+                        try:
+                            got = (decode_chunk_device(c, cap,
+                                                       metrics=m)
+                                   if c else None)
+                        except Exception:
+                            got = None      # leases must not leak
+                        if got is None:
+                            if c is not None:
+                                c.close()
+                            m.add("deviceDecodeFallback.pages", 1)
+                            continue
+                        chunks.append(c)
+                        if isinstance(fld.dtype,
+                                      (dt.StringType, dt.BinaryType)):
+                            data, valid, offsets = got
+                            dev_cols[name] = Column(fld.dtype, nrows,
+                                                    data, valid,
+                                                    offsets)
+                        else:
+                            vals, valid = got
+                            if str(vals.dtype) != _np.dtype(np_dt).name:
+                                vals = vals.astype(np_dt)
+                            dev_cols[name] = Column(fld.dtype, nrows,
+                                                    vals, valid)
+                        m.add("deviceDecodeBytes", rgmd.column(ci)
+                              .total_compressed_size)
                     rest = [n for n in cols if n not in dev_cols]
                     if rest:
                         at = pf.read_row_group(rg, columns=rest)
@@ -305,10 +369,34 @@ class ParquetScanExec(TpuExec):
                         else:
                             out_cols.append(host_by_name[n])
                     tbl = Table(list(cols), out_cols)
+                # staging buffers go back to the pool only after the
+                # decode OUTPUTS are materialized: jnp.asarray can alias
+                # the host buffer zero-copy (CPU backend) and dispatch
+                # is async, so a reused lease would be overwritten while
+                # queued kernels still read it. Worker-side wait, off
+                # the compute thread.
+                if chunks:
+                    outs = [(col.data, col.validity, col.offsets)
+                            for col in dev_cols.values()
+                            if col.offsets is not None] + \
+                           [(col.data, col.validity)
+                            for col in dev_cols.values()
+                            if col.offsets is None]
+                    # tpulint: allow[block-sync] prefetch-thread join:
+                    jax.block_until_ready(outs)  # staging reuse must
+                    # not race async kernels aliasing the host buffer
+                for c in chunks:
+                    c.close()
                 m.add("numOutputRows", nrows)
                 m.add("numOutputBatches", 1)
                 m.add("deviceDecodedChunks", len(dev_cols))
                 yield DeviceBatch(tbl, num_rows=nrows)
+            for k, v in pool.metrics.items():
+                delta = v - pool0.get(k, 0)
+                if k.endswith("HeldBytes"):
+                    m.set(k, v)
+                elif delta:
+                    m.add(k, delta)
         return gen()
 
     def _decoded_batches(self, ctx, path, m):
@@ -383,11 +471,19 @@ class ParquetScanExec(TpuExec):
                 yield DeviceBatch(tbl, num_rows=at.num_rows)
             return
         from ..config import PARQUET_DEVICE_DECODE
-        if (ctx.conf.get(PARQUET_DEVICE_DECODE)
+        if (self._device_decode_on(ctx)
                 and not (self.dv and path in self.dv)):
             dev_iter = self._device_decoded_batches(ctx, path, m)
             if dev_iter is not None:
-                yield from dev_iter
+                # decompress + plan + upload staging runs on a worker
+                # thread: device compute only ever waits on the queue
+                # (prefetchWaitSecs), not on snappy or page parsing
+                nthreads = max(1,
+                               ctx.conf.get(MULTITHREADED_READ_THREADS))
+                yield from _prefetched(dev_iter,
+                                       depth=min(nthreads, 4),
+                                       wait_metrics=(m,
+                                                     "prefetchWaitSecs"))
                 return
         host_iter = self._decoded_batches(ctx, path, m)
         if reader_type == "MULTITHREADED":
@@ -496,14 +592,37 @@ def _remote_decode_parquet(path, columns, filters, batch_rows):
     return blobs, skipped
 
 
-def _prefetched(it: Iterator, depth: int):
+_DECOMP_POOL = None
+_DECOMP_LOCK = __import__("threading").Lock()
+
+
+def _decompress_pool(ctx):
+    """Shared thread pool for per-page snappy decompression in the
+    device scan (the MULTITHREADED prefetch pool): pages of one chunk
+    decompress in parallel, and the whole plan step already runs on
+    the prefetch worker — never the compute thread."""
+    global _DECOMP_POOL
+    from ..config import MULTITHREADED_READ_THREADS
+    n = max(1, ctx.conf.get(MULTITHREADED_READ_THREADS))
+    with _DECOMP_LOCK:
+        if _DECOMP_POOL is None:
+            from concurrent.futures import ThreadPoolExecutor
+            _DECOMP_POOL = ThreadPoolExecutor(
+                max_workers=n, thread_name_prefix="srtpu-decomp")
+        return _DECOMP_POOL
+
+
+def _prefetched(it: Iterator, depth: int, wait_metrics=None):
     """Run `it` on a worker thread with a bounded queue so host parquet
     decode overlaps device compute (async-IO analog, reference io/async
     ThrottlingExecutor). An abandoned consumer (e.g. under a LIMIT)
     signals the worker via a stop event and drains the queue so the
-    blocked put unblocks — no leaked threads or pinned batches."""
+    blocked put unblocks — no leaked threads or pinned batches.
+    `wait_metrics=(MetricSet, name)` records consumer block time on the
+    queue — the observable proof that decode ran ahead of compute."""
     import queue
     import threading
+    import time as _time
     q: "queue.Queue" = queue.Queue(maxsize=depth)
     DONE = object()
     stop = threading.Event()
@@ -536,7 +655,13 @@ def _prefetched(it: Iterator, depth: int):
     t.start()
     try:
         while True:
-            item = q.get()
+            if wait_metrics is not None:
+                t0 = _time.perf_counter()
+                item = q.get()
+                wait_metrics[0].add(wait_metrics[1],
+                                    _time.perf_counter() - t0)
+            else:
+                item = q.get()
             if item is DONE:
                 if err:
                     raise err[0]

@@ -131,6 +131,8 @@ class PageInfo:
     # v2 only
     num_nulls: int = 0
     def_levels_byte_length: int = -1   # -1: v1 (length-prefixed in data)
+    rep_levels_byte_length: int = 0    # v2; must be 0 for flat columns
+    data_compressed: bool = True       # v2 is_compressed flag
     data_offset: int = 0               # payload start within chunk bytes
     is_v2: bool = False
 
@@ -181,6 +183,11 @@ def parse_page_headers(chunk: bytes, total_values: int) -> List[PageInfo]:
                         info.encoding = r.i32()
                     elif f2 == 5 and t2 in (4, 5, 6):
                         info.def_levels_byte_length = r.i32()
+                    elif f2 == 6 and t2 in (4, 5, 6):
+                        info.rep_levels_byte_length = r.i32()
+                    elif f2 == 7 and t2 in (1, 2):
+                        # BOOL carries its value in the field type
+                        info.data_compressed = (t2 == 1)
                     else:
                         r._skip(t2)
             else:

@@ -20,9 +20,10 @@ single oversized buffer cannot wedge the process.
 from __future__ import annotations
 
 import threading
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional
 
-__all__ = ["HostMemoryManager", "HostBudgetExceeded", "host_manager"]
+__all__ = ["HostMemoryManager", "HostBudgetExceeded", "host_manager",
+           "PinnedStagingPool", "StagingBuffer", "staging_pool"]
 
 
 class HostBudgetExceeded(MemoryError):
@@ -99,6 +100,124 @@ class HostMemoryManager:
             self._holders = max(0, self._holders - 1)
 
 
+# ----------------------------------------------------------------------
+# Pinned staging pool (the HostAlloc pinned-pool analog)
+# ----------------------------------------------------------------------
+_STAGING_FLOOR = 64 * 1024
+
+
+def _staging_bucket(nbytes: int) -> int:
+    """Pow2 size class so buffers (and the H2D upload shapes cut from
+    them) repeat across chunks instead of compiling/allocating fresh."""
+    c = _STAGING_FLOOR
+    while c < nbytes:
+        c <<= 1
+    return c
+
+
+class StagingBuffer:
+    """One leased staging buffer: a pow2-capacity uint8 array plus the
+    caller's requested length. Return it with release() (or via the
+    pool) so the next chunk reuses the allocation."""
+
+    __slots__ = ("array", "nbytes", "_pool", "_cached")
+
+    def __init__(self, array, nbytes: int, pool: "PinnedStagingPool",
+                 cached: bool):
+        self.array = array          # np.uint8[capacity]
+        self.nbytes = int(nbytes)   # live prefix the caller asked for
+        self._pool = pool
+        self._cached = cached       # counted against the pool budget
+
+    @property
+    def capacity(self) -> int:
+        return int(self.array.shape[0])
+
+    def view(self) -> memoryview:
+        """Writable view of the live prefix (readinto target)."""
+        return memoryview(self.array)[:self.nbytes]
+
+    def release(self):
+        self._pool.release(self)
+
+
+class PinnedStagingPool:
+    """Reusable size-bucketed host staging buffers for raw-chunk H2D
+    uploads (reference: HostAlloc.scala pinned pool / PinnedMemoryPool).
+
+    The device parquet scan used to allocate a fresh host buffer per
+    column chunk (file read + snappy decompress target + upload source);
+    this pool leases pow2-bucketed uint8 arrays instead, so steady-state
+    scans stop churning the allocator and upload shapes stay constant.
+    Cached bytes are accounted against the global host budget
+    (`memory.host.limitBytes`); when the pool is full, extra leases are
+    served as transient buffers that simply drop on release."""
+
+    def __init__(self, max_bytes: int,
+                 manager: Optional[HostMemoryManager] = None):
+        self.max_bytes = int(max_bytes)
+        self._manager = manager
+        self._free: Dict[int, List] = {}     # bucket -> free arrays
+        self._held = 0                       # cached bytes (free + leased)
+        self._lock = threading.Lock()
+        self.metrics = {"stagingPoolHits": 0, "stagingPoolMisses": 0,
+                        "stagingPoolTransient": 0,
+                        "stagingPoolHeldBytes": 0}
+
+    def acquire(self, nbytes: int) -> StagingBuffer:
+        import numpy as np
+        cap = _staging_bucket(max(int(nbytes), 1))
+        with self._lock:
+            lst = self._free.get(cap)
+            if lst:
+                self.metrics["stagingPoolHits"] += 1
+                return StagingBuffer(lst.pop(), nbytes, self, True)
+            grow = self._held + cap <= self.max_bytes
+            if grow:
+                self._held += cap
+                self.metrics["stagingPoolHeldBytes"] = self._held
+                self.metrics["stagingPoolMisses"] += 1
+            else:
+                self.metrics["stagingPoolTransient"] += 1
+        if grow and self._manager is not None:
+            # cached buffers draw from the host budget like any other
+            # host-resident consumer; a refusal demotes to transient
+            if not self._manager.try_reserve(cap):
+                with self._lock:
+                    self._held -= cap
+                    self.metrics["stagingPoolHeldBytes"] = self._held
+                grow = False
+        arr = np.empty(cap, np.uint8)
+        return StagingBuffer(arr, nbytes, self, grow)
+
+    def release(self, buf: StagingBuffer):
+        if not buf._cached:
+            return                            # transient: let GC take it
+        with self._lock:
+            self._free.setdefault(buf.capacity, []).append(buf.array)
+
+    def clear(self) -> int:
+        """Drop all cached free buffers, releasing their host budget.
+        Returns bytes freed (pressure-hook shape)."""
+        with self._lock:
+            drops = [(cap, len(lst)) for cap, lst in self._free.items()]
+            freed = sum(cap * n for cap, n in drops)
+            self._free.clear()
+            self._held -= freed
+            self.metrics["stagingPoolHeldBytes"] = self._held
+        if self._manager is not None:
+            for cap, n in drops:              # one reservation per buffer
+                for _ in range(n):
+                    self._manager.release(cap)
+        return freed
+
+    @property
+    def held_bytes(self) -> int:
+        with self._lock:
+            return self._held
+
+
+_STAGING: Optional[PinnedStagingPool] = None
 _GLOBAL: Optional[HostMemoryManager] = None
 _LOCK = threading.Lock()
 
@@ -119,3 +238,18 @@ def host_manager(conf=None) -> HostMemoryManager:
             from ..config import HOST_MEMORY_LIMIT
             _GLOBAL.budget = conf.get(HOST_MEMORY_LIMIT)
         return _GLOBAL
+
+
+def staging_pool(conf=None) -> PinnedStagingPool:
+    """Process-wide pinned staging pool (sized once, by the first
+    configured caller; conf-less callers get the default cap)."""
+    global _STAGING
+    if _STAGING is None:
+        from ..config import HOST_STAGING_POOL_BYTES
+        cap = (conf.get(HOST_STAGING_POOL_BYTES) if conf is not None
+               else HOST_STAGING_POOL_BYTES.default)
+        mgr = host_manager(conf)          # takes _LOCK itself: call first
+        with _LOCK:
+            if _STAGING is None:
+                _STAGING = PinnedStagingPool(cap, mgr)
+    return _STAGING

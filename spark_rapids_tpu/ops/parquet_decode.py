@@ -25,7 +25,8 @@ import numpy as np
 from .gather import row_of_unit
 
 __all__ = ["decode_plain_fixed", "expand_hybrid", "apply_def_levels",
-           "bucket_len"]
+           "bucket_len", "byte_array_index", "rows_from_packed",
+           "dict_rows", "assemble_strings", "snappy_expand"]
 
 
 def bucket_len(n: int, floor: int = 8) -> int:
@@ -99,6 +100,125 @@ def apply_def_levels(def_levels, packed_words, max_def, total,
     vidx = jnp.cumsum(valid.astype(jnp.int32)) - 1
     words = packed_words[jnp.clip(vidx, 0, packed_words.shape[0] - 1)]
     return jnp.where(valid, words, 0), valid
+
+
+def _le32_at(chunk, pos):
+    """Little-endian uint32 read at arbitrary byte positions (gather of
+    four lanes; out-of-range positions clip and yield garbage the
+    caller masks)."""
+    nb = chunk.shape[0]
+    w = jnp.zeros(pos.shape, jnp.int32)
+    for b in range(4):
+        byte = chunk[jnp.clip(pos + b, 0, nb - 1)].astype(jnp.int32)
+        w = w | (byte << (8 * b))
+    return w
+
+
+@functools.partial(jax.jit, static_argnames=("kbits", "cap"))
+def byte_array_index(chunk, page_payload_off, page_first_val,
+                     n_pages, total, kbits: int, cap: int):
+    """Locate every PACKED value of a PLAIN BYTE_ARRAY section: returns
+    (byte_start int32[cap], byte_len int32[cap]) into `chunk`.
+
+    The [uint32 len][bytes] stream is a linked list (each length tells
+    where the next one starts), so value positions are found by pointer
+    doubling: a jump table next[b] = b + 4 + le32(b) over every byte
+    position, squared kbits times; value i applies the 2^k jump for
+    each set bit of its within-page ordinal. O(kbits) gathers instead
+    of a sequential host walk of the value stream. `kbits` must cover
+    the max per-page value count; page_first_val rows past n_pages must
+    carry the sentinel `total`."""
+    i = jnp.arange(cap, dtype=jnp.int32)
+    pg = row_of_unit(page_first_val, page_payload_off.shape[0], cap)
+    pg = jnp.minimum(pg, jnp.maximum(n_pages - 1, 0))
+    k = jnp.maximum(i - page_first_val[pg], 0)
+    pos = page_payload_off[pg]
+    nb = chunk.shape[0]
+    b = jnp.arange(nb, dtype=jnp.int32)
+    nxt = jnp.clip(b + 4 + _le32_at(chunk, b), 0, nb - 1) \
+        .astype(jnp.int32)
+    for bit in range(kbits):
+        take = ((k >> bit) & 1).astype(jnp.bool_)
+        pos = jnp.where(take, nxt[jnp.clip(pos, 0, nb - 1)], pos)
+        if bit != kbits - 1:
+            nxt = nxt[nxt]
+    live = i < total
+    lens = jnp.clip(_le32_at(chunk, pos), 0, nb)
+    return (jnp.where(live, pos + 4, 0).astype(jnp.int32),
+            jnp.where(live, lens, 0).astype(jnp.int32))
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def rows_from_packed(starts, lens, valid, total, cap: int):
+    """Map packed-stream (start, len) pairs to the ROW domain: nulls get
+    length 0, non-null row r takes packed value rank(r)."""
+    i = jnp.arange(cap, dtype=jnp.int32)
+    v = valid & (i < total)
+    vidx = jnp.clip(jnp.cumsum(v.astype(jnp.int32)) - 1, 0,
+                    starts.shape[0] - 1)
+    return starts[vidx], jnp.where(v, lens[vidx], 0)
+
+
+@functools.partial(jax.jit, static_argnames=("cap",))
+def dict_rows(idx, dstart, dlen, valid, total, cap: int):
+    """Per-row (start, len) for dictionary-encoded strings: packed
+    index stream -> row domain via validity rank, then dictionary
+    entry extents."""
+    i = jnp.arange(cap, dtype=jnp.int32)
+    v = valid & (i < total)
+    vidx = jnp.clip(jnp.cumsum(v.astype(jnp.int32)) - 1, 0,
+                    idx.shape[0] - 1)
+    rid = jnp.clip(idx[vidx], 0, dstart.shape[0] - 1)
+    return dstart[rid], jnp.where(v, dlen[rid], 0)
+
+
+@functools.partial(jax.jit, static_argnames=("cap", "dcap"))
+def assemble_strings(chunk, row_start, row_len, total, cap: int,
+                     dcap: int):
+    """Gather per-row byte ranges of `chunk` into the engine's chunked
+    string layout: (data uint8[dcap], offsets int32[cap+1]). Offsets
+    come from an exclusive prefix sum of the (null-masked) lengths;
+    bytes move via the scatter+cummax byte->row ownership map."""
+    i = jnp.arange(cap, dtype=jnp.int32)
+    row_len = jnp.where(i < total, jnp.maximum(row_len, 0), 0)
+    off = jnp.concatenate([jnp.zeros(1, jnp.int32),
+                           jnp.cumsum(row_len).astype(jnp.int32)])
+    rob = row_of_unit(off, cap, dcap)
+    pos = jnp.arange(dcap, dtype=jnp.int32)
+    src = row_start[rob] + (pos - off[rob])
+    nb = chunk.shape[0]
+    data = chunk[jnp.clip(src, 0, nb - 1)]
+    data = jnp.where(pos < off[cap], data, 0).astype(jnp.uint8)
+    return data, off
+
+
+@functools.partial(jax.jit, static_argnames=("kbits", "cap"))
+def snappy_expand(comp, el_dst, el_lit, el_src, n_el, out_len,
+                  kbits: int, cap: int):
+    """Device snappy decompression of ONE page from its host-parsed
+    element table (the nvcomp-snappy analog; conf
+    `sql.parquet.deviceSnappy`).
+
+    Each output byte first maps to its owning element (scatter+cummax).
+    Literal bytes resolve directly to a compressed-buffer position
+    (encoded as -(pos+1)); copy bytes point at an EARLIER output byte
+    (i - back_offset — overlapping copies included, since the target is
+    always strictly earlier). kbits pointer-doubling rounds
+    (src = src[src]) then resolve every byte to a literal source, and
+    one gather materializes the page. el_dst rows past n_el must carry
+    the sentinel out_len."""
+    i = jnp.arange(cap, dtype=jnp.int32)
+    eid = row_of_unit(el_dst, el_dst.shape[0], cap)
+    eid = jnp.minimum(eid, jnp.maximum(n_el - 1, 0))
+    within = i - el_dst[eid]
+    lit = el_lit[eid].astype(jnp.bool_)
+    src = jnp.where(lit, -(el_src[eid] + within) - 1, i - el_src[eid])
+    for _ in range(kbits):
+        t = jnp.clip(src, 0, cap - 1)
+        src = jnp.where(src >= 0, src[t], src)
+    nb = comp.shape[0]
+    out = comp[jnp.clip(-src - 1, 0, nb - 1)]
+    return jnp.where(i < out_len, out, 0).astype(jnp.uint8)
 
 
 def words_to_np_values(words: np.ndarray, physical: str):

@@ -67,6 +67,21 @@ def render_analyze(tree: dict, metrics_by_lore: Dict[Optional[int], dict],
             ann.append(f"shuffle={fmt_bytes(shuffle)}")
         if m.get("spillBytes"):
             ann.append(f"spill={fmt_bytes(m['spillBytes'])}")
+        if m.get("deviceDecodedChunks"):
+            ann.append(f"devDecoded={int(m['deviceDecodedChunks'])}")
+        if m.get("decompressBusySecs"):
+            ann.append(
+                f"decompress={m['decompressBusySecs'] * 1e3:.1f}ms")
+        if m.get("prefetchWaitSecs") is not None:
+            ann.append(
+                f"prefetchWait={m['prefetchWaitSecs'] * 1e3:.1f}ms")
+        # per-column device-decode fallback reasons: why this scan (or
+        # part of it) still decodes on the host — the printf-free answer
+        fb = {k.split(".", 1)[1]: int(v) for k, v in m.items()
+              if k.startswith("deviceDecodeFallback.")}
+        if fb:
+            ann.append("fallback={" + ", ".join(
+                f"{k}:{v}" for k, v in sorted(fb.items())) + "}")
         if ann:
             line += "  " + " ".join(ann)
         if lid in rank:

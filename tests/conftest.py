@@ -20,6 +20,12 @@ import pytest  # noqa: E402
 import spark_rapids_tpu as st  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy variants excluded from tier-1 (-m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def session():
     return st.TpuSession({

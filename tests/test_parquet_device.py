@@ -107,25 +107,54 @@ def test_multi_page_and_row_groups(tmp_path):
     _check(t, pf, out)
 
 
-def test_compressed_falls_back(tmp_path):
+def test_gzip_falls_back(tmp_path):
+    """Slice 2 covers snappy; other codecs still route to host, with a
+    per-column reason."""
+    from spark_rapids_tpu.io.parquet_device import fallback_reasons
     t = _mk_table(n=100)
     p = str(tmp_path / "t.parquet")
-    pq.write_table(t, p, compression="snappy")
+    pq.write_table(t, p, compression="gzip")
     pf = pq.ParquetFile(p)
     assert eligible_chunks(pf, 0, t.column_names) == {}
+    reasons = fallback_reasons(pf, 0, t.column_names)
+    assert all(cat == "codec" for cat, _ in reasons.values())
 
 
-def test_strings_not_eligible(tmp_path):
-    t = pa.table({"s": pa.array(["a", "bb", None])})
+def test_snappy_now_eligible(tmp_path):
+    """Slice 2: snappy chunks decompress on the prefetch pool and feed
+    the same device decode."""
+    t = _mk_table(n=4000, seed=9)
     p = str(tmp_path / "t.parquet")
-    pq.write_table(t, p, compression="NONE")
+    pq.write_table(t, p, compression="snappy", use_dictionary=False)
     pf = pq.ParquetFile(p)
-    assert eligible_chunks(pf, 0, ["s"]) == {}
+    assert set(eligible_chunks(pf, 0, t.column_names)) \
+        == set(t.column_names)
+    _check(t, pf, _roundtrip_file(t, pf, p))
+
+
+def _roundtrip_file(table, pf, p):
+    out = {}
+    for rg in range(pf.metadata.num_row_groups):
+        elig = eligible_chunks(pf, rg, table.column_names)
+        nrows = pf.metadata.row_group(rg).num_rows
+        cap = bucket_capacity(nrows)
+        for name, ci in elig.items():
+            nullable = pf.schema_arrow.field(name).nullable
+            c = chunk_device_plan(pf, p, rg, ci, name, nullable)
+            assert c is not None, f"plan failed for {name}"
+            got = decode_chunk_device(c, cap)
+            assert got is not None, f"decode fell back for {name}"
+            vals, valid = got
+            vals = np.asarray(vals)[:nrows]
+            valid = np.asarray(valid)[:nrows]
+            out.setdefault(name, []).append((vals, valid))
+    return out
 
 
 def test_scan_end_to_end_mixed_columns(tmp_path):
-    """Session scan: eligible columns decode on device, strings ride the
-    host path, results match pandas."""
+    """Session scan: eligible columns (strings included, slice 2)
+    decode on device, results match pandas. The conf must be set
+    explicitly: on the CPU backend the device path is opt-in."""
     import spark_rapids_tpu as st
     from spark_rapids_tpu import functions as F
 
@@ -140,7 +169,9 @@ def test_scan_end_to_end_mixed_columns(tmp_path):
     })
     p = str(tmp_path / "f.parquet")
     pq.write_table(t, p, compression="NONE", use_dictionary=False)
-    s = st.TpuSession()
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.format.parquet.deviceDecode.enabled":
+            True})
     df = (s.read.parquet(p).group_by("s")
           .agg(F.sum(F.col("a")).alias("sa"),
                F.sum(F.col("b")).alias("sb")))

@@ -313,6 +313,11 @@ def test_cli_diff_on_real_logs(tmp_path):
         q.to_arrow()
         return s.last_event_log
 
+    # warm the jit caches first: the first execution pays XLA compile
+    # INSIDE the aggregate's opTime timer (~1s), which would swamp log A
+    # and make every operator look faster in B (the seed failure mode:
+    # no operator regresses, diff comes back empty)
+    run()
     log_a = run()
     # injected slowdown: patch the aggregate's timer target
     from spark_rapids_tpu.exec import aggregate as agg_exec
