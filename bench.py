@@ -15,6 +15,9 @@ the persistent compilation cache (spark_rapids_tpu/__init__.py) makes
 subsequent processes start warm.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
+
+`--smoke` (or BENCH_SMOKE=1) is the CI profile: tiny scale factors,
+2 iterations, scan profile skipped — same JSON shape in ~a minute.
 """
 import contextlib
 import json
@@ -33,9 +36,18 @@ import numpy as np  # noqa: E402
 # its OWN deadline, shorter than any plausible runner timeout, and always
 # flushes a parseable artifact: per-query SIGALRM budgets inside the
 # sweep, per-section budgets before it, and a partial-result flush when
-# the global budget runs out mid-way.
-_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S", "780"))
-_QUERY_BUDGET_S = float(os.environ.get("BENCH_QUERY_BUDGET_S", "60"))
+# the global budget runs out mid-way. r05 showed 780s was NOT inside the
+# runner's timeout — the partial flush never won the race — so the
+# defaults now leave real headroom (600s global, 45s/query).
+#
+# --smoke (or BENCH_SMOKE=1): CI profile — tiny scale factors, 2 iters,
+# no scan profile; exercises every code path in ~a minute.
+_SMOKE = ("--smoke" in sys.argv[1:]
+          or os.environ.get("BENCH_SMOKE", "") == "1")
+_BUDGET_S = float(os.environ.get("BENCH_BUDGET_S",
+                                 "240" if _SMOKE else "600"))
+_QUERY_BUDGET_S = float(os.environ.get("BENCH_QUERY_BUDGET_S",
+                                       "20" if _SMOKE else "45"))
 _T0 = time.monotonic()
 
 # --profile: embed the per-query top-5 operator breakdown (from the
@@ -80,7 +92,7 @@ def _alarm(seconds: float, what: str):
 def _section_budget() -> float:
     """Seconds a pre-sweep section may spend: bounded per section, and
     always reserving tail budget so the sweep + final flush still run."""
-    return min(300.0, _remaining() - 120.0)
+    return min(240.0, _remaining() - 120.0)
 
 
 def _arm(what: str):
@@ -134,11 +146,14 @@ def _backend_alive():
     ruled out as the aggravator). Returns (ok, attempts)."""
     attempts = []
     for label, env, t in (
-            ("default", None, 240),
-            ("no-compile-cache", {"SRTPU_COMPILE_CACHE": "0"}, 240),
-            ("retry", None, 300)):
-        # a dead backend must not eat the whole bench budget in probes
-        t = min(t, max(30.0, _remaining() * 0.4))
+            ("default", None, 180),
+            ("no-compile-cache", {"SRTPU_COMPILE_CACHE": "0"}, 180),
+            ("retry", None, 240)):
+        # a dead backend must not eat the whole bench budget in probes:
+        # each probe gets at most a quarter of what is left, so even
+        # three dead-tunnel timeouts leave the CPU-fallback sweep and
+        # the final flush most of the budget
+        t = min(t, max(20.0, _remaining() * 0.25))
         ok, err = _probe_backend(t, env)
         if ok:
             return True, attempts
@@ -170,10 +185,12 @@ def main():
 
 
 def _main_impl():
-    sf = float(os.environ.get("BENCH_SF", "10.0"))
-    sf_agg = float(os.environ.get("BENCH_SF_AGG", "2.0"))
-    sf_join = float(os.environ.get("BENCH_SF_JOIN", "1.0"))
-    iters = int(os.environ.get("BENCH_ITERS", "5"))
+    sf = float(os.environ.get("BENCH_SF", "0.1" if _SMOKE else "10.0"))
+    sf_agg = float(os.environ.get("BENCH_SF_AGG",
+                                  "0.1" if _SMOKE else "2.0"))
+    sf_join = float(os.environ.get("BENCH_SF_JOIN",
+                                   "0.1" if _SMOKE else "1.0"))
+    iters = int(os.environ.get("BENCH_ITERS", "2" if _SMOKE else "5"))
     plat = os.environ.get("BENCH_PLATFORM")
     fellback = False
     tpu_errors = []
@@ -316,22 +333,27 @@ def _main_impl():
     # ---- full TPC-H sweep @ BENCH_SF_FULL (geomean over all 22) ---------
     # default SF1: the round-4 verdict's bar is
     # tpch_all22_vs_pandas_geomean >= 1.0 at SF >= 1
-    sf_full = float(os.environ.get("BENCH_SF_FULL", "1.0"))
+    sf_full = float(os.environ.get("BENCH_SF_FULL",
+                                   "0.05" if _SMOKE else "1.0"))
     tpch_all = _tpch_sweep(s, sf_full)
     _partial["extra"].update(tpch_all)
 
     # ---- scan profile: device-decode eligibility + time split ----------
     # (ISSUE 4 acceptance: eligibility fraction of the snappy bench
-    # dataset's column-chunk bytes, and where scan wall time goes)
-    try:
-        _arm("scan profile")
-        _partial["extra"]["scan_profile"] = _scan_profile(st, sf_full)
-        _disarm()
-    except _BenchTimeout as e:
-        _partial["extra"]["scan_profile"] = {"error": f"timeout: {e}"}
-    except Exception as e:  # advisory: never lose the bench result
-        _partial["extra"]["scan_profile"] = {"error": repr(e)[:300]}
-        print(f"bench: scan profile failed: {e!r}", file=sys.stderr)
+    # dataset's column-chunk bytes, and where scan wall time goes).
+    # Skipped under --smoke: it rewrites the whole dataset as parquet.
+    if _SMOKE:
+        _partial["extra"]["smoke"] = True
+    else:
+        try:
+            _arm("scan profile")
+            _partial["extra"]["scan_profile"] = _scan_profile(st, sf_full)
+            _disarm()
+        except _BenchTimeout as e:
+            _partial["extra"]["scan_profile"] = {"error": f"timeout: {e}"}
+        except Exception as e:  # advisory: never lose the bench result
+            _partial["extra"]["scan_profile"] = {"error": repr(e)[:300]}
+            print(f"bench: scan profile failed: {e!r}", file=sys.stderr)
 
     rows_per_s = n / tpu_q6
     extra = {
@@ -348,6 +370,11 @@ def _main_impl():
         **({"backend_fallback": "cpu (tpu unreachable)"}
            if fellback else {}),
     }
+    # milestone-only keys (scan profile, smoke flag) must survive into
+    # the success-path JSON too, not just the partial flush
+    for k in ("scan_profile", "smoke"):
+        if k in _partial["extra"]:
+            extra[k] = _partial["extra"][k]
     # ---- regression gate vs the previous round's JSON -------------------
     # Engine-time metrics only (rows/s, q*_s): the *_vs_numpy ratios mix in
     # the baseline sample and the host machine, which is exactly how the
@@ -397,9 +424,10 @@ def _tpch_sweep(s, sf: float):
         tabs = tpch.gen_all(sf=sf, seed=7)
         dfs = {k: s.create_dataframe(v).cache() for k, v in tabs.items()}
         host = to_pandas(tabs)
+    from spark_rapids_tpu.profiler import xla_stats
     reg = tpch.queries()
     engine_s, oracle_s, errors = {}, {}, {}
-    profile = {}
+    profile, xla = {}, {}
     for qn in range(1, 23):
         # per-query guard: one failing OR straggling query (unsupported
         # op on a new backend, OOM, runaway plan) must not lose the whole
@@ -416,11 +444,20 @@ def _tpch_sweep(s, sf: float):
         try:
             with _alarm(min(_QUERY_BUDGET_S, left), f"tpch q{qn}"):
                 q = reg[qn](dfs)
+                x0 = xla_stats.snapshot()
                 e_t = _best(lambda: q.to_arrow(), 2)
+                x1 = xla_stats.snapshot()
                 o_t = _best(lambda: ORACLES[qn](host), 2)
             # assign together: a failed oracle must not leave a dangling
             # engine_s entry that KeyErrors the geomean below
             engine_s[qn], oracle_s[qn] = e_t, o_t
+            # XLA activity across the query's 3 runs (warm + 2 timed):
+            # the whole-stage fusion acceptance metric — fewer programs
+            # compiled and fewer per-batch dispatches at equal results
+            xla[f"q{qn}"] = {
+                "compiles": int(x1["compiles"] - x0["compiles"]),
+                "dispatches": int(x1["dispatches"] - x0["dispatches"]),
+            }
             if _PROFILE:
                 try:
                     from spark_rapids_tpu.profiler.event_log import (
@@ -450,6 +487,8 @@ def _tpch_sweep(s, sf: float):
             "tpch_all22_per_query_ms": {
                 f"q{q}": round(v * 1e3, 1) for q, v in engine_s.items()},
         })
+    if xla:
+        out["tpch_xla_per_query"] = xla
     if profile:
         out["tpch_profile"] = profile
     if errors:

@@ -21,6 +21,38 @@ _jax.config.update("jax_enable_x64", True)
 # tunneled-TPU compiles are minutes; caching across processes turns cold
 # starts into seconds. SRTPU_COMPILE_CACHE overrides the location; set it
 # to "0" to disable.
+#
+# The cache dir is fingerprinted by backend + host CPU features +
+# jaxlib version: AOT results compiled on one machine can embed vector
+# instructions another host lacks (cpu_aot_loader feature-mismatch
+# spam, and SIGILL if a mismatched program runs anyway), so each
+# distinct feature set gets its own subdirectory. Foreign-fingerprint
+# subdirs or a legacy unfingerprinted cache log ONE structured warning
+# — never a per-program complaint.
+
+
+def _cache_fingerprint() -> str:
+    import hashlib
+    import platform
+    feats = ""
+    try:
+        with open("/proc/cpuinfo", encoding="utf-8") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):
+                    feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                    break
+    except OSError:
+        feats = platform.machine() + " " + platform.processor()
+    try:
+        import jaxlib
+        ver = getattr(jaxlib, "__version__", "?")
+    except Exception:
+        ver = "?"
+    # note: no jax.default_backend() here — that would force backend
+    # initialization at import time
+    return hashlib.sha256(f"{feats}|{ver}".encode()).hexdigest()[:12]
+
+
 _cache = _os.environ.get("SRTPU_COMPILE_CACHE")
 if _cache != "0":
     if not _cache:
@@ -28,8 +60,22 @@ if _cache != "0":
             _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))),
             ".jax_cache")
     try:
-        _os.makedirs(_cache, exist_ok=True)
-        _jax.config.update("jax_compilation_cache_dir", _cache)
+        _fp = _cache_fingerprint()
+        _sub = _os.path.join(_cache, f"host-{_fp}")
+        _legacy = [e for e in (_os.listdir(_cache)
+                               if _os.path.isdir(_cache) else [])
+                   if not _os.path.isdir(_os.path.join(_cache, e))
+                   or (e != _os.path.basename(_sub) and "-" in e)]
+        if _legacy:
+            import logging
+            logging.getLogger(__name__).warning(
+                "compile cache %s holds %d entr%s from other machine "
+                "fingerprints (or a pre-fingerprint layout); they are "
+                "ignored — this host uses %s",
+                _cache, len(_legacy), "y" if len(_legacy) == 1 else "ies",
+                _sub)
+        _os.makedirs(_sub, exist_ok=True)
+        _jax.config.update("jax_compilation_cache_dir", _sub)
         _jax.config.update("jax_persistent_cache_min_compile_time_secs",
                            0.5)
     except Exception:

@@ -85,6 +85,9 @@ class AuditReport:
         self.findings = findings
         self.tree_lines = tree_lines
         self.node_count = node_count
+        # descriptions of the whole-stage fusion groups the planner
+        # formed AFTER this audit ran (plan/fusion.py fills this in)
+        self.fusion_groups: List[str] = []
 
     def of_kind(self, kind: str) -> List[Verdict]:
         return [v for v in self.findings if v.kind == kind]
@@ -103,6 +106,9 @@ class AuditReport:
             out.extend(v.describe() for v in self.findings)
         else:
             out.append("-- no findings: plan runs fully on TPU --")
+        if self.fusion_groups:
+            out.append("-- fused stages --")
+            out.extend(self.fusion_groups)
         return out
 
     def render(self) -> str:

@@ -144,6 +144,21 @@ ALLOW_CPU_FALLBACK = _conf(
     "sql.allowCpuFallback", True,
     "Allow operators that cannot run on TPU to fall back to the host CPU "
     "path instead of failing.", bool)
+STAGE_FUSION_ENABLED = _conf(
+    "sql.exec.stageFusion.enabled", True,
+    "Whole-stage XLA fusion: at plan time, collapse maximal chains of "
+    "narrow operators (Filter, Project, limit-mask, the expression-eval "
+    "front half of aggregates, probe-side join pre-projection, sort-key "
+    "computation) into one FusedStage node compiled as a single jitted "
+    "program, eliminating per-operator dispatches and intermediate "
+    "batch materialization (the WholeStageCodegen analog). Barriers: "
+    "exchanges, host fallbacks, cached scans, and nodes the static "
+    "auditor flags recompile_risk. Per-node opt-out: set "
+    "`node.fusion_opt_out = True` on the physical node.", bool)
+STAGE_FUSION_MAX_OPS = _conf(
+    "sql.exec.stageFusion.maxOps", 16,
+    "Maximum number of member operators in one fused stage; longer "
+    "chains are split. Bounds single-program XLA compile time.", int)
 METRICS_LEVEL = _conf(
     "sql.metrics.level", "MODERATE",
     "Metric verbosity: ESSENTIAL|MODERATE|DEBUG.", str)

@@ -25,6 +25,7 @@ from ..ops import sortkeys as sk
 from ..ops.concat import concat_cvs, concat_masks, pad_cv, pad_mask
 from ..ops.gather import take, take_strings
 from ..ops.kernel_utils import CV
+from ..profiler import xla_stats
 from ..utils.transfer import fetch_int
 from .base import ExecContext, TpuExec
 from .batch import DeviceBatch
@@ -61,6 +62,10 @@ class UngroupedAggExec(TpuExec):
     (collapse_fusable) and the cross-batch merge folds in too: ONE jitted
     dispatch per batch instead of one per operator — the whole-stage-fusion
     answer to the reference's per-kernel cudf dispatch (§3.3 hot loop)."""
+
+    # the update program collapses the child chain itself; the fusion
+    # pass must not wrap that prefix in a FusedStage (plan/fusion.py)
+    fuses_child_chain = True
 
     def __init__(self, child: TpuExec, agg_names: Sequence[str],
                  bound_aggs: Sequence[AggExpr], schema: Schema):
@@ -164,7 +169,9 @@ class UngroupedAggExec(TpuExec):
             self._whole_jit = self._whole_input_program()
         args = tuple((tuple(b.cvs()), b.row_mask) for b in batches)
         with m.timer("opTime"):
-            return self._whole_jit(args)
+            out = self._whole_jit(args)
+        xla_stats.count_dispatch()
+        return out
 
     def execute_partition(self, ctx: ExecContext, pid: int):
         self._resolve_fusion()
@@ -185,6 +192,7 @@ class UngroupedAggExec(TpuExec):
                     else:
                         acc = self._update_merge_jit(acc, batch.cvs(),
                                                      batch.row_mask)
+                xla_stats.count_dispatch()
         if acc is None:
             # aggregate over empty input still yields one row (stages run
             # over all-dead base-schema columns)
@@ -194,7 +202,9 @@ class UngroupedAggExec(TpuExec):
                       if f.dtype.is_variable_width else None)
                    for f in self._base.schema.fields]
             acc = self._update_jit(cvs, jnp.zeros(128, jnp.bool_))
+            xla_stats.count_dispatch()
         outs = self._finalize_jit(acc)
+        xla_stats.count_dispatch()
         tbl = make_table(self.schema, _pad_one_row(outs), 1)
         m.add("numOutputRows", 1)
         yield DeviceBatch(tbl, 1)
@@ -316,6 +326,12 @@ class HashAggregateExec(TpuExec):
                       exchange); merge states and finalize.
     The filter chain below collapses into the first-pass program
     (collapse_fusable): one dispatch per input batch."""
+
+    # the first-pass program collapses the child chain itself (filters
+    # only: the collapse keeps column ordinals); the fusion pass leaves
+    # that prefix alone (plan/fusion.py)
+    fuses_child_chain = True
+    fusion_require_ordinals = True
 
     def __init__(self, child: TpuExec, key_names: Sequence[str],
                  bound_keys: Sequence[Expression], agg_names: Sequence[str],
@@ -923,6 +939,7 @@ class HashAggregateExec(TpuExec):
             outs, sl_c, count, overflow = fn(args)
             from ..utils.transfer import fetch
             cnt, ovf = fetch((count, overflow))
+        xla_stats.count_dispatch()
         if bool(ovf):
             self._whole_disabled = True
             return None
@@ -964,6 +981,7 @@ class HashAggregateExec(TpuExec):
                     self._update_cache[("hash", nchunks, hash_once)] = hfn
                 rep_rows, st, sl, leftover, n_live = hfn(b.cvs(),
                                                          b.row_mask)
+                xla_stats.count_dispatch()
                 from ..utils.transfer import fetch
                 lo, nl = (int(v) for v in fetch((leftover, n_live)))
                 if lo == 0:
@@ -978,6 +996,7 @@ class HashAggregateExec(TpuExec):
                 fn = jax.jit(self._update_fn(nchunks))
                 self._update_cache[nchunks] = fn
             ks, st, sl = fn(b.cvs(), b.row_mask)
+            xla_stats.count_dispatch()
             return (ks, st, sl, b.capacity)
 
         from ..config import AGG_MAX_MERGE_ROWS
@@ -1110,6 +1129,7 @@ class HashAggregateExec(TpuExec):
             m.add("numOutputBatches", 1)
             return DeviceBatch(tbl, cap, sl, cap)
         outs = self._finalize_jit(ks, st, sl)
+        xla_stats.count_dispatch()
         tbl = make_table(self.schema, outs, cap)
         m.add("numOutputBatches", 1)
         return DeviceBatch(tbl, cap, sl, cap)
@@ -1153,6 +1173,7 @@ class HashAggregateExec(TpuExec):
             fn = jax.jit(self._merge_fn(nchunks))
             self._merge_cache[nchunks] = fn
         ks2, st2, sl2 = fn(ks, st, sl)
+        xla_stats.count_dispatch()
         return self._compact_partial(ks2, st2, sl2)
 
     def _compact_partial(self, ks, st, sl):

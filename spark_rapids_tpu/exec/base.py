@@ -64,6 +64,14 @@ class ExecContext:
 class TpuExec:
     """Base physical operator."""
 
+    # whole-stage fusion hooks (plan/fusion.py): opt a node out of the
+    # fusion pass; mark operators that collapse their own child chain
+    # (collapse_fusable below) so the pass does not wrap it twice; and
+    # whether that collapse stops at column-renumbering stages
+    fusion_opt_out = False
+    fuses_child_chain = False
+    fusion_require_ordinals = False
+
     def __init__(self, children: List["TpuExec"], schema: Schema):
         self.children = children
         self._schema = schema

@@ -38,6 +38,7 @@ from ..ops import sortkeys as sk
 from ..ops.concat import concat_cvs, concat_masks
 from ..ops.kernel_utils import CV
 from ..utils.transfer import fetch_int
+from ..profiler import xla_stats
 from .base import ExecContext, TpuExec
 from .batch import DeviceBatch
 from .nodes import make_table
@@ -61,6 +62,10 @@ def _null_cvs(fields, cap):
 
 
 class HashJoinExec(TpuExec):
+    # the stream side collapses into the probe pre-stage program
+    # (_stream_batches); the fusion pass leaves that prefix alone
+    fuses_child_chain = True
+
     def __init__(self, left: TpuExec, right: TpuExec,
                  bound_left_keys: Sequence[Expression],
                  bound_right_keys: Sequence[Expression], how: str,
@@ -84,6 +89,13 @@ class HashJoinExec(TpuExec):
         self.condition = condition
         self._count_cache = {}
         self._expand_cache = {}
+        # probe-side pre-projection: the fusable stream-side chain
+        # collapses into one pre-stage program per stream batch
+        # (resolved lazily at first execute, see UngroupedAggExec)
+        self._base_left = None
+        self._lstages = None
+        self._n_fused = 0
+        self._pre_jit = None
 
     def num_partitions(self, ctx):
         if self.per_partition:
@@ -92,7 +104,37 @@ class HashJoinExec(TpuExec):
 
     def describe(self):
         mode = "distributed" if self.per_partition else "single"
-        return f"HashJoinExec[{self.how}, {mode}]"
+        fused = f", fused_stages={self._n_fused}" if self._n_fused else ""
+        return f"HashJoinExec[{self.how}, {mode}{fused}]"
+
+    def _resolve_fusion(self, ctx):
+        if self._base_left is None:
+            from ..config import STAGE_FUSION_ENABLED
+            from .base import collapse_fusable
+            if ctx.conf.get(STAGE_FUSION_ENABLED):
+                self._base_left, self._lstages, self._n_fused = \
+                    collapse_fusable(self.children[0])
+            else:
+                self._base_left, self._n_fused = self.children[0], 0
+            if self._n_fused:
+                self._pre_jit = jax.jit(self._lstages)
+
+    def _stream_batches(self, ctx, pid):
+        """Probe-side input with the fusable left chain applied as one
+        pre-stage program per batch (the probe-side pre-projection)."""
+        self._resolve_fusion(ctx)
+        base = self._base_left
+        for lpid in ([pid] if self.per_partition
+                     else range(base.num_partitions(ctx))):
+            for b in base.execute_partition(ctx, lpid):
+                if self._n_fused:
+                    cvs2, mask2 = self._pre_jit(b.cvs(), b.row_mask)
+                    xla_stats.count_dispatch()
+                    b = DeviceBatch(
+                        make_table(self.children[0].schema, cvs2,
+                                   b.num_rows),
+                        b.num_rows, mask2, b.capacity)
+                yield b
 
     # ------------------------------------------------------------------
     @staticmethod
@@ -421,7 +463,7 @@ class HashJoinExec(TpuExec):
             yield from self._execute_cross(ctx)
             return
         m = ctx.metrics_for(self._op_id)
-        left, right = self.children
+        right = self.children[1]
         build_pids = ([pid] if self.per_partition
                       else range(right.num_partitions(ctx)))
         with m.timer("buildTime"):
@@ -437,12 +479,8 @@ class HashJoinExec(TpuExec):
                 ctx, m, pid, bbatches, total_bytes, budget)
             return
 
-        def stream():
-            for lpid in ([pid] if self.per_partition
-                         else range(left.num_partitions(ctx))):
-                yield from left.execute_partition(ctx, lpid)
-
-        yield from self._join_pass(ctx, m, bbatches, stream())
+        yield from self._join_pass(ctx, m, bbatches,
+                                   self._stream_batches(ctx, pid))
 
     def _join_pass(self, ctx: ExecContext, m, bbatches, stream_batches):
         """One complete hash-join pass: concat the given build batches,
@@ -646,19 +684,13 @@ class HashJoinExec(TpuExec):
         buckets, so every join type decomposes exactly (reference:
         GpuSubPartitionHashJoin.scala:617 — 16-bucket
         repartition-and-loop)."""
-        left, _ = self.children
         S = 2
         while S < 16 and total_bytes > S * budget:
             S *= 2
         m.add("numSubPartitions", S)
 
-        def stream():
-            for lpid in ([pid] if self.per_partition
-                         else range(left.num_partitions(ctx))):
-                yield from left.execute_partition(ctx, lpid)
-
         piles_b, bytes_b, piles_s = self._split_both(
-            ctx, m, S, 0xAB5, bbatches, stream())
+            ctx, m, S, 0xAB5, bbatches, self._stream_batches(ctx, pid))
         del bbatches
         yield from self._run_buckets(ctx, m, piles_b, bytes_b, piles_s,
                                      budget, depth=1)
@@ -754,6 +786,7 @@ class HashJoinExec(TpuExec):
                 (cnt, offsets, total, bstart,
                  touched) = pfn(sorted_ukey, n_valid_b, skey_cvs[0],
                                 smask)
+                xla_stats.count_dispatch()
                 perm = bperm
                 if self.how in ("right", "full") and \
                         self.condition is None:
@@ -770,6 +803,7 @@ class HashJoinExec(TpuExec):
                     self._count_cache[ckey] = cfn
                 (cnt, offsets, total, bstart, perm,
                  matched_b) = cfn(bkey_cvs, bmask, skey_cvs, smask)
+                xla_stats.count_dispatch()
                 if self.how in ("right", "full") and \
                         self.condition is None:
                     yield ("matched_b", matched_b)
@@ -811,6 +845,7 @@ class HashJoinExec(TpuExec):
                 self._expand_cache[ekey] = efn
             lg, rg, lvalid, rvalid, _ = efn(cnt, offsets, bstart, perm,
                                             smask)
+            xla_stats.count_dispatch()
             out_cvs = self._gather_cols(scvs, lg, lvalid)
             out_cvs += self._gather_cols(bcvs, rg, rvalid)
             tbl = make_table(self.schema, out_cvs, n_out)

@@ -19,6 +19,7 @@ from ..columnar.column import Column, bucket_capacity
 from ..columnar.table import Field, Schema, Table
 from ..expr.expressions import EmitCtx, Expression
 from ..ops.kernel_utils import CV
+from ..profiler import xla_stats
 from .base import ExecContext, TpuExec
 from .batch import DeviceBatch
 
@@ -721,6 +722,7 @@ class ProjectExec(TpuExec):
         for batch in self.children[0].execute_partition(ctx, pid):
             with m.timer("opTime"):
                 out = self._jit(batch.cvs(), batch.row_mask)
+            xla_stats.count_dispatch()
             m.add("numOutputBatches", 1)
             yield DeviceBatch(make_table(self.schema, out, batch.num_rows),
                               batch.num_rows, batch.row_mask, batch.capacity)
@@ -753,18 +755,32 @@ class FilterExec(TpuExec):
         for batch in self.children[0].execute_partition(ctx, pid):
             with m.timer("opTime"):
                 new_mask = self._jit(batch.cvs(), batch.row_mask)
+            xla_stats.count_dispatch()
             m.add("numOutputBatches", 1)
             yield DeviceBatch(batch.table, batch.num_rows, new_mask,
                               batch.capacity)
 
 
 class LimitExec(TpuExec):
-    """Global limit; collapses to a single output partition."""
+    """Global limit; collapses to a single output partition.
+
+    The limit itself is stateful across batches (`remaining` lives on
+    the host), so it can never be a FusedStage member — instead it
+    collapses its own fusable child chain into the clip program
+    (collapse_fusable): stages + rank-clip run as one dispatch per
+    batch."""
+
+    fuses_child_chain = True
 
     def __init__(self, child: TpuExec, n: int):
         super().__init__([child], child.schema)
         self.n = n
         self._ncap = bucket_capacity(max(n, 1))
+        # resolved lazily at first execute (children may be wrapped by
+        # LORE dump pass-throughs after planning)
+        self._base = None
+        self._stages = None
+        self._n_fused = 0
 
         def _clip(mask, remaining):
             ranks = jnp.cumsum(mask.astype(jnp.int64))
@@ -772,6 +788,13 @@ class LimitExec(TpuExec):
             return new_mask, jnp.sum(new_mask.astype(jnp.int64))
 
         self._jit = jax.jit(_clip)
+
+        def _clip_fused(cvs, mask, remaining):
+            cvs, mask = self._stages(cvs, mask)
+            new_mask, took = _clip(mask, remaining)
+            return cvs, new_mask, took
+
+        self._fused_jit = jax.jit(_clip_fused)
         ncap = self._ncap
 
         def _perm(mask):
@@ -781,19 +804,42 @@ class LimitExec(TpuExec):
 
         self._perm = jax.jit(_perm)
 
+    def _resolve_fusion(self, ctx):
+        if self._base is None:
+            from ..config import STAGE_FUSION_ENABLED
+            from .base import collapse_fusable
+            if ctx.conf.get(STAGE_FUSION_ENABLED):
+                self._base, self._stages, self._n_fused = collapse_fusable(
+                    self.children[0])
+            else:
+                self._base, self._n_fused = self.children[0], 0
+                self._stages = lambda cvs, mask: (cvs, mask)
+
+    def describe(self):
+        fused = f", fused_stages={self._n_fused}" if self._n_fused else ""
+        return f"LimitExec[{self.n}{fused}]"
+
     def num_partitions(self, ctx):
         return 1
 
     def execute_partition(self, ctx, pid):
+        self._resolve_fusion(ctx)
         remaining = self.n
-        child = self.children[0]
+        child = self._base
         for cpid in range(child.num_partitions(ctx)):
             if remaining <= 0:
                 return
             for batch in child.execute_partition(ctx, cpid):
                 if remaining <= 0:
                     return
-                mask, took = self._jit(batch.row_mask, remaining)
+                if self._n_fused:
+                    cvs, mask, took = self._fused_jit(
+                        batch.cvs(), batch.row_mask, remaining)
+                    tbl = None
+                else:
+                    cvs, tbl = batch.cvs(), batch.table
+                    mask, took = self._jit(batch.row_mask, remaining)
+                xla_stats.count_dispatch()
                 took = int(took)
                 if took == 0:
                     continue
@@ -804,11 +850,13 @@ class LimitExec(TpuExec):
                     # O(n) bytes, not the full sorted input
                     from ..ops.gather import gather_cols
                     idx, inb = self._perm(mask)
-                    cvs = gather_cols(batch.cvs(), idx, inb)
-                    tbl = make_table(self.schema, cvs, took)
-                    yield DeviceBatch(tbl, took, inb, self._ncap)
+                    out = gather_cols(cvs, idx, inb)
+                    yield DeviceBatch(make_table(self.schema, out, took),
+                                      took, inb, self._ncap)
                 else:
-                    yield DeviceBatch(batch.table, batch.num_rows, mask,
+                    if tbl is None:
+                        tbl = make_table(self.schema, cvs, batch.num_rows)
+                    yield DeviceBatch(tbl, batch.num_rows, mask,
                                       batch.capacity)
 
 

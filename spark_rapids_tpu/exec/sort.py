@@ -22,6 +22,7 @@ from ..ops import sortkeys as sk
 from ..ops.concat import concat_cvs, concat_masks
 from ..ops.gather import take
 from ..ops.kernel_utils import CV
+from ..profiler import xla_stats
 from ..utils.transfer import fetch_int
 from .base import ExecContext, TpuExec
 from .batch import DeviceBatch
@@ -62,16 +63,39 @@ class SortExec(TpuExec):
     order — bounded device memory (reference: GpuSortExec.scala:44
     out-of-core mode, redesigned around the exchange)."""
 
+    # the collect loop applies the fusable child chain as one pre-stage
+    # program per batch (sort keys themselves already emit inside the
+    # sort program); the fusion pass leaves the prefix alone
+    fuses_child_chain = True
+
     def __init__(self, child: TpuExec, bound_orders, schema: Schema):
         super().__init__([child], schema)
         self.orders = list(bound_orders)
         self._jit_cache = {}
+        # resolved lazily at first execute (see UngroupedAggExec)
+        self._base = None
+        self._stages = None
+        self._n_fused = 0
+        self._pre_jit = None
+
+    def _resolve_fusion(self, ctx):
+        if self._base is None:
+            from ..config import STAGE_FUSION_ENABLED
+            from .base import collapse_fusable
+            if ctx.conf.get(STAGE_FUSION_ENABLED):
+                self._base, self._stages, self._n_fused = collapse_fusable(
+                    self.children[0])
+            else:
+                self._base, self._n_fused = self.children[0], 0
+            if self._n_fused:
+                self._pre_jit = jax.jit(self._stages)
 
     def num_partitions(self, ctx):
         return 1
 
     def describe(self):
-        return f"SortExec[{self.orders}]"
+        fused = f", fused_stages={self._n_fused}" if self._n_fused else ""
+        return f"SortExec[{self.orders}{fused}]"
 
     def _nchunks(self, cvs, mask) -> Tuple[int, ...]:
         ncs = []
@@ -108,6 +132,7 @@ class SortExec(TpuExec):
                              sort_batch_cvs(c, mk, self.orders, _nc))
                 self._jit_cache[nchunks] = fn
             out, out_mask = fn(cvs, mask)
+        xla_stats.count_dispatch()
         cap = out_mask.shape[0]
         m.add("numOutputBatches", 1)
         return DeviceBatch(make_table(self.schema, out, cap), cap,
@@ -120,8 +145,9 @@ class SortExec(TpuExec):
         handles + per-partition sorts, reference GpuSortExec.scala:44)."""
         from ..config import SORT_OOC_THRESHOLD
         from ..memory.spill import spill_store
+        self._resolve_fusion(ctx)
         m = ctx.metrics_for(self._op_id)
-        child = self.children[0]
+        child = self._base
         store = spill_store(ctx.conf)
         handles = []
         total = 0
@@ -130,7 +156,14 @@ class SortExec(TpuExec):
             from .batch import maybe_compact
             for cpid in range(child.num_partitions(ctx)):
                 for batch in child.execute_partition(ctx, cpid):
-                    batch = maybe_compact(batch, child.schema)
+                    if self._n_fused:
+                        cvs2, mask2 = self._pre_jit(batch.cvs(),
+                                                    batch.row_mask)
+                        xla_stats.count_dispatch()
+                        batch = DeviceBatch(
+                            make_table(self.schema, cvs2, batch.num_rows),
+                            batch.num_rows, mask2, batch.capacity)
+                    batch = maybe_compact(batch, self.schema)
                     handles.append(retry_no_split(
                         lambda b=batch: store.add_batch(b)))
                     total += batch.nbytes

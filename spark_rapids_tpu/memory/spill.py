@@ -28,6 +28,48 @@ __all__ = ["SpillStore", "SpillableBatchHandle", "spill_store"]
 DEVICE, HOST, DISK = "device", "host", "disk"
 
 
+def _write_spill_file(path: str, flat: Dict[str, np.ndarray], pool) -> None:
+    """Spill file format: one JSON header line ({key: {dtype, shape}})
+    followed by each array's raw C-order bytes in header order. Bytes
+    are staged through the PinnedStagingPool so steady-state spilling
+    reuses the same pow2 host buffers as the scan path instead of
+    churning fresh allocations per handle; without a pool (conf-less
+    store) arrays write directly."""
+    import json
+    header = {k: {"dtype": str(a.dtype), "shape": list(a.shape)}
+              for k, a in flat.items()}
+    with open(path, "wb") as f:
+        f.write((json.dumps(header) + "\n").encode("utf-8"))
+        for k in header:
+            raw = np.ascontiguousarray(flat[k])
+            n = raw.nbytes
+            if n == 0:
+                continue
+            if pool is None:
+                raw.tofile(f)
+                continue
+            lease = pool.acquire(n)
+            try:
+                dst = np.frombuffer(lease.view(), np.uint8)
+                dst[:] = raw.reshape(-1).view(np.uint8)
+                f.write(lease.view())
+            finally:
+                lease.release()
+
+
+def _read_spill_file(path: str) -> Dict[str, np.ndarray]:
+    import json
+    flat: Dict[str, np.ndarray] = {}
+    with open(path, "rb") as f:
+        header = json.loads(f.readline().decode("utf-8"))
+        for k, meta in header.items():
+            dtype = np.dtype(meta["dtype"])
+            count = int(np.prod(meta["shape"], dtype=np.int64))
+            a = np.fromfile(f, dtype=dtype, count=count)
+            flat[k] = a.reshape(meta["shape"])
+    return flat
+
+
 class SpillableBatchHandle:
     """One spillable columnar batch. Not thread-safe per handle; the store
     lock serializes spills."""
@@ -91,13 +133,14 @@ class SpillableBatchHandle:
             return 0
         self._release_host()
         os.makedirs(spill_dir, exist_ok=True)
-        path = os.path.join(spill_dir, f"spill-{self.id}.npz")
+        path = os.path.join(spill_dir, f"spill-{self.id}.bin")
         flat = {}
         for i, bufs in enumerate(self._host["cols"]):
             flatten_bufs(bufs, f"c{i}_", flat)
         # tpulint: allow[host-sync] _host tier is already on the host
         flat["mask"] = np.asarray(self._host["mask"])
-        np.savez(path, **flat)
+        _write_spill_file(path, flat,
+                          getattr(self.store, "staging", None))
         self._disk_path = path
         self._host = None
         self.state = DISK
@@ -113,11 +156,11 @@ class SpillableBatchHandle:
             if self.state == DEVICE:
                 return self._batch
             if self.state == DISK:
-                data = np.load(self._disk_path)
+                data = _read_spill_file(self._disk_path)
                 schema, names, num_rows, capacity = self._meta
                 cols = []
                 for i in range(len(names)):
-                    flat = {k.split("_", 1)[1]: data[k] for k in data.files
+                    flat = {k.split("_", 1)[1]: data[k] for k in data
                             if k.startswith(f"c{i}_")}
                     cols.append(unflatten_bufs(flat))
                 self._host = {"cols": cols, "mask": data["mask"]}
@@ -162,11 +205,13 @@ class SpillStore:
 
     def __init__(self, dm: Optional[DeviceManager] = None,
                  spill_dir: str = "/tmp/srtpu-spill",
-                 host_limit: int = 32 << 30, host_mgr=None):
+                 host_limit: int = 32 << 30, host_mgr=None,
+                 staging=None):
         self.dm = dm or device_manager()
         self.spill_dir = spill_dir
         self.host_limit = host_limit
         self.host_mgr = host_mgr
+        self.staging = staging    # PinnedStagingPool for disk-write I/O
         self._lock = threading.RLock()
         self._handles: Dict[str, SpillableBatchHandle] = {}
         self.dm.register_spill_hook(self.spill)
@@ -249,9 +294,10 @@ def spill_store(conf=None) -> SpillStore:
             kw = {}
             if conf is not None:
                 from ..config import HOST_SPILL_LIMIT, SPILL_DIR
-                from .host import host_manager
+                from .host import host_manager, staging_pool
                 kw = {"spill_dir": conf.get(SPILL_DIR),
                       "host_limit": conf.get(HOST_SPILL_LIMIT),
-                      "host_mgr": host_manager(conf)}
+                      "host_mgr": host_manager(conf),
+                      "staging": staging_pool(conf)}
             _STORE = SpillStore(device_manager(conf), **kw)
         return _STORE

@@ -1,0 +1,113 @@
+"""Plan-time whole-stage fusion pass.
+
+Runs after optimizer/CBO rewrites, conversion, lore-id assignment and
+the static audit: greedily groups maximal chains of fusible narrow
+operators (TpuExec.fusable_stage is non-None) into FusedStageExec
+(exec/fused.py) — one jitted program per stage instead of one per
+operator.
+
+Fusion barriers (a chain never crosses them):
+  * any operator without a pure batch transform (exchanges, shuffles,
+    scans, host fallbacks, python exec, aggregates, joins, sorts —
+    their fusable_stage() is None);
+  * CachedScanExec bases: fusing over the HBM batch cache would break
+    the aggregates' cached whole-input fast path and make buffer
+    donation unsafe, so cached chains are left to the consuming
+    operators' own collapse;
+  * nodes the static auditor flagged `recompile_risk` — fusing them
+    would multiply every recompile across the whole stage program;
+  * per-node opt-out: `node.fusion_opt_out = True`.
+
+Operators that already collapse their child chain into their own
+program (aggregate update, limit clip, sort collect, join probe
+pre-stage) declare `fuses_child_chain = True`; the pass leaves exactly
+the prefix they will consume unfused so the same work is not wrapped
+twice.
+"""
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from ..analysis.audit import RECOMPILE_RISK
+from ..exec.base import TpuExec
+from ..exec.fused import FusedStageExec
+from ..exec.nodes import CachedScanExec
+
+__all__ = ["fuse_stages"]
+
+
+def _max_lore(root: TpuExec) -> int:
+    best = [0]
+
+    def walk(n):
+        lid = getattr(n, "lore_id", None)
+        if isinstance(lid, int):
+            best[0] = max(best[0], abs(lid))
+        for m in getattr(n, "members", []) or []:
+            walk(m)
+        for c in n.children:
+            walk(c)
+
+    walk(root)
+    return best[0]
+
+
+def fuse_stages(root: TpuExec, conf,
+                report=None) -> Tuple[TpuExec, List[str]]:
+    """Rewrite `root`, grouping fusable chains into FusedStageExec.
+    Returns (new_root, group_lines) where group_lines describe each
+    group for explain("VALIDATE") / the plan_audit event."""
+    from ..config import STAGE_FUSION_ENABLED, STAGE_FUSION_MAX_OPS
+    if not conf.get(STAGE_FUSION_ENABLED):
+        return root, []
+    max_ops = max(2, int(conf.get(STAGE_FUSION_MAX_OPS)))
+    risky = set()
+    if report is not None:
+        risky = {v.lore_id for v in report.of_kind(RECOMPILE_RISK)
+                 if v.lore_id is not None}
+
+    groups: List[FusedStageExec] = []
+
+    def fusable(n: TpuExec) -> bool:
+        return (len(n.children) == 1
+                and not isinstance(n, FusedStageExec)
+                and n.fusable_stage() is not None
+                and not getattr(n, "fusion_opt_out", False)
+                and getattr(n, "lore_id", None) not in risky)
+
+    def walk(node: TpuExec) -> TpuExec:
+        chain, cur = [], node
+        while len(chain) < max_ops and fusable(cur):
+            chain.append(cur)
+            cur = cur.children[0]
+        if len(chain) >= 2 and not isinstance(cur, CachedScanExec):
+            fused = FusedStageExec(chain, walk(cur))
+            groups.append(fused)
+            return fused
+        recurse(node)
+        return node
+
+    def recurse(node: TpuExec) -> None:
+        if getattr(node, "fuses_child_chain", False) and node.children:
+            # skip the prefix the operator collapses itself
+            # (collapse_fusable in exec/base.py) so it is not fused twice
+            ro = getattr(node, "fusion_require_ordinals", False)
+            parent, cur = node, node.children[0]
+            while (cur.children
+                   and cur.fusable_stage() is not None
+                   and not (ro and not cur.preserves_ordinals())):
+                parent, cur = cur, cur.children[0]
+            parent.children[0] = walk(cur)
+            for i in range(1, len(node.children)):
+                node.children[i] = walk(node.children[i])
+        else:
+            node.children = [walk(c) for c in node.children]
+
+    new_root = walk(root)
+    next_id = _max_lore(new_root)
+    lines = []
+    for g in groups:
+        next_id += 1
+        g.lore_id = next_id
+        lines.append(g.describe())
+    return new_root, lines

@@ -610,6 +610,13 @@ class Planner:
         report = audit_plan(meta, self.conf)
         self.last_audit = report
         if root_exec is not None:
+            # whole-stage fusion pass (plan/fusion.py): runs after the
+            # audit because recompile_risk lore ids are fusion barriers,
+            # and before explain so VALIDATE can render the groups
+            from .fusion import fuse_stages
+            root_exec, fusion_groups = fuse_stages(root_exec, self.conf,
+                                                   report)
+            report.fusion_groups = fusion_groups
             # ride the physical root so the profiler wrapper can emit
             # the plan_audit event without re-walking
             root_exec.audit_report = report

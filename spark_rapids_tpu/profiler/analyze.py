@@ -82,6 +82,18 @@ def render_analyze(tree: dict, metrics_by_lore: Dict[Optional[int], dict],
         if fb:
             ann.append("fallback={" + ", ".join(
                 f"{k}:{v}" for k, v in sorted(fb.items())) + "}")
+        # FusedStage member counters: post-stage live rows per fused
+        # child (the per-member selectivity view; members are not plan
+        # children, so their rows render on the fused node)
+        fr = {k.split(".", 1)[1]: int(v) for k, v in m.items()
+              if k.startswith("fusedRows.")}
+        if fr:
+            ann.append("memberRows={" + ", ".join(
+                f"{k}:{v}" for k, v in sorted(fr.items())) + "}")
+        if m.get("xlaCompiles") is not None:
+            ann.append(f"xlaCompiles={int(m['xlaCompiles'])}")
+        if m.get("xlaDispatches") is not None:
+            ann.append(f"xlaDispatches={int(m['xlaDispatches'])}")
         if ann:
             line += "  " + " ".join(ann)
         if lid in rank:

@@ -12,7 +12,8 @@ typed events the profiling tool post-processes:
   fetch_retry   {stage, pid, shuffle_id}         (distributed runner)
   op_metrics    {ops: [{lore_id, name, describe, metrics}], stage?}
   watermarks    {devicePeakBytes, hostPeakBytes, spill?, hostPressure?}
-  xla_compile   {compiles, compile_secs, cache_hits, cache_misses}
+  xla_compile   {compiles, compile_secs, cache_hits, cache_misses,
+                 dispatches}
   query_end     {status, wall_s, error?}
 
 Locally `session.py` wraps every action (`profile_query`); the

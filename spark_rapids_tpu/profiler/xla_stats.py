@@ -18,12 +18,20 @@ from __future__ import annotations
 import threading
 from typing import Dict
 
-__all__ = ["install", "snapshot"]
+__all__ = ["install", "snapshot", "count_dispatch"]
 
 _lock = threading.Lock()
 _stats = {"compiles": 0, "compile_secs": 0.0,
-          "cache_hits": 0, "cache_misses": 0}
+          "cache_hits": 0, "cache_misses": 0, "dispatches": 0}
 _installed = False
+
+
+def count_dispatch(n: int = 1) -> None:
+    """Record `n` executable dispatches. jax.monitoring has no dispatch
+    event, so per-batch jit call sites in the exec layer call this
+    explicitly; snapshot() diffs then expose per-query xlaDispatches."""
+    with _lock:
+        _stats["dispatches"] += n
 
 
 def install():

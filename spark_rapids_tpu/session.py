@@ -701,13 +701,22 @@ class DataFrame:
         op_metrics/watermarks/xla_compile/query_end events, and the
         DataFrame keeps the physical root + metric snapshots for
         last_metrics() / explain("ANALYZE")."""
+        from .profiler import xla_stats
         from .profiler.event_log import profile_query
         root, ctx = self._execute()
+        xla0 = xla_stats.snapshot()
         with profile_query(self._session, root, ctx, action):
             try:
                 out = body(root, ctx)
             finally:
                 ctx.close()
+        # per-query XLA accounting rides the root node's MetricSet so it
+        # flows into last_metrics() / EXPLAIN ANALYZE / op_metrics events
+        xla1 = xla_stats.snapshot()
+        rm = ctx.metrics_for(root._op_id)
+        rm.add("xlaCompiles", int(xla1["compiles"] - xla0["compiles"]))
+        rm.add("xlaDispatches",
+               int(xla1["dispatches"] - xla0["dispatches"]))
         self._last_root = root
         self._last_metrics = {op: ms.snapshot(ctx.metrics_level)
                               for op, ms in ctx.metrics.items()}

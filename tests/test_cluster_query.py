@@ -51,7 +51,9 @@ def _rows(at):
             for j in range(at.num_rows)]
 
 
-@pytest.mark.parametrize("mesh_devices", [0, 4])
+@pytest.mark.parametrize(
+    "mesh_devices",
+    [0, pytest.param(4, marks=pytest.mark.slow)])  # mesh variant ~21s
 def test_distributed_q3(tmp_path, mesh_devices):
     splits, tables = _write_splits(tmp_path, n_splits=3)
     want = _rows(_local_q3(tables))

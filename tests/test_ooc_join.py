@@ -46,8 +46,11 @@ def _run(ldata, rdata, how, extra_conf):
 _OOC = {"spark.rapids.tpu.sql.join.buildSideBudgetBytes": 16 << 10}
 
 
-@pytest.mark.parametrize("how", ["inner", "left", "right", "full",
-                                 "left_semi", "left_anti"])
+@pytest.mark.parametrize(
+    "how", ["inner", "left", "right",
+            pytest.param("full", marks=pytest.mark.slow),  # ~16s; the
+            # other five join types keep tier-1 coverage of this path
+            "left_semi", "left_anti"])
 def test_subpartition_join_matches(how):
     ldata, rdata = _mk(4000, 3000, seed=5)
     got = _run(ldata, rdata, how, _OOC)

@@ -10,6 +10,7 @@
 """
 import numpy as np
 import pyarrow as pa
+import pytest
 
 import spark_rapids_tpu as st
 import spark_rapids_tpu.functions as F
@@ -23,6 +24,7 @@ def _metric(df, exec_name, key):
     return total
 
 
+@pytest.mark.slow  # ~32s: the single biggest tier-1 wall-clock sink
 def test_agg_bucket_recursion_two_levels():
     """maxMergeRows=256 with ~10k groups forces K=16 at depth 0 and a
     second split inside oversized buckets; results stay exact."""

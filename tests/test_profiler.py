@@ -157,11 +157,14 @@ def test_explain_all_carries_lore_ids(tmp_path, capsys):
     capsys.readouterr()
     assert "[loreId=1]" in text
     # lore ids in explain match the ids EXPLAIN ANALYZE reports, so a
-    # hot operator maps directly to a lore.idsToDump replay id
+    # hot operator maps directly to a lore.idsToDump replay id; ids of
+    # operators fused into a FusedStage survive as `Name[id]` members
+    # of the fused node's line
     analyzed = q.explain("ANALYZE")
     import re
     ids_plain = set(re.findall(r"loreId=(\d+)", text))
     ids_analyzed = set(re.findall(r"loreId=(\d+)", analyzed))
+    ids_analyzed |= set(re.findall(r"\w+\[(\d+)\]", analyzed))
     assert ids_plain and ids_plain <= ids_analyzed
 
 
@@ -316,7 +319,11 @@ def test_cli_diff_on_real_logs(tmp_path):
     # warm the jit caches first: the first execution pays XLA compile
     # INSIDE the aggregate's opTime timer (~1s), which would swamp log A
     # and make every operator look faster in B (the seed failure mode:
-    # no operator regresses, diff comes back empty)
+    # no operator regresses, diff comes back empty). Two warm-ups: the
+    # second also drains one-shot global-state work (e.g. spill-store
+    # pressure hooks left registered by earlier test modules) that would
+    # otherwise inflate log A by tens of ms.
+    run()
     run()
     log_a = run()
     # injected slowdown: patch the aggregate's timer target
@@ -326,7 +333,7 @@ def test_cli_diff_on_real_logs(tmp_path):
     def slow(self, ctx, pid):
         m = ctx.metrics_for(self._op_id)
         with m.timer("opTime"):
-            _t.sleep(0.05)
+            _t.sleep(0.25)
         return orig(self, ctx, pid)
 
     agg_exec.HashAggregateExec.execute_partition = slow
@@ -338,7 +345,7 @@ def test_cli_diff_on_real_logs(tmp_path):
                                      profile_report.load_events(log_b))
     regressed = [r for r in ranked if r["delta_s"] > 0]
     assert regressed[0]["name"] == "HashAggregateExec"
-    assert regressed[0]["delta_s"] >= 0.04
+    assert regressed[0]["delta_s"] >= 0.2
 
 
 # ----------------------------------------------------------------------
