@@ -10,8 +10,14 @@ single-core numpy join baseline's runtime).
 
 Hot runs use HBM-cached columnar tables (GpuInMemoryTableScan analog) so
 the engine — not the host<->device tunnel — is measured; the cold run
-measures the full parquet->result path. First-ever run pays XLA compiles;
-the persistent compilation cache (spark_rapids_tpu/__init__.py) makes
+measures the full parquet->result path. Headline timings are FRESH
+executions: a new query tree is built (and re-planned) per timed
+iteration, so resident operator state cannot flatter the numbers; the
+old same-object reruns are reported as *_resident_replay_* for
+comparison. First-ever shapes pay XLA compiles once per process — the
+process-global program cache (runtime/program_cache.py) makes every
+later same-shaped query, fresh or not, compile-free — and the
+persistent compilation cache (spark_rapids_tpu/__init__.py) makes
 subsequent processes start warm.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
@@ -120,6 +126,23 @@ def _best(fn, iters):
     for _ in range(max(iters, 1)):
         t0 = time.perf_counter()
         fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def _best_fresh(build, iters):
+    """Honest engine timing: `build()` returns a NEW DataFrame tree each
+    iteration, so every timed run re-plans and re-executes from scratch
+    (planning + program-cache lookups included) instead of replaying a
+    resident physical plan's device state. The first build warms the
+    process-global program cache — XLA compiles are a process cost, not
+    a per-query cost — and is untimed."""
+    build().to_arrow()  # warm: first-ever shapes pay their XLA compiles
+    best = float("inf")
+    for _ in range(max(iters, 1)):
+        q = build()
+        t0 = time.perf_counter()
+        q.to_arrow()
         best = min(best, time.perf_counter() - t0)
     return best
 
@@ -238,12 +261,19 @@ def _main_impl():
     got = r.column(0).to_pylist()[0]
     expect = decimal.Decimal(base_q6_val).scaleb(-4)
     assert got == expect, f"Q6 mismatch: {got} != {expect}"
-    tpu_q6 = _best(lambda: q.to_arrow(), iters)
+    # headline: FRESH execution — a new query tree per timed iteration
+    # (the cached input table stays; that is the GpuInMemoryTableScan
+    # analog, not resident operator state). Same-object replay is the
+    # old optimistic number, reported separately as resident_replay.
+    tpu_q6 = _best_fresh(lambda: tpch.q6(df), iters)
+    tpu_q6_replay = _best(lambda: q.to_arrow(), iters)
     _disarm()
     _partial.update({"metric": f"tpch_q6_sf{sf}_rows_per_sec",
                      "value": round(n / tpu_q6, 1),
                      "vs_baseline": round(cpu_q6 / tpu_q6, 3)})
-    _partial["extra"]["q6_hot_ms"] = round(tpu_q6 * 1e3, 2)
+    _partial["extra"]["q6_fresh_ms"] = round(tpu_q6 * 1e3, 2)
+    _partial["extra"]["q6_resident_replay_ms"] = round(
+        tpu_q6_replay * 1e3, 2)
 
     # ---- cold Q6 (parquet -> result, same SF) ---------------------------
     import shutil
@@ -268,6 +298,18 @@ def _main_impl():
         shutil.rmtree(pq_dir, ignore_errors=True)
     _disarm()
     _partial["extra"]["q6_cold_s"] = round(tpu_q6_cold, 3)
+    # smoke gate: a FRESH rerun of an already-seen shape must compile
+    # nothing — the process-global program cache's core guarantee
+    if _SMOKE:
+        from spark_rapids_tpu.profiler import xla_stats
+        x0 = xla_stats.snapshot()
+        tpch.q6(df).to_arrow()
+        x1 = xla_stats.snapshot()
+        fresh_compiles = int(x1["compiles"] - x0["compiles"])
+        _partial["extra"]["fresh_rerun_compiles"] = fresh_compiles
+        assert fresh_compiles == 0, (
+            f"fresh rerun of q6 compiled {fresh_compiles} XLA programs; "
+            f"the program cache must make it zero")
     del df, q
     if sf != sf_agg:
         del at, ship, qty, price, disc
@@ -293,9 +335,12 @@ def _main_impl():
     df1 = s.create_dataframe(at1).cache()
     q1 = tpch.q1(df1)
     q1.to_arrow()
-    tpu_q1 = _best(lambda: q1.to_arrow(), min(iters, 3))
+    tpu_q1 = _best_fresh(lambda: tpch.q1(df1), min(iters, 3))
+    tpu_q1_replay = _best(lambda: q1.to_arrow(), min(iters, 3))
     _disarm()
     _partial["extra"]["q1_rows_per_sec"] = round(n1 / tpu_q1, 1)
+    _partial["extra"]["q1_resident_replay_ms"] = round(
+        tpu_q1_replay * 1e3, 2)
     del df1, q1
 
     # ---- Q3 @ BENCH_SF_JOIN --------------------------------------------
@@ -326,9 +371,11 @@ def _main_impl():
     ord_df = s.create_dataframe(orders).cache()
     q3 = tpch.q3(cust_df, ord_df, df3)
     q3.to_arrow()
-    tpu_q3 = _best(lambda: q3.to_arrow(), 2)
+    tpu_q3 = _best_fresh(lambda: tpch.q3(cust_df, ord_df, df3), 2)
+    tpu_q3_replay = _best(lambda: q3.to_arrow(), 2)
     _disarm()
     _partial["extra"]["q3_s"] = round(tpu_q3, 3)
+    _partial["extra"]["q3_resident_replay_s"] = round(tpu_q3_replay, 3)
 
     # ---- full TPC-H sweep @ BENCH_SF_FULL (geomean over all 22) ---------
     # default SF1: the round-4 verdict's bar is
@@ -356,23 +403,37 @@ def _main_impl():
             print(f"bench: scan profile failed: {e!r}", file=sys.stderr)
 
     rows_per_s = n / tpu_q6
+    from spark_rapids_tpu.runtime import program_cache
+    pc = program_cache.stats()
     extra = {
-        "q6_hot_ms": round(tpu_q6 * 1e3, 2),
+        # every headline number below times a FRESH query tree per
+        # iteration (new DataFrame, re-planned); *_resident_replay_* are
+        # the old same-object reruns, kept for comparison only
+        "methodology": "fresh",
+        "q6_fresh_ms": round(tpu_q6 * 1e3, 2),
+        "q6_resident_replay_ms": round(tpu_q6_replay * 1e3, 2),
         "q6_cold_s": round(tpu_q6_cold, 3),
         "q6_cold_rows_per_sec": round(n / tpu_q6_cold, 1),
         "q1_sf": sf_agg,
         "q1_rows_per_sec": round(n1 / tpu_q1, 1),
+        "q1_resident_replay_ms": round(tpu_q1_replay * 1e3, 2),
         "q1_vs_numpy": round(cpu_q1 / tpu_q1, 3),
         "q3_sf": sf_join,
         "q3_s": round(tpu_q3, 3),
+        "q3_resident_replay_s": round(tpu_q3_replay, 3),
         "q3_vs_numpy": round(cpu_q3 / tpu_q3, 3),
+        "program_cache": {
+            "hits": int(pc.get("program_cache_hits", 0)),
+            "misses": int(pc.get("program_cache_misses", 0)),
+            "evictions": int(pc.get("program_cache_evictions", 0)),
+        },
         **tpch_all,
         **({"backend_fallback": "cpu (tpu unreachable)"}
            if fellback else {}),
     }
     # milestone-only keys (scan profile, smoke flag) must survive into
     # the success-path JSON too, not just the partial flush
-    for k in ("scan_profile", "smoke"):
+    for k in ("scan_profile", "smoke", "fresh_rerun_compiles"):
         if k in _partial["extra"]:
             extra[k] = _partial["extra"][k]
     # ---- regression gate vs the previous round's JSON -------------------
@@ -427,6 +488,7 @@ def _tpch_sweep(s, sf: float):
     from spark_rapids_tpu.profiler import xla_stats
     reg = tpch.queries()
     engine_s, oracle_s, errors = {}, {}, {}
+    replay_s = {}
     profile, xla = {}, {}
     for qn in range(1, 23):
         # per-query guard: one failing OR straggling query (unsupported
@@ -445,12 +507,16 @@ def _tpch_sweep(s, sf: float):
             with _alarm(min(_QUERY_BUDGET_S, left), f"tpch q{qn}"):
                 q = reg[qn](dfs)
                 x0 = xla_stats.snapshot()
-                e_t = _best(lambda: q.to_arrow(), 2)
+                # headline: fresh tree per timed iteration; the same-
+                # object rerun is the optimistic resident_replay number
+                e_t = _best_fresh(lambda: reg[qn](dfs), 2)
                 x1 = xla_stats.snapshot()
+                r_t = _best(lambda: q.to_arrow(), 1)
                 o_t = _best(lambda: ORACLES[qn](host), 2)
             # assign together: a failed oracle must not leave a dangling
             # engine_s entry that KeyErrors the geomean below
             engine_s[qn], oracle_s[qn] = e_t, o_t
+            replay_s[qn] = r_t
             # XLA activity across the query's 3 runs (warm + 2 timed):
             # the whole-stage fusion acceptance metric — fewer programs
             # compiled and fewer per-batch dispatches at equal results
@@ -487,6 +553,14 @@ def _tpch_sweep(s, sf: float):
             "tpch_all22_per_query_ms": {
                 f"q{q}": round(v * 1e3, 1) for q, v in engine_s.items()},
         })
+        if replay_s:
+            k_r = len(replay_s)
+            geo_r = math.exp(
+                sum(math.log(v) for v in replay_s.values()) / k_r)
+            out["tpch_all22_resident_replay_geomean_s"] = round(geo_r, 4)
+            out["tpch_all22_resident_replay_per_query_ms"] = {
+                f"q{q}": round(v * 1e3, 1)
+                for q, v in replay_s.items()}
     if xla:
         out["tpch_xla_per_query"] = xla
     if profile:
@@ -641,6 +715,12 @@ def _regression_gate(current: dict, fellback: bool, sfs: dict):
         was_fallback = "backend_fallback" in parsed
         if was_fallback != fellback:
             continue  # cross-backend comparison is meaningless
+        if (parsed.get("extra") or {}).get("methodology") != "fresh":
+            # pre-fresh-methodology artifact: its numbers timed resident
+            # same-object replays, which this bench no longer reports as
+            # headline — comparing would misread the methodology change
+            # as a perf regression
+            continue
         prev = (os.path.basename(path), parsed)
         break
     if prev is None:

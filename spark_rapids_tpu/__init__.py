@@ -22,9 +22,10 @@ _jax.config.update("jax_enable_x64", True)
 # starts into seconds. SRTPU_COMPILE_CACHE overrides the location; set it
 # to "0" to disable.
 #
-# The cache dir is fingerprinted by backend + host CPU features +
+# The cache dir is fingerprinted by host CPU model + features +
 # jaxlib version: AOT results compiled on one machine can embed vector
-# instructions another host lacks (cpu_aot_loader feature-mismatch
+# instructions (or microarch-specific XLA target options) another host
+# lacks (cpu_aot_loader feature-mismatch
 # spam, and SIGILL if a mismatched program runs anyway), so each
 # distinct feature set gets its own subdirectory. Foreign-fingerprint
 # subdirs or a legacy unfingerprinted cache log ONE structured warning
@@ -35,11 +36,16 @@ def _cache_fingerprint() -> str:
     import hashlib
     import platform
     feats = ""
+    model = ""
     try:
         with open("/proc/cpuinfo", encoding="utf-8") as f:
             for line in f:
-                if line.startswith(("flags", "Features")):
+                if not feats and line.startswith(("flags", "Features")):
                     feats = " ".join(sorted(line.split(":", 1)[1].split()))
+                elif not model and line.startswith(("model name", "CPU part",
+                                                    "vendor_id")):
+                    model = line.split(":", 1)[1].strip()
+                if feats and model:
                     break
     except OSError:
         feats = platform.machine() + " " + platform.processor()
@@ -50,7 +56,12 @@ def _cache_fingerprint() -> str:
         ver = "?"
     # note: no jax.default_backend() here — that would force backend
     # initialization at import time
-    return hashlib.sha256(f"{feats}|{ver}".encode()).hexdigest()[:12]
+    # model identity matters beyond the flags list: XLA:CPU picks
+    # per-microarchitecture target options (prefer-no-gather/-scatter)
+    # that the flags line does not expose, and loading an AOT result
+    # built under different options can SIGILL/crash outright
+    return hashlib.sha256(
+        f"{model}|{feats}|{ver}".encode()).hexdigest()[:12]
 
 
 _cache = _os.environ.get("SRTPU_COMPILE_CACHE")

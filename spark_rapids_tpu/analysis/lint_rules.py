@@ -25,6 +25,13 @@ module turns those conventions into machine-checked rules (consumed by
   donate-missing   a jit-traced consume-and-replace function (returns
                    `param.at[...].set(...)`) without donate_argnums:
                    XLA cannot reuse the input buffer
+  jit-instance     a `jax.jit(...)` call inside an exec/ operator method
+                   (assigned to `self.*` or a per-instance memo dict):
+                   the program dies with the instance, so a fresh
+                   same-shaped query re-compiles — route through
+                   `runtime/program_cache.cached_program` (class-level
+                   `@jax.jit` decorators are already process-global and
+                   are not flagged)
   allow-no-reason  a `# tpulint: allow[...]` marker without a reason —
                    every accepted violation must say why
 
@@ -443,12 +450,61 @@ def rule_donate_missing(ctx: _ModuleCtx):
                        f"in place")
 
 
+def rule_jit_instance(ctx: _ModuleCtx):
+    """Flag non-decorator `jax.jit(...)` calls lexically inside an
+    operator method (first parameter `self`) in exec/ modules: the
+    jitted program is owned by one exec instance, so an identical
+    fresh query tree re-traces and re-compiles it. The process-global
+    `runtime/program_cache.cached_program` is the replacement. Class-
+    level `@jax.jit` staticmethod decorators are a single process-wide
+    program already and are excluded (decorators are not Call
+    expressions in a method body)."""
+    if not re.search(r"(^|/)exec/", ctx.path):
+        return
+
+    # decorator expressions (incl. partial(jax.jit, ...)) are exempt
+    dec_nodes: Set[int] = set()
+    for fn in ast.walk(ctx.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in fn.decorator_list:
+                for n in ast.walk(dec):
+                    dec_nodes.add(id(n))
+
+    def is_jit_call(node) -> bool:
+        if not isinstance(node, ast.Call):
+            return False
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr == "jit" \
+                and isinstance(f.value, ast.Name) \
+                and f.value.id in ctx.jax_aliases:
+            return True
+        return (isinstance(f, ast.Name) and f.id == "jit"
+                and "jit" in ctx.from_jax)
+
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        args = fn.args.posonlyargs + fn.args.args
+        if not args or args[0].arg != "self":
+            continue
+        for node in ast.walk(fn):
+            if id(node) in dec_nodes or not is_jit_call(node):
+                continue
+            yield (node.lineno, node.col_offset, "jit-instance",
+                   f"jax.jit inside exec method {fn.name!r} builds a "
+                   f"per-instance program: a fresh same-shaped query "
+                   f"re-compiles it — use runtime/program_cache."
+                   f"cached_program so the trace is shared process-"
+                   f"globally")
+
+
 RULES = {
     "host-sync": rule_host_sync,
     "block-sync": rule_block_sync,
     "jit-static-shape": rule_jit_static_shape,
     "strong-literal": rule_strong_literal,
     "donate-missing": rule_donate_missing,
+    "jit-instance": rule_jit_instance,
 }
 
 

@@ -159,6 +159,27 @@ STAGE_FUSION_MAX_OPS = _conf(
     "sql.exec.stageFusion.maxOps", 16,
     "Maximum number of member operators in one fused stage; longer "
     "chains are split. Bounds single-program XLA compile time.", int)
+PROGRAM_CACHE_ENABLED = _conf(
+    "sql.exec.programCache.enabled", True,
+    "Process-global XLA program cache (runtime/program_cache.py): "
+    "jitted operator programs are keyed by (operator class, program "
+    "tag, expression fingerprint, donation flags, backend, "
+    "jit-relevant conf fingerprint, input avals signature) and shared "
+    "across exec instances, DataFrames, and Sessions, so a fresh "
+    "same-shaped query tree performs zero new XLA compiles on a warm "
+    "process. Off: every exec instance jits privately (pre-cache "
+    "behavior).", bool)
+PROGRAM_CACHE_MAX_ENTRIES = _conf(
+    "sql.exec.programCache.maxEntries", 512,
+    "LRU capacity of the process-global program cache, in cached "
+    "programs (one per distinct key, including the avals signature). "
+    "Power-of-two capacity bucketing keeps distinct signatures per "
+    "site small, so the default comfortably holds a full TPC-H sweep. "
+    "Each live XLA:CPU executable pins ~10-20 memory mappings, so the "
+    "bound is also a vm.max_map_count budget (~11k maps at 512): "
+    "raising it far beyond the default risks mmap exhaustion in "
+    "long-lived many-query processes. Eviction counts surface as "
+    "program_cache_evictions in the xla_compile event record.", int)
 METRICS_LEVEL = _conf(
     "sql.metrics.level", "MODERATE",
     "Metric verbosity: ESSENTIAL|MODERATE|DEBUG.", str)

@@ -106,10 +106,17 @@ class UngroupedAggExec(TpuExec):
                                 jnp.reshape(ok, (1,))))
             return out
 
-        self._update_jit = jax.jit(_update)
-        self._update_merge_jit = jax.jit(_update_merge,
-                                         donate_argnums=(0,))
-        self._finalize_jit = jax.jit(_finalize)
+        from ..runtime.program_cache import cached_program, exprs_fp
+        self._aggs_fp = exprs_fp(self.aggs)
+        # update programs inline self._stages, whose fingerprint is only
+        # known after _resolve_fusion — built there
+        self._raw_update = _update
+        self._raw_update_merge = _update_merge
+        self._update_jit = None
+        self._update_merge_jit = None
+        self._finalize_jit = cached_program(
+            _finalize, cls="UngroupedAggExec", tag="finalize",
+            key=(self._aggs_fp,))
 
     def num_partitions(self, ctx):
         return 1
@@ -123,6 +130,17 @@ class UngroupedAggExec(TpuExec):
             from .base import collapse_fusable
             self._base, self._stages, self._n_fused = collapse_fusable(
                 self.children[0])
+            from ..runtime.program_cache import cached_program
+            key = (self._aggs_fp,
+                   getattr(self._stages, "_stage_fp",
+                           ("inst", id(self))))
+            self._update_jit = cached_program(
+                self._raw_update, cls="UngroupedAggExec", tag="update",
+                key=key)
+            self._update_merge_jit = cached_program(
+                self._raw_update_merge, cls="UngroupedAggExec",
+                tag="update_merge", key=key, donate_argnums=(0,))
+            self._whole_key = key
 
     def _whole_input_program(self):
         """ONE dispatch for the whole HBM-resident input: every batch is an
@@ -153,7 +171,9 @@ class UngroupedAggExec(TpuExec):
                     out.append((jnp.reshape(v, (1,) + tuple(v.shape)),
                                 jnp.reshape(ok, (1,))))
             return out
-        return jax.jit(run)
+        from ..runtime.program_cache import cached_program
+        return cached_program(run, cls="UngroupedAggExec", tag="whole",
+                              key=self._whole_key)
 
     def _try_whole_input(self, ctx, m):
         """Single-dispatch path for an HBM-resident child; returns
@@ -366,7 +386,13 @@ class HashAggregateExec(TpuExec):
 
         self._update_cache = {}
         self._merge_cache = {}
-        self._finalize_jit = jax.jit(self._finalize_fn)
+        from ..runtime.program_cache import cached_program, exprs_fp
+        # shared program-cache key material: same keys+aggs from a
+        # different DataFrame reuse every grouped-agg program
+        self._fp = (exprs_fp(self.keys), exprs_fp(self.aggs))
+        self._finalize_jit = cached_program(
+            self._finalize_fn, cls="HashAggregateExec", tag="finalize",
+            key=self._fp)
         hashable = (dt.BooleanType, dt.ByteType, dt.ShortType,
                     dt.IntegerType, dt.DateType, dt.LongType,
                     dt.TimestampType, dt.DecimalType, dt.FloatType,
@@ -466,7 +492,9 @@ class HashAggregateExec(TpuExec):
             nkeys = len(ks)
             return (out_cvs[:nkeys],
                     [cv.data for cv in out_cvs[nkeys:]], count)
-        return jax.jit(fn)
+        from ..runtime.program_cache import cached_program
+        return cached_program(fn, cls="HashAggregateExec", tag="bslice",
+                              key=self._fp + (K, seed))
 
     def _shrink_to(self, ks, st, nlive: int):
         """Slice a live-prefix partial down to a bucketed capacity."""
@@ -625,7 +653,10 @@ class HashAggregateExec(TpuExec):
                 cvs2, mask2 = self._stages(cvs, mask)
                 ctx = EmitCtx(cvs2, mask2.shape[0])
                 return [k.emit(ctx) for k in self.keys]
-            kfn = jax.jit(kfn_)
+            from ..runtime.program_cache import cached_program
+            kfn = cached_program(kfn_, cls="HashAggregateExec",
+                                 tag="keyemit",
+                                 key=self._fp + (self._stage_fp,))
             self._update_cache["keyemit"] = kfn
         key_cvs = kfn(b.cvs(), b.row_mask)
         rep2 = rep_rows[idx]
@@ -756,6 +787,9 @@ class HashAggregateExec(TpuExec):
             else:
                 self._base, self._n_fused = self.children[0], 0
                 self._stages = lambda cvs, mask: (cvs, mask)
+                self._stages._stage_fp = ("chain",)
+            self._stage_fp = getattr(self._stages, "_stage_fp",
+                                     ("inst", id(self)))
 
     # -- whole-input fused path (HBM-cached child, one device program) --
     def _whole_grouped_program(self, nchunks, opt_cap,
@@ -931,8 +965,13 @@ class HashAggregateExec(TpuExec):
                tuple(b.capacity for b in batches))
         fn = self._update_cache.get(key)
         if fn is None:
-            fn = jax.jit(self._whole_grouped_program(
-                self._whole_nchunks, opt_cap, hash_once))
+            from ..runtime.program_cache import cached_program
+            fn = cached_program(
+                self._whole_grouped_program(self._whole_nchunks,
+                                            opt_cap, hash_once),
+                cls="HashAggregateExec", tag="whole",
+                key=self._fp + (self._stage_fp, self._whole_nchunks,
+                                opt_cap, hash_once))
             self._update_cache[key] = fn
         args = tuple((tuple(b.cvs()), b.row_mask) for b in batches)
         with m.timer("opTime"):
@@ -976,8 +1015,12 @@ class HashAggregateExec(TpuExec):
             if self._hash_ok and not self._hash_disabled:
                 hfn = self._update_cache.get(("hash", nchunks, hash_once))
                 if hfn is None:
-                    hfn = jax.jit(self._hash_update_fn(nchunks,
-                                                       hash_once))
+                    from ..runtime.program_cache import cached_program
+                    hfn = cached_program(
+                        self._hash_update_fn(nchunks, hash_once),
+                        cls="HashAggregateExec", tag="hash_update",
+                        key=self._fp + (self._stage_fp, nchunks,
+                                        hash_once))
                     self._update_cache[("hash", nchunks, hash_once)] = hfn
                 rep_rows, st, sl, leftover, n_live = hfn(b.cvs(),
                                                          b.row_mask)
@@ -993,7 +1036,11 @@ class HashAggregateExec(TpuExec):
                 self._hash_disabled = True
             fn = self._update_cache.get(nchunks)
             if fn is None:
-                fn = jax.jit(self._update_fn(nchunks))
+                from ..runtime.program_cache import cached_program
+                fn = cached_program(
+                    self._update_fn(nchunks), cls="HashAggregateExec",
+                    tag="update",
+                    key=self._fp + (self._stage_fp, nchunks))
                 self._update_cache[nchunks] = fn
             ks, st, sl = fn(b.cvs(), b.row_mask)
             xla_stats.count_dispatch()
@@ -1170,7 +1217,10 @@ class HashAggregateExec(TpuExec):
         nchunks = self._nchunks_for(ks, sl)
         fn = self._merge_cache.get(nchunks)
         if fn is None:
-            fn = jax.jit(self._merge_fn(nchunks))
+            from ..runtime.program_cache import cached_program
+            fn = cached_program(
+                self._merge_fn(nchunks), cls="HashAggregateExec",
+                tag="merge", key=self._fp + (nchunks,))
             self._merge_cache[nchunks] = fn
         ks2, st2, sl2 = fn(ks, st, sl)
         xla_stats.count_dispatch()
@@ -1228,7 +1278,9 @@ class CollectAggExec(TpuExec):
         self.agg_names = list(agg_names)
         self.aggs = list(bound_aggs)
         self.per_partition = per_partition
-        self._run_cache = {}
+        from ..runtime.program_cache import exprs_fp
+        self._fp = (exprs_fp(self.keys), exprs_fp(self.aggs))
+        self._run_cache = {}  # local memo over CachedProgram wrappers
 
     def num_partitions(self, ctx):
         if self.per_partition:
@@ -1441,7 +1493,11 @@ class CollectAggExec(TpuExec):
             vnchunks = self._value_nchunks(cvs, mask)
             fn = self._run_cache.get((nchunks, vnchunks))
             if fn is None:
-                fn = jax.jit(self._run_fn(nchunks, vnchunks))
+                from ..runtime.program_cache import cached_program
+                fn = cached_program(
+                    self._run_fn(nchunks, vnchunks),
+                    cls="CollectAggExec", tag="run",
+                    key=self._fp + (nchunks, vnchunks))
                 self._run_cache[(nchunks, vnchunks)] = fn
             outs, seg_live = fn(cvs, mask)
             cap = mask.shape[0]

@@ -42,6 +42,11 @@ class ExecContext:
         # SharedBuildExec's per-run materialization cache:
         # {id(node): {pid: [spill handles]}} — closed by close()
         self.shared_handles: Dict[int, dict] = {}
+        # adopt this query's conf into the process-global program cache
+        # (enable/size + jit-relevant conf fingerprint mixed into keys)
+        if not planning:
+            from ..runtime import program_cache
+            program_cache.set_active_conf(self.conf)
 
     def close(self):
         """Release per-run resources (shared-build spill handles)."""
@@ -112,6 +117,16 @@ class TpuExec:
         (filters do; projections do not)."""
         return True
 
+    def stage_fingerprint(self) -> tuple:
+        """Structural identity of this node's fusable_stage() transform,
+        used as program-cache key material when the stage is inlined
+        into a parent's jitted program. The default is identity-based —
+        correct but never shared; nodes whose stage is fully determined
+        by bound expressions override it (Filter/Project/Limit/
+        FusedStage) so same-shaped trees from different DataFrames
+        share one trace."""
+        return ("inst", id(self))
+
     # ------------------------------------------------------------------
     def execute_all(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         for pid in range(self.num_partitions(ctx)):
@@ -137,19 +152,28 @@ def collapse_fusable(node: TpuExec, require_ordinals: bool = False):
     (composed_fn is identity and base_child is `node`).
 
     require_ordinals: stop at stages that renumber columns (projections) —
-    for parents that inspect child batches by ordinal outside the jit."""
+    for parents that inspect child batches by ordinal outside the jit.
+
+    The composed closure carries `_stage_fp` — the tuple of member
+    stage fingerprints — so callers that jit it (sort/join/agg
+    pre-stages) can key the program-cache entry on chain structure
+    instead of instance identity."""
     stages = []
+    fps = []
     while True:
         fn = node.fusable_stage()
         if fn is None or (require_ordinals and not node.preserves_ordinals()):
             break
         stages.append(fn)
+        fps.append(node.stage_fingerprint())
         node = node.children[0]
     stages.reverse()
+    fps.reverse()
 
     def composed(cvs, mask):
         for fn in stages:
             cvs, mask = fn(cvs, mask)
         return cvs, mask
 
+    composed._stage_fp = ("chain",) + tuple(fps)
     return node, composed, len(stages)

@@ -31,6 +31,17 @@ from .batch import DeviceBatch
 __all__ = ["ShuffleExchangeExec", "RangeShuffleExchangeExec"]
 
 
+def _finish_map(cvs, mask, pids, n):
+    """Shared map-side tail: dead rows to the overflow bucket, stable
+    sort by target partition, per-partition counts."""
+    eff = jnp.where(mask, pids, n)
+    order = jnp.argsort(eff, stable=True)
+    live_sorted = mask[order]
+    counts = jnp.bincount(eff, length=n + 1)[:n]
+    out = [take(cv, order, in_bounds=live_sorted) for cv in cvs]
+    return out, counts
+
+
 class ShuffleExchangeExec(TpuExec):
     def __init__(self, child: TpuExec, num_partitions: int,
                  bound_keys: Optional[Sequence[Expression]],
@@ -41,7 +52,15 @@ class ShuffleExchangeExec(TpuExec):
         self._shuffle: Optional[LocalShuffle] = None
         self._pstats: Optional[List[int]] = None
         self._lock = threading.RLock()
-        self._jit = jax.jit(self._map_fn)
+        # the program closes over plan-time config only (n + bound key
+        # exprs), never self: a cached entry pinning the builder must
+        # not pin this instance's shuffle files / partition stats
+        from ..runtime.program_cache import cached_program, exprs_fp
+        self._jit = cached_program(
+            self._build_map_fn(self.n, self.keys),
+            cls=type(self).__name__, tag="map",
+            key=(self.n,
+                 exprs_fp(self.keys) if self.keys else None))
 
     def describe(self):
         mode = "hash" if self.keys else "roundrobin"
@@ -51,34 +70,41 @@ class ShuffleExchangeExec(TpuExec):
         return self.n
 
     # ---- map-side device program --------------------------------------
-    def _compute_pids(self, cvs, mask):
-        """int32[cap] target partition per row (overridden by range)."""
-        cap = mask.shape[0]
-        if not self.keys:
-            return ((jnp.cumsum(mask.astype(jnp.int32)) - 1)
-                    % self.n).astype(jnp.int32)
-        ctx = EmitCtx(cvs, cap)
-        key_cvs = [k.emit(ctx) for k in self.keys]
-        if (len(self.keys) == 1 and cap % 1024 == 0
-                and jax.default_backend() == "tpu"):
-            kd = self.keys[0].dtype
-            if isinstance(kd, (dt.IntegerType, dt.DateType)):
-                # hot path: fused Pallas murmur3+pmod kernel
-                from ..ops.pallas_kernels import pallas_partition_ids_i32
-                kcv = key_cvs[0]
-                return pallas_partition_ids_i32(
-                    kcv.data.astype(jnp.int32), kcv.validity, self.n)
-        return partition_ids(key_cvs, [k.dtype for k in self.keys],
-                             self.n)
+    def _run_map(self, cvs, mask):
+        """Dispatch the cached map-side program for one batch (the
+        OOM-retry injection seam for tests)."""
+        return self._jit(cvs, mask, *self._map_args())
 
-    def _map_fn(self, cvs, mask):
-        pids = self._compute_pids(cvs, mask)
-        eff = jnp.where(mask, pids, self.n)
-        order = jnp.argsort(eff, stable=True)
-        live_sorted = mask[order]
-        counts = jnp.bincount(eff, length=self.n + 1)[:self.n]
-        out = [take(cv, order, in_bounds=live_sorted) for cv in cvs]
-        return out, counts
+    def _map_args(self):
+        """Extra traced arguments appended to the map program call
+        (range bounds — device data must be traced, never baked)."""
+        return ()
+
+    @staticmethod
+    def _build_map_fn(n, keys):
+        def _compute_pids(cvs, mask):
+            """int32[cap] target partition per row."""
+            cap = mask.shape[0]
+            if not keys:
+                return ((jnp.cumsum(mask.astype(jnp.int32)) - 1)
+                        % n).astype(jnp.int32)
+            ctx = EmitCtx(cvs, cap)
+            key_cvs = [k.emit(ctx) for k in keys]
+            if (len(keys) == 1 and cap % 1024 == 0
+                    and jax.default_backend() == "tpu"):
+                kd = keys[0].dtype
+                if isinstance(kd, (dt.IntegerType, dt.DateType)):
+                    # hot path: fused Pallas murmur3+pmod kernel
+                    from ..ops.pallas_kernels import \
+                        pallas_partition_ids_i32
+                    kcv = key_cvs[0]
+                    return pallas_partition_ids_i32(
+                        kcv.data.astype(jnp.int32), kcv.validity, n)
+            return partition_ids(key_cvs, [k.dtype for k in keys], n)
+
+        def _map_fn(cvs, mask):
+            return _finish_map(cvs, mask, _compute_pids(cvs, mask), n)
+        return _map_fn
 
     def release(self):
         sh, self._shuffle = self._shuffle, None
@@ -114,7 +140,8 @@ class ShuffleExchangeExec(TpuExec):
                 halves simply produce more sub-batches per partition)."""
                 with m.timer("partitionTime"):
                     from ..shuffle.serializer import cv_shuffle_bufs
-                    out, counts = self._jit(batch.cvs(), batch.row_mask)
+                    out, counts = self._run_map(batch.cvs(),
+                                                batch.row_mask)
                     return fetch({
                         "cols": [cv_shuffle_bufs(cv) for cv in out],
                         "counts": counts,
@@ -193,14 +220,24 @@ class RangeShuffleExchangeExec(ShuffleExchangeExec):
     def describe(self):
         return f"RangeShuffleExchangeExec[n={self.n}]"
 
-    def _compute_pids(self, cvs, mask):
-        cap = mask.shape[0]
-        ctx = EmitCtx(cvs, cap)
-        kcv = self.keys[0].emit(ctx)
-        pids = jnp.searchsorted(self._bounds, kcv.data,
-                                side="right").astype(jnp.int32)
-        # nulls partition first (Spark null ordering for range)
-        return jnp.where(kcv.validity, pids, 0)
+    def _map_args(self):
+        # sampled bounds are device data: traced argument, NOT a baked
+        # closure constant — a shared cached program must see each
+        # instance's own bounds
+        return (self._bounds,)
+
+    @staticmethod
+    def _build_map_fn(n, keys):
+        def _map_fn(cvs, mask, bounds):
+            cap = mask.shape[0]
+            ctx = EmitCtx(cvs, cap)
+            kcv = keys[0].emit(ctx)
+            pids = jnp.searchsorted(bounds, kcv.data,
+                                    side="right").astype(jnp.int32)
+            # nulls partition first (Spark null ordering for range)
+            pids = jnp.where(kcv.validity, pids, 0)
+            return _finish_map(cvs, mask, pids, n)
+        return _map_fn
 
     def _ensure_shuffled(self, ctx):
         with self._lock:  # RLock: safe to re-enter in super()

@@ -13,7 +13,6 @@ from __future__ import annotations
 
 from typing import List, Sequence
 
-import jax
 import jax.numpy as jnp
 
 from ..columnar.table import Schema
@@ -67,7 +66,11 @@ class ExpandExec(TpuExec):
             out_mask = concat_masks([mask] * n_sets)
             return out, out_mask
 
-        self._jit = jax.jit(_run)
+        from ..runtime.program_cache import cached_program, exprs_fp
+        self._jit = cached_program(
+            _run, cls="ExpandExec", tag="run",
+            key=(exprs_fp(self.bound_keys),
+                 tuple(self.include_masks)))
 
     def describe(self):
         return (f"ExpandExec[{len(self.include_masks)} sets, "

@@ -64,7 +64,10 @@ class FusedStageExec(TpuExec):
         # device backends the child's batch buffers and the running
         # stats vector are dead after the call and donated
         donate = () if jax.default_backend() == "cpu" else (0, 1, 2)
-        self._jit = jax.jit(_run, donate_argnums=donate)
+        from ..runtime.program_cache import cached_program
+        self._jit = cached_program(
+            _run, cls="FusedStageExec", tag="run",
+            key=self.stage_fingerprint(), donate_argnums=donate)
 
     # ------------------------------------------------------------------
     def fusable_stage(self):
@@ -81,6 +84,10 @@ class FusedStageExec(TpuExec):
 
     def preserves_ordinals(self) -> bool:
         return all(m.preserves_ordinals() for m in self.members)
+
+    def stage_fingerprint(self) -> tuple:
+        return ("FusedStage",) + tuple(
+            m.stage_fingerprint() for m in self._exec_order)
 
     def describe(self) -> str:
         parts = " > ".join(

@@ -11,7 +11,6 @@ from __future__ import annotations
 
 from typing import List
 
-import jax
 import jax.numpy as jnp
 
 from ..columnar import dtypes as dt
@@ -53,7 +52,11 @@ class GenerateExec(TpuExec):
             measures = [ops_gather.repeat_measures(cv, eff) for cv in cvs]
             return arr, lens, out_off, out_off[mask.shape[0]], measures
 
-        self._count = jax.jit(_count)
+        from ..runtime.program_cache import cached_program, expr_fp
+        self._gen_fp = (expr_fp(self.gen), self.outer, self.with_pos,
+                        self.is_map)
+        self._count = cached_program(_count, cls="GenerateExec",
+                                     tag="count", key=self._gen_fp)
         self._expand_cache = {}
 
     def describe(self):
@@ -63,8 +66,9 @@ class GenerateExec(TpuExec):
         return f"GenerateExec[{mode}({self.gen.child!r})]"
 
     def _expand_fn(self, out_cap: int, caps_key):
-        # instance-level memo: a class-global lru_cache would pin exec
-        # trees + XLA executables of finished queries
+        # instance-level memo over program-cache wrappers (the wrappers
+        # are cheap; the jitted programs live in the bounded process
+        # cache, keyed on generator shape not instance identity)
         cached = self._expand_cache.get((out_cap, caps_key))
         if cached is not None:
             return cached
@@ -97,7 +101,9 @@ class GenerateExec(TpuExec):
             out_mask = out_live
             return outs, out_mask
 
-        jfn = jax.jit(fn)
+        from ..runtime.program_cache import cached_program
+        jfn = cached_program(fn, cls="GenerateExec", tag="expand",
+                             key=self._gen_fp + (out_cap, caps_key))
         self._expand_cache[(out_cap, caps_key)] = jfn
         return jfn
 

@@ -89,6 +89,12 @@ class HashJoinExec(TpuExec):
         self.condition = condition
         self._count_cache = {}
         self._expand_cache = {}
+        from ..runtime.program_cache import expr_fp, exprs_fp
+        # shared program-cache key material: same keys/type/condition
+        # from a different DataFrame reuse every join program
+        self._fp = (exprs_fp(self.lkeys), exprs_fp(self.rkeys), how,
+                    expr_fp(condition) if condition is not None
+                    else None)
         # probe-side pre-projection: the fusable stream-side chain
         # collapses into one pre-stage program per stream batch
         # (resolved lazily at first execute, see UngroupedAggExec)
@@ -117,7 +123,11 @@ class HashJoinExec(TpuExec):
             else:
                 self._base_left, self._n_fused = self.children[0], 0
             if self._n_fused:
-                self._pre_jit = jax.jit(self._lstages)
+                from ..runtime.program_cache import cached_program
+                self._pre_jit = cached_program(
+                    self._lstages, cls=type(self).__name__, tag="pre",
+                    key=getattr(self._lstages, "_stage_fp",
+                                ("inst", id(self))))
 
     def _stream_batches(self, ctx, pid):
         """Probe-side input with the fusable left chain applied as one
@@ -224,7 +234,9 @@ class HashJoinExec(TpuExec):
                 perm = sk.lexsort([inv, pinned])
                 return pinned[perm], perm.astype(jnp.int32), \
                     jnp.sum(valid.astype(jnp.int32))
-            fn = jax.jit(fn_)
+            from ..runtime.program_cache import cached_program
+            fn = cached_program(fn_, cls=type(self).__name__,
+                                tag="buildsort", key=self._fp)
             self._count_cache[key] = fn
         return fn(bkey_cvs[0], bmask)
 
@@ -251,7 +263,9 @@ class HashJoinExec(TpuExec):
                                          jnp.uint64(0xFFFFFFFFFFFFFFFF)))
                 kmax = jnp.max(jnp.where(valid, ukey, jnp.uint64(0)))
                 return kmin, kmax, jnp.sum(valid.astype(jnp.int32))
-            rfn = jax.jit(rfn_)
+            from ..runtime.program_cache import cached_program
+            rfn = cached_program(rfn_, cls=type(self).__name__,
+                                 tag="keyrange", key=self._fp)
             self._count_cache[key] = rfn
         kmin_d, kmax_d, nv_d = rfn(bkey_cvs[0], bmask)
         kmin, kmax, nv = (int(v) for v in fetch((kmin_d, kmax_d, nv_d)))
@@ -275,7 +289,10 @@ class HashJoinExec(TpuExec):
                 idx_t = jnp.zeros(R + 1, jnp.int32).at[off].max(
                     jnp.arange(cap_b, dtype=jnp.int32))
                 return cnt_t, idx_t
-            bfn = jax.jit(bfn_, static_argnums=())
+            from ..runtime.program_cache import cached_program
+            bfn = cached_program(bfn_, cls=type(self).__name__,
+                                 tag="directbuild",
+                                 key=self._fp + (R,))
             self._count_cache[bkey] = bfn
         cnt_t, idx_t = bfn(bkey_cvs[0], bmask, kmin_d)
         return {"R": R, "kmin": kmin_d, "kmax": kmax_d,
@@ -295,7 +312,10 @@ class HashJoinExec(TpuExec):
                 cnt = cnt_t[poff].astype(jnp.int64)
                 bidx = idx_t[poff]
                 return cnt, bidx
-            fn = jax.jit(fn_)
+            from ..runtime.program_cache import cached_program
+            fn = cached_program(fn_, cls=type(self).__name__,
+                                tag="directprobe",
+                                key=self._fp + (R,))
             self._count_cache[key] = fn
         return fn(direct["cnt_t"], direct["idx_t"], direct["kmin"],
                   direct["kmax"], skcv, smask)
@@ -565,7 +585,9 @@ class HashJoinExec(TpuExec):
             mask_b = mask & (pids == b)
             out_cvs, count = compact(cvs, mask_b)
             return out_cvs, count
-        return jax.jit(fn)
+        from ..runtime.program_cache import cached_program, exprs_fp
+        return cached_program(fn, cls=type(self).__name__, tag="subpart",
+                              key=(exprs_fp(key_exprs), S, seed))
 
     def _subpart_fns(self, S: int, seed: int):
         """Cached (build-side, stream-side) sub-partition programs."""
@@ -781,7 +803,11 @@ class HashJoinExec(TpuExec):
                 pkey = ("probe", cap_b, cap_s)
                 pfn = self._count_cache.get(pkey)
                 if pfn is None:
-                    pfn = jax.jit(self._probe_fn(cap_b, cap_s))
+                    from ..runtime.program_cache import cached_program
+                    pfn = cached_program(
+                        self._probe_fn(cap_b, cap_s),
+                        cls=type(self).__name__, tag="probe",
+                        key=self._fp + (cap_b, cap_s))
                     self._count_cache[pkey] = pfn
                 (cnt, offsets, total, bstart,
                  touched) = pfn(sorted_ukey, n_valid_b, skey_cvs[0],
@@ -799,7 +825,11 @@ class HashJoinExec(TpuExec):
                 ckey = (nchunks, cap_b, cap_s)
                 cfn = self._count_cache.get(ckey)
                 if cfn is None:
-                    cfn = jax.jit(self._count_fn(nchunks, cap_b, cap_s))
+                    from ..runtime.program_cache import cached_program
+                    cfn = cached_program(
+                        self._count_fn(nchunks, cap_b, cap_s),
+                        cls=type(self).__name__, tag="count",
+                        key=self._fp + (nchunks, cap_b, cap_s))
                     self._count_cache[ckey] = cfn
                 (cnt, offsets, total, bstart, perm,
                  matched_b) = cfn(bkey_cvs, bmask, skey_cvs, smask)
@@ -840,8 +870,11 @@ class HashJoinExec(TpuExec):
             ekey = (out_cap, cap_b, cap_s, with_left_nulls)
             efn = self._expand_cache.get(ekey)
             if efn is None:
-                efn = jax.jit(self._expand_fn(out_cap, cap_b,
-                                              with_left_nulls))
+                from ..runtime.program_cache import cached_program
+                efn = cached_program(
+                    self._expand_fn(out_cap, cap_b, with_left_nulls),
+                    cls=type(self).__name__, tag="expand",
+                    key=self._fp + (out_cap, cap_b, with_left_nulls))
                 self._expand_cache[ekey] = efn
             lg, rg, lvalid, rvalid, _ = efn(cnt, offsets, bstart, perm,
                                             smask)
@@ -869,7 +902,11 @@ class HashJoinExec(TpuExec):
             ekey = (out_cap, cap_b, cap_s, False)
             efn = self._expand_cache.get(ekey)
             if efn is None:
-                efn = jax.jit(self._expand_fn(out_cap, cap_b, False))
+                from ..runtime.program_cache import cached_program
+                efn = cached_program(
+                    self._expand_fn(out_cap, cap_b, False),
+                    cls=type(self).__name__, tag="expand",
+                    key=self._fp + (out_cap, cap_b, False))
                 self._expand_cache[ekey] = efn
             lg, rg, lvalid, rvalid, _ = efn(cnt, offsets, bstart, perm,
                                             smask)

@@ -97,13 +97,16 @@ class MeshExchangeExec(TpuExec):
         mesh = self._get_mesh()
         n = self.n
         axis = self.axis_name
-        key_dtypes = [k.dtype for k in self.keys]
+        # close over the bound key exprs, never self: a cached entry
+        # pinning the builder must not pin this exchange's parked output
+        keys = self.keys
+        key_dtypes = [k.dtype for k in keys]
 
         def shard_fn(flat, mask):
             cvs = _unflatten_cvs(flat, has_offsets)
             cap = mask.shape[0]
             ectx = EmitCtx(cvs, cap)
-            key_cvs = [k.emit(ectx) for k in self.keys]
+            key_cvs = [k.emit(ectx) for k in keys]
             pids = partition_ids(key_cvs, key_dtypes, n)
             out_cvs, out_mask = exchange_cvs(cvs, mask, pids, n, axis)
             out_cvs, count = compact(out_cvs, out_mask)
@@ -120,7 +123,10 @@ class MeshExchangeExec(TpuExec):
                 out_specs=(tuple(P(axis) for _ in flat), P(axis)),
             )(tuple(flat), mask)
 
-        return jax.jit(step)
+        from ..runtime.program_cache import cached_program, exprs_fp
+        return cached_program(
+            step, cls="MeshExchangeExec", tag="step",
+            key=(n, axis, exprs_fp(keys), tuple(has_offsets)))
 
     # ------------------------------------------------------------------
     def _assemble_global(self, pieces, sharding, devices, m=None):

@@ -703,7 +703,9 @@ class ProjectExec(TpuExec):
             ctx = EmitCtx(cvs, mask.shape[0])
             return [e.emit(ctx) for e in self.bound]
 
-        self._jit = jax.jit(_run)
+        from ..runtime.program_cache import cached_program, exprs_fp
+        self._jit = cached_program(_run, cls="ProjectExec", tag="run",
+                                   key=exprs_fp(self.bound))
 
     def describe(self):
         return f"ProjectExec[{', '.join(map(repr, self.bound))}]"
@@ -713,6 +715,10 @@ class ProjectExec(TpuExec):
             ctx = EmitCtx(cvs, mask.shape[0])
             return [e.emit(ctx) for e in self.bound], mask
         return fn
+
+    def stage_fingerprint(self):
+        from ..runtime.program_cache import exprs_fp
+        return ("Project", exprs_fp(self.bound))
 
     def preserves_ordinals(self):
         return False
@@ -738,7 +744,9 @@ class FilterExec(TpuExec):
             cv = self.bound.emit(ctx)
             return mask & cv.validity & cv.data.astype(jnp.bool_)
 
-        self._jit = jax.jit(_run)
+        from ..runtime.program_cache import cached_program, expr_fp
+        self._jit = cached_program(_run, cls="FilterExec", tag="run",
+                                   key=(expr_fp(self.bound),))
 
     def describe(self):
         return f"FilterExec[{self.bound!r}]"
@@ -749,6 +757,10 @@ class FilterExec(TpuExec):
             cv = self.bound.emit(ctx)
             return cvs, mask & cv.validity & cv.data.astype(jnp.bool_)
         return fn
+
+    def stage_fingerprint(self):
+        from ..runtime.program_cache import expr_fp
+        return ("Filter", expr_fp(self.bound))
 
     def execute_partition(self, ctx, pid):
         m = ctx.metrics_for(self._op_id)
@@ -782,19 +794,18 @@ class LimitExec(TpuExec):
         self._stages = None
         self._n_fused = 0
 
+        from ..runtime.program_cache import cached_program
+
         def _clip(mask, remaining):
             ranks = jnp.cumsum(mask.astype(jnp.int64))
             new_mask = mask & (ranks <= remaining)
             return new_mask, jnp.sum(new_mask.astype(jnp.int64))
 
-        self._jit = jax.jit(_clip)
-
-        def _clip_fused(cvs, mask, remaining):
-            cvs, mask = self._stages(cvs, mask)
-            new_mask, took = _clip(mask, remaining)
-            return cvs, new_mask, took
-
-        self._fused_jit = jax.jit(_clip_fused)
+        self._clip = _clip
+        self._jit = cached_program(_clip, cls="LimitExec", tag="clip")
+        # _fused_jit is keyed on the fused chain's structure, which is
+        # only known after _resolve_fusion — built there
+        self._fused_jit = None
         ncap = self._ncap
 
         def _perm(mask):
@@ -802,7 +813,8 @@ class LimitExec(TpuExec):
             perm, count = compaction_perm(mask)
             return perm[:ncap], jnp.arange(ncap) < count
 
-        self._perm = jax.jit(_perm)
+        self._perm = cached_program(_perm, cls="LimitExec", tag="perm",
+                                    key=(ncap,))
 
     def _resolve_fusion(self, ctx):
         if self._base is None:
@@ -814,6 +826,19 @@ class LimitExec(TpuExec):
             else:
                 self._base, self._n_fused = self.children[0], 0
                 self._stages = lambda cvs, mask: (cvs, mask)
+        if self._fused_jit is None:
+            from ..runtime.program_cache import cached_program
+            clip = self._clip
+
+            def _clip_fused(cvs, mask, remaining):
+                cvs, mask = self._stages(cvs, mask)
+                new_mask, took = clip(mask, remaining)
+                return cvs, new_mask, took
+
+            self._fused_jit = cached_program(
+                _clip_fused, cls="LimitExec", tag="clip_fused",
+                key=getattr(self._stages, "_stage_fp",
+                            ("inst", id(self))))
 
     def describe(self):
         fused = f", fused_stages={self._n_fused}" if self._n_fused else ""

@@ -18,7 +18,6 @@ from __future__ import annotations
 import threading
 from typing import Iterator
 
-import jax
 import jax.numpy as jnp
 
 from ..expr.expressions import EmitCtx
@@ -108,8 +107,13 @@ class RuntimeBloomFilterExec(TpuExec):
                 ectx = EmitCtx(list(cvs), mask.shape[0])
                 return a.update(a.child.emit(ectx), mask)
 
-            upd_jit = jax.jit(upd)
-            merge_jit = jax.jit(a.merge)
+            from ..runtime.program_cache import cached_program, expr_fp
+            afp = expr_fp(a)
+            upd_jit = cached_program(upd, cls="RuntimeBloomFilterExec",
+                                     tag="update", key=(afp,))
+            merge_jit = cached_program(a.merge,
+                                       cls="RuntimeBloomFilterExec",
+                                       tag="merge", key=(afp,))
             with m.timer("bloomBuildTime"):
                 for b in self.build.execute_all(ctx):
                     st = upd_jit(b.cvs(), b.row_mask)
@@ -119,23 +123,30 @@ class RuntimeBloomFilterExec(TpuExec):
             self._bits = state[0]
         return self._bits
 
-    def _probe(self, bits, cvs, mask):
-        from ..ops.hash import bloom_positions
-        ectx = EmitCtx(list(cvs), mask.shape[0])
-        cv = self.stream_key.emit(ectx)
-        nb = self._agg.num_bits
-        hit = cv.validity
-        for pos in bloom_positions(cv, self.stream_key.dtype,
-                                   self._agg.k, nb):
-            hit = hit & bits[jnp.clip(pos, 0, nb - 1)]
-        return mask & hit
-
     def execute_partition(self, ctx: ExecContext,
                           pid: int) -> Iterator[DeviceBatch]:
         m = ctx.metrics_for(self._op_id)
         bits = self._ensure_filter(ctx)
         if self._probe_jit is None:
-            self._probe_jit = jax.jit(self._probe)
+            # close over the bound key + agg config only (not self):
+            # the cached program must not pin this node's bloom bits
+            # or build subtree. The bit vector is a traced argument.
+            from ..runtime.program_cache import cached_program, expr_fp
+            skey, agg = self.stream_key, self._agg
+
+            def _probe(bits, cvs, mask):
+                from ..ops.hash import bloom_positions
+                ectx = EmitCtx(list(cvs), mask.shape[0])
+                cv = skey.emit(ectx)
+                nb = agg.num_bits
+                hit = cv.validity
+                for pos in bloom_positions(cv, skey.dtype, agg.k, nb):
+                    hit = hit & bits[jnp.clip(pos, 0, nb - 1)]
+                return mask & hit
+
+            self._probe_jit = cached_program(
+                _probe, cls="RuntimeBloomFilterExec", tag="probe",
+                key=(expr_fp(skey), expr_fp(agg)))
         for batch in self.children[0].execute_partition(ctx, pid):
             with m.timer("bloomProbeTime"):
                 new_mask = self._probe_jit(bits, batch.cvs(),

@@ -12,7 +12,6 @@ from __future__ import annotations
 
 from typing import List, Sequence, Tuple
 
-import jax
 import jax.numpy as jnp
 
 from ..columnar import dtypes as dt
@@ -88,7 +87,11 @@ class SortExec(TpuExec):
             else:
                 self._base, self._n_fused = self.children[0], 0
             if self._n_fused:
-                self._pre_jit = jax.jit(self._stages)
+                from ..runtime.program_cache import cached_program
+                self._pre_jit = cached_program(
+                    self._stages, cls="SortExec", tag="pre",
+                    key=getattr(self._stages, "_stage_fp",
+                                ("inst", id(self))))
 
     def num_partitions(self, ctx):
         return 1
@@ -128,8 +131,13 @@ class SortExec(TpuExec):
             nchunks = self._nchunks(cvs, mask)
             fn = self._jit_cache.get(nchunks)
             if fn is None:
-                fn = jax.jit(lambda c, mk, _nc=nchunks:
-                             sort_batch_cvs(c, mk, self.orders, _nc))
+                from ..runtime.program_cache import (cached_program,
+                                                     exprs_fp)
+                fn = cached_program(
+                    lambda c, mk, _nc=nchunks:
+                    sort_batch_cvs(c, mk, self.orders, _nc),
+                    cls="SortExec", tag="sort",
+                    key=(exprs_fp(self.orders), nchunks))
                 self._jit_cache[nchunks] = fn
             out, out_mask = fn(cvs, mask)
         xla_stats.count_dispatch()

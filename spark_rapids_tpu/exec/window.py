@@ -212,7 +212,9 @@ class WindowExec(TpuExec):
                     "one WindowExec handles one (partition, order) spec; "
                     "the planner stages differing specs into a chain")
         self.spec = spec
-        self._jit_cache = {}
+        from ..runtime.program_cache import exprs_fp
+        self._wfp = exprs_fp(self.wexprs)
+        self._jit_cache = {}  # local memo over CachedProgram wrappers
 
     def num_partitions(self, ctx):
         return 1
@@ -519,9 +521,12 @@ class WindowExec(TpuExec):
                 key = (mask.shape[0], is_last)
                 fn = self._jit_cache.get(("chunk", key))
                 if fn is None:
-                    fn = jax.jit(lambda c, mk, cr, _l=is_last:
-                                 self._compute_chunk(c, mk, nchunks,
-                                                     cr, _l))
+                    from ..runtime.program_cache import cached_program
+                    fn = cached_program(
+                        lambda c, mk, cr, _l=is_last:
+                        self._compute_chunk(c, mk, nchunks, cr, _l),
+                        cls="WindowExec", tag="chunk",
+                        key=self._wfp + (nchunks, is_last))
                     self._jit_cache[("chunk", key)] = fn
                 # this path runs under memory pressure by construction;
                 # retry-after-spill like the in-core window (no input
@@ -940,7 +945,11 @@ class WindowExec(TpuExec):
             nchunks = self._nchunks(cvs, mask)
             fn = self._jit_cache.get(nchunks)
             if fn is None:
-                fn = jax.jit(lambda c, mk: self._compute(c, mk, nchunks))
+                from ..runtime.program_cache import cached_program
+                fn = cached_program(
+                    lambda c, mk: self._compute(c, mk, nchunks),
+                    cls="WindowExec", tag="whole",
+                    key=self._wfp + (nchunks,))
                 self._jit_cache[nchunks] = fn
             # window frames span the whole partition: input splitting is
             # not legal, so OOM protection is retry-after-spill only

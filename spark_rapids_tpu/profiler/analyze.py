@@ -94,6 +94,11 @@ def render_analyze(tree: dict, metrics_by_lore: Dict[Optional[int], dict],
             ann.append(f"xlaCompiles={int(m['xlaCompiles'])}")
         if m.get("xlaDispatches") is not None:
             ann.append(f"xlaDispatches={int(m['xlaDispatches'])}")
+        if m.get("programCacheHits") is not None:
+            ann.append(f"programCacheHits={int(m['programCacheHits'])}")
+        if m.get("programCacheMisses") is not None:
+            ann.append(
+                f"programCacheMisses={int(m['programCacheMisses'])}")
         if ann:
             line += "  " + " ".join(ann)
         if lid in rank:

@@ -68,7 +68,18 @@ def install():
 
 def snapshot() -> Dict[str, float]:
     """Current cumulative counters (install()s the listeners on first
-    use; callers diff two snapshots to scope a query)."""
+    use; callers diff two snapshots to scope a query). Includes the
+    process-global program cache's hit/miss/eviction counters so the
+    xla_compile event record and EXPLAIN ANALYZE carry them alongside
+    the compile counts they explain."""
     install()
     with _lock:
-        return dict(_stats)
+        out = dict(_stats)
+    try:
+        from ..runtime.program_cache import stats as _pc_stats
+        pc = _pc_stats()
+        pc.pop("program_cache_entries", None)  # gauge, not a counter
+        out.update(pc)
+    except Exception:
+        pass
+    return out

@@ -1,0 +1,11 @@
+"""Process-global runtime services shared by every exec instance.
+
+Today: the XLA program cache (program_cache.py) — compiled-program
+reuse across exec instances, DataFrames, and Sessions within one
+process, the property the reference engine gets for free from pre-built
+cuDF kernels (GpuOverrides.scala:5017 plans in milliseconds because
+nothing compiles per query).
+"""
+from . import program_cache  # noqa: F401
+
+__all__ = ["program_cache"]

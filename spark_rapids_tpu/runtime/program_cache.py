@@ -1,0 +1,309 @@
+"""Process-global XLA program cache: compile once, run many.
+
+Every per-exec-instance `jax.jit` made the compile-once property
+per-DataFrame: a fresh q4 tree re-traced and re-lowered ~every operator
+program even though an identical-shaped tree ran seconds earlier in the
+same process. The reference engine compiles nothing per query — cuDF
+kernels are pre-built — and Eiger/Theseus (PAPERS.md) both key reusable
+pre-compiled operator kernels by type signature. This module retrofits
+that property: a thread-safe, LRU-bounded, process-global table of
+jitted programs keyed by
+
+    (operator class, program tag, site key [expression fingerprints,
+     chunk counts, capacities...], donate/static argnums, backend,
+     jit-relevant conf fingerprint, input avals signature
+     [pytree structure + dtypes + bucketed capacities])
+
+Exec nodes call `cached_program(builder_fn, cls=..., tag=..., key=...)`
+instead of `jax.jit(builder_fn)`. The builder must be parameterized on
+the key — it may close over plan configuration (bound expressions,
+dtypes, bucketed capacities) but never over per-run device state or
+large buffers: on a hit the FIRST-seen builder's trace runs, so any
+instance state not captured by the key would silently leak into other
+instances' results. Capacities are already power-of-two bucketed
+(`columnar.column.bucket_capacity`), which is what bounds the avals-
+signature cardinality and keeps this table small.
+
+Counters (hits/misses/evictions) surface through
+`profiler/xla_stats.snapshot()` into EXPLAIN ANALYZE
+(`programCacheHits=`/`programCacheMisses=` at the root), the
+`xla_compile` event-log record, and `tools/profile_report.py`. A miss
+is (at most) one fresh trace; on a warm process a same-shaped fresh
+query tree performs zero new XLA compiles.
+"""
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+__all__ = ["cached_program", "CachedProgram", "stats", "clear",
+           "set_active_conf", "expr_fp", "exprs_fp", "conf_fingerprint"]
+
+_lock = threading.RLock()
+_cache: "OrderedDict[tuple, Any]" = OrderedDict()
+_stats = {"program_cache_hits": 0, "program_cache_misses": 0,
+          "program_cache_evictions": 0}
+_enabled = True
+_max_entries = 512
+_active_conf_fp: tuple = ()
+
+# conf entries whose values change the shape or contents of traced
+# programs (plan-affecting knobs); everything else — metric levels,
+# event-log paths, memory thresholds — only steers host-side control
+# flow and must NOT split the cache
+_JIT_RELEVANT_CONF_KEYS = (
+    "spark.rapids.tpu.sql.exec.stageFusion.enabled",
+    "spark.rapids.tpu.sql.exec.stageFusion.maxOps",
+)
+
+
+def conf_fingerprint(conf) -> tuple:
+    """Fingerprint of the jit-relevant conf subset (part of every cache
+    key, so two sessions with different program-shaping confs never
+    share a trace)."""
+    out = []
+    for key in _JIT_RELEVANT_CONF_KEYS:
+        try:
+            from ..config import REGISTRY
+            entry = REGISTRY.get(key)
+            out.append((key, conf.get(entry) if entry is not None
+                        else None))
+        except Exception:
+            out.append((key, None))
+    return tuple(out)
+
+
+def set_active_conf(conf) -> None:
+    """Adopt a session conf: enable/size the cache and record the
+    jit-relevant conf fingerprint mixed into every key. Called by
+    ExecContext at query start; process-global by design (the cache
+    itself is process-global), so the fingerprint-in-key is what keeps
+    concurrently active sessions with different program-shaping confs
+    from sharing traces."""
+    global _enabled, _max_entries, _active_conf_fp
+    from ..config import (PROGRAM_CACHE_ENABLED,
+                          PROGRAM_CACHE_MAX_ENTRIES)
+    fp = conf_fingerprint(conf)
+    with _lock:
+        _enabled = bool(conf.get(PROGRAM_CACHE_ENABLED))
+        _max_entries = max(1, int(conf.get(PROGRAM_CACHE_MAX_ENTRIES)))
+        _active_conf_fp = fp
+        while len(_cache) > _max_entries:
+            _release(_cache.popitem(last=False)[1])
+            _stats["program_cache_evictions"] += 1
+
+
+def _release(prog) -> None:
+    """Drop a program's compiled executables NOW instead of waiting for
+    GC. Each live XLA:CPU executable holds ~10-20 mmap'd segments;
+    a process that merely *retains* a few thousand compiled programs
+    walks into vm.max_map_count (default 65530), at which point the
+    next LLVM JIT mmap fails and the compiler segfaults. Eviction and
+    clear() therefore free eagerly — reference cycles through jit
+    closures must not delay the unmap."""
+    try:
+        prog.clear_cache()
+    except Exception:
+        pass
+
+
+def stats() -> Dict[str, int]:
+    with _lock:
+        out = dict(_stats)
+        out["program_cache_entries"] = len(_cache)
+        return out
+
+
+def clear() -> None:
+    """Drop every entry (releasing compiled executables eagerly) and
+    zero the counters (tests, module teardown)."""
+    with _lock:
+        for prog in _cache.values():
+            _release(prog)
+        _cache.clear()
+        for k in _stats:
+            _stats[k] = 0
+
+
+# ---------------------------------------------------------------------
+# fingerprints: structural identity for bound expression trees (and any
+# package config object — SortOrder, WindowSpec, AggExpr reductions...)
+# ---------------------------------------------------------------------
+_SCALARS = (str, bytes, int, float, bool, complex, type(None))
+
+# the join-rename machinery (session.py) gensyms hidden key columns
+# from a process-global counter (`__join_r<N>_x`): two identical fresh
+# query trees carry different counters in otherwise identical bound
+# expressions. Post-binding, column NAMES are cosmetic — emit works on
+# ordinals — so the fingerprint normalizes the counter away; ordinals
+# and dtypes still distinguish genuinely different columns.
+import re as _re
+
+_GENSYM_RE = _re.compile(r"__join_r\d+_")
+
+
+def expr_fp(obj, _memo: Optional[dict] = None):
+    """Structural fingerprint of a bound expression tree (or any plan
+    config object): class name + dtype + scalar attributes, preorder —
+    the same stability property as the preorder lore ids, so two
+    semantically identical trees built by different DataFrames collide
+    correctly. Unhashable or callable attribute values fall back to
+    `("id", id(v))` — correct (never falsely shared) but unshared."""
+    if isinstance(obj, str):
+        return _GENSYM_RE.sub("__join_r?_", obj)
+    if isinstance(obj, _SCALARS):
+        return obj
+    if _memo is None:
+        _memo = {}
+    oid = id(obj)
+    if oid in _memo:
+        return _memo[oid]
+    if isinstance(obj, (list, tuple)):
+        return ("seq",) + tuple(expr_fp(x, _memo) for x in obj)
+    if isinstance(obj, (set, frozenset)):
+        return ("set",) + tuple(sorted(
+            (repr(expr_fp(x, _memo)) for x in obj)))
+    if isinstance(obj, dict):
+        return ("map",) + tuple(sorted(
+            ((str(k), expr_fp(v, _memo)) for k, v in obj.items())))
+    mod = type(obj).__module__ or ""
+    if mod.startswith("spark_rapids_tpu") and hasattr(obj, "__dict__") \
+            and not callable(obj):
+        _memo[oid] = ("cyc", type(obj).__qualname__)  # cycle guard
+        parts: list = [type(obj).__qualname__]
+        for k, v in sorted(vars(obj).items()):
+            # skip obvious runtime attachments (jitted wrappers,
+            # lore/op ids assigned post-construction don't change
+            # semantics and would split the key per instance)
+            if k.startswith("_jit") or k in ("_op_id", "lore_id",
+                                             "_cached"):
+                continue
+            parts.append((k, expr_fp(v, _memo)))
+        fp = tuple(parts)
+        _memo[oid] = fp
+        return fp
+    if callable(obj):
+        return ("id", oid)
+    try:
+        hash(obj)
+    except TypeError:
+        return ("id", oid)
+    # hashable foreign value (numpy scalar, Decimal, date, dtype...):
+    # identity-hashed objects stay distinct (unshared but correct)
+    return obj
+
+
+def exprs_fp(exprs: Iterable) -> tuple:
+    return tuple(expr_fp(e) for e in exprs)
+
+
+# ---------------------------------------------------------------------
+# avals signature: pytree structure + (shape, dtype) per array leaf
+# ---------------------------------------------------------------------
+def _leaf_sig(x):
+    shape = getattr(x, "shape", None)
+    dtype = getattr(x, "dtype", None)
+    if shape is not None and dtype is not None:
+        return ("a", tuple(shape), str(dtype))
+    # python scalars trace as weak-typed 0-d values: the aval depends on
+    # the python type, never the value
+    if isinstance(x, bool):
+        return ("pyb",)
+    if isinstance(x, int):
+        return ("pyi",)
+    if isinstance(x, float):
+        return ("pyf",)
+    return ("o", type(x).__name__)
+
+
+def avals_signature(args: tuple,
+                    static_argnums: Tuple[int, ...] = ()) -> tuple:
+    import jax
+    static = set(static_argnums)
+    parts = []
+    for i, a in enumerate(args):
+        if i in static:
+            parts.append(("s", a if _hashable(a) else ("id", id(a))))
+        else:
+            leaves, treedef = jax.tree_util.tree_flatten(a)
+            parts.append((treedef, tuple(_leaf_sig(x) for x in leaves)))
+    return tuple(parts)
+
+
+def _hashable(v) -> bool:
+    try:
+        hash(v)
+        return True
+    except TypeError:
+        return False
+
+
+# ---------------------------------------------------------------------
+# the cache proper
+# ---------------------------------------------------------------------
+class CachedProgram:
+    """Callable wrapper over one builder function + site key. Each call
+    computes the input avals signature and resolves the jitted program
+    in the process-global table; a hit from a DIFFERENT exec instance
+    reuses the first-seen builder's trace (that is the point)."""
+
+    __slots__ = ("_fn", "_base_key", "_donate", "_static", "_local")
+
+    def __init__(self, fn, base_key: tuple,
+                 donate_argnums: Tuple[int, ...] = (),
+                 static_argnums: Tuple[int, ...] = ()):
+        self._fn = fn
+        self._base_key = base_key
+        self._donate = tuple(donate_argnums)
+        self._static = tuple(static_argnums)
+        self._local = None  # fallback jit when the cache is disabled
+
+    def _jit(self):
+        import jax
+        kw = {}
+        if self._donate:
+            kw["donate_argnums"] = self._donate
+        if self._static:
+            kw["static_argnums"] = self._static
+        return jax.jit(self._fn, **kw)
+
+    def __call__(self, *args):
+        import jax
+        if not _enabled:
+            if self._local is None:
+                self._local = self._jit()
+            return self._local(*args)
+        sig = avals_signature(args, self._static)
+        key = (self._base_key, self._donate, self._static,
+               jax.default_backend(), _active_conf_fp, sig)
+        with _lock:
+            prog = _cache.get(key)
+            if prog is not None:
+                _cache.move_to_end(key)
+                _stats["program_cache_hits"] += 1
+            else:
+                prog = self._jit()
+                _cache[key] = prog
+                _stats["program_cache_misses"] += 1
+                while len(_cache) > _max_entries:
+                    _release(_cache.popitem(last=False)[1])
+                    _stats["program_cache_evictions"] += 1
+        return prog(*args)
+
+
+def cached_program(fn, *, cls: str, tag: str, key: tuple = (),
+                   donate_argnums: Tuple[int, ...] = (),
+                   static_argnums: Tuple[int, ...] = ()) -> CachedProgram:
+    """Process-global replacement for a per-instance `jax.jit(fn)`.
+
+    `cls`/`tag` name the call site (operator class + which of its
+    programs); `key` carries everything instance-specific the traced
+    program depends on — expression fingerprints (`expr_fp`), chunk
+    counts, capacities, flags. `fn` may close over exactly that keyed
+    state and nothing else. A site whose program genuinely depends on
+    unkeyable instance state must key on `("id", id(self))` — correct
+    but unshared — rather than omit it."""
+    return CachedProgram(fn, ("prog", cls, tag, key),
+                         donate_argnums=donate_argnums,
+                         static_argnums=static_argnums)

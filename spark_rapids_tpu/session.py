@@ -678,6 +678,22 @@ class DataFrame:
         return DataFrame(self._session,
                          L.CachedScan(batches, self._plan.schema))
 
+    def uncache(self) -> "DataFrame":
+        """Release this DataFrame's cached physical plan (exec nodes,
+        their device state, materialized shuffles). The next action
+        re-plans from the logical tree — a FRESH execution, which is
+        what honest benchmarking times (`bench.py` calls this between
+        iterations so repeat runs do not silently reuse resident
+        operator state)."""
+        cached = self._cached
+        if cached is not None:
+            try:
+                cached[1].release()
+            except Exception:
+                pass
+            self._cached = None
+        return self
+
     # -- actions --------------------------------------------------------
     _cached: Optional[tuple] = None
     _last_root = None
@@ -717,6 +733,12 @@ class DataFrame:
         rm.add("xlaCompiles", int(xla1["compiles"] - xla0["compiles"]))
         rm.add("xlaDispatches",
                int(xla1["dispatches"] - xla0["dispatches"]))
+        rm.add("programCacheHits",
+               int(xla1.get("program_cache_hits", 0)
+                   - xla0.get("program_cache_hits", 0)))
+        rm.add("programCacheMisses",
+               int(xla1.get("program_cache_misses", 0)
+                   - xla0.get("program_cache_misses", 0)))
         self._last_root = root
         self._last_metrics = {op: ms.snapshot(ctx.metrics_level)
                               for op, ms in ctx.metrics.items()}
@@ -806,13 +828,7 @@ class DataFrame:
         # ShuffleExchangeExec) would short-circuit re-execution, leaving
         # every operator below them metric-less — ANALYZE must measure a
         # full fresh run
-        cached = self._cached
-        if cached is not None:
-            try:
-                cached[1].release()
-            except Exception:
-                pass
-            self._cached = None
+        self.uncache()
         self.to_arrow()
         root = self._last_root
         recs = op_metrics_records(root, self._last_metrics)

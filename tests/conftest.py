@@ -26,6 +26,21 @@ def pytest_configure(config):
         "slow: heavy variants excluded from tier-1 (-m 'not slow')")
 
 
+@pytest.fixture(autouse=True, scope="module")
+def _drop_program_cache_per_module():
+    """Release the process-global program cache at module boundaries.
+    Every live XLA:CPU executable pins ~10-20 memory mappings; one
+    long pytest process compiling the whole suite's worth of programs
+    walks into vm.max_map_count (65530), after which LLVM's JIT mmap
+    fails and the NEXT compile segfaults. Per-instance jits used to
+    die with their exec trees; this restores that lifetime at module
+    granularity while keeping cross-instance sharing within a module
+    (which is what the cache tests assert)."""
+    yield
+    from spark_rapids_tpu.runtime import program_cache
+    program_cache.clear()
+
+
 @pytest.fixture(scope="session")
 def session():
     return st.TpuSession({

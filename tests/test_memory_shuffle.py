@@ -229,7 +229,7 @@ def test_exchange_map_retry_splits(monkeypatch):
     df = s.create_dataframe({
         "k": pa.array([i % 900 for i in range(n)], pa.int64()),
         "v": pa.array(list(range(n)), pa.int64())})
-    orig = ShuffleExchangeExec._map_fn
+    orig = ShuffleExchangeExec._run_map
     state = {"fired": 0}
 
     def flaky(self, cvs, mask):
@@ -238,7 +238,7 @@ def test_exchange_map_retry_splits(monkeypatch):
             raise RuntimeError("RESOURCE_EXHAUSTED: injected")
         return orig(self, cvs, mask)
 
-    monkeypatch.setattr(ShuffleExchangeExec, "_map_fn", flaky)
+    monkeypatch.setattr(ShuffleExchangeExec, "_run_map", flaky)
     out = df.group_by("k").agg(F.sum("v").alias("s")).to_arrow()
     got = dict(zip(out.column(0).to_pylist(), out.column(1).to_pylist()))
     want = {}

@@ -1,0 +1,215 @@
+"""Process-global XLA program cache (runtime/program_cache.py):
+cross-instance sharing, key sensitivity (dtype / capacity / conf),
+LRU bounding, thread safety, and the end-to-end guarantee the cache
+exists for — a FRESH same-shaped query tree performs zero new XLA
+compiles on a warm process."""
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.profiler import xla_stats
+from spark_rapids_tpu.runtime import program_cache
+from spark_rapids_tpu.runtime.program_cache import (CachedProgram,
+                                                    cached_program,
+                                                    expr_fp, exprs_fp)
+from spark_rapids_tpu.workloads import tpch
+
+_BASE = {"spark.rapids.tpu.sql.batchSizeRows": 512}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    """Each test starts from an empty cache with default sizing (the
+    cache is process-global state; later tests must not inherit the
+    tiny max_entries a previous test configured)."""
+    program_cache.clear()
+    program_cache.set_active_conf(st.TpuSession(dict(_BASE)).conf)
+    yield
+    program_cache.clear()
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+# ---------------------------------------------------------------------
+# unit: the cache proper
+# ---------------------------------------------------------------------
+def test_cross_instance_hit():
+    """Two wrappers with the same (cls, tag, key) share one compiled
+    program: the second call is a hit, not a second trace."""
+    jnp = _jnp()
+    traces = {"n": 0}
+
+    def make():
+        def f(x):
+            traces["n"] += 1  # runs once per trace, not per call
+            return x + 1
+        return cached_program(f, cls="T", tag="run", key=("k",))
+
+    a, b = make(), make()
+    x = jnp.arange(8)
+    assert np.asarray(a(x))[3] == 4
+    assert np.asarray(b(x))[3] == 4
+    s = program_cache.stats()
+    assert traces["n"] == 1
+    assert s["program_cache_misses"] == 1
+    assert s["program_cache_hits"] == 1
+    assert s["program_cache_entries"] == 1
+
+
+def test_key_miss_on_dtype_and_capacity():
+    """The avals signature splits the key: a different input dtype or a
+    different (bucketed) capacity is a separate program."""
+    jnp = _jnp()
+    p = cached_program(lambda x: x * 2, cls="T", tag="run")
+    p(jnp.arange(8, dtype=jnp.int32))
+    p(jnp.arange(8, dtype=jnp.int32))          # hit
+    p(jnp.arange(8, dtype=jnp.float32))        # dtype -> miss
+    p(jnp.arange(16, dtype=jnp.int32))         # capacity -> miss
+    s = program_cache.stats()
+    assert s["program_cache_misses"] == 3
+    assert s["program_cache_hits"] == 1
+
+
+def test_key_miss_on_site_key_and_conf_change():
+    jnp = _jnp()
+    x = jnp.arange(4)
+    cached_program(lambda v: v + 1, cls="T", tag="run", key=(1,))(x)
+    cached_program(lambda v: v + 2, cls="T", tag="run", key=(2,))(x)
+    assert program_cache.stats()["program_cache_misses"] == 2
+    # a jit-relevant conf change (stageFusion.maxOps) splits the key
+    # even at identical (cls, tag, key, avals)
+    program_cache.set_active_conf(st.TpuSession({
+        **_BASE,
+        "spark.rapids.tpu.sql.exec.stageFusion.maxOps": 3}).conf)
+    cached_program(lambda v: v + 1, cls="T", tag="run", key=(1,))(x)
+    assert program_cache.stats()["program_cache_misses"] == 3
+
+
+def test_expr_fp_structural_identity():
+    """Semantically identical bound expression trees built separately
+    fingerprint identically; different literals do not."""
+    from spark_rapids_tpu.expr.expressions import col, lit
+    sch = st.TpuSession(dict(_BASE)).create_dataframe(
+        pa.table({"a": pa.array([1, 2], pa.int64())})).schema
+    e1 = (col("a") + lit(1)).bind(sch)
+    e2 = (col("a") + lit(1)).bind(sch)
+    e3 = (col("a") + lit(2)).bind(sch)
+    assert expr_fp(e1) == expr_fp(e2)
+    assert expr_fp(e1) != expr_fp(e3)
+    assert exprs_fp([e1, e3]) == exprs_fp([e2, e3])
+
+
+def test_lru_eviction_under_small_cap():
+    jnp = _jnp()
+    session = st.TpuSession({
+        **_BASE, "spark.rapids.tpu.sql.exec.programCache.maxEntries": 2})
+    program_cache.set_active_conf(session.conf)
+    x = jnp.arange(4)
+    p = [cached_program(lambda v, _i=i: v + _i, cls="T", tag="run",
+                        key=(i,)) for i in range(3)]
+    p[0](x)
+    p[1](x)
+    p[2](x)                     # evicts key 0 (LRU)
+    s = program_cache.stats()
+    assert s["program_cache_entries"] == 2
+    assert s["program_cache_evictions"] == 1
+    p[1](x)                     # still resident
+    assert program_cache.stats()["program_cache_hits"] == 1
+    p[0](x)                     # re-miss after eviction
+    assert program_cache.stats()["program_cache_misses"] == 4
+
+
+def test_disabled_cache_falls_back_to_local_jit():
+    jnp = _jnp()
+    session = st.TpuSession({
+        **_BASE, "spark.rapids.tpu.sql.exec.programCache.enabled": False})
+    program_cache.set_active_conf(session.conf)
+    p = cached_program(lambda v: v * 3, cls="T", tag="run")
+    assert np.asarray(p(jnp.arange(4)))[2] == 6
+    s = program_cache.stats()
+    assert s["program_cache_entries"] == 0
+    assert s["program_cache_misses"] == 0
+    assert isinstance(p, CachedProgram) and p._local is not None
+
+
+def test_thread_safety_smoke():
+    """Concurrent callers racing the same and different keys: results
+    stay correct and the accounting adds up (hits+misses == calls)."""
+    jnp = _jnp()
+    errs = []
+
+    def worker(i):
+        try:
+            p = cached_program(lambda v, _k=i % 4: v + _k, cls="T",
+                               tag="smoke", key=(i % 4,))
+            for _ in range(5):
+                out = np.asarray(p(jnp.arange(8)))
+                assert out[0] == i % 4
+        except Exception as e:  # pragma: no cover - failure reporting
+            errs.append(repr(e))
+
+    threads = [threading.Thread(target=worker, args=(i,))
+               for i in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs, errs
+    s = program_cache.stats()
+    assert s["program_cache_hits"] + s["program_cache_misses"] == 40
+    assert s["program_cache_entries"] == 4
+
+
+# ---------------------------------------------------------------------
+# end-to-end: zero recompiles for fresh same-shaped queries
+# ---------------------------------------------------------------------
+def _root_metric(df, name):
+    return df.last_metrics()[df._last_root._op_id].get(name)
+
+
+@pytest.mark.parametrize("qn", [1, 6])
+def test_fresh_session_zero_recompile(qn):
+    """The tentpole guarantee: a SECOND, completely fresh Session +
+    DataFrame tree over same-shaped data performs zero new XLA compiles
+    — every program comes from the process-global cache."""
+    tabs = tpch.gen_all(sf=0.01, seed=11)
+
+    def run():
+        s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 4096})
+        dfs = {k: s.create_dataframe(v) for k, v in tabs.items()}
+        q = tpch.queries()[qn](dfs)
+        out = q.to_arrow()
+        return out, q
+
+    first, q_first = run()
+    assert _root_metric(q_first, "xlaCompiles") > 0
+    second, q_second = run()
+    assert second.equals(first)
+    assert _root_metric(q_second, "xlaCompiles") == 0, (
+        f"fresh q{qn} recompiled on a warm process")
+    assert _root_metric(q_second, "programCacheHits") > 0
+    assert _root_metric(q_second, "programCacheMisses") == 0
+
+
+def test_uncache_forces_fresh_execution_same_result():
+    """DataFrame.uncache() drops the resident physical plan; the next
+    action re-plans and re-executes — same bytes, and still zero new
+    compiles thanks to the program cache."""
+    s = st.TpuSession(dict(_BASE))
+    t = pa.table({"a": pa.array(list(range(1000)), pa.int64())})
+    import spark_rapids_tpu.functions as F
+    df = s.create_dataframe(t).group_by().agg(F.sum("a").alias("s"))
+    first = df.to_arrow()
+    root1 = df._last_root
+    df.uncache()
+    assert df._cached is None
+    second = df.to_arrow()
+    assert second.equals(first)
+    assert df._last_root is not root1
+    assert _root_metric(df, "xlaCompiles") == 0

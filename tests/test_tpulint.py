@@ -221,6 +221,91 @@ def test_donate_argnums_suppresses():
 
 
 # ----------------------------------------------------------------------
+# jit-instance
+# ----------------------------------------------------------------------
+def _lint_exec(src):
+    return lint_source(textwrap.dedent(src),
+                       "spark_rapids_tpu/exec/snippet.py")
+
+
+def test_jit_instance_fires_on_self_assignment():
+    vs = _lint_exec("""
+        import jax
+
+        class P:
+            def __init__(self, fn):
+                self._jit = jax.jit(fn)
+    """)
+    assert [v.rule for v in vs] == ["jit-instance"]
+    assert "cached_program" in vs[0].message
+
+
+def test_jit_instance_fires_on_memo_dict_store():
+    vs = _lint_exec("""
+        import jax
+
+        class P:
+            def run(self, nchunks):
+                fn = self._cache.get(nchunks)
+                if fn is None:
+                    fn = jax.jit(self._build(nchunks))
+                    self._cache[nchunks] = fn
+                return fn
+    """)
+    assert [v.rule for v in vs] == ["jit-instance"]
+
+
+def test_jit_instance_decorator_not_flagged():
+    """Class-level @jax.jit staticmethods are one process-global program
+    already; partial(jax.jit, ...) decorators likewise."""
+    assert [v.rule for v in _lint_exec("""
+        from functools import partial
+        import jax
+
+        class P:
+            @staticmethod
+            @jax.jit
+            def stats(x):
+                return x + 1
+
+            @staticmethod
+            @partial(jax.jit, donate_argnums=(0,))
+            def bump(acc):
+                return acc + 1
+    """)] == []
+
+
+def test_jit_instance_outside_exec_not_flagged():
+    assert _rules("""
+        import jax
+
+        class P:
+            def __init__(self, fn):
+                self._jit = jax.jit(fn)
+    """) == []
+
+
+def test_jit_instance_module_function_not_flagged():
+    assert [v.rule for v in _lint_exec("""
+        import jax
+
+        def build(fn):
+            return jax.jit(fn)
+    """)] == []
+
+
+def test_jit_instance_allow_marker_suppresses():
+    assert [v.rule for v in _lint_exec("""
+        import jax
+
+        class P:
+            def __init__(self, fn):
+                # tpulint: allow[jit-instance] keyed on unshareable state
+                self._jit = jax.jit(fn)
+    """)] == []
+
+
+# ----------------------------------------------------------------------
 # allow markers
 # ----------------------------------------------------------------------
 def test_marker_on_line_suppresses():

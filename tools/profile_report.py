@@ -145,11 +145,24 @@ def report(events: List[dict], top: int = 0) -> str:
                     f"  watermarks: device peak "
                     f"{fmt_bytes(e.get('devicePeakBytes', 0))}, host "
                     f"peak {fmt_bytes(e.get('hostPeakBytes', 0))}")
-            elif e["event"] == "xla_compile" and e.get("compiles"):
-                lines.append(
-                    f"  xla: {e['compiles']} compiles, "
-                    f"{e.get('compile_secs', 0):.2f}s compiling, "
-                    f"{e.get('cache_hits', 0)} persistent-cache hits")
+            elif e["event"] == "xla_compile" and (
+                    e.get("compiles")
+                    or e.get("program_cache_hits")
+                    or e.get("program_cache_misses")):
+                line = (f"  xla: {int(e.get('compiles', 0))} compiles, "
+                        f"{e.get('compile_secs', 0):.2f}s compiling, "
+                        f"{int(e.get('cache_hits', 0))} "
+                        f"persistent-cache hits")
+                if e.get("program_cache_hits") is not None \
+                        or e.get("program_cache_misses") is not None:
+                    line += (f"; program cache "
+                             f"{int(e.get('program_cache_hits', 0))} "
+                             f"hits / "
+                             f"{int(e.get('program_cache_misses', 0))} "
+                             f"misses / "
+                             f"{int(e.get('program_cache_evictions', 0))}"
+                             f" evictions")
+                lines.append(line)
         lines.append("")
     return "\n".join(lines)
 
