@@ -24,6 +24,15 @@ Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", "extra"}.
 
 `--smoke` (or BENCH_SMOKE=1) is the CI profile: tiny scale factors,
 2 iterations, scan profile skipped — same JSON shape in ~a minute.
+
+`--concurrent N` is the TPC-H *throughput* mode (the service PR's
+acceptance surface): N client streams submit shuffled query mixes
+through the session's QueryManager, reporting makespan, per-query
+p50/p99 latency, queue-wait share, and service counters
+(admitted/queued_peak/cancelled); every stream result is asserted
+byte-identical to a serial reference run, and a forced mid-stream
+cancel must leave zero resource leaks. Under --smoke the standard
+bench also runs a 2-stream variant and embeds it in `extra`.
 """
 import contextlib
 import json
@@ -47,11 +56,13 @@ import numpy as np  # noqa: E402
 # defaults now leave real headroom (600s global, 45s/query).
 #
 # --smoke (or BENCH_SMOKE=1): CI profile — tiny scale factors, 2 iters,
-# no scan profile; exercises every code path in ~a minute.
+# no scan profile; exercises every code path including a 2-stream
+# concurrent-service pass (330s budget: the sweep drains to its ~30s
+# floor, and the concurrent tail section needs room after it).
 _SMOKE = ("--smoke" in sys.argv[1:]
           or os.environ.get("BENCH_SMOKE", "") == "1")
 _BUDGET_S = float(os.environ.get("BENCH_BUDGET_S",
-                                 "240" if _SMOKE else "600"))
+                                 "330" if _SMOKE else "600"))
 _QUERY_BUDGET_S = float(os.environ.get("BENCH_QUERY_BUDGET_S",
                                        "20" if _SMOKE else "45"))
 _T0 = time.monotonic()
@@ -61,6 +72,16 @@ _T0 = time.monotonic()
 # trajectory carries attribution, not just totals
 _PROFILE = ("--profile" in sys.argv[1:]
             or os.environ.get("BENCH_PROFILE", "") == "1")
+
+# --concurrent N: TPC-H throughput mode through the query service
+_CONCURRENT = 0
+if "--concurrent" in sys.argv[1:]:
+    _ci = sys.argv.index("--concurrent")
+    try:
+        _CONCURRENT = int(sys.argv[_ci + 1])
+    except (IndexError, ValueError):
+        print("bench: --concurrent needs a stream count", file=sys.stderr)
+        sys.exit(2)
 
 # milestone metrics flushed verbatim when the budget expires mid-run
 _partial = {"extra": {}}
@@ -235,6 +256,27 @@ def _main_impl():
     from spark_rapids_tpu.columnar.column import Column
     from spark_rapids_tpu.workloads import tpch
 
+    # ---- standalone throughput mode: bench.py --concurrent N ----------
+    if _CONCURRENT:
+        sf_c = float(os.environ.get("BENCH_SF_FULL",
+                                    "0.05" if _SMOKE else "1.0"))
+        # the throughput mode is the whole run: no pre-sweep sections
+        # follow it, so reserve only the final-flush tail
+        with _alarm(_remaining() - 15.0, f"concurrent x{_CONCURRENT}"):
+            s = st.TpuSession()
+            conc = _concurrent_throughput(s, sf_c, _CONCURRENT)
+        print(json.dumps({
+            "metric": (f"tpch_throughput_{_CONCURRENT}streams_"
+                       f"sf{sf_c}_q_per_s"),
+            "value": conc["queries_per_sec"],
+            "unit": "queries/s",
+            "vs_baseline": conc["throughput_vs_serial"],
+            **({"backend_fallback": "cpu (tpu unreachable)",
+                "tpu_probe_errors": tpu_errors} if fellback else {}),
+            "extra": conc,
+        }))
+        return
+
     # ---- Q6 @ BENCH_SF --------------------------------------------------
     _arm("q6 hot")
     at = tpch.gen_lineitem(sf=sf, seed=7)
@@ -391,6 +433,29 @@ def _main_impl():
     # Skipped under --smoke: it rewrites the whole dataset as parquet.
     if _SMOKE:
         _partial["extra"]["smoke"] = True
+        # 2-stream throughput variant: the concurrent query service's
+        # smoke surface (byte-identical to serial, no leaks after a
+        # forced cancel, service counters in extra.service). This is
+        # the LAST section before the final emit, so it reserves only
+        # the flush tail (the 120s _arm reserve would starve it — the
+        # sweep already drained the budget near its own floor), and it
+        # runs an 8-query warm-replay-fast subset, not all 22.
+        try:
+            with _alarm(max(0.0, _remaining() - 10.0),
+                        "concurrent 2-stream smoke"):
+                conc = _concurrent_throughput(
+                    s, sf_full, 2,
+                    qids=(3, 5, 6, 9, 11, 12, 14, 19))
+            _partial["extra"]["concurrent_2stream"] = conc
+            _partial["extra"]["service"] = conc["service"]
+        except _BenchTimeout as e:
+            _partial["extra"]["concurrent_2stream"] = {
+                "error": f"timeout: {e}"}
+        except Exception as e:  # advisory: never lose the bench result
+            _partial["extra"]["concurrent_2stream"] = {
+                "error": repr(e)[:300]}
+            print(f"bench: concurrent smoke failed: {e!r}",
+                  file=sys.stderr)
     else:
         try:
             _arm("scan profile")
@@ -433,7 +498,8 @@ def _main_impl():
     }
     # milestone-only keys (scan profile, smoke flag) must survive into
     # the success-path JSON too, not just the partial flush
-    for k in ("scan_profile", "smoke", "fresh_rerun_compiles"):
+    for k in ("scan_profile", "smoke", "fresh_rerun_compiles",
+              "concurrent_2stream", "service"):
         if k in _partial["extra"]:
             extra[k] = _partial["extra"][k]
     # ---- regression gate vs the previous round's JSON -------------------
@@ -567,6 +633,118 @@ def _tpch_sweep(s, sf: float):
         out["tpch_profile"] = profile
     if errors:
         out["tpch_all22_errors"] = errors
+    return out
+
+
+def _concurrent_throughput(s, sf: float, n_streams: int,
+                           qids=None) -> dict:
+    """TPC-H throughput mode: N client streams each run a shuffled
+    permutation of the 22 queries (or the `qids` subset) through the
+    session's QueryManager (DataFrame.submit -> fair scheduler ->
+    admission -> semaphore). Returns makespan, p50/p99 stream-query
+    latency, queue-wait share, service counters, and asserts (a) every
+    concurrent result is byte-identical to the serial reference and
+    (b) a forced mid-stream cancel leaks nothing."""
+    import random
+    import threading
+
+    from spark_rapids_tpu.memory.diagnostics import leak_report
+    from spark_rapids_tpu.workloads import tpch
+
+    tabs = tpch.gen_all(sf=sf, seed=7)
+    dfs = {k: s.create_dataframe(v).cache() for k, v in tabs.items()}
+    reg = tpch.queries()
+    qids = sorted(reg) if qids is None else [q for q in qids if q in reg]
+
+    # serial reference: one pass, results kept for the identity assert
+    serial = {}
+    t0 = time.perf_counter()
+    for qn in qids:
+        serial[qn] = reg[qn](dfs).to_arrow()
+    serial_s = time.perf_counter() - t0
+
+    mgr = s.query_manager()
+    base_stats = dict(mgr.stats)
+    lk0 = leak_report()
+
+    results = []        # (qn, table, latency_s, queue_wait_ms)
+    errors = []
+    lock = threading.Lock()
+
+    def stream(i: int):
+        order = qids[:]
+        random.Random(1234 + i).shuffle(order)
+        for qn in order:
+            t1 = time.perf_counter()
+            try:
+                h = reg[qn](dfs).submit()
+                tbl = h.result()
+                lat = time.perf_counter() - t1
+                with lock:
+                    results.append((qn, tbl, lat, h.queue_wait_ms))
+            except Exception as e:  # noqa: BLE001 — reported in JSON
+                with lock:
+                    errors.append(f"stream{i} q{qn}: {e!r}")
+
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=stream, args=(i,),
+                                name=f"bench-stream-{i}")
+               for i in range(n_streams)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    makespan = time.perf_counter() - t0
+
+    mismatched = sorted({qn for qn, tbl, _, _ in results
+                         if not tbl.equals(serial[qn])})
+    assert not mismatched, (
+        f"concurrent results diverge from serial reference for "
+        f"queries {mismatched}")
+
+    # forced mid-stream cancel: submit one more query, cancel at once,
+    # and require the resource picture back at the pre-submit baseline
+    h = reg[9](dfs).submit()
+    h.cancel("bench forced mid-stream cancel")
+    try:
+        h.result(timeout=120)
+    except Exception:  # noqa: BLE001 — cancelled or finished-first: both fine
+        pass
+    lk1 = leak_report()
+    assert lk1["openHandles"] == lk0["openHandles"] \
+        and lk1["deviceReservedBytes"] == lk0["deviceReservedBytes"], (
+        f"resource leak after forced cancel: {lk0} -> {lk1}")
+
+    lats = sorted(r[2] for r in results)
+    stats = mgr.stats
+    out = {
+        "streams": n_streams,
+        "sf": sf,
+        "queries_completed": len(results),
+        "makespan_s": round(makespan, 3),
+        "serial_reference_s": round(serial_s, 3),
+        # back-to-back serial time for the same N-stream workload,
+        # divided by the concurrent makespan = throughput speedup
+        "throughput_vs_serial": round(serial_s * n_streams
+                                      / max(makespan, 1e-9), 3),
+        "queries_per_sec": round(len(results) / max(makespan, 1e-9), 3),
+        "p50_s": round(lats[len(lats) // 2], 4) if lats else None,
+        "p99_s": round(lats[min(len(lats) - 1,
+                                int(0.99 * len(lats)))], 4)
+        if lats else None,
+        "queue_wait_share": round(
+            (sum(r[3] for r in results) / 1e3)
+            / max(sum(lats), 1e-9), 4) if lats else None,
+        "service": {
+            "admitted": stats["admitted"] - base_stats["admitted"],
+            "queued_peak": stats["queued_peak"],
+            "cancelled": stats["cancelled"] - base_stats["cancelled"],
+        },
+    }
+    if errors:
+        out["errors"] = errors[:10]
+    for df in dfs.values():
+        df.uncache()
     return out
 
 

@@ -32,6 +32,12 @@ module turns those conventions into machine-checked rules (consumed by
                    `runtime/program_cache.cached_program` (class-level
                    `@jax.jit` decorators are already process-global and
                    are not flagged)
+  ctx-cancel       an exec/ batch loop over execute_partition /
+                   execute_all whose body never calls
+                   `ctx.check_cancel()`: a cancelled or timed-out query
+                   would run the operator to completion instead of
+                   stopping at the next batch boundary (the query
+                   service's cooperative-cancellation contract)
   allow-no-reason  a `# tpulint: allow[...]` marker without a reason —
                    every accepted violation must say why
 
@@ -498,6 +504,45 @@ def rule_jit_instance(ctx: _ModuleCtx):
                    f"globally")
 
 
+def rule_ctx_cancel(ctx: _ModuleCtx):
+    """Flag exec/ batch loops (`for ... in <x>.execute_partition(...)`
+    or `.execute_all(...)`) whose body never polls the cooperative
+    cancel token: the query service (service/query_manager.py) can only
+    stop a query at sites that call `ctx.check_cancel()`, so a loop
+    without one turns cancel/deadline into a no-op for that operator.
+    Comprehension-shaped collectors are not flagged (they cannot host a
+    statement; their inner operators carry the checkpoints)."""
+    if not re.search(r"(^|/)exec/", ctx.path):
+        return
+
+    def pulls_batches(e) -> bool:
+        for n in ast.walk(e):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr in ("execute_partition",
+                                        "execute_all"):
+                return True
+        return False
+
+    def body_checks(stmts) -> bool:
+        for s in stmts:
+            for n in ast.walk(s):
+                if isinstance(n, ast.Call) \
+                        and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "check_cancel":
+                    return True
+        return False
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.For) and pulls_batches(node.iter) \
+                and not body_checks(node.body):
+            yield (node.lineno, node.col_offset, "ctx-cancel",
+                   "batch loop over execute_partition/execute_all "
+                   "never polls the cancel token: a cancelled or "
+                   "timed-out query runs this operator to completion — "
+                   "add ctx.check_cancel() at the top of the loop body")
+
+
 RULES = {
     "host-sync": rule_host_sync,
     "block-sync": rule_block_sync,
@@ -505,6 +550,7 @@ RULES = {
     "strong-literal": rule_strong_literal,
     "donate-missing": rule_donate_missing,
     "jit-instance": rule_jit_instance,
+    "ctx-cancel": rule_ctx_cancel,
 }
 
 

@@ -49,15 +49,18 @@ class _Executor:
 
 
 class _Task:
-    __slots__ = ("task_id", "fn", "args", "tables", "future", "attempts")
+    __slots__ = ("task_id", "fn", "args", "tables", "future", "attempts",
+                 "tag")
 
-    def __init__(self, task_id, fn, args, tables=None):
+    def __init__(self, task_id, fn, args, tables=None, tag=None):
         self.task_id = task_id
         self.fn = fn
         self.args = args
         self.tables = tables
         self.future: Future = Future()
         self.attempts = 0
+        # query_id of the owning query (cancel drains by tag)
+        self.tag = tag
 
 
 class ClusterManager:
@@ -78,6 +81,9 @@ class ClusterManager:
         self._idle: "queue.Queue[int]" = queue.Queue()
         self._lock = threading.Lock()
         self._next_task = 0
+        # tags (query_ids) whose tasks were cancelled: dispatch skips
+        # them, results for them are dropped on arrival
+        self._dead_tags: set = set()
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._listener: Optional[socket.socket] = None
@@ -145,14 +151,47 @@ class ClusterManager:
             self._listener.close()
 
     # -- public API ----------------------------------------------------
-    def submit(self, fn: Callable, *args, tables=None) -> Future:
+    def submit(self, fn: Callable, *args, tables=None,
+               tag=None) -> Future:
         """Schedule fn(*args) on an executor. When `tables` is given (a
         possibly-empty list of pyarrow Tables), they ride the task frame
         as Arrow IPC and arrive appended as the final positional
-        argument of fn — arity is stable even for an empty list."""
-        t = _Task(self._alloc_id(), fn, args, tables)
+        argument of fn — arity is stable even for an empty list. `tag`
+        groups tasks for cancel_tag() (the query_id in service runs)."""
+        t = _Task(self._alloc_id(), fn, args, tables, tag=tag)
         self._pending.put(t)
         return t.future
+
+    def cancel_tag(self, tag) -> int:
+        """Cancel every task submitted under `tag`: queued tasks are
+        drained and their futures failed; in-flight results arriving
+        later are dropped (the executor finishes the fragment but the
+        bytes never resolve a future). Returns the number of queued
+        tasks drained. Executors are NOT killed — cooperative cancel on
+        the driver side only, matching the engine's checkpoint model."""
+        if tag is None:
+            return 0
+        with self._lock:
+            self._dead_tags.add(tag)
+        drained = 0
+        keep: List[_Task] = []
+        while True:
+            try:
+                t = self._pending.get_nowait()
+            except queue.Empty:
+                break
+            if t.tag == tag:
+                drained += 1
+                try:
+                    t.future.set_exception(RuntimeError(
+                        f"task {t.task_id} cancelled (tag {tag})"))
+                except Exception:
+                    pass
+            else:
+                keep.append(t)
+        for t in keep:
+            self._pending.put(t)
+        return drained
 
     def map(self, fn: Callable, items) -> List[Any]:
         futures = [self.submit(fn, it) for it in items]
@@ -224,6 +263,17 @@ class ClusterManager:
                 task = self._pending.get(timeout=0.1)
             except queue.Empty:
                 continue
+            with self._lock:
+                dead = task.tag is not None \
+                    and task.tag in self._dead_tags
+            if dead:
+                try:
+                    task.future.set_exception(RuntimeError(
+                        f"task {task.task_id} cancelled "
+                        f"(tag {task.tag})"))
+                except Exception:
+                    pass
+                continue
             while not self._stop.is_set():
                 try:
                     eid = self._idle.get(timeout=0.2)
@@ -288,10 +338,25 @@ class ClusterManager:
                 self._mark_lost(eid)
                 return
             task_id = payload.get("task_id")
+            dropped = False
             with self._lock:
                 ex = self._executors.get(eid)
                 task = ex.inflight.pop(task_id, None) if ex else None
+                if task is not None and task.tag is not None \
+                        and task.tag in self._dead_tags:
+                    # cancelled mid-flight: drop the result, re-idle
+                    # the executor, fail the future for any waiter
+                    try:
+                        task.future.set_exception(RuntimeError(
+                            f"task {task.task_id} cancelled "
+                            f"(tag {task.tag})"))
+                    except Exception:
+                        pass
+                    task = None
+                    dropped = True
             if task is None:
+                if dropped:
+                    self._idle.put(eid)
                 continue
             try:
                 if kind == "result":

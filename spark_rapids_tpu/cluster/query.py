@@ -195,7 +195,8 @@ class DistributedRunner:
     def run(self, splits: Sequence, map_fn: Callable,
             part_keys: Sequence[str], reduce_fn: Callable,
             n_reduce: Optional[int] = None,
-            final_fn: Optional[Callable] = None):
+            final_fn: Optional[Callable] = None,
+            token=None):
         """Execute map fragments over `splits`, peer-to-peer shuffle on
         `part_keys` into `n_reduce` buckets, run reduce fragments, and
         (optionally) a driver-side final fragment over the concatenated
@@ -207,7 +208,14 @@ class DistributedRunner:
         block METADATA {pid -> (addr, sizes)}; reducers fetch blocks
         directly from mappers. A reduce whose fetch fails (dead mapper
         / evicted shuffle) triggers lineage re-execution of the
-        affected map splits, then one reduce retry."""
+        affected map splits, then one reduce retry.
+
+        `token` (a service CancelToken) makes the run cancellable at
+        fragment boundaries: the driver polls it before dispatching
+        each stage and between fragment results; on trip it drains the
+        query's pending tasks (cancel_tag) and drops in-flight results,
+        so executors finish their current fragment but no new work
+        starts and nothing resolves back to the caller."""
         import uuid
 
         import pyarrow as pa
@@ -235,16 +243,23 @@ class DistributedRunner:
             if w is not None:
                 w.emit(event, **kw)
 
+        def check():
+            # cooperative cancel checkpoint at fragment boundaries
+            if token is not None:
+                token.check()
+
         def run_maps(idxs, attempt=0):
+            check()
             emit("stage_submit", stage="map", n_tasks=len(idxs),
                  attempt=attempt)
             t0 = time.perf_counter()
             futs = {i: self.cm.submit(
                 map_fragment_task, map_fn, splits[i], self.conf,
-                n_reduce, list(part_keys), shuffle_id, i)
+                n_reduce, list(part_keys), shuffle_id, i, tag=qid)
                 for i in idxs}
             out = {}
             for i, f in futs.items():
+                check()
                 out[i] = f.result()
                 self._absorb(f, stages)
             wall = time.perf_counter() - t0
@@ -267,6 +282,7 @@ class DistributedRunner:
 
             try:
                 for attempt in range(3):
+                    check()
                     # per-pid fetch plan: mapper addr -> map ids that
                     # produced blocks for that pid
                     all_pids = sorted({p for m2 in metas.values()
@@ -286,11 +302,12 @@ class DistributedRunner:
                                    for a, ids in sorted(by_addr.items())]
                         rfuts.append((pid, self.cm.submit(
                             reduce_fetch_task, reduce_fn, self.conf,
-                            shuffle_id, pid, sources)))
+                            shuffle_id, pid, sources, tag=qid)))
                     emit("stage_submit", stage="reduce",
                          n_tasks=len(rfuts), attempt=attempt)
                     refetch = set()
                     for pid, f in rfuts:
+                        check()
                         try:
                             done[pid] = f.result().tables[0]
                             self._absorb(f, stages)
@@ -338,6 +355,12 @@ class DistributedRunner:
             return result
         except BaseException as e:
             status, err = "error", repr(e)
+            # drain this query's pending fragments and drop in-flight
+            # results so a cancelled/failed run leaves the cluster idle
+            try:
+                self.cm.cancel_tag(qid)
+            except Exception:
+                pass
             raise
         finally:
             # merge each stage's op records lore-keyed (stable across

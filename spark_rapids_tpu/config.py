@@ -457,6 +457,56 @@ MESH_DEVICES = _conf(
     "(jax.sharding.Mesh) instead of the host file shuffle — the TPU-pod "
     "analog of the reference's UCX shuffle mode. 0 disables (single-chip "
     "+ host shuffle).", int)
+SERVICE_QUERY_TIMEOUT_SECS = _conf(
+    "sql.service.queryTimeoutSecs", 0.0,
+    "Wall-clock deadline per query, measured from submission (queue "
+    "time counts). Past it the query's CancelToken trips and the next "
+    "cooperative checkpoint (batch/stage/shuffle boundary, semaphore "
+    "wait) raises QueryTimedOut; queued queries past their deadline "
+    "are killed without ever being admitted. 0 = no deadline.", float)
+SERVICE_SCHEDULER_MODE = _conf(
+    "sql.service.scheduler.mode", "fair",
+    "Cross-query scheduling policy: 'fair' (deficit-round-robin across "
+    "weighted pools, FIFO within a pool — the Spark fair-scheduler "
+    "analog) or 'fifo' (global submission order, pools ignored).", str)
+SERVICE_SCHEDULER_POOLS = _conf(
+    "sql.service.scheduler.pools", "default:1",
+    "Weighted scheduler pools as 'name:weight,name:weight,...'. Under "
+    "saturation a pool's admission share is proportional to its "
+    "weight; a query picks its pool via sql.service.pool (unknown "
+    "pool names are created on the fly with weight 1).", str)
+SERVICE_POOL = _conf(
+    "sql.service.pool", "default",
+    "Scheduler pool this session's queries submit into (the "
+    "spark.scheduler.pool analog). Pool weight also becomes the "
+    "TpuSemaphore acquire priority, so heavier pools win device "
+    "admission ties.", str)
+SERVICE_MAX_CONCURRENT = _conf(
+    "sql.service.maxConcurrentQueries", 4,
+    "Upper bound on queries RUNNING concurrently in one engine "
+    "process; further admitted work queues in the scheduler. Distinct "
+    "from sql.concurrentTpuTasks, which bounds tasks on the chip "
+    "within the already-admitted queries.", int)
+SERVICE_ADMISSION_ENABLED = _conf(
+    "sql.service.admission.enabled", True,
+    "Memory-aware admission control: a query is only admitted when "
+    "its plan-derived device+host estimate fits alongside the "
+    "already-admitted queries' estimates (scan sizes + join build "
+    "sides from the planner's cardinality estimator). Queries whose "
+    "solo estimate exceeds the budget still run — alone.", bool)
+SERVICE_ADMISSION_DEVICE_FRACTION = _conf(
+    "sql.service.admission.deviceFraction", 0.8,
+    "Fraction of the DeviceManager budget the admission controller "
+    "hands out to concurrently admitted query estimates.", float)
+SERVICE_ADMISSION_HOST_FRACTION = _conf(
+    "sql.service.admission.hostFraction", 0.8,
+    "Fraction of the HostMemoryManager budget admission may commit "
+    "(ignored while the host budget is unlimited).", float)
+SERVICE_ADMISSION_DEVICE_LIMIT = _conf(
+    "sql.service.admission.deviceLimitBytes", 0,
+    "Explicit admission byte budget for device estimates; overrides "
+    "deviceFraction * DeviceManager budget when > 0.", int,
+    internal=True)
 
 
 class TpuConf:

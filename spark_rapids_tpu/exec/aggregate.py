@@ -206,6 +206,7 @@ class UngroupedAggExec(TpuExec):
         acc = None
         for cpid in range(child.num_partitions(ctx)):
             for batch in child.execute_partition(ctx, cpid):
+                ctx.check_cancel()
                 with m.timer("opTime"):
                     if acc is None:
                         acc = self._update_jit(batch.cvs(), batch.row_mask)
@@ -1056,6 +1057,7 @@ class HashAggregateExec(TpuExec):
         compactable = True      # do eager merges still shrink the state?
         for cpid in child_pids:
             for batch in child.execute_partition(ctx, cpid):
+                ctx.check_cancel()
                 with m.timer("opTime"):
                     # split-and-retry: idempotent per-batch first-pass agg
                     # re-executes on halves under memory pressure
@@ -1193,6 +1195,7 @@ class HashAggregateExec(TpuExec):
         handles = []
         from ..memory.retry import retry_no_split
         for batch in self.children[0].execute_partition(ctx, pid):
+            ctx.check_cancel()
             handles.append((retry_no_split(
                 lambda b=batch: store.add_batch(b, priority=8)),
                 batch.capacity))

@@ -39,6 +39,13 @@ class ExecContext:
                               "DEBUG": DEBUG}.get(
             str(self.conf.get(METRICS_LEVEL)).upper(), MODERATE)
         self.metrics_sync = bool(self.conf.get(METRICS_SYNC))
+        # query-service identity + cooperative interruption: the
+        # QueryManager threads its CancelToken through here and every
+        # batch loop polls check_cancel() (lint rule ctx-cancel);
+        # sem_priority is the pool-weight-derived TpuSemaphore priority
+        self.cancel = None
+        self.query_id: Optional[str] = None
+        self.sem_priority = 0
         # SharedBuildExec's per-run materialization cache:
         # {id(node): {pid: [spill handles]}} — closed by close()
         self.shared_handles: Dict[int, dict] = {}
@@ -64,6 +71,15 @@ class ExecContext:
             if op_id not in self.metrics:
                 self.metrics[op_id] = MetricSet(sync=self.metrics_sync)
             return self.metrics[op_id]
+
+    def check_cancel(self):
+        """Cooperative cancellation checkpoint: raises QueryCancelled/
+        QueryTimedOut when this query's token tripped. One attribute
+        read when no service is involved — cheap enough for per-batch
+        polling."""
+        tok = self.cancel
+        if tok is not None:
+            tok.check()
 
 
 class TpuExec:
@@ -130,7 +146,9 @@ class TpuExec:
     # ------------------------------------------------------------------
     def execute_all(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         for pid in range(self.num_partitions(ctx)):
-            yield from self.execute_partition(ctx, pid)
+            for batch in self.execute_partition(ctx, pid):
+                ctx.check_cancel()
+                yield batch
 
     def node_name(self) -> str:
         return type(self).__name__

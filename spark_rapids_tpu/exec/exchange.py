@@ -150,6 +150,7 @@ class ShuffleExchangeExec(TpuExec):
             for mpid in range(child.num_partitions(ctx)):
                 pieces = [[] for _ in range(self.n)]
                 for batch in child.execute_partition(ctx, mpid):
+                    ctx.check_cancel()
                     for host in with_retry(batch, map_one):
                         # tpulint: allow[host-sync] `host` is map_one's fetch output (numpy views)
                         counts_h = np.asarray(host["counts"])

@@ -83,6 +83,7 @@ class ExpandExec(TpuExec):
         m = ctx.metrics_for(self._op_id)
         n_sets = len(self.include_masks)
         for batch in self.children[0].execute_partition(ctx, pid):
+            ctx.check_cancel()
             with m.timer("opTime"):
                 out, out_mask = self._jit(batch.cvs(), batch.row_mask)
             num = (n_sets - 1) * batch.capacity + batch.num_rows

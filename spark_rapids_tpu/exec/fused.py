@@ -104,6 +104,7 @@ class FusedStageExec(TpuExec):
         stats = jnp.zeros(len(self.members), dtype=jnp.int64)
         n_batches = 0
         for batch in self.children[0].execute_partition(ctx, pid):
+            ctx.check_cancel()
             with m.timer("opTime"):
                 cvs, mask, stats = self._jit(batch.cvs(), batch.row_mask,
                                              stats)

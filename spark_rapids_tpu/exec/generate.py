@@ -110,6 +110,7 @@ class GenerateExec(TpuExec):
     def execute_partition(self, ctx, pid):
         m = ctx.metrics_for(self._op_id)
         for batch in self.children[0].execute_partition(ctx, pid):
+            ctx.check_cancel()
             with m.timer("opTime"):
                 cvs = batch.cvs()
                 arr, lens, out_off, total_dev, measures = self._count(

@@ -57,6 +57,7 @@ class HostFilterExec(TpuExec):
     def execute_partition(self, ctx: ExecContext, pid: int):
         m = ctx.metrics_for(self._op_id)
         for batch in self.children[0].execute_partition(ctx, pid):
+            ctx.check_cancel()
             with m.timer("hostEvalTime"):
                 at, rows = _batch_rows(batch)
                 if not rows:
@@ -90,6 +91,7 @@ class HostProjectExec(TpuExec):
     def execute_partition(self, ctx: ExecContext, pid: int):
         m = ctx.metrics_for(self._op_id)
         for batch in self.children[0].execute_partition(ctx, pid):
+            ctx.check_cancel()
             with m.timer("hostEvalTime"):
                 at, rows = _batch_rows(batch)
                 arrays = []

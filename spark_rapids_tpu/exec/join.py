@@ -137,6 +137,7 @@ class HashJoinExec(TpuExec):
         for lpid in ([pid] if self.per_partition
                      else range(base.num_partitions(ctx))):
             for b in base.execute_partition(ctx, lpid):
+                ctx.check_cancel()
                 if self._n_fused:
                     cvs2, mask2 = self._pre_jit(b.cvs(), b.row_mask)
                     xla_stats.count_dispatch()
@@ -951,6 +952,7 @@ class HashJoinExec(TpuExec):
         n_b = fetch_int((jnp.sum(bmask)))
         for lpid in range(left.num_partitions(ctx)):
             for batch in left.execute_partition(ctx, lpid):
+                ctx.check_cancel()
                 scvs, smask = batch.cvs(), batch.row_mask
                 cap_s = batch.capacity
                 sidx = jnp.nonzero(smask, size=cap_s, fill_value=0)[0]
@@ -1003,6 +1005,7 @@ class NestedLoopJoinExec(HashJoinExec):
         right_fields = right.schema.fields
         for lpid in range(left.num_partitions(ctx)):
             for batch in left.execute_partition(ctx, lpid):
+                ctx.check_cancel()
                 scvs, smask = batch.cvs(), batch.row_mask
                 cap_s = batch.capacity
                 sidx = jnp.nonzero(smask, size=cap_s, fill_value=0)[0]

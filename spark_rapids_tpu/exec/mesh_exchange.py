@@ -324,6 +324,7 @@ class MeshExchangeExec(TpuExec):
             try:
                 for cpid in range(child.num_partitions(ctx)):
                     for b in child.execute_partition(ctx, cpid):
+                        ctx.check_cancel()
                         # waiting slot batches are spillable: a slow
                         # child partition must not pin up to n-1 batches
                         # in HBM

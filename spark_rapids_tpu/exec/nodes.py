@@ -726,6 +726,7 @@ class ProjectExec(TpuExec):
     def execute_partition(self, ctx, pid):
         m = ctx.metrics_for(self._op_id)
         for batch in self.children[0].execute_partition(ctx, pid):
+            ctx.check_cancel()
             with m.timer("opTime"):
                 out = self._jit(batch.cvs(), batch.row_mask)
             xla_stats.count_dispatch()
@@ -765,6 +766,7 @@ class FilterExec(TpuExec):
     def execute_partition(self, ctx, pid):
         m = ctx.metrics_for(self._op_id)
         for batch in self.children[0].execute_partition(ctx, pid):
+            ctx.check_cancel()
             with m.timer("opTime"):
                 new_mask = self._jit(batch.cvs(), batch.row_mask)
             xla_stats.count_dispatch()
@@ -855,6 +857,7 @@ class LimitExec(TpuExec):
             if remaining <= 0:
                 return
             for batch in child.execute_partition(ctx, cpid):
+                ctx.check_cancel()
                 if remaining <= 0:
                     return
                 if self._n_fused:
@@ -898,6 +901,7 @@ class UnionExec(TpuExec):
             n = c.num_partitions(ctx)
             if pid < n:
                 for b in c.execute_partition(ctx, pid):
+                    ctx.check_cancel()
                     # positional union: rename child columns to ours
                     yield DeviceBatch(b.table.rename(self.schema.names),
                                       b.num_rows, b.row_mask, b.capacity)
@@ -938,28 +942,48 @@ def collect_to_arrow(root: TpuExec, ctx: ExecContext):
     else:
         sem = _session_semaphore(ctx)
         import concurrent.futures as cf
+        import threading as _threading
+        sem_wait = [0.0]
+        wait_lock = _threading.Lock()
+        # pool-weight-derived base priority (service scheduler): heavier
+        # pools get more-negative values and win permit ties; pid breaks
+        # ties within a query via the heap's seq ordering
+        base_prio = getattr(ctx, "sem_priority", 0)
 
         def run_part(pid):
             # GpuSemaphore model: hold the permit while DEVICE work runs
             # (advancing the iterator executes the jitted kernels), release
             # around the host-side fetch/convert
             out = []
+            waited = 0.0
             it = root.execute_partition(ctx, pid)
-            while True:
-                sem.acquire(priority=pid)
-                try:
-                    b = next(it, None)
-                finally:
-                    sem.release()
-                if b is None:
-                    break
-                out.append(_batch_to_arrow(b))
+            try:
+                while True:
+                    waited += sem.acquire(priority=base_prio,
+                                          token=ctx.cancel)
+                    try:
+                        b = next(it, None)
+                    finally:
+                        sem.release()
+                    if b is None:
+                        break
+                    ctx.check_cancel()
+                    out.append(_batch_to_arrow(b))
+            finally:
+                with wait_lock:
+                    sem_wait[0] += waited
             return out
 
         workers = min(nparts, max(2, ctx.conf.concurrent_tasks * 2))
         with cf.ThreadPoolExecutor(workers) as pool:
             results = list(pool.map(run_part, range(nparts)))
         pieces = [at for r in results for at in r]
+        if sem_wait[0] > 0:
+            # per-query chip-admission wait, surfaced on the root node
+            # (Ms suffix on purpose: op_time_seconds sums *Time keys and
+            # wait is not attributed operator time)
+            ctx.metrics_for(root._op_id).add(
+                "semaphoreWaitMs", round(sem_wait[0] * 1e3, 3))
     if not pieces:
         return root.schema.to_arrow().empty_table()
     return pa.concat_tables(pieces)

@@ -326,6 +326,7 @@ class ArrowEvalPythonExec(TpuExec):
         pool = self._ensure_pool(ctx)
         out_arrow = self.schema.to_arrow()
         for batch in self.children[0].execute_partition(ctx, pid):
+            ctx.check_cancel()
             with m.timer("pythonEvalTime"):
                 out = self._ship(pool, _batch_to_arrow(batch), m,
                                  out_arrow)

@@ -60,6 +60,7 @@ class SharedBuildExec(TpuExec):
                 store = spill_store(ctx.conf)
                 handles = []
                 for b in self.children[0].execute_partition(ctx, pid):
+                    ctx.check_cancel()
                     handles.append(retry_no_split(
                         lambda bb=b: store.add_batch(bb)))
                 cache[pid] = handles
@@ -116,6 +117,7 @@ class RuntimeBloomFilterExec(TpuExec):
                                        tag="merge", key=(afp,))
             with m.timer("bloomBuildTime"):
                 for b in self.build.execute_all(ctx):
+                    ctx.check_cancel()
                     st = upd_jit(b.cvs(), b.row_mask)
                     state = st if state is None else merge_jit(state, st)
                 if state is None:          # empty build: nothing matches
@@ -148,6 +150,7 @@ class RuntimeBloomFilterExec(TpuExec):
                 _probe, cls="RuntimeBloomFilterExec", tag="probe",
                 key=(expr_fp(skey), expr_fp(agg)))
         for batch in self.children[0].execute_partition(ctx, pid):
+            ctx.check_cancel()
             with m.timer("bloomProbeTime"):
                 new_mask = self._probe_jit(bits, batch.cvs(),
                                            batch.row_mask)

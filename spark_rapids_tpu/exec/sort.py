@@ -164,6 +164,7 @@ class SortExec(TpuExec):
             from .batch import maybe_compact
             for cpid in range(child.num_partitions(ctx)):
                 for batch in child.execute_partition(ctx, cpid):
+                    ctx.check_cancel()
                     if self._n_fused:
                         cvs2, mask2 = self._pre_jit(batch.cvs(),
                                                     batch.row_mask)
@@ -207,6 +208,7 @@ class SortExec(TpuExec):
             [self.orders[0].expr], self.schema)
         for rp in range(nparts):  # partitions are range-ordered
             for batch in ex.execute_partition(ctx, rp):
+                ctx.check_cancel()
                 yield self._sort_one_batch(ctx, batch.cvs(),
                                            batch.row_mask)
 

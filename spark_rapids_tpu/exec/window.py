@@ -907,6 +907,7 @@ class WindowExec(TpuExec):
         try:
             for cpid in range(child.num_partitions(ctx)):
                 for b in child.execute_partition(ctx, cpid):
+                    ctx.check_cancel()
                     total_rows += b.num_rows
                     handles.append(store.add_batch(b))
             if total_rows <= chunk_rows:

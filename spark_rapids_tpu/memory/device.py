@@ -62,8 +62,10 @@ class DeviceManager:
                 cur = self._reserved
             else:
                 return False
-        from .diagnostics import record_device_watermark
+        from .diagnostics import record_device_watermark, \
+            record_query_bytes
         record_device_watermark(cur)
+        record_query_bytes("device", nbytes)
         return True
 
     def reserve(self, nbytes: int):
@@ -81,6 +83,8 @@ class DeviceManager:
             with self._lock:
                 needed = nbytes - (self.budget - self._reserved)
             if needed > 0:
+                from .diagnostics import record_query_spill
+                record_query_spill(needed)
                 hook(needed)
             if self.try_reserve(nbytes, _record=False):
                 return
@@ -91,6 +95,8 @@ class DeviceManager:
     def release(self, nbytes: int):
         with self._lock:
             self._reserved = max(0, self._reserved - nbytes)
+        from .diagnostics import record_query_bytes
+        record_query_bytes("device", -nbytes)
 
     def trigger_spill(self, nbytes: Optional[int] = None):
         """Ask the spill store to free memory proactively (the retry

@@ -17,13 +17,15 @@ from __future__ import annotations
 
 import threading
 from collections import defaultdict
-from typing import Dict
+from typing import Dict, Optional
 
 __all__ = ["retry_scope", "in_retry_scope", "enable_retry_coverage",
            "record_allocation", "coverage_report", "reset_coverage",
            "leak_report", "assert_no_leaks", "record_device_watermark",
            "record_host_watermark", "reset_watermarks",
-           "watermarks_snapshot"]
+           "watermarks_snapshot", "record_query_bytes",
+           "record_query_spill", "query_attribution",
+           "reset_query_attribution"]
 
 _tls = threading.local()
 _enabled = False
@@ -131,7 +133,78 @@ def watermarks_snapshot() -> dict:
     hm = _host._GLOBAL
     if hm is not None:
         out["hostPressure"] = dict(hm.metrics)
+    qid = _current_query_id()
+    if qid is not None:
+        rec = query_attribution(qid)
+        if rec:
+            out["queryAttribution"] = rec
     return out
+
+
+# -- per-query attribution ----------------------------------------------
+# The query service tags each worker thread with its query_id
+# (service/query_manager.py _query_scope); the memory managers report
+# every reserve/release/spill-pressure event here so concurrent queries'
+# footprints stay separable in the event log and leak reports.
+_QA_LOCK = threading.Lock()
+# query_id -> {"deviceBytes", "devicePeakBytes", "hostBytes",
+#              "hostPeakBytes", "spillPressureBytes"}
+_query_attr: Dict[str, dict] = {}
+
+
+def _current_query_id():
+    try:
+        from ..service.query_manager import current_query_id
+        return current_query_id()
+    except Exception:
+        return None
+
+
+def record_query_bytes(kind: str, delta: int):
+    """Attribute a device/host reservation delta (`kind` is 'device' or
+    'host', delta signed) to the current thread's query, if any."""
+    qid = _current_query_id()
+    if qid is None:
+        return
+    with _QA_LOCK:
+        rec = _query_attr.setdefault(qid, {
+            "deviceBytes": 0, "devicePeakBytes": 0,
+            "hostBytes": 0, "hostPeakBytes": 0,
+            "spillPressureBytes": 0})
+        cur_key, peak_key = f"{kind}Bytes", f"{kind}PeakBytes"
+        rec[cur_key] = max(0, rec[cur_key] + int(delta))
+        if rec[cur_key] > rec[peak_key]:
+            rec[peak_key] = rec[cur_key]
+
+
+def record_query_spill(nbytes: int):
+    """Attribute spill pressure (bytes the spill cascade was asked to
+    free) to the query that triggered it."""
+    qid = _current_query_id()
+    if qid is None:
+        return
+    with _QA_LOCK:
+        rec = _query_attr.setdefault(qid, {
+            "deviceBytes": 0, "devicePeakBytes": 0,
+            "hostBytes": 0, "hostPeakBytes": 0,
+            "spillPressureBytes": 0})
+        rec["spillPressureBytes"] += int(nbytes)
+
+
+def query_attribution(query_id: Optional[str] = None):
+    """Attribution snapshot: one query's record, or all of them."""
+    with _QA_LOCK:
+        if query_id is not None:
+            return dict(_query_attr.get(query_id) or {})
+        return {q: dict(r) for q, r in _query_attr.items()}
+
+
+def reset_query_attribution(query_id: Optional[str] = None):
+    with _QA_LOCK:
+        if query_id is None:
+            _query_attr.clear()
+        else:
+            _query_attr.pop(query_id, None)
 
 
 # -- leak checking ------------------------------------------------------

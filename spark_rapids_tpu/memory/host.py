@@ -60,8 +60,9 @@ class HostMemoryManager:
                 cur = self._reserved
             else:
                 return False
-        from .diagnostics import record_host_watermark
+        from .diagnostics import record_host_watermark, record_query_bytes
         record_host_watermark(cur)
+        record_query_bytes("host", nbytes)
         return True
 
     def reserve(self, nbytes: int):
@@ -72,6 +73,8 @@ class HostMemoryManager:
             return
         need = nbytes
         self.metrics["pressureCalls"] += 1
+        from .diagnostics import record_query_spill
+        record_query_spill(need)
         for fn in list(self._hooks):
             try:
                 freed = fn(need)
@@ -91,13 +94,16 @@ class HostMemoryManager:
             self._reserved += nbytes
             self._holders += 1
             cur = self._reserved
-        from .diagnostics import record_host_watermark
+        from .diagnostics import record_host_watermark, record_query_bytes
         record_host_watermark(cur)
+        record_query_bytes("host", nbytes)
 
     def release(self, nbytes: int):
         with self._lock:
             self._reserved = max(0, self._reserved - nbytes)
             self._holders = max(0, self._holders - 1)
+        from .diagnostics import record_query_bytes
+        record_query_bytes("host", -nbytes)
 
 
 # ----------------------------------------------------------------------

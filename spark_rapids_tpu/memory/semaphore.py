@@ -4,7 +4,14 @@ Analog of the reference's GpuSemaphore (reference: GpuSemaphore.scala:183,
 PrioritySemaphore.scala): a counting semaphore with priority ordering;
 tasks acquire before device work and release around host-side I/O so
 another task's kernels can occupy the chip.
-"""
+
+Query-service integration: `acquire` takes the pool-weight-derived
+priority (heavier pools map to more-negative values — the heap pops the
+smallest first), accepts a CancelToken so a cancelled query stops
+waiting for the chip instead of blocking forever, and returns the wait
+time so callers can attribute `semaphoreWaitMs` per query (the
+`metrics` dict stays the process-wide total, surfaced as
+`semaphoreAcquires` on the root MetricSet)."""
 from __future__ import annotations
 
 import heapq
@@ -22,23 +29,46 @@ class TpuSemaphore:
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._waiters = []          # heap of (priority, seq)
+        self._dead = set()          # abandoned waiter entries (cancelled)
         self._seq = itertools.count()
         self.metrics = {"acquireWaitTime": 0.0, "acquires": 0}
 
-    def acquire(self, priority: int = 0):
+    def _purge_dead(self):
+        while self._waiters and tuple(self._waiters[0]) in self._dead:
+            self._dead.discard(tuple(heapq.heappop(self._waiters)))
+
+    def acquire(self, priority: int = 0, token=None) -> float:
+        """Block until a permit is granted in priority order; returns
+        seconds spent waiting. With a CancelToken, the wait polls it and
+        a tripped token abandons the slot (raising QueryCancelled)."""
         import time
         t0 = time.perf_counter()
         with self._cond:
             seq = next(self._seq)
-            heapq.heappush(self._waiters, (priority, seq))
-            while not (self._available > 0
-                       and self._waiters[0] == (priority, seq)):
-                self._cond.wait()
+            ent = (priority, seq)
+            heapq.heappush(self._waiters, ent)
+            try:
+                while True:
+                    self._purge_dead()
+                    if self._available > 0 and self._waiters[0] == ent:
+                        break
+                    if token is not None:
+                        self._cond.wait(timeout=0.05)
+                        token.check()
+                    else:
+                        self._cond.wait()
+            except BaseException:
+                # leave no ghost head blocking the heap
+                self._dead.add(ent)
+                self._cond.notify_all()
+                raise
             heapq.heappop(self._waiters)
             self._available -= 1
+            waited = time.perf_counter() - t0
             self.metrics["acquires"] += 1
-            self.metrics["acquireWaitTime"] += time.perf_counter() - t0
+            self.metrics["acquireWaitTime"] += waited
             self._cond.notify_all()
+        return waited
 
     def release(self):
         with self._cond:
@@ -46,8 +76,8 @@ class TpuSemaphore:
             self._cond.notify_all()
 
     @contextmanager
-    def hold(self, priority: int = 0):
-        self.acquire(priority)
+    def hold(self, priority: int = 0, token=None):
+        self.acquire(priority, token=token)
         try:
             yield
         finally:

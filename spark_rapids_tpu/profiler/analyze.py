@@ -99,6 +99,16 @@ def render_analyze(tree: dict, metrics_by_lore: Dict[Optional[int], dict],
         if m.get("programCacheMisses") is not None:
             ann.append(
                 f"programCacheMisses={int(m['programCacheMisses'])}")
+        # query-service waits (root node): time queued behind other
+        # queries + time blocked on the TpuSemaphore for the chip
+        if m.get("queueWaitMs") is not None:
+            ann.append(f"queueWaitMs={float(m['queueWaitMs']):.1f}")
+        if m.get("semaphoreWaitMs") is not None:
+            ann.append(
+                f"semaphoreWaitMs={float(m['semaphoreWaitMs']):.1f}")
+        if m.get("semaphoreAcquires") is not None:
+            ann.append(
+                f"semaphoreAcquires={int(m['semaphoreAcquires'])}")
         if ann:
             line += "  " + " ".join(ann)
         if lid in rank:

@@ -3,6 +3,9 @@
 One JSONL file per query under `spark.rapids.tpu.sql.eventLog.dir`, with
 typed events the profiling tool post-processes:
 
+  query_queued  {pool, estimate_device_bytes, estimate_host_bytes}
+                (query service, service/query_manager.py)
+  query_admitted{pool, queue_wait_ms}            (query service)
   query_start   {query_id, action, ts}
   plan          {plan: nested {lore_id, name, describe, children}}
   plan_audit    {ok, nodes, findings: [{kind, reason, node, path,
@@ -14,7 +17,8 @@ typed events the profiling tool post-processes:
   watermarks    {devicePeakBytes, hostPeakBytes, spill?, hostPressure?}
   xla_compile   {compiles, compile_secs, cache_hits, cache_misses,
                  dispatches}
-  query_end     {status, wall_s, error?}
+  query_cancelled{reason}       (cooperative cancel / deadline kill)
+  query_end     {status: ok|error|cancelled|timeout, wall_s, error?}
 
 Locally `session.py` wraps every action (`profile_query`); the
 distributed runner (cluster/query.py) writes one log driver-side from
@@ -197,10 +201,13 @@ def top_operators(records: List[dict], n: int = 5) -> List[dict]:
 # the per-action wrapper session.py runs every query inside
 # ---------------------------------------------------------------------
 @contextmanager
-def profile_query(session, root, ctx, action: str):
+def profile_query(session, root, ctx, action: str, handle=None):
     """Emit the full event sequence for one local query action. No-op
-    (beyond a cheap conf check) when event logging is disabled."""
-    w = open_query_log(ctx.conf, next_query_id())
+    (beyond a cheap conf check) when event logging is disabled. With a
+    query-service `handle`, the log file is named by the handle's
+    query_id and carries queue/admission/cancellation events."""
+    w = open_query_log(ctx.conf, handle.query_id if handle is not None
+                       else next_query_id())
     if w is None:
         yield None
         return
@@ -211,6 +218,14 @@ def profile_query(session, root, ctx, action: str):
     xla0 = xla_stats.snapshot()
     diagnostics.reset_watermarks()
     t0 = time.perf_counter()
+    if handle is not None:
+        # reconstructed from handle timestamps: by the time the action
+        # body runs, the query has already been queued and admitted
+        w.emit("query_queued", pool=handle.pool,
+               estimate_device_bytes=int(handle.estimate[0]),
+               estimate_host_bytes=int(handle.estimate[1]))
+        w.emit("query_admitted", pool=handle.pool,
+               queue_wait_ms=round(handle.queue_wait_ms, 3))
     w.emit("query_start", action=action)
     w.emit("plan", plan=plan_tree(root))
     audit = getattr(root, "audit_report", None)
@@ -223,7 +238,16 @@ def profile_query(session, root, ctx, action: str):
     try:
         yield w
     except BaseException as e:
-        status, err = "error", repr(e)
+        from ..service.query_manager import QueryCancelled, QueryTimedOut
+        if isinstance(e, QueryTimedOut):
+            status = "timeout"
+        elif isinstance(e, QueryCancelled):
+            status = "cancelled"
+        else:
+            status = "error"
+        err = repr(e)
+        if status != "error":
+            w.emit("query_cancelled", reason=status)
         raise
     finally:
         try:

@@ -1,0 +1,13 @@
+"""Concurrent query service: admission control, fair scheduling,
+cancellation & deadlines — the serving layer multiplexing independent
+queries over one engine process (Thrift-Server / fair-scheduler analog;
+see docs/service.md)."""
+from .query_manager import (CancelToken, QueryCancelled, QueryHandle,
+                            QueryManager, QueryTimedOut, QueryState,
+                            current_query_id)
+from .scheduler import FairScheduler, estimate_plan_memory
+from .server import QueryServer
+
+__all__ = ["CancelToken", "QueryCancelled", "QueryTimedOut", "QueryHandle",
+           "QueryManager", "QueryState", "FairScheduler", "QueryServer",
+           "estimate_plan_memory", "current_query_id"]

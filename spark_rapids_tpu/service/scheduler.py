@@ -1,0 +1,227 @@
+"""Fair-share scheduler: weighted pools + memory-aware admission.
+
+Analog of Spark's fair scheduler (FIFO within a pool, weighted shares
+across pools) crossed with the admission side of the reference's
+GpuSemaphore story: the semaphore bounds TASKS on the chip, this bounds
+QUERIES in the engine, gated on a device+host memory estimate derived
+from the plan's scan/build sizes (plan/planner.py cardinality
+estimator) so concurrent queries cannot jointly blow the
+DeviceManager/HostMemoryManager budgets — an oversized admission mix
+queues with metrics instead of OOMing mid-flight.
+
+Cross-pool arbitration is deficit round robin: every recharge round
+credits each contending pool by its weight, and each admission debits
+one credit from the granted pool, so under saturation grant counts
+converge to the weight ratio without starving light pools.
+"""
+from __future__ import annotations
+
+from collections import deque
+from typing import Optional, Tuple
+
+__all__ = ["Pool", "FairScheduler", "estimate_plan_memory"]
+
+
+class Pool:
+    __slots__ = ("name", "weight", "queue", "credit")
+
+    def __init__(self, name: str, weight: int = 1):
+        self.name = name
+        self.weight = max(1, int(weight))
+        self.queue = deque()
+        self.credit = 0.0
+
+    def __repr__(self):
+        return f"Pool({self.name}, w={self.weight}, q={len(self.queue)})"
+
+
+def _parse_pools(spec: str):
+    pools = {}
+    for part in str(spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        try:
+            weight = int(w) if w else 1
+        except ValueError:
+            weight = 1
+        pools[name.strip()] = Pool(name.strip(), weight)
+    if "default" not in pools:
+        pools["default"] = Pool("default", 1)
+    return pools
+
+
+class FairScheduler:
+    """NOT thread-safe on its own: the QueryManager serializes every
+    call under its lock (offer/next_ready/remove/release are lock-free
+    hot-path pieces of the manager's pump)."""
+
+    def __init__(self, conf=None):
+        from ..config import (SERVICE_SCHEDULER_MODE,
+                              SERVICE_SCHEDULER_POOLS, TpuConf)
+        self.conf = conf or TpuConf()
+        self.mode = str(self.conf.get(SERVICE_SCHEDULER_MODE)).lower()
+        self.pools = _parse_pools(self.conf.get(SERVICE_SCHEDULER_POOLS))
+        # admitted-estimate accounting (bytes committed to running
+        # queries; compared against _limits(), NOT real reservations —
+        # the managers keep owning actuals + spill)
+        self._admitted_dev = 0
+        self._admitted_host = 0
+        self._admitted_count = 0
+
+    # -- queue maintenance ---------------------------------------------
+    def pool_of(self, h) -> Pool:
+        p = self.pools.get(h.pool)
+        if p is None:
+            # unknown pool names materialize with weight 1 rather than
+            # failing the query (matches Spark's fair-scheduler behavior)
+            p = self.pools[h.pool] = Pool(h.pool, 1)
+        return p
+
+    def offer(self, h):
+        self.pool_of(h).queue.append(h)
+
+    def remove(self, h) -> bool:
+        try:
+            self.pool_of(h).queue.remove(h)
+            return True
+        except ValueError:
+            return False
+
+    def queued_count(self) -> int:
+        return sum(len(p.queue) for p in self.pools.values())
+
+    def priority_of(self, h) -> int:
+        """TpuSemaphore acquire priority for this query's tasks: the
+        heap pops the SMALLEST priority first, so heavier pools map to
+        more-negative priorities and win device admission ties."""
+        return -self.pool_of(h).weight
+
+    # -- admission ------------------------------------------------------
+    def _limits(self) -> Tuple[int, int]:
+        from ..config import (SERVICE_ADMISSION_DEVICE_FRACTION,
+                              SERVICE_ADMISSION_DEVICE_LIMIT,
+                              SERVICE_ADMISSION_HOST_FRACTION)
+        explicit = int(self.conf.get(SERVICE_ADMISSION_DEVICE_LIMIT) or 0)
+        if explicit > 0:
+            dev_limit = explicit
+        else:
+            from ..memory.device import device_manager
+            dev_limit = int(device_manager(self.conf).budget * float(
+                self.conf.get(SERVICE_ADMISSION_DEVICE_FRACTION)))
+        from ..memory.host import host_manager
+        host_budget = host_manager(self.conf).budget
+        host_limit = (int(host_budget * float(
+            self.conf.get(SERVICE_ADMISSION_HOST_FRACTION)))
+            if host_budget and host_budget > 0 else 0)  # 0 = unlimited
+        return dev_limit, host_limit
+
+    def _fits(self, h) -> bool:
+        from ..config import SERVICE_ADMISSION_ENABLED
+        if not self.conf.get(SERVICE_ADMISSION_ENABLED):
+            return True
+        if self._admitted_count == 0:
+            # never starve: a query whose solo estimate exceeds the
+            # budget is admitted when it would run alone
+            return True
+        dev, host = h.estimate
+        dev_limit, host_limit = self._limits()
+        if dev_limit > 0 and self._admitted_dev + int(dev) > dev_limit:
+            return False
+        if host_limit > 0 and self._admitted_host + int(host) > host_limit:
+            return False
+        return True
+
+    def release(self, h):
+        """A granted query finished: return its estimate to the pot."""
+        dev, host = h.estimate
+        self._admitted_dev = max(0, self._admitted_dev - int(dev))
+        self._admitted_host = max(0, self._admitted_host - int(host))
+        self._admitted_count = max(0, self._admitted_count - 1)
+
+    def _grant(self, pool: Pool, h):
+        pool.queue.popleft()
+        pool.credit -= 1.0
+        dev, host = h.estimate
+        self._admitted_dev += int(dev)
+        self._admitted_host += int(host)
+        self._admitted_count += 1
+        return h
+
+    def _live_head(self, pool: Pool):
+        """FIFO head of the pool, dropping dead (cancelled/expired)
+        entries — their waiter threads finalize them."""
+        while pool.queue:
+            h = pool.queue[0]
+            if h.token.cancelled():
+                pool.queue.popleft()
+                continue
+            return h
+        return None
+
+    def next_ready(self):
+        """Pick the next admissible query, or None. FIFO mode: global
+        submission order. Fair mode: deficit round robin over pools."""
+        contending = [p for p in self.pools.values()
+                      if self._live_head(p) is not None]
+        if not contending:
+            return None
+        if self.mode == "fifo":
+            pool = min(contending, key=lambda p: p.queue[0]._seq)
+            h = pool.queue[0]
+            return self._grant(pool, h) if self._fits(h) else None
+        # deficit round robin: recharge when no contending pool has
+        # credit, then grant from the most-credited pool whose head fits
+        if all(p.credit < 1.0 for p in contending):
+            for p in contending:
+                p.credit += p.weight
+        for p in sorted(contending,
+                        key=lambda p: (-p.credit, p.queue[0]._seq)):
+            if p.credit < 1.0:
+                continue
+            h = p.queue[0]
+            if self._fits(h):
+                return self._grant(p, h)
+        return None
+
+
+# -- plan-derived memory estimate ---------------------------------------
+def estimate_plan_memory(plan, conf=None) -> Tuple[int, int]:
+    """(device_bytes, host_bytes) admission estimate for a LOGICAL plan:
+    every scan leaf contributes its estimated materialized size and
+    every join's build side (right child) counts again for the resident
+    hash build — the same audited row/width numbers the planner's
+    broadcast decision uses (plan/planner.py _estimate_bytes). Host
+    estimate is half the device total (shuffle assembly + D2H staging
+    ride host buffers but stream). Unknowable plans estimate 0 and are
+    bounded only by the running-query cap."""
+    if plan is None:
+        return (0, 0)
+    from ..plan.planner import _estimate_bytes
+    dev = 0
+    stack = [plan]
+    seen = set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        children = list(getattr(node, "children", []) or [])
+        if not children:
+            try:
+                b = _estimate_bytes(node)
+            except Exception:
+                b = None
+            if b:
+                dev += int(b)
+        else:
+            if type(node).__name__ == "Join" and len(children) == 2:
+                try:
+                    b = _estimate_bytes(children[1])
+                except Exception:
+                    b = None
+                if b:
+                    dev += int(b)
+            stack.extend(children)
+    return (dev, dev // 2)

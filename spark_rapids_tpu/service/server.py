@@ -1,0 +1,188 @@
+"""JSON-lines socket gateway: the Thrift-Server analog.
+
+Multiplexes concurrent client sessions onto ONE engine process: each
+connection sends newline-delimited JSON requests and reads one JSON
+response line per request. Queries run asynchronously through the
+session's QueryManager (submit returns a `query_id` immediately);
+clients poll status, page through the columnar result, or cancel.
+
+Wire protocol (see docs/service.md):
+
+    {"op": "submit", "sql": "...", "pool": "etl", "timeout_secs": 30}
+        -> {"ok": true, "query_id": "query-123-0"}
+    {"op": "status", "query_id": "..."}
+        -> {"ok": true, "state": "RUNNING", "queue_wait_ms": 1.2, ...}
+    {"op": "fetch", "query_id": "...", "page": 0, "page_rows": 4096}
+        -> {"ok": true, "columns": {...}, "num_rows": N, "last": false}
+    {"op": "cancel", "query_id": "..."}  -> {"ok": true, "cancelled": true}
+    {"op": "ping"}                       -> {"ok": true}
+
+Result pages are COLUMNAR ({name: [values...]}) — the arrow batches a
+Thrift client would receive, JSON-encoded for transport neutrality.
+"""
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Optional
+
+__all__ = ["QueryServer"]
+
+
+def _json_value(v):
+    """JSON-safe scalar: arrow fetches yield decimals/dates/datetimes."""
+    if v is None or isinstance(v, (bool, int, float, str)):
+        return v
+    return str(v)
+
+
+class QueryServer:
+    def __init__(self, session, host: str = "127.0.0.1", port: int = 0):
+        self.session = session
+        self.host = host
+        self.port = port
+        self._sock: Optional[socket.socket] = None
+        self._threads = []
+        self._stop = threading.Event()
+        # query_id -> (handle, result holder); results stay fetchable
+        # after the handle leaves the manager's live table
+        self._results = {}
+        self._lock = threading.Lock()
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self):
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        s.bind((self.host, self.port))
+        s.listen(16)
+        self.host, self.port = s.getsockname()
+        self._sock = s
+        t = threading.Thread(target=self._accept_loop, daemon=True,
+                             name="srtpu-gateway-accept")
+        t.start()
+        self._threads.append(t)
+        return self.host, self.port
+
+    @property
+    def address(self):
+        return self.host, self.port
+
+    def close(self):
+        self._stop.set()
+        if self._sock is not None:
+            try:
+                self._sock.close()
+            except OSError:
+                pass
+        with self._lock:
+            for h, _ in self._results.values():
+                if not h.done():
+                    h.cancel("gateway shutdown")
+            self._results.clear()
+
+    def __enter__(self):
+        self.start()
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- connection handling --------------------------------------------
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True, name="srtpu-gateway-conn")
+            t.start()
+
+    def _serve_conn(self, conn: socket.socket):
+        with conn:
+            rfile = conn.makefile("r", encoding="utf-8")
+            wfile = conn.makefile("w", encoding="utf-8")
+            for line in rfile:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    req = json.loads(line)
+                    resp = self._handle(req)
+                except Exception as e:  # noqa: BLE001 — wire boundary
+                    resp = {"ok": False,
+                            "error": f"{type(e).__name__}: {e}"}
+                try:
+                    wfile.write(json.dumps(resp) + "\n")
+                    wfile.flush()
+                except OSError:
+                    return
+
+    # -- request dispatch -----------------------------------------------
+    def _handle(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "ping":
+            return {"ok": True, "stats":
+                    self.session.query_manager().snapshot()}
+        if op == "submit":
+            return self._submit(req)
+        if op == "status":
+            return self._status(req)
+        if op == "fetch":
+            return self._fetch(req)
+        if op == "cancel":
+            return self._cancel(req)
+        return {"ok": False, "error": f"unknown op: {op!r}"}
+
+    def _submit(self, req: dict) -> dict:
+        df = self.session.sql(req["sql"])
+        handle = df.submit(pool=req.get("pool"),
+                           timeout=req.get("timeout_secs"))
+        with self._lock:
+            self._results[handle.query_id] = (handle, None)
+        return {"ok": True, "query_id": handle.query_id}
+
+    def _entry(self, req: dict):
+        qid = req.get("query_id", "")
+        with self._lock:
+            return qid, self._results.get(qid)
+
+    def _status(self, req: dict) -> dict:
+        qid, ent = self._entry(req)
+        if ent is None:
+            return {"ok": False, "error": f"unknown query_id: {qid!r}"}
+        out = {"ok": True}
+        out.update(ent[0].status())
+        return out
+
+    def _fetch(self, req: dict) -> dict:
+        qid, ent = self._entry(req)
+        if ent is None:
+            return {"ok": False, "error": f"unknown query_id: {qid!r}"}
+        handle = ent[0]
+        if not handle.done():
+            return {"ok": False, "pending": True,
+                    "state": handle.state}
+        try:
+            table = handle.result()
+        except BaseException as e:  # noqa: BLE001 — reported to client
+            return {"ok": False, "state": handle.state,
+                    "error": f"{type(e).__name__}: {e}"}
+        page = max(0, int(req.get("page", 0)))
+        page_rows = max(1, int(req.get("page_rows", 4096)))
+        sliced = table.slice(page * page_rows, page_rows)
+        cols = {name: [_json_value(v) for v in
+                       sliced.column(i).to_pylist()]
+                for i, name in enumerate(table.column_names)}
+        return {"ok": True, "columns": cols,
+                "num_rows": sliced.num_rows,
+                "total_rows": table.num_rows,
+                "last": (page + 1) * page_rows >= table.num_rows}
+
+    def _cancel(self, req: dict) -> dict:
+        qid, ent = self._entry(req)
+        if ent is None:
+            return {"ok": False, "error": f"unknown query_id: {qid!r}"}
+        return {"ok": True, "cancelled": ent[0].cancel()}

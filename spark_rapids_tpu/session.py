@@ -26,6 +26,15 @@ __all__ = ["TpuSession", "DataFrame"]
 # name-based joins (see DataFrame.join)
 _JOIN_RENAME_COUNTER = [0]
 
+_QM_LOCK = __import__("threading").Lock()
+
+# reentrancy guard: a nested action on a thread that already holds an
+# admission grant (e.g. a runtime-filter subquery collected inside a
+# parent query) runs under the OUTER query's handle instead of asking
+# the scheduler for a second grant (which could deadlock at
+# maxConcurrentQueries=1)
+_ACTION_TLS = __import__("threading").local()
+
 
 class TpuSession:
     _active: Optional["TpuSession"] = None
@@ -67,11 +76,36 @@ class TpuSession:
             atexit.register(cm.shutdown)
         return cm
 
+    def query_manager(self):
+        """Lazily build the concurrent query service (service/): every
+        action routes through it for admission, fair scheduling,
+        cancellation, and deadlines (docs/service.md)."""
+        import threading
+        mgr = getattr(self, "_query_manager", None)
+        if mgr is None:
+            with _QM_LOCK:
+                mgr = getattr(self, "_query_manager", None)
+                if mgr is None:
+                    from .service.query_manager import QueryManager
+                    mgr = QueryManager(self.conf)
+                    self._query_manager = mgr
+        return mgr
+
+    def serve(self, host: str = "127.0.0.1", port: int = 0):
+        """Start the JSON-lines gateway (service/server.py) multiplexing
+        client sessions onto this engine process; returns the server
+        (its .host/.port carry the bound address)."""
+        from .service.server import QueryServer
+        srv = QueryServer(self, host, port)
+        srv.start()
+        return srv
+
     def stop(self):
         cm = getattr(self, "_cluster", None)
         if cm is not None:
             cm.shutdown()
             self._cluster = None
+        self._query_manager = None
         if TpuSession._active is self:
             TpuSession._active = None
 
@@ -670,11 +704,9 @@ class DataFrame:
     def cache(self) -> "DataFrame":
         """Materialize this DataFrame into HBM-resident device batches
         (GpuInMemoryTableScan analog); later queries skip decode + H2D."""
-        root, ctx = self._execute()
-        try:
-            batches = list(root.execute_all(ctx))
-        finally:
-            ctx.close()
+        def body(root, ctx):
+            return list(root.execute_all(ctx))
+        batches = self._run_action("cache", body)
         return DataFrame(self._session,
                          L.CachedScan(batches, self._plan.schema))
 
@@ -698,34 +730,109 @@ class DataFrame:
     _cached: Optional[tuple] = None
     _last_root = None
 
-    def _execute(self):
+    def _execute(self, conf=None):
         # Cache the physical plan: exec nodes own their jitted kernels, so
         # re-collecting a DataFrame reuses compiled programs (the analog of
-        # Spark's executedPlan reuse).
-        if self._cached is not None and self._cached[0] is self._session.conf:
+        # Spark's executedPlan reuse). `conf` is the per-query snapshot
+        # taken at submission — concurrent queries must not observe a
+        # session conf mutated mid-flight.
+        if conf is None:
+            conf = self._session.conf
+        if self._cached is not None and self._cached[0] is conf:
             root = self._cached[1]
         else:
-            planner = Planner(self._session.conf)
+            planner = Planner(conf)
             root = planner.plan(self._plan)
-            self._cached = (self._session.conf, root)
-        ctx = ExecContext(self._session.conf, self._session)
+            self._cached = (conf, root)
+        ctx = ExecContext(conf, self._session)
         return root, ctx
 
     def _run_action(self, action: str, body):
-        """Run one query action inside the profiler wrapper: the event
-        log (when sql.eventLog.enabled) gets query_start/plan/
-        op_metrics/watermarks/xla_compile/query_end events, and the
-        DataFrame keeps the physical root + metric snapshots for
-        last_metrics() / explain("ANALYZE")."""
+        """Run one query action through the query service: admission by
+        the fair scheduler, a CancelToken + query_id on the ExecContext,
+        and the profiler wrapper (query_queued/query_admitted/
+        query_start/.../query_end events when sql.eventLog.enabled).
+        Runs on the CALLER's thread once admitted; `DataFrame.submit`
+        is the async counterpart."""
+        outer = getattr(_ACTION_TLS, "handle", None)
+        if outer is not None:
+            # nested action (subquery collected inside a parent query):
+            # ride the outer grant + token, skip re-admission
+            return self._execute_action(action, body, self._session.conf,
+                                        outer, nested=True)
+        from .service.query_manager import QueryCancelled
+        mgr = self._session.query_manager()
+        conf = self._session.conf  # per-query conf snapshot
+        handle = mgr.open_query(plan=self._plan, conf=conf, action=action)
+        try:
+            out = self._execute_action(action, body, conf, handle)
+        except BaseException as e:
+            mgr.close_query(handle, error=e)
+            raise
+        mgr.close_query(handle, result=out)
+        return out
+
+    def submit(self, action: str = "collect", pool=None, timeout=None):
+        """Async action through the query service: returns a QueryHandle
+        immediately; `handle.result()` blocks for the arrow table (or
+        re-raises). The gateway and the throughput bench submit here."""
+        if action != "collect":
+            raise ValueError("submit() supports the 'collect' action")
+        from .exec.nodes import collect_to_arrow as _collect
+        mgr = self._session.query_manager()
+        conf = self._session.conf
+
+        def run(handle):
+            return self._execute_action(
+                "collect", lambda root, ctx: _collect(root, ctx),
+                conf, handle)
+
+        return mgr.submit(run, plan=self._plan, conf=conf,
+                          action="collect", pool=pool, timeout=timeout)
+
+    def _execute_action(self, action: str, body, conf, handle,
+                        nested: bool = False):
+        """The admitted half of an action: plan (or reuse the cached
+        physical tree), execute under the profiler wrapper, then attach
+        the per-query XLA/semaphore/queue-wait accounting to the root
+        node's MetricSet. On ANY failure — including cooperative
+        cancellation — the physical plan is released deterministically
+        (exchange handles, spill files, parked device buffers) instead
+        of waiting for GC."""
         from .profiler import xla_stats
         from .profiler.event_log import profile_query
-        root, ctx = self._execute()
+        from .service.query_manager import _query_scope
+        root, ctx = self._execute(conf)
+        if handle is not None:
+            ctx.cancel = handle.token
+            ctx.query_id = handle.query_id
+            mgr = getattr(self._session, "_query_manager", None)
+            if mgr is not None:
+                ctx.sem_priority = mgr.scheduler.priority_of(handle)
+        sem = getattr(self._session, "_semaphore", None)
+        sem_acq0 = sem.metrics["acquires"] if sem is not None else 0
         xla0 = xla_stats.snapshot()
-        with profile_query(self._session, root, ctx, action):
+        _ACTION_TLS.handle = handle if not nested else \
+            getattr(_ACTION_TLS, "handle", None)
+        try:
+            with _query_scope(handle.query_id if handle else "?"):
+                with profile_query(self._session, root, ctx, action,
+                                   handle=None if nested else handle):
+                    try:
+                        out = body(root, ctx)
+                    finally:
+                        ctx.close()
+        except BaseException:
             try:
-                out = body(root, ctx)
-            finally:
-                ctx.close()
+                root.release()
+            except Exception:
+                pass
+            if self._cached is not None and self._cached[1] is root:
+                self._cached = None
+            raise
+        finally:
+            if not nested:
+                _ACTION_TLS.handle = None
         # per-query XLA accounting rides the root node's MetricSet so it
         # flows into last_metrics() / EXPLAIN ANALYZE / op_metrics events
         xla1 = xla_stats.snapshot()
@@ -739,6 +846,13 @@ class DataFrame:
         rm.add("programCacheMisses",
                int(xla1.get("program_cache_misses", 0)
                    - xla0.get("program_cache_misses", 0)))
+        if handle is not None and not nested:
+            rm.add("queueWaitMs", round(handle.queue_wait_ms, 3))
+        sem = getattr(self._session, "_semaphore", None)
+        if sem is not None:
+            acq = sem.metrics["acquires"] - sem_acq0
+            if acq:
+                rm.add("semaphoreAcquires", int(acq))
         self._last_root = root
         self._last_metrics = {op: ms.snapshot(ctx.metrics_level)
                               for op, ms in ctx.metrics.items()}
@@ -856,20 +970,48 @@ class DataFrame:
 
     def _iter_partition_tables(self):
         """Stream the result partition-by-partition as compacted host
-        arrow tables (shared by every file writer)."""
+        arrow tables (shared by every file writer). Writers hold their
+        admission grant for the generator's whole lifetime (the query
+        service's open/close pair brackets the stream)."""
         import pyarrow as pa
         from .exec.nodes import _batch_to_arrow
         from .profiler.event_log import profile_query
-        root, ctx = self._execute()
-        with profile_query(self._session, root, ctx, "write"):
+        outer = getattr(_ACTION_TLS, "handle", None)
+        mgr = self._session.query_manager() if outer is None else None
+        conf = self._session.conf
+        handle = outer if outer is not None else mgr.open_query(
+            plan=self._plan, conf=conf, action="write")
+        root, ctx = self._execute(conf)
+        ctx.cancel = handle.token
+        ctx.query_id = handle.query_id
+        try:
+            with profile_query(self._session, root, ctx, "write",
+                               handle=None if outer else handle):
+                try:
+                    for pid in range(root.num_partitions(ctx)):
+                        ctx.check_cancel()
+                        tables = [_batch_to_arrow(b)
+                                  for b in root.execute_partition(ctx, pid)]
+                        if tables:
+                            yield pa.concat_tables(tables)
+                finally:
+                    ctx.close()
+        except BaseException as e:
             try:
-                for pid in range(root.num_partitions(ctx)):
-                    tables = [_batch_to_arrow(b)
-                              for b in root.execute_partition(ctx, pid)]
-                    if tables:
-                        yield pa.concat_tables(tables)
-            finally:
-                ctx.close()
+                root.release()
+            except Exception:
+                pass
+            if self._cached is not None and self._cached[1] is root:
+                self._cached = None
+            if mgr is not None:
+                # an abandoned generator is a clean early stop, not a
+                # query failure
+                mgr.close_query(handle, error=None if isinstance(
+                    e, GeneratorExit) else e)
+            raise
+        else:
+            if mgr is not None:
+                mgr.close_query(handle)
         self._last_root = root
         self._last_metrics = {op: ms.snapshot(ctx.metrics_level)
                               for op, ms in ctx.metrics.items()}

@@ -68,7 +68,8 @@ def test_event_log_roundtrip(tmp_path):
     assert path and os.path.exists(path)
     evs = read_event_log(path)
     kinds = [e["event"] for e in evs]
-    assert kinds[0] == "query_start"
+    # the query service prepends its admission lifecycle (docs/service.md)
+    assert kinds[:3] == ["query_queued", "query_admitted", "query_start"]
     assert kinds[-1] == "query_end"
     for required in ("plan", "op_metrics", "watermarks", "xla_compile"):
         assert required in kinds

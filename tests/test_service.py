@@ -1,0 +1,586 @@
+"""Concurrent query service: admission control, fair scheduling,
+cooperative cancellation, deadlines, leak-free teardown, and the
+JSON-lines gateway (service/, the Thrift-server + fair-scheduler +
+job-group-cancel analog)."""
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pandas as pd
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.config import (
+    SERVICE_ADMISSION_DEVICE_LIMIT, SERVICE_MAX_CONCURRENT,
+    SERVICE_SCHEDULER_MODE, SERVICE_SCHEDULER_POOLS, TpuConf)
+from spark_rapids_tpu.expr.expressions import col
+from spark_rapids_tpu.memory.diagnostics import leak_report
+from spark_rapids_tpu.service.query_manager import (
+    CancelToken, QueryCancelled, QueryManager, QueryState, QueryTimedOut)
+
+
+# =====================================================================
+# CancelToken
+# =====================================================================
+def test_cancel_token_basics():
+    t = CancelToken("q1")
+    t.check()                            # armed but untripped: no-op
+    assert not t.cancelled()
+    t.cancel("user asked")
+    assert t.cancelled()
+    with pytest.raises(QueryCancelled, match="user asked"):
+        t.check()
+
+
+def test_cancel_token_deadline_raises_timed_out():
+    t = CancelToken("q2", timeout_secs=0.05)
+    t.check()
+    time.sleep(0.08)
+    assert t.cancelled()
+    with pytest.raises(QueryTimedOut, match="deadline"):
+        t.check()
+    # QueryTimedOut is a QueryCancelled: one except clause covers both
+    assert issubclass(QueryTimedOut, QueryCancelled)
+
+
+# =====================================================================
+# scheduler semantics (raw QueryManager, no engine)
+# =====================================================================
+class _Gate:
+    """A submit() body that blocks until released (and stays
+    cancellable while blocked)."""
+
+    def __init__(self):
+        self.started = threading.Event()
+        self.release = threading.Event()
+
+    def fn(self, handle):
+        self.started.set()
+        while not self.release.wait(0.01):
+            handle.token.check()
+        return "done"
+
+
+def _conf(**over):
+    settings = {SERVICE_MAX_CONCURRENT.key: 1}
+    settings.update(over)
+    return TpuConf(settings)
+
+
+def test_fair_share_2to1_across_pools():
+    """Deficit round robin: under saturation, pool a (weight 2) is
+    granted twice for every pool-b (weight 1) grant."""
+    mgr = QueryManager(_conf(**{
+        SERVICE_SCHEDULER_POOLS.key: "warm:1,a:2,b:1"}))
+    order, lock = [], threading.Lock()
+    gate = _Gate()
+    blocker = mgr.submit(gate.fn, pool="warm", action="blocker")
+    assert gate.started.wait(5)
+
+    def mk(pool):
+        def fn(handle):
+            with lock:
+                order.append(pool)
+            return pool
+        return fn
+
+    # all queued behind the blocker, then drained one at a time
+    handles = [mgr.submit(mk("a"), pool="a") for _ in range(6)]
+    handles += [mgr.submit(mk("b"), pool="b") for _ in range(3)]
+    gate.release.set()
+    for h in handles + [blocker]:
+        h.result(timeout=30)
+    assert order.count("a") == 6 and order.count("b") == 3
+    # every 3-grant window of the drain splits 2:1
+    assert order[:3].count("a") == 2 and order[:3].count("b") == 1
+    assert order[:6].count("a") == 4 and order[:6].count("b") == 2
+
+
+def test_fifo_within_pool():
+    """A single pool is strict submission order even in fair mode."""
+    mgr = QueryManager(_conf())
+    order, lock = [], threading.Lock()
+    gate = _Gate()
+    blocker = mgr.submit(gate.fn, action="blocker")
+    assert gate.started.wait(5)
+
+    def mk(i):
+        def fn(handle):
+            with lock:
+                order.append(i)
+            return i
+        return fn
+
+    handles = [mgr.submit(mk(i)) for i in range(8)]
+    gate.release.set()
+    for h in handles + [blocker]:
+        h.result(timeout=30)
+    assert order == list(range(8))
+
+
+def test_fifo_mode_ignores_pool_weights():
+    """mode=fifo: global submission order across pools, weights moot."""
+    mgr = QueryManager(_conf(**{
+        SERVICE_SCHEDULER_MODE.key: "fifo",
+        SERVICE_SCHEDULER_POOLS.key: "a:8,b:1"}))
+    order, lock = [], threading.Lock()
+    gate = _Gate()
+    blocker = mgr.submit(gate.fn, pool="b", action="blocker")
+    assert gate.started.wait(5)
+
+    def mk(tag):
+        def fn(handle):
+            with lock:
+                order.append(tag)
+            return tag
+        return fn
+
+    handles = []
+    for i in range(6):  # interleave submissions: b0 a1 b2 a3 b4 a5
+        pool = "a" if i % 2 else "b"
+        handles.append(mgr.submit(mk(f"{pool}{i}"), pool=pool))
+    gate.release.set()
+    for h in handles + [blocker]:
+        h.result(timeout=30)
+    assert order == ["b0", "a1", "b2", "a3", "b4", "a5"]
+
+
+def test_admission_blocks_on_memory_then_unblocks():
+    """Memory-aware admission: a second query whose estimate would
+    blow the device budget queues until the first releases."""
+    mgr = QueryManager(TpuConf({
+        SERVICE_MAX_CONCURRENT.key: 4,
+        SERVICE_ADMISSION_DEVICE_LIMIT.key: 1000}))
+    g1, g2 = _Gate(), _Gate()
+    h1 = mgr.submit(g1.fn, estimate=(600, 0))
+    assert g1.started.wait(5)
+    h2 = mgr.submit(g2.fn, estimate=(600, 0))
+    # 600 + 600 > 1000: h2 must NOT start while h1 holds its grant
+    assert not g2.started.wait(0.3)
+    assert h2.state == QueryState.QUEUED
+    assert mgr.snapshot()["queued"] == 1
+    g2.release.set()                     # pre-release: runs on admission
+    g1.release.set()
+    assert h1.result(timeout=10) == "done"
+    assert h2.result(timeout=10) == "done"
+    assert mgr.scheduler._admitted_dev == 0     # estimates returned
+    assert mgr.scheduler._admitted_count == 0
+    assert mgr.snapshot()["queued_peak"] >= 1
+
+
+def test_oversized_query_admitted_when_alone():
+    """Never starve: an estimate beyond the whole budget still runs
+    when nothing else is admitted."""
+    mgr = QueryManager(TpuConf({
+        SERVICE_MAX_CONCURRENT.key: 2,
+        SERVICE_ADMISSION_DEVICE_LIMIT.key: 1000}))
+    h = mgr.submit(lambda handle: "huge", estimate=(10_000, 0))
+    assert h.result(timeout=10) == "huge"
+
+
+def test_cancel_while_queued():
+    mgr = QueryManager(_conf())
+    gate = _Gate()
+    blocker = mgr.submit(gate.fn, action="blocker")
+    assert gate.started.wait(5)
+    ran = threading.Event()
+
+    def fn(handle):
+        ran.set()  # pragma: no cover — must never be admitted
+
+    h2 = mgr.submit(fn)
+    assert h2.state == QueryState.QUEUED
+    assert h2.cancel("not needed")
+    assert h2.wait(5)
+    assert h2.state == QueryState.CANCELLED
+    with pytest.raises(QueryCancelled, match="not needed"):
+        h2.result(timeout=1)
+    assert mgr.snapshot()["cancelled"] == 1
+    assert mgr.scheduler.queued_count() == 0
+    gate.release.set()
+    assert blocker.result(timeout=10) == "done"
+    assert not ran.is_set()
+    assert mgr.snapshot()["running"] == 0
+    # cancelling a terminal query is a no-op
+    assert not h2.cancel("again")
+
+
+def test_deadline_while_queued():
+    mgr = QueryManager(_conf())
+    gate = _Gate()
+    blocker = mgr.submit(gate.fn, action="blocker")
+    assert gate.started.wait(5)
+    h2 = mgr.submit(lambda handle: "x", timeout=0.15)
+    assert h2.wait(10)
+    assert h2.state == QueryState.TIMED_OUT
+    with pytest.raises(QueryTimedOut):
+        h2.result(timeout=1)
+    assert mgr.snapshot()["timed_out"] == 1
+    assert h2.queue_wait_ms >= 100       # died waiting, never admitted
+    gate.release.set()
+    blocker.result(timeout=10)
+
+
+def test_deadline_while_running():
+    mgr = QueryManager(_conf())
+
+    def fn(handle):
+        while True:                      # cooperative poll loop
+            time.sleep(0.01)
+            handle.token.check()
+
+    h = mgr.submit(fn, timeout=0.2)
+    assert h.wait(10)
+    assert h.state == QueryState.TIMED_OUT
+    with pytest.raises(QueryTimedOut, match="deadline"):
+        h.result(timeout=1)
+    snap = mgr.snapshot()
+    assert snap["timed_out"] == 1 and snap["running"] == 0
+
+
+def test_submit_hammer_8_threads():
+    """8 client threads x 10 queries against one manager: every query
+    finishes, counters balance, nothing left admitted or queued."""
+    mgr = QueryManager(TpuConf({SERVICE_MAX_CONCURRENT.key: 3}))
+    results, lock, errors = [], threading.Lock(), []
+
+    def client(tid):
+        try:
+            hs = []
+            for i in range(10):
+                def fn(handle, tid=tid, i=i):
+                    time.sleep(0.001)
+                    return (tid, i)
+                hs.append(mgr.submit(fn, action=f"t{tid}-{i}"))
+            for h in hs:
+                r = h.result(timeout=60)
+                with lock:
+                    results.append(r)
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            with lock:
+                errors.append(e)
+
+    threads = [threading.Thread(target=client, args=(t,))
+               for t in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+    assert not errors
+    assert sorted(results) == [(t, i) for t in range(8)
+                               for i in range(10)]
+    snap = mgr.snapshot()
+    assert snap["submitted"] == 80
+    assert snap["admitted"] == 80 and snap["finished"] == 80
+    assert snap["running"] == 0 and snap["queued"] == 0
+    assert mgr.scheduler._admitted_count == 0
+    assert mgr._queries == {}            # handle table pruned
+
+
+# =====================================================================
+# engine integration: concurrency, cancellation, leaks
+# =====================================================================
+def _sleepy(pdf: pd.DataFrame) -> pd.DataFrame:
+    time.sleep(0.08)
+    return pdf
+
+
+@pytest.fixture(scope="module")
+def slow_query():
+    """A deterministically slow query (python worker sleeps per batch)
+    plus its serial reference result; warmed once so worker pools and
+    the session semaphore exist before leak baselines are taken."""
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 64})
+    n = 2048
+    rng = np.random.default_rng(7)
+    df = s.create_dataframe({
+        "k": pa.array(rng.integers(0, 10, n)),
+        "v": pa.array(rng.normal(0, 1, n))})
+    q = df.map_in_pandas(_sleepy, [("k", dt.INT64), ("v", dt.FLOAT64)]) \
+        .filter(col("v") > -100.0)       # device op downstream
+    ref = q.to_arrow()                   # warm run
+    assert ref.num_rows == n
+    return s, q
+
+
+def _resource_baseline(s):
+    from spark_rapids_tpu.memory.host import host_manager, staging_pool
+    return {"leaks": leak_report(),
+            "host_reserved": host_manager().reserved,
+            "staging_held": staging_pool().held_bytes,
+            "sem_available": s._semaphore._available}
+
+
+def _assert_resources_back_to(base, s):
+    from spark_rapids_tpu.memory.host import host_manager, staging_pool
+    after = leak_report()
+    assert after["openHandles"] == base["leaks"]["openHandles"]
+    assert after["deviceReservedBytes"] == \
+        base["leaks"]["deviceReservedBytes"]
+    assert host_manager().reserved == base["host_reserved"]
+    assert staging_pool().held_bytes == base["staging_held"]
+    sem = s._semaphore
+    assert sem._available == base["sem_available"]
+    assert sem._available == sem._permits     # every permit returned
+
+
+def test_cancel_mid_query_releases_all_resources(slow_query):
+    """Satellite (c): a forced mid-scan cancel returns device/host
+    reservations, semaphore permits, staging leases, and spill handles
+    to baseline."""
+    s, q = slow_query
+    base = _resource_baseline(s)
+    cancelled0 = s.query_manager().snapshot()["cancelled"]
+    h = q.submit()
+    time.sleep(0.25)                     # mid-run (full run >= 1s)
+    assert h.cancel("leak probe")
+    with pytest.raises(QueryCancelled, match="leak probe"):
+        h.result(timeout=60)
+    assert h.state == QueryState.CANCELLED
+    _assert_resources_back_to(base, s)
+    assert s.query_manager().snapshot()["cancelled"] == cancelled0 + 1
+
+
+def test_deadline_kill_releases_all_resources(slow_query):
+    s, q = slow_query
+    base = _resource_baseline(s)
+    timed0 = s.query_manager().snapshot()["timed_out"]
+    h = q.submit(timeout=0.3)
+    with pytest.raises(QueryTimedOut):
+        h.result(timeout=60)
+    assert h.state == QueryState.TIMED_OUT
+    _assert_resources_back_to(base, s)
+    assert s.query_manager().snapshot()["timed_out"] == timed0 + 1
+
+
+def test_sync_action_raises_query_timed_out(slow_query):
+    """The synchronous path (to_arrow on the caller's thread) honors
+    the session-wide deadline conf too."""
+    s, q = slow_query
+    old = s.conf
+    s.conf = s.conf.set(
+        "spark.rapids.tpu.sql.service.queryTimeoutSecs", 0.3)
+    try:
+        with pytest.raises(QueryTimedOut):
+            q.to_arrow()
+    finally:
+        s.conf = old
+
+
+def test_concurrent_streams_byte_identical_to_serial():
+    """4 client threads x 3 queries each return tables byte-identical
+    to the serial reference — concurrency must not perturb results."""
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 512})
+    n = 8000
+    rng = np.random.default_rng(11)
+    tab = pa.table({"k": pa.array(rng.integers(0, 9, n)),
+                    "v": pa.array(rng.normal(0, 1, n))})
+
+    def build():
+        df = s.create_dataframe(tab)
+        return df.filter(col("v") > 0).select(
+            col("k"), (col("v") * 3.0).alias("w"))
+
+    ref = build().to_arrow()
+    finished0 = s.query_manager().snapshot()["finished"]
+    errors = []
+
+    def stream():
+        try:
+            for _ in range(3):
+                t = build().submit().result(timeout=120)
+                if not t.equals(ref):
+                    errors.append("result diverged from serial run")
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            errors.append(repr(e))
+
+    threads = [threading.Thread(target=stream) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(180)
+    assert not errors
+    snap = s.query_manager().snapshot()
+    assert snap["finished"] - finished0 >= 12
+    assert snap["running"] == 0 and snap["queued"] == 0
+
+
+# =====================================================================
+# satellite (b): semaphore + queue-wait metrics surfaced
+# =====================================================================
+def test_semaphore_and_queue_metrics_surface():
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 512})
+    n = 4000
+    rng = np.random.default_rng(3)
+    df = s.create_dataframe({"k": pa.array(rng.integers(0, 5, n)),
+                             "v": pa.array(rng.normal(0, 1, n))})
+    q = df.filter(col("v") > 0).select(col("k"),
+                                       (col("v") + 1.0).alias("w"))
+    q.to_arrow()
+    root = q._last_root
+    m = q.last_metrics()[root._op_id]
+    assert m.get("semaphoreAcquires", 0) >= 1
+    assert "queueWaitMs" in m
+    assert "semaphoreWaitMs" in m
+    sem = s._semaphore
+    assert sem.metrics["acquires"] >= 1
+    assert sem.metrics["acquireWaitTime"] >= 0.0
+    text = q.explain("ANALYZE")
+    assert "queueWaitMs=" in text
+    assert "semaphoreWaitMs=" in text
+    assert "semaphoreAcquires=" in text
+
+
+# =====================================================================
+# satellite: event-log lifecycle events
+# =====================================================================
+def _event_logs(tmp_path):
+    out = []
+    for p in sorted(tmp_path.glob("*.jsonl")):
+        with open(p, encoding="utf-8") as f:
+            out.append([json.loads(line) for line in f if line.strip()])
+    return out
+
+
+def test_event_log_records_service_lifecycle(tmp_path):
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.batchSizeRows": 256,
+        "spark.rapids.tpu.sql.eventLog.enabled": True,
+        "spark.rapids.tpu.sql.eventLog.dir": str(tmp_path)})
+    df = s.create_dataframe({"a": pa.array([1, 2, 3, 4])})
+    df.select((col("a") * 2).alias("b")).to_arrow()
+    logs = _event_logs(tmp_path)
+    assert logs
+    evs = logs[-1]
+    names = [e["event"] for e in evs]
+    assert "query_queued" in names
+    assert "query_admitted" in names
+    assert names.index("query_queued") < names.index("query_admitted") \
+        < names.index("query_start")
+    admitted = next(e for e in evs if e["event"] == "query_admitted")
+    assert "queue_wait_ms" in admitted and "pool" in admitted
+    end = next(e for e in evs if e["event"] == "query_end")
+    assert end["status"] == "ok"
+
+
+def test_event_log_records_deadline_kill(tmp_path):
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.batchSizeRows": 64,
+        "spark.rapids.tpu.sql.eventLog.enabled": True,
+        "spark.rapids.tpu.sql.eventLog.dir": str(tmp_path)})
+    n = 1024
+    df = s.create_dataframe({"k": pa.array(list(range(n))),
+                             "v": pa.array([0.5] * n)})
+    q = df.map_in_pandas(_sleepy, [("k", dt.INT64), ("v", dt.FLOAT64)])
+    h = q.submit(timeout=0.3)
+    with pytest.raises(QueryTimedOut):
+        h.result(timeout=60)
+    cancelled = [e for log in _event_logs(tmp_path) for e in log
+                 if e["event"] == "query_cancelled"]
+    assert cancelled and cancelled[-1]["reason"] == "timeout"
+    ends = [e for log in _event_logs(tmp_path) for e in log
+            if e["event"] == "query_end"]
+    assert any(e["status"] == "timeout" for e in ends)
+
+
+# =====================================================================
+# JSON-lines gateway
+# =====================================================================
+def _rpc(f, **req):
+    f.write(json.dumps(req) + "\n")
+    f.flush()
+    return json.loads(f.readline())
+
+
+def test_gateway_round_trip():
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 128})
+    n = 300
+    df = s.create_dataframe({"k": pa.array(list(range(n))),
+                             "v": pa.array([float(i % 7) for i in
+                                            range(n)])})
+    df.create_or_replace_temp_view("service_t")
+    srv = s.serve()
+    sock = None
+    try:
+        sock = socket.create_connection(srv.address, timeout=10)
+        f = sock.makefile("rw", encoding="utf-8")
+        pong = _rpc(f, op="ping")
+        assert pong["ok"] and "stats" in pong
+        sub = _rpc(f, op="submit",
+                   sql="SELECT k, v FROM service_t WHERE v > 3")
+        assert sub["ok"]
+        qid = sub["query_id"]
+        deadline = time.monotonic() + 60
+        while True:
+            status = _rpc(f, op="status", query_id=qid)
+            assert status["ok"]
+            if status["state"] in ("FINISHED", "FAILED", "CANCELLED",
+                                   "TIMED_OUT"):
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        assert status["state"] == "FINISHED"
+        assert status["queue_wait_ms"] >= 0
+        # page through the columnar result
+        rows, page = 0, 0
+        while True:
+            pg = _rpc(f, op="fetch", query_id=qid, page=page,
+                      page_rows=50)
+            assert pg["ok"]
+            rows += pg["num_rows"]
+            assert all(v > 3 for v in pg["columns"]["v"])
+            if pg["last"]:
+                break
+            page += 1
+        expect = sum(1 for i in range(n) if i % 7 > 3)
+        assert rows == expect == pg["total_rows"]
+        # error surfaces, not a dropped connection
+        bad = _rpc(f, op="status", query_id="nope")
+        assert not bad["ok"] and "unknown query_id" in bad["error"]
+        unk = _rpc(f, op="frobnicate")
+        assert not unk["ok"] and "unknown op" in unk["error"]
+        mangled = _rpc(f, op="submit", sql="SELECT FROM FROM")
+        assert not mangled["ok"]
+    finally:
+        if sock is not None:
+            sock.close()
+        srv.close()
+
+
+def test_gateway_cancel():
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 64})
+    n = 2048
+    df = s.create_dataframe({"k": pa.array(list(range(n))),
+                             "v": pa.array([1.0] * n)})
+    slow = df.map_in_pandas(_sleepy, [("k", dt.INT64),
+                                      ("v", dt.FLOAT64)])
+    slow.create_or_replace_temp_view("service_slow_t")
+    srv = s.serve()
+    sock = None
+    try:
+        sock = socket.create_connection(srv.address, timeout=10)
+        f = sock.makefile("rw", encoding="utf-8")
+        sub = _rpc(f, op="submit", sql="SELECT * FROM service_slow_t")
+        assert sub["ok"]
+        qid = sub["query_id"]
+        cn = _rpc(f, op="cancel", query_id=qid)
+        assert cn["ok"]
+        deadline = time.monotonic() + 60
+        while True:
+            status = _rpc(f, op="status", query_id=qid)
+            # cancelled, or finished first: both are clean outcomes
+            if status["state"] in ("CANCELLED", "FINISHED"):
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        if status["state"] == "CANCELLED":
+            pg = _rpc(f, op="fetch", query_id=qid)
+            assert not pg["ok"] and "QueryCancelled" in pg["error"]
+    finally:
+        if sock is not None:
+            sock.close()
+        srv.close()

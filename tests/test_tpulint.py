@@ -306,6 +306,67 @@ def test_jit_instance_allow_marker_suppresses():
 
 
 # ----------------------------------------------------------------------
+# ctx-cancel
+# ----------------------------------------------------------------------
+def test_ctx_cancel_fires_on_uncheckpointed_batch_loop():
+    vs = _lint_exec("""
+        class P:
+            def execute_partition(self, ctx, pid):
+                for b in self.children[0].execute_partition(ctx, pid):
+                    yield b
+    """)
+    assert [v.rule for v in vs] == ["ctx-cancel"]
+    assert "check_cancel" in vs[0].message
+
+
+def test_ctx_cancel_execute_all_loop_fires():
+    assert [v.rule for v in _lint_exec("""
+        class P:
+            def build(self, ctx):
+                for b in self.build_side.execute_all(ctx):
+                    self.absorb(b)
+    """)] == ["ctx-cancel"]
+
+
+def test_ctx_cancel_checkpointed_loop_clean():
+    assert [v.rule for v in _lint_exec("""
+        class P:
+            def execute_partition(self, ctx, pid):
+                for b in self.children[0].execute_partition(ctx, pid):
+                    ctx.check_cancel()
+                    yield b
+    """)] == []
+
+
+def test_ctx_cancel_outside_exec_not_flagged():
+    assert _rules("""
+        class P:
+            def execute_partition(self, ctx, pid):
+                for b in self.children[0].execute_partition(ctx, pid):
+                    yield b
+    """) == []
+
+
+def test_ctx_cancel_allow_marker_suppresses():
+    assert [v.rule for v in _lint_exec("""
+        class P:
+            def execute_partition(self, ctx, pid):
+                # tpulint: allow[ctx-cancel] single-batch source loop
+                for b in self.children[0].execute_partition(ctx, pid):
+                    yield b
+    """)] == []
+
+
+def test_ctx_cancel_non_batch_loop_not_flagged():
+    assert [v.rule for v in _lint_exec("""
+        class P:
+            def execute_partition(self, ctx, pid):
+                for cpid in range(self.num_partitions(ctx)):
+                    yield cpid
+    """)] == []
+
+
+# ----------------------------------------------------------------------
 # allow markers
 # ----------------------------------------------------------------------
 def test_marker_on_line_suppresses():
