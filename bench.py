@@ -433,6 +433,19 @@ def _main_impl():
     # Skipped under --smoke: it rewrites the whole dataset as parquet.
     if _SMOKE:
         _partial["extra"]["smoke"] = True
+        # exchange-pipeline smoke (ISSUE 9): reuse dedup, q4 map-thread
+        # speedup, serial/parallel/reused parity — before the
+        # concurrent section so both share what budget remains
+        try:
+            with _alarm(max(0.0, _remaining() - 60.0),
+                        "exchange pipeline smoke"):
+                _partial["extra"]["exchange"] = _exchange_smoke(sf_full)
+        except _BenchTimeout as e:
+            _partial["extra"]["exchange"] = {"error": f"timeout: {e}"}
+        except Exception as e:  # advisory: never lose the bench result
+            _partial["extra"]["exchange"] = {"error": repr(e)[:300]}
+            print(f"bench: exchange smoke failed: {e!r}",
+                  file=sys.stderr)
         # 2-stream throughput variant: the concurrent query service's
         # smoke surface (byte-identical to serial, no leaks after a
         # forced cancel, service counters in extra.service). This is
@@ -499,7 +512,7 @@ def _main_impl():
     # milestone-only keys (scan profile, smoke flag) must survive into
     # the success-path JSON too, not just the partial flush
     for k in ("scan_profile", "smoke", "fresh_rerun_compiles",
-              "concurrent_2stream", "service"):
+              "concurrent_2stream", "service", "exchange"):
         if k in _partial["extra"]:
             extra[k] = _partial["extra"][k]
     # ---- regression gate vs the previous round's JSON -------------------
@@ -745,6 +758,127 @@ def _concurrent_throughput(s, sf: float, n_streams: int,
         out["errors"] = errors[:10]
     for df in dfs.values():
         df.uncache()
+    return out
+
+
+def _exchange_smoke(sf: float) -> dict:
+    """Exchange-pipeline smoke surface (ISSUE 9 acceptance): (a) a
+    duplicate-exchange query (shuffled self-join) executes its map
+    phase once per DISTINCT subtree — `exchangeReuseHits >= 1`, the
+    map-side execution counter is equal across serial-map and
+    parallel-map runs with reuse on, and strictly below the reuse-off
+    counter; (b) fresh q4 wall-clock with the parallel map side vs the
+    serial-map baseline on this machine; (c) byte-identical results
+    across the serial / parallel / reused paths for every TPC-H query
+    the remaining budget covers."""
+    import spark_rapids_tpu as st
+    from spark_rapids_tpu.exec.exchange import map_partitions_executed
+    from spark_rapids_tpu.workloads import tpch
+
+    def mk(threads, reuse):
+        return st.TpuSession({
+            "spark.rapids.tpu.sql.shuffle.partitions": 4,
+            "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1,
+            "spark.rapids.tpu.sql.exec.exchange.mapThreads": threads,
+            "spark.rapids.tpu.sql.exec.exchange.reuse.enabled": reuse})
+
+    out = {}
+
+    # ---- (a) duplicate-exchange dedup (deterministic: hard asserts) ----
+    def dup_run(threads, reuse):
+        s2 = mk(threads, reuse)
+        df = s2.create_dataframe({"k": list(range(64)) * 8,
+                                  "v": list(range(512))})
+        m0 = map_partitions_executed()
+        j = df.join(df, on="k")
+        rows = sorted(map(tuple, j.collect()))
+        hits = sum(int(m.get("exchangeReuseHits", 0))
+                   for m in j.last_metrics().values())
+        return rows, map_partitions_executed() - m0, hits
+
+    rows_ser, maps_ser, hits_ser = dup_run(1, True)
+    rows_par, maps_par, hits_par = dup_run(4, True)
+    rows_off, maps_off, _ = dup_run(4, False)
+    assert hits_par >= 1, "exchange reuse did not fire on self-join"
+    assert maps_ser == maps_par, \
+        "parallel map changed the map-side execution counter"
+    assert maps_par < maps_off, \
+        "reuse did not elide the duplicate map phase"
+    assert rows_ser == rows_par == rows_off, \
+        "self-join rows differ across serial/parallel/reuse paths"
+    out["reuse_hits"] = hits_par
+    out["dup_map_execs_reused"] = maps_par
+    out["dup_map_execs_no_reuse"] = maps_off
+
+    reg = tpch.queries()
+    tabs = tpch.gen_all(sf=sf, seed=7)
+
+    # ---- (b) fresh q4: parallel map vs serial-map baseline -------------
+    try:
+        def q4_time(threads):
+            s2 = mk(threads, True)
+            dfs = {k: s2.create_dataframe(v).cache()
+                   for k, v in tabs.items()}
+            reg[4](dfs).to_arrow()          # warm the program cache
+            t = _best_fresh(lambda: reg[4](dfs), 2)
+            for df in dfs.values():
+                df.uncache()
+            return t
+
+        with _alarm(min(120.0, max(5.0, _remaining() - 90.0)),
+                    "exchange q4 speedup"):
+            ser_t = q4_time(1)
+            par_t = q4_time(0)              # 0 = auto min(4, cores)
+        out["q4_serial_map_s"] = round(ser_t, 4)
+        out["q4_parallel_map_s"] = round(par_t, 4)
+        out["q4_map_speedup"] = round(ser_t / par_t, 3)
+        out["q4_speedup_pass"] = (ser_t / par_t) >= 1.3
+        if not out["q4_speedup_pass"]:
+            print(f"bench: exchange q4 map speedup "
+                  f"{ser_t / par_t:.2f}x < 1.3x target",
+                  file=sys.stderr)
+    except _BenchTimeout as e:
+        out["q4_speedup_error"] = f"timeout: {e}"
+    except Exception as e:  # advisory: keep the dedup evidence
+        out["q4_speedup_error"] = repr(e)[:300]
+
+    # ---- (c) serial / parallel / reused parity over the suite ----------
+    try:
+        sessions = [mk(1, False), mk(4, False), mk(4, True)]
+        all_dfs = [{k: s2.create_dataframe(v).cache()
+                    for k, v in tabs.items()} for s2 in sessions]
+        verified, identical, mismatches = 0, 0, []
+        for qn in sorted(reg):
+            left = _remaining() - 45.0      # flush + concurrent tail
+            if left <= 2.0:
+                out["parity_note"] = \
+                    f"budget exhausted after q{qn - 1}"
+                break
+            try:
+                with _alarm(min(_QUERY_BUDGET_S, left),
+                            f"exchange parity q{qn}"):
+                    ref = reg[qn](all_dfs[0]).to_arrow()
+                    same = all(reg[qn](d).to_arrow().equals(ref)
+                               for d in all_dfs[1:])
+                verified += 1
+                identical += bool(same)
+                if not same:
+                    mismatches.append(qn)
+            except _BenchTimeout:
+                out.setdefault("parity_timeouts", []).append(qn)
+        out["parity_verified"] = verified
+        out["parity_identical"] = identical
+        if mismatches:
+            out["parity_mismatches"] = mismatches
+        assert not mismatches, \
+            f"exchange paths disagree on queries {mismatches}"
+        for dfs in all_dfs:
+            for df in dfs.values():
+                df.uncache()
+    except Exception as e:  # advisory beyond the mismatch assert
+        out.setdefault("parity_error", repr(e)[:300])
+        if "disagree" in str(e):
+            raise
     return out
 
 

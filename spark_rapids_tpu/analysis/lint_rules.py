@@ -543,6 +543,50 @@ def rule_ctx_cancel(ctx: _ModuleCtx):
                    "add ctx.check_cancel() at the top of the loop body")
 
 
+def rule_pool_cancel(ctx: _ModuleCtx):
+    """Flag exec/ worker functions handed to a thread pool
+    (`<pool>.submit(worker, ...)`) whose body never polls the
+    cooperative cancel token: a cancelled query joins the pool's
+    futures, so a worker that never calls `ctx.check_cancel()` (or a
+    `check_cancel`-polling helper) keeps running map/build work to
+    completion after the cancel — the pool drain blocks on it and the
+    query's resources stay pinned for the full phase."""
+    if not re.search(r"(^|/)exec/", ctx.path):
+        return
+
+    submitted: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "submit" and node.args:
+            target = node.args[0]
+            if isinstance(target, ast.Name):
+                submitted.add(target.id)
+            elif isinstance(target, ast.Attribute):
+                submitted.add(target.attr)
+
+    if not submitted:
+        return
+
+    def polls_cancel(fn) -> bool:
+        for n in ast.walk(fn):
+            if isinstance(n, ast.Call) \
+                    and isinstance(n.func, ast.Attribute) \
+                    and n.func.attr == "check_cancel":
+                return True
+        return False
+
+    for fn in ast.walk(ctx.tree):
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and fn.name in submitted and not polls_cancel(fn):
+            yield (fn.lineno, fn.col_offset, "pool-cancel",
+                   f"worker {fn.name!r} is submitted to a thread pool "
+                   f"but never polls the cancel token: a cancelled "
+                   f"query blocks on the pool drain while this worker "
+                   f"runs its whole loop — poll ctx.check_cancel() "
+                   f"inside the worker")
+
+
 RULES = {
     "host-sync": rule_host_sync,
     "block-sync": rule_block_sync,
@@ -551,6 +595,7 @@ RULES = {
     "donate-missing": rule_donate_missing,
     "jit-instance": rule_jit_instance,
     "ctx-cancel": rule_ctx_cancel,
+    "pool-cancel": rule_pool_cancel,
 }
 
 

@@ -159,30 +159,37 @@ def _serve_conn(sock: socket.socket):
         sock.close()
 
 
-def ensure_server() -> Tuple[str, int]:
-    """Start (once) the block server in this process; returns its
-    address for shuffle-map metadata."""
+def ensure_server(advertise_host: str = None) -> Tuple[str, int]:
+    """Start (once) the block server in this process; returns the
+    ADVERTISED address for shuffle-map metadata. Binds all interfaces
+    so multi-host reducers can connect; what gets advertised to them is
+    `advertise_host` (conf `cluster.blockServer.advertiseHost`), which
+    defaults to 127.0.0.1 — correct for the single-host default
+    deployment, and never leaks a wildcard address into metadata."""
     global _SERVER_ADDR
     with _INIT_LOCK:
-        if _SERVER_ADDR is not None:
-            return _SERVER_ADDR
-        listener = socket.socket()
-        listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        listener.bind(("127.0.0.1", 0))
-        listener.listen(16)
-        _SERVER_ADDR = listener.getsockname()
+        if _SERVER_ADDR is None:
+            listener = socket.socket()
+            listener.setsockopt(socket.SOL_SOCKET,
+                                socket.SO_REUSEADDR, 1)
+            listener.bind(("0.0.0.0", 0))
+            listener.listen(16)
+            _SERVER_ADDR = listener.getsockname()
 
-        def accept_loop():
-            while True:
-                try:
-                    conn, _ = listener.accept()
-                except OSError:
-                    return
-                threading.Thread(target=_serve_conn, args=(conn,),
-                                 daemon=True).start()
+            def accept_loop():
+                while True:
+                    try:
+                        conn, _ = listener.accept()
+                    except OSError:
+                        return
+                    threading.Thread(target=_serve_conn, args=(conn,),
+                                     daemon=True).start()
 
-        threading.Thread(target=accept_loop, daemon=True).start()
-        return _SERVER_ADDR
+            threading.Thread(target=accept_loop, daemon=True).start()
+        if not advertise_host:
+            from ..config import CLUSTER_BLOCK_ADVERTISE_HOST
+            advertise_host = CLUSTER_BLOCK_ADVERTISE_HOST.default
+        return (advertise_host, _SERVER_ADDR[1])
 
 
 def fetch_blocks(addr: Tuple[str, int], shuffle_id: str,

@@ -92,7 +92,9 @@ def map_fragment_task(map_fn, split, conf, n_reduce: int,
     if shuffle_id is None:
         return ArrowResult({"pids": pids}, tables)
     from . import blocks
-    addr = blocks.ensure_server()
+    from ..config import CLUSTER_BLOCK_ADVERTISE_HOST
+    addr = blocks.ensure_server(
+        s.conf.get(CLUSTER_BLOCK_ADVERTISE_HOST))
     st_ = blocks.store()
     sizes = {}
     for pid, t in zip(pids, tables):

@@ -100,6 +100,40 @@ SHUFFLE_WRITER_THREADS = _conf(
 SHUFFLE_READER_THREADS = _conf(
     "shuffle.multiThreaded.reader.threads", 4,
     "Thread pool size for shuffle reads.", int)
+EXCHANGE_MAP_THREADS = _conf(
+    "sql.exec.exchange.mapThreads", 0,
+    "Worker threads executing an exchange's map-side child partitions "
+    "concurrently (each worker runs a full map partition: child "
+    "execute, device partition pass, host slicing, shuffle write). "
+    "Device admission still goes through the TpuSemaphore, so chip "
+    "concurrency stays bounded by sql.concurrentTpuTasks; this conf "
+    "overlaps the HOST halves (decode, slicing, serialization, file "
+    "I/O) across partitions (the RapidsShuffleThreadedWriter analog). "
+    "0 = auto (min(4, cpu cores)); 1 = serial map side.", int)
+EXCHANGE_ASYNC_BROADCAST = _conf(
+    "sql.exec.exchange.asyncBroadcast.enabled", True,
+    "Materialize a broadcast join's build side on a background thread "
+    "started when the JOIN begins executing, so the build overlaps the "
+    "stream side's scan/decode instead of serializing in front of it "
+    "(GpuBroadcastExchangeExec async-collect analog). The join blocks "
+    "on the future at probe time, bounded by broadcastTimeoutSecs.",
+    bool)
+EXCHANGE_BROADCAST_TIMEOUT = _conf(
+    "sql.exec.exchange.broadcastTimeoutSecs", 300.0,
+    "Upper bound on the join's wait for an async broadcast build "
+    "(spark.sql.broadcastTimeout analog). On timeout the join degrades "
+    "to the synchronous build path on the calling thread and counts "
+    "broadcastTimeoutFallbacks — it never hangs. 0 = wait forever.",
+    float)
+EXCHANGE_REUSE = _conf(
+    "sql.exec.exchange.reuse.enabled", True,
+    "Plan-level exchange deduplication (Spark's ReuseExchange rule): "
+    "after fusion, structurally identical exchange subtrees (same "
+    "fingerprint under gensym normalization) are rewritten to "
+    "ReusedExchange nodes sharing the first occurrence's materialized "
+    "shuffle blocks — one map phase per distinct subtree per query. "
+    "Hits surface as exchangeReuseHits in EXPLAIN ANALYZE and the "
+    "event log.", bool)
 TEXT_BLOCK_SIZE = _conf(
     "sql.text.blockSize", 32 * 1024 * 1024,
     "Host decode block size (bytes) for streaming CSV/JSON scans.", int)
@@ -257,6 +291,13 @@ CLUSTER_EXECUTORS = _conf(
     "driver/executor split of Plugin.scala; 0 = in-process). The TPU "
     "client stays in the driver — executors parallelize host decode and "
     "ship Arrow IPC back; heartbeat loss requeues their tasks.", int)
+CLUSTER_BLOCK_ADVERTISE_HOST = _conf(
+    "cluster.blockServer.advertiseHost", "127.0.0.1",
+    "Host address the shuffle block server advertises to peers in its "
+    "block locations (the server itself binds 0.0.0.0, so remote "
+    "executors can connect when this is set to a routable address). "
+    "Default keeps the single-host topology: every executor process "
+    "lives on this machine and fetches over loopback.", str)
 CLUSTER_HEARTBEAT_TIMEOUT = _conf(
     "cluster.heartbeatTimeoutSeconds", 3.0,
     "Executor liveness: no heartbeat for this long marks the executor "

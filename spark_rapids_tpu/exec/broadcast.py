@@ -1,0 +1,137 @@
+"""Broadcast exchange: collect-once build side for broadcast hash joins.
+
+(reference: GpuBroadcastExchangeExec.scala — the build side materializes
+ON A BACKGROUND THREAD bounded by spark.sql.broadcastTimeout, so the
+stream side's scan/decode overlaps the build instead of serializing
+behind it.) The node owns the materialized build batches, so (a) the
+join can kick the build off asynchronously at execute time and block
+only when it actually needs the data, and (b) the plan-level reuse pass
+(plan/reuse.py) can dedupe structurally identical broadcast subtrees —
+both consumers share one materialization under the instance lock.
+
+Timeout semantics: `await_build` degrades, never hangs. Past the conf
+deadline it counts `broadcastTimeoutFallbacks` and runs the build
+synchronously on the calling thread — if the background future already
+started, the instance lock makes that a bounded wait for the in-flight
+build (which still polls the cancel token) rather than duplicate work.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import List, Optional
+
+from .base import ExecContext, TpuExec
+
+__all__ = ["BroadcastExchangeExec", "on_build_pool"]
+
+_POOL_LOCK = threading.Lock()
+_POOL = None
+
+
+def _build_pool():
+    """Shared daemon pool for async broadcast builds (a few concurrent
+    builds at most: one per broadcast join executing)."""
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            import concurrent.futures as cf
+            _POOL = cf.ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="bcast-build")
+        return _POOL
+
+
+def on_build_pool() -> bool:
+    """True when the current thread IS a broadcast-build pool worker.
+    A build whose subtree contains another broadcast join must
+    materialize that nested build inline: submitting it to the same
+    bounded pool and waiting on the future forms a wait cycle (every
+    worker parked on a future queued behind itself) that only the
+    await timeout can break."""
+    return threading.current_thread().name.startswith("bcast-build")
+
+
+class BroadcastExchangeExec(TpuExec):
+    def __init__(self, child: TpuExec, schema):
+        super().__init__([child], schema)
+        self._lock = threading.RLock()
+        self._batches: Optional[List] = None
+        self._future = None
+        self._future_lock = threading.Lock()
+        self._submit_t: Optional[float] = None
+
+    def describe(self):
+        return "BroadcastExchangeExec"
+
+    def num_partitions(self, ctx):
+        return 1
+
+    # ------------------------------------------------------------------
+    def _materialize(self, ctx: ExecContext) -> List:
+        with self._lock:
+            if self._batches is None:
+                m = ctx.metrics_for(self._op_id)
+                child = self.children[0]
+                out = []
+                with m.timer("buildTime"):
+                    for bpid in range(child.num_partitions(ctx)):
+                        for b in child.execute_partition(ctx, bpid):
+                            ctx.check_cancel()
+                            out.append(b)
+                m.set("numOutputBatches", len(out))
+                self._batches = out
+            return self._batches
+
+    def build_done(self) -> bool:
+        """Whether the materialized build is ready without blocking."""
+        if self._batches is not None:
+            return True
+        f = self._future
+        return f is not None and f.done()
+
+    def submit_build(self, ctx: ExecContext):
+        """Kick the build onto the background pool; idempotent (one
+        future per instance, shared by every consumer)."""
+        with self._future_lock:
+            if self._future is None:
+                self._submit_t = time.perf_counter()
+                self._future = _build_pool().submit(self._materialize,
+                                                    ctx)
+            return self._future
+
+    def await_build(self, ctx: ExecContext,
+                    timeout_secs: float) -> List:
+        """Block on the async build, bounded by timeout_secs (0 = wait
+        forever). On timeout: count the fallback and run/join the build
+        synchronously on this thread — never an unbounded silent hang."""
+        import concurrent.futures as cf
+        m = ctx.metrics_for(self._op_id)
+        fut = self.submit_build(ctx)
+        t_await = time.perf_counter()
+        try:
+            batches = fut.result(timeout_secs if timeout_secs
+                                 and timeout_secs > 0 else None)
+        except cf.TimeoutError:
+            m.add("broadcastTimeoutFallbacks", 1)
+            fut.cancel()  # not-yet-started futures build fresh below
+            batches = self._materialize(ctx)
+        # build time that ran while the stream side worked: everything
+        # between submit and the moment the join blocked on the result
+        if self._submit_t is not None:
+            overlap = max(0.0, t_await - self._submit_t)
+            m.add("broadcastBuildOverlapMs", round(overlap * 1e3, 3))
+            self._submit_t = None
+        return batches
+
+    # ------------------------------------------------------------------
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        for b in self._materialize(ctx):
+            ctx.check_cancel()
+            yield b
+
+    def release(self):
+        with self._lock:
+            self._batches = None
+        with self._future_lock:
+            self._future = None
+        super().release()

@@ -28,7 +28,24 @@ from ..utils.transfer import fetch
 from .base import ExecContext, TpuExec
 from .batch import DeviceBatch
 
-__all__ = ["ShuffleExchangeExec", "RangeShuffleExchangeExec"]
+__all__ = ["ShuffleExchangeExec", "RangeShuffleExchangeExec",
+           "map_partitions_executed"]
+
+# process-global count of map partitions actually EXECUTED (not served
+# from a materialized shuffle): the exchange-reuse acceptance counter —
+# a deduped plan must show the same delta as its single-occurrence run
+_map_exec_lock = threading.Lock()
+_map_exec_stats = {"partitions": 0}
+
+
+def map_partitions_executed() -> int:
+    with _map_exec_lock:
+        return _map_exec_stats["partitions"]
+
+
+def _count_map_exec(n: int = 1):
+    with _map_exec_lock:
+        _map_exec_stats["partitions"] += n
 
 
 def _finish_map(cvs, mask, pids, n):
@@ -147,26 +164,92 @@ class ShuffleExchangeExec(TpuExec):
                         "counts": counts,
                     })
 
-            for mpid in range(child.num_partitions(ctx)):
+            def slice_into(host, pieces):
+                """Host-side: cut one map pass output into per-reduce
+                sub-batches (numpy views, no device work)."""
+                # tpulint: allow[host-sync] `host` is map_one's fetch output (numpy views)
+                counts_h = np.asarray(host["counts"])
+                starts = np.concatenate(
+                    [[0], np.cumsum(counts_h)]).astype(np.int64)
+                for rp in range(self.n):
+                    cnt = int(counts_h[rp])
+                    if cnt == 0:
+                        continue
+                    lo, hi = int(starts[rp]), int(starts[rp] + cnt)
+                    from ..shuffle.serializer import slice_host_col
+                    cols = [slice_host_col(cb, lo, hi)
+                            for cb in host["cols"]]
+                    pieces[rp].append(HostSubBatch(cols, cnt))
+
+            def map_partition(mpid, rider=None, stop=None):
+                """One full map task: child execute + device partition
+                pass (permit-bounded when pooled), host slicing, shuffle
+                write. Workers write to their own mpid-keyed file, so
+                pool completion order never changes reduce-side bytes."""
                 pieces = [[] for _ in range(self.n)]
-                for batch in child.execute_partition(ctx, mpid):
+                it = child.execute_partition(ctx, mpid)
+                while True:
                     ctx.check_cancel()
-                    for host in with_retry(batch, map_one):
-                        # tpulint: allow[host-sync] `host` is map_one's fetch output (numpy views)
-                        counts_h = np.asarray(host["counts"])
-                        starts = np.concatenate(
-                            [[0], np.cumsum(counts_h)]).astype(np.int64)
-                        for rp in range(self.n):
-                            cnt = int(counts_h[rp])
-                            if cnt == 0:
-                                continue
-                            lo, hi = int(starts[rp]), int(starts[rp] + cnt)
-                            from ..shuffle.serializer import slice_host_col
-                            cols = [slice_host_col(cb, lo, hi)
-                                    for cb in host["cols"]]
-                            pieces[rp].append(HostSubBatch(cols, cnt))
+                    if stop is not None and stop.is_set():
+                        return  # a sibling worker failed; unwind quietly
+                    if rider is None:
+                        batch = next(it, None)
+                        hosts = (None if batch is None
+                                 else list(with_retry(batch, map_one)))
+                    else:
+                        # device admission: ride the caller's permit or
+                        # take a real one (exchange_pool.PermitRider)
+                        with rider.step():
+                            batch = next(it, None)
+                            hosts = (None if batch is None
+                                     else list(with_retry(batch,
+                                                          map_one)))
+                    if batch is None:
+                        break
+                    for host in hosts:
+                        slice_into(host, pieces)
                 with m.timer("writeTime"):
                     sh.write_map_partition(mpid, pieces)
+                _count_map_exec()
+
+            nparts = child.num_partitions(ctx)
+            from .exchange_pool import PermitRider, resolve_map_threads
+            threads = resolve_map_threads(ctx, nparts)
+            try:
+                if threads <= 1 or nparts <= 1:
+                    for mpid in range(nparts):
+                        map_partition(mpid)
+                else:
+                    import concurrent.futures as cf
+                    from .nodes import _session_semaphore
+                    sem = _session_semaphore(ctx)
+                    rider = PermitRider(
+                        sem, priority=getattr(ctx, "sem_priority", 0),
+                        token=ctx.cancel)
+                    stop = threading.Event()
+                    with cf.ThreadPoolExecutor(
+                            threads,
+                            thread_name_prefix="exch-map") as pool:
+                        futs = [pool.submit(map_partition, mpid, rider,
+                                            stop)
+                                for mpid in range(nparts)]
+                        try:
+                            for f in cf.as_completed(futs):
+                                f.result()
+                        except BaseException:
+                            stop.set()  # drain in-flight workers fast
+                            for f in futs:
+                                f.cancel()
+                            raise
+                    if rider.waited_secs > 0:
+                        # Ms suffix on purpose: op_time_seconds sums
+                        # *Time keys and pool wait is not operator time
+                        m.add("mapPoolWaitMs",
+                              round(rider.waited_secs * 1e3, 3))
+            except BaseException:
+                sh.cleanup()  # cancelled/failed map phase leaks nothing
+                raise
+            m.set("mapPartitionsExecuted", nparts)
             # data-movement visibility (the Theseus point PAPERS.md
             # makes): serialized bytes through this exchange, for the
             # event log / EXPLAIN ANALYZE

@@ -485,23 +485,50 @@ class HashJoinExec(TpuExec):
             return
         m = ctx.metrics_for(self._op_id)
         right = self.children[1]
-        build_pids = ([pid] if self.per_partition
-                      else range(right.num_partitions(ctx)))
-        with m.timer("buildTime"):
-            bbatches = []
-            for bpid in build_pids:
-                bbatches.extend(right.execute_partition(ctx, bpid))
+        stream_batches = self._stream_batches(ctx, pid)
+        from ..config import (EXCHANGE_ASYNC_BROADCAST,
+                              EXCHANGE_BROADCAST_TIMEOUT)
+        from .broadcast import BroadcastExchangeExec, on_build_pool
+        if (not self.per_partition
+                and isinstance(right, BroadcastExchangeExec)
+                and ctx.conf.get(EXCHANGE_ASYNC_BROADCAST)
+                and not on_build_pool()):
+            # async broadcast build (GpuBroadcastExchangeExec model):
+            # the build materializes on a background thread while this
+            # thread advances the stream side's scan/decode/pre-stage;
+            # bounded prefetch so waiting batches don't pin HBM
+            right.submit_build(ctx)
+            prefetched = []
+            while not right.build_done() and len(prefetched) < 2:
+                b = next(stream_batches, None)
+                if b is None:
+                    break
+                prefetched.append(b)
+            with m.timer("buildTime"):
+                bbatches = right.await_build(
+                    ctx, ctx.conf.get(EXCHANGE_BROADCAST_TIMEOUT))
+            if prefetched:
+                import itertools
+                stream_batches = itertools.chain(prefetched,
+                                                 stream_batches)
+        else:
+            build_pids = ([pid] if self.per_partition
+                          else range(right.num_partitions(ctx)))
+            with m.timer("buildTime"):
+                bbatches = []
+                for bpid in build_pids:
+                    bbatches.extend(right.execute_partition(ctx, bpid))
 
         from ..config import JOIN_BUILD_BUDGET
         budget = ctx.conf.get(JOIN_BUILD_BUDGET)
         total_bytes = sum(b.nbytes for b in bbatches)
         if budget > 0 and total_bytes > budget and self.lkeys:
             yield from self._execute_subpartitioned(
-                ctx, m, pid, bbatches, total_bytes, budget)
+                ctx, m, pid, bbatches, total_bytes, budget,
+                stream_batches=stream_batches)
             return
 
-        yield from self._join_pass(ctx, m, bbatches,
-                                   self._stream_batches(ctx, pid))
+        yield from self._join_pass(ctx, m, bbatches, stream_batches)
 
     def _join_pass(self, ctx: ExecContext, m, bbatches, stream_batches):
         """One complete hash-join pass: concat the given build batches,
@@ -698,7 +725,8 @@ class HashJoinExec(TpuExec):
             yield b
 
     def _execute_subpartitioned(self, ctx: ExecContext, m, pid, bbatches,
-                                total_bytes: int, budget: int):
+                                total_bytes: int, budget: int,
+                                stream_batches=None):
         """Build side exceeds its budget: rehash BOTH sides into S
         disjoint-key sub-partitions parked as spillable piles, then run
         an independent join pass per sub-partition, RECURSIVELY
@@ -713,7 +741,9 @@ class HashJoinExec(TpuExec):
         m.add("numSubPartitions", S)
 
         piles_b, bytes_b, piles_s = self._split_both(
-            ctx, m, S, 0xAB5, bbatches, self._stream_batches(ctx, pid))
+            ctx, m, S, 0xAB5, bbatches,
+            stream_batches if stream_batches is not None
+            else self._stream_batches(ctx, pid))
         del bbatches
         yield from self._run_buckets(ctx, m, piles_b, bytes_b, piles_s,
                                      budget, depth=1)

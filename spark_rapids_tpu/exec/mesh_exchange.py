@@ -54,6 +54,9 @@ from .nodes import make_table
 
 __all__ = ["MeshExchangeExec"]
 
+# end-of-partition marker in the parallel drain's per-partition queues
+_DRAIN_DONE = object()
+
 
 class MeshExchangeExec(TpuExec):
     """Hash partition exchange over a device mesh, in chunked collective
@@ -321,17 +324,26 @@ class MeshExchangeExec(TpuExec):
                                         has_offsets, n_str)
                 pending = cur
 
+            nparts = child.num_partitions(ctx)
+            from .exchange_pool import PermitRider, resolve_map_threads
+            threads = resolve_map_threads(ctx, nparts)
+            queues: List = []
             try:
-                for cpid in range(child.num_partitions(ctx)):
-                    for b in child.execute_partition(ctx, cpid):
-                        ctx.check_cancel()
-                        # waiting slot batches are spillable: a slow
-                        # child partition must not pin up to n-1 batches
-                        # in HBM
-                        slot.append(store.add_batch(b, priority=10))
-                        if len(slot) == n:
-                            flush(slot)
-                            slot = []
+                if threads <= 1 or nparts <= 1:
+                    for cpid in range(nparts):
+                        for b in child.execute_partition(ctx, cpid):
+                            ctx.check_cancel()
+                            # waiting slot batches are spillable: a slow
+                            # child partition must not pin up to n-1
+                            # batches in HBM
+                            slot.append(store.add_batch(b, priority=10))
+                            if len(slot) == n:
+                                flush(slot)
+                                slot = []
+                else:
+                    slot = self._parallel_drain(
+                        ctx, store, child, nparts, threads, queues,
+                        slot, flush, m, PermitRider)
                 if slot:
                     flush(slot)
                     slot = []
@@ -339,10 +351,18 @@ class MeshExchangeExec(TpuExec):
                     self._collect_round(m, store, out, pending,
                                         has_offsets, n_str)
             except BaseException:
-                # failing mid-stream (upstream OOM, bad data) must not
-                # leak: close waiting slot handles and everything parked
-                # so far; self._out stays None so a retried action
-                # re-runs the exchange from a clean slate
+                # failing mid-stream (upstream OOM, bad data, cancel)
+                # must not leak: close waiting queue/slot handles and
+                # everything parked so far; self._out stays None so a
+                # retried action re-runs the exchange from a clean slate
+                for q in queues:
+                    while True:
+                        try:
+                            item = q.get_nowait()
+                        except Exception:
+                            break
+                        if item is not _DRAIN_DONE:
+                            item.close()
                 for h in slot:
                     h.close()
                 for pile in out:
@@ -350,6 +370,88 @@ class MeshExchangeExec(TpuExec):
                         h.close()
                 raise
             self._out = out
+
+    def _parallel_drain(self, ctx, store, child, nparts, threads,
+                        queues, slot, flush, m, PermitRider):
+        """Drain child partitions on a bounded worker pool. Workers park
+        batches as spillable handles into per-partition queues; the
+        calling thread consumes the queues in STRICT cpid order, feeding
+        the same n-slot rounds as the serial drain — round composition
+        (and therefore exchange output) stays byte-identical. Device
+        admission per child step goes through the PermitRider so chip
+        concurrency stays bounded by sql.concurrentTpuTasks."""
+        import concurrent.futures as cf
+        import queue as _queue
+        from .nodes import _session_semaphore
+        sem = _session_semaphore(ctx)
+        rider = PermitRider(sem,
+                            priority=getattr(ctx, "sem_priority", 0),
+                            token=ctx.cancel)
+        stop = threading.Event()
+        n = self.n
+        queues.extend(_queue.Queue(maxsize=4) for _ in range(nparts))
+
+        def put_item(q, item):
+            """Bounded put that stays cancellable; returns False when
+            the drain was aborted before hand-off."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.05)
+                    return True
+                except _queue.Full:
+                    ctx.check_cancel()
+            return False
+
+        def produce(cpid):
+            q = queues[cpid]
+            it = child.execute_partition(ctx, cpid)
+            while True:
+                ctx.check_cancel()
+                if stop.is_set():
+                    return
+                with rider.step():
+                    b = next(it, None)
+                    h = (None if b is None
+                         else store.add_batch(b, priority=10))
+                if h is None:
+                    break
+                if not put_item(q, h):
+                    h.close()
+                    return
+            put_item(q, _DRAIN_DONE)
+
+        with cf.ThreadPoolExecutor(
+                threads, thread_name_prefix="mesh-map") as pool:
+            futs = [pool.submit(produce, cpid)
+                    for cpid in range(nparts)]
+            try:
+                for cpid in range(nparts):
+                    q = queues[cpid]
+                    while True:
+                        try:
+                            item = q.get(timeout=0.05)
+                        except _queue.Empty:
+                            ctx.check_cancel()
+                            f = futs[cpid]
+                            if f.done() and f.exception() is not None:
+                                raise f.exception()
+                            continue
+                        if item is _DRAIN_DONE:
+                            break
+                        slot.append(item)
+                        if len(slot) == n:
+                            flush(slot)
+                            slot = []
+                for f in futs:
+                    f.result()
+            except BaseException:
+                stop.set()  # unblock producers parked on full queues
+                for f in futs:
+                    f.cancel()
+                raise
+        if rider.waited_secs > 0:
+            m.add("mapPoolWaitMs", round(rider.waited_secs * 1e3, 3))
+        return slot
 
     def execute_partition(self, ctx: ExecContext, pid: int):
         self._ensure_exchanged(ctx)

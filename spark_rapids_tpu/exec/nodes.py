@@ -566,6 +566,7 @@ class ParquetScanExec(TpuExec):
             yield DeviceBatch(tbl, num_rows=sl.num_rows)
 
 
+# tpulint: allow[pool-cancel] remote-executor task, no ExecContext — cancel is task abort
 def _remote_decode_parquet(path, columns, filters, batch_rows):
     """Executor-side parquet decode task: returns (list of Arrow IPC
     stream blobs — one per batch — , skipped row-group count). Pure

@@ -7,11 +7,13 @@ from typing import List
 import jax.numpy as jnp
 
 from ..columnar import dtypes as dt
-from ..ops.hash import murmur3_row_hash
+from ..ops.hash import (hive_hash_row_hash, murmur3_row_hash,
+                        xxhash64_row_hash)
 from ..ops.kernel_utils import CV
 from .expressions import Expression
 
-__all__ = ["Murmur3Hash", "BloomFilterMightContain"]
+__all__ = ["Murmur3Hash", "XxHash64", "HiveHash",
+           "BloomFilterMightContain"]
 
 
 class BloomFilterMightContain(Expression):
@@ -74,3 +76,49 @@ class Murmur3Hash(Expression):
 
     def __repr__(self):
         return "hash(" + ", ".join(map(repr, self.children)) + ")"
+
+
+class XxHash64(Expression):
+    """xxhash64(cols...): Spark's 64-bit row hash (reference: the jni
+    Hash kernels' xxhash64 algorithm next to murmur3). Seed 42, int64
+    result, nulls pass the running hash through."""
+
+    def __init__(self, children: List[Expression], seed: int = 42):
+        self.children = list(children)
+        self.seed = seed
+
+    def bind(self, schema):
+        b = XxHash64([c.bind(schema) for c in self.children], self.seed)
+        b.dtype = dt.INT64
+        return b
+
+    def emit(self, ctx):
+        cvs = [c.emit(ctx) for c in self.children]
+        h = xxhash64_row_hash(cvs, [c.dtype for c in self.children],
+                              self.seed)
+        return CV(h, jnp.ones(ctx.capacity, jnp.bool_))
+
+    def __repr__(self):
+        return "xxhash64(" + ", ".join(map(repr, self.children)) + ")"
+
+
+class HiveHash(Expression):
+    """hive_hash(cols...): Hive's 31-polynomial row hashCode (int32,
+    nulls contribute 0) — the third jni Hash kernel algorithm, used for
+    Hive-bucketed table writes."""
+
+    def __init__(self, children: List[Expression]):
+        self.children = list(children)
+
+    def bind(self, schema):
+        b = HiveHash([c.bind(schema) for c in self.children])
+        b.dtype = dt.INT32
+        return b
+
+    def emit(self, ctx):
+        cvs = [c.emit(ctx) for c in self.children]
+        h = hive_hash_row_hash(cvs, [c.dtype for c in self.children])
+        return CV(h, jnp.ones(ctx.capacity, jnp.bool_))
+
+    def __repr__(self):
+        return "hive_hash(" + ", ".join(map(repr, self.children)) + ")"

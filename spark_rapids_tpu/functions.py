@@ -366,6 +366,16 @@ def hash(*cols):  # noqa: A001 - pyspark naming
     return Murmur3Hash([_to_expr(c) for c in cols])
 
 
+def xxhash64(*cols):
+    from .expr.hash_expr import XxHash64
+    return XxHash64([_to_expr(c) for c in cols])
+
+
+def hive_hash(*cols):
+    from .expr.hash_expr import HiveHash
+    return HiveHash([_to_expr(c) for c in cols])
+
+
 def lpad(e, length, pad=" "):
     return _se.Pad(_to_expr(e), length, pad, left=True)
 

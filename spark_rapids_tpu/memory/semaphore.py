@@ -70,6 +70,21 @@ class TpuSemaphore:
             self._cond.notify_all()
         return waited
 
+    def try_acquire(self) -> bool:
+        """Non-blocking permit grab for opportunistic extra parallelism
+        (exchange map pools): succeeds only when a permit is free AND
+        nobody is queued for it — never steals from a priority waiter.
+        Callers must have a guaranteed-progress fallback (the exchange
+        pool's ridden caller permit) since this can fail forever while
+        blocked tasks pin every permit."""
+        with self._cond:
+            self._purge_dead()
+            if self._available > 0 and not self._waiters:
+                self._available -= 1
+                self.metrics["acquires"] += 1
+                return True
+            return False
+
     def release(self):
         with self._cond:
             self._available += 1

@@ -21,7 +21,9 @@ from ..columnar import dtypes as dt
 from .kernel_utils import CV
 
 __all__ = ["murmur3_cv", "murmur3_row_hash", "partition_ids",
-           "fold64", "avalanche32", "hash_once_rows"]
+           "fold64", "avalanche32", "hash_once_rows",
+           "xxhash64_cv", "xxhash64_row_hash",
+           "hive_hash_cv", "hive_hash_row_hash"]
 
 # numpy (NOT jnp) scalars: module-level eager jnp constants become
 # captured device buffers hoisted into executable parameters, and the
@@ -231,6 +233,208 @@ def hash_once_rows(eq_arrays, seed: int = 0x9E3779B1):
         for a in arrs:
             h = fold64(h, a)
     return avalanche32(h)
+
+
+# ----------------------------------------------------------------------
+# Spark-facing xxhash64 / hive-hash row hashes (reference: the jni Hash
+# kernel family's other two algorithms next to murmur3 — XXHash64.scala /
+# HiveHash in HashFunctions). Same fold-left null semantics as murmur3:
+# a null input passes the running hash through unchanged (xxhash64);
+# hive-hash contributes 0 for nulls (Hive's ObjectInspectorUtils).
+# ----------------------------------------------------------------------
+
+_P64_4 = 0x85EBCA77C2B2AE63
+_P64_5 = 0x27D4EB2F165667C5
+
+
+def _rotl64(x, r):
+    return (x << r) | (x >> (64 - r))
+
+
+def _xxh_fmix(h):
+    h = h ^ (h >> 33)
+    h = h * jnp.uint64(_P64_2)
+    h = h ^ (h >> 29)
+    h = h * jnp.uint64(_P64_3)
+    return h ^ (h >> 32)
+
+
+def _xxh_int(x_i32, seed_u64, length=4):
+    """Spark XXH64.hashInt: 4-byte input fast path."""
+    h = seed_u64 + jnp.uint64(_P64_5 + length)
+    w = (x_i32.astype(jnp.int64) & 0xFFFFFFFF).astype(jnp.uint64)
+    h = h ^ (w * jnp.uint64(_P64_1))
+    h = _rotl64(h, 23) * jnp.uint64(_P64_2) + jnp.uint64(_P64_3)
+    return _xxh_fmix(h)
+
+
+def _xxh_long(x_i64, seed_u64, length=8):
+    """Spark XXH64.hashLong: 8-byte input fast path."""
+    h = seed_u64 + jnp.uint64(_P64_5 + length)
+    k1 = _rotl64(x_i64.astype(jnp.uint64) * jnp.uint64(_P64_2), 31) \
+        * jnp.uint64(_P64_1)
+    h = h ^ k1
+    h = _rotl64(h, 27) * jnp.uint64(_P64_1) + jnp.uint64(_P64_4)
+    return _xxh_fmix(h)
+
+
+def _xxh_string(cv: CV, seed_u64):
+    """XXH64 over the byte payload: Spark's hashUnsafeBytes small-input
+    path (8-byte rounds, a 4-byte round, tail bytes), exact for strings
+    under 32 bytes and byte-faithful to that schedule up to 64; beyond
+    the 64-byte prefix a last-word fold keeps common-prefix keys apart
+    (engine-internal, same bound as the murmur3 string path)."""
+    n = cv.offsets.shape[0] - 1
+    starts = cv.offsets[:-1]
+    lens = (cv.offsets[1:] - starts).astype(jnp.int32)
+    data = cv.data
+    dcap = data.shape[0]
+    MAXB = 64
+    h = seed_u64 + jnp.uint64(_P64_5) + lens.astype(jnp.uint64)
+    overlong = lens > MAXB
+    eff = jnp.where(overlong, MAXB, lens)
+    nfull8 = eff // 8
+    for w in range(MAXB // 8):
+        base = starts + 8 * w
+        word = jnp.zeros(n, jnp.uint64)
+        for b in range(8):
+            idx = jnp.clip(base + b, 0, dcap - 1)
+            word = word | (data[idx].astype(jnp.uint64) << (8 * b))
+        k1 = _rotl64(word * jnp.uint64(_P64_2), 31) * jnp.uint64(_P64_1)
+        step = _rotl64(h ^ k1, 27) * jnp.uint64(_P64_1) \
+            + jnp.uint64(_P64_4)
+        h = jnp.where(w < nfull8, step, h)
+    aligned = nfull8 * 8
+    # one 4-byte round when >= 4 bytes remain
+    word4 = jnp.zeros(n, jnp.uint64)
+    for b in range(4):
+        idx = jnp.clip(starts + aligned + b, 0, dcap - 1)
+        word4 = word4 | (data[idx].astype(jnp.uint64) << (8 * b))
+    has4 = aligned + 4 <= eff
+    step = _rotl64(h ^ (word4 * jnp.uint64(_P64_1)), 23) \
+        * jnp.uint64(_P64_2) + jnp.uint64(_P64_3)
+    h = jnp.where(has4, step, h)
+    aligned = jnp.where(has4, aligned + 4, aligned)
+    # tail bytes, one round each
+    for t in range(3):
+        pos = aligned + t
+        idx = jnp.clip(starts + pos, 0, dcap - 1)
+        byte = data[idx].astype(jnp.uint64)
+        step = _rotl64(h ^ (byte * jnp.uint64(_P64_5)), 11) \
+            * jnp.uint64(_P64_1)
+        h = jnp.where(pos < eff, step, h)
+    # beyond the prefix: fold the LAST word (engine-internal)
+    tail_base = jnp.maximum(starts, starts + lens - 8)
+    tail = jnp.zeros(n, jnp.uint64)
+    for b in range(8):
+        idx = jnp.clip(tail_base + b, 0, dcap - 1)
+        tail = tail | (data[idx].astype(jnp.uint64) << (8 * b))
+    h = jnp.where(overlong, fold64(h, tail), h)
+    return _xxh_fmix(h)
+
+
+def xxhash64_cv(cv: CV, dtype: dt.DataType, seed_u64):
+    """Per-row xxhash64 of one column folding into `seed_u64` (uint64
+    array); null rows pass the seed through (Spark semantics)."""
+    x = cv.data
+    if isinstance(dtype, dt.BooleanType):
+        h = _xxh_int(jnp.where(x, 1, 0).astype(jnp.int32), seed_u64)
+    elif isinstance(dtype, (dt.ByteType, dt.ShortType, dt.IntegerType,
+                            dt.DateType)):
+        h = _xxh_int(x.astype(jnp.int32), seed_u64)
+    elif isinstance(dtype, (dt.LongType, dt.TimestampType)):
+        h = _xxh_long(x.astype(jnp.int64), seed_u64)
+    elif isinstance(dtype, dt.DecimalType):
+        if dtype.is_decimal128:
+            h = _xxh_long(x[:, 0] ^ x[:, 1], seed_u64)
+        else:
+            h = _xxh_long(x.astype(jnp.int64), seed_u64)
+    elif isinstance(dtype, dt.FloatType):
+        xx = jnp.where(x == 0, jnp.zeros_like(x), x)
+        h = _xxh_int(xx.view(jnp.int32), seed_u64)
+    elif isinstance(dtype, dt.DoubleType):
+        # same frexp decomposition as murmur3 (no f64 bitcast on TPU):
+        # engine-internally consistent, documented deviation
+        xx = jnp.where(x == 0, jnp.zeros_like(x), x)
+        m, e = jnp.frexp(jnp.abs(xx))
+        mant = (m * (2.0 ** 53)).astype(jnp.int64)
+        mant = jnp.where(xx < 0, -mant, mant)
+        h = _xxh_long(mant ^ (e.astype(jnp.int64) << 1), seed_u64)
+    elif isinstance(dtype, (dt.StringType, dt.BinaryType)):
+        h = _xxh_string(cv, seed_u64)
+    else:
+        raise NotImplementedError(f"xxhash64({dtype})")
+    return jnp.where(cv.validity, h, seed_u64)
+
+
+def xxhash64_row_hash(cvs, dtypes, seed: int = 42):
+    """Row xxhash64 across columns, Spark style: fold column hashes
+    left to right from the int64 seed; int64 result."""
+    n = cvs[0].validity.shape[0]
+    h = jnp.full(n, jnp.uint64(seed))
+    for cv, dtp in zip(cvs, dtypes):
+        h = xxhash64_cv(cv, dtp, h)
+    return h.astype(jnp.int64)
+
+
+def hive_hash_cv(cv: CV, dtype: dt.DataType):
+    """Hive hashCode of one column (int32); null rows contribute 0
+    (ObjectInspectorUtils.hashCode semantics)."""
+    x = cv.data
+    if isinstance(dtype, dt.BooleanType):
+        h = jnp.where(x, 1, 0).astype(jnp.int32)
+    elif isinstance(dtype, (dt.ByteType, dt.ShortType, dt.IntegerType,
+                            dt.DateType)):
+        h = x.astype(jnp.int32)
+    elif isinstance(dtype, (dt.LongType, dt.TimestampType)):
+        v = x.astype(jnp.int64)
+        h = (v ^ (v.astype(jnp.uint64) >> 32).astype(jnp.int64)) \
+            .astype(jnp.int32)
+    elif isinstance(dtype, dt.DecimalType):
+        v = (x[:, 0] ^ x[:, 1]) if dtype.is_decimal128 \
+            else x.astype(jnp.int64)
+        h = (v ^ (v.astype(jnp.uint64) >> 32).astype(jnp.int64)) \
+            .astype(jnp.int32)
+    elif isinstance(dtype, dt.FloatType):
+        xx = jnp.where(x == 0, jnp.zeros_like(x), x)
+        h = xx.view(jnp.int32)
+    elif isinstance(dtype, dt.DoubleType):
+        xx = jnp.where(x == 0, jnp.zeros_like(x), x)
+        m, e = jnp.frexp(jnp.abs(xx))
+        mant = (m * (2.0 ** 53)).astype(jnp.int64)
+        mant = jnp.where(xx < 0, -mant, mant)
+        v = mant ^ (e.astype(jnp.int64) << 1)
+        h = (v ^ (v.astype(jnp.uint64) >> 32).astype(jnp.int64)) \
+            .astype(jnp.int32)
+    elif isinstance(dtype, (dt.StringType, dt.BinaryType)):
+        # Java String.hashCode polynomial over the UTF-8 bytes, bounded
+        # at the same 64-byte prefix as the other string hashes
+        n = cv.offsets.shape[0] - 1
+        starts = cv.offsets[:-1]
+        lens = (cv.offsets[1:] - starts).astype(jnp.int32)
+        data, dcap = cv.data, cv.data.shape[0]
+        h = jnp.zeros(n, jnp.int32)
+        for pos in range(64):
+            idx = jnp.clip(starts + pos, 0, dcap - 1)
+            byte = data[idx].astype(jnp.int32)
+            byte = jnp.where(byte >= 128, byte - 256, byte)
+            h = jnp.where(pos < lens,
+                          (h * jnp.int32(31) + byte).astype(jnp.int32),
+                          h)
+    else:
+        raise NotImplementedError(f"hive_hash({dtype})")
+    return jnp.where(cv.validity, h, jnp.int32(0))
+
+
+def hive_hash_row_hash(cvs, dtypes):
+    """Hive row hash: result = result * 31 + columnHash, folded left to
+    right from 0 (int32 wraparound)."""
+    n = cvs[0].validity.shape[0]
+    h = jnp.zeros(n, jnp.int32)
+    for cv, dtp in zip(cvs, dtypes):
+        h = (h * jnp.int32(31) + hive_hash_cv(cv, dtp)) \
+            .astype(jnp.int32)
+    return h
 
 
 # bloom-filter hash scheme shared by BloomFilterAggregate (build),

@@ -424,11 +424,15 @@ def _join(meta, conv, conf):
             return HashJoinExec(lread, rread, n.bound_left_keys,
                                 n.bound_right_keys, n.how, n.schema,
                                 per_partition=True, condition=cond)
-    # broadcast hash join: build side collected once, stream partitions
-    # probe it (GpuBroadcastHashJoinExecBase analog)
-    return HashJoinExec(left, right, n.bound_left_keys,
-                        n.bound_right_keys, n.how, n.schema,
-                        condition=cond)
+    # broadcast hash join: build side collected once behind a
+    # BroadcastExchangeExec (async background build + reuse-pass
+    # dedupe target), stream partitions probe it
+    # (GpuBroadcastHashJoinExecBase analog)
+    from ..exec.broadcast import BroadcastExchangeExec
+    return HashJoinExec(left,
+                        BroadcastExchangeExec(right, right.schema),
+                        n.bound_left_keys, n.bound_right_keys, n.how,
+                        n.schema, condition=cond)
 
 
 def _maybe_bloom_prefilter(left, right, n, meta, conf):
@@ -617,6 +621,12 @@ class Planner:
             root_exec, fusion_groups = fuse_stages(root_exec, self.conf,
                                                    report)
             report.fusion_groups = fusion_groups
+            # exchange reuse (Spark's ReuseExchange analog): duplicate
+            # exchange subtrees collapse to ReusedExchange nodes AFTER
+            # fusion (fused chains are part of the subtree identity)
+            from .reuse import reuse_exchanges
+            root_exec, reuse_hits = reuse_exchanges(root_exec, self.conf)
+            root_exec.exchange_reuse_hits = reuse_hits
             # ride the physical root so the profiler wrapper can emit
             # the plan_audit event without re-walking
             root_exec.audit_report = report

@@ -1,0 +1,273 @@
+"""Plan-level exchange reuse — the ReuseExchange rule analog.
+
+(reference: Spark's ReuseExchange / ReuseSubquery physical rules and the
+plugin's GpuReusedExchangeExec rendering.) A post-fusion pass over the
+PHYSICAL tree fingerprints every exchange subtree (structural: class
+names, plan-config attributes, expression fingerprints via the
+program-cache's gensym-normalized `expr_fp`, child subtrees) and
+rewrites later duplicates to `ReusedExchangeExec` nodes that delegate to
+the first occurrence — one map phase / broadcast build per DISTINCT
+subtree per query. Self-joins and reused CTE-shaped scans stop paying
+the shuffle twice.
+
+Safety posture: a fingerprint miss (attribute we cannot fingerprint)
+makes the subtree UNIQUE, never merged — false negatives cost a shuffle,
+false positives would corrupt results.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional, Tuple
+
+from ..exec.base import ExecContext, TpuExec
+
+__all__ = ["reuse_exchanges", "ReusedExchangeExec"]
+
+# exec-node attributes that are runtime identity, never plan config
+_SKIP_ATTRS = {"children", "lore_id", "audit_report", "fusion_opt_out"}
+
+
+def _norm_names(fp):
+    """Erase column-name attributes from an expr_fp tuple: exchange
+    subtrees hold BOUND expressions, which emit by ordinal — `k` vs the
+    session's gensym rename `__join_r1_k` is the same data. Ordinals
+    and dtypes still distinguish genuinely different columns."""
+    if isinstance(fp, tuple):
+        if len(fp) == 2 and fp[0] == "_name" and isinstance(fp[1], str):
+            return ("_name", "?")
+        return tuple(_norm_names(x) for x in fp)
+    return fp
+
+
+def _value_fp(v) -> Optional[tuple]:
+    """Structural fingerprint of one plan-config attribute value; None
+    when the value cannot be fingerprinted (subtree becomes unique)."""
+    from ..expr.expressions import Expression
+    from ..runtime.program_cache import expr_fp
+    if v is None or isinstance(v, (bool, int, float, str, bytes)):
+        return ("lit", v)
+    if isinstance(v, Expression):
+        return ("expr", _norm_names(expr_fp(v)))
+    if isinstance(v, (list, tuple)):
+        parts = tuple(_value_fp(x) for x in v)
+        return None if any(p is None for p in parts) else ("seq", parts)
+    if isinstance(v, dict):
+        try:
+            items = sorted(v.items())
+        except TypeError:
+            return None
+        parts = tuple((k, _value_fp(x)) for k, x in items)
+        return (None if any(p is None for _, p in parts)
+                else ("map", parts))
+    from ..columnar.table import Schema
+    if isinstance(v, Schema):
+        return ("schema", _schema_fp(v))
+    from ..exec.base import TpuExec
+    if isinstance(v, TpuExec):
+        # nested plan nodes held as attributes (FusedStageExec.members,
+        # AQE plan wrappers): fingerprint structurally like children
+        fp = node_fp(v)
+        return None if fp is None else ("exec", fp)
+    try:
+        import pyarrow as pa
+        if isinstance(v, pa.Table):
+            # zero-copy memory identity: planning a self-join wraps the
+            # one session table in fresh pa.Table objects per branch,
+            # but the chunks still point at the same buffers — same
+            # addresses + offsets + lengths IS the same bytes, while a
+            # genuine copy stays unique (conservative, never false)
+            parts = [tuple(str(f.type) for f in v.schema), v.num_rows]
+            for column in v.columns:
+                for ch in column.chunks:
+                    parts.append((ch.offset, len(ch),
+                                  tuple(b.address if b is not None else 0
+                                        for b in ch.buffers())))
+            return ("arrow", tuple(parts))
+    except Exception:
+        pass
+    # anything else (cached arrow tables, reader objects...): identity
+    # fingerprint — the SAME object is trivially the same data (the
+    # self-join case, where both scans hold one cached table), while
+    # distinct-but-equal objects stay unique. Never falsely shared.
+    return ("id", id(v))
+
+
+def _schema_fp(schema) -> tuple:
+    # dtypes only: post-binding, column names are labels — the bytes an
+    # exchange materializes are fully determined by the child tree and
+    # the bound (ordinal-addressed) expressions
+    return tuple(str(f.dtype) for f in schema.fields)
+
+
+def _is_identity_project(node) -> bool:
+    """A bound Project that only renames: every output unwraps (through
+    Alias) to BoundRef(ordinal=i) at its own position, covering the
+    whole child schema — a pure label change, zero data effect."""
+    from ..expr.expressions import Alias, BoundRef
+    child = node.children[0]
+    bound = getattr(node, "bound", None)
+    if bound is None or len(bound) != len(child.schema.fields):
+        return False
+    for i, e in enumerate(bound):
+        while isinstance(e, Alias):
+            e = e.child
+        if not (isinstance(e, BoundRef) and e.ordinal == i):
+            return False
+    return True
+
+
+def _canonical(node: TpuExec) -> TpuExec:
+    """See through pure-rename Projects so `Exchange(Scan)` and
+    `Exchange(Project[x AS __join_r1_x](Scan))` — the shape every
+    self-join produces — fingerprint identically. The fusion pass can
+    wrap those same rename chains into a FusedStageExec before the
+    reuse pass runs, so a fused stage whose members are ALL identity
+    Projects is seen through too."""
+    from ..exec.fused import FusedStageExec
+    from ..exec.nodes import ProjectExec
+
+    def _ident(n):
+        return (isinstance(n, ProjectExec) and n.children
+                and _is_identity_project(n))
+
+    while True:
+        if _ident(node):
+            node = node.children[0]
+            continue
+        if (isinstance(node, FusedStageExec) and node.children
+                and node.members and all(_ident(m) for m in node.members)):
+            node = node.children[0]
+            continue
+        return node
+
+
+def node_fp(node: TpuExec) -> Optional[tuple]:
+    """Structural fingerprint of a physical subtree. Public attributes
+    are plan config (n, keys, paths...); underscore attributes are
+    runtime state (locks, programs, materialized shuffles) and are
+    skipped. Any non-fingerprintable public attribute poisons the
+    subtree (returns None): it stays unique rather than risk a false
+    merge."""
+    node = _canonical(node)
+    parts = [("cls", type(node).__name__),
+             ("schema", _schema_fp(node.schema))]
+    for k in sorted(vars(node)):
+        if k.startswith("_") or k in _SKIP_ATTRS:
+            continue
+        fp = _value_fp(vars(node)[k])
+        if fp is None:
+            return None
+        parts.append((k, fp))
+    kids = []
+    for c in node.children:
+        cfp = node_fp(c)
+        if cfp is None:
+            return None
+        kids.append(cfp)
+    parts.append(("children", tuple(kids)))
+    return tuple(parts)
+
+
+class ReusedExchangeExec(TpuExec):
+    """Stand-in for a duplicate exchange subtree: every read delegates
+    to the first occurrence's materialization (shared under the
+    target's own lock), so the duplicate costs zero map work. Carries
+    the replaced node's lore id and renders the target's in describe().
+    No children: the shared subtree stays owned (and released) by its
+    original parent."""
+
+    def __init__(self, target: TpuExec, original: TpuExec):
+        super().__init__([], original.schema)
+        self.target = target
+        self.lore_id = getattr(original, "lore_id", None)
+        self._hit_lock = threading.Lock()
+        self._hit_ctxs = set()
+
+    def describe(self):
+        tid = getattr(self.target, "lore_id", "?")
+        return f"ReusedExchange[loreId={self.lore_id} -> {tid}]"
+
+    def num_partitions(self, ctx):
+        return self.target.num_partitions(ctx)
+
+    def _count_hit(self, ctx: ExecContext):
+        """One exchangeReuseHits per (execution, node): a map/build
+        phase this query did NOT re-run."""
+        with self._hit_lock:
+            if id(ctx) in self._hit_ctxs:
+                return
+            if len(self._hit_ctxs) > 64:
+                self._hit_ctxs.clear()
+            self._hit_ctxs.add(id(ctx))
+        ctx.metrics_for(self._op_id).add("exchangeReuseHits", 1)
+
+    # ---- exchange API, delegated (AQE readers call these) -------------
+    def stage_stats(self, ctx: ExecContext):
+        self._count_hit(ctx)
+        return self.target.stage_stats(ctx)
+
+    def read_slice(self, ctx: ExecContext, rpid: int, chunk: int = 0,
+                   nchunks: int = 1):
+        self._count_hit(ctx)
+        return self.target.read_slice(ctx, rpid, chunk=chunk,
+                                      nchunks=nchunks)
+
+    def execute_partition(self, ctx: ExecContext, pid: int):
+        self._count_hit(ctx)
+        for b in self.target.execute_partition(ctx, pid):
+            ctx.check_cancel()
+            yield b
+
+    def release(self):
+        # the target is still parented by its first occurrence — it is
+        # NOT ours to release (double-release would drop shared blocks
+        # while the original parent may still replay them); children is
+        # empty, so super().release() recurses into nothing
+        super().release()
+
+
+def _reusable(node: TpuExec) -> bool:
+    from ..exec.broadcast import BroadcastExchangeExec
+    from ..exec.exchange import ShuffleExchangeExec
+    from ..exec.mesh_exchange import MeshExchangeExec
+    return isinstance(node, (ShuffleExchangeExec, BroadcastExchangeExec,
+                             MeshExchangeExec))
+
+
+def reuse_exchanges(root: TpuExec, conf) -> Tuple[TpuExec, int]:
+    """Rewrite duplicate exchange subtrees to ReusedExchangeExec nodes.
+    Returns (new_root, hits). Post-fusion, pre-LORE-wrap."""
+    from ..config import EXCHANGE_REUSE
+    from ..exec.aqe import AqeShufflePlan
+    if not conf.get(EXCHANGE_REUSE):
+        return root, 0
+    seen = {}
+    replaced = {}  # id(duplicate exchange) -> its ReusedExchangeExec
+    hits = 0
+
+    def walk(node: TpuExec) -> TpuExec:
+        nonlocal hits
+        if isinstance(node, ReusedExchangeExec):
+            return node
+        node.children = [walk(c) for c in node.children]
+        # AqeShufflePlan keeps DIRECT exchange references (outside the
+        # children list) and calls stage_stats on them — swap replaced
+        # duplicates there too, or the dedup'd map phase still runs
+        p = getattr(node, "plan", None)
+        if isinstance(p, AqeShufflePlan):
+            p.exchanges = [replaced.get(id(e), e) for e in p.exchanges]
+        if not _reusable(node):
+            return node
+        fp = node_fp(node)
+        if fp is None:
+            return node
+        first = seen.get(fp)
+        if first is not None and first is not node:
+            hits += 1
+            r = ReusedExchangeExec(first, node)
+            replaced[id(node)] = r
+            return r
+        seen[fp] = node
+        return node
+
+    return walk(root), hits

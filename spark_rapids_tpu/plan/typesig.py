@@ -237,6 +237,11 @@ register("ParseUrl", STRING,
 # -- misc ---------------------------------------------------------------
 register("Murmur3Hash", ALL_COMMON,
          "Spark-compatible murmur3_x86_32, device kernel")
+register("XxHash64", ALL_COMMON,
+         "Spark-compatible xxhash64 (seed 42, int64), device kernel; "
+         "strings exact under 32 bytes (docs/compatibility.md)")
+register("HiveHash", ALL_COMMON,
+         "Hive 31-polynomial hashCode (int32), device kernel")
 register("Literal", ALL_COMMON + NESTED, "constant")
 register("Alias", ALL_COMMON + NESTED, "name binding (pass-through)")
 register("ColumnRef", ALL_COMMON + NESTED, "column reference")

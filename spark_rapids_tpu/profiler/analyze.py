@@ -99,6 +99,19 @@ def render_analyze(tree: dict, metrics_by_lore: Dict[Optional[int], dict],
         if m.get("programCacheMisses") is not None:
             ann.append(
                 f"programCacheMisses={int(m['programCacheMisses'])}")
+        # exchange pipeline (docs/observability.md): parallel-map pool
+        # waits, async broadcast overlap, and plan-level reuse hits
+        if m.get("mapPoolWaitMs") is not None:
+            ann.append(f"mapPoolWaitMs={float(m['mapPoolWaitMs']):.1f}")
+        if m.get("broadcastBuildOverlapMs") is not None:
+            ann.append("broadcastBuildOverlapMs="
+                       f"{float(m['broadcastBuildOverlapMs']):.1f}")
+        if m.get("broadcastTimeoutFallbacks"):
+            ann.append("broadcastTimeoutFallbacks="
+                       f"{int(m['broadcastTimeoutFallbacks'])}")
+        if m.get("exchangeReuseHits"):
+            ann.append(
+                f"exchangeReuseHits={int(m['exchangeReuseHits'])}")
         # query-service waits (root node): time queued behind other
         # queries + time blocked on the TpuSemaphore for the chip
         if m.get("queueWaitMs") is not None:

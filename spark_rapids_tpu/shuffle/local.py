@@ -78,7 +78,10 @@ class LocalShuffle:
         self.reader_threads = reader_threads
         self.codec = get_codec(codec)
         self._lock = threading.Lock()
-        self._map_files: List[str] = []
+        # keyed by map partition id and iterated in sorted order: with a
+        # parallel map side, COMPLETION order is nondeterministic but
+        # reduce-side concatenation must stay byte-identical to serial
+        self._map_files: Dict[int, str] = {}
         self._arena = None  # lazy HostArena for reduce-side assembly
         self.metrics = {"bytesWritten": 0, "blocksWritten": 0}
 
@@ -102,22 +105,25 @@ class LocalShuffle:
         else:
             blocks = [ser(sb) for _, sb in flat]
         index = []  # (offset, length) per reduce partition
+        nbytes = nblocks = 0
         with open(path, "wb") as f:
             bi = 0
             for rp in range(self.n):
                 start = f.tell()
                 for sb in pieces_per_reduce[rp]:
                     f.write(blocks[bi])
-                    self.metrics["bytesWritten"] += len(blocks[bi])
-                    self.metrics["blocksWritten"] += 1
+                    nbytes += len(blocks[bi])
+                    nblocks += 1
                     bi += 1
                 index.append((start, f.tell() - start))
             idx_off = f.tell()
             for off, ln in index:
                 f.write(struct.pack("<QQ", off, ln))
             f.write(struct.pack("<QI", idx_off, self.n))
-        with self._lock:
-            self._map_files.append(path)
+        with self._lock:  # concurrent map workers share the metrics dict
+            self.metrics["bytesWritten"] += nbytes
+            self.metrics["blocksWritten"] += nblocks
+            self._map_files[mpid] = path
 
     # ---------------- reduce side --------------------------------------
     def _segment_extent(self, f, rpid: int):
@@ -150,7 +156,7 @@ class LocalShuffle:
         specs = [wire_spec(f.dtype) for f in self.schema.fields]
 
         with self._lock:
-            files = list(self._map_files)
+            files = [self._map_files[k] for k in sorted(self._map_files)]
 
         selected = None
         if nchunks > 1:
@@ -201,7 +207,7 @@ class LocalShuffle:
         re-planning)."""
         sizes = [0] * self.n
         with self._lock:
-            files = list(self._map_files)
+            files = [self._map_files[k] for k in sorted(self._map_files)]
         for path in files:
             with open(path, "rb") as f:
                 f.seek(-12, os.SEEK_END)

@@ -1,0 +1,407 @@
+"""Parallel pipelined exchanges: multithreaded map side parity,
+plan-level exchange reuse, async broadcast build, and the xxhash64 /
+hive-hash device kernels (the jni Hash family's other algorithms).
+
+Determinism contract: the parallel map side must be BYTE-IDENTICAL to
+serial — workers fill mpid-keyed slots and the reduce side reads them
+in sorted mpid order, so completion order never leaks into results.
+"""
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.columnar import dtypes as dt
+from spark_rapids_tpu.exec.exchange import map_partitions_executed
+from spark_rapids_tpu.ops.kernel_utils import CV
+
+
+def _mk_session(**extra):
+    conf = {"spark.rapids.tpu.sql.batchSizeRows": 256,
+            "spark.rapids.tpu.sql.shuffle.partitions": 4}
+    conf.update(extra)
+    return st.TpuSession(conf)
+
+
+def _mixed_table(n=2000, seed=3):
+    rng = np.random.default_rng(seed)
+    return pa.table({
+        "k": pa.array([None if i % 17 == 0 else int(x) for i, x in
+                       enumerate(rng.integers(0, 12, n))],
+                      type=pa.int64()),
+        "v": pa.array(rng.normal(0, 1, n)),
+        "s": pa.array([None if i % 23 == 0 else f"s{i % 41}"
+                       for i in range(n)]),
+    })
+
+
+# =====================================================================
+# multithreaded map side
+# =====================================================================
+def _shuffled(sess, at):
+    # two chained exchanges: the second one's child has 6 map
+    # partitions, so its map phase actually fans out across workers
+    df = sess.create_dataframe(at)
+    return (df.repartition(6)
+              .repartition(5, F.col("k"))
+              .to_arrow())
+
+
+def test_parallel_map_byte_identical_to_serial():
+    """Nulls, strings, and a multi-partition map side: mapThreads=1 vs
+    mapThreads=4 produce the same table in the same order."""
+    at = _mixed_table()
+    serial = _shuffled(_mk_session(
+        **{"spark.rapids.tpu.sql.exec.exchange.mapThreads": 1}), at)
+    parallel = _shuffled(_mk_session(
+        **{"spark.rapids.tpu.sql.exec.exchange.mapThreads": 4}), at)
+    assert serial.schema == parallel.schema
+    assert serial.equals(parallel)          # byte-identical, order too
+
+
+def test_parallel_map_empty_partitions_parity():
+    """Two distinct keys into 8 partitions: most reduce (and then map)
+    partitions are empty — empty slots must not shift output."""
+    at = pa.table({"k": pa.array([1, 2] * 300, type=pa.int64()),
+                   "v": pa.array(range(600), type=pa.int64())})
+
+    def run(threads):
+        s = _mk_session(**{
+            "spark.rapids.tpu.sql.exec.exchange.mapThreads": threads})
+        return (s.create_dataframe(at)
+                 .repartition(8, F.col("k"))
+                 .repartition(3, F.col("k"))
+                 .to_arrow())
+
+    assert run(1).equals(run(4))
+
+
+def test_parallel_map_agg_parity():
+    at = _mixed_table(1500, seed=9)
+
+    def run(threads):
+        s = _mk_session(**{
+            "spark.rapids.tpu.sql.exec.exchange.mapThreads": threads})
+        df = s.create_dataframe(at).repartition(6)
+        out = (df.group_by("k")
+                 .agg(F.count(F.col("v")).alias("c"),
+                      F.sum(F.col("v")).alias("sv"))
+                 .collect())
+        return sorted(((r[0], r[1], round(r[2], 9)) for r in out),
+                      key=lambda t: (t[0] is None, t[0] or 0))
+
+    assert run(1) == run(4)
+
+
+def test_map_threads_conf_resolution():
+    from spark_rapids_tpu.exec.exchange_pool import resolve_map_threads
+
+    class _Ctx:
+        def __init__(self, conf):
+            self.conf = conf
+
+    from spark_rapids_tpu.config import TpuConf
+    ctx = _Ctx(TpuConf(
+        {"spark.rapids.tpu.sql.exec.exchange.mapThreads": 3}))
+    assert resolve_map_threads(ctx, 10) == 3
+    assert resolve_map_threads(ctx, 2) == 2    # capped by nparts
+    ctx0 = _Ctx(TpuConf({}))
+    assert resolve_map_threads(ctx0, 64) >= 1  # auto
+
+
+# =====================================================================
+# plan-level exchange reuse
+# =====================================================================
+def _self_join_rows(reuse, how="inner"):
+    s = _mk_session(**{
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.sql.exec.exchange.reuse.enabled": reuse})
+    df = s.create_dataframe(
+        {"k": [1, 2, 3, 4, 5, 6, 7, 8] * 10, "v": list(range(80))})
+    m0 = map_partitions_executed()
+    j = df.join(df, on="k", how=how)
+    rows = sorted(map(tuple, j.collect()))
+    return rows, map_partitions_executed() - m0, j
+
+
+def test_exchange_reuse_self_join_halves_map_work():
+    rows_on, maps_on, j = _self_join_rows(True)
+    rows_off, maps_off, _ = _self_join_rows(False)
+    assert rows_on == rows_off
+    assert maps_on < maps_off       # one map phase per DISTINCT subtree
+    plan = j.explain("ANALYZE")
+    assert "ReusedExchange[loreId=" in plan
+    assert "exchangeReuseHits=1" in plan
+
+
+@pytest.mark.parametrize("how", ["left_semi", "left_anti"])
+def test_exchange_reuse_semi_anti_shapes(how):
+    """The TPC-H q4/q21 shapes: semi/anti self-joins dedupe the build
+    exchange while keeping exact row parity."""
+    rows_on, maps_on, j = _self_join_rows(True, how=how)
+    rows_off, maps_off, _ = _self_join_rows(False, how=how)
+    assert rows_on == rows_off
+    assert maps_on < maps_off
+    hits = sum(int(m.get("exchangeReuseHits", 0))
+               for m in j.last_metrics().values())
+    assert hits >= 1
+
+
+def test_exchange_reuse_disabled_by_conf():
+    _, maps_off, j = _self_join_rows(False)
+    plan = j.explain("ALL")
+    assert "ReusedExchange" not in plan
+
+
+def test_exchange_reuse_distinct_subtrees_not_merged():
+    """Two different filters feed two exchanges: fingerprints differ,
+    nothing merges, results stay correct."""
+    s = _mk_session(**{
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1})
+    df = s.create_dataframe(
+        {"k": [1, 2, 3, 4] * 20, "v": list(range(80))})
+    a = df.filter(F.col("v") < 60)
+    b = df.filter(F.col("v") < 40)
+    j = a.join(b, on="k")
+    rows = j.collect()
+    assert len(rows) > 0
+    assert "ReusedExchange" not in j.explain("ALL")
+
+
+def test_reuse_fingerprint_name_blind():
+    """node_fp must see through pure-rename projects and column-name
+    labels — the Exchange(Project[k AS gensym](Scan)) self-join shape."""
+    from spark_rapids_tpu.plan.planner import Planner
+    from spark_rapids_tpu.plan.reuse import node_fp
+    s = _mk_session(**{
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1,
+        "spark.rapids.tpu.sql.exec.exchange.reuse.enabled": False})
+    df = s.create_dataframe({"k": [1, 2, 3], "v": [4, 5, 6]})
+    j = df.join(df, on="k")
+    root = Planner(s.conf).plan(j._plan)
+    exs = []
+
+    def walk(n):
+        if type(n).__name__ == "ShuffleExchangeExec":
+            exs.append(n)
+        for c in n.children:
+            walk(c)
+
+    walk(root)
+    assert len(exs) == 2
+    fa, fb = node_fp(exs[0]), node_fp(exs[1])
+    assert fa is not None and fa == fb
+
+
+# =====================================================================
+# async broadcast build
+# =====================================================================
+def _bcast_join(timeout_secs, async_on=True):
+    s = _mk_session(**{
+        "spark.rapids.tpu.sql.exec.exchange.broadcastTimeoutSecs":
+            timeout_secs,
+        "spark.rapids.tpu.sql.exec.exchange.asyncBroadcast.enabled":
+            async_on})
+    left = s.create_dataframe(
+        {"k": list(range(200)) * 4, "v": list(range(800))})
+    right = s.create_dataframe(
+        {"k": list(range(200)), "w": [k * 10 for k in range(200)]})
+    j = left.join(right, on="k")
+    rows = sorted(map(tuple, j.collect()))
+    return rows, j
+
+
+def test_async_broadcast_parity_with_sync():
+    rows_async, j = _bcast_join(300.0, async_on=True)
+    rows_sync, _ = _bcast_join(300.0, async_on=False)
+    assert rows_async == rows_sync
+    assert len(rows_async) == 800
+    overlap = [m.get("broadcastBuildOverlapMs")
+               for m in j.last_metrics().values()
+               if "broadcastBuildOverlapMs" in m]
+    assert overlap                           # async path actually ran
+
+
+def test_broadcast_timeout_degrades_to_sync(monkeypatch):
+    """A microscopic timeout forces the fallback: results stay correct
+    and the fallback is counted, never a hang. The build is slowed so
+    it cannot finish during the stream-side prefetch window (a fast
+    build that beats the await is legitimately not a fallback)."""
+    import time as _time
+
+    from spark_rapids_tpu.exec import broadcast as _bx
+
+    orig = _bx.BroadcastExchangeExec._materialize
+
+    def slow(self, ctx):
+        _time.sleep(0.3)
+        return orig(self, ctx)
+
+    monkeypatch.setattr(_bx.BroadcastExchangeExec, "_materialize", slow)
+    rows, j = _bcast_join(1e-9, async_on=True)
+    ref, _ = _bcast_join(300.0, async_on=False)
+    assert rows == ref
+    fallbacks = sum(int(m.get("broadcastTimeoutFallbacks", 0))
+                    for m in j.last_metrics().values())
+    assert fallbacks >= 1
+
+
+def test_async_broadcast_nested_builds_do_not_deadlock():
+    """A broadcast join INSIDE the build side of another broadcast join
+    (the TPC-H q2 shape): the nested build must materialize inline on
+    the build-pool thread, not wait on a future queued behind itself on
+    the same bounded pool — that cycle only the 300s timeout breaks."""
+    import time as _time
+
+    s = _mk_session(**{
+        "spark.rapids.tpu.sql.exec.exchange.broadcastTimeoutSecs": 30.0})
+    a = s.create_dataframe({"k": list(range(50)), "v": list(range(50))})
+    b = s.create_dataframe(
+        {"k": list(range(50)), "w": [k * 2 for k in range(50)]})
+    c = s.create_dataframe(
+        {"k": list(range(50)), "x": [k * 3 for k in range(50)]})
+    j = a.join(b.join(c, on="k"), on="k")
+    t0 = _time.perf_counter()
+    rows = j.collect()
+    assert _time.perf_counter() - t0 < 25.0   # not the timeout path
+    assert len(rows) == 50
+    fallbacks = sum(int(m.get("broadcastTimeoutFallbacks", 0))
+                    for m in j.last_metrics().values())
+    assert fallbacks == 0
+
+
+# =====================================================================
+# xxhash64 / hive-hash kernels (Spark's other two jni Hash algorithms)
+# =====================================================================
+_M64 = (1 << 64) - 1
+_P1, _P2, _P3 = 0x9E3779B185EBCA87, 0xC2B2AE3D27D4EB4F, \
+    0x165667B19E3779F9
+_P4, _P5 = 0x85EBCA77C2B2AE63, 0x27D4EB2F165667C5
+
+
+def _rotl(x, r):
+    return ((x << r) | (x >> (64 - r))) & _M64
+
+
+def _fmix(h):
+    h ^= h >> 33
+    h = (h * _P2) & _M64
+    h ^= h >> 29
+    h = (h * _P3) & _M64
+    return h ^ (h >> 32)
+
+
+def _ref_xxh_int(i, seed):
+    h = (seed + _P5 + 4) & _M64
+    h ^= ((i & 0xFFFFFFFF) * _P1) & _M64
+    h = (_rotl(h, 23) * _P2 + _P3) & _M64
+    return _fmix(h)
+
+
+def _ref_xxh_long(l, seed):
+    h = (seed + _P5 + 8) & _M64
+    k1 = (_rotl((l & _M64) * _P2 & _M64, 31) * _P1) & _M64
+    h = (_rotl(h ^ k1, 27) * _P1 + _P4) & _M64
+    return _fmix(h)
+
+
+def _ref_xxh_bytes(b, seed):
+    h = (seed + _P5 + len(b)) & _M64
+    i = 0
+    while i + 8 <= len(b):
+        w = int.from_bytes(b[i:i + 8], "little")
+        k1 = (_rotl((w * _P2) & _M64, 31) * _P1) & _M64
+        h = (_rotl(h ^ k1, 27) * _P1 + _P4) & _M64
+        i += 8
+    if i + 4 <= len(b):
+        w = int.from_bytes(b[i:i + 4], "little")
+        h = (_rotl(h ^ ((w * _P1) & _M64), 23) * _P2 + _P3) & _M64
+        i += 4
+    while i < len(b):
+        h = (_rotl(h ^ ((b[i] * _P5) & _M64), 11) * _P1) & _M64
+        i += 1
+    return _fmix(h)
+
+
+def _s64(u):
+    return u - (1 << 64) if u >= (1 << 63) else u
+
+
+def test_xxhash64_ints_match_spark_reference():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.hash import xxhash64_row_hash
+    xs = [1, -7, 0, 2 ** 31 - 1]
+    cv = CV(jnp.asarray(np.array(xs, np.int32)), jnp.ones(4, bool))
+    got = list(np.asarray(xxhash64_row_hash([cv], [dt.INT32])))
+    assert got == [_s64(_ref_xxh_int(x & 0xFFFFFFFF, 42)) for x in xs]
+    xs = [1, -7, 2 ** 40, -(2 ** 50)]
+    cv = CV(jnp.asarray(np.array(xs, np.int64)), jnp.ones(4, bool))
+    got = list(np.asarray(xxhash64_row_hash([cv], [dt.INT64])))
+    assert got == [_s64(_ref_xxh_long(x & _M64, 42)) for x in xs]
+
+
+def test_xxhash64_strings_match_reference_under_64_bytes():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.hash import xxhash64_row_hash
+    strs = [b"", b"abc", b"hello world!", b"0123456789abcdefGHIJKLMN",
+            b"x" * 31, b"y" * 63]
+    data = b"".join(strs)
+    offs = np.zeros(len(strs) + 1, np.int32)
+    for i, s in enumerate(strs):
+        offs[i + 1] = offs[i] + len(s)
+    cv = CV(jnp.asarray(np.frombuffer(data, np.uint8)),
+            jnp.ones(len(strs), bool), offsets=jnp.asarray(offs))
+    got = list(np.asarray(xxhash64_row_hash([cv], [dt.STRING])))
+    assert got == [_s64(_ref_xxh_bytes(s, 42)) for s in strs]
+
+
+def test_xxhash64_null_passes_seed_through():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.hash import xxhash64_row_hash
+    a = CV(jnp.asarray(np.array([5, 5], np.int32)),
+           jnp.asarray([True, False]))
+    b = CV(jnp.asarray(np.array([9, 9], np.int64)), jnp.ones(2, bool))
+    got = list(np.asarray(
+        xxhash64_row_hash([a, b], [dt.INT32, dt.INT64])))
+    assert got == [_s64(_ref_xxh_long(9, _ref_xxh_int(5, 42))),
+                   _s64(_ref_xxh_long(9, 42))]
+
+
+def test_hive_hash_matches_java_semantics():
+    import jax.numpy as jnp
+    from spark_rapids_tpu.ops.hash import hive_hash_row_hash
+
+    def jstr(b):
+        h = 0
+        for x in b:
+            x = x - 256 if x >= 128 else x
+            h = (h * 31 + x) & 0xFFFFFFFF
+        return h - (1 << 32) if h >= (1 << 31) else h
+
+    def wrap(v):
+        v &= 0xFFFFFFFF
+        return v - (1 << 32) if v >= (1 << 31) else v
+
+    cvi = CV(jnp.asarray(np.array([3, -4], np.int32)),
+             jnp.ones(2, bool))
+    strs = [b"abc", b"hive"]
+    offs = np.array([0, 3, 7], np.int32)
+    cvs = CV(jnp.asarray(np.frombuffer(b"".join(strs), np.uint8)),
+             jnp.ones(2, bool), offsets=jnp.asarray(offs))
+    got = list(np.asarray(
+        hive_hash_row_hash([cvi, cvs], [dt.INT32, dt.STRING])))
+    assert got == [wrap(wrap(3 * 31) + jstr(b"abc")),
+                   wrap(wrap(-4 * 31) + jstr(b"hive"))]
+
+
+def test_hash_functions_end_to_end():
+    s = _mk_session()
+    df = s.create_dataframe({"k": [1, 2, None], "v": ["a", "bb", "c"]})
+    out = df.select(
+        F.xxhash64(F.col("k"), F.col("v")).alias("x"),
+        F.hive_hash(F.col("k"), F.col("v")).alias("h")).collect()
+    assert len(out) == 3
+    # null k row: xxhash64 folds only v; hive contributes 0 for k
+    assert all(isinstance(r[0], int) and isinstance(r[1], int)
+               for r in out)

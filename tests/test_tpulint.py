@@ -367,6 +367,71 @@ def test_ctx_cancel_non_batch_loop_not_flagged():
 
 
 # ----------------------------------------------------------------------
+# pool-cancel
+# ----------------------------------------------------------------------
+def test_pool_cancel_fires_on_unpolled_worker():
+    vs = _lint_exec("""
+        def run(self, ctx, pool):
+            def work(mpid):
+                while True:
+                    self.step(mpid)
+            futs = [pool.submit(work, i) for i in range(4)]
+    """)
+    assert [v.rule for v in vs] == ["pool-cancel"]
+    assert "check_cancel" in vs[0].message
+
+
+def test_pool_cancel_method_target_fires():
+    assert [v.rule for v in _lint_exec("""
+        class B:
+            def _materialize(self, ctx):
+                return [b for b in self.batches]
+
+            def submit(self, ctx, pool):
+                return pool.submit(self._materialize, ctx)
+    """)] == ["pool-cancel"]
+
+
+def test_pool_cancel_polling_worker_clean():
+    assert [v.rule for v in _lint_exec("""
+        def run(self, ctx, pool):
+            def work(mpid):
+                while True:
+                    ctx.check_cancel()
+                    self.step(mpid)
+            futs = [pool.submit(work, i) for i in range(4)]
+    """)] == []
+
+
+def test_pool_cancel_outside_exec_not_flagged():
+    assert _rules("""
+        def run(ctx, pool):
+            def work(mpid):
+                while True:
+                    step(mpid)
+            return pool.submit(work, 0)
+    """) == []
+
+
+def test_pool_cancel_unsubmitted_fn_not_flagged():
+    assert [v.rule for v in _lint_exec("""
+        def helper(x):
+            return x + 1
+    """)] == []
+
+
+def test_pool_cancel_allow_marker_suppresses():
+    assert [v.rule for v in _lint_exec("""
+        def run(self, ctx, pool):
+            # tpulint: allow[pool-cancel] remote task, no ctx available
+            def work(mpid):
+                while True:
+                    self.step(mpid)
+            return pool.submit(work, 0)
+    """)] == []
+
+
+# ----------------------------------------------------------------------
 # allow markers
 # ----------------------------------------------------------------------
 def test_marker_on_line_suppresses():
