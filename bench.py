@@ -61,6 +61,11 @@ import numpy as np  # noqa: E402
 # floor, and the concurrent tail section needs room after it).
 _SMOKE = ("--smoke" in sys.argv[1:]
           or os.environ.get("BENCH_SMOKE", "") == "1")
+if _SMOKE:
+    # smoke doubles as the lockdep soak: witness every engine lock for
+    # the whole run (must be in the env BEFORE the engine imports) and
+    # record the order-graph stats in extra.lockdep
+    os.environ.setdefault("SRTPU_LOCKDEP", "1")
 _BUDGET_S = float(os.environ.get("BENCH_BUDGET_S",
                                  "330" if _SMOKE else "600"))
 _QUERY_BUDGET_S = float(os.environ.get("BENCH_QUERY_BUDGET_S",
@@ -433,6 +438,12 @@ def _main_impl():
     # Skipped under --smoke: it rewrites the whole dataset as parquet.
     if _SMOKE:
         _partial["extra"]["smoke"] = True
+        from spark_rapids_tpu.runtime import lockdep as _lockdep
+        _lw = _lockdep.witness()
+        if _lw is not None:
+            # filled in now so a budget-expiry partial flush still
+            # carries it; refreshed after the concurrent tail below
+            _partial["extra"]["lockdep"] = _lw.report()
         # exchange-pipeline smoke (ISSUE 9): reuse dedup, q4 map-thread
         # speedup, serial/parallel/reused parity — before the
         # concurrent section so both share what budget remains
@@ -511,8 +522,14 @@ def _main_impl():
     }
     # milestone-only keys (scan profile, smoke flag) must survive into
     # the success-path JSON too, not just the partial flush
+    if "lockdep" in _partial["extra"]:
+        # refresh: the report should cover the concurrent tail too
+        from spark_rapids_tpu.runtime import lockdep as _lockdep
+        _lw = _lockdep.witness()
+        if _lw is not None:
+            _partial["extra"]["lockdep"] = _lw.report()
     for k in ("scan_profile", "smoke", "fresh_rerun_compiles",
-              "concurrent_2stream", "service", "exchange"):
+              "concurrent_2stream", "service", "exchange", "lockdep"):
         if k in _partial["extra"]:
             extra[k] = _partial["extra"][k]
     # ---- regression gate vs the previous round's JSON -------------------

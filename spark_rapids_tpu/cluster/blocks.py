@@ -49,7 +49,8 @@ class FetchFailed(RuntimeError):
 class BlockStore:
     def __init__(self):
         self.dir = tempfile.mkdtemp(prefix="srtpu-shuffle-")
-        self._lock = threading.Lock()
+        from ..runtime import lockdep
+        self._lock = lockdep.lock("BlockStore._lock")
         # shuffle_id -> {(map_id, pid): path}
         self._shuffles: "OrderedDict[str, Dict[Tuple[int, int], str]]" = \
             OrderedDict()
@@ -183,9 +184,11 @@ def ensure_server(advertise_host: str = None) -> Tuple[str, int]:
                     except OSError:
                         return
                     threading.Thread(target=_serve_conn, args=(conn,),
-                                     daemon=True).start()
+                                     daemon=True,
+                                     name="tpu-blockserv-conn").start()
 
-            threading.Thread(target=accept_loop, daemon=True).start()
+            threading.Thread(target=accept_loop, daemon=True,
+                             name="tpu-blockserv").start()
         if not advertise_host:
             from ..config import CLUSTER_BLOCK_ADVERTISE_HOST
             advertise_host = CLUSTER_BLOCK_ADVERTISE_HOST.default

@@ -112,13 +112,16 @@ class ClusterManager:
                 [sys.executable, "-m", "spark_rapids_tpu.cluster.executor",
                  host, str(port), str(i)], env=env)
             self._executors[i] = _Executor(i, proc)
-        accept = threading.Thread(target=self._accept_loop, daemon=True)
+        accept = threading.Thread(target=self._accept_loop, daemon=True,
+                                  name="tpu-driver-accept")
         accept.start()
         self._threads.append(accept)
-        mon = threading.Thread(target=self._monitor_loop, daemon=True)
+        mon = threading.Thread(target=self._monitor_loop, daemon=True,
+                               name="tpu-driver-monitor")
         mon.start()
         self._threads.append(mon)
-        disp = threading.Thread(target=self._dispatch_loop, daemon=True)
+        disp = threading.Thread(target=self._dispatch_loop, daemon=True,
+                                name="tpu-driver-dispatch")
         disp.start()
         self._threads.append(disp)
         # wait for registrations
@@ -230,16 +233,19 @@ class ClusterManager:
                     ex.sock = sock
                     ex.last_heartbeat = time.time()
                 rt = threading.Thread(target=self._recv_loop,
-                                      args=(eid, sock), daemon=True)
+                                      args=(eid, sock), daemon=True,
+                                      name=f"tpu-driver-recv-{eid}")
                 rt.start()
                 st_ = threading.Thread(target=self._send_loop,
-                                       args=(eid, sock), daemon=True)
+                                       args=(eid, sock), daemon=True,
+                                       name=f"tpu-driver-send-{eid}")
                 st_.start()
                 self._threads.extend([rt, st_])
                 self._idle.put(eid)
             elif kind == "hb_register":
                 ht = threading.Thread(target=self._hb_loop,
-                                      args=(eid, sock), daemon=True)
+                                      args=(eid, sock), daemon=True,
+                                      name=f"tpu-driver-hb-{eid}")
                 ht.start()
                 self._threads.append(ht)
             else:

@@ -53,7 +53,8 @@ def executor_main(host: str, port: int, exec_id: int) -> None:
         pass
     stop = threading.Event()
     t = threading.Thread(target=_heartbeat_loop,
-                         args=(host, port, exec_id, stop), daemon=True)
+                         args=(host, port, exec_id, stop), daemon=True,
+                         name="tpu-exec-hb")
     t.start()
     sock = socket.create_connection((host, port))
     send_msg(sock, "register", {"executor": exec_id, "pid": os.getpid()})

@@ -548,6 +548,23 @@ SERVICE_ADMISSION_DEVICE_LIMIT = _conf(
     "Explicit admission byte budget for device estimates; overrides "
     "deviceFraction * DeviceManager budget when > 0.", int,
     internal=True)
+LOCKDEP_ENABLED = _conf(
+    "sql.debug.lockdep.enabled", False,
+    "Runtime lockdep witness (runtime/lockdep.py): wrap engine locks, "
+    "the TpuSemaphore permit and exchange ride slot, record the "
+    "acquisition-order graph, and report lock-order cycles at edge "
+    "FORMATION time plus bounded-pool self-waits. Deadline kills "
+    "attach an all-threads held-resource dump to QueryTimedOut and "
+    "the event log. Locks created before the session exist are only "
+    "covered when env SRTPU_LOCKDEP=1 was set before import. Debug "
+    "tool; overhead is small (<3% on the test suite) but nonzero.",
+    bool)
+LOCKDEP_RAISE = _conf(
+    "sql.debug.lockdep.raiseOnCycle", True,
+    "With lockdep enabled: raise LockOrderViolation/PoolSelfWait at "
+    "the acquisition that forms the cycle (fail fast, the kernel-"
+    "lockdep behavior). False records findings for the "
+    "concurrency_report event without raising.", bool)
 
 
 class TpuConf:

@@ -28,6 +28,10 @@ __all__ = ["BroadcastExchangeExec", "on_build_pool"]
 _POOL_LOCK = threading.Lock()
 _POOL = None
 
+# the bounded build pool's worker-name prefix: on_build_pool() keys off
+# it, and runtime/lockdep's check_pool_wait guards await_build with it
+BUILD_POOL_PREFIX = "tpu-bcast-build"
+
 
 def _build_pool():
     """Shared daemon pool for async broadcast builds (a few concurrent
@@ -37,7 +41,7 @@ def _build_pool():
         if _POOL is None:
             import concurrent.futures as cf
             _POOL = cf.ThreadPoolExecutor(
-                max_workers=4, thread_name_prefix="bcast-build")
+                max_workers=4, thread_name_prefix=BUILD_POOL_PREFIX)
         return _POOL
 
 
@@ -48,16 +52,18 @@ def on_build_pool() -> bool:
     bounded pool and waiting on the future forms a wait cycle (every
     worker parked on a future queued behind itself) that only the
     await timeout can break."""
-    return threading.current_thread().name.startswith("bcast-build")
+    return threading.current_thread().name.startswith(BUILD_POOL_PREFIX)
 
 
 class BroadcastExchangeExec(TpuExec):
     def __init__(self, child: TpuExec, schema):
         super().__init__([child], schema)
-        self._lock = threading.RLock()
+        from ..runtime import lockdep
+        self._lock = lockdep.rlock("BroadcastExchangeExec._lock")
         self._batches: Optional[List] = None
         self._future = None
-        self._future_lock = threading.Lock()
+        self._future_lock = lockdep.lock(
+            "BroadcastExchangeExec._future_lock")
         self._submit_t: Optional[float] = None
 
     def describe(self):
@@ -105,8 +111,15 @@ class BroadcastExchangeExec(TpuExec):
         forever). On timeout: count the fallback and run/join the build
         synchronously on this thread — never an unbounded silent hang."""
         import concurrent.futures as cf
+
+        from ..runtime import lockdep
         m = ctx.metrics_for(self._op_id)
         fut = self.submit_build(ctx)
+        # the q2 wait-cycle guard, live: blocking on a build future FROM
+        # a build worker parks the bounded pool behind itself (join.py's
+        # on_build_pool() gate makes this unreachable in practice; the
+        # witness proves it stays that way)
+        lockdep.check_pool_wait(BUILD_POOL_PREFIX)
         t_await = time.perf_counter()
         try:
             batches = fut.result(timeout_secs if timeout_secs

@@ -68,7 +68,8 @@ class ShuffleExchangeExec(TpuExec):
         self.keys = list(bound_keys) if bound_keys else None
         self._shuffle: Optional[LocalShuffle] = None
         self._pstats: Optional[List[int]] = None
-        self._lock = threading.RLock()
+        from ..runtime import lockdep
+        self._lock = lockdep.rlock("ShuffleExchangeExec._lock")
         # the program closes over plan-time config only (n + bound key
         # exprs), never self: a cached entry pinning the builder must
         # not pin this instance's shuffle files / partition stats
@@ -159,6 +160,7 @@ class ShuffleExchangeExec(TpuExec):
                     from ..shuffle.serializer import cv_shuffle_bufs
                     out, counts = self._run_map(batch.cvs(),
                                                 batch.row_mask)
+                    # tpulint: allow[sync-under-lock] the map phase IS the critical section: _lock memoizes the whole shuffle build and readers only need it after _shuffle is set
                     return fetch({
                         "cols": [cv_shuffle_bufs(cv) for cv in out],
                         "counts": counts,
@@ -229,12 +231,14 @@ class ShuffleExchangeExec(TpuExec):
                     stop = threading.Event()
                     with cf.ThreadPoolExecutor(
                             threads,
-                            thread_name_prefix="exch-map") as pool:
+                            thread_name_prefix="tpu-exch-map") as pool:
                         futs = [pool.submit(map_partition, mpid, rider,
                                             stop)
                                 for mpid in range(nparts)]
                         try:
+                            # tpulint: allow[wait-under-lock] map-pool join under the memoizing _lock is the design: PermitRider guarantees worker progress (rides the caller's permit), and other readers must wait for materialization anyway
                             for f in cf.as_completed(futs):
+                                # tpulint: allow[wait-under-lock] same join as the line above; sibling failure breaks the loop via stop+cancel
                                 f.result()
                         except BaseException:
                             stop.set()  # drain in-flight workers fast

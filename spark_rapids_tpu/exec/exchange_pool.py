@@ -57,6 +57,11 @@ class PermitRider:
     `mapPoolWaitMs` metric.
     """
 
+    # lockdep resource key for the ride slot: the witness sees it as a
+    # distinct class-keyed resource so ride-then-lock vs lock-then-ride
+    # inversions across map workers are observable
+    RIDE = "PermitRider.ride"
+
     def __init__(self, sem, priority: int = 0, token=None):
         self._sem = sem
         self._priority = priority
@@ -64,11 +69,17 @@ class PermitRider:
         self._rider = threading.Semaphore(1)
         self._lock = threading.Lock()
         self._waited = 0.0
+        self._riding = None      # thread name currently on the ride slot
 
     @property
     def waited_secs(self) -> float:
         with self._lock:
             return self._waited
+
+    def debug_state(self) -> dict:
+        """Held-state introspection for the lockdep dump."""
+        with self._lock:
+            return {"riding": self._riding, "waitedSecs": self._waited}
 
     @contextmanager
     def step(self):
@@ -90,11 +101,25 @@ class PermitRider:
                 self._waited += waited
             return waited
 
+        from ..runtime import lockdep
+
+        def _ride():
+            with self._lock:
+                self._riding = threading.current_thread().name
+            lockdep.note_acquired(self.RIDE)
+
+        def _unride():
+            lockdep.note_released(self.RIDE)
+            with self._lock:
+                self._riding = None
+
         while True:
             if self._rider.acquire(blocking=False):
+                _ride()
                 try:
                     yield _record()
                 finally:
+                    _unride()
                     self._rider.release()
                 return
             if self._sem.try_acquire():
@@ -104,9 +129,11 @@ class PermitRider:
                     self._sem.release()
                 return
             if self._rider.acquire(timeout=0.05):
+                _ride()
                 try:
                     yield _record()
                 finally:
+                    _unride()
                     self._rider.release()
                 return
             if self._token is not None:

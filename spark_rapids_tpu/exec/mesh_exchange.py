@@ -71,7 +71,8 @@ class MeshExchangeExec(TpuExec):
         self.axis_name = axis_name
         self._mesh = None
         self._out: Optional[List[List]] = None   # per shard: spill handles
-        self._lock = threading.RLock()
+        from ..runtime import lockdep
+        self._lock = lockdep.rlock("MeshExchangeExec._lock")
         self._jit_cache = {}
         self._compress = False    # set per-execution from conf
 
@@ -155,6 +156,7 @@ class MeshExchangeExec(TpuExec):
             # a per-piece sync would serialize every column of every
             # shard and undo the round's async pipelining
             packed = [compress_array(p) for p in pieces]
+            # tpulint: allow[sync-under-lock] one batched size fetch inside the memoized exchange build; readers block on _lock until _out is set regardless
             totals = [int(v) for v in fetch([t for _, t, _ in packed])]
             arrs = []
             for (comp, _t, nbytes), t, p, d in zip(packed, totals,
@@ -247,6 +249,7 @@ class MeshExchangeExec(TpuExec):
         n = self.n
         with m.timer("exchangeTime"):
             from ..utils.transfer import fetch
+            # tpulint: allow[sync-under-lock] round collection is double-buffered INSIDE the memoized build; the fetch overlaps the next round's collective and readers need _out anyway
             stats_h = fetch(stats).reshape(n, 1 + n_str)
         out_cap = n * row_cap
         for s in range(n):
@@ -421,7 +424,7 @@ class MeshExchangeExec(TpuExec):
             put_item(q, _DRAIN_DONE)
 
         with cf.ThreadPoolExecutor(
-                threads, thread_name_prefix="mesh-map") as pool:
+                threads, thread_name_prefix="tpu-mesh-map") as pool:
             futs = [pool.submit(produce, cpid)
                     for cpid in range(nparts)]
             try:
@@ -443,6 +446,7 @@ class MeshExchangeExec(TpuExec):
                             flush(slot)
                             slot = []
                 for f in futs:
+                    # tpulint: allow[wait-under-lock] producer join under the memoizing _lock: queues already drained _DRAIN_DONE so workers are exiting; PermitRider kept them off blocking sem.acquire
                     f.result()
             except BaseException:
                 stop.set()  # unblock producers parked on full queues

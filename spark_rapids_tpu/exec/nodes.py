@@ -544,7 +544,8 @@ class ParquetScanExec(TpuExec):
             return at, 0
 
         nthreads = max(1, ctx.conf.get(MULTITHREADED_READ_THREADS))
-        with ThreadPoolExecutor(max_workers=nthreads) as pool:
+        with ThreadPoolExecutor(max_workers=nthreads,
+                                thread_name_prefix="tpu-coalesce") as pool:
             parts = list(pool.map(read_one, group))
         tables = []
         for at, skipped in parts:
@@ -610,7 +611,7 @@ def _decompress_pool(ctx):
         if _DECOMP_POOL is None:
             from concurrent.futures import ThreadPoolExecutor
             _DECOMP_POOL = ThreadPoolExecutor(
-                max_workers=n, thread_name_prefix="srtpu-decomp")
+                max_workers=n, thread_name_prefix="tpu-decomp")
         return _DECOMP_POOL
 
 
@@ -653,7 +654,8 @@ def _prefetched(it: Iterator, depth: int, wait_metrics=None):
                 except queue.Full:
                     continue
 
-    t = threading.Thread(target=work, daemon=True)
+    t = threading.Thread(target=work, daemon=True,
+                         name="tpu-prefetch")
     t.start()
     try:
         while True:
@@ -976,7 +978,8 @@ def collect_to_arrow(root: TpuExec, ctx: ExecContext):
             return out
 
         workers = min(nparts, max(2, ctx.conf.concurrent_tasks * 2))
-        with cf.ThreadPoolExecutor(workers) as pool:
+        with cf.ThreadPoolExecutor(
+                workers, thread_name_prefix="tpu-collect") as pool:
             results = list(pool.map(run_part, range(nparts)))
         pieces = [at for r in results for at in r]
         if sem_wait[0] > 0:

@@ -100,7 +100,7 @@ class AsyncWriteQueue:
         self.controller = controller
         self._pool = ThreadPoolExecutor(
             max_workers=max(1, num_threads),
-            thread_name_prefix="srtpu-async-write")
+            thread_name_prefix="tpu-async-write")
         self._futures: List = []
         self._error: Optional[BaseException] = None
         self._lock = threading.Lock()

@@ -19,7 +19,15 @@ import itertools
 import threading
 from contextlib import contextmanager
 
+from ..runtime import lockdep
+
 __all__ = ["TpuSemaphore"]
+
+# lockdep resource key for any permit of any TpuSemaphore instance:
+# permits from different sessions never form real cycles with each
+# other, and class-keying is what lets the witness see permit-then-lock
+# vs lock-then-permit inversions across threads
+PERMIT = "TpuSemaphore.permit"
 
 
 class TpuSemaphore:
@@ -31,11 +39,21 @@ class TpuSemaphore:
         self._waiters = []          # heap of (priority, seq)
         self._dead = set()          # abandoned waiter entries (cancelled)
         self._seq = itertools.count()
+        self._holders = {}          # thread name -> permits held
         self.metrics = {"acquireWaitTime": 0.0, "acquires": 0}
 
     def _purge_dead(self):
         while self._waiters and tuple(self._waiters[0]) in self._dead:
             self._dead.discard(tuple(heapq.heappop(self._waiters)))
+
+    def _note_held(self, delta: int):
+        # caller holds self._cond
+        name = threading.current_thread().name
+        n = self._holders.get(name, 0) + delta
+        if n <= 0:
+            self._holders.pop(name, None)
+        else:
+            self._holders[name] = n
 
     def acquire(self, priority: int = 0, token=None) -> float:
         """Block until a permit is granted in priority order; returns
@@ -67,7 +85,9 @@ class TpuSemaphore:
             waited = time.perf_counter() - t0
             self.metrics["acquires"] += 1
             self.metrics["acquireWaitTime"] += waited
+            self._note_held(+1)
             self._cond.notify_all()
+        lockdep.note_acquired(PERMIT)
         return waited
 
     def try_acquire(self) -> bool:
@@ -82,13 +102,20 @@ class TpuSemaphore:
             if self._available > 0 and not self._waiters:
                 self._available -= 1
                 self.metrics["acquires"] += 1
-                return True
-            return False
+                self._note_held(+1)
+                got = True
+            else:
+                got = False
+        if got:
+            lockdep.note_acquired(PERMIT)
+        return got
 
     def release(self):
         with self._cond:
             self._available += 1
+            self._note_held(-1)
             self._cond.notify_all()
+        lockdep.note_released(PERMIT)
 
     @contextmanager
     def hold(self, priority: int = 0, token=None):
@@ -97,3 +124,14 @@ class TpuSemaphore:
             yield
         finally:
             self.release()
+
+    def debug_state(self) -> dict:
+        """Point-in-time introspection for the lockdep dump and the
+        concurrency_report event: who holds permits, who is queued."""
+        with self._cond:
+            return {
+                "permits": self._permits,
+                "available": self._available,
+                "holders": dict(self._holders),
+                "waiters": len(self._waiters) - len(self._dead),
+            }

@@ -110,6 +110,7 @@ class SpillableBatchHandle:
             "cols": [c.device_buffers() for c in b.table.columns],
             "mask": b.row_mask,
         }
+        # tpulint: allow[sync-under-lock] spill D2H must run under the store lock: the handle's state machine (DEVICE->HOST) and the pressure sweep that chose this victim both key off it; audited PR 10, no waiter can need the device result
         self._host = fetch(tree)
         self._meta = (b.table.schema, list(b.table.names), b.num_rows,
                       b.capacity)
@@ -212,7 +213,8 @@ class SpillStore:
         self.host_limit = host_limit
         self.host_mgr = host_mgr
         self.staging = staging    # PinnedStagingPool for disk-write I/O
-        self._lock = threading.RLock()
+        from ..runtime import lockdep
+        self._lock = lockdep.rlock("SpillStore._lock")
         self._handles: Dict[str, SpillableBatchHandle] = {}
         self.dm.register_spill_hook(self.spill)
         if host_mgr is not None:

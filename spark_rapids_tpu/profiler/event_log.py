@@ -17,7 +17,11 @@ typed events the profiling tool post-processes:
   watermarks    {devicePeakBytes, hostPeakBytes, spill?, hostPressure?}
   xla_compile   {compiles, compile_secs, cache_hits, cache_misses,
                  dispatches}
-  query_cancelled{reason}       (cooperative cancel / deadline kill)
+  query_cancelled{reason, lockdep?: {threads, findings, edges}}
+                (cooperative cancel / deadline kill; deadline kills
+                 attach the runtime/lockdep.py all-threads dump)
+  concurrency_report{enabled, resources, orderEdges, maxOrderGraph,
+                 acquires, findings}  (lockdep witness, when enabled)
   query_end     {status: ok|error|cancelled|timeout, wall_s, error?}
 
 Locally `session.py` wraps every action (`profile_query`); the
@@ -247,12 +251,23 @@ def profile_query(session, root, ctx, action: str, handle=None):
             status = "error"
         err = repr(e)
         if status != "error":
-            w.emit("query_cancelled", reason=status)
+            # deadline kills carry the lockdep all-threads dump (see
+            # runtime/lockdep.attach_dump) — surface it so a timeout in
+            # the log is attributable to held resources, not a mystery
+            cancel_fields = {"reason": status}
+            dump = getattr(e, "lockdep_dump", None)
+            if dump is not None:
+                cancel_fields["lockdep"] = dump
+            w.emit("query_cancelled", **cancel_fields)
         raise
     finally:
         try:
             w.emit("op_metrics", ops=op_metrics_records(
                 root, ctx.metrics, ctx.metrics_level))
+            from ..runtime import lockdep
+            lw = lockdep.witness()
+            if lw is not None:
+                w.emit("concurrency_report", **lw.report())
             w.emit("watermarks", **diagnostics.watermarks_snapshot())
             x1 = xla_stats.snapshot()
             w.emit("xla_compile",

@@ -4,8 +4,11 @@ Today: the XLA program cache (program_cache.py) — compiled-program
 reuse across exec instances, DataFrames, and Sessions within one
 process, the property the reference engine gets for free from pre-built
 cuDF kernels (GpuOverrides.scala:5017 plans in milliseconds because
-nothing compiles per query).
+nothing compiles per query) — and the lockdep witness (lockdep.py),
+the runtime half of the concurrency auditor
+(docs/static_analysis.md).
 """
+from . import lockdep  # noqa: F401
 from . import program_cache  # noqa: F401
 
-__all__ = ["program_cache"]
+__all__ = ["lockdep", "program_cache"]

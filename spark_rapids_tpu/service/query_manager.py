@@ -90,7 +90,13 @@ class CancelToken:
         if self._cancelled:
             raise QueryCancelled(self.query_id, self._reason)
         if self.deadline is not None and time.monotonic() > self.deadline:
-            raise QueryTimedOut(self.query_id, self.timeout_secs)
+            e = QueryTimedOut(self.query_id, self.timeout_secs)
+            # a deadline kill is where PR 8's deadlocks used to surface
+            # as bare timeouts: attach the all-threads held-resource
+            # dump so the exception (and event log) says WHO was stuck
+            from ..runtime import lockdep
+            lockdep.attach_dump(e)
+            raise e
 
 
 class QueryHandle:
@@ -200,7 +206,8 @@ class QueryManager:
         from ..config import (SERVICE_MAX_CONCURRENT, TpuConf)
         self.conf = conf or TpuConf()
         from .scheduler import FairScheduler
-        self._lock = threading.Lock()
+        from ..runtime import lockdep
+        self._lock = lockdep.lock("QueryManager._lock")
         self._cond = threading.Condition(self._lock)
         self.scheduler = FairScheduler(self.conf)
         self.max_concurrent = max(1, int(
@@ -267,7 +274,7 @@ class QueryManager:
                 self.close_query(h, result=out)
 
         t = threading.Thread(target=_worker, daemon=True,
-                             name=f"srtpu-query-{h.query_id}")
+                             name=f"tpu-svc-query-{h.query_id}")
         t.start()
         return h
 

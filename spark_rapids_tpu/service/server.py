@@ -59,7 +59,7 @@ class QueryServer:
         self.host, self.port = s.getsockname()
         self._sock = s
         t = threading.Thread(target=self._accept_loop, daemon=True,
-                             name="srtpu-gateway-accept")
+                             name="tpu-svc-gateway-accept")
         t.start()
         self._threads.append(t)
         return self.host, self.port
@@ -97,7 +97,7 @@ class QueryServer:
             except OSError:
                 return
             t = threading.Thread(target=self._serve_conn, args=(conn,),
-                                 daemon=True, name="srtpu-gateway-conn")
+                                 daemon=True, name="tpu-svc-conn")
             t.start()
 
     def _serve_conn(self, conn: socket.socket):

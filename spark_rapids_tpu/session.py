@@ -49,6 +49,8 @@ class TpuSession:
         from .config import RETRY_COVERAGE_ENABLED
         from .memory.diagnostics import enable_retry_coverage
         enable_retry_coverage(bool(self.conf.get(RETRY_COVERAGE_ENABLED)))
+        from .runtime import lockdep
+        lockdep.maybe_enable_from_conf(self.conf)
 
     @staticmethod
     def builder_get_or_create(conf: Optional[Dict] = None) -> "TpuSession":

@@ -77,7 +77,8 @@ class LocalShuffle:
         self.writer_threads = writer_threads
         self.reader_threads = reader_threads
         self.codec = get_codec(codec)
-        self._lock = threading.Lock()
+        from ..runtime import lockdep
+        self._lock = lockdep.lock("LocalShuffle._lock")
         # keyed by map partition id and iterated in sorted order: with a
         # parallel map side, COMPLETION order is nondeterministic but
         # reduce-side concatenation must stay byte-identical to serial
@@ -100,7 +101,10 @@ class LocalShuffle:
         flat = [(rp, sb) for rp in range(self.n)
                 for sb in pieces_per_reduce[rp]]
         if self.writer_threads > 1 and len(flat) > 1:
-            with cf.ThreadPoolExecutor(self.writer_threads) as pool:
+            with cf.ThreadPoolExecutor(
+                    self.writer_threads,
+                    thread_name_prefix="tpu-shufwrite") as pool:
+                # tpulint: allow[wait-under-lock] serializer pool is private, CPU/file-bound, and takes no locks or permits — join under the exchange build lock cannot cycle
                 blocks = list(pool.map(lambda t: ser(t[1]), flat))
         else:
             blocks = [ser(sb) for _, sb in flat]
@@ -195,7 +199,9 @@ class LocalShuffle:
             return out
 
         if self.reader_threads > 1 and len(files) > 1:
-            with cf.ThreadPoolExecutor(self.reader_threads) as pool:
+            with cf.ThreadPoolExecutor(
+                    self.reader_threads,
+                    thread_name_prefix="tpu-shufread") as pool:
                 results = list(pool.map(read_one, enumerate(files)))
         else:
             results = [read_one((i, p)) for i, p in enumerate(files)]

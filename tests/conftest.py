@@ -4,6 +4,12 @@ analog of the reference's `local-cluster[...]` pseudo-distributed tests,
 integration_tests/README.md:205)."""
 import os
 
+# Lockdep witness for the WHOLE suite: must be in the env BEFORE the
+# engine imports so lock factories wrap at creation (runtime/lockdep.py).
+# Any lock-order cycle or pool self-wait the tests drive the engine into
+# raises at formation time instead of hanging the suite.
+os.environ.setdefault("SRTPU_LOCKDEP", "1")
+
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (

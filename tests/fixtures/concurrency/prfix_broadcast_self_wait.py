@@ -1,0 +1,59 @@
+"""Archived PRE-FIX shape of the PR 8 broadcast wait-cycle (q2 bug).
+
+A broadcast build whose subtree contains ANOTHER broadcast join
+re-enters `await_build` FROM a bounded build-pool worker: the nested
+build is submitted to the same 4-worker pool the caller occupies and
+`fut.result()` parks the worker behind itself. With enough concurrent
+builds every worker waits on a future that can only run on the pool
+they are blocking — broken in production only by the 300s broadcast
+timeout (q2 ran 306s). The live fix is `on_build_pool()` in
+exec/broadcast.py (nested builds materialize inline) plus the
+lockdep `check_pool_wait` guard.
+
+tests/test_concurrency_audit.py asserts the static analyzer flags the
+`fut.result()` below as `pool-self-wait`. Never imported by the engine.
+"""
+import concurrent.futures as cf
+import threading
+
+_POOL_LOCK = threading.Lock()
+_POOL = None
+
+
+def _build_pool():
+    global _POOL
+    with _POOL_LOCK:
+        if _POOL is None:
+            _POOL = cf.ThreadPoolExecutor(
+                max_workers=4, thread_name_prefix="bcast-build")
+        return _POOL
+
+
+class BroadcastExchangeExec:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._future = None
+        self._future_lock = threading.Lock()
+        self._batches = None
+
+    def _materialize(self, ctx):
+        # runs ON a bcast-build worker (submitted below); a nested
+        # broadcast join in the child subtree calls await_build again
+        with self._lock:
+            if self._batches is None:
+                out = []
+                for child in ctx.broadcast_children:
+                    out.extend(child.await_build(ctx))
+                self._batches = out
+            return self._batches
+
+    def submit_build(self, ctx):
+        with self._future_lock:
+            if self._future is None:
+                self._future = _build_pool().submit(self._materialize,
+                                                    ctx)
+            return self._future
+
+    def await_build(self, ctx):
+        fut = self.submit_build(ctx)
+        return fut.result()

@@ -1,0 +1,49 @@
+"""Archived PRE-FIX shape of the PR 8 exchange permit starvation.
+
+The thread that triggers `_ensure_shuffled` already HOLDS a TpuSemaphore
+permit (collect acquires around `next(it)`, and advancing the iterator
+is what materializes the shuffle). Pre-fix, every map worker BLOCKED in
+`sem.acquire()` for a real permit — with `sql.concurrentTpuTasks=1` the
+only permit is pinned by their own caller, which is itself parked on
+the pool join under the materialization lock; with CHAINED exchanges
+every permit can be pinned by collect threads blocked on this
+exchange's lock. The live fix is PermitRider (exec/exchange_pool.py):
+one worker rides the caller's already-granted permit, the rest poll
+`try_acquire`.
+
+tests/test_concurrency_audit.py asserts the static analyzer flags the
+pool join under `self._lock` as `wait-under-lock` and the permit-wait
+reachable from the join as the starvation half. Never imported by the
+engine.
+"""
+import concurrent.futures as cf
+import threading
+
+
+class ShuffleExchangeExec:
+    def __init__(self, sem):
+        self._lock = threading.RLock()
+        self.sem = sem
+        self._shuffle = None
+
+    def _ensure_shuffled(self, ctx, nparts):
+        def map_one(pid):
+            # pre-fix: unconditional blocking acquire on a permit the
+            # caller may be pinning
+            self.sem.acquire()
+            try:
+                return pid
+            finally:
+                self.sem.release()
+
+        with self._lock:
+            if self._shuffle is None:
+                with cf.ThreadPoolExecutor(
+                        max_workers=4,
+                        thread_name_prefix="exch-map") as pool:
+                    futs = [pool.submit(map_one, pid)
+                            for pid in range(nparts)]
+                    for f in cf.as_completed(futs):
+                        f.result()
+                self._shuffle = object()
+            return self._shuffle

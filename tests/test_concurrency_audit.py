@@ -1,0 +1,202 @@
+"""Static concurrency audit (analysis/concurrency.py): the two archived
+PR 8 deadlock shapes must be re-detected, the rule machinery must
+separate cycle from no-cycle, allow markers and the baseline must
+behave like the other tpulint rules, and the live tree must be clean."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from spark_rapids_tpu.analysis.concurrency import (
+    CONC_RULES, analyze_paths, build_model, inventory)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "concurrency")
+ENGINE = os.path.join(ROOT, "spark_rapids_tpu")
+
+
+def _rules(violations):
+    rules = {v.rule for v in violations}
+    assert rules <= set(CONC_RULES)
+    return rules
+
+
+# ---------------------------------------------------------------------
+# the two historical PR 8 deadlocks, archived pre-fix
+# ---------------------------------------------------------------------
+def test_pr8_broadcast_self_wait_fixture_detected():
+    vs = analyze_paths(
+        [os.path.join(FIXTURES, "prfix_broadcast_self_wait.py")],
+        rel_to=ROOT)
+    assert "pool-self-wait" in _rules(vs)
+    psw = [v for v in vs if v.rule == "pool-self-wait"]
+    # flagged at the fut.result() in await_build, attributed to the
+    # bounded build pool
+    assert any("bcast-build" in v.message for v in psw)
+    assert any("await_build" in v.message for v in psw)
+
+
+def test_pr8_permit_starvation_fixture_detected():
+    vs = analyze_paths(
+        [os.path.join(FIXTURES, "prfix_permit_starvation.py")],
+        rel_to=ROOT)
+    assert "wait-under-lock" in _rules(vs)
+    wul = [v for v in vs if v.rule == "wait-under-lock"]
+    # both halves of the starvation: the pool join under the
+    # materialization lock AND the worker's blocking permit wait that
+    # inherits the lock interprocedurally
+    assert any(v.message.startswith("blocking future") for v in wul)
+    assert any(v.message.startswith("blocking sem") for v in wul)
+    assert all("ShuffleExchangeExec._lock" in v.message for v in wul)
+
+
+# ---------------------------------------------------------------------
+# rule units: cycle vs no-cycle, sync-under-lock, markers, baseline
+# ---------------------------------------------------------------------
+def _analyze_src(tmp_path, src, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return analyze_paths([str(p)], rel_to=str(tmp_path))
+
+
+def test_lock_order_cycle_detected(tmp_path):
+    vs = _analyze_src(tmp_path, """\
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def forward():
+    with lock_a:
+        with lock_b:
+            pass
+
+
+def backward():
+    with lock_b:
+        with lock_a:
+            pass
+""")
+    assert "lock-order-cycle" in _rules(vs)
+
+
+def test_consistent_order_is_clean(tmp_path):
+    vs = _analyze_src(tmp_path, """\
+import threading
+
+lock_a = threading.Lock()
+lock_b = threading.Lock()
+
+
+def one():
+    with lock_a:
+        with lock_b:
+            pass
+
+
+def two():
+    with lock_a:
+        with lock_b:
+            pass
+""")
+    assert vs == []
+
+
+def test_sync_under_lock_detected_and_marker_allows(tmp_path):
+    src = """\
+import threading
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+
+    def demote(self, batch):
+        with self._lock:
+            host = batch.block_until_ready()
+        return host
+"""
+    vs = _analyze_src(tmp_path, src)
+    assert _rules(vs) == {"sync-under-lock"}
+    allowed = src.replace(
+        "            host = batch.block_until_ready()",
+        "            # tpulint: allow[sync-under-lock] state machine "
+        "needs the D2H under the lock\n"
+        "            host = batch.block_until_ready()")
+    assert _analyze_src(tmp_path, allowed, name="mod2.py") == []
+
+
+def test_condition_wait_own_lock_exempt(tmp_path):
+    # Condition.wait releases its paired lock while parked — must NOT
+    # count as waiting under that lock
+    vs = _analyze_src(tmp_path, """\
+import threading
+
+
+class Gate:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+
+    def block(self):
+        with self._cond:
+            self._cond.wait()
+""")
+    assert vs == []
+
+
+def test_baseline_diffing_with_concurrency_violations(tmp_path):
+    from spark_rapids_tpu.analysis.lint_rules import (baseline_entries,
+                                                      diff_baseline)
+    vs = analyze_paths(
+        [os.path.join(FIXTURES, "prfix_broadcast_self_wait.py")],
+        rel_to=ROOT)
+    assert vs
+    accepted = baseline_entries(vs, "archived pre-fix shape")["entries"]
+    new, stale = diff_baseline(vs, accepted)
+    assert new == [] and stale == []
+    # dropping one accepted entry makes that violation NEW again; an
+    # entry for code no longer observed goes STALE
+    new, stale = diff_baseline(vs, accepted[1:])
+    assert len(new) == 1
+    ghost = dict(accepted[0])
+    ghost["snippet"] = "gone_from_the_tree()"
+    new, stale = diff_baseline(vs, accepted + [ghost])
+    assert new == [] and len(stale) == 1
+
+
+# ---------------------------------------------------------------------
+# the live tree
+# ---------------------------------------------------------------------
+def test_engine_tree_is_clean():
+    """Every intentional site is inline-annotated; the committed
+    concurrency baseline stays EMPTY."""
+    assert analyze_paths([ENGINE], rel_to=ROOT) == []
+    with open(os.path.join(ROOT, "tools",
+                           "tpulint_concurrency_baseline.json")) as f:
+        assert json.load(f)["entries"] == []
+
+
+def test_inventory_names_engine_pools_and_resources():
+    model = build_model([ENGINE], rel_to=ROOT)
+    inv = inventory(model)
+    pools = set(inv["pools"])
+    for expected in ("tpu-exch-map", "tpu-mesh-map", "tpu-decomp",
+                     "tpu-collect", "tpu-coalesce", "tpu-shufwrite"):
+        assert expected in pools, (expected, sorted(pools))
+    for res in ("ShuffleExchangeExec._lock", "QueryManager._lock",
+                "SpillStore._lock", "TpuSemaphore._lock"):
+        assert res in inv["resources"], res
+
+
+@pytest.mark.slow
+def test_tpulint_concurrency_cli_check_mode():
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+         "--concurrency", "--check"],
+        capture_output=True, text=True, cwd=ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 new" in out.stdout

@@ -14,6 +14,8 @@ from spark_rapids_tpu.analysis.lint_rules import (diff_baseline,
 
 _ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
 _BASELINE = os.path.join(_ROOT, "tools", "tpulint_baseline.json")
+_CONC_BASELINE = os.path.join(_ROOT, "tools",
+                              "tpulint_concurrency_baseline.json")
 
 
 def test_tpulint_clean_against_committed_baseline():
@@ -41,6 +43,32 @@ def test_every_baseline_entry_carries_a_reason():
 def test_tpulint_cli_clean():
     r = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "tpulint.py")],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_concurrency_audit_clean_against_committed_baseline():
+    """The interprocedural deadlock pass (analysis/concurrency.py) runs
+    clean: every intentional wait/sync site carries an inline allow
+    marker and the committed concurrency baseline stays empty."""
+    from spark_rapids_tpu.analysis.concurrency import analyze_paths
+    violations = analyze_paths([os.path.join(_ROOT, "spark_rapids_tpu")],
+                               rel_to=_ROOT)
+    baseline = load_baseline(_CONC_BASELINE)
+    assert baseline == [], (
+        "concurrency baseline must stay empty — annotate intentional "
+        "sites inline instead")
+    new, stale = diff_baseline(violations, baseline)
+    assert not new, (
+        "new concurrency violations (fix them or add a "
+        "`# tpulint: allow[<rule>] <reason>` marker):\n"
+        + "\n".join(v.describe() for v in new))
+
+
+def test_tpulint_concurrency_cli_check_clean():
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "tpulint.py"),
+         "--concurrency", "--check"],
         capture_output=True, text=True)
     assert r.returncode == 0, r.stdout + r.stderr
 

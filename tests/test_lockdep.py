@@ -1,0 +1,189 @@
+"""Runtime lockdep witness (runtime/lockdep.py): cycle formation and
+pool self-waits caught LIVE, deadline kills attributed, and — the real
+payoff — zero findings across a parallel chained-exchange run with the
+witness on (conftest sets SRTPU_LOCKDEP=1 for the whole suite)."""
+import concurrent.futures as cf
+import threading
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.runtime import lockdep
+
+
+def test_witness_enabled_for_suite():
+    # the conftest env gate must have instrumented the engine at import
+    assert lockdep.enabled()
+    assert lockdep.witness().report()["enabled"] is True
+
+
+# ---------------------------------------------------------------------
+# constructed live findings (LOCAL Witness instances: the process
+# witness must stay finding-free for the whole suite)
+# ---------------------------------------------------------------------
+def test_live_pool_self_wait_caught_from_worker():
+    """The PR 8 q2 shape, reproduced live: a bounded-pool worker blocks
+    on a future of its own pool. The witness reports it from INSIDE the
+    worker, before the wait can park the pool behind itself."""
+    w = lockdep.Witness(raise_on_finding=True)
+    with cf.ThreadPoolExecutor(
+            max_workers=1, thread_name_prefix="tpu-bcast-build") as pool:
+
+        def nested_build():
+            return "built"
+
+        def outer_build():
+            inner = pool.submit(nested_build)   # queued behind ourselves
+            w.check_pool_wait("tpu-bcast-build")
+            return inner.result()               # pragma: no cover
+
+        fut = pool.submit(outer_build)
+        with pytest.raises(lockdep.PoolSelfWait, match="tpu-bcast-build"):
+            fut.result(timeout=30)
+    assert w.findings and w.findings[0]["kind"] == "pool-self-wait"
+
+
+def test_live_order_inversion_across_two_threads():
+    """ABBA: thread 1 establishes A -> B; thread 2 acquiring A under B
+    closes the cycle and raises AT FORMATION, no actual interleaving
+    needed."""
+    w = lockdep.Witness(raise_on_finding=True)
+    seen = []
+
+    def t1():
+        w.acquired("A")
+        w.acquired("B")
+        w.released("B")
+        w.released("A")
+
+    def t2():
+        w.acquired("B")
+        try:
+            w.acquired("A")
+        except lockdep.LockOrderViolation as e:
+            seen.append(e)
+
+    th1 = threading.Thread(target=t1, name="tpu-test-t1")
+    th1.start()
+    th1.join()
+    th2 = threading.Thread(target=t2, name="tpu-test-t2")
+    th2.start()
+    th2.join()
+    assert len(seen) == 1
+    assert "A -> B" in str(seen[0]) or "B -> A" in str(seen[0])
+    assert w.findings[0]["kind"] == "lock-order-cycle"
+    assert w.findings[0]["thread"] == "tpu-test-t2"
+
+
+def test_same_class_nesting_is_benign():
+    """Chained exchanges re-enter the same class lock (child
+    materialization under the parent's) — no self-edge, no finding."""
+    w = lockdep.Witness(raise_on_finding=True)
+    w.acquired("ShuffleExchangeExec._lock")
+    w.acquired("ShuffleExchangeExec._lock")
+    w.released("ShuffleExchangeExec._lock")
+    w.released("ShuffleExchangeExec._lock")
+    assert w.findings == []
+
+
+def test_consistent_order_never_raises():
+    w = lockdep.Witness(raise_on_finding=True)
+
+    def worker():
+        for _ in range(50):
+            w.acquired("A")
+            w.acquired("B")
+            w.acquired("C")
+            for k in ("C", "B", "A"):
+                w.released(k)
+
+    ts = [threading.Thread(target=worker) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert w.findings == []
+    assert w.report()["orderEdges"] >= 2
+
+
+def test_dump_attributes_held_resources_by_thread_name():
+    w = lockdep.Witness(raise_on_finding=False)
+    ready = threading.Event()
+    release = threading.Event()
+
+    def holder():
+        w.acquired("SpillStore._lock")
+        ready.set()
+        release.wait(timeout=30)
+        w.released("SpillStore._lock")
+
+    t = threading.Thread(target=holder, name="tpu-test-holder",
+                         daemon=True)
+    t.start()
+    assert ready.wait(timeout=30)
+    d = w.dump()
+    rows = {r["thread"]: r for r in d["threads"]}
+    assert rows["tpu-test-holder"]["held"] == ["SpillStore._lock"]
+    # held threads sort first so the culprit leads the report
+    assert d["threads"][0]["held"]
+    text = lockdep.format_dump(d)
+    assert "tpu-test-holder: held=[SpillStore._lock]" in text
+    release.set()
+    t.join()
+
+
+def test_attach_dump_folds_threads_into_timeout_message():
+    from spark_rapids_tpu.service.query_manager import QueryTimedOut
+    e = QueryTimedOut("q-test", 1.5)
+    d = lockdep.attach_dump(e)       # process witness is on (conftest)
+    assert d is not None and e.lockdep_dump is d
+    assert "lockdep threads:" in str(e)
+    # idempotent: a second attach must not stack another dump
+    assert lockdep.attach_dump(e) is None
+
+
+def test_semaphore_debug_state_tracks_holders():
+    from spark_rapids_tpu.memory.semaphore import TpuSemaphore
+    sem = TpuSemaphore(permits=2)
+    assert sem.debug_state()["available"] == 2
+    sem.acquire()
+    st_ = sem.debug_state()
+    me = threading.current_thread().name
+    assert st_["available"] == 1 and st_["holders"] == {me: 1}
+    sem.release()
+    assert sem.debug_state()["holders"] == {}
+
+
+# ---------------------------------------------------------------------
+# the payoff: a real parallel chained-exchange query under the witness
+# ---------------------------------------------------------------------
+def test_q4_parallel_exchange_run_zero_findings():
+    """Chained exchanges + parallel map side + broadcast-size join +
+    collect: the workload that held both PR 8 deadlocks, run with the
+    witness raising at formation. Zero findings, and the order graph
+    actually observed the engine's locks."""
+    w = lockdep.witness()
+    before = len(w.findings)
+    sess = st.TpuSession({
+        "spark.rapids.tpu.sql.batchSizeRows": 256,
+        "spark.rapids.tpu.sql.shuffle.partitions": 4,
+        "spark.rapids.tpu.sql.exec.exchange.mapThreads": 4,
+    })
+    rng = np.random.default_rng(7)
+    at = pa.table({
+        "k": pa.array(rng.integers(0, 12, 2000), type=pa.int64()),
+        "v": pa.array(rng.normal(0, 1, 2000)),
+    })
+    df = sess.create_dataframe(at)
+    out = (df.repartition(6)
+             .repartition(5, F.col("k"))
+             .group_by(F.col("k")).agg(F.sum(F.col("v")).alias("sv"))
+             .to_arrow())
+    assert out.num_rows == 12
+    assert len(w.findings) == before
+    rep = w.report()
+    assert rep["findings"] == len(w.findings)
+    assert rep["acquires"] > 0 and rep["resources"] >= 2

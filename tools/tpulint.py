@@ -14,6 +14,12 @@ Usage:
   python tools/tpulint.py --no-baseline         # report everything
   python tools/tpulint.py --write-baseline --reason "accepted: ..."
                                                 # accept current state
+  python tools/tpulint.py --concurrency         # static deadlock audit
+                # (analysis/concurrency.py: lock-order-cycle,
+                #  wait-under-lock, pool-self-wait, sync-under-lock;
+                #  same allow markers, separate baseline file)
+  python tools/tpulint.py --concurrency --check # strict CI gate: stale
+                # baseline entries fail too, keeping the baseline honest
 
 Exit codes: 0 clean, 1 new violations (or baseline entries without a
 reason), 2 usage error.
@@ -30,6 +36,8 @@ from spark_rapids_tpu.analysis.lint_rules import (  # noqa: E402
     baseline_entries, diff_baseline, lint_paths, load_baseline)
 
 DEFAULT_BASELINE = os.path.join(_ROOT, "tools", "tpulint_baseline.json")
+DEFAULT_CONC_BASELINE = os.path.join(
+    _ROOT, "tools", "tpulint_concurrency_baseline.json")
 
 
 def main(argv=None) -> int:
@@ -37,8 +45,14 @@ def main(argv=None) -> int:
                                  description=__doc__.splitlines()[0])
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: spark_rapids_tpu/)")
-    ap.add_argument("--baseline", default=DEFAULT_BASELINE,
+    ap.add_argument("--baseline", default=None,
                     help="baseline JSON of accepted violations")
+    ap.add_argument("--concurrency", action="store_true",
+                    help="run the interprocedural concurrency audit "
+                         "instead of the per-line hazard rules")
+    ap.add_argument("--check", action="store_true",
+                    help="strict mode: stale baseline entries are "
+                         "failures too (CI gate)")
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baseline; report every violation")
     ap.add_argument("--write-baseline", action="store_true",
@@ -49,12 +63,19 @@ def main(argv=None) -> int:
                     help="emit JSON instead of text")
     args = ap.parse_args(argv)
 
+    if args.baseline is None:
+        args.baseline = (DEFAULT_CONC_BASELINE if args.concurrency
+                         else DEFAULT_BASELINE)
     paths = args.paths or [os.path.join(_ROOT, "spark_rapids_tpu")]
     for p in paths:
         if not os.path.exists(p):
             print(f"tpulint: no such path: {p}", file=sys.stderr)
             return 2
-    violations = lint_paths(paths, rel_to=_ROOT)
+    if args.concurrency:
+        from spark_rapids_tpu.analysis.concurrency import analyze_paths
+        violations = analyze_paths(paths, rel_to=_ROOT)
+    else:
+        violations = lint_paths(paths, rel_to=_ROOT)
 
     if args.write_baseline:
         if violations and not args.reason:
@@ -91,7 +112,8 @@ def main(argv=None) -> int:
                   f"{e.get('path')}: {e.get('rule')}")
         print(f"tpulint: {len(violations)} observed, {len(new)} new, "
               f"{len(baseline)} baselined, {len(stale)} stale")
-    return 1 if (new or unreasoned) else 0
+    fail = new or unreasoned or (args.check and stale)
+    return 1 if fail else 0
 
 
 if __name__ == "__main__":
