@@ -214,6 +214,36 @@ PROGRAM_CACHE_MAX_ENTRIES = _conf(
     "raising it far beyond the default risks mmap exhaustion in "
     "long-lived many-query processes. Eviction counts surface as "
     "program_cache_evictions in the xla_compile event record.", int)
+RESULT_CACHE_ENABLED = _conf(
+    "sql.cache.enabled", False,
+    "Process-global cross-query result & fragment cache "
+    "(runtime/result_cache.py): whole-query Arrow results and hot "
+    "exchange map outputs are keyed on name/gensym-blind structural "
+    "plan fingerprints composed with scan snapshot versions (parquet "
+    "path+mtime+size sets, Delta table version), so a table write "
+    "soundly invalidates every dependent entry. A whole-query hit is "
+    "answered on the service fast path without consuming an admission "
+    "slot. Off by default (Spark/Presto posture): repeat traffic "
+    "opts in per session.", bool)
+RESULT_CACHE_MAX_BYTES = _conf(
+    "sql.cache.maxBytes", 256 * 1024 * 1024,
+    "Byte budget of the result cache across both tiers (whole-query "
+    "Arrow results + cached exchange fragments). Least-recently-used "
+    "entries are evicted past the budget; cached bytes also charge "
+    "the host-memory budget (spark.rapids.tpu.memory.host.limitBytes) "
+    "and are released first under host-memory pressure.", int)
+RESULT_CACHE_FRAGMENTS = _conf(
+    "sql.cache.fragments.enabled", True,
+    "Fragment tier of the result cache: materialized exchange map "
+    "outputs are cached by exchange-subtree fingerprint and served as "
+    "cached sources (CachedFragmentExec) in later plans, eliding the "
+    "whole map phase. Only consulted when sql.cache.enabled is on.", bool)
+RESULT_CACHE_MAX_ENTRY_BYTES = _conf(
+    "sql.cache.maxEntryBytes", 64 * 1024 * 1024,
+    "Largest single result or fragment the cache will store. Results "
+    "bigger than this execute normally and are never cached (a "
+    "full-table scan must not wipe the working set of an interactive "
+    "dashboard mix).", int)
 METRICS_LEVEL = _conf(
     "sql.metrics.level", "MODERATE",
     "Metric verbosity: ESSENTIAL|MODERATE|DEBUG.", str)

@@ -139,7 +139,7 @@ class ParquetScanExec(TpuExec):
 
     def __init__(self, paths: Sequence[str], schema: Schema,
                  columns: Optional[Sequence[str]] = None,
-                 filters=None, dv=None):
+                 filters=None, dv=None, snapshot=None, delta_version=None):
         super().__init__([], schema)
         self.paths = list(paths)
         self.columns = list(columns) if columns else None
@@ -148,8 +148,28 @@ class ParquetScanExec(TpuExec):
         # masks applied lazily per batch (Delta DVs); loaded once per
         # file at exec time, never at plan construction
         self.dv = dict(dv) if dv else None
+        # bind-time (path, mtime_ns, size) pinning + Delta version,
+        # copied from the logical scan (plan/logical.py). Public: both
+        # flow into the exchange-subtree fingerprint the fragment cache
+        # keys on. Verified per execute_partition — a file overwritten
+        # MID-query raises instead of mixing old and new bytes
+        # (between-action changes replan via DataFrame._execute).
+        self.snapshot = tuple(snapshot) if snapshot else None
+        self.delta_version = delta_version
         self._dv_cache = {}
         self._groups_cache = None
+
+    def _verify_snapshot(self, ctx):
+        if self.snapshot is None:
+            return
+        from ..io.snapshot import SnapshotMismatch, snapshot_current
+        if not snapshot_current(self.snapshot):
+            ctx.metrics_for(self._op_id).add("scanSnapshotViolations", 1)
+            raise SnapshotMismatch(
+                f"parquet files changed under a running scan: "
+                f"{self.paths[:3]}{'...' if len(self.paths) > 3 else ''} "
+                f"(bind-time snapshot no longer matches; re-run the "
+                f"action to rebind)")
 
     def _reader_type(self, ctx) -> str:
         # cached: AUTO must not re-stat files per call — a flipped
@@ -444,6 +464,7 @@ class ParquetScanExec(TpuExec):
                               MULTITHREADED_READ_THREADS,
                               PARQUET_READER_TYPE)
         m = ctx.metrics_for(self._op_id)
+        self._verify_snapshot(ctx)
         reader_type = self._reader_type(ctx)
         if reader_type == "COALESCING":
             # pid indexes file GROUPS here, not files

@@ -155,6 +155,15 @@ class DeltaTable:
         return out
 
 
+def _invalidate_cached(path: str):
+    """Advisory: drop result-cache entries reading any file under path."""
+    try:
+        from ..runtime import result_cache
+        result_cache.invalidate_prefix(path)
+    except Exception:
+        pass
+
+
 def write_delta(df, path: str, mode: str = "append"):
     """Transactional write: data files first, then one commit. On a lost
     commit race the actions are RECOMPUTED against the new snapshot (the
@@ -194,6 +203,7 @@ def write_delta(df, path: str, mode: str = "append"):
             "operation": op, "timestamp": int(time.time() * 1000)}})
         if table.try_commit(actions, latest + 1):
             table.maybe_checkpoint(latest + 1)
+            _invalidate_cached(path)
             if mode == "append":
                 maybe_auto_compact(df._session, path, df._session.conf)
             return latest + 1
@@ -210,11 +220,15 @@ def read_delta(session, path: str, version: Optional[int] = None):
     adds = table.snapshot_adds(version)
     if not adds:
         raise ValueError(f"delta table {path} has no live files")
+    # pin the table version in the scan: it rides the structural plan
+    # fingerprint, so a commit (append/OPTIMIZE/DML) changes every
+    # dependent result-cache key even when file mtimes are unhelpful
+    dv_ver = table.latest_version() if version is None else version
     plain = [os.path.join(path, a["path"]) for a in adds
              if not a.get("deletionVector")]
     with_dv = [a for a in adds if a.get("deletionVector")]
     if not with_dv:
-        return DataFrame(session, ParquetScan(plain))
+        return DataFrame(session, ParquetScan(plain, delta_version=dv_ver))
     import pyarrow as pa
     import pyarrow.parquet as pq
     from .dv import read_dv_file
@@ -230,7 +244,7 @@ def read_delta(session, path: str, version: Optional[int] = None):
     if not plain:
         return DataFrame(session, InMemoryScan(dv_tbl))
     return DataFrame(session, Union([
-        ParquetScan(plain), InMemoryScan(dv_tbl)]))
+        ParquetScan(plain, delta_version=dv_ver), InMemoryScan(dv_tbl)]))
 
 
 # ----------------------------------------------------------------------
@@ -287,6 +301,7 @@ def _commit_dml(table: DeltaTable, build_actions, op: str) -> int:
             "operation": op, "timestamp": int(time.time() * 1000)}})
         if table.try_commit(actions, latest + 1):
             table.maybe_checkpoint(latest + 1)
+            _invalidate_cached(table.path)
             return latest + 1
 
 

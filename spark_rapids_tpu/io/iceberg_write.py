@@ -216,4 +216,9 @@ def write_iceberg(df, path: str, mode: str = "append") -> int:
         json.dump(meta, f)
     with open(os.path.join(mdir, "version-hint.text"), "w") as f:
         f.write(str(version))
+    try:
+        from ..runtime import result_cache
+        result_cache.invalidate_prefix(path)
+    except Exception:
+        pass
     return total_rows

@@ -55,3 +55,8 @@ def write_parquet(df, path: str, mode: str = "overwrite",
                        os.path.join(path, "part-00000.parquet"),
                        compression=compression)
     open(os.path.join(path, "_SUCCESS"), "w").close()
+    try:
+        from ..runtime import result_cache
+        result_cache.invalidate_prefix(path)
+    except Exception:
+        pass

@@ -238,4 +238,9 @@ class DataFrameWriter:
             write_fn(empty, fname)
             stats.num_files = 1
         open(os.path.join(path, "_SUCCESS"), "w").close()
+        try:
+            from ..runtime import result_cache
+            result_cache.invalidate_prefix(path)
+        except Exception:
+            pass
         return stats

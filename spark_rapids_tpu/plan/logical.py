@@ -79,7 +79,7 @@ class CachedScan(LogicalPlan):
 class ParquetScan(LogicalPlan):
     def __init__(self, paths: Sequence[str], schema: Optional[Schema] = None,
                  columns: Optional[Sequence[str]] = None, filters=None,
-                 dv=None):
+                 dv=None, delta_version=None):
         import pyarrow.parquet as pq
         self.paths = list(paths)
         self.columns = list(columns) if columns is not None else None
@@ -89,6 +89,15 @@ class ParquetScan(LogicalPlan):
         # {path: (table_root, deletionVector descriptor)}: dead-row
         # masks applied lazily inside the scan (Delta DVs)
         self.dv = dict(dv) if dv else None
+        # bind-time snapshot: (path, mtime_ns, size) per file, plus the
+        # Delta table version when read through read_delta. An overwrite
+        # between actions refreshes the plan (DataFrame._execute); one
+        # mid-query raises (io/snapshot.py). Public attrs on purpose —
+        # both flow into the structural plan fingerprint, which is how
+        # a table write invalidates dependent result-cache entries.
+        from ..io.snapshot import scan_snapshot
+        self.snapshot = scan_snapshot(self.paths)
+        self.delta_version = delta_version
         if schema is None:
             schema = Schema.from_arrow(pq.read_schema(self.paths[0]))
             if self.columns is not None:
@@ -96,6 +105,15 @@ class ParquetScan(LogicalPlan):
                                  if f.name in self.columns])
         self._schema = schema
         self.children = []
+
+    def refresh_snapshot(self) -> bool:
+        """Re-stat the pinned files; True when anything changed."""
+        from ..io.snapshot import scan_snapshot
+        cur = scan_snapshot(self.paths)
+        if cur != self.snapshot:
+            self.snapshot = cur
+            return True
+        return False
 
     @property
     def schema(self):
@@ -114,11 +132,14 @@ class TextScan(LogicalPlan):
                  schema: Optional[Schema] = None, columns=None,
                  options=None):
         from ..exec.text_scan import infer_text_schema
+        from ..io.snapshot import scan_snapshot
         self.children = []
         self.paths = list(paths)
         self.fmt = fmt
         self.columns = list(columns) if columns else None
         self.options = options
+        # bind-time file pinning, same contract as ParquetScan.snapshot
+        self.snapshot = scan_snapshot(self.paths)
         if schema is not None and not isinstance(schema, Schema):
             schema = Schema.from_arrow(schema)   # accept pyarrow schemas
         self._full_schema = schema or infer_text_schema(

@@ -99,7 +99,10 @@ def _pq(meta, conv, conf):
     n = meta.node
     scan = x.ParquetScanExec(n.paths, n.schema, n.columns,
                              filters=n.filters,
-                             dv=getattr(n, "dv", None))
+                             dv=getattr(n, "dv", None),
+                             snapshot=getattr(n, "snapshot", None),
+                             delta_version=getattr(n, "delta_version",
+                                                   None))
     if len(n.paths) > 1:
         # many-small-files: coalesce toward the batch target
         # (GpuCoalesceBatches after scans, GpuTransitionOverrides.scala:77);
@@ -627,6 +630,14 @@ class Planner:
             from .reuse import reuse_exchanges
             root_exec, reuse_hits = reuse_exchanges(root_exec, self.conf)
             root_exec.exchange_reuse_hits = reuse_hits
+            # fragment tier of the cross-query result cache: an
+            # exchange subtree whose map output is already cached (from
+            # a PREVIOUS query) becomes a CachedFragmentExec source —
+            # cross-query what reuse_exchanges is intra-query
+            from ..runtime import result_cache
+            root_exec, frag_hits = result_cache.substitute_fragments(
+                root_exec, self.conf)
+            root_exec.result_cache_fragment_hits = frag_hits
             # ride the physical root so the profiler wrapper can emit
             # the plan_audit event without re-walking
             root_exec.audit_report = report

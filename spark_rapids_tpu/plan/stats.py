@@ -74,10 +74,10 @@ def _sample_arrow_column(node: L.LogicalPlan, name: str):
         if not node.batches or name not in node.schema.names:
             return None
         from ..exec.nodes import _batch_to_arrow
-        at = getattr(node, "_stats_sample", None)
+        at = getattr(node, "_stats_sample_cache", None)
         if at is None:
             at = _batch_to_arrow(node.batches[0]).slice(0, SAMPLE_ROWS)
-            node._stats_sample = at
+            node._stats_sample_cache = at
         if name not in at.schema.names:
             return None
         return at.column(name)

@@ -17,6 +17,12 @@ typed events the profiling tool post-processes:
   watermarks    {devicePeakBytes, hostPeakBytes, spill?, hostPressure?}
   xla_compile   {compiles, compile_secs, cache_hits, cache_misses,
                  dispatches}
+  result_cache  {hits, misses, fragment_hits, fragment_misses, stores,
+                 evictions, invalidations, entries, bytes, fast_path?,
+                 rows?}   (cross-query result cache,
+                 runtime/result_cache.py; emitted when
+                 sql.cache.enabled — fast_path=True records a
+                 whole-query hit answered without admission)
   query_cancelled{reason, lockdep?: {threads, findings, edges}}
                 (cooperative cancel / deadline kill; deadline kills
                  attach the runtime/lockdep.py all-threads dump)
@@ -46,7 +52,7 @@ from ..utils.metrics import DEBUG
 __all__ = ["EventLogWriter", "open_query_log", "read_event_log",
            "next_query_id", "plan_tree", "op_metrics_records",
            "aggregate_ops", "op_time_seconds", "top_operators",
-           "profile_query"]
+           "profile_query", "log_fast_path"]
 
 _QUERY_SEQ = itertools.count()
 
@@ -220,6 +226,9 @@ def profile_query(session, root, ctx, action: str, handle=None):
     if session is not None:
         session.last_event_log = w.path
     xla0 = xla_stats.snapshot()
+    from ..runtime import result_cache
+    rc_on = result_cache.enabled(ctx.conf)
+    rc0 = result_cache.stats() if rc_on else None
     diagnostics.reset_watermarks()
     t0 = time.perf_counter()
     if handle is not None:
@@ -273,6 +282,28 @@ def profile_query(session, root, ctx, action: str, handle=None):
             w.emit("xla_compile",
                    **{k: round(x1[k] - xla0.get(k, 0), 6)
                       for k in x1})
+            if rc_on:
+                rc1 = result_cache.stats()
+                w.emit("result_cache",
+                       hits=rc1["result_cache_hits"]
+                       - rc0["result_cache_hits"],
+                       misses=rc1["result_cache_misses"]
+                       - rc0["result_cache_misses"],
+                       fragment_hits=rc1["result_cache_fragment_hits"]
+                       - rc0["result_cache_fragment_hits"],
+                       fragment_misses=rc1[
+                           "result_cache_fragment_misses"]
+                       - rc0["result_cache_fragment_misses"],
+                       stores=rc1["result_cache_stores"]
+                       + rc1["result_cache_fragment_stores"]
+                       - rc0["result_cache_stores"]
+                       - rc0["result_cache_fragment_stores"],
+                       evictions=rc1["result_cache_evictions"]
+                       - rc0["result_cache_evictions"],
+                       invalidations=rc1["result_cache_invalidations"]
+                       - rc0["result_cache_invalidations"],
+                       entries=rc1["result_cache_entries"],
+                       bytes=rc1["result_cache_bytes"])
             end = {"status": status,
                    "wall_s": round(time.perf_counter() - t0, 6)}
             if err is not None:
@@ -280,3 +311,24 @@ def profile_query(session, root, ctx, action: str, handle=None):
             w.emit("query_end", **end)
         finally:
             w.close()
+
+
+def log_fast_path(session, conf, handle, action: str, rows: int,
+                  wall_s: float):
+    """Compact event log for a result-cache FAST-PATH hit: the query
+    never planned or executed, so the full profile_query sequence does
+    not apply — but a served query must still leave an auditable
+    record (query_start / result_cache / query_end)."""
+    w = open_query_log(conf, handle.query_id if handle is not None
+                       else next_query_id())
+    if w is None:
+        return
+    try:
+        if session is not None:
+            session.last_event_log = w.path
+        w.emit("query_start", action=action, fast_path=True)
+        w.emit("result_cache", hits=1, misses=0, fast_path=True,
+               rows=int(rows))
+        w.emit("query_end", status="ok", wall_s=round(wall_s, 6))
+    finally:
+        w.close()

@@ -175,9 +175,14 @@ def expr_fp(obj, _memo: Optional[dict] = None):
         for k, v in sorted(vars(obj).items()):
             # skip obvious runtime attachments (jitted wrappers,
             # lore/op ids assigned post-construction don't change
-            # semantics and would split the key per instance)
-            if k.startswith("_jit") or k in ("_op_id", "lore_id",
-                                             "_cached"):
+            # semantics and would split the key per instance).
+            # Private `_*_cache` attrs are derived memos by convention
+            # (_ndv_cache, _est_rows_cache, ...): planning another
+            # query lazily sets them on shared plan nodes, which would
+            # destabilize every later fingerprint of those nodes.
+            if k.startswith("_jit") \
+                    or (k.startswith("_") and k.endswith("_cache")) \
+                    or k in ("_op_id", "lore_id", "_cached"):
                 continue
             parts.append((k, expr_fp(v, _memo)))
         fp = tuple(parts)

@@ -1,0 +1,619 @@
+"""Process-global cross-query result & fragment cache: execute once,
+serve many.
+
+The service (PR 7) admits and schedules queries; dashboard traffic is
+overwhelmingly REPEATED queries over slowly-changing tables. The
+program cache (PR 6) made "compile once, run many" real; this module
+makes "execute once, serve many" real, in two tiers under one
+byte-budgeted LRU:
+
+- **query tier** — whole-query Arrow results, keyed on the
+  name/gensym-blind structural fingerprint of the LOGICAL plan
+  (program_cache.expr_fp: join-rename gensyms normalized, underscore
+  state skipped) composed with the per-query conf snapshot and the
+  backend. A hit is served on the service FAST PATH: no admission
+  slot, no planning, no execution — still metered
+  (QueryManager.stats["cache_fast_path"]) and still event-logged
+  (`result_cache` record).
+- **fragment tier** — materialized exchange map outputs (host Arrow,
+  one table per reduce partition + the partition-stats vector), keyed
+  on the exchange subtree's `plan/reuse.node_fp` fingerprint. The
+  planner consults this tier AFTER the exchange-reuse pass: a hit
+  substitutes a `CachedFragmentExec` source (ReusedExchangeExec-style
+  delegation shape), eliding the whole map phase; a miss tags the
+  exchange so a successful run harvests its output for next time.
+
+**Invalidation** is carried by the keys themselves: every scan binds a
+snapshot (path, mtime_ns, size / Delta version — plan/logical.py,
+io/snapshot.py) that flows into both fingerprints, so a table write
+changes every dependent key and the stale entries simply become
+unreachable (the LRU ages them out). Writes through the engine
+(io/parquet.py, io/delta.py) additionally drop intersecting entries
+eagerly via `invalidate_paths`, and `DataFrame.uncache()` drops the
+plan's query-tier entries via `invalidate_plan` so "fresh execution"
+stays honest.
+
+**Memory discipline**: entry bytes charge the host-memory budget
+(memory/host.py) via try_reserve, the cache registers a pressure hook
+that evicts LRU entries first when OTHER consumers hit the budget,
+and an internal byte cap (sql.cache.maxBytes) bounds the cache even
+with no host budget configured. All mutation happens under a
+lockdep-witnessed lock (runtime/lockdep.py) so the PR 9 concurrency
+auditor covers the cache for the whole tier-1 suite.
+
+Off by default (`spark.rapids.tpu.sql.cache.enabled`): repeat-heavy
+serving opts in per session, the Spark/Presto result-cache posture.
+"""
+from __future__ import annotations
+
+import weakref
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from . import lockdep
+
+__all__ = [
+    "enabled", "fragments_enabled", "lookup_query", "put_query",
+    "substitute_fragments", "harvest_fragments", "invalidate_paths",
+    "invalidate_prefix", "invalidate_plan", "stats", "clear",
+    "set_host_manager",
+    "CachedFragmentExec",
+]
+
+# ---------------------------------------------------------------------
+# state — every access under _lock (lockdep-witnessed when enabled)
+
+_lock = lockdep.lock("ResultCache._lock")
+_entries: "OrderedDict[tuple, _Entry]" = OrderedDict()  # LRU: MRU last
+_by_path: Dict[str, set] = {}        # data-file path -> {keys}
+_by_plan: Dict[tuple, set] = {}      # logical plan fp -> {query keys}
+_bytes = 0                           # sum of entry nbytes
+_stats = {
+    "result_cache_hits": 0,
+    "result_cache_misses": 0,
+    "result_cache_fragment_hits": 0,
+    "result_cache_fragment_misses": 0,
+    "result_cache_stores": 0,
+    "result_cache_fragment_stores": 0,
+    "result_cache_evictions": 0,
+    "result_cache_invalidations": 0,
+    "result_cache_rejected": 0,
+}
+# host managers that already carry our pressure hook (the global
+# singleton plus any test-injected private manager)
+_hooked: "weakref.WeakSet" = weakref.WeakSet()
+# test hook: a PRIVATE HostMemoryManager so budget tests never mutate
+# the process singleton's budget (that would poison later tests)
+_host_override = None
+
+
+class _Entry:
+    __slots__ = ("value", "nbytes", "tier", "paths", "plan_fp", "mgr")
+
+    def __init__(self, value, nbytes: int, tier: str,
+                 paths: Tuple[str, ...], plan_fp=None, mgr=None):
+        self.value = value        # pa.Table | _Fragment
+        self.nbytes = nbytes
+        self.tier = tier          # "query" | "fragment"
+        self.paths = paths
+        self.plan_fp = plan_fp    # query tier only
+        self.mgr = mgr            # host manager charged, if any
+
+
+class _Fragment:
+    """A cached exchange map output: per-reduce-partition host Arrow
+    tables (None = empty partition, matching reduce_batch's None) and
+    the serialized-bytes partition-stats vector AQE planning reads."""
+    __slots__ = ("tables", "pstats", "nparts")
+
+    def __init__(self, tables: List, pstats: List[int]):
+        self.tables = tables
+        self.pstats = list(pstats)
+        self.nparts = len(tables)
+
+
+# ---------------------------------------------------------------------
+# conf accessors
+
+def enabled(conf) -> bool:
+    from ..config import RESULT_CACHE_ENABLED
+    return bool(conf.get(RESULT_CACHE_ENABLED))
+
+
+def fragments_enabled(conf) -> bool:
+    from ..config import RESULT_CACHE_FRAGMENTS
+    return bool(conf.get(RESULT_CACHE_FRAGMENTS))
+
+
+def _max_bytes(conf) -> int:
+    from ..config import RESULT_CACHE_MAX_BYTES
+    return int(conf.get(RESULT_CACHE_MAX_BYTES))
+
+
+def _max_entry_bytes(conf) -> int:
+    from ..config import RESULT_CACHE_MAX_ENTRY_BYTES
+    return int(conf.get(RESULT_CACHE_MAX_ENTRY_BYTES))
+
+
+# ---------------------------------------------------------------------
+# keys
+
+def _backend() -> str:
+    try:
+        import jax
+        return jax.default_backend()
+    except Exception:
+        return "?"
+
+
+def _conf_fp(conf) -> tuple:
+    # the FULL conf snapshot: partition counts, batch sizes, broadcast
+    # thresholds etc. all change row order or typing of results, and
+    # byte-identity to fresh execution is the acceptance bar —
+    # conservative splitting beats a subtly shared wrong answer
+    return tuple(sorted((k, repr(v))
+                        for k, v in conf._settings.items()))
+
+
+def _plan_paths(plan) -> Tuple[str, ...]:
+    """Every data-file path a logical (or physical) tree scans."""
+    out, stack, seen = [], [plan], set()
+    while stack:
+        n = stack.pop()
+        if id(n) in seen:
+            continue
+        seen.add(id(n))
+        if getattr(n, "snapshot", None) is not None:
+            out.extend(getattr(n, "paths", ()) or ())
+        stack.extend(getattr(n, "children", ()) or ())
+        t = getattr(n, "target", None)   # ReusedExchangeExec delegation
+        if t is not None and hasattr(t, "children"):
+            stack.append(t)
+    return tuple(out)
+
+
+def _query_key(plan, conf):
+    from .program_cache import expr_fp
+    pfp = expr_fp(plan)
+    return ("q", pfp, _conf_fp(conf), _backend()), pfp, _plan_paths(plan)
+
+
+# ---------------------------------------------------------------------
+# core LRU under _lock
+
+def _unindex_locked(key, e: _Entry):
+    global _bytes
+    _bytes -= e.nbytes
+    for p in e.paths:
+        s = _by_path.get(p)
+        if s is not None:
+            s.discard(key)
+            if not s:
+                del _by_path[p]
+    if e.plan_fp is not None:
+        s = _by_plan.get(e.plan_fp)
+        if s is not None:
+            s.discard(key)
+            if not s:
+                del _by_plan[e.plan_fp]
+
+
+def _release_host(dropped: List[_Entry]):
+    """Return host-budget reservations AFTER _lock is dropped (keeps
+    the ResultCache -> HostMemoryManager lock order one-way)."""
+    for e in dropped:
+        if e.mgr is not None:
+            try:
+                e.mgr.release(e.nbytes)
+            except Exception:
+                pass
+
+
+def _host_mgr(conf):
+    if _host_override is not None:
+        return _host_override
+    from ..memory.host import host_manager
+    return host_manager(conf)
+
+
+def _pressure_hook(bytes_needed: int) -> int:
+    """Host-memory pressure: evict LRU entries first. Registered on
+    every manager the cache charges; called by HostMemoryManager.reserve
+    outside its own lock."""
+    dropped, freed = [], 0
+    with _lock:
+        while _entries and freed < bytes_needed:
+            key, e = _entries.popitem(last=False)
+            _unindex_locked(key, e)
+            _stats["result_cache_evictions"] += 1
+            freed += e.nbytes
+            dropped.append(e)
+    _release_host(dropped)
+    return freed
+
+
+def _store(key, entry: _Entry, conf):
+    """Insert under the byte budget: evict LRU past sql.cache.maxBytes,
+    charge the host budget, reject when the host refuses even after
+    making room."""
+    global _bytes
+    cap = _max_bytes(conf)
+    if entry.nbytes > min(cap, _max_entry_bytes(conf)):
+        with _lock:
+            _stats["result_cache_rejected"] += 1
+        return False
+    mgr = _host_mgr(conf)
+    if mgr is not None:
+        if mgr not in _hooked:
+            mgr.register_pressure_hook(_pressure_hook)  # idempotent
+            _hooked.add(mgr)
+        if not mgr.try_reserve(entry.nbytes):
+            # make room with our own LRU, then retry once
+            _pressure_hook(entry.nbytes)
+            if not mgr.try_reserve(entry.nbytes):
+                with _lock:
+                    _stats["result_cache_rejected"] += 1
+                return False
+        entry.mgr = mgr
+    dropped = []
+    with _lock:
+        old = _entries.pop(key, None)
+        if old is not None:
+            _unindex_locked(key, old)
+            dropped.append(old)
+        while _entries and _bytes + entry.nbytes > cap:
+            k2, e2 = _entries.popitem(last=False)
+            _unindex_locked(k2, e2)
+            _stats["result_cache_evictions"] += 1
+            dropped.append(e2)
+        _entries[key] = entry
+        _bytes += entry.nbytes
+        for p in entry.paths:
+            _by_path.setdefault(p, set()).add(key)
+        if entry.plan_fp is not None:
+            _by_plan.setdefault(entry.plan_fp, set()).add(key)
+        _stats["result_cache_stores" if entry.tier == "query"
+               else "result_cache_fragment_stores"] += 1
+    _release_host(dropped)
+    return True
+
+
+def _get(key, tier: str) -> Optional[_Entry]:
+    hk = ("result_cache_hits" if tier == "query"
+          else "result_cache_fragment_hits")
+    mk = ("result_cache_misses" if tier == "query"
+          else "result_cache_fragment_misses")
+    with _lock:
+        e = _entries.get(key)
+        if e is None:
+            _stats[mk] += 1
+            return None
+        _entries.move_to_end(key)
+        _stats[hk] += 1
+        return e
+
+
+# ---------------------------------------------------------------------
+# query tier
+
+def lookup_query(plan, conf):
+    """Consult the whole-query tier for a collect over `plan`. Returns
+    (arrow_table | None, token): the token carries the key + paths for
+    `put_query` after a miss executes; (None, None) when disabled.
+    Refreshes scan snapshots first, so an external overwrite makes the
+    old key unreachable (and eagerly drops entries over the changed
+    paths)."""
+    if not enabled(conf):
+        return None, None
+    from ..io.snapshot import refresh_plan_snapshots
+    changed = refresh_plan_snapshots(plan)
+    if changed:
+        invalidate_paths(changed)
+    key, pfp, paths = _query_key(plan, conf)
+    e = _get(key, "query")
+    token = (key, pfp, paths)
+    return (e.value if e is not None else None), token
+
+
+def put_query(token, value, conf) -> bool:
+    """Store a collect result (pa.Table) after a miss executed."""
+    if token is None or value is None:
+        return False
+    try:
+        nbytes = int(value.get_total_buffer_size())
+    except Exception:
+        return False
+    key, pfp, paths = token
+    return _store(key, _Entry(value, nbytes, "query", paths,
+                              plan_fp=pfp), conf)
+
+
+# ---------------------------------------------------------------------
+# fragment tier — planner substitution + post-run harvest
+
+class CachedFragmentExec:
+    """A fragment-tier hit: serves a previously materialized exchange
+    map output as a source node (the cached analog of
+    ReusedExchangeExec). Implements the exchange consumer surface —
+    num_partitions / stage_stats / read_slice / execute_partition — by
+    re-hydrating the stored host Arrow tables into device batches, so
+    shuffle readers and AQE planning work unchanged."""
+
+    fusion_opt_out = True
+    fuses_child_chain = False
+    fusion_require_ordinals = False
+
+    def __init__(self, entry: _Entry, original):
+        frag: _Fragment = entry.value
+        self.children: list = []
+        self._schema = original.schema
+        self._op_id = f"CachedFragmentExec@{id(self):x}"
+        self.lore_id = getattr(original, "lore_id", None)
+        self._frag = frag
+        self._hit_lock = lockdep.lock("CachedFragmentExec._hit_lock")
+        self._hit_ctxs: set = set()
+
+    @property
+    def schema(self):
+        return self._schema
+
+    def _count_hit(self, ctx):
+        with self._hit_lock:
+            if id(ctx) in self._hit_ctxs or len(self._hit_ctxs) >= 64:
+                return
+            self._hit_ctxs.add(id(ctx))
+        ctx.metrics_for(self._op_id).add("resultCacheFragmentHits", 1)
+
+    def num_partitions(self, ctx) -> int:
+        return self._frag.nparts
+
+    def stage_stats(self, ctx) -> List[int]:
+        self._count_hit(ctx)
+        return list(self._frag.pstats)
+
+    def read_slice(self, ctx, rpid: int, chunk: int = 0,
+                   nchunks: int = 1):
+        self._count_hit(ctx)
+        at = self._frag.tables[rpid]
+        if at is None:
+            return None
+        if nchunks > 1:
+            per = -(-at.num_rows // nchunks)
+            at = at.slice(chunk * per, per)
+        if at.num_rows == 0 and len(at.columns) > 0:
+            return None
+        from ..columnar.table import Table
+        from ..exec.batch import DeviceBatch
+        m = ctx.metrics_for(self._op_id)
+        with m.timer("fetchAndMergeTime"):
+            tbl = Table.from_arrow(at)
+        m.add("numOutputRows", at.num_rows)
+        m.add("numOutputBatches", 1)
+        return DeviceBatch(tbl, num_rows=at.num_rows)
+
+    def execute_partition(self, ctx, pid: int):
+        b = self.read_slice(ctx, pid)
+        if b is not None:
+            yield b
+
+    def execute_all(self, ctx):
+        for pid in range(self.num_partitions(ctx)):
+            for b in self.execute_partition(ctx, pid):
+                ctx.check_cancel()
+                yield b
+
+    def release(self):
+        """The cache owns the Arrow tables; nothing to free here."""
+
+    def fusable_stage(self):
+        return None
+
+    def preserves_ordinals(self) -> bool:
+        return True
+
+    def stage_fingerprint(self) -> tuple:
+        return ("inst", id(self))
+
+    def node_name(self) -> str:
+        return "CachedFragmentExec"
+
+    def describe(self) -> str:
+        return (f"CachedFragmentExec[{self._frag.nparts} partitions, "
+                f"{sum(self._frag.pstats)} bytes]")
+
+    def tree_string(self, indent: int = 0) -> str:
+        return "  " * indent + self.describe() + "\n"
+
+
+def _fragment_key(node, conf_fp, backend):
+    from ..plan.reuse import node_fp
+    fp = node_fp(node)
+    if fp is None:
+        return None
+    return ("f", fp, conf_fp, backend)
+
+
+def substitute_fragments(root, conf):
+    """Planner pass (after exchange reuse): replace shuffle exchanges
+    whose subtree fingerprint has a cached map output with
+    CachedFragmentExec, rewiring ReusedExchangeExec targets and
+    AqeShufflePlan.exchanges references the same way the reuse pass
+    does. Misses tag the exchange (`_frag_key`, underscore = excluded
+    from fingerprints) for harvest after a successful run. Returns
+    (root, hits)."""
+    if not (enabled(conf) and fragments_enabled(conf)):
+        return root, 0
+    from ..exec.aqe import AqeShufflePlan
+    from ..exec.exchange import ShuffleExchangeExec
+    from ..plan.reuse import ReusedExchangeExec
+    cfp = _conf_fp(conf)
+    backend = _backend()
+    replaced: Dict[int, CachedFragmentExec] = {}
+    hits = 0
+
+    def walk(node):
+        nonlocal hits
+        for i, c in enumerate(node.children):
+            node.children[i] = walk(c)
+        p = getattr(node, "plan", None)
+        if isinstance(p, AqeShufflePlan):
+            p.exchanges = [replaced.get(id(e), e) for e in p.exchanges]
+        if isinstance(node, ReusedExchangeExec):
+            node.target = replaced.get(id(node.target), node.target)
+            return node
+        if isinstance(node, ShuffleExchangeExec):
+            key = _fragment_key(node, cfp, backend)
+            if key is None:
+                return node
+            e = _get(key, "fragment")
+            if e is not None:
+                r = CachedFragmentExec(e, node)
+                replaced[id(node)] = r
+                hits += 1
+                return r
+            node._frag_key = key
+        return node
+
+    root = walk(root)
+    return root, hits
+
+
+def harvest_fragments(root, ctx) -> int:
+    """After a successful action: store the map outputs of exchanges
+    the planner tagged on a fragment miss AND that actually
+    materialized during this run. Reads each reduce partition back
+    through the exchange's own read_slice (one D2H per partition, paid
+    once per distinct fragment) into host Arrow. Returns stores."""
+    conf = ctx.conf
+    if not (enabled(conf) and fragments_enabled(conf)):
+        return 0
+    from ..exec.nodes import _batch_to_arrow
+    stored = 0
+    stack, seen = [root], set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.extend(getattr(node, "children", ()) or ())
+        t = getattr(node, "target", None)
+        if t is not None and hasattr(t, "children"):
+            stack.append(t)
+        key = getattr(node, "_frag_key", None)
+        if key is None or getattr(node, "_shuffle", None) is None:
+            continue
+        with _lock:
+            if key in _entries:
+                continue
+        pstats = getattr(node, "_pstats", None)
+        if pstats is None:
+            continue
+        est = sum(pstats)
+        if est > _max_entry_bytes(conf):
+            continue
+        try:
+            tables = []
+            for rpid in range(node.num_partitions(ctx)):
+                b = node.read_slice(ctx, rpid)
+                tables.append(None if b is None else _batch_to_arrow(b))
+        except Exception:
+            continue          # advisory: never fail the query
+        nbytes = sum(int(t.get_total_buffer_size())
+                     for t in tables if t is not None)
+        frag = _Fragment(tables, pstats)
+        if _store(key, _Entry(frag, nbytes, "fragment",
+                              _plan_paths(node)), conf):
+            stored += 1
+    return stored
+
+
+# ---------------------------------------------------------------------
+# invalidation
+
+def invalidate_paths(paths) -> int:
+    """Drop every entry that scans any of `paths` (called by the write
+    paths — parquet overwrite, Delta commit — and by the snapshot
+    refresh when it observes an external change). Returns drops."""
+    dropped = []
+    with _lock:
+        keys = set()
+        for p in paths:
+            keys |= _by_path.get(p, set())
+        for key in keys:
+            e = _entries.pop(key, None)
+            if e is not None:
+                _unindex_locked(key, e)
+                dropped.append(e)
+        if dropped:
+            _stats["result_cache_invalidations"] += len(dropped)
+    _release_host(dropped)
+    return len(dropped)
+
+
+def invalidate_prefix(prefix: str) -> int:
+    """Drop every entry scanning a file under `prefix` (a table
+    directory — the Delta/parquet writers know the root, not which
+    scans read which data files)."""
+    with _lock:
+        paths = [p for p in _by_path if p.startswith(prefix)]
+    return invalidate_paths(paths) if paths else 0
+
+
+def invalidate_plan(plan, conf=None) -> int:
+    """Drop the query-tier entries for `plan` under ANY conf — the
+    `DataFrame.uncache()` interplay: uncache promises the next action
+    is a fresh execution, so the cache must not answer it."""
+    try:
+        from .program_cache import expr_fp
+        pfp = expr_fp(plan)
+    except Exception:
+        return 0
+    dropped = []
+    with _lock:
+        for key in list(_by_plan.get(pfp, ())):
+            e = _entries.pop(key, None)
+            if e is not None:
+                _unindex_locked(key, e)
+                dropped.append(e)
+        if dropped:
+            _stats["result_cache_invalidations"] += len(dropped)
+    _release_host(dropped)
+    return len(dropped)
+
+
+# ---------------------------------------------------------------------
+# introspection / lifecycle
+
+def stats() -> dict:
+    with _lock:
+        out = dict(_stats)
+        out["result_cache_entries"] = len(_entries)
+        out["result_cache_bytes"] = _bytes
+    return out
+
+
+def clear():
+    """Drop everything, release host reservations, zero the counters,
+    and reset the test host-manager override (tests/conftest.py calls
+    this at module boundaries, program-cache precedent)."""
+    global _host_override
+    with _lock:
+        dropped = list(_entries.values())
+        keys = list(_entries.keys())
+        for key, e in zip(keys, dropped):
+            _unindex_locked(key, e)
+        _entries.clear()
+        _by_path.clear()
+        _by_plan.clear()
+        for k in _stats:
+            _stats[k] = 0
+    _release_host(dropped)
+    _host_override = None
+
+
+def set_host_manager(mgr):
+    """Test hook: charge cache bytes against a PRIVATE
+    HostMemoryManager instead of the process singleton (whose budget
+    must never be mutated by a test). clear() resets it."""
+    global _host_override
+    _host_override = mgr

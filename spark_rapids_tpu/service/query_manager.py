@@ -217,7 +217,7 @@ class QueryManager:
         self._queries = {}  # query_id -> handle (bounded: pruned on close)
         self.stats = {"submitted": 0, "admitted": 0, "finished": 0,
                       "failed": 0, "cancelled": 0, "timed_out": 0,
-                      "queued_peak": 0}
+                      "queued_peak": 0, "cache_fast_path": 0}
 
     # -- submission -----------------------------------------------------
     def _new_handle(self, plan=None, conf=None, action: str = "",
@@ -309,6 +309,25 @@ class QueryManager:
                 except QueryCancelled as e:
                     self._finalize(h, error=e)
                     raise
+
+    def fast_path(self, plan=None, conf=None, action: str = "",
+                  pool: Optional[str] = None, result=None) -> QueryHandle:
+        """Answer a query from the result cache WITHOUT consuming an
+        admission slot: no enqueue, no scheduler offer, no wait — the
+        whole point of the cache fast path is that a hit must not sit
+        behind admitted queries. Still metered: the handle counts in
+        submitted/finished plus the cache_fast_path counter, and the
+        caller still event-logs it (result_cache record)."""
+        h = self._new_handle(plan, conf, action, pool, None,
+                             estimate=(0, 0))
+        with self._cond:
+            self._seq += 1
+            h._seq = self._seq
+            self.stats["submitted"] += 1
+            self.stats["cache_fast_path"] += 1
+        h.admitted_at = h.submitted_at        # zero queue wait
+        self._finalize(h, result=result)      # admitted=False: no slot
+        return h
 
     # -- completion -----------------------------------------------------
     def close_query(self, h: QueryHandle, result=None, error=None):

@@ -726,6 +726,13 @@ class DataFrame:
             except Exception:
                 pass
             self._cached = None
+        # uncache promises the NEXT action is a fresh execution — the
+        # cross-query result cache must not answer it from a prior run
+        try:
+            from .runtime import result_cache
+            result_cache.invalidate_plan(self._plan)
+        except Exception:
+            pass
         return self
 
     # -- actions --------------------------------------------------------
@@ -740,6 +747,22 @@ class DataFrame:
         # session conf mutated mid-flight.
         if conf is None:
             conf = self._session.conf
+        # scan-snapshot staleness: re-stat every pinned data file; a
+        # mid-session overwrite drops the cached physical plan (replan
+        # rebinds against the new files) and invalidates dependent
+        # result-cache entries — stale bytes are never served, cache on
+        # or off (io/snapshot.py)
+        from .io.snapshot import refresh_plan_snapshots
+        changed = refresh_plan_snapshots(self._plan)
+        if changed:
+            from .runtime import result_cache
+            result_cache.invalidate_paths(changed)
+            if self._cached is not None:
+                try:
+                    self._cached[1].release()
+                except Exception:
+                    pass
+                self._cached = None
         if self._cached is not None and self._cached[0] is conf:
             root = self._cached[1]
         else:
@@ -756,18 +779,41 @@ class DataFrame:
         query_start/.../query_end events when sql.eventLog.enabled).
         Runs on the CALLER's thread once admitted; `DataFrame.submit`
         is the async counterpart."""
+        import time as _time
+        from .runtime import result_cache
+        conf = self._session.conf  # per-query conf snapshot
         outer = getattr(_ACTION_TLS, "handle", None)
+        # whole-query tier of the cross-query result cache: collects
+        # consult it BEFORE admission — a hit is served on the service
+        # fast path (no slot consumed, still metered + event-logged)
+        token = None
+        if action == "collect" and result_cache.enabled(conf):
+            t0 = _time.perf_counter()
+            hit, token = result_cache.lookup_query(self._plan, conf)
+            if hit is not None:
+                if outer is None:
+                    mgr = self._session.query_manager()
+                    handle = mgr.fast_path(plan=self._plan, conf=conf,
+                                           action=action, result=hit)
+                    from .profiler.event_log import log_fast_path
+                    log_fast_path(self._session, conf, handle, action,
+                                  hit.num_rows,
+                                  _time.perf_counter() - t0)
+                self._last_metrics = {"ResultCache": {
+                    "resultCacheHits": 1,
+                    "numOutputRows": hit.num_rows}}
+                return hit
         if outer is not None:
             # nested action (subquery collected inside a parent query):
             # ride the outer grant + token, skip re-admission
-            return self._execute_action(action, body, self._session.conf,
-                                        outer, nested=True)
-        from .service.query_manager import QueryCancelled
+            return self._execute_action(action, body, conf,
+                                        outer, nested=True,
+                                        cache_token=token)
         mgr = self._session.query_manager()
-        conf = self._session.conf  # per-query conf snapshot
         handle = mgr.open_query(plan=self._plan, conf=conf, action=action)
         try:
-            out = self._execute_action(action, body, conf, handle)
+            out = self._execute_action(action, body, conf, handle,
+                                       cache_token=token)
         except BaseException as e:
             mgr.close_query(handle, error=e)
             raise
@@ -780,20 +826,37 @@ class DataFrame:
         re-raises). The gateway and the throughput bench submit here."""
         if action != "collect":
             raise ValueError("submit() supports the 'collect' action")
+        import time as _time
         from .exec.nodes import collect_to_arrow as _collect
+        from .runtime import result_cache
         mgr = self._session.query_manager()
         conf = self._session.conf
+
+        token = None
+        if result_cache.enabled(conf):
+            t0 = _time.perf_counter()
+            hit, token = result_cache.lookup_query(self._plan, conf)
+            if hit is not None:
+                # cache fast path: answered without an admission slot;
+                # handle.result() returns immediately
+                handle = mgr.fast_path(plan=self._plan, conf=conf,
+                                       action="collect", pool=pool,
+                                       result=hit)
+                from .profiler.event_log import log_fast_path
+                log_fast_path(self._session, conf, handle, "collect",
+                              hit.num_rows, _time.perf_counter() - t0)
+                return handle
 
         def run(handle):
             return self._execute_action(
                 "collect", lambda root, ctx: _collect(root, ctx),
-                conf, handle)
+                conf, handle, cache_token=token)
 
         return mgr.submit(run, plan=self._plan, conf=conf,
                           action="collect", pool=pool, timeout=timeout)
 
     def _execute_action(self, action: str, body, conf, handle,
-                        nested: bool = False):
+                        nested: bool = False, cache_token=None):
         """The admitted half of an action: plan (or reuse the cached
         physical tree), execute under the profiler wrapper, then attach
         the per-query XLA/semaphore/queue-wait accounting to the root
@@ -816,12 +879,27 @@ class DataFrame:
         xla0 = xla_stats.snapshot()
         _ACTION_TLS.handle = handle if not nested else \
             getattr(_ACTION_TLS, "handle", None)
+        from .runtime import result_cache
+        rc_on = result_cache.enabled(conf)
+        rc0 = result_cache.stats() if rc_on else None
         try:
             with _query_scope(handle.query_id if handle else "?"):
                 with profile_query(self._session, root, ctx, action,
                                    handle=None if nested else handle):
                     try:
                         out = body(root, ctx)
+                        if rc_on:
+                            # a successful run feeds BOTH cache tiers:
+                            # tagged exchange map outputs (fragment
+                            # misses from planning) and, for collects,
+                            # the whole-query arrow result
+                            try:
+                                result_cache.harvest_fragments(root, ctx)
+                            except Exception:
+                                pass
+                            if cache_token is not None:
+                                result_cache.put_query(cache_token, out,
+                                                       conf)
                     finally:
                         ctx.close()
         except BaseException:
@@ -850,6 +928,27 @@ class DataFrame:
                    - xla0.get("program_cache_misses", 0)))
         if handle is not None and not nested:
             rm.add("queueWaitMs", round(handle.queue_wait_ms, 3))
+        if rc_on:
+            # per-action cache accounting on the root MetricSet (flows
+            # into EXPLAIN ANALYZE / op_metrics); global-counter diffs,
+            # so concurrent queries' events can interleave — counters,
+            # not invariants
+            rc1 = result_cache.stats()
+            for metric, counter in (
+                    ("resultCacheHits", "result_cache_hits"),
+                    ("resultCacheMisses", "result_cache_misses"),
+                    ("resultCacheFragmentHits",
+                     "result_cache_fragment_hits"),
+                    ("resultCacheEvictions", "result_cache_evictions"),
+                    ("resultCacheInvalidationEvents",
+                     "result_cache_invalidations")):
+                d = int(rc1[counter] - rc0[counter])
+                if d:
+                    rm.add(metric, d)
+            if cache_token is not None:
+                # this action's own whole-query lookup missed (it was
+                # counted in _run_action, before the rc0 snapshot)
+                rm.add("resultCacheMisses", 1)
         sem = getattr(self._session, "_semaphore", None)
         if sem is not None:
             acq = sem.metrics["acquires"] - sem_acq0

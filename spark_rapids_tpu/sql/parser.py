@@ -38,7 +38,7 @@ _KEYWORDS = {"select", "from", "where", "group", "by", "order", "limit",
              "case", "when", "then", "else", "end", "cast", "join",
              "inner", "left", "right", "full", "outer", "on", "using",
              "asc", "desc", "distinct", "like", "true", "false", "semi",
-             "anti", "cross", "having", "exists"}
+             "anti", "cross", "having", "exists", "with"}
 
 _TYPES = {"int": dt.INT32, "integer": dt.INT32, "bigint": dt.INT64,
           "long": dt.INT64, "smallint": dt.INT16, "tinyint": dt.INT8,
@@ -944,6 +944,53 @@ def parse_sql(session, sql: str):
                          L.InMemoryScan(pa.table({"plan": [text or ""]})))
 
     p = _Parser(_tokenize(sql), session=session)
+    undo_ctes = _parse_ctes(p, session)
+    try:
+        return _finish_select(p, session)
+    finally:
+        undo_ctes()
+
+
+_CTE_ABSENT = object()
+
+
+def _parse_ctes(p: "_Parser", session):
+    """WITH name AS (subquery) [, ...]: each CTE materializes as a
+    statement-scoped view — later CTEs and the main query resolve it by
+    name through session._views. Same-named session views are shadowed
+    for the statement and restored by the returned undo callable."""
+    if not p.accept("kw", "with"):
+        return lambda: None
+    if not hasattr(session, "_views"):
+        session._views = {}
+    views = session._views
+    shadowed = {}
+    while True:
+        nm = p.expect("id")[1].lower()
+        p.expect("kw", "as")
+        p.expect("op", "(")
+        info = p._subquery()
+        if info.corr:
+            raise UnsupportedExpr("correlated CTE")
+        p.expect("op", ")")
+        if nm not in shadowed:
+            shadowed[nm] = views.get(nm, _CTE_ABSENT)
+        views[nm] = _finalize_derived(session, info)
+        if not p.accept("op", ","):
+            break
+
+    def undo():
+        for name, old in shadowed.items():
+            if old is _CTE_ABSENT:
+                views.pop(name, None)
+            else:
+                views[name] = old
+    return undo
+
+
+def _finish_select(p: "_Parser", session):
+    from ..session import DataFrame
+    from ..plan import logical as L
     p.expect("kw", "select")
     distinct = bool(p.accept("kw", "distinct"))
     projs = p._select_list()

@@ -43,8 +43,12 @@ def _drop_program_cache_per_module():
     granularity while keeping cross-instance sharing within a module
     (which is what the cache tests assert)."""
     yield
-    from spark_rapids_tpu.runtime import program_cache
+    from spark_rapids_tpu.runtime import program_cache, result_cache
     program_cache.clear()
+    # cached Arrow results/fragments pin host bytes and index entries by
+    # on-disk paths; a module's tmp_path tables must not leak hits (or
+    # stale invalidation state) into the next module
+    result_cache.clear()
 
 
 @pytest.fixture(scope="session")

@@ -78,3 +78,80 @@ def test_sql_having(session):
     out = session.sql("SELECT k, sum(v) AS sv FROM h GROUP BY k "
                       "HAVING sum(v) > 10 ORDER BY k")
     assert out.collect() == [(1, 30), (3, 100)]
+
+
+# ---------------------------------------------------------------------
+# WITH (common table expressions)
+
+def test_sql_with_single_cte(session):
+    df = session.create_dataframe({"k": [1, 1, 2, 2, 3],
+                                   "v": [10, 20, 1, 2, 100]})
+    df.create_or_replace_temp_view("base")
+    out = session.sql(
+        "WITH sums AS (SELECT k, sum(v) AS sv FROM base GROUP BY k) "
+        "SELECT k, sv FROM sums WHERE sv > 10 ORDER BY k")
+    assert out.collect() == [(1, 30), (3, 100)]
+
+
+def test_sql_with_chained_ctes(session):
+    df = session.create_dataframe({"k": [1, 1, 2, 3],
+                                   "v": [5, 7, 11, 13]})
+    df.create_or_replace_temp_view("base2")
+    # the second CTE reads the first; the main query reads the second
+    out = session.sql(
+        "WITH s AS (SELECT k, sum(v) AS sv FROM base2 GROUP BY k), "
+        "     big AS (SELECT k, sv FROM s WHERE sv > 10) "
+        "SELECT k, sv FROM big ORDER BY k")
+    assert out.collect() == [(1, 12), (2, 11), (3, 13)]
+
+
+def test_sql_cte_shadows_then_restores_view(session):
+    session.create_dataframe(
+        {"x": [1, 2, 3]}).create_or_replace_temp_view("shad")
+    out = session.sql(
+        "WITH shad AS (SELECT x FROM shad WHERE x > 1) "
+        "SELECT x FROM shad ORDER BY x")
+    assert out.collect() == [(2,), (3,)]
+    # the statement-scoped CTE must not leak: the session view is back
+    out2 = session.sql("SELECT x FROM shad ORDER BY x")
+    assert out2.collect() == [(1,), (2,), (3,)]
+
+
+def test_sql_with_cte_tpch_q15(session):
+    """TPC-H q15 in its natural WITH form: the revenue view as a CTE +
+    a scalar max subquery over it, checked against the same pipeline
+    built through the DataFrame API."""
+    from spark_rapids_tpu import functions as F
+    from spark_rapids_tpu.expr.expressions import col
+    from spark_rapids_tpu.workloads import tpch
+    li = session.create_dataframe(
+        tpch.gen_lineitem(sf=0.002, seed=21, full=True))
+    sup = session.create_dataframe(tpch.gen_supplier(sf=0.01, seed=22))
+    li.create_or_replace_temp_view("lineitem")
+    sup.create_or_replace_temp_view("supplier")
+    out = session.sql(
+        "WITH revenue AS ("
+        "    SELECT l_suppkey AS supplier_no,"
+        "           sum(l_extendedprice * (1 - l_discount))"
+        "               AS total_revenue"
+        "    FROM lineitem GROUP BY l_suppkey) "
+        "SELECT s_suppkey, s_name, total_revenue "
+        "FROM supplier JOIN revenue ON s_suppkey = supplier_no "
+        "WHERE total_revenue = (SELECT max(total_revenue) FROM revenue) "
+        "ORDER BY s_suppkey").to_arrow()
+    revenue = (li.group_by("l_suppkey")
+               .agg(F.sum((col("l_extendedprice")
+                           * (1 - col("l_discount"))))
+                    .alias("total_revenue"))
+               .select(col("l_suppkey").alias("supplier_no"),
+                       col("total_revenue")))
+    mx = revenue.agg(F.max(col("total_revenue")).alias("mr"))
+    ref = (sup.join(revenue,
+                    on=col("s_suppkey") == col("supplier_no"))
+           .join(mx, how="cross")
+           .filter(col("total_revenue") == col("mr"))
+           .select(col("s_suppkey"), col("s_name"),
+                   col("total_revenue"))
+           .sort("s_suppkey").to_arrow())
+    assert out.num_rows == ref.num_rows and out.num_rows >= 1
+    assert out.to_pydict() == ref.to_pydict()

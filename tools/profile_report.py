@@ -163,6 +163,24 @@ def report(events: List[dict], top: int = 0) -> str:
                              f"{int(e.get('program_cache_evictions', 0))}"
                              f" evictions")
                 lines.append(line)
+            elif e["event"] == "result_cache" and (
+                    e.get("hits") or e.get("misses")
+                    or e.get("fragment_hits") or e.get("stores")):
+                line = (f"  result cache: {int(e.get('hits', 0))} hits / "
+                        f"{int(e.get('misses', 0))} misses, "
+                        f"{int(e.get('fragment_hits', 0))} fragment hits, "
+                        f"{int(e.get('stores', 0))} stores")
+                if e.get("fast_path"):
+                    line += " [fast path]"
+                if e.get("evictions") or e.get("invalidations"):
+                    line += (f"; {int(e.get('evictions', 0))} evictions, "
+                             f"{int(e.get('invalidations', 0))} "
+                             f"invalidation events")
+                if e.get("bytes") is not None:
+                    line += (f"; resident "
+                             f"{fmt_bytes(e.get('bytes', 0))} in "
+                             f"{int(e.get('entries', 0))} entries")
+                lines.append(line)
         lines.append("")
     return "\n".join(lines)
 
