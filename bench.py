@@ -465,6 +465,15 @@ def _main_impl():
             # filled in now so a budget-expiry partial flush still
             # carries it; refreshed after the concurrent tail below
             _partial["extra"]["lockdep"] = _lw.report()
+        # AQE replan counters accumulated by the sweep above (ISSUE 12):
+        # coalesced partitions, skew splits, join demotions, calibration
+        # hits — filled in now for partial flushes, refreshed after the
+        # concurrent tail so its replans count too
+        try:
+            from spark_rapids_tpu.plan.aqe import aqe_stats as _aqe_stats
+            _partial["extra"]["aqe"] = _aqe_stats()
+        except Exception as e:  # advisory: never lose the bench result
+            _partial["extra"]["aqe"] = {"error": repr(e)[:300]}
         # exchange-pipeline smoke (ISSUE 9): reuse dedup, q4 map-thread
         # speedup, serial/parallel/reused parity — before the
         # concurrent section so both share what budget remains
@@ -564,9 +573,16 @@ def _main_impl():
         _lw = _lockdep.witness()
         if _lw is not None:
             _partial["extra"]["lockdep"] = _lw.report()
+    if "aqe" in _partial["extra"]:
+        # refresh: the concurrent tail's replans should count too
+        try:
+            from spark_rapids_tpu.plan.aqe import aqe_stats as _aqe_stats
+            _partial["extra"]["aqe"] = _aqe_stats()
+        except Exception:
+            pass
     for k in ("scan_profile", "smoke", "fresh_rerun_compiles",
               "concurrent_2stream", "service", "exchange", "lockdep",
-              "result_cache"):
+              "result_cache", "aqe"):
         if k in _partial["extra"]:
             extra[k] = _partial["extra"][k]
     # ---- regression gate vs the previous round's JSON -------------------
@@ -584,7 +600,8 @@ def _main_impl():
             "q6_cold_s": extra.get("q6_cold_s"),
             "tpch_all22_geomean_s": tpch_all.get("tpch_all22_geomean_s"),
         }, fellback, {"q1_sf": sf_agg, "q3_sf": sf_join, "q6_sf": sf,
-                      "tpch_sf": tpch_all.get("tpch_all22_sf")})
+                      "tpch_sf": tpch_all.get("tpch_all22_sf")},
+            xla_per_query=tpch_all.get("tpch_xla_per_query"))
     except Exception as e:  # advisory: never lose the bench result
         regressions = []
         extra["regression_gate_error"] = repr(e)
@@ -1250,10 +1267,13 @@ def _scan_profile(st, sf: float) -> dict:
         shutil.rmtree(d, ignore_errors=True)
 
 
-def _regression_gate(current: dict, fellback: bool, sfs: dict):
+def _regression_gate(current: dict, fellback: bool, sfs: dict,
+                     xla_per_query: dict = None):
     """Compare engine-time metrics against the newest BENCH_r*.json that
     ran on the same backend class (fallback vs real). Returns a list of
-    human-readable regression strings for slips >15%."""
+    human-readable regression strings for slips >15%, plus per-query
+    XLA compile-count growth >1.5x (plan-shape churn shows up as
+    recompiles long before it shows up in wall time at small SF)."""
     import glob
     import re
     here = os.path.dirname(os.path.abspath(__file__))
@@ -1312,6 +1332,22 @@ def _regression_gate(current: dict, fellback: bool, sfs: dict):
         if ratio < 0.85:
             out.append(f"{k}: {cur:.4g} vs {old:.4g} in {name} "
                        f"({ratio:.2f}x)")
+    # per-query XLA compile counts: only comparable at the same sweep SF,
+    # and only above a noise floor (tiny plans recompile for benign
+    # reasons like a first-touch dtype specialization)
+    if xla_per_query and prev_sfs.get("tpch_sf") == sfs.get("tpch_sf"):
+        old_xla = extra.get("tpch_xla_per_query") or {}
+        for q in sorted(xla_per_query):
+            cur_rec = xla_per_query.get(q)
+            old_rec = old_xla.get(q)
+            if not isinstance(cur_rec, dict) or not isinstance(old_rec,
+                                                               dict):
+                continue
+            cc = int(cur_rec.get("compiles") or 0)
+            oc = int(old_rec.get("compiles") or 0)
+            if oc > 0 and cc >= 8 and cc > 1.5 * oc:
+                out.append(f"{q}: xla compiles {cc} vs {oc} in {name} "
+                           f"({cc / oc:.2f}x growth)")
     return out
 
 

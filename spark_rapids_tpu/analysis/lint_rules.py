@@ -511,8 +511,11 @@ def rule_ctx_cancel(ctx: _ModuleCtx):
     stop a query at sites that call `ctx.check_cancel()`, so a loop
     without one turns cancel/deadline into a no-op for that operator.
     Comprehension-shaped collectors are not flagged (they cannot host a
-    statement; their inner operators carry the checkpoints)."""
-    if not re.search(r"(^|/)exec/", ctx.path):
+    statement; their inner operators carry the checkpoints). Scope:
+    exec/ operators plus the AQE stage driver (plan/aqe.py), whose
+    replan loop sits between stage barriers and must stay
+    cancellable."""
+    if not re.search(r"(^|/)(exec/|plan/aqe\.py$)", ctx.path):
         return
 
     def pulls_batches(e) -> bool:
@@ -550,8 +553,9 @@ def rule_pool_cancel(ctx: _ModuleCtx):
     futures, so a worker that never calls `ctx.check_cancel()` (or a
     `check_cancel`-polling helper) keeps running map/build work to
     completion after the cancel — the pool drain blocks on it and the
-    query's resources stay pinned for the full phase."""
-    if not re.search(r"(^|/)exec/", ctx.path):
+    query's resources stay pinned for the full phase. Scope: exec/
+    operators plus the AQE stage driver (plan/aqe.py)."""
+    if not re.search(r"(^|/)(exec/|plan/aqe\.py$)", ctx.path):
         return
 
     submitted: Set[str] = set()

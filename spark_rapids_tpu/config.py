@@ -155,6 +155,30 @@ ADAPTIVE_SKEW_MIN_BYTES = _conf(
     "sql.adaptive.skewJoin.skewedPartitionThresholdInBytes",
     256 * 1024 * 1024,
     "Minimum bytes before a stream partition is considered skewed.", int)
+ADAPTIVE_COALESCE_ENABLED = _conf(
+    "sql.adaptive.coalescePartitions.enabled", True,
+    "AQE rule 1: merge small contiguous post-shuffle partitions toward "
+    "advisoryPartitionSizeInBytes at the stage boundary "
+    "(spark.sql.adaptive.coalescePartitions.enabled). Off: one task per "
+    "reduce partition.", bool)
+ADAPTIVE_SKEW_ENABLED = _conf(
+    "sql.adaptive.skewJoin.enabled", True,
+    "AQE rule 2: split join stream partitions exceeding "
+    "skewedPartitionFactor x median (and the byte threshold) into "
+    "row-balanced slices, each probing the full matching build "
+    "partition (spark.sql.adaptive.skewJoin.enabled).", bool)
+ADAPTIVE_DEMOTE_ENABLED = _conf(
+    "sql.adaptive.joinDemotion.enabled", True,
+    "AQE rule 3: when a shuffled hash join's build side materializes "
+    "under autoBroadcastJoinThreshold, rewrite the remaining stage to a "
+    "broadcast hash join and skip the stream-side shuffle entirely "
+    "(runtime inverse of Spark's DemoteBroadcastHashJoin).", bool)
+ADAPTIVE_CALIBRATION = _conf(
+    "sql.adaptive.calibration.enabled", True,
+    "Feed observed output cardinalities back into plan/stats.py as a "
+    "session-scoped calibration table keyed by structural plan "
+    "fingerprints, correcting CBO row estimates (join reorder) for "
+    "later plans of the same subtrees.", bool)
 SHUFFLE_COMPRESS = _conf(
     "shuffle.compression.codec", "lz4",
     "Shuffle wire compression: none|lz4|zstd (nvcomp analog, host-side).",

@@ -33,14 +33,19 @@ class AqeShufflePlan:
     (both join sides must re-plan identically — same key space)."""
 
     def __init__(self, exchanges, target_bytes: int, skew_factor: int,
-                 skew_min_bytes: int, allow_split: bool):
+                 skew_min_bytes: int, allow_split: bool,
+                 allow_coalesce: bool = True):
         self.exchanges = list(exchanges)
         self.target = max(1, target_bytes)
         self.skew_factor = skew_factor
         self.skew_min = skew_min_bytes
         self.allow_split = allow_split
+        self.allow_coalesce = allow_coalesce
         self._groups: Optional[List[List[Tuple[int, int, int]]]] = None
         self._lock = threading.Lock()
+        # decision record for the aqe_replan event / EXPLAIN ANALYZE,
+        # set the first (only) time groups() computes
+        self.decision: Optional[dict] = None
 
     def groups(self, ctx: ExecContext):
         """List of task groups; each group is [(rpid, chunk, nchunks)...].
@@ -64,6 +69,7 @@ class AqeShufflePlan:
             groups: List[List[Tuple[int, int, int]]] = []
             cur: List[Tuple[int, int, int]] = []
             cur_bytes = 0
+            skewed_rps, split_slices = 0, 0
             for rp in range(n):
                 sb = stream[rp]
                 if self.allow_split and sb > skew_cut and median > 0:
@@ -71,10 +77,13 @@ class AqeShufflePlan:
                         groups.append(cur)
                         cur, cur_bytes = [], 0
                     nchunks = max(2, -(-sb // self.target))
+                    skewed_rps += 1
+                    split_slices += nchunks
                     for c in range(nchunks):
                         groups.append([(rp, c, nchunks)])
                     continue
-                if cur and cur_bytes + sizes[rp] > self.target:
+                if cur and (not self.allow_coalesce
+                            or cur_bytes + sizes[rp] > self.target):
                     groups.append(cur)
                     cur, cur_bytes = [], 0
                 cur.append((rp, 0, 1))
@@ -84,6 +93,19 @@ class AqeShufflePlan:
             if not groups:
                 groups = [[(0, 0, 1)]]
             self._groups = groups
+            self.decision = {
+                "rule": "shuffle_read",
+                "exchange_lores": [getattr(ex, "lore_id", None)
+                                   for ex in self.exchanges],
+                "partitions_before": n,
+                "partitions_after": len(groups),
+                "coalesced_away": sum(len(g) - 1 for g in groups
+                                      if len(g) > 1),
+                "skewed_partitions": skewed_rps,
+                "split_slices": split_slices,
+                "median_bytes": int(median),
+                "skew_cut_bytes": int(skew_cut),
+                "target_bytes": int(self.target)}
             return groups
 
 
@@ -102,7 +124,18 @@ class AQEShuffleReadExec(TpuExec):
         self.role = role
 
     def describe(self):
-        return f"AQEShuffleReadExec[{self.role}]"
+        d = self.plan.decision
+        if d is None:
+            return f"AQEShuffleReadExec[{self.role}]"
+        parts = [self.role]
+        if d["partitions_after"] != d["partitions_before"] \
+                or d["coalesced_away"]:
+            parts.append(f"coalesced {d['partitions_before']}"
+                         f"→{d['partitions_after']}")
+        if d["split_slices"]:
+            parts.append(f"skewSplits={d['skewed_partitions']}"
+                         f"→{d['split_slices']}")
+        return f"AQEShuffleReadExec[{', '.join(parts)}]"
 
     def num_partitions(self, ctx: ExecContext):
         if getattr(ctx, "planning", False):
@@ -116,6 +149,14 @@ class AQEShuffleReadExec(TpuExec):
         group = self.plan.groups(ctx)[pid]
         ex = self.children[0]
         m = ctx.metrics_for(self._op_id)
+        d = self.plan.decision
+        if d is not None:
+            # idempotent (set, not add): every task writes the same
+            # replan summary, surfaced in EXPLAIN ANALYZE + op_metrics
+            m.set("aqePartitionsBefore", d["partitions_before"])
+            m.set("aqePartitionsAfter", d["partitions_after"])
+            if d["split_slices"]:
+                m.set("aqeSkewSplits", d["split_slices"])
         seen = set()
         for rpid, chunk, nchunks in group:
             if self.role == "build":

@@ -259,6 +259,14 @@ class ShuffleExchangeExec(TpuExec):
             # event log / EXPLAIN ANALYZE
             m.set("shuffleBytesWritten", sh.metrics["bytesWritten"])
             self._pstats = sh.partition_stats()
+            # exact per-reduce-partition byte distribution (write-time
+            # accumulated, shuffle/local.py) — the skew detector's
+            # input, surfaced in EXPLAIN ANALYZE and the event log
+            ordered = sorted(self._pstats)
+            m.set("shufflePartitionBytesMin", int(ordered[0]))
+            m.set("shufflePartitionBytesMedian",
+                  int(ordered[len(ordered) // 2]))
+            m.set("shufflePartitionBytesMax", int(ordered[-1]))
             self._shuffle = sh
 
     # ---- adaptive stage API (GpuCustomShuffleReaderExec inputs) --------

@@ -124,6 +124,13 @@ def _walk(meta, small: int):
 # model far above this bar.
 _REWRITE_MIN_RATIO = 12.0
 
+# AQE calibration only overrides the analytic model when the observed
+# cardinalities say the written-order estimate was off by at least this
+# factor. Measurements that CONFIRM the estimates add no information
+# the static CBO lacked — re-optimizing on them just swaps a known-good
+# order for cost-model noise and pays the recompile.
+_CALIBRATION_ERROR_FACTOR = 8.0
+
 
 class _Edge:
     """One equi-join conjunct between two relations of a flattened
@@ -213,9 +220,16 @@ def _edge_selectivities(edges: List[_Edge], stats) -> None:
         e.sel = 1.0 / max(ndv_a, ndv_b, 1.0)
 
 
-def _set_rows(s: frozenset, stats, edges) -> float:
+def _set_rows(s: frozenset, stats, edges, cal=None) -> float:
     """Order-independent cardinality of a joined relation set: product
-    of relation rows times the selectivity of every internal edge."""
+    of relation rows times the selectivity of every internal edge.
+    `cal` (subset -> observed rows or None) overrides the analytic
+    product with a cardinality an earlier execution of the same
+    relation set actually measured — the AQE calibration loop."""
+    if cal is not None:
+        observed = cal(s)
+        if observed is not None:
+            return observed
     rows = 1.0
     for i in s:
         rows *= stats[i].rows
@@ -225,7 +239,7 @@ def _set_rows(s: frozenset, stats, edges) -> float:
     return rows
 
 
-def _dp_order(n: int, stats, edges) -> List[int]:
+def _dp_order(n: int, stats, edges, cal=None) -> List[int]:
     """Selinger-style DP over left-deep orders: best (cost, order) per
     relation subset; extensions must stay connected (no cross products
     unless the chain itself is disconnected, which cannot happen — every
@@ -246,8 +260,8 @@ def _dp_order(n: int, stats, edges) -> List[int]:
                 if j in s or not (adj[j] & s):
                     continue
                 s2 = frozenset(s | {j})
-                rows = _set_rows(s2, stats, edges)
-                c2 = cost + _step_cost(_set_rows(s, stats, edges),
+                rows = _set_rows(s2, stats, edges, cal)
+                c2 = cost + _step_cost(_set_rows(s, stats, edges, cal),
                                        rows, stats[j].rows)
                 cur = nxt.get(s2)
                 if cur is None or c2 < cur[0]:
@@ -269,21 +283,21 @@ def _step_cost(prev_rows: float, out_rows: float, rel_rows: float) -> float:
     return max(prev_rows, out_rows) + min(rel_rows, prev_rows)
 
 
-def _order_cost(order: List[int], stats, edges) -> float:
+def _order_cost(order: List[int], stats, edges, cal=None) -> float:
     """Cost of one left-deep order under the DP's model (Σ _step_cost).
     Used both to rank candidate orders and to cost the WRITTEN order
     for the rewrite gate."""
     cost = 0.0
     s = {order[0]}
     for j in order[1:]:
-        prev_rows = _set_rows(frozenset(s), stats, edges)
+        prev_rows = _set_rows(frozenset(s), stats, edges, cal)
         s.add(j)
-        rows = _set_rows(frozenset(s), stats, edges)
+        rows = _set_rows(frozenset(s), stats, edges, cal)
         cost += _step_cost(prev_rows, rows, stats[j].rows)
     return cost
 
 
-def _greedy_order(n: int, stats, edges) -> List[int]:
+def _greedy_order(n: int, stats, edges, cal=None) -> List[int]:
     """Beyond the DP bound: start from the smallest relation and
     repeatedly add the connected relation minimizing the intermediate
     cardinality."""
@@ -299,7 +313,7 @@ def _greedy_order(n: int, stats, edges) -> List[int]:
         if not cands:
             cands = set(range(n)) - done
         j = min(cands, key=lambda j_: _set_rows(
-            frozenset(done | {j_}), stats, edges))
+            frozenset(done | {j_}), stats, edges, cal))
         order.append(j)
         done.add(j)
     return order
@@ -311,7 +325,8 @@ def _contains_agg(node: L.LogicalPlan) -> bool:
     return any(_contains_agg(c) for c in node.children)
 
 
-def _rebuild_chain(relations, edges, order, stats) -> L.LogicalPlan:
+def _rebuild_chain(relations, edges, order, stats,
+                   cal=None) -> L.LogicalPlan:
     """Left-deep rebuild in the chosen order; each step puts the smaller
     estimated side on the RIGHT so the planner's build/broadcast choice
     (right child) stays consistent with the reorder.
@@ -358,7 +373,7 @@ def _rebuild_chain(relations, edges, order, stats) -> L.LogicalPlan:
             cur = L.Join(rel, cur, rel_keys, cur_keys, "inner")
         cur_set.add(j)
         out_set = frozenset(cur_set)
-        cur_rows = _set_rows(out_set, stats, edges)
+        cur_rows = _set_rows(out_set, stats, edges, cal)
     return cur
 
 
@@ -383,8 +398,40 @@ def reorder_joins(plan: L.LogicalPlan, conf) -> L.LogicalPlan:
                 if all(s.rows is not None for s in stats):
                     _edge_selectivities(edges, stats)
                     n = len(relations)
-                    order = (_dp_order(n, stats, edges) if n <= max_dp
-                             else _greedy_order(n, stats, edges))
+                    # AQE calibration: price a relation subset by the
+                    # cardinality an earlier order of the same set
+                    # actually produced (order-independent jset keys,
+                    # plan/stats.py), falling back to the analytic
+                    # product when nothing was observed
+                    from .stats import calibration_lookup, logical_fp
+                    rel_fps = [logical_fp(r) for r in relations]
+
+                    def cal(s, _fps=rel_fps):
+                        if len(s) < 2:
+                            return None
+                        return calibration_lookup(
+                            ("jset", frozenset(_fps[i] for i in s)))
+                    # re-optimize from observations only on DECISIVE
+                    # estimate error: when the measured cardinalities
+                    # roughly confirm the analytic model, plan exactly
+                    # as the static CBO would — the row model is too
+                    # coarse to overrule a known-good order on marginal
+                    # differences, and the churned plan pays recompiles
+                    # for it (q5 steady state regressed ~2x when
+                    # accurate estimates were "re-optimized")
+                    idorder = list(range(n))
+                    written_static = _order_cost(idorder, stats, edges,
+                                                 None)
+                    written_cal = _order_cost(idorder, stats, edges,
+                                              cal)
+                    use_cal = (written_cal > 0 and written_static > 0
+                               and max(written_static / written_cal,
+                                       written_cal / written_static)
+                               >= _CALIBRATION_ERROR_FACTOR)
+                    c = cal if use_cal else None
+                    order = (_dp_order(n, stats, edges, c)
+                             if n <= max_dp
+                             else _greedy_order(n, stats, edges, c))
                     # conservative gate: estimates are coarse (sampled
                     # NDVs, fixed filter selectivities), so only
                     # overrule the written order when the modeled win
@@ -392,11 +439,11 @@ def reorder_joins(plan: L.LogicalPlan, conf) -> L.LogicalPlan:
                     # plan for estimate noise (q7/q8/q9 regressed 2-5x
                     # on sub-2x modeled wins; q5's straggler order is
                     # modeled >10x worse than optimal)
-                    written = _order_cost(list(range(n)), stats, edges)
-                    best = _order_cost(order, stats, edges)
+                    written = written_cal if use_cal else written_static
+                    best = _order_cost(order, stats, edges, c)
                     if best * _REWRITE_MIN_RATIO <= written:
                         joined = _rebuild_chain(relations, edges, order,
-                                                stats)
+                                                stats, cal)
                         # restore the original output schema (names +
                         # order)
                         return L.Project(joined,

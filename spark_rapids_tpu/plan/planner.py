@@ -166,7 +166,8 @@ def _aqe_wrap(exchange, conf, allow_split=False, plan=None,
     """Wrap a file-shuffle exchange with an adaptive reader when enabled
     (GpuCustomShuffleReaderExec analog). Mesh exchanges re-plan at trace
     time instead, so they pass through."""
-    from ..config import (ADAPTIVE_ENABLED, ADAPTIVE_SKEW_FACTOR,
+    from ..config import (ADAPTIVE_COALESCE_ENABLED, ADAPTIVE_ENABLED,
+                          ADAPTIVE_SKEW_ENABLED, ADAPTIVE_SKEW_FACTOR,
                           ADAPTIVE_SKEW_MIN_BYTES, ADAPTIVE_TARGET_BYTES)
     from ..exec.exchange import ShuffleExchangeExec
     if not conf.get(ADAPTIVE_ENABLED) or \
@@ -178,7 +179,10 @@ def _aqe_wrap(exchange, conf, allow_split=False, plan=None,
                               conf.get(ADAPTIVE_TARGET_BYTES),
                               conf.get(ADAPTIVE_SKEW_FACTOR),
                               conf.get(ADAPTIVE_SKEW_MIN_BYTES),
-                              allow_split)
+                              allow_split
+                              and conf.get(ADAPTIVE_SKEW_ENABLED),
+                              allow_coalesce=conf.get(
+                                  ADAPTIVE_COALESCE_ENABLED))
     else:
         plan.exchanges.append(exchange)
     return AQEShuffleReadExec(exchange, plan, role), plan
@@ -589,6 +593,17 @@ class Planner:
     last_audit = None
 
     def plan(self, root: L.LogicalPlan) -> TpuExec:
+        # calibration lookups (observed cardinalities from earlier runs
+        # in this session) are live for the whole planning pass —
+        # optimizer join-reorder included — and only there, so a
+        # session with AQE off plans as if the table did not exist
+        from ..config import ADAPTIVE_CALIBRATION, ADAPTIVE_ENABLED
+        from .stats import calibration_scope
+        with calibration_scope(self.conf.get(ADAPTIVE_ENABLED)
+                               and self.conf.get(ADAPTIVE_CALIBRATION)):
+            return self._plan_scoped(root)
+
+    def _plan_scoped(self, root: L.LogicalPlan) -> TpuExec:
         from .optimizer import optimize
         root = optimize(root, self.conf)
         meta = PlanMeta(root)
@@ -685,6 +700,14 @@ class Planner:
         rule = _RULES[type(meta.node)]
         try:
             meta.exec_node = rule(meta, self._convert, self.conf)
+            # stamp calibration fingerprints (no-op outside an enabled
+            # calibration scope) so post-run harvest can key observed
+            # cardinalities without re-deriving the logical tree
+            try:
+                from .stats import attach_calibration_fps
+                attach_calibration_fps(meta.node, meta.exec_node)
+            except Exception:
+                pass
             return meta.exec_node
         except ModuleNotFoundError as e:
             raise UnsupportedExpr(

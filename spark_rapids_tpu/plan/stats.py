@@ -16,14 +16,244 @@ cost performance, never correctness.
 """
 from __future__ import annotations
 
-from typing import Callable, Dict, Optional
+import threading
+from contextlib import contextmanager
+from typing import Any, Callable, Dict, Optional
 
 from . import logical as L
 
-__all__ = ["Stats", "compute_stats", "scan_column_ndv"]
+__all__ = ["Stats", "compute_stats", "scan_column_ndv",
+           "calibration_scope", "calibration_lookup", "logical_fp",
+           "join_set_fp", "attach_calibration_fps",
+           "harvest_calibration", "calibration_stats",
+           "clear_calibration"]
 
 # Rows sampled (from the first batch / the arrow table head) for NDV.
 SAMPLE_ROWS = 1 << 16
+
+# ---------------------------------------------------------------------
+# Session-scoped cardinality calibration (the AQE feedback loop).
+#
+# After a query runs, `harvest_calibration` records each operator's
+# OBSERVED numOutputRows keyed by the structural fingerprint of its
+# logical subtree (the same gensym-normalized expr_fp identity the
+# reuse pass and result cache key on). `compute_stats` consults the
+# table first, so the next plan of the same subtree — in this session —
+# estimates from measurement instead of heuristics. Join subtrees also
+# record under an ORDER-INDEPENDENT key (the frozenset of their flat
+# relation fingerprints), which is what lets the join-reorder DP
+# (plan/cbo.py) cost a relation subset by the cardinality an earlier
+# order actually produced.
+#
+# Lookups are scoped: they only fire inside a `calibration_scope(True)`
+# (Planner.plan enters it when sql.adaptive.enabled AND
+# sql.adaptive.calibration.enabled), so a session that turns AQE off
+# plans exactly as if the table did not exist. Entries are advisory —
+# a stale entry can cost performance, never correctness.
+# ---------------------------------------------------------------------
+_CAL_LOCK = threading.Lock()
+_CAL: Dict[Any, float] = {}
+_CAL_STATS = {"calibration_hits": 0, "calibration_updates": 0}
+_CAL_TLS = threading.local()
+
+
+@contextmanager
+def calibration_scope(enabled: bool):
+    """Enable calibration lookups on this thread (planning only)."""
+    prev = getattr(_CAL_TLS, "enabled", False)
+    _CAL_TLS.enabled = bool(enabled)
+    try:
+        yield
+    finally:
+        _CAL_TLS.enabled = prev
+
+
+def calibration_lookup(key) -> Optional[float]:
+    """Observed row count for a fingerprint key, or None. Counts a hit
+    only inside an enabled scope."""
+    if key is None or not getattr(_CAL_TLS, "enabled", False):
+        return None
+    with _CAL_LOCK:
+        v = _CAL.get(key)
+        if v is not None:
+            _CAL_STATS["calibration_hits"] += 1
+        return v
+
+
+def _calibration_record(key, rows: float) -> None:
+    with _CAL_LOCK:
+        _CAL[key] = float(rows)
+        _CAL_STATS["calibration_updates"] += 1
+
+
+def calibration_stats() -> Dict[str, int]:
+    with _CAL_LOCK:
+        out = dict(_CAL_STATS)
+        out["calibration_entries"] = len(_CAL)
+        return out
+
+
+def clear_calibration() -> None:
+    with _CAL_LOCK:
+        _CAL.clear()
+        for k in _CAL_STATS:
+            _CAL_STATS[k] = 0
+
+
+def logical_fp(node: L.LogicalPlan):
+    """CARDINALITY fingerprint of a logical subtree, memoized on the
+    node (`_*_cache` convention, so expr_fp skips the memo attr).
+
+    Row counts are invariant to projection placement and column
+    pruning, so the fingerprint hashes only the cardinality skeleton —
+    scans, filter conditions, join how/keys, grouping keys, limits —
+    and SEES THROUGH row-preserving wrappers (Project/Sort/Window/
+    Repartition). That invariance is load-bearing: lookups fire at the
+    join-reorder stage (pre-prune) while harvest keys come from the
+    final converted tree (post-prune); a full structural fp would never
+    match across the two, and its repr/hash cost scales with embedded
+    bound-expression trees."""
+    fp = getattr(node, "_calib_fp_cache", None)
+    if fp is None:
+        fp = node._calib_fp_cache = _card_fp(node)
+    return fp
+
+
+def _card_fp(node: L.LogicalPlan):
+    from ..runtime.program_cache import expr_fp, exprs_fp
+    if isinstance(node, (L.Project, L.Sort, L.Repartition, L.WindowOp)):
+        return logical_fp(node.children[0])   # row-preserving
+    if isinstance(node, L.Filter):
+        return ("F", expr_fp(node.condition),
+                logical_fp(node.children[0]))
+    if isinstance(node, L.Join):
+        return ("J", node.how,
+                exprs_fp(node.left_keys), exprs_fp(node.right_keys),
+                expr_fp(getattr(node, "condition", None)),
+                logical_fp(node.children[0]),
+                logical_fp(node.children[1]))
+    if isinstance(node, L.Aggregate):
+        # groups depend on keys only — different agg columns over the
+        # same keys legitimately share one observation
+        return ("A", exprs_fp(node.keys), logical_fp(node.children[0]))
+    if isinstance(node, L.Limit):
+        return ("L", int(node.n), logical_fp(node.children[0]))
+    if isinstance(node, L.Union):
+        return ("U",) + tuple(logical_fp(c) for c in node.children)
+    if isinstance(node, L.InMemoryScan):
+        return ("S", "mem", id(node.arrow))   # session-scoped identity
+    if isinstance(node, L.ParquetScan):
+        # rows depend on the files, the pushed row-group filters, and
+        # the data version — not on the projected column subset
+        return ("S", "parquet", tuple(node.paths),
+                expr_fp(node.filters), expr_fp(node.snapshot))
+    if isinstance(node, L.Expand):
+        return ("X", "Expand", len(node.include_masks),
+                logical_fp(node.children[0]))
+    if not node.children:
+        paths = getattr(node, "paths", None) or getattr(node, "path",
+                                                        None)
+        if paths:
+            return ("S", type(node).__qualname__,
+                    tuple(paths) if not isinstance(paths, str)
+                    else paths)
+        return ("S", type(node).__qualname__, id(node))
+    # unknown operator: type + child skeletons. Two same-typed siblings
+    # over one child could falsely share — advisory rows only, never a
+    # correctness risk.
+    return ("X", type(node).__qualname__) + tuple(
+        logical_fp(c) for c in node.children)
+
+
+def _flatten_rels(node: L.LogicalPlan):
+    """Relations of a flat inner-equi join chain, seeing through the
+    pass-through projections session.join leaves between chained joins
+    — the SAME flattening discipline as cbo._flatten_chain, so a jset
+    key harvested from an executed join matches the key the reorder
+    pass looks up for the same relation set. A non-inner join anywhere
+    poisons the chain (order is semantics there, so subset keys would
+    lie)."""
+    from .cbo import _is_passthrough, _reorderable_join
+    if isinstance(node, L.Project) and _is_passthrough(node) \
+            and _reorderable_join(node.children[0]):
+        return _flatten_rels(node.children[0])
+    if isinstance(node, L.Join):
+        if not _reorderable_join(node):
+            return None
+        l = _flatten_rels(node.children[0])
+        r = _flatten_rels(node.children[1])
+        if l is None or r is None:
+            return None
+        return l + r
+    return [node]
+
+
+def join_set_fp(node: L.LogicalPlan):
+    """Order-independent key for an inner-equi join subtree: the
+    frozenset of its flat relations' fingerprints. Any join order over
+    the same relation set produces the same multiset of output rows,
+    so one observed cardinality prices every order."""
+    if not isinstance(node, L.Join):
+        return None
+    rels = _flatten_rels(node)
+    if rels is None or len(rels) < 2:
+        return None
+    # fp tuples are hashable by construction (expr_fp falls back to
+    # ("id", id) for anything that isn't) — hash them directly; repr()
+    # would stringify embedded foreign values (arrow buffers!) at
+    # data-proportional cost
+    return ("jset", frozenset(logical_fp(r) for r in rels))
+
+
+def attach_calibration_fps(logical: L.LogicalPlan, physical) -> None:
+    """Stamp the planning-time fingerprints onto the physical node so
+    post-run harvest can key observations without re-deriving the
+    logical tree. Underscore attrs are invisible to the reuse pass's
+    node_fp, so attachments never split exchange-reuse identity."""
+    if physical is None or not getattr(_CAL_TLS, "enabled", False):
+        return
+    physical._calib_fp = logical_fp(logical)
+    jfp = join_set_fp(logical)
+    if jfp is not None:
+        physical._calib_set_fp = jfp
+
+
+def harvest_calibration(root_exec, ctx) -> int:
+    """Record observed output cardinalities of a finished run into the
+    calibration table. Skipped wholesale when the tree contains a
+    limit/top-k (truncated pulls underreport every producer below
+    them) and when the conf gates calibration off. Returns the number
+    of entries recorded."""
+    from ..config import ADAPTIVE_CALIBRATION, ADAPTIVE_ENABLED
+    conf = getattr(ctx, "conf", None)
+    if conf is None or not (conf.get(ADAPTIVE_ENABLED)
+                            and conf.get(ADAPTIVE_CALIBRATION)):
+        return 0
+    nodes, stack, seen = [], [root_exec], set()
+    while stack:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        tname = type(node).__name__
+        if "Limit" in tname or "TopK" in tname:
+            return 0
+        nodes.append(node)
+        stack.extend(node.children)
+    recorded = 0
+    for node in nodes:
+        ms = ctx.metrics.get(node._op_id)
+        if ms is None:
+            continue
+        rows = ms.get("numOutputRows", 0)
+        if not rows or rows <= 0:
+            continue
+        for attr in ("_calib_fp", "_calib_set_fp"):
+            key = getattr(node, attr, None)
+            if key is not None:
+                _calibration_record(key, float(rows))
+                recorded += 1
+    return recorded
 
 
 class Stats:
@@ -153,7 +383,19 @@ def _join_rows(node: L.Join, ls: Stats, rs: Stats) -> Optional[float]:
 
 
 def compute_stats(node: L.LogicalPlan) -> Stats:
-    """Bottom-up (rows, ndv) estimate for a logical subtree."""
+    """Bottom-up (rows, ndv) estimate for a logical subtree. Inside a
+    calibration scope, an observed cardinality for this exact subtree
+    overrides the analytic row estimate (NDV propagation unchanged —
+    observation measures rows, not distincts)."""
+    s = _compute_stats_raw(node)
+    rows = calibration_lookup(logical_fp(node)) \
+        if getattr(_CAL_TLS, "enabled", False) else None
+    if rows is not None:
+        s = Stats(rows, s._ndv_of)
+    return s
+
+
+def _compute_stats_raw(node: L.LogicalPlan) -> Stats:
     from .cbo import _selectivity
     from .planner import _estimate_rows
 

@@ -112,6 +112,24 @@ def render_analyze(tree: dict, metrics_by_lore: Dict[Optional[int], dict],
         if m.get("exchangeReuseHits"):
             ann.append(
                 f"exchangeReuseHits={int(m['exchangeReuseHits'])}")
+        # AQE replan decisions (docs/aqe.md): coalesce/skew on the
+        # shuffle readers, demotion on the rewritten join, plus the
+        # exact per-reduce-partition byte distribution on exchanges
+        if m.get("aqePartitionsBefore") is not None:
+            ann.append(f"AQEShuffleRead[coalesced "
+                       f"{int(m['aqePartitionsBefore'])}"
+                       f"→{int(m['aqePartitionsAfter'])}]")
+        if m.get("aqeSkewSplits"):
+            ann.append(f"aqeSkewSplits={int(m['aqeSkewSplits'])}")
+        if m.get("aqeDemotedBuildBytes") is not None:
+            ann.append("aqeDemotedToBroadcast="
+                       f"{fmt_bytes(m['aqeDemotedBuildBytes'])}")
+        if m.get("shufflePartitionBytesMax") is not None:
+            ann.append(
+                "shufflePartitionBytes="
+                f"{fmt_bytes(m.get('shufflePartitionBytesMin', 0))}"
+                f"/{fmt_bytes(m.get('shufflePartitionBytesMedian', 0))}"
+                f"/{fmt_bytes(m['shufflePartitionBytesMax'])}")
         # query-service waits (root node): time queued behind other
         # queries + time blocked on the TpuSemaphore for the chip
         if m.get("queueWaitMs") is not None:

@@ -10,6 +10,11 @@ typed events the profiling tool post-processes:
   plan          {plan: nested {lore_id, name, describe, children}}
   plan_audit    {ok, nodes, findings: [{kind, reason, node, path,
                  lore_id}]}   (static auditor, analysis/audit.py)
+  aqe_replan    {action, decisions: [{rule: shuffle_read|
+                 demote_broadcast_join, ...lore ids old→new, partition
+                 counts, split/byte thresholds}]}  (AQE stage driver,
+                 plan/aqe.py; emitted between stage completion and
+                 consumer launch when any replan decision was taken)
   stage_submit  {stage, n_tasks, attempt}        (distributed runner)
   stage_complete{stage, wall_s, shuffle_bytes}   (distributed runner)
   fetch_retry   {stage, pid, shuffle_id}         (distributed runner)

@@ -885,9 +885,30 @@ class DataFrame:
         try:
             with _query_scope(handle.query_id if handle else "?"):
                 with profile_query(self._session, root, ctx, action,
-                                   handle=None if nested else handle):
+                                   handle=None if nested else handle) as w:
                     try:
+                        # AQE stage driver: materialize shuffle stages
+                        # bottom-up and replan (coalesce / skew-split /
+                        # join demotion) between stage completion and
+                        # consumer launch. Decisions are re-served on a
+                        # cached root so every run's event log is
+                        # self-contained. Errors (cancellation
+                        # included) propagate — a stage that ran IS
+                        # query execution.
+                        from .plan.aqe import run_stage_driver
+                        decisions = run_stage_driver(root, ctx, conf)
+                        if decisions and w is not None:
+                            w.emit("aqe_replan", action=action,
+                                   decisions=decisions)
                         out = body(root, ctx)
+                        # observed-cardinality harvest: close the AQE
+                        # feedback loop (plan/stats.py calibration
+                        # table); advisory, never fails the query
+                        from .plan.stats import harvest_calibration
+                        try:
+                            harvest_calibration(root, ctx)
+                        except Exception:
+                            pass
                         if rc_on:
                             # a successful run feeds BOTH cache tiers:
                             # tagged exchange map outputs (fragment
@@ -1087,14 +1108,24 @@ class DataFrame:
         ctx.query_id = handle.query_id
         try:
             with profile_query(self._session, root, ctx, "write",
-                               handle=None if outer else handle):
+                               handle=None if outer else handle) as w:
                 try:
+                    from .plan.aqe import run_stage_driver
+                    decisions = run_stage_driver(root, ctx, conf)
+                    if decisions and w is not None:
+                        w.emit("aqe_replan", action="write",
+                               decisions=decisions)
                     for pid in range(root.num_partitions(ctx)):
                         ctx.check_cancel()
                         tables = [_batch_to_arrow(b)
                                   for b in root.execute_partition(ctx, pid)]
                         if tables:
                             yield pa.concat_tables(tables)
+                    from .plan.stats import harvest_calibration
+                    try:
+                        harvest_calibration(root, ctx)
+                    except Exception:
+                        pass
                 finally:
                     ctx.close()
         except BaseException as e:

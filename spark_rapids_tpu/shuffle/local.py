@@ -85,6 +85,11 @@ class LocalShuffle:
         self._map_files: Dict[int, str] = {}
         self._arena = None  # lazy HostArena for reduce-side assembly
         self.metrics = {"bytesWritten": 0, "blocksWritten": 0}
+        # exact per-reduce-partition serialized bytes + rows, summed at
+        # WRITE time (the MapOutputStatistics analog): the skew/coalesce
+        # detectors read these without re-opening any map file
+        self._rp_bytes = [0] * self.n
+        self._rp_rows = [0] * self.n
 
     # ---------------- map side ----------------------------------------
     def write_map_partition(self, mpid: int, pieces_per_reduce):
@@ -127,6 +132,10 @@ class LocalShuffle:
         with self._lock:  # concurrent map workers share the metrics dict
             self.metrics["bytesWritten"] += nbytes
             self.metrics["blocksWritten"] += nblocks
+            for rp in range(self.n):
+                self._rp_bytes[rp] += index[rp][1]
+                self._rp_rows[rp] += sum(sb.n_rows
+                                         for sb in pieces_per_reduce[rp])
             self._map_files[mpid] = path
 
     # ---------------- reduce side --------------------------------------
@@ -208,21 +217,16 @@ class LocalShuffle:
         return [sb for r in results for sb in r]
 
     def partition_stats(self) -> List[int]:
-        """Serialized bytes per reduce partition, from the map-file
-        trailing indexes (the MapOutputStatistics analog feeding adaptive
-        re-planning)."""
-        sizes = [0] * self.n
+        """EXACT serialized bytes per reduce partition, accumulated at
+        write time (the MapOutputStatistics analog feeding adaptive
+        re-planning) — no map-file re-reads on the replan path."""
         with self._lock:
-            files = [self._map_files[k] for k in sorted(self._map_files)]
-        for path in files:
-            with open(path, "rb") as f:
-                f.seek(-12, os.SEEK_END)
-                idx_off, n = struct.unpack("<QI", f.read(12))
-                f.seek(idx_off)
-                for rp in range(self.n):
-                    off, ln = struct.unpack("<QQ", f.read(16))
-                    sizes[rp] += ln
-        return sizes
+            return list(self._rp_bytes)
+
+    def partition_row_stats(self) -> List[int]:
+        """Rows per reduce partition, accumulated at write time."""
+        with self._lock:
+            return list(self._rp_rows)
 
     def reduce_batch_slice(self, rpid: int, chunk: int,
                            nchunks: int) -> Optional[DeviceBatch]:

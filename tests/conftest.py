@@ -49,6 +49,11 @@ def _drop_program_cache_per_module():
     # on-disk paths; a module's tmp_path tables must not leak hits (or
     # stale invalidation state) into the next module
     result_cache.clear()
+    # observed-cardinality calibration is session-scoped state keyed on
+    # structural fingerprints; one module's harvested row counts must
+    # not steer another module's join planning
+    from spark_rapids_tpu.plan import stats as _stats
+    _stats.clear_calibration()
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,309 @@
+"""Stage-wise AQE replanning (plan/aqe.py; reference: Spark AQE's
+AQEShuffleReadExec + DynamicJoinSelection + OptimizeSkewedJoin): join
+demotion to broadcast from materialized build bytes, per-rule on/off
+byte-identity parity, exact per-reduce-partition shuffle statistics,
+`aqe_replan` event-log records, EXPLAIN ANALYZE annotations, and the
+observed-cardinality calibration loop feeding the join-reorder CBO."""
+import numpy as np
+import pyarrow as pa
+
+import spark_rapids_tpu as st
+import spark_rapids_tpu.functions as F
+from spark_rapids_tpu.expr.expressions import col
+from spark_rapids_tpu.plan import aqe as plan_aqe
+from spark_rapids_tpu.plan import stats as plan_stats
+
+AQE_OFF = {"spark.rapids.tpu.sql.adaptive.enabled": False}
+
+
+def _session(**extra):
+    conf = {
+        "spark.rapids.tpu.sql.batchSizeRows": 512,
+        "spark.rapids.tpu.sql.shuffle.partitions": 8,
+        "spark.rapids.tpu.sql.adaptive.advisoryPartitionSizeInBytes": 4096,
+        "spark.rapids.tpu.sql.adaptive.skewJoin."
+        "skewedPartitionThresholdInBytes": 4096,
+        "spark.rapids.tpu.sql.adaptive.skewJoin.skewedPartitionFactor": 2,
+    }
+    conf.update(extra)
+    return st.TpuSession(conf)
+
+
+def _demotion_query(s, n=40_000):
+    """Shuffle-hash join whose build side the planner OVERestimates (a
+    point filter on a 5000-key dim) but which materializes as one row:
+    the demotion shape."""
+    big = s.create_dataframe({"k": pa.array([i % 5000 for i in range(n)]),
+                              "v": pa.array([float(i) for i in range(n)])})
+    dim = s.create_dataframe({"k": pa.array(list(range(5000))),
+                              "w": pa.array([float(i)
+                                             for i in range(5000)])})
+    sel = dim.filter(col("k") == 17)
+    return big.join(sel, on=["k"]).select("k", "v", "w").sort("v")
+
+
+def _skew_query(s, n=30_000):
+    """90% of probe rows share one key -> one reduce partition dwarfs
+    the median; the build side is too big to broadcast."""
+    k = [0] * (n * 9 // 10) + [i % 500 + 1 for i in range(n // 10)]
+    big = s.create_dataframe({"k": pa.array(k),
+                              "v": pa.array([float(i) for i in range(n)])})
+    dim = s.create_dataframe({"k": pa.array(list(range(501))),
+                              "w": pa.array([float(i)
+                                             for i in range(501)])})
+    return big.join(dim, on=["k"]).select("k", "v", "w").sort("v")
+
+
+def _coalesce_query(s, n=20_000):
+    """64 reduce partitions over 7 distinct keys: most partitions come
+    out empty, the rest far below the advisory size."""
+    df = s.create_dataframe({"k": pa.array([i % 7 for i in range(n)]),
+                             "v": pa.array([float(i) for i in range(n)])})
+    return df.group_by("k").agg(F.sum("v").alias("sv"),
+                                F.count("v").alias("c")).sort("k")
+
+
+# ------------------------------------------------------------------
+# per-rule on/off byte-identity parity
+# ------------------------------------------------------------------
+
+def test_demotion_fires_and_byte_identical_to_off():
+    before = plan_aqe.aqe_stats()["demotions"]
+    s = _session(**{"spark.rapids.tpu.sql.autoBroadcastJoinThreshold":
+                    8192})
+    got = _demotion_query(s).to_arrow()
+    assert plan_aqe.aqe_stats()["demotions"] > before
+    s_off = _session(**AQE_OFF,
+                     **{"spark.rapids.tpu.sql."
+                        "autoBroadcastJoinThreshold": 8192})
+    want = _demotion_query(s_off).to_arrow()
+    assert got.combine_chunks().equals(want.combine_chunks())
+
+
+def test_skew_split_byte_identical_to_off():
+    before = plan_aqe.aqe_stats()["skew_splits"]
+    s = _session(**{"spark.rapids.tpu.sql.autoBroadcastJoinThreshold":
+                    -1})
+    got = _skew_query(s).to_arrow()
+    assert plan_aqe.aqe_stats()["skew_splits"] > before
+    s_off = _session(**AQE_OFF,
+                     **{"spark.rapids.tpu.sql."
+                        "autoBroadcastJoinThreshold": -1})
+    want = _skew_query(s_off).to_arrow()
+    assert got.combine_chunks().equals(want.combine_chunks())
+
+
+def test_coalesce_many_empty_partitions_byte_identical_to_off():
+    before = plan_aqe.aqe_stats()["coalesced_partitions"]
+    s = _session(**{"spark.rapids.tpu.sql.shuffle.partitions": 64})
+    got = _coalesce_query(s).to_arrow()
+    assert plan_aqe.aqe_stats()["coalesced_partitions"] > before
+    s_off = _session(**AQE_OFF,
+                     **{"spark.rapids.tpu.sql.shuffle.partitions": 64})
+    want = _coalesce_query(s_off).to_arrow()
+    assert got.combine_chunks().equals(want.combine_chunks())
+
+
+def test_rule_gates_disable_individually():
+    # each rule's own gate turns JUST that rule off; results still match
+    s = _session(**{
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 8192,
+        "spark.rapids.tpu.sql.adaptive.joinDemotion.enabled": False})
+    before = plan_aqe.aqe_stats()["demotions"]
+    got = _demotion_query(s, 8000).to_arrow()
+    assert plan_aqe.aqe_stats()["demotions"] == before
+    s2 = _session(**{
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 8192})
+    assert got.combine_chunks().equals(
+        _demotion_query(s2, 8000).to_arrow().combine_chunks())
+
+
+# ------------------------------------------------------------------
+# exact per-reduce-partition map output statistics
+# ------------------------------------------------------------------
+
+def test_shuffle_partition_stats_exact(tmp_path):
+    from spark_rapids_tpu.columnar.table import Table
+    from spark_rapids_tpu.shuffle.local import LocalShuffle
+    from spark_rapids_tpu.shuffle.serializer import HostSubBatch
+
+    schema = Table.from_arrow(pa.table({"a": pa.array([1], pa.int64())}
+                                       )).schema
+    sh = LocalShuffle("t-exact", 3, schema, shuffle_dir=str(tmp_path),
+                      writer_threads=1, reader_threads=1)
+
+    def sb(n):
+        return HostSubBatch(
+            [{"validity": np.ones(n, bool),
+              "data": np.arange(n, dtype=np.int64)}], n)
+
+    # map 0: rp0 gets 10+5 rows in two blocks, rp1 empty, rp2 one row
+    sh.write_map_partition(0, [[sb(10), sb(5)], [], [sb(1)]])
+    # map 1: rp1 gets 7 rows
+    sh.write_map_partition(1, [[], [sb(7)], []])
+    stats = sh.partition_stats()
+    rows = sh.partition_row_stats()
+    assert rows == [15, 7, 1]
+    # EXACT: per-partition bytes sum to the total written (both are
+    # accumulated from the same serialized block lengths)
+    assert sum(stats) == sh.metrics["bytesWritten"]
+    assert stats[1] > 0 and stats[0] > stats[2]
+    # and the stats agree with what the reduce side actually reads
+    got_rows = [sum(b.n_rows for b in sh.read_reduce_partition(rp))
+                for rp in range(3)]
+    assert got_rows == rows
+    sh.cleanup()
+
+
+# ------------------------------------------------------------------
+# event log + EXPLAIN ANALYZE surfaces
+# ------------------------------------------------------------------
+
+def _events_of(s):
+    from spark_rapids_tpu.profiler.event_log import read_event_log
+    assert s.last_event_log is not None
+    return read_event_log(s.last_event_log)
+
+
+def test_aqe_replan_event_records_demotion(tmp_path):
+    s = _session(**{
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 8192,
+        "spark.rapids.tpu.sql.eventLog.enabled": True,
+        "spark.rapids.tpu.sql.eventLog.dir": str(tmp_path / "ev")})
+    _demotion_query(s).to_arrow()
+    evs = _events_of(s)
+    replans = [e for e in evs if e["event"] == "aqe_replan"]
+    assert replans, "demotion run must emit an aqe_replan event"
+    decs = [d for e in replans for d in e["decisions"]]
+    dem = [d for d in decs if d["rule"] == "demote_broadcast_join"]
+    assert dem
+    d = dem[0]
+    # lore ids old->new: the skipped stream/build exchanges and the
+    # broadcast node that replaced them
+    assert d["old_lores"] and d["new_lores"]
+    assert d["build_bytes"] <= d["threshold"] == 8192
+
+
+def test_explain_analyze_annotations(tmp_path):
+    from spark_rapids_tpu.profiler.analyze import render_analyze
+    from spark_rapids_tpu.profiler.event_log import aggregate_ops
+    s = _session(**{
+        "spark.rapids.tpu.sql.shuffle.partitions": 16,
+        "spark.rapids.tpu.sql.eventLog.enabled": True,
+        "spark.rapids.tpu.sql.eventLog.dir": str(tmp_path / "ev")})
+    _coalesce_query(s).to_arrow()
+    evs = _events_of(s)
+    plan = next(e["plan"] for e in evs if e["event"] == "plan")
+    ops = [o for e in evs if e["event"] == "op_metrics"
+           for o in e["ops"]]
+    by_lore = {v["lore_id"]: v["metrics"]
+               for v in aggregate_ops(ops).values()}
+    text = render_analyze(plan, by_lore)
+    assert "AQEShuffleRead[coalesced 16" in text
+    assert "shufflePartitionBytes=" in text
+
+
+# ------------------------------------------------------------------
+# cardinality calibration: harvest, scoping, and the CBO feedback loop
+# ------------------------------------------------------------------
+
+def test_calibration_harvest_and_scoped_lookup():
+    plan_stats.clear_calibration()
+    s = _session()
+    df = s.create_dataframe({"k": pa.array([i % 11 for i in range(4000)]),
+                             "v": pa.array([float(i)
+                                            for i in range(4000)])})
+    q = df.group_by("k").agg(F.sum("v").alias("sv"))
+    q.to_arrow()
+    assert plan_stats.calibration_stats()["calibration_entries"] > 0
+    # the aggregate's observed cardinality (11 groups) overrides the
+    # estimate — but ONLY inside an enabled calibration scope
+    agg_logical = q._plan
+    while not hasattr(agg_logical, "keys"):
+        agg_logical = agg_logical.children[0]
+    with plan_stats.calibration_scope(True):
+        assert plan_stats.compute_stats(agg_logical).rows == 11.0
+    assert plan_stats.calibration_lookup(
+        plan_stats.logical_fp(agg_logical)) is None  # scope off -> miss
+
+
+def test_adaptive_off_harvests_nothing():
+    plan_stats.clear_calibration()
+    s = _session(**AQE_OFF)
+    df = s.create_dataframe({"k": pa.array([1, 2, 3] * 100),
+                             "v": pa.array([1.0] * 300)})
+    df.group_by("k").agg(F.sum("v").alias("s")).to_arrow()
+    assert plan_stats.calibration_stats()["calibration_entries"] == 0
+
+
+def test_limit_query_does_not_poison_calibration():
+    plan_stats.clear_calibration()
+    s = _session()
+    df = s.create_dataframe({"k": pa.array(list(range(1000))),
+                             "v": pa.array([float(i)
+                                            for i in range(1000)])})
+    df.sort("k").limit(5).to_arrow()
+    # truncated pulls underreport every producer: nothing recorded
+    assert plan_stats.calibration_stats()["calibration_entries"] == 0
+
+
+def test_stale_cbo_stats_corrected_on_second_run():
+    """The q5-shaped regression: deliberately stale NDVs make the
+    written (straggler) join order look fine, so run 1 keeps it and
+    executes the blowup. The harvested join-set cardinalities must make
+    run 2's reorder pass pick the selective order instead."""
+    from spark_rapids_tpu.plan import logical as L
+    from spark_rapids_tpu.plan.optimizer import optimize
+    plan_stats.clear_calibration()
+    s = _session(**{"spark.rapids.tpu.sql.autoBroadcastJoinThreshold":
+                    -1})
+    n = 3000
+    rng = np.random.default_rng(7)
+    a = s.create_dataframe({"j": pa.array(rng.integers(0, 30, n)),
+                            "c_k": pa.array(np.arange(n))})
+    b = s.create_dataframe({"j": pa.array(rng.integers(0, 30, n)),
+                            "b_v": pa.array(rng.random(n))})
+    c = s.create_dataframe({"c_k": pa.array(np.arange(10)),
+                            "c_v": pa.array(rng.random(10))})
+    # stale stats: claim j is near-unique (A><B looks selective) and
+    # c_k in A has only 10 distincts (A><C looks like no help)
+    a._plan._ndv_cache = {"j": float(n), "c_k": 10.0}
+    b._plan._ndv_cache = {"j": float(n)}
+
+    def leaves(plan):
+        out = []
+
+        def walk(nd):
+            if isinstance(nd, L.Join):
+                walk(nd.left), walk(nd.right)
+            elif isinstance(nd, (L.Project, L.Filter)):
+                walk(nd.children[0])
+            else:
+                out.append(tuple(sorted(nd.schema.names)))
+        walk(plan)
+        return out
+
+    q = a.join(b, on=["j"]).join(c, on=["c_k"])
+    with plan_stats.calibration_scope(True):
+        first = optimize(q._plan, s.conf)
+    assert leaves(first) == leaves(q._plan), \
+        "stale stats must keep the written order on the first plan"
+    q.to_arrow()          # executes the straggler order, harvests truth
+    assert plan_stats.calibration_stats()["calibration_entries"] > 0
+    q2 = a.join(b, on=["j"]).join(c, on=["c_k"])
+    with plan_stats.calibration_scope(True):
+        second = optimize(q2._plan, s.conf)
+    assert leaves(second) != leaves(q2._plan), \
+        "observed cardinalities must correct the join order"
+    # the selective A><C pair must now run first
+    inner = [None]
+
+    def walk(nd):
+        if isinstance(nd, L.Join):
+            inner[0] = nd
+        for ch in nd.children:
+            walk(ch)
+    walk(second)
+    sides = {leaves(inner[0].left)[0], leaves(inner[0].right)[0]}
+    assert ("b_v", "j") not in sides
+    assert plan_stats.calibration_stats()["calibration_hits"] > 0

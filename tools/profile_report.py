@@ -140,6 +140,29 @@ def report(events: List[dict], top: int = 0) -> str:
             elif e["event"] == "fetch_retry":
                 lines.append(f"  FETCH RETRY pid={e.get('pid')} "
                              f"addr={e.get('addr')}")
+            elif e["event"] == "aqe_replan":
+                decs = e.get("decisions") or []
+                parts = []
+                for d in decs:
+                    if d.get("rule") == "demote_broadcast_join":
+                        parts.append(
+                            "demoted join lore "
+                            f"{d.get('join_lore')} to broadcast "
+                            f"({fmt_bytes(d.get('build_bytes', 0))} "
+                            f"build, lores {d.get('old_lores')}"
+                            f"→{d.get('new_lores')})")
+                    else:
+                        seg = (f"shuffle read "
+                               f"{d.get('partitions_before')}"
+                               f"→{d.get('partitions_after')} tasks")
+                        if d.get("split_slices"):
+                            seg += (f", {d.get('skewed_partitions')} "
+                                    f"skewed→{d.get('split_slices')} "
+                                    f"slices")
+                        parts.append(seg)
+                lines.append(
+                    f"  aqe: {len(decs)} decision(s): "
+                    + "; ".join(parts))
             elif e["event"] == "watermarks":
                 lines.append(
                     f"  watermarks: device peak "
