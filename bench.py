@@ -465,6 +465,12 @@ def _main_impl():
             # filled in now so a budget-expiry partial flush still
             # carries it; refreshed after the concurrent tail below
             _partial["extra"]["lockdep"] = _lw.report()
+        from spark_rapids_tpu.runtime import ledger as _ledger
+        _lg = _ledger.ledger()
+        if _lg is not None:
+            # resource acquire/release balance for the run so far —
+            # same partial-flush/refresh lifecycle as lockdep
+            _partial["extra"]["ledger"] = _lg.report()
         # AQE replan counters accumulated by the sweep above (ISSUE 12):
         # coalesced partitions, skew splits, join demotions, calibration
         # hits — filled in now for partial flushes, refreshed after the
@@ -580,9 +586,15 @@ def _main_impl():
             _partial["extra"]["aqe"] = _aqe_stats()
         except Exception:
             pass
+    if "ledger" in _partial["extra"]:
+        # refresh: the concurrent tail's queries must balance too
+        from spark_rapids_tpu.runtime import ledger as _ledger
+        _lg = _ledger.ledger()
+        if _lg is not None:
+            _partial["extra"]["ledger"] = _lg.report()
     for k in ("scan_profile", "smoke", "fresh_rerun_compiles",
               "concurrent_2stream", "service", "exchange", "lockdep",
-              "result_cache", "aqe"):
+              "result_cache", "aqe", "ledger"):
         if k in _partial["extra"]:
             extra[k] = _partial["extra"][k]
     # ---- regression gate vs the previous round's JSON -------------------

@@ -1,13 +1,20 @@
 """Static analysis for the engine: the plan-time auditor (NOT_ON_TPU
-verdict tagging, analysis/audit.py) and the AST rules behind the
-`tpulint` engine linter (analysis/lint_rules.py).
+verdict tagging, analysis/audit.py), the AST rules behind the
+`tpulint` engine linter (analysis/lint_rules.py), the interprocedural
+concurrency auditor (analysis/concurrency.py), and the
+resource-lifetime auditor (analysis/lifetime.py).
 
-Both passes make the engine's safety contracts machine-checked instead
+The passes make the engine's safety contracts machine-checked instead
 of reviewer folklore: the auditor walks a bound physical plan BEFORE
-execution and predicts where it will fall back, fail, or recompile; the
-linter walks the engine's own source and flags sync/recompile hazards
-(implicit device->host syncs, shape-baking jit closures, dtype-promotion
-traps, missing buffer donation).
+execution and predicts where it will fall back, fail, or recompile;
+the linter walks the engine's own source and flags sync/recompile
+hazards (implicit device->host syncs, shape-baking jit closures,
+dtype-promotion traps, missing buffer donation, fingerprint-unstable
+node attrs); the concurrency auditor proves deadlock-shape properties
+over locks/pools/semaphores (runtime twin: runtime/lockdep.py); the
+lifetime auditor proves acquire/release properties over staging
+leases, permits, spill handles, and byte reservations (runtime twin:
+runtime/ledger.py).
 """
 from .audit import (AuditReport, Verdict, audit_plan, OK, WILL_FALLBACK,
                     WILL_NOT_WORK, RECOMPILE_RISK)

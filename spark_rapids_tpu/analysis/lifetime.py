@@ -1,0 +1,719 @@
+"""Static resource-lifetime auditor: acquire/release shape analysis.
+
+The engine's hardest shipped bugs were lifetime bugs, not logic bugs:
+PR 4 released a staging-pool lease before `block_until_ready`, letting
+queued XLA kernels read recycled host memory. This pass models the
+engine's typed acquire/release resources —
+
+  PinnedStagingPool leases     x = pool.acquire(n)   / x.release()
+  SpillStore handles           x = store.add_batch(b)/ x.close()
+  Device/Host byte reservations  mgr.reserve(n)      / mgr.release(n)
+  TpuSemaphore permits / rider slots  sem.acquire()  / sem.release()
+
+— and reports four fatal shapes as tpulint Violations (same identity,
+marker and baseline machinery as lint_rules.py / concurrency.py):
+
+  leak-on-exception     an acquisition whose release is not dominated
+                        by try/finally or a context manager, and that
+                        never escapes to an owner (returned, stored,
+                        registered for cleanup): any raise between —
+                        including cancel-checkpoint exits — leaks it.
+  double-release        the same resource released twice on some path.
+  use-after-release     the resource (or a buffer derived from it via
+                        .view()/.array/frombuffer aliasing) flows into
+                        a call after its release on some path.
+  release-before-sync   a lease whose buffer fed a jnp/jax op released
+                        with no intervening block_until_ready/fetch —
+                        the exact PR 4 race (archived under
+                        tests/fixtures/lifetime/ and re-detected).
+  unbalanced-transfer   a tracked resource handed across a thread/pool
+                        boundary (pool.submit / Thread target) whose
+                        resolved worker has no protected release of
+                        the corresponding parameter: nobody owns it on
+                        the worker's error path.
+
+Call resolution for unbalanced-transfer reuses concurrency.py's Model
+(lexical-scope chain, unique-method heuristic); allow-markers
+(`# tpulint: allow[rule] reason`) and the JSON baseline flow through
+tools/tpulint.py --lifetime exactly like the other analyzers.
+
+The analysis is per-function and intentionally conservative: a
+resource that escapes its acquiring function (ownership transfer to a
+handle list, a cleanup registry, the caller) is not second-guessed —
+interprocedural balance is the runtime ledger's job
+(runtime/ledger.py), which this pass pairs with.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Tuple
+
+from .concurrency import (Model, _allowed, _file_markers, _is_riderish,
+                          _is_semish, _iter_py, _last_name, _mod_name,
+                          build_model)
+from .lint_rules import Violation
+
+__all__ = ["LIFETIME_RULES", "analyze_paths", "analyze_source"]
+
+LIFETIME_RULES = ("leak-on-exception", "double-release",
+                  "use-after-release", "release-before-sync",
+                  "unbalanced-transfer")
+
+#: attribute names whose access on a lease creates an aliasing derived
+#: value (the PR 4 race flows through exactly these)
+_ALIAS_ATTRS = ("array", "view")
+#: call names that propagate aliasing from an argument to the result
+_ALIAS_CALLS = ("frombuffer", "asarray", "memoryview", "ascontiguousarray")
+#: calls that act as a device-sync barrier for release-before-sync
+_SYNC_CALLS = ("block_until_ready", "fetch")
+
+
+def _root_name(expr) -> Optional[str]:
+    """Leftmost identifier of a Name/Attribute/Call chain."""
+    while isinstance(expr, (ast.Attribute, ast.Call)):
+        expr = expr.func if isinstance(expr, ast.Call) else expr.value
+    return expr.id if isinstance(expr, ast.Name) else None
+
+
+def _is_poolish(expr) -> bool:
+    n = _last_name(expr)
+    if not n:
+        return False
+    low = n.lower()
+    return "pool" in low or "staging" in low
+
+
+def _is_mgrish(expr) -> bool:
+    n = _last_name(expr)
+    if not n:
+        return False
+    low = n.lower()
+    return low in ("dm", "hm") or "mgr" in low or "manager" in low
+
+
+def _acquisition(call) -> Optional[Tuple[str, str]]:
+    """(kind, site-tag) when `call` acquires a tracked handle-like
+    resource bound to a variable; None otherwise."""
+    if not isinstance(call, ast.Call) or not isinstance(
+            call.func, ast.Attribute):
+        return None
+    base, attr = call.func.value, call.func.attr
+    if attr == "acquire" and _is_poolish(base):
+        return "staging-lease", f"{_last_name(base)}.acquire"
+    if attr == "add_batch":
+        return "spill-handle", f"{_last_name(base)}.add_batch"
+    return None
+
+
+class _Rec:
+    """Per-variable lifetime state inside one function walk."""
+
+    __slots__ = ("var", "kind", "line", "tag", "released", "rel_line",
+                 "protected", "escaped", "fed", "synced", "reported")
+
+    def __init__(self, var: str, kind: str, line: int, tag: str):
+        self.var = var
+        self.kind = kind
+        self.line = line
+        self.tag = tag
+        self.released = False     # released on SOME path walked so far
+        self.rel_line = 0
+        self.protected = False    # some release sits in a finalbody /
+        # the acquisition is a with-item
+        self.escaped = False      # ownership transferred out
+        self.fed = False          # buffer flowed into a jnp/jax op
+        self.synced = True        # block_until_ready seen since feed
+        self.reported = set()     # rules already emitted for this var
+
+    def copy(self) -> "_Rec":
+        r = _Rec(self.var, self.kind, self.line, self.tag)
+        r.released, r.rel_line = self.released, self.rel_line
+        r.protected, r.escaped = self.protected, self.escaped
+        r.fed, r.synced = self.fed, self.synced
+        r.reported = self.reported   # shared: one report per var
+        return r
+
+
+class _PairRec:
+    """One acquire half of a paired-call resource (byte reservation,
+    permit): `base.reserve(n)` / `sem.acquire()` matched against a
+    later `base.release(...)` in the same function."""
+
+    __slots__ = ("base", "kind", "line", "released", "protected")
+
+    def __init__(self, base: str, kind: str, line: int):
+        self.base = base
+        self.kind = kind
+        self.line = line
+        self.released = False
+        self.protected = False
+
+
+class _FnLifetime:
+    """Sequential walk of one function body with some-path branch
+    semantics: If/Try branches are walked on copies and merged with
+    union (a release on SOME path arms use-after/double-release on the
+    code that follows)."""
+
+    def __init__(self, auditor: "_ModuleAuditor", funcdef, cls_name):
+        self.a = auditor
+        self.fn = funcdef
+        self.cls = cls_name
+        self.recs: Dict[str, _Rec] = {}
+        self.derived: Dict[str, str] = {}    # alias var -> lease var
+        self.pairs: List[_PairRec] = []
+        self.in_finally = False
+
+    # -- expression helpers -------------------------------------------
+    def _names_in(self, node) -> set:
+        return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+    def _lease_roots(self, node) -> set:
+        """Tracked lease vars referenced by `node`, through derived
+        aliases."""
+        roots = set()
+        for nm in self._names_in(node):
+            if nm in self.recs:
+                roots.add(nm)
+            elif nm in self.derived:
+                roots.add(self.derived[nm])
+        return roots
+
+    def _alias_source(self, value) -> Optional[str]:
+        """Lease var that `value` aliases (one hop through _ALIAS_ATTRS
+        / _ALIAS_CALLS), or None."""
+        # slicing an aliasing view still aliases the same memory
+        while isinstance(value, ast.Subscript):
+            value = value.value
+        if isinstance(value, ast.Attribute) and value.attr in _ALIAS_ATTRS:
+            root = value.value
+            if isinstance(root, ast.Name):
+                return self._resolve_lease(root.id)
+        if isinstance(value, ast.Call):
+            fname = _last_name(value.func)
+            # jnp.asarray(...) yields a DEVICE value: its hazard is
+            # covered by release-before-sync (feed tracking), not by
+            # host-alias use-after-release
+            if _root_name(value.func) in self.a.jax_aliases:
+                return None
+            if fname in _ALIAS_CALLS or fname in _ALIAS_ATTRS:
+                for arg in value.args:
+                    for sub in ast.walk(arg):
+                        if isinstance(sub, ast.Name):
+                            src = self._resolve_lease(sub.id)
+                            if src is not None:
+                                return src
+        return None
+
+    def _resolve_lease(self, name: str) -> Optional[str]:
+        if name in self.recs:
+            return name
+        return self.derived.get(name)
+
+    # -- event handlers ------------------------------------------------
+    def _emit(self, rule: str, line: int, col: int, msg: str):
+        self.a.emit(rule, line, col, msg)
+
+    def _note_release(self, var: str, node):
+        rec = self.recs.get(var)
+        if rec is None:
+            return
+        if rec.released and "double-release" not in rec.reported:
+            rec.reported.add("double-release")
+            self._emit(
+                "double-release", node.lineno, node.col_offset,
+                f"{rec.kind} `{var}` (acquired line {rec.line} via "
+                f"{rec.tag}) released again — already released on a "
+                f"path through line {rec.rel_line}")
+        if rec.fed and not rec.synced \
+                and "release-before-sync" not in rec.reported:
+            rec.reported.add("release-before-sync")
+            self._emit(
+                "release-before-sync", node.lineno, node.col_offset,
+                f"{rec.kind} `{var}` fed a jnp/jax op but is released "
+                f"with no block_until_ready on the outputs: dispatch "
+                f"is async and jnp.asarray can alias the host buffer "
+                f"zero-copy, so queued kernels read the recycled "
+                f"buffer (the PR 4 staging race)")
+        rec.released = True
+        rec.rel_line = node.lineno
+        if self.in_finally:
+            rec.protected = True
+
+    def _release_target(self, call) -> Optional[str]:
+        """Var released by `call`: x.release() / x.close() /
+        pool.release(x)."""
+        if not isinstance(call.func, ast.Attribute):
+            return None
+        base, attr = call.func.value, call.func.attr
+        if attr in ("release", "close") and isinstance(base, ast.Name) \
+                and base.id in self.recs and not call.args:
+            return base.id
+        if attr == "release" and call.args \
+                and isinstance(call.args[0], ast.Name) \
+                and call.args[0].id in self.recs:
+            return call.args[0].id
+        return None
+
+    def _scan_calls(self, stmt):
+        """Order-independent per-statement scan: releases, feeds,
+        sync barriers, escapes, pair events, transfers."""
+        released_vars = set()
+        for node in ast.walk(stmt):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = _last_name(node.func)
+            # sync barrier clears every pending feed
+            if fname in _SYNC_CALLS:
+                for rec in self.recs.values():
+                    rec.synced = True
+            tgt = self._release_target(node)
+            if tgt is not None:
+                self._note_release(tgt, node)
+                released_vars.add(tgt)
+                continue
+            # paired-call resources --------------------------------
+            if isinstance(node.func, ast.Attribute):
+                base, attr = node.func.value, node.func.attr
+                bname = _last_name(base)
+                if attr in ("reserve", "force_reserve") \
+                        and _is_mgrish(base):
+                    self.pairs.append(_PairRec(
+                        bname, "reservation", node.lineno))
+                elif attr == "acquire" and (
+                        _is_semish(base) or _is_riderish(base)):
+                    self.pairs.append(_PairRec(
+                        bname, "permit", node.lineno))
+                elif attr == "release" and node.args \
+                        and _is_mgrish(base):
+                    self._close_pair(bname)
+                elif attr == "release" and not node.args and (
+                        _is_semish(base) or _is_riderish(base)):
+                    self._close_pair(bname)
+                elif attr == "submit" and len(node.args) >= 2:
+                    self._check_transfer(node, node.args[0],
+                                         node.args[1:])
+            if fname == "Thread":
+                tref = kargs = None
+                for kw in node.keywords:
+                    if kw.arg == "target":
+                        tref = kw.value
+                    elif kw.arg == "args" and isinstance(
+                            kw.value, (ast.Tuple, ast.List)):
+                        kargs = kw.value.elts
+                if tref is not None and kargs:
+                    self._check_transfer(node, tref, kargs)
+        # jnp/jax feeds and use/escape detection, after releases so a
+        # release statement itself is not a "use"
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                root = _root_name(node.func)
+                if root in self.a.jax_aliases \
+                        and _last_name(node.func) not in _SYNC_CALLS:
+                    for var in self._lease_roots(node):
+                        rec = self.recs[var]
+                        rec.fed = True
+                        rec.synced = False
+                self._scan_escapes(node)
+        # use-after-release: any reference to a released lease (or a
+        # derived alias) outside the release call itself
+        for var in self._lease_roots(stmt):
+            rec = self.recs[var]
+            if rec.released and var not in released_vars \
+                    and "use-after-release" not in rec.reported:
+                rec.reported.add("use-after-release")
+                self._emit(
+                    "use-after-release", stmt.lineno, stmt.col_offset,
+                    f"{rec.kind} `{var}` used after its release on a "
+                    f"path through line {rec.rel_line}: the buffer may "
+                    f"already be recycled by the next lease")
+
+    def _close_pair(self, base: Optional[str]):
+        for p in self.pairs:
+            if p.base == base and not p.released:
+                p.released = True
+                p.protected = p.protected or self.in_finally
+                return
+
+    def _scan_escapes(self, call: ast.Call):
+        """Bare lease names handed to a call (append to a handle list,
+        cleanup registration, constructor capture) transfer ownership —
+        the leak rule must not second-guess the new owner."""
+        if self._release_target(call) is not None:
+            return
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            for sub in ast.walk(arg):
+                name = None
+                if isinstance(sub, ast.Name):
+                    name = sub.id
+                elif isinstance(sub, ast.Attribute) \
+                        and sub.attr in ("release", "close") \
+                        and isinstance(sub.value, ast.Name):
+                    name = sub.value.id  # ctx.add_cleanup(x.release)
+                if name in self.recs:
+                    self.recs[name].escaped = True
+
+    def _check_transfer(self, call, fn_ref, args):
+        """unbalanced-transfer: a tracked resource passed to a worker
+        whose resolved body has no finally-protected release of the
+        receiving parameter."""
+        passed = []   # (arg position, var)
+        for i, arg in enumerate(args):
+            if isinstance(arg, ast.Name) and arg.id in self.recs:
+                passed.append((i, arg.id))
+        if not passed:
+            return
+        worker = self.a.resolve_worker(self.fn, fn_ref)
+        for pos, var in passed:
+            rec = self.recs[var]
+            rec.escaped = True   # the worker owns it now — if it can
+            if worker is None:
+                continue         # unresolvable: trust the transfer
+            param = self._worker_param(worker, pos)
+            if param is None or self._worker_releases(worker, param):
+                continue
+            if "unbalanced-transfer" not in rec.reported:
+                rec.reported.add("unbalanced-transfer")
+                self._emit(
+                    "unbalanced-transfer", call.lineno, call.col_offset,
+                    f"{rec.kind} `{var}` handed across a thread/pool "
+                    f"boundary to `{worker.name}` which never releases "
+                    f"parameter `{param}` under try/finally: nobody "
+                    f"owns it on the worker's error path")
+
+    @staticmethod
+    def _worker_param(worker, pos: int) -> Optional[str]:
+        args = [a.arg for a in worker.args.args]
+        if args and args[0] in ("self", "cls"):
+            args = args[1:]
+        return args[pos] if pos < len(args) else None
+
+    @staticmethod
+    def _worker_releases(worker, param: str) -> bool:
+        for node in ast.walk(worker):
+            if not isinstance(node, ast.Try):
+                continue
+            for fin in node.finalbody:
+                for sub in ast.walk(fin):
+                    if isinstance(sub, ast.Call) and isinstance(
+                            sub.func, ast.Attribute):
+                        base, attr = sub.func.value, sub.func.attr
+                        if attr in ("release", "close") and isinstance(
+                                base, ast.Name) and base.id == param:
+                            return True
+                        if attr == "release" and any(
+                                isinstance(a, ast.Name)
+                                and a.id == param for a in sub.args):
+                            return True
+        # `with param:` / `with closing(param):` also owns it
+        for node in ast.walk(worker):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    for sub in ast.walk(item.context_expr):
+                        if isinstance(sub, ast.Name) and sub.id == param:
+                            return True
+        return False
+
+    # -- statement walk ------------------------------------------------
+    def run(self):
+        self.walk(self.fn.body)
+        # function-end verdicts ---------------------------------------
+        for rec in self.recs.values():
+            # a more specific finding already covers this var: don't
+            # stack the generic leak report on top
+            if rec.escaped or rec.reported:
+                continue
+            if not rec.released:
+                rec.reported.add("leak-on-exception")
+                self._emit(
+                    "leak-on-exception", rec.line, 0,
+                    f"{rec.kind} `{rec.var}` acquired via {rec.tag} is "
+                    f"never released or transferred in this function: "
+                    f"it leaks on every path")
+            elif not rec.protected:
+                rec.reported.add("leak-on-exception")
+                self._emit(
+                    "leak-on-exception", rec.line, 0,
+                    f"{rec.kind} `{rec.var}` acquired via {rec.tag} is "
+                    f"released on the straight-line path only — no "
+                    f"try/finally or context manager, so any exception "
+                    f"(including a cancel-checkpoint exit) between "
+                    f"acquire and release leaks it")
+        for p in self.pairs:
+            if p.released and not p.protected:
+                self._emit(
+                    "leak-on-exception", p.line, 0,
+                    f"{p.kind} acquired on `{p.base}` is released on "
+                    f"the straight-line path only — no try/finally, so "
+                    f"an exception (including a cancel-checkpoint "
+                    f"exit) between acquire and release leaks it")
+
+    def walk(self, stmts):
+        for stmt in stmts:
+            self.stmt(stmt)
+
+    def _bind(self, targets, value):
+        """Assignment: new acquisitions, alias propagation, rebinds."""
+        simple = [t.id for t in targets if isinstance(t, ast.Name)]
+        acq = _acquisition(value) if isinstance(value, ast.Call) else None
+        if acq and len(simple) == 1:
+            kind, tag = acq
+            var = simple[0]
+            self.recs[var] = _Rec(var, kind, value.lineno, tag)
+            self.derived = {d: r for d, r in self.derived.items()
+                            if r != var}
+            return
+        src = self._alias_source(value) if value is not None else None
+        for var in simple:
+            if src is not None and src != var:
+                self.derived[var] = src
+            else:
+                # rebinding kills prior tracking for this name
+                self.recs.pop(var, None)
+                self.derived.pop(var, None)
+        # storing a lease into an attribute/subscript is an escape
+        for t in targets:
+            if isinstance(t, (ast.Attribute, ast.Subscript)) \
+                    and value is not None:
+                for sub in ast.walk(value):
+                    if isinstance(sub, ast.Name) and sub.id in self.recs:
+                        self.recs[sub.id].escaped = True
+
+    def _snapshot(self):
+        return ({v: r.copy() for v, r in self.recs.items()},
+                dict(self.derived), list(self.pairs))
+
+    def _merge(self, branches):
+        """Union merge of branch outcomes (some-path semantics)."""
+        base_recs: Dict[str, _Rec] = {}
+        base_derived: Dict[str, str] = {}
+        for recs, derived, _pairs in branches:
+            for v, r in recs.items():
+                cur = base_recs.get(v)
+                if cur is None:
+                    base_recs[v] = r.copy()
+                else:
+                    cur.released = cur.released or r.released
+                    cur.rel_line = max(cur.rel_line, r.rel_line)
+                    cur.protected = cur.protected or r.protected
+                    cur.escaped = cur.escaped or r.escaped
+                    cur.fed = cur.fed or r.fed
+                    cur.synced = cur.synced and r.synced
+            base_derived.update(derived)
+        self.recs = base_recs
+        self.derived = base_derived
+
+    def stmt(self, stmt):
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return  # nested defs audited as their own functions
+        if isinstance(stmt, ast.Assign):
+            self._scan_calls(stmt)
+            self._bind(stmt.targets, stmt.value)
+            return
+        if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            self._scan_calls(stmt)
+            if stmt.value is not None:
+                self._bind([stmt.target], stmt.value)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                acq = _acquisition(item.context_expr)
+                if acq and isinstance(item.optional_vars, ast.Name):
+                    kind, tag = acq
+                    var = item.optional_vars.id
+                    rec = _Rec(var, kind, item.context_expr.lineno, tag)
+                    rec.protected = True   # __exit__ owns the release
+                    self.recs[var] = rec
+                else:
+                    self._scan_calls(stmt)
+            self.walk(stmt.body)
+            for item in stmt.items:
+                acq = _acquisition(item.context_expr)
+                if acq and isinstance(item.optional_vars, ast.Name):
+                    var = item.optional_vars.id
+                    if var in self.recs:
+                        self._note_release(var, stmt)
+            return
+        if isinstance(stmt, ast.If):
+            self._scan_calls(stmt.test)
+            snap = self._snapshot()
+            self.walk(stmt.body)
+            b1 = self._snapshot()
+            self.recs, self.derived, self.pairs = (
+                {v: r.copy() for v, r in snap[0].items()},
+                dict(snap[1]), snap[2])
+            self.walk(stmt.orelse)
+            b2 = self._snapshot()
+            self.pairs = b1[2] + [p for p in b2[2] if p not in b1[2]]
+            self._merge([b1, b2])
+            return
+        if isinstance(stmt, (ast.For, ast.While)):
+            if isinstance(stmt, ast.For):
+                self._scan_calls(stmt.iter)
+                self._bind([stmt.target], None)
+            else:
+                self._scan_calls(stmt.test)
+            self.walk(stmt.body)
+            self.walk(stmt.orelse)
+            return
+        if isinstance(stmt, ast.Try):
+            self.walk(stmt.body)
+            snap = self._snapshot()
+            branches = [snap]
+            for handler in stmt.handlers:
+                self.recs, self.derived = (
+                    {v: r.copy() for v, r in snap[0].items()},
+                    dict(snap[1]))
+                # a release inside an except handler is deliberate
+                # error-path compensation (release-then-reraise): it
+                # counts as protection, like a finalbody release
+                was = self.in_finally
+                self.in_finally = True
+                self.walk(handler.body)
+                self.in_finally = was
+                branches.append(self._snapshot())
+            self._merge(branches)
+            self.walk(stmt.orelse)
+            was = self.in_finally
+            self.in_finally = True
+            self.walk(stmt.finalbody)
+            self.in_finally = was
+            return
+        if isinstance(stmt, ast.Return) or (
+                isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, (ast.Yield, ast.YieldFrom))):
+            # returned / yielded resources belong to the caller now
+            self._scan_calls(stmt)
+            val = stmt.value
+            if val is not None:
+                for sub in ast.walk(val):
+                    if isinstance(sub, ast.Name) and sub.id in self.recs:
+                        self.recs[sub.id].escaped = True
+            return
+        self._scan_calls(stmt)
+
+
+class _ModuleAuditor:
+    """Per-module driver: collects jnp/jax aliases and function ASTs,
+    runs _FnLifetime over every def, resolves transfer workers through
+    the concurrency Model."""
+
+    def __init__(self, model: Optional[Model], mod: str, path: str,
+                 src: str):
+        self.model = model
+        self.mod = mod
+        self.path = path
+        self.tree = ast.parse(src)
+        self.lines = src.splitlines()
+        self.violations: List[Violation] = []
+        self.jax_aliases = set()
+        self.fn_by_line: Dict[int, ast.AST] = {}
+        self._cur_line = 0
+        self._collect()
+
+    def _collect(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    root = alias.name.split(".")[0]
+                    if root == "jax":
+                        self.jax_aliases.add(alias.asname or root)
+            elif isinstance(node, ast.ImportFrom):
+                if node.module and node.module.split(".")[0] == "jax":
+                    for alias in node.names:
+                        self.jax_aliases.add(alias.asname or alias.name)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                self.fn_by_line[node.lineno] = node
+
+    def emit(self, rule: str, line: int, col: int, msg: str):
+        snippet = ""
+        if 1 <= line <= len(self.lines):
+            snippet = self.lines[line - 1].strip()
+        self.violations.append(Violation(
+            self.path, line, col, rule, msg, snippet))
+
+    # -- worker resolution (concurrency Model reuse) -------------------
+    def resolve_worker(self, funcdef, fn_ref) -> Optional[ast.AST]:
+        """AST of the function `fn_ref` names, via the concurrency
+        model's scope-chain + unique-method resolution."""
+        if isinstance(fn_ref, ast.Lambda):
+            return None
+        if isinstance(fn_ref, ast.Name):
+            ref = ("local", fn_ref.id)
+        elif isinstance(fn_ref, ast.Attribute):
+            kind = ("self" if isinstance(fn_ref.value, ast.Name)
+                    and fn_ref.value.id == "self" else "attr")
+            ref = (kind, fn_ref.attr)
+        else:
+            return None
+        if self.model is None:
+            return None
+        owner = self._model_fn(funcdef.lineno)
+        if owner is None:
+            return None
+        fid = self.model.resolve_ref(owner, ref)
+        if fid is None:
+            return None
+        callee = self.model.funcs.get(fid)
+        if callee is None or callee.mod != self.mod:
+            return None   # cross-module worker: out of scope here
+        return self.fn_by_line.get(callee.line)
+
+    def _model_fn(self, line: int):
+        for fn in self.model.funcs.values():
+            if fn.mod == self.mod and fn.line == line:
+                return fn
+        return None
+
+    def run(self) -> List[Violation]:
+        self._visit(self.tree.body, None)
+        return self.violations
+
+    def _visit(self, body, cls_name):
+        for node in body:
+            if isinstance(node, ast.ClassDef):
+                self._visit(node.body, node.name)
+            elif isinstance(node, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                _FnLifetime(self, node, cls_name).run()
+                self._visit(node.body, cls_name)
+
+
+# ---------------------------------------------------------------------
+# entry points
+# ---------------------------------------------------------------------
+def analyze_source(src: str, path: str = "<mem>", mod: str = "mem",
+                   model: Optional[Model] = None) -> List[Violation]:
+    """Audit one module's source (unit-test surface). Marker-allowed
+    sites are dropped, like analyze_paths."""
+    try:
+        auditor = _ModuleAuditor(model, mod, path, src)
+    except SyntaxError:
+        return []
+    out = []
+    markers = _file_markers(src.splitlines())
+    for v in sorted(auditor.run(), key=lambda v: (v.line, v.col, v.rule)):
+        if not _allowed(markers, v.rule, v.line):
+            out.append(v)
+    return out
+
+
+def analyze_paths(paths: List[str], rel_to: Optional[str] = None,
+                  model: Optional[Model] = None) -> List[Violation]:
+    """Build the concurrency call-resolution model over `paths`, run
+    the lifetime pass per module, drop marker-allowed sites. Violations
+    share lint_rules' (path, rule, snippet) identity, so the tpulint
+    baseline/diff machinery applies unchanged."""
+    model = model or build_model(paths, rel_to)
+    out: List[Violation] = []
+    for f in _iter_py(paths):
+        rel = (os.path.relpath(f, rel_to) if rel_to else f)
+        rel = rel.replace(os.sep, "/")
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        out.extend(analyze_source(
+            src, path=rel, mod=_mod_name(f, rel_to), model=model))
+    out.sort(key=lambda v: (v.path, v.line, v.col, v.rule))
+    return out

@@ -38,6 +38,14 @@ module turns those conventions into machine-checked rules (consumed by
                    would run the operator to completion instead of
                    stopping at the next batch boundary (the query
                    service's cooperative-cancellation contract)
+  fp-unstable-attr a plan/ or exec/ node attribute visible to the
+                   structural fingerprints (plan/reuse.node_fp,
+                   runtime/program_cache.expr_fp) assigned from a
+                   process-global counter, id(), uuid, or a clock:
+                   same-shaped plans stop deduplicating and the
+                   cross-query caches miss forever. Identity attrs must
+                   be fingerprint-skipped names (`_op_id`, `lore_id`,
+                   `_cached`, `_jit*`, `_*_cache`) or underscore-private
   allow-no-reason  a `# tpulint: allow[...]` marker without a reason —
                    every accepted violation must say why
 
@@ -591,6 +599,97 @@ def rule_pool_cancel(ctx: _ModuleCtx):
                    f"inside the worker")
 
 
+#: attribute names the fingerprints skip by contract — identity fields
+#: allowed to hold counter values (program_cache.expr_fp skips `_jit*`,
+#: `_*_cache`, and these names; plan/reuse.node_fp skips every
+#: underscore-prefixed attr)
+_FP_SKIPPED_ATTRS = ("_op_id", "lore_id", "_cached")
+#: callable last-names whose result differs per process/call: anything
+#: they feed into a fingerprint-visible attr splits the caches
+_UNSTABLE_CALLS = {"id", "uuid1", "uuid4", "time", "monotonic",
+                   "perf_counter", "time_ns", "monotonic_ns", "random",
+                   "randint", "token_hex", "urandom", "getrandbits"}
+#: next(<counter-ish>) arg name fragments that mark a process-global
+#: counter (next(iter(batches)) is data, not identity — not flagged)
+_COUNTERISH = ("id", "count", "counter", "seq")
+
+
+def _fp_exempt_attr(attr: str) -> bool:
+    """True when the structural fingerprints skip this attribute name
+    (the documented expr_fp/node_fp contract), so unstable values are
+    fine there."""
+    if attr in _FP_SKIPPED_ATTRS:
+        return True
+    if attr.startswith("_jit"):
+        return True
+    if attr.startswith("_") and attr.endswith("_cache"):
+        return True
+    return False
+
+
+def _unstable_value(rhs) -> Optional[str]:
+    """Describe the first process-unstable expression in `rhs`, or
+    None when the value is structural."""
+    for n in ast.walk(rhs):
+        if not isinstance(n, ast.Call):
+            continue
+        fname = None
+        if isinstance(n.func, ast.Name):
+            fname = n.func.id
+        elif isinstance(n.func, ast.Attribute):
+            fname = n.func.attr
+        if fname in _UNSTABLE_CALLS:
+            return f"{fname}(...)"
+        if fname == "next" and n.args:
+            arg = n.args[0]
+            argname = None
+            if isinstance(arg, ast.Name):
+                argname = arg.id
+            elif isinstance(arg, ast.Attribute):
+                argname = arg.attr
+            if argname and any(frag in argname.lower()
+                               for frag in _COUNTERISH):
+                return f"next({argname})"
+    return None
+
+
+def rule_fp_unstable_attr(ctx: _ModuleCtx):
+    """Flag `self.<attr> = <unstable>` in plan/ and exec/ node classes
+    where <attr> is visible to the structural fingerprints
+    (plan/reuse.node_fp fingerprints every public attr;
+    runtime/program_cache.expr_fp additionally sees private attrs that
+    are not `_jit*` / `_*_cache` / explicitly skipped) and <unstable>
+    draws from a process-global counter, id(), uuid, a clock, or a
+    random source. Such attrs make structurally identical plans hash
+    differently, silently disabling exchange reuse, the program cache,
+    and the cross-query result cache. Identity bookkeeping belongs in
+    the fingerprint-skipped names (`_op_id`, `lore_id`, `_cached`,
+    `_jit*`, `_*_cache`)."""
+    if not re.search(r"(^|/)(plan|exec)/", ctx.path):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Assign):
+            continue
+        for tgt in node.targets:
+            if not (isinstance(tgt, ast.Attribute)
+                    and isinstance(tgt.value, ast.Name)
+                    and tgt.value.id == "self"):
+                continue
+            if _fp_exempt_attr(tgt.attr):
+                continue
+            desc = _unstable_value(node.value)
+            if desc is not None:
+                yield (node.lineno, node.col_offset, "fp-unstable-attr",
+                       f"attribute {tgt.attr!r} is visible to the "
+                       f"structural fingerprints (expr_fp/node_fp) but "
+                       f"is assigned the process-unstable value {desc}: "
+                       f"identical plans stop fingerprint-equal and "
+                       f"every cross-query cache misses — rename it to "
+                       f"a fingerprint-skipped name (_op_id/lore_id/"
+                       f"_cached/_jit*/_*_cache) or derive it "
+                       f"structurally")
+
+
 RULES = {
     "host-sync": rule_host_sync,
     "block-sync": rule_block_sync,
@@ -600,6 +699,7 @@ RULES = {
     "jit-instance": rule_jit_instance,
     "ctx-cancel": rule_ctx_cancel,
     "pool-cancel": rule_pool_cancel,
+    "fp-unstable-attr": rule_fp_unstable_attr,
 }
 
 

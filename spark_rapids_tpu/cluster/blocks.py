@@ -61,11 +61,20 @@ class BlockStore:
 
     def pin(self, shuffle_id: str):
         with self._lock:
+            fresh = shuffle_id not in self._pinned
             self._pinned.add(shuffle_id)
+        if fresh:
+            from ..runtime import ledger
+            ledger.note_acquire("shuffle_pin", token=shuffle_id,
+                                tag=f"BlockStore.pin[{shuffle_id}]")
 
     def unpin(self, shuffle_id: str):
         with self._lock:
+            was = shuffle_id in self._pinned
             self._pinned.discard(shuffle_id)
+        if was:
+            from ..runtime import ledger
+            ledger.note_release("shuffle_pin", token=shuffle_id)
 
     def put(self, shuffle_id: str, map_id: int, pid: int, table) -> int:
         import pyarrow as pa
@@ -77,6 +86,7 @@ class BlockStore:
         with self._lock:
             if shuffle_id not in self._shuffles:
                 self._shuffles[shuffle_id] = {}
+            fresh_pin = shuffle_id not in self._pinned
             self._pinned.add(shuffle_id)     # in-flight until drop()
             # true LRU: every put refreshes recency before evicting;
             # pinned (in-flight) shuffles are skipped — only completed
@@ -93,6 +103,10 @@ class BlockStore:
                     except OSError:
                         pass
             self._shuffles[shuffle_id][(map_id, pid)] = path
+        if fresh_pin:
+            from ..runtime import ledger
+            ledger.note_acquire("shuffle_pin", token=shuffle_id,
+                                tag=f"BlockStore.pin[{shuffle_id}]")
         return os.path.getsize(path)
 
     def get(self, shuffle_id: str, map_id: int, pid: int):
@@ -109,8 +123,12 @@ class BlockStore:
 
     def drop(self, shuffle_id: str):
         with self._lock:
+            was = shuffle_id in self._pinned
             self._pinned.discard(shuffle_id)
             old = self._shuffles.pop(shuffle_id, None)
+        if was:
+            from ..runtime import ledger
+            ledger.note_release("shuffle_pin", token=shuffle_id)
         for p in (old or {}).values():
             try:
                 os.unlink(p)

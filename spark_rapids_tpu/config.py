@@ -619,6 +619,34 @@ LOCKDEP_RAISE = _conf(
     "the acquisition that forms the cycle (fail fast, the kernel-"
     "lockdep behavior). False records findings for the "
     "concurrency_report event without raising.", bool)
+LEDGER_ENABLED = _conf(
+    "sql.debug.ledger.enabled", False,
+    "Runtime resource ledger (runtime/ledger.py): count every "
+    "acquire/release of device/host reservations, staging leases, "
+    "spill handles, shuffle pins, semaphore permits, ride slots and "
+    "result-cache charges, attribute them to the submitting query, "
+    "and assert owner-scoped kinds balance at every terminal state "
+    "(FINISHED, CANCELLED, TIMED_OUT alike). Deadline kills and "
+    "budget-exhaustion errors attach an outstanding-holders dump "
+    "(kind, site, thread, query) next to the lockdep dump, and every "
+    "profiled query emits a resource_ledger event. Acquisitions made "
+    "before the session exist are only covered when env SRTPU_LEDGER=1 "
+    "was set first. Debug tool; overhead <5% on the test suite.",
+    bool)
+LEDGER_RAISE = _conf(
+    "sql.debug.ledger.raiseOnImbalance", True,
+    "With the ledger enabled: raise ResourceLeakError when a query "
+    "finishes cleanly with owner-scoped resources outstanding (fail "
+    "fast). False records findings for the resource_ledger event "
+    "without raising; error-path imbalances are always recorded, "
+    "never raised over the original error.", bool)
+LEDGER_POISON = _conf(
+    "sql.debug.ledger.poison", False,
+    "With the ledger enabled: fill released cached staging buffers "
+    "with 0xAB before they return to the pool free list, turning "
+    "latent use-after-release reads (the PR 4 corruption class) into "
+    "deterministic garbage instead of data-dependent flakes. Debug "
+    "mode: adds a memset per lease release.", bool)
 
 
 class TpuConf:

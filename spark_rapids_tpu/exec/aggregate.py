@@ -789,6 +789,7 @@ class HashAggregateExec(TpuExec):
                 self._base, self._n_fused = self.children[0], 0
                 self._stages = lambda cvs, mask: (cvs, mask)
                 self._stages._stage_fp = ("chain",)
+            # tpulint: allow[fp-unstable-attr] id(self) is the documented per-instance fallback key: unshared, never falsely shared
             self._stage_fp = getattr(self._stages, "_stage_fp",
                                      ("inst", id(self)))
 

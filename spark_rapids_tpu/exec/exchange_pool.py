@@ -101,15 +101,17 @@ class PermitRider:
                 self._waited += waited
             return waited
 
-        from ..runtime import lockdep
+        from ..runtime import ledger, lockdep
 
         def _ride():
             with self._lock:
                 self._riding = threading.current_thread().name
             lockdep.note_acquired(self.RIDE)
+            ledger.note_acquire("ride", tag="PermitRider.step")
 
         def _unride():
             lockdep.note_released(self.RIDE)
+            ledger.note_release("ride")
             with self._lock:
                 self._riding = None
 

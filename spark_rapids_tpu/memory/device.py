@@ -62,10 +62,13 @@ class DeviceManager:
                 cur = self._reserved
             else:
                 return False
+        from ..runtime import ledger
         from .diagnostics import record_device_watermark, \
             record_query_bytes
         record_device_watermark(cur)
         record_query_bytes("device", nbytes)
+        ledger.note_acquire("device_bytes", nbytes,
+                            tag="DeviceManager.try_reserve")
         return True
 
     def reserve(self, nbytes: int):
@@ -88,15 +91,20 @@ class DeviceManager:
                 hook(needed)
             if self.try_reserve(nbytes, _record=False):
                 return
-        raise BudgetExceeded(
+        exc = BudgetExceeded(
             f"need {nbytes} bytes, reserved {self._reserved} of "
             f"{self.budget} and spill store exhausted")
+        from ..runtime import ledger
+        ledger.attach_dump(exc)   # who holds the budget, by thread/query
+        raise exc
 
     def release(self, nbytes: int):
         with self._lock:
             self._reserved = max(0, self._reserved - nbytes)
+        from ..runtime import ledger
         from .diagnostics import record_query_bytes
         record_query_bytes("device", -nbytes)
+        ledger.note_release("device_bytes", nbytes)
 
     def trigger_spill(self, nbytes: Optional[int] = None):
         """Ask the spill store to free memory proactively (the retry

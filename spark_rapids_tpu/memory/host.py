@@ -60,9 +60,12 @@ class HostMemoryManager:
                 cur = self._reserved
             else:
                 return False
+        from ..runtime import ledger
         from .diagnostics import record_host_watermark, record_query_bytes
         record_host_watermark(cur)
         record_query_bytes("host", nbytes)
+        ledger.note_acquire("host_bytes", nbytes,
+                            tag="HostMemoryManager.try_reserve")
         return True
 
     def reserve(self, nbytes: int):
@@ -83,9 +86,12 @@ class HostMemoryManager:
             self.metrics["pressureFreed"] += int(freed or 0)
             if self.try_reserve(nbytes):
                 return
-        raise HostBudgetExceeded(
+        exc = HostBudgetExceeded(
             f"host reservation of {nbytes} bytes over budget "
             f"{self.budget} ({self._reserved} reserved)")
+        from ..runtime import ledger
+        ledger.attach_dump(exc)   # who holds the budget, by thread/query
+        raise exc
 
     def force_reserve(self, nbytes: int):
         """Unconditional reservation (soft-admit): accounting may
@@ -94,16 +100,21 @@ class HostMemoryManager:
             self._reserved += nbytes
             self._holders += 1
             cur = self._reserved
+        from ..runtime import ledger
         from .diagnostics import record_host_watermark, record_query_bytes
         record_host_watermark(cur)
         record_query_bytes("host", nbytes)
+        ledger.note_acquire("host_bytes", nbytes,
+                            tag="HostMemoryManager.force_reserve")
 
     def release(self, nbytes: int):
         with self._lock:
             self._reserved = max(0, self._reserved - nbytes)
             self._holders = max(0, self._holders - 1)
+        from ..runtime import ledger
         from .diagnostics import record_query_bytes
         record_query_bytes("host", -nbytes)
+        ledger.note_release("host_bytes", nbytes)
 
 
 # ----------------------------------------------------------------------
@@ -172,12 +183,17 @@ class PinnedStagingPool:
 
     def acquire(self, nbytes: int) -> StagingBuffer:
         import numpy as np
+
+        from ..runtime import ledger
         cap = _staging_bucket(max(int(nbytes), 1))
         with self._lock:
             lst = self._free.get(cap)
             if lst:
                 self.metrics["stagingPoolHits"] += 1
-                return StagingBuffer(lst.pop(), nbytes, self, True)
+                buf = StagingBuffer(lst.pop(), nbytes, self, True)
+                ledger.note_acquire("staging_lease", cap, token=id(buf),
+                                    tag="PinnedStagingPool.acquire")
+                return buf
             grow = self._held + cap <= self.max_bytes
             if grow:
                 self._held += cap
@@ -194,11 +210,21 @@ class PinnedStagingPool:
                     self.metrics["stagingPoolHeldBytes"] = self._held
                 grow = False
         arr = np.empty(cap, np.uint8)
-        return StagingBuffer(arr, nbytes, self, grow)
+        buf = StagingBuffer(arr, nbytes, self, grow)
+        ledger.note_acquire("staging_lease", cap, token=id(buf),
+                            tag="PinnedStagingPool.acquire")
+        return buf
 
     def release(self, buf: StagingBuffer):
+        from ..runtime import ledger
+        ledger.note_release("staging_lease", buf.capacity, token=id(buf))
         if not buf._cached:
             return                            # transient: let GC take it
+        if ledger.poison_enabled():
+            # turn latent use-after-release into deterministic garbage:
+            # the recycled array reads 0xAB, not whatever the next
+            # lease happens to write (the PR 4 corruption class)
+            buf.array.fill(ledger.POISON_BYTE)
         with self._lock:
             self._free.setdefault(buf.capacity, []).append(buf.array)
 

@@ -19,7 +19,7 @@ import itertools
 import threading
 from contextlib import contextmanager
 
-from ..runtime import lockdep
+from ..runtime import ledger, lockdep
 
 __all__ = ["TpuSemaphore"]
 
@@ -88,6 +88,7 @@ class TpuSemaphore:
             self._note_held(+1)
             self._cond.notify_all()
         lockdep.note_acquired(PERMIT)
+        ledger.note_acquire("permit", tag="TpuSemaphore.acquire")
         return waited
 
     def try_acquire(self) -> bool:
@@ -108,6 +109,7 @@ class TpuSemaphore:
                 got = False
         if got:
             lockdep.note_acquired(PERMIT)
+            ledger.note_acquire("permit", tag="TpuSemaphore.try_acquire")
         return got
 
     def release(self):
@@ -116,6 +118,7 @@ class TpuSemaphore:
             self._note_held(-1)
             self._cond.notify_all()
         lockdep.note_released(PERMIT)
+        ledger.note_release("permit")
 
     @contextmanager
     def hold(self, priority: int = 0, token=None):

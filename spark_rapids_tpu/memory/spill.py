@@ -198,6 +198,8 @@ class SpillableBatchHandle:
         self._batch = None
         self._host = None
         self.store._remove(self)
+        from ..runtime import ledger
+        ledger.note_release("spill_handle", token=self.id)
 
 
 class SpillStore:
@@ -246,6 +248,9 @@ class SpillStore:
         h = SpillableBatchHandle(self, batch, priority)
         with self._lock:
             self._handles[h.id] = h
+        from ..runtime import ledger
+        ledger.note_acquire("spill_handle", h.nbytes, token=h.id,
+                            tag=f"SpillStore.add_batch[{h.id[:8]}]")
         return h
 
     def _remove(self, h: SpillableBatchHandle):

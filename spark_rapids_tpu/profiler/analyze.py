@@ -140,6 +140,17 @@ def render_analyze(tree: dict, metrics_by_lore: Dict[Optional[int], dict],
         if m.get("semaphoreAcquires") is not None:
             ann.append(
                 f"semaphoreAcquires={int(m['semaphoreAcquires'])}")
+        # resource ledger (root node, when SRTPU_LEDGER/conf enabled):
+        # staging-lease traffic this action + the global balance sample
+        if m.get("ledgerBalanced") is not None:
+            parts = []
+            if m.get("ledgerLeaseAcquires"):
+                parts.append(f"leases={int(m['ledgerLeaseAcquires'])}")
+            if m.get("ledgerPeakLeases"):
+                parts.append(f"peak={int(m['ledgerPeakLeases'])}")
+            parts.append("balanced=" + ("yes" if m["ledgerBalanced"]
+                                        else "NO"))
+            ann.append("ledger[" + " ".join(parts) + "]")
         if ann:
             line += "  " + " ".join(ann)
         if lid in rank:

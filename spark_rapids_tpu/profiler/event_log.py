@@ -28,11 +28,19 @@ typed events the profiling tool post-processes:
                  runtime/result_cache.py; emitted when
                  sql.cache.enabled — fast_path=True records a
                  whole-query hit answered without admission)
-  query_cancelled{reason, lockdep?: {threads, findings, edges}}
+  query_cancelled{reason, lockdep?: {threads, findings, edges},
+                 ledger?: {kinds, holders, findings}}
                 (cooperative cancel / deadline kill; deadline kills
-                 attach the runtime/lockdep.py all-threads dump)
+                 attach the runtime/lockdep.py all-threads dump and the
+                 runtime/ledger.py outstanding-holders dump)
   concurrency_report{enabled, resources, orderEdges, maxOrderGraph,
                  acquires, findings}  (lockdep witness, when enabled)
+  resource_ledger{enabled, kinds: {kind: {acquires, releases,
+                 outstanding, peakOutstanding}}, balanceOk,
+                 balancedQueries, imbalancedQueries, findings}
+                (resource-lifetime ledger, runtime/ledger.py, when
+                 enabled — per-kind acquire/release counters and the
+                 per-query balance verdicts)
   query_end     {status: ok|error|cancelled|timeout, wall_s, error?}
 
 Locally `session.py` wraps every action (`profile_query`); the
@@ -272,16 +280,22 @@ def profile_query(session, root, ctx, action: str, handle=None):
             dump = getattr(e, "lockdep_dump", None)
             if dump is not None:
                 cancel_fields["lockdep"] = dump
+            ldump = getattr(e, "ledger_dump", None)
+            if ldump is not None:
+                cancel_fields["ledger"] = ldump
             w.emit("query_cancelled", **cancel_fields)
         raise
     finally:
         try:
             w.emit("op_metrics", ops=op_metrics_records(
                 root, ctx.metrics, ctx.metrics_level))
-            from ..runtime import lockdep
+            from ..runtime import ledger, lockdep
             lw = lockdep.witness()
             if lw is not None:
                 w.emit("concurrency_report", **lw.report())
+            lg = ledger.ledger()
+            if lg is not None:
+                w.emit("resource_ledger", **lg.report())
             w.emit("watermarks", **diagnostics.watermarks_snapshot())
             x1 = xla_stats.snapshot()
             w.emit("xla_compile",

@@ -201,12 +201,14 @@ def _unindex_locked(key, e: _Entry):
 def _release_host(dropped: List[_Entry]):
     """Return host-budget reservations AFTER _lock is dropped (keeps
     the ResultCache -> HostMemoryManager lock order one-way)."""
+    from . import ledger
     for e in dropped:
         if e.mgr is not None:
             try:
                 e.mgr.release(e.nbytes)
             except Exception:
                 pass
+        ledger.note_release("cache_charge", token=id(e))
 
 
 def _host_mgr(conf):
@@ -255,6 +257,9 @@ def _store(key, entry: _Entry, conf):
                     _stats["result_cache_rejected"] += 1
                 return False
         entry.mgr = mgr
+    from . import ledger
+    ledger.note_acquire("cache_charge", entry.nbytes, token=id(entry),
+                        tag=f"result_cache[{entry.tier}]")
     dropped = []
     with _lock:
         old = _entries.pop(key, None)

@@ -93,9 +93,12 @@ class CancelToken:
             e = QueryTimedOut(self.query_id, self.timeout_secs)
             # a deadline kill is where PR 8's deadlocks used to surface
             # as bare timeouts: attach the all-threads held-resource
-            # dump so the exception (and event log) says WHO was stuck
-            from ..runtime import lockdep
+            # dump so the exception (and event log) says WHO was stuck,
+            # plus the resource ledger's outstanding-holders table (who
+            # still holds leases/permits/handles, on which thread)
+            from ..runtime import ledger, lockdep
             lockdep.attach_dump(e)
+            ledger.attach_dump(e)
             raise e
 
 
@@ -367,6 +370,18 @@ class QueryManager:
         except Exception:
             pass
         h._done.set()
+        # resource-ledger balance witness: EVERY terminal state —
+        # FINISHED, CANCELLED, TIMED_OUT alike — must leave the query's
+        # owner-scoped resources (leases, permits, ride slots) balanced.
+        # A clean finish with a leak raises to the caller; on an error
+        # path the finding is recorded but must not mask the original
+        # error.
+        from ..runtime import ledger
+        try:
+            ledger.note_query_end(h.query_id, h.state)
+        except ledger.ResourceLeakError:
+            if error is None:
+                raise
 
     # -- cancellation ---------------------------------------------------
     def cancel(self, handle_or_id, reason: str = "cancelled") -> bool:

@@ -49,8 +49,9 @@ class TpuSession:
         from .config import RETRY_COVERAGE_ENABLED
         from .memory.diagnostics import enable_retry_coverage
         enable_retry_coverage(bool(self.conf.get(RETRY_COVERAGE_ENABLED)))
-        from .runtime import lockdep
+        from .runtime import ledger, lockdep
         lockdep.maybe_enable_from_conf(self.conf)
+        ledger.maybe_enable_from_conf(self.conf)
 
     @staticmethod
     def builder_get_or_create(conf: Optional[Dict] = None) -> "TpuSession":
@@ -877,6 +878,10 @@ class DataFrame:
         sem = getattr(self._session, "_semaphore", None)
         sem_acq0 = sem.metrics["acquires"] if sem is not None else 0
         xla0 = xla_stats.snapshot()
+        from .runtime import ledger as _ledger
+        lg = _ledger.ledger()
+        lease_acq0 = (lg.report()["kinds"].get("staging_lease", {})
+                      .get("acquires", 0) if lg is not None else 0)
         _ACTION_TLS.handle = handle if not nested else \
             getattr(_ACTION_TLS, "handle", None)
         from .runtime import result_cache
@@ -975,6 +980,18 @@ class DataFrame:
             acq = sem.metrics["acquires"] - sem_acq0
             if acq:
                 rm.add("semaphoreAcquires", int(acq))
+        if lg is not None:
+            # resource-ledger accounting on the root MetricSet (flows
+            # into EXPLAIN ANALYZE): lease traffic this action plus the
+            # per-query balance verdict — global-counter diffs, like the
+            # cache counters above
+            rep = lg.report()
+            sk = rep["kinds"].get("staging_lease", {})
+            d = int(sk.get("acquires", 0) - lease_acq0)
+            if d:
+                rm.add("ledgerLeaseAcquires", d)
+            rm.add("ledgerPeakLeases", int(sk.get("peakOutstanding", 0)))
+            rm.add("ledgerBalanced", int(bool(rep["balanceOk"])))
         self._last_root = root
         self._last_metrics = {op: ms.snapshot(ctx.metrics_level)
                               for op, ms in ctx.metrics.items()}

@@ -9,6 +9,11 @@ import os
 # Any lock-order cycle or pool self-wait the tests drive the engine into
 # raises at formation time instead of hanging the suite.
 os.environ.setdefault("SRTPU_LOCKDEP", "1")
+# Resource-ledger witness for the WHOLE suite (runtime/ledger.py): every
+# query the tests run must end every terminal state (FINISHED, CANCELLED,
+# TIMED_OUT) with balanced query-scoped acquire/release counters, or
+# QueryManager._finalize raises ResourceLeakError and the test fails.
+os.environ.setdefault("SRTPU_LEDGER", "1")
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -1,0 +1,48 @@
+"""Archived PRE-FIX shape of the PR 4 staging-lease recycle race.
+
+The device-decode path staged compressed parquet pages into a
+PinnedStagingPool lease, aliased the staging memory zero-copy into a
+jnp array (`np.frombuffer(lease.view())` then `jnp.asarray(dst)` — on
+the CPU backend asarray may NOT copy), and released the lease in the
+`finally` as soon as the Python-level decode returned. XLA dispatch is
+asynchronous: the decompress/decode kernels were still queued when the
+pool handed the same buffer to the next chunk, which overwrote the
+bytes the in-flight kernels were reading. Symptom in production:
+rare wrong column values under concurrent scans, never under
+single-query runs.
+
+The live fix (exec/nodes.py prefetch worker) calls
+`jax.block_until_ready(outs)` on the decode OUTPUTS before any
+`chunk.close()`; the runtime ledger's poison mode (SRTPU_LEDGER_POISON,
+runtime/ledger.py) fills released staging buffers with 0xAB so the
+pre-fix shape fails loudly instead of corrupting results.
+
+tests/test_lifetime_audit.py asserts the static analyzer
+(analysis/lifetime.py) flags the release below as
+`release-before-sync`. Never imported by the engine.
+"""
+import numpy as np
+import jax.numpy as jnp
+
+
+class DeviceDecoder:
+    """Pre-fix device decode: stage -> alias -> dispatch -> release."""
+
+    def __init__(self, pool):
+        self.pool = pool
+
+    def decode_chunk(self, raw: bytes):
+        lease = self.pool.acquire(len(raw))
+        try:
+            # aliasing view over pinned staging memory
+            dst = np.frombuffer(lease.view(), np.uint8)[:len(raw)]
+            dst[:] = np.frombuffer(raw, np.uint8)
+            # async dispatch; on the CPU backend this can alias `dst`
+            # zero-copy instead of snapshotting it
+            col = jnp.asarray(dst)
+        finally:
+            # BUG (the PR 4 race): the lease returns to the pool while
+            # queued kernels may still read the aliased buffer — no
+            # block_until_ready on the decode outputs first
+            lease.release()
+        return col

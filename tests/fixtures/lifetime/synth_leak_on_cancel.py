@@ -1,0 +1,29 @@
+"""Synthetic leak-on-cancel: a staging lease lost to a checkpoint exit.
+
+The engine's cooperative-cancellation contract (service/query_manager)
+means any batch loop can raise QueryCancelled / DeadlineExceeded at a
+`token.check()` checkpoint. An acquire/release pair with the release on
+the straight-line path only — no try/finally, no context manager —
+leaks the resource on every cancelled, timed-out, or failed execution.
+Leaked pinned staging leases are the worst case: the pool's free list
+never recovers the buffer, so steady-state cancel traffic starves every
+later query's H2D staging (the runtime ledger surfaces exactly this as
+an unbalanced `staging_lease` count at query end).
+
+tests/test_lifetime_audit.py asserts the static analyzer
+(analysis/lifetime.py) flags the acquisition below as
+`leak-on-exception`. Never imported by the engine.
+"""
+
+
+def assemble_partition(pool, token, parts):
+    lease = pool.acquire(sum(len(p) for p in parts))
+    view = lease.view()
+    pos = 0
+    for p in parts:
+        token.check()   # cancel checkpoint: raises on cancel/deadline
+        view[pos:pos + len(p)] = p
+        pos += len(p)
+    out = bytes(view[:pos])
+    lease.release()     # never reached when a checkpoint fires
+    return out

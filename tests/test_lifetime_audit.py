@@ -1,0 +1,408 @@
+"""Static resource-lifetime audit (analysis/lifetime.py): the archived
+PR 4 staging race and the synthetic leak-on-cancel must be re-detected,
+each rule must separate its offending shape from the clean idiom
+(try/finally, context manager, compensation handler, ownership
+transfer), allow markers and the baseline must behave like the other
+tpulint analyzers, and the live tree must be clean against a committed
+EMPTY baseline."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from spark_rapids_tpu.analysis.lifetime import (LIFETIME_RULES,
+                                                analyze_paths,
+                                                analyze_source)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "lifetime")
+ENGINE = os.path.join(ROOT, "spark_rapids_tpu")
+
+
+def _rules(violations):
+    rules = {v.rule for v in violations}
+    assert rules <= set(LIFETIME_RULES)
+    return rules
+
+
+# ---------------------------------------------------------------------
+# the archived fixtures
+# ---------------------------------------------------------------------
+def test_pr4_staging_race_fixture_detected():
+    """The PR 4 pre-fix shape: lease buffer aliased into a jnp array,
+    released in the finally with no block_until_ready on the outputs."""
+    vs = analyze_paths(
+        [os.path.join(FIXTURES, "prfix_staging_release_before_sync.py")],
+        rel_to=ROOT)
+    assert _rules(vs) == {"release-before-sync"}
+    v = vs[0]
+    assert "lease.release()" in v.snippet
+    assert "block_until_ready" in v.message
+    assert "PR 4" in v.message
+
+
+def test_leak_on_cancel_fixture_detected():
+    vs = analyze_paths(
+        [os.path.join(FIXTURES, "synth_leak_on_cancel.py")],
+        rel_to=ROOT)
+    assert _rules(vs) == {"leak-on-exception"}
+    assert "cancel-checkpoint" in vs[0].message
+
+
+def test_fixed_shape_of_pr4_is_clean():
+    """Adding the live fix (sync before release) to the archived shape
+    silences the analyzer — the rule keys on the missing barrier, not
+    on staging use per se."""
+    src = """\
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+
+def decode_chunk(pool, raw):
+    lease = pool.acquire(len(raw))
+    try:
+        dst = np.frombuffer(lease.view(), np.uint8)[:len(raw)]
+        dst[:] = np.frombuffer(raw, np.uint8)
+        col = jnp.asarray(dst)
+        jax.block_until_ready(col)
+    finally:
+        lease.release()
+    return col
+"""
+    assert analyze_source(src, path="fixed.py", mod="fixed") == []
+
+
+# ---------------------------------------------------------------------
+# per-rule units: offending shape vs clean idiom
+# ---------------------------------------------------------------------
+def test_leak_when_never_released():
+    src = """\
+def f(pool, parts, token):
+    lease = pool.acquire(100)
+    for p in parts:
+        token.check()
+    return len(parts)
+"""
+    vs = analyze_source(src, path="m.py", mod="m")
+    assert _rules(vs) == {"leak-on-exception"}
+    assert "never released" in vs[0].message
+
+
+def test_leak_when_release_is_straight_line_only():
+    src = """\
+def f(pool, token):
+    lease = pool.acquire(100)
+    token.check()
+    lease.release()
+"""
+    vs = analyze_source(src, path="m.py", mod="m")
+    assert _rules(vs) == {"leak-on-exception"}
+    assert "straight-line" in vs[0].message
+
+
+def test_try_finally_release_is_clean():
+    src = """\
+import numpy as np
+
+
+def f(pool, n):
+    lease = pool.acquire(n)
+    try:
+        dst = np.frombuffer(lease.view(), np.uint8)
+        dst[:] = 0
+    finally:
+        lease.release()
+"""
+    assert analyze_source(src, path="m.py", mod="m") == []
+
+
+def test_context_manager_lease_is_clean():
+    src = """\
+def f(pool):
+    with pool.acquire(32) as lease:
+        n = lease.nbytes
+    return n
+"""
+    assert analyze_source(src, path="m.py", mod="m") == []
+
+
+def test_compensation_handler_counts_as_protection():
+    """release-then-reraise in an except handler is the engine's
+    reserve-compensation idiom (shuffle/local.py arena reservation) —
+    protected, not a leak."""
+    src = """\
+def f(hm):
+    hm.reserve(100)
+    try:
+        arena = build_arena()
+    except MemoryError:
+        hm.release(100)
+        raise
+    return arena
+"""
+    assert analyze_source(src, path="m.py", mod="m") == []
+
+
+def test_ownership_transfer_is_not_a_leak():
+    """Appending the handle to an owner collection (or registering a
+    cleanup) transfers ownership out of the function: interprocedural
+    balance is the runtime ledger's job, not this rule's."""
+    src = """\
+def f(pool, owned):
+    lease = pool.acquire(64)
+    owned.append(lease)
+
+
+def g(pool, ctx):
+    lease = pool.acquire(64)
+    ctx.add_cleanup(lease.release)
+"""
+    assert analyze_source(src, path="m.py", mod="m") == []
+
+
+def test_permit_acquire_without_finally_flagged():
+    src = """\
+def f(sem, token):
+    sem.acquire()
+    token.check()
+    sem.release()
+
+
+def g(sem, token):
+    sem.acquire()
+    try:
+        token.check()
+    finally:
+        sem.release()
+"""
+    vs = analyze_source(src, path="m.py", mod="m")
+    assert [v.rule for v in vs] == ["leak-on-exception"]
+    assert vs[0].line == 2   # f's acquire, not g's
+
+
+def test_double_release_detected():
+    src = """\
+def f(pool):
+    lease = pool.acquire(8)
+    lease.release()
+    lease.release()
+"""
+    vs = analyze_source(src, path="m.py", mod="m")
+    assert [v.rule for v in vs] == ["double-release"]
+
+
+def test_branch_releases_are_not_double():
+    """One release per If arm is balanced, not a double-release."""
+    src = """\
+def f(pool, cond):
+    lease = pool.acquire(8)
+    try:
+        if cond:
+            lease.release()
+        else:
+            lease.release()
+    finally:
+        pass
+"""
+    vs = analyze_source(src, path="m.py", mod="m")
+    assert "double-release" not in {v.rule for v in vs}
+
+
+def test_use_after_release_detected():
+    src = """\
+def f(pool):
+    lease = pool.acquire(8)
+    lease.release()
+    return lease.view()
+"""
+    vs = analyze_source(src, path="m.py", mod="m")
+    assert [v.rule for v in vs] == ["use-after-release"]
+    assert "recycled" in vs[0].message
+
+
+def test_use_after_release_through_derived_alias():
+    """np.frombuffer over lease.view() aliases the staging memory: a
+    use of the DERIVED array after release is the same bug."""
+    src = """\
+import numpy as np
+
+
+def f(pool):
+    lease = pool.acquire(8)
+    dst = np.frombuffer(lease.view(), np.uint8)
+    lease.release()
+    return dst.sum()
+"""
+    vs = analyze_source(src, path="m.py", mod="m")
+    assert "use-after-release" in {v.rule for v in vs}
+
+
+def test_unbalanced_transfer_detected(tmp_path):
+    src = """\
+def worker(lease):
+    data = lease.view()
+    lease.release()
+
+
+def f(pool, ex):
+    lease = pool.acquire(64)
+    ex.submit(worker, lease)
+"""
+    p = tmp_path / "xfer.py"
+    p.write_text(src)
+    vs = analyze_paths([str(p)], rel_to=str(tmp_path))
+    assert [v.rule for v in vs] == ["unbalanced-transfer"]
+    assert "worker" in vs[0].message
+
+
+def test_transfer_to_finally_protected_worker_is_clean(tmp_path):
+    src = """\
+def worker(lease):
+    try:
+        data = lease.view()
+    finally:
+        lease.release()
+
+
+def f(pool, ex):
+    lease = pool.acquire(64)
+    ex.submit(worker, lease)
+"""
+    p = tmp_path / "xfer_ok.py"
+    p.write_text(src)
+    assert analyze_paths([str(p)], rel_to=str(tmp_path)) == []
+
+
+def test_thread_target_transfer_detected(tmp_path):
+    src = """\
+import threading
+
+
+def worker(h):
+    h.close()
+
+
+def f(store, b):
+    h = store.add_batch(b)
+    t = threading.Thread(target=worker, args=(h,))
+    t.start()
+"""
+    p = tmp_path / "xfer_thread.py"
+    p.write_text(src)
+    vs = analyze_paths([str(p)], rel_to=str(tmp_path))
+    assert [v.rule for v in vs] == ["unbalanced-transfer"]
+
+
+# ---------------------------------------------------------------------
+# markers + baseline machinery (shared with the other analyzers)
+# ---------------------------------------------------------------------
+def test_allow_marker_suppresses_with_reason():
+    src = """\
+def f(pool, token):
+    # tpulint: allow[leak-on-exception] demo: released by caller contract
+    lease = pool.acquire(8)
+    token.check()
+    lease.release()
+"""
+    assert analyze_source(src, path="m.py", mod="m") == []
+
+
+def test_baseline_diffing_with_lifetime_violations():
+    from spark_rapids_tpu.analysis.lint_rules import (baseline_entries,
+                                                      diff_baseline)
+    vs = analyze_paths(
+        [os.path.join(FIXTURES, "synth_leak_on_cancel.py")],
+        rel_to=ROOT)
+    assert vs
+    accepted = baseline_entries(vs, "archived fixture")["entries"]
+    new, stale = diff_baseline(vs, accepted)
+    assert new == [] and stale == []
+    new, stale = diff_baseline(vs, [])
+    assert len(new) == len(vs) and stale == []
+    ghost = dict(accepted[0])
+    ghost["snippet"] = "gone_from_the_tree()"
+    new, stale = diff_baseline(vs, accepted + [ghost])
+    assert new == [] and len(stale) == 1
+
+
+# ---------------------------------------------------------------------
+# the live tree
+# ---------------------------------------------------------------------
+def test_engine_tree_is_clean():
+    """Every intentional site is inline-annotated; the committed
+    lifetime baseline stays EMPTY — the engine carries no accepted
+    lifetime hazards."""
+    assert analyze_paths([ENGINE], rel_to=ROOT) == []
+    with open(os.path.join(ROOT, "tools",
+                           "tpulint_lifetime_baseline.json")) as f:
+        assert json.load(f)["entries"] == []
+
+
+@pytest.mark.slow
+def test_tpulint_lifetime_cli_check_mode():
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+         "--lifetime", "--check"],
+        capture_output=True, text=True, cwd=ROOT)
+    assert out.returncode == 0, out.stdout + out.stderr
+    assert "0 new" in out.stdout
+
+
+# ---------------------------------------------------------------------
+# satellite: fp-unstable-attr (lint_rules.py)
+# ---------------------------------------------------------------------
+def test_fp_unstable_attr_flags_counter_identity():
+    from spark_rapids_tpu.analysis.lint_rules import lint_source
+    src = """\
+import itertools
+import uuid
+
+_ids = itertools.count()
+
+
+class ProjectExec:
+    def __init__(self, child):
+        self.node_id = next(_ids)          # fp-visible counter: BAD
+        self.token = uuid.uuid4().hex      # fp-visible uuid: BAD
+        self._op_id = next(_ids)           # fingerprint-skipped: fine
+        self._jit_cache_key = id(child)    # _jit* prefix: fine
+        self._program_cache = {}           # _*_cache: fine
+        self.columns = list(child)         # structural: fine
+"""
+    vs = lint_source(src, path="spark_rapids_tpu/exec/synth.py")
+    bad = [v for v in vs if v.rule == "fp-unstable-attr"]
+    assert {v.line for v in bad} == {9, 10}
+    assert all("fingerprint" in v.message for v in bad)
+
+
+def test_fp_unstable_attr_scoped_to_plan_and_exec():
+    from spark_rapids_tpu.analysis.lint_rules import lint_source
+    src = """\
+import itertools
+
+_ids = itertools.count()
+
+
+class Worker:
+    def __init__(self):
+        self.worker_id = next(_ids)
+"""
+    # runtime/ modules are not fingerprinted: out of scope
+    vs = lint_source(src, path="spark_rapids_tpu/runtime/synth.py")
+    assert [v for v in vs if v.rule == "fp-unstable-attr"] == []
+    vs = lint_source(src, path="spark_rapids_tpu/plan/synth.py")
+    assert [v.rule for v in vs] == ["fp-unstable-attr"]
+
+
+def test_fp_unstable_attr_ignores_data_iterators():
+    from spark_rapids_tpu.analysis.lint_rules import lint_source
+    src = """\
+class ScanExec:
+    def __init__(self, batches):
+        self.first = next(iter(batches))
+"""
+    vs = lint_source(src, path="spark_rapids_tpu/exec/synth.py")
+    assert [v for v in vs if v.rule == "fp-unstable-attr"] == []

@@ -16,6 +16,8 @@ _ROOT = os.path.normpath(os.path.join(os.path.dirname(__file__), ".."))
 _BASELINE = os.path.join(_ROOT, "tools", "tpulint_baseline.json")
 _CONC_BASELINE = os.path.join(_ROOT, "tools",
                               "tpulint_concurrency_baseline.json")
+_LIFETIME_BASELINE = os.path.join(_ROOT, "tools",
+                                  "tpulint_lifetime_baseline.json")
 
 
 def test_tpulint_clean_against_committed_baseline():
@@ -69,6 +71,33 @@ def test_tpulint_concurrency_cli_check_clean():
     r = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "tpulint.py"),
          "--concurrency", "--check"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_lifetime_audit_clean_against_committed_baseline():
+    """The resource-lifetime pass (analysis/lifetime.py) runs clean:
+    every intentional acquire/release shape carries an inline allow
+    marker and the committed lifetime baseline stays EMPTY — the
+    engine accepts no lifetime hazards."""
+    from spark_rapids_tpu.analysis.lifetime import analyze_paths
+    violations = analyze_paths([os.path.join(_ROOT, "spark_rapids_tpu")],
+                               rel_to=_ROOT)
+    baseline = load_baseline(_LIFETIME_BASELINE)
+    assert baseline == [], (
+        "lifetime baseline must stay empty — annotate intentional "
+        "sites inline instead")
+    new, stale = diff_baseline(violations, baseline)
+    assert not new, (
+        "new lifetime violations (fix them or add a "
+        "`# tpulint: allow[<rule>] <reason>` marker):\n"
+        + "\n".join(v.describe() for v in new))
+
+
+def test_tpulint_lifetime_cli_check_clean():
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "tpulint.py"),
+         "--lifetime", "--check"],
         capture_output=True, text=True)
     assert r.returncode == 0, r.stdout + r.stderr
 

@@ -20,6 +20,12 @@ Usage:
                 #  same allow markers, separate baseline file)
   python tools/tpulint.py --concurrency --check # strict CI gate: stale
                 # baseline entries fail too, keeping the baseline honest
+  python tools/tpulint.py --lifetime            # resource-lifetime audit
+                # (analysis/lifetime.py: leak-on-exception,
+                #  double-release, use-after-release,
+                #  release-before-sync, unbalanced-transfer; same allow
+                #  markers, separate baseline — committed EMPTY: the
+                #  live tree holds no accepted lifetime hazards)
 
 Exit codes: 0 clean, 1 new violations (or baseline entries without a
 reason), 2 usage error.
@@ -38,6 +44,8 @@ from spark_rapids_tpu.analysis.lint_rules import (  # noqa: E402
 DEFAULT_BASELINE = os.path.join(_ROOT, "tools", "tpulint_baseline.json")
 DEFAULT_CONC_BASELINE = os.path.join(
     _ROOT, "tools", "tpulint_concurrency_baseline.json")
+DEFAULT_LIFETIME_BASELINE = os.path.join(
+    _ROOT, "tools", "tpulint_lifetime_baseline.json")
 
 
 def main(argv=None) -> int:
@@ -50,6 +58,10 @@ def main(argv=None) -> int:
     ap.add_argument("--concurrency", action="store_true",
                     help="run the interprocedural concurrency audit "
                          "instead of the per-line hazard rules")
+    ap.add_argument("--lifetime", action="store_true",
+                    help="run the resource-lifetime audit (acquire/"
+                         "release shape analysis) instead of the "
+                         "per-line hazard rules")
     ap.add_argument("--check", action="store_true",
                     help="strict mode: stale baseline entries are "
                          "failures too (CI gate)")
@@ -63,8 +75,13 @@ def main(argv=None) -> int:
                     help="emit JSON instead of text")
     args = ap.parse_args(argv)
 
+    if args.concurrency and args.lifetime:
+        print("tpulint: pick one of --concurrency/--lifetime per run",
+              file=sys.stderr)
+        return 2
     if args.baseline is None:
         args.baseline = (DEFAULT_CONC_BASELINE if args.concurrency
+                         else DEFAULT_LIFETIME_BASELINE if args.lifetime
                          else DEFAULT_BASELINE)
     paths = args.paths or [os.path.join(_ROOT, "spark_rapids_tpu")]
     for p in paths:
@@ -73,6 +90,9 @@ def main(argv=None) -> int:
             return 2
     if args.concurrency:
         from spark_rapids_tpu.analysis.concurrency import analyze_paths
+        violations = analyze_paths(paths, rel_to=_ROOT)
+    elif args.lifetime:
+        from spark_rapids_tpu.analysis.lifetime import analyze_paths
         violations = analyze_paths(paths, rel_to=_ROOT)
     else:
         violations = lint_paths(paths, rel_to=_ROOT)
