@@ -88,6 +88,24 @@ if "--concurrent" in sys.argv[1:]:
         print("bench: --concurrent needs a stream count", file=sys.stderr)
         sys.exit(2)
 
+# --chaos SEED: fault-injection soak — concurrent TPC-H under a
+# randomized (but seeded, reproducible) fault plan, asserting
+# byte-identical results, zero strict-kind ledger imbalance, and
+# bounded retries. The plan is derived from SEED alone: re-running
+# with the same seed re-derives the same plan.
+_CHAOS = None
+if "--chaos" in sys.argv[1:]:
+    _ci = sys.argv.index("--chaos")
+    try:
+        _CHAOS = int(sys.argv[_ci + 1])
+    except (IndexError, ValueError):
+        print("bench: --chaos needs an integer seed", file=sys.stderr)
+        sys.exit(2)
+    # the soak's cleanliness claims need both witnesses live from the
+    # first engine import
+    os.environ.setdefault("SRTPU_LOCKDEP", "1")
+    os.environ.setdefault("SRTPU_LEDGER", "1")
+
 # --zipfian (with --concurrent N): repeat-heavy variant — streams draw
 # from a zipfian query mix through a cache-ENABLED session, with
 # interleaved side-table writes proving invalidation soundness. This is
@@ -270,6 +288,33 @@ def _main_impl():
     import spark_rapids_tpu as st
     from spark_rapids_tpu.columnar.column import Column
     from spark_rapids_tpu.workloads import tpch
+
+    # ---- standalone chaos soak: bench.py --chaos SEED -----------------
+    if _CHAOS is not None:
+        sf_c = float(os.environ.get("BENCH_SF_FULL",
+                                    "0.05" if _SMOKE else "0.2"))
+        with _alarm(_remaining() - 15.0, f"chaos soak seed={_CHAOS}"):
+            soak = _chaos_soak(st, sf_c, _CHAOS,
+                               n_streams=2 if _SMOKE else 4)
+        print(json.dumps({
+            "metric": f"tpch_chaos_soak_sf{sf_c}",
+            "value": soak["queries_completed"],
+            "unit": "queries",
+            "vs_baseline": None,
+            **({"backend_fallback": "cpu (tpu unreachable)",
+                "tpu_probe_errors": tpu_errors} if fellback else {}),
+            "extra": soak,
+        }))
+        if not soak["ok"]:
+            print(f"bench: chaos soak FAILED: "
+                  f"mismatched={soak['mismatched']} "
+                  f"errors={soak.get('errors')} "
+                  f"ledger_ok={soak['ledger'].get('balanceOk')} "
+                  f"lockdep_findings="
+                  f"{soak['lockdep'].get('findings')}",
+                  file=sys.stderr)
+            sys.exit(1)
+        return
 
     # ---- standalone throughput mode: bench.py --concurrent N ----------
     if _CONCURRENT:
@@ -508,6 +553,31 @@ def _main_impl():
             _partial["extra"]["result_cache"] = {"error": repr(e)[:300]}
             print(f"bench: result cache smoke failed: {e!r}",
                   file=sys.stderr)
+        # chaos smoke (ISSUE 14): a short seeded fault-injection soak
+        # over a fast query subset — injected/recovered counters land
+        # in extra.chaos and survive partial flushes
+        try:
+            with _alarm(max(0.0, _remaining() - 30.0), "chaos smoke"):
+                soak = _chaos_soak(st, sf_full, seed=7, n_streams=2,
+                                   qids=(3, 6, 12))
+            _partial["extra"]["chaos"] = {
+                "ok": soak["ok"],
+                "seed": soak["seed"],
+                "queries_completed": soak["queries_completed"],
+                "mismatched": soak["mismatched"],
+                "injected": soak["injected"],
+                "recovered": soak["recovered"],
+                "regenerations": soak["regenerations"],
+                "query_retries": soak["query_retries"],
+                "degradations": soak["degradations"],
+                **({"errors": soak["errors"]}
+                   if soak.get("errors") else {}),
+            }
+        except _BenchTimeout as e:
+            _partial["extra"]["chaos"] = {"error": f"timeout: {e}"}
+        except Exception as e:  # advisory: never lose the bench result
+            _partial["extra"]["chaos"] = {"error": repr(e)[:300]}
+            print(f"bench: chaos smoke failed: {e!r}", file=sys.stderr)
         # 2-stream throughput variant: the concurrent query service's
         # smoke surface (byte-identical to serial, no leaks after a
         # forced cancel, service counters in extra.service). This is
@@ -594,7 +664,7 @@ def _main_impl():
             _partial["extra"]["ledger"] = _lg.report()
     for k in ("scan_profile", "smoke", "fresh_rerun_compiles",
               "concurrent_2stream", "service", "exchange", "lockdep",
-              "result_cache", "aqe", "ledger"):
+              "result_cache", "aqe", "ledger", "chaos"):
         if k in _partial["extra"]:
             extra[k] = _partial["extra"][k]
     # ---- regression gate vs the previous round's JSON -------------------
@@ -729,6 +799,126 @@ def _tpch_sweep(s, sf: float):
         out["tpch_profile"] = profile
     if errors:
         out["tpch_all22_errors"] = errors
+    return out
+
+
+def _chaos_soak(st, sf: float, seed: int, n_streams: int = 2,
+                qids=(1, 3, 6, 12, 14), max_retries: int = 8) -> dict:
+    """Fault-injection soak (ISSUE 14 acceptance): derive a randomized
+    fault plan from `seed` alone, run N concurrent TPC-H streams through
+    the SYNC path (so the service-level transparent retry, degradation,
+    and OOM-retry recovery paths are all live), and require every result
+    byte-identical to the fault-free serial reference, the strict-kind
+    resource ledger balanced, and retries bounded. Same seed => same
+    plan => same injection decisions for a fixed execution order."""
+    import random
+    import threading
+
+    from spark_rapids_tpu.runtime import faults
+    from spark_rapids_tpu.runtime import ledger as _ledger
+    from spark_rapids_tpu.workloads import tpch
+
+    s = st.TpuSession({
+        # the cross-query result cache would serve the reference bytes
+        # back verbatim and mask every downstream fault point
+        "spark.rapids.tpu.sql.resultCache.enabled": "false",
+        "spark.rapids.tpu.sql.service.maxQueryRetries":
+            str(max_retries),
+    })
+    tabs = tpch.gen_all(sf=sf, seed=7)
+    dfs = {k: s.create_dataframe(v).cache() for k, v in tabs.items()}
+    reg = tpch.queries()
+    qids = [q for q in qids if q in reg]
+
+    # fault-free serial reference (also warms the program cache, so the
+    # chaos pass measures recovery, not compiles)
+    faults.clear_plan()
+    serial = {qn: reg[qn](dfs).to_arrow() for qn in qids}
+
+    # randomized-but-reproducible plan: every named point armed with a
+    # seeded low-probability transient raise (kill/delay excluded: the
+    # in-process soak must not kill the bench, and delays only stretch
+    # the budget without exercising a recovery path)
+    rng = random.Random(seed)
+    raises = ["FetchFailed", "RESOURCE_EXHAUSTED", "ChaosError"]
+    rules = []
+    for point in sorted(faults.POINTS):
+        prob = round(rng.uniform(0.05, 0.12), 3)
+        rules.append(f"{point}:prob={prob}"
+                     f":seed={rng.randrange(1 << 16)}"
+                     f":raise={rng.choice(raises)}")
+    plan = ";".join(rules)
+
+    faults.reset_recovery_stats()
+    faults.install_plan(plan)
+    results, errors = [], []
+    lock = threading.Lock()
+
+    def stream(i: int):
+        order = qids[:]
+        random.Random(seed * 1000 + i).shuffle(order)
+        for qn in order:
+            try:
+                tbl = reg[qn](dfs).to_arrow()
+                with lock:
+                    results.append((qn, tbl))
+            except Exception as e:  # noqa: BLE001 — reported in JSON
+                with lock:
+                    errors.append(f"stream{i} q{qn}: {e!r}")
+
+    try:
+        threads = [threading.Thread(target=stream, args=(i,),
+                                    name=f"chaos-stream-{i}")
+                   for i in range(n_streams)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+    finally:
+        # clear_plan() wipes the injection counters with the rules, so
+        # snapshot them first
+        counts = faults.injection_counts()
+        faults.clear_plan()
+
+    mismatched = sorted({qn for qn, tbl in results
+                         if not tbl.equals(serial[qn])})
+    rec = faults.recovery_stats()
+    lg = _ledger.ledger()
+    led = lg.report() if lg is not None else {"enabled": False,
+                                             "balanceOk": True}
+    from spark_rapids_tpu.runtime import lockdep as _lockdep
+    lw = _lockdep.witness()
+    lockrep = lw.report() if lw is not None else {"enabled": False,
+                                                 "findings": 0}
+    retries = rec.get("query_retries", 0)
+    retry_budget = len(qids) * n_streams * max_retries
+    for df in dfs.values():
+        df.uncache()
+    out = {
+        "seed": seed,
+        "plan": plan,
+        "streams": n_streams,
+        "sf": sf,
+        "wall_s": round(wall, 3),
+        "queries_completed": len(results),
+        "mismatched": mismatched,
+        "injected": counts,
+        "recovered": rec,
+        "regenerations": rec.get("regenerations", 0),
+        "query_retries": retries,
+        "degradations": rec.get("degradations", 0),
+        "retries_bounded": retries <= retry_budget,
+        "ledger": led,
+        "lockdep": lockrep,
+        "ok": (not mismatched and not errors
+               and retries <= retry_budget
+               and bool(led.get("balanceOk", True))
+               and int(lockrep.get("findings", 0)) == 0),
+    }
+    if errors:
+        out["errors"] = errors[:10]
     return out
 
 

@@ -46,6 +46,14 @@ module turns those conventions into machine-checked rules (consumed by
                    cross-query caches miss forever. Identity attrs must
                    be fingerprint-skipped names (`_op_id`, `lore_id`,
                    `_cached`, `_jit*`, `_*_cache`) or underscore-private
+  retry-swallows-cancel
+                   a broad `except Exception` (or bare except) inside a
+                   retry loop whose handler neither re-raises nor
+                   consults the cancellation/transience classifiers:
+                   the loop would eat QueryCancelled/KeyboardInterrupt
+                   and retry a query the user already killed — retry
+                   handlers must re-raise, or route through
+                   is_transient_error/is_oom_error/check_cancel
   allow-no-reason  a `# tpulint: allow[...]` marker without a reason —
                    every accepted violation must say why
 
@@ -690,6 +698,94 @@ def rule_fp_unstable_attr(ctx: _ModuleCtx):
                        f"structurally")
 
 
+#: identifiers whose presence in a broad retry handler shows the author
+#: thought about cancellation/transience classification (the classifier
+#: helpers, the cancel exception types, and the token itself)
+_CANCEL_AWARE_NAMES = {"QueryCancelled", "QueryTimedOut",
+                       "KeyboardInterrupt", "GeneratorExit",
+                       "CancelToken", "check_cancel",
+                       "is_oom_error", "is_transient_error"}
+#: a loop (or its enclosing function) is retry-shaped when any bound
+#: name smells like retry machinery
+_RETRYISH_RE = re.compile(r"retr(y|ies)|attempt|backoff", re.IGNORECASE)
+
+
+def rule_retry_swallows_cancel(ctx: _ModuleCtx):
+    """Flag a broad `except Exception` / `except BaseException` / bare
+    `except` inside a retry-shaped loop (the enclosing function or any
+    name in the loop matches retry/attempt/backoff) whose handler body
+    neither contains a `raise` nor references any cancellation-aware
+    name (QueryCancelled, KeyboardInterrupt, CancelToken, check_cancel,
+    is_oom_error, is_transient_error). Such a handler retries
+    EVERYTHING — including a cancellation the user already issued or a
+    deadline the service already enforced — turning "kill this query"
+    into "run it max_retries more times". Retry handlers must re-raise
+    on the non-transient path or classify before continuing."""
+
+    def broad(h: ast.ExceptHandler) -> bool:
+        if h.type is None:
+            return True
+        elts = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+        return any(isinstance(e, ast.Name)
+                   and e.id in ("Exception", "BaseException")
+                   for e in elts)
+
+    def handler_aware(h: ast.ExceptHandler) -> bool:
+        for s in h.body:
+            for n in ast.walk(s):
+                if isinstance(n, ast.Raise):
+                    return True
+                if isinstance(n, ast.Name) \
+                        and n.id in _CANCEL_AWARE_NAMES:
+                    return True
+                if isinstance(n, ast.Attribute) \
+                        and n.attr in _CANCEL_AWARE_NAMES:
+                    return True
+        return False
+
+    def retryish(loop, fn_name: Optional[str]) -> bool:
+        if fn_name and _RETRYISH_RE.search(fn_name):
+            return True
+        for n in ast.walk(loop):
+            if isinstance(n, ast.Name) and _RETRYISH_RE.search(n.id):
+                return True
+            if isinstance(n, ast.Attribute) \
+                    and _RETRYISH_RE.search(n.attr):
+                return True
+        return False
+
+    seen: Set[Tuple[int, int]] = set()
+
+    def visit(node, fn_name: Optional[str]):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_name = node.name
+        if isinstance(node, (ast.While, ast.For)) \
+                and retryish(node, fn_name):
+            for n in ast.walk(node):
+                if not isinstance(n, ast.Try):
+                    continue
+                for h in n.handlers:
+                    pos = (h.lineno, h.col_offset)
+                    if pos in seen:
+                        continue
+                    if broad(h) and not handler_aware(h):
+                        seen.add(pos)
+                        yield (h.lineno, h.col_offset,
+                               "retry-swallows-cancel",
+                               "broad except inside a retry loop "
+                               "neither re-raises nor consults a "
+                               "cancellation/transience classifier: "
+                               "a cancelled or timed-out query would "
+                               "be retried instead of dying — "
+                               "re-raise QueryCancelled/"
+                               "KeyboardInterrupt (or classify with "
+                               "is_transient_error) before retrying")
+        for child in ast.iter_child_nodes(node):
+            yield from visit(child, fn_name)
+
+    yield from visit(ctx.tree, None)
+
+
 RULES = {
     "host-sync": rule_host_sync,
     "block-sync": rule_block_sync,
@@ -699,6 +795,7 @@ RULES = {
     "jit-instance": rule_jit_instance,
     "ctx-cancel": rule_ctx_cancel,
     "pool-cancel": rule_pool_cancel,
+    "retry-swallows-cancel": rule_retry_swallows_cancel,
     "fp-unstable-attr": rule_fp_unstable_attr,
 }
 

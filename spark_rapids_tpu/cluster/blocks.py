@@ -40,10 +40,16 @@ class FetchFailed(RuntimeError):
     substring match silently degraded to full re-execution whenever a
     message format drifted)."""
 
-    def __init__(self, msg: str, addr=None, shuffle_id: str = None):
+    def __init__(self, msg: str, addr=None, shuffle_id: str = None,
+                 transient: bool = True):
         super().__init__(msg)
         self.addr = tuple(addr) if addr else None
         self.shuffle_id = shuffle_id
+        # transient failures (connect/recv errors — the peer may just
+        # be slow) are worth transport-level backoff retries; a
+        # structural "missing blocks" reply is not: the blocks will not
+        # reappear until the driver regenerates the map outputs
+        self.transient = transient
 
 
 class BlockStore:
@@ -213,10 +219,11 @@ def ensure_server(advertise_host: str = None) -> Tuple[str, int]:
         return (advertise_host, _SERVER_ADDR[1])
 
 
-def fetch_blocks(addr: Tuple[str, int], shuffle_id: str,
-                 map_ids: Sequence[int], pid: int) -> List:
-    """Fetch this reduce partition's blocks from one mapper executor."""
-    addr = tuple(addr)
+def _fetch_once(addr: Tuple[str, int], shuffle_id: str,
+                map_ids: Sequence[int], pid: int) -> List:
+    from ..runtime import faults
+    if faults.ACTIVE:
+        faults.hit("block.fetch")
     try:
         sock = socket.create_connection(addr, timeout=10)
     except OSError as e:
@@ -234,8 +241,52 @@ def fetch_blocks(addr: Tuple[str, int], shuffle_id: str,
     if kind != "blocks":
         raise FetchFailed(
             f"mapper {addr} missing blocks: {payload}", addr=addr,
-            shuffle_id=shuffle_id)
+            shuffle_id=shuffle_id, transient=False)
     return payload.get("_arrow", [])
+
+
+def fetch_blocks(addr: Tuple[str, int], shuffle_id: str,
+                 map_ids: Sequence[int], pid: int,
+                 max_retries: int = 2, wait_ms: float = 50.0,
+                 stats: dict = None) -> List:
+    """Fetch this reduce partition's blocks from one mapper executor,
+    retrying TRANSIENT failures (connect/recv errors) with bounded
+    exponential backoff + jitter before letting the FetchFailed
+    escalate to the driver's lineage regeneration. The jitter PRNG is
+    seeded from (shuffle_id, pid) — deterministic per partition, yet
+    concurrent reducers hitting the same mapper de-synchronize. When
+    `stats` is given, per-attempt records accumulate under
+    "fetch_attempts" and total backoff under "fetch_retry_ms" (the
+    driver turns these into fetch_retry events + the fetchRetryMs
+    metric)."""
+    import time as _time
+
+    from ..runtime.backoff import backoff_delays
+    from ..runtime.faults import note_recovery
+    addr = tuple(addr)
+    seed = hash((shuffle_id, pid)) & 0xFFFFFFFF
+    delays = backoff_delays(max_retries, wait_ms, seed=seed)
+    attempt = 0
+    while True:
+        try:
+            out = _fetch_once(addr, shuffle_id, map_ids, pid)
+            if attempt and stats is not None:
+                stats["fetch_recovered"] = \
+                    stats.get("fetch_recovered", 0) + 1
+            return out
+        except FetchFailed as e:
+            if not e.transient or attempt >= max_retries:
+                raise
+            d = delays[attempt]
+            attempt += 1
+            note_recovery("fetch_retries")
+            if stats is not None:
+                stats.setdefault("fetch_attempts", []).append(
+                    {"addr": list(addr), "pid": pid, "attempt": attempt,
+                     "delay_ms": round(d * 1e3, 3), "error": repr(e)})
+                stats["fetch_retry_ms"] = \
+                    stats.get("fetch_retry_ms", 0.0) + d * 1e3
+            _time.sleep(d)
 
 
 def drop_shuffle(addr: Tuple[str, int], shuffle_id: str) -> bool:

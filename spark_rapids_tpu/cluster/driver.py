@@ -25,6 +25,11 @@ __all__ = ["ClusterManager", "ExecutorLostError"]
 
 HEARTBEAT_TIMEOUT_S = 3.0
 MAX_TASK_RETRIES = 3
+# how long a cancelled query's tag stays on the dead list: long enough
+# for its in-flight fragments to drain (result frames arriving after a
+# cancel are dropped by tag), short enough that a long-lived service
+# driver does not accrete one entry per cancelled query forever
+DEAD_TAG_TTL_S = 60.0
 
 
 class ExecutorLostError(RuntimeError):
@@ -82,8 +87,10 @@ class ClusterManager:
         self._lock = threading.Lock()
         self._next_task = 0
         # tags (query_ids) whose tasks were cancelled: dispatch skips
-        # them, results for them are dropped on arrival
-        self._dead_tags: set = set()
+        # them, results for them are dropped on arrival; values are the
+        # cancel times so the monitor can prune entries past
+        # DEAD_TAG_TTL_S (membership tests read it like a set)
+        self._dead_tags: Dict[Any, float] = {}
         self._stop = threading.Event()
         self._threads: List[threading.Thread] = []
         self._listener: Optional[socket.socket] = None
@@ -175,7 +182,7 @@ class ClusterManager:
         if tag is None:
             return 0
         with self._lock:
-            self._dead_tags.add(tag)
+            self._dead_tags[tag] = time.time()
         drained = 0
         keep: List[_Task] = []
         while True:
@@ -277,6 +284,7 @@ class ClusterManager:
                     task.future.set_exception(RuntimeError(
                         f"task {task.task_id} cancelled "
                         f"(tag {task.tag})"))
+                # tpulint: allow[retry-swallows-cancel] double-set guard on an already-cancelled future; the task is dropped, not re-run
                 except Exception:
                     pass
                 continue
@@ -330,10 +338,26 @@ class ClusterManager:
                 # dispatch would run it on two executors)
                 self._mark_lost(eid)
                 return
-            except Exception as e:   # unpicklable task: fail it, keep
-                with self._lock:     # the executor alive
+            except Exception as e:   # non-fatal send failure: the
+                with self._lock:     # executor stays alive
                     ex.inflight.pop(task.task_id, None)
-                task.future.set_exception(e)
+                from ..runtime.backoff import backoff_delays
+                from ..runtime.faults import (is_transient_error,
+                                              note_recovery)
+                if is_transient_error(e) \
+                        and task.attempts < MAX_TASK_RETRIES:
+                    # transient dispatch failure (injected rpc.send
+                    # fault): bounded backoff + jitter, then requeue —
+                    # the RPC half of the fetch-backoff story. Sleeping
+                    # here only stalls THIS executor's sender thread.
+                    note_recovery("rpc_retries")
+                    time.sleep(backoff_delays(
+                        task.attempts, 25.0,
+                        seed=task.task_id)[task.attempts - 1])
+                    self._pending.put(task)
+                else:
+                    # unpicklable task (or retries exhausted): fail it
+                    task.future.set_exception(e)
                 self._idle.put(eid)
 
     def _recv_loop(self, eid: int, sock: socket.socket):
@@ -386,6 +410,12 @@ class ClusterManager:
                         from .blocks import FetchFailed
                         err = FetchFailed(msg, addr=ef.get("addr"),
                                           shuffle_id=ef.get("shuffle_id"))
+                    elif ef.get("type") == "InjectedFault":
+                        # typed re-raise so the transient classifier
+                        # (service retry) sees the injection for what
+                        # it is instead of a generic RuntimeError
+                        from ..runtime.faults import InjectedFault
+                        err = InjectedFault(msg, point=ef.get("point"))
                     else:
                         err = RuntimeError(msg)
                     task.future.set_exception(err)
@@ -401,6 +431,14 @@ class ClusterManager:
                          if e.sock is not None and not e.lost
                          and now - e.last_heartbeat
                          > self.heartbeat_timeout]
+                # dead-tag hygiene: a cancelled query's tag only
+                # matters while its in-flight fragments drain; expired
+                # entries would otherwise accumulate one per cancelled
+                # query for the life of a service driver
+                expired = [t for t, ts in self._dead_tags.items()
+                           if now - ts > DEAD_TAG_TTL_S]
+                for t in expired:
+                    del self._dead_tags[t]
             for eid in stale:
                 self._mark_lost(eid)
             time.sleep(0.2)

@@ -68,6 +68,13 @@ def executor_main(host: str, port: int, exec_id: int) -> None:
                 continue
             task_id = payload["task_id"]
             try:
+                from ..runtime import faults
+                if faults.ACTIVE:
+                    # executor.task: raise fails the task (reported,
+                    # driver-side retry policy applies), kill exits the
+                    # PROCESS — the heartbeat/socket loss path marks
+                    # this executor lost and requeues its tasks
+                    faults.hit("executor.task")
                 fn = payload["fn"]
                 args = tuple(payload.get("args", ()))
                 # tasks submitted with tables=... get them appended as
@@ -98,6 +105,7 @@ def executor_main(host: str, port: int, exec_id: int) -> None:
                 drain_task_metrics()
                 payload = {"task_id": task_id, "message": repr(e),
                            "traceback": traceback.format_exc()}
+                from ..runtime.faults import InjectedFault
                 from .blocks import FetchFailed
                 if isinstance(e, FetchFailed):
                     # structured fields survive the wire so the driver
@@ -107,6 +115,12 @@ def executor_main(host: str, port: int, exec_id: int) -> None:
                         "type": "FetchFailed",
                         "addr": list(e.addr) if e.addr else None,
                         "shuffle_id": e.shuffle_id}
+                elif isinstance(e, InjectedFault):
+                    # ditto for injections: the driver rebuilds the
+                    # type so transient-error classification survives
+                    # the process boundary
+                    payload["error_fields"] = {
+                        "type": "InjectedFault", "point": e.point}
                 send_msg(sock, "error", payload)
     except RpcClosed:
         pass

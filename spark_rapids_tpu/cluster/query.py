@@ -136,21 +136,29 @@ def reduce_fragment_task(reduce_fn, conf, tables):
 def reduce_fetch_task(reduce_fn, conf, shuffle_id: str, pid: int,
                       sources):
     """Executor-side reduce stage (P2P): fetch this partition's blocks
-    DIRECTLY from the mapper executors' block servers, then run the
-    reduce fragment. `sources` = [(addr, [map_id, ...]), ...]."""
+    DIRECTLY from the mapper executors' block servers (transient fetch
+    failures retry with bounded backoff per sql.shuffle.fetch.*), then
+    run the reduce fragment. `sources` = [(addr, [map_id, ...]), ...]."""
+    from ..config import FETCH_RETRY_MAX, FETCH_RETRY_WAIT_MS, TpuConf
     from . import blocks
 
+    tc = TpuConf(conf)
+    max_retries = int(tc.get(FETCH_RETRY_MAX))
+    wait_ms = float(tc.get(FETCH_RETRY_WAIT_MS))
     tables = []
     fetched_bytes = 0
+    fstats: dict = {}
     for addr, map_ids in sources:
-        got = blocks.fetch_blocks(addr, shuffle_id, map_ids, pid)
+        got = blocks.fetch_blocks(addr, shuffle_id, map_ids, pid,
+                                  max_retries=max_retries,
+                                  wait_ms=wait_ms, stats=fstats)
         fetched_bytes += sum(t.nbytes for t in got)
         tables.extend(got)
     out = _run_reduce_fragment(reduce_fn, conf, tables, pid)
     try:
         from .task_metrics import record_task_metrics
         record_task_metrics({"stage": "reduce", "reduce_pid": pid,
-                             "fetch_bytes": fetched_bytes})
+                             "fetch_bytes": fetched_bytes, **fstats})
     except Exception:
         pass
     return ArrowResult({}, [out])
@@ -189,6 +197,16 @@ class DistributedRunner:
                     acc["plan"] = rec["plan"]
             acc["ops"].extend(rec.get("ops") or [])
             acc["fetch_bytes"] += rec.get("fetch_bytes") or 0
+            # transport-level fetch retry accounting (blocks.py backoff
+            # loop): total backoff ms -> the stage's fetchRetryMs
+            # metric; per-attempt records -> driver fetch_retry events
+            if rec.get("fetch_retry_ms"):
+                acc["fetchRetryMs"] = round(
+                    acc.get("fetchRetryMs", 0.0)
+                    + float(rec["fetch_retry_ms"]), 3)
+            if rec.get("fetch_attempts"):
+                acc.setdefault("fetch_attempts", []).extend(
+                    rec["fetch_attempts"])
             for k, v in (rec.get("watermarks") or {}).items():
                 if isinstance(v, (int, float)):
                     acc["watermarks"][k] = max(
@@ -224,9 +242,11 @@ class DistributedRunner:
 
         import spark_rapids_tpu as st
 
-        from ..config import TpuConf
+        from ..config import SHUFFLE_MAX_REGENERATIONS, TpuConf
         from ..profiler import event_log as EL
+        from ..runtime.faults import note_recovery
         from .blocks import FetchFailed, drop_shuffle
+        from .driver import ExecutorLostError
 
         n_reduce = n_reduce or max(len(self.cm.alive_executors), 1)
         shuffle_id = uuid.uuid4().hex[:12]
@@ -250,19 +270,38 @@ class DistributedRunner:
             if token is not None:
                 token.check()
 
+        def submit_map(i):
+            return self.cm.submit(
+                map_fragment_task, map_fn, splits[i], self.conf,
+                n_reduce, list(part_keys), shuffle_id, i, tag=qid)
+
         def run_maps(idxs, attempt=0):
+            from ..runtime.faults import is_transient_error
+            from .driver import MAX_TASK_RETRIES
             check()
             emit("stage_submit", stage="map", n_tasks=len(idxs),
                  attempt=attempt)
             t0 = time.perf_counter()
-            futs = {i: self.cm.submit(
-                map_fragment_task, map_fn, splits[i], self.conf,
-                n_reduce, list(part_keys), shuffle_id, i, tag=qid)
-                for i in idxs}
-            out = {}
-            for i, f in futs.items():
+            pending = [(i, submit_map(i)) for i in idxs]
+            out, tries = {}, {}
+            while pending:
+                i, f = pending.pop(0)
                 check()
-                out[i] = f.result()
+                try:
+                    out[i] = f.result()
+                except Exception as e:
+                    # idempotent map fragments: a TRANSIENT in-task
+                    # failure (injected fault, lost executor mid-run)
+                    # is resubmitted — possibly landing on another
+                    # executor — up to the task-retry budget
+                    tries[i] = tries.get(i, 0) + 1
+                    if not is_transient_error(e) \
+                            or tries[i] > MAX_TASK_RETRIES:
+                        raise
+                    emit("task_retry", stage="map", split=i,
+                         attempt=tries[i], error=repr(e))
+                    pending.append((i, submit_map(i)))
+                    continue
                 self._absorb(f, stages)
             wall = time.perf_counter() - t0
             stages.setdefault("map", {}).setdefault("wall_s", 0.0)
@@ -282,8 +321,13 @@ class DistributedRunner:
             metas = run_maps(range(len(splits)))
             done: Dict[int, object] = {}     # pid -> reduce output table
 
+            # lineage-based regeneration budget: each round re-executes
+            # ONLY the lost map partitions on surviving executors, then
+            # retries the missing reduces (sql.shuffle.maxRegenerations)
+            max_regen = int(TpuConf(self.conf).get(
+                SHUFFLE_MAX_REGENERATIONS))
             try:
-                for attempt in range(3):
+                for attempt in range(max_regen + 1):
                     check()
                     # per-pid fetch plan: mapper addr -> map ids that
                     # produced blocks for that pid
@@ -308,38 +352,69 @@ class DistributedRunner:
                     emit("stage_submit", stage="reduce",
                          n_tasks=len(rfuts), attempt=attempt)
                     refetch = set()
+                    retry_only = False
                     for pid, f in rfuts:
                         check()
                         try:
                             done[pid] = f.result().tables[0]
                             self._absorb(f, stages)
-                        except FetchFailed as e:
+                        except (FetchFailed, ExecutorLostError) as e:
                             emit("fetch_retry", stage="reduce", pid=pid,
                                  shuffle_id=shuffle_id,
-                                 addr=list(e.addr) if e.addr else None,
-                                 attempt=attempt)
-                            if attempt == 2:
+                                 addr=list(e.addr)
+                                 if getattr(e, "addr", None) else None,
+                                 attempt=attempt, error=repr(e))
+                            if attempt >= max_regen:
                                 raise
                             # lineage: re-execute the map splits of the
                             # FAILED mapper, identified by the typed
                             # exception's structured addr (idempotent
-                            # fragments); an addr-less failure
-                            # re-executes everything
+                            # fragments); an addr-less failure — or an
+                            # executor lost outright — re-executes
+                            # everything still unreduced
                             dead = set()
-                            if e.addr is not None:
+                            addr = getattr(e, "addr", None)
+                            if addr is not None:
                                 dead = {i for i, m2 in metas.items()
-                                        if tuple(m2["addr"]) == e.addr}
+                                        if tuple(m2["addr"]) == addr}
                             refetch |= dead or set(metas)
+                        except Exception as e:
+                            # TRANSIENT in-task reduce failure (injected
+                            # fault): the shuffle blocks are still
+                            # parked, so retry JUST this partition next
+                            # round — no map regeneration needed
+                            from ..runtime.faults import \
+                                is_transient_error
+                            if not is_transient_error(e) \
+                                    or attempt >= max_regen:
+                                raise
+                            emit("task_retry", stage="reduce", pid=pid,
+                                 attempt=attempt, error=repr(e))
+                            retry_only = True
+                    # executor-side transport retries that SUCCEEDED
+                    # ride back in task metrics: surface each attempt
+                    # as its own driver-log event
+                    racc = stages.get("reduce") or {}
+                    for rec in racc.pop("fetch_attempts", []):
+                        emit("fetch_retry", stage="reduce",
+                             shuffle_id=shuffle_id, **rec)
                     wall = time.perf_counter() - t0
                     if "reduce" in stages:
                         stages["reduce"]["wall_s"] = \
                             stages["reduce"].get("wall_s", 0.0) + wall
                     emit("stage_complete", stage="reduce",
                          attempt=attempt, wall_s=round(wall, 6))
-                    if not refetch:
+                    if not refetch and not retry_only:
                         break
-                    metas.update(run_maps(sorted(refetch),
-                                          attempt=attempt + 1))
+                    if refetch:
+                        lost = sorted(refetch)
+                        note_recovery("regenerations", len(lost))
+                        emit("shuffle_regeneration",
+                             shuffle_id=shuffle_id, map_ids=lost,
+                             attempt=attempt + 1,
+                             survivors=len(self.cm.alive_executors))
+                        metas.update(run_maps(lost,
+                                              attempt=attempt + 1))
             finally:
                 # the shuffle's blocks are pinned on the mappers (the
                 # MAX_SHUFFLES LRU never evicts in-flight shuffles); drop

@@ -79,6 +79,13 @@ def _recv_exact(sock: socket.socket, n: int) -> bytes:
 
 def send_msg(sock: socket.socket, kind: str, payload: Any,
              tables: Sequence = ()) -> None:
+    from ..runtime import faults
+    if faults.ACTIVE and kind == "task":
+        # fault point BEFORE any bytes hit the socket (a partial frame
+        # would poison the stream, not simulate a failure) and only for
+        # task dispatch — control traffic (heartbeats, register,
+        # shutdown) failing would test the harness, not the engine
+        faults.hit("rpc.send")
     blobs = tables_to_ipc(tables) if tables else []
     header = pickle.dumps(
         (kind, payload, [len(memoryview(b)) for b in blobs]),

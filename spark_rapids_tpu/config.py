@@ -357,6 +357,52 @@ CLUSTER_HEARTBEAT_TIMEOUT = _conf(
     "Executor liveness: no heartbeat for this long marks the executor "
     "lost and re-executes its in-flight tasks "
     "(RapidsShuffleHeartbeatManager analog).", float)
+FAULTS_PLAN = _conf(
+    "sql.debug.faults.plan", None,
+    "Deterministic fault-injection plan (runtime/faults.py): "
+    "';'-separated rules `point[:selector]*[:action]` over the named "
+    "fault points (block.fetch, rpc.send, executor.task, "
+    "device.dispatch, exchange.map, spill.write, xla.compile). "
+    "Selectors: nth=N, prob=P, seed=S, times=K, query=SUB, op=NAME; "
+    "actions: raise=NAME, delay=MS, kill. Same plan + seed injects the "
+    "identical failure sequence. The SRTPU_FAULTS env var installs the "
+    "same grammar process-wide (spark-rapids-jni CUDA fault-injection "
+    "analog). None disables with zero overhead.", str)
+SHUFFLE_MAX_REGENERATIONS = _conf(
+    "sql.shuffle.maxRegenerations", 2,
+    "Upper bound on lineage-based shuffle regeneration rounds per "
+    "distributed query: on FetchFailed/executor loss the driver "
+    "re-executes only the lost map partitions on surviving executors "
+    "and retries the reduce, at most this many times before the "
+    "failure propagates (Spark stage-retry analog).", int)
+FETCH_RETRY_MAX = _conf(
+    "sql.shuffle.fetch.maxRetries", 2,
+    "Transport-level retries per shuffle block fetch before the "
+    "FetchFailed escalates to the driver's lineage regeneration. "
+    "Retries wait exponential-backoff-with-jitter delays "
+    "(runtime/backoff.py) starting at sql.shuffle.fetch.retryWaitMs.",
+    int)
+FETCH_RETRY_WAIT_MS = _conf(
+    "sql.shuffle.fetch.retryWaitMs", 50.0,
+    "Base backoff delay (ms) for shuffle block fetch retries; attempt "
+    "k waits min(base * 2^k, 10s) with deterministic jitter.", float)
+SERVICE_MAX_QUERY_RETRIES = _conf(
+    "sql.service.maxQueryRetries", 1,
+    "Transparent re-admissions of a query that failed with a "
+    "classified-TRANSIENT error (runtime/faults.is_transient_error: "
+    "FetchFailed, executor loss, injected faults, connection resets — "
+    "never cancellation, deadline, or user errors). Each retry is a "
+    "fresh admission with the ORIGINAL deadline still binding, "
+    "surfaced as a query_retry event. 0 disables.", int)
+DEGRADE_TO_HOST = _conf(
+    "sql.exec.degradeToHost.enabled", True,
+    "Graceful device->host degradation: an operator whose device "
+    "kernel raises a non-OOM, non-cancellation error re-evaluates the "
+    "batch on the host interpreter (exec/host_fallback path), and "
+    "after two device failures on the same program stops dispatching "
+    "to the device for the remainder of the query (counted as "
+    "degradedToHost, event-logged as degrade_to_host, visible in "
+    "EXPLAIN ANALYZE).", bool)
 MAX_READER_BATCH_SIZE_ROWS = _conf(
     "sql.reader.batchSizeRows", 1 << 21,
     "Soft limit on rows per scan batch.", int)

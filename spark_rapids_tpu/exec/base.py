@@ -49,6 +49,13 @@ class ExecContext:
         # SharedBuildExec's per-run materialization cache:
         # {id(node): {pid: [spill handles]}} — closed by close()
         self.shared_handles: Dict[int, dict] = {}
+        # graceful device->host degradation state (exec/degrade.py):
+        # per-op device failure counts, the ops pinned to host for the
+        # remainder of this query, and recovery events the profiler
+        # wrapper drains into the query's event log
+        self.device_failures: Dict[str, int] = {}
+        self.degraded: Dict[str, bool] = {}
+        self.pending_events: List[dict] = []
         # adopt this query's conf into the process-global program cache
         # (enable/size + jit-relevant conf fingerprint mixed into keys)
         if not planning:

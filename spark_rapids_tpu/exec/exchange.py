@@ -156,6 +156,12 @@ class ShuffleExchangeExec(TpuExec):
                 """Idempotent map-side partition pass for one (sub)batch:
                 device partition + ONE bulk D2H (split-and-retry safe —
                 halves simply produce more sub-batches per partition)."""
+                from ..runtime import faults
+                if faults.ACTIVE:
+                    # inside the with_retry wrapper: an injected
+                    # RESOURCE_EXHAUSTED exercises the split-retry path
+                    faults.hit("exchange.map", query_id=ctx.query_id,
+                               op=type(self).__name__)
                 with m.timer("partitionTime"):
                     from ..shuffle.serializer import cv_shuffle_bufs
                     out, counts = self._run_map(batch.cvs(),

@@ -98,20 +98,44 @@ class FusedStageExec(TpuExec):
 
     # ------------------------------------------------------------------
     def execute_partition(self, ctx: ExecContext, pid: int):
+        from ..runtime import faults
         from ..utils.transfer import fetch
+        from . import degrade
         from .nodes import make_table
         m = ctx.metrics_for(self._op_id)
         stats = jnp.zeros(len(self.members), dtype=jnp.int64)
         n_batches = 0
         for batch in self.children[0].execute_partition(ctx, pid):
             ctx.check_cancel()
-            with m.timer("opTime"):
-                cvs, mask, stats = self._jit(batch.cvs(), batch.row_mask,
-                                             stats)
-            xla_stats.count_dispatch()
+            if self._op_id not in ctx.degraded:
+                try:
+                    if faults.ACTIVE:
+                        faults.hit("device.dispatch",
+                                   query_id=ctx.query_id,
+                                   op="FusedStageExec")
+                    with m.timer("opTime"):
+                        cvs, mask, stats = self._jit(
+                            batch.cvs(), batch.row_mask, stats)
+                except Exception as e:  # noqa: BLE001 - classified below
+                    if not (degrade.hostable_fused(self)
+                            and degrade.should_degrade(ctx, self, e)):
+                        raise
+                else:
+                    xla_stats.count_dispatch()
+                    n_batches += 1
+                    yield DeviceBatch(
+                        make_table(self.schema, cvs, batch.num_rows),
+                        batch.num_rows, mask, batch.capacity)
+                    continue
+            # degraded (or this batch's dispatch just failed): the host
+            # interpreter runs the member chain bottom-up
+            with m.timer("hostEvalTime"):
+                hb = degrade.host_fused_batch(self, batch)
+            m.add("degradedToHost", 1)
+            if hb is None:
+                continue
             n_batches += 1
-            yield DeviceBatch(make_table(self.schema, cvs, batch.num_rows),
-                              batch.num_rows, mask, batch.capacity)
+            yield hb
         m.add("numOutputBatches", n_batches)
         if n_batches:
             # one partition-end fetch for every member counter

@@ -20,6 +20,7 @@ from ..columnar.table import Field, Schema, Table
 from ..expr.expressions import EmitCtx, Expression
 from ..ops.kernel_utils import CV
 from ..profiler import xla_stats
+from ..runtime import faults
 from .base import ExecContext, TpuExec
 from .batch import DeviceBatch
 
@@ -748,15 +749,35 @@ class ProjectExec(TpuExec):
         return False
 
     def execute_partition(self, ctx, pid):
+        from . import degrade
         m = ctx.metrics_for(self._op_id)
         for batch in self.children[0].execute_partition(ctx, pid):
             ctx.check_cancel()
-            with m.timer("opTime"):
-                out = self._jit(batch.cvs(), batch.row_mask)
-            xla_stats.count_dispatch()
+            if self._op_id not in ctx.degraded:
+                try:
+                    if faults.ACTIVE:
+                        faults.hit("device.dispatch",
+                                   query_id=ctx.query_id,
+                                   op="ProjectExec")
+                    with m.timer("opTime"):
+                        out = self._jit(batch.cvs(), batch.row_mask)
+                except Exception as e:  # noqa: BLE001 - classified below
+                    if not degrade.should_degrade(ctx, self, e):
+                        raise
+                else:
+                    xla_stats.count_dispatch()
+                    m.add("numOutputBatches", 1)
+                    yield DeviceBatch(
+                        make_table(self.schema, out, batch.num_rows),
+                        batch.num_rows, batch.row_mask, batch.capacity)
+                    continue
+            # degraded (or this batch's dispatch just failed): the host
+            # interpreter evaluates the same bound expressions
+            with m.timer("hostEvalTime"):
+                hb = degrade.host_project_batch(self, batch)
+            m.add("degradedToHost", 1)
             m.add("numOutputBatches", 1)
-            yield DeviceBatch(make_table(self.schema, out, batch.num_rows),
-                              batch.num_rows, batch.row_mask, batch.capacity)
+            yield hb
 
 
 class FilterExec(TpuExec):
@@ -788,15 +809,36 @@ class FilterExec(TpuExec):
         return ("Filter", expr_fp(self.bound))
 
     def execute_partition(self, ctx, pid):
+        from . import degrade
         m = ctx.metrics_for(self._op_id)
         for batch in self.children[0].execute_partition(ctx, pid):
             ctx.check_cancel()
-            with m.timer("opTime"):
-                new_mask = self._jit(batch.cvs(), batch.row_mask)
-            xla_stats.count_dispatch()
+            if self._op_id not in ctx.degraded:
+                try:
+                    if faults.ACTIVE:
+                        faults.hit("device.dispatch",
+                                   query_id=ctx.query_id,
+                                   op="FilterExec")
+                    with m.timer("opTime"):
+                        new_mask = self._jit(batch.cvs(), batch.row_mask)
+                except Exception as e:  # noqa: BLE001 - classified below
+                    if not degrade.should_degrade(ctx, self, e):
+                        raise
+                else:
+                    xla_stats.count_dispatch()
+                    m.add("numOutputBatches", 1)
+                    yield DeviceBatch(batch.table, batch.num_rows,
+                                      new_mask, batch.capacity)
+                    continue
+            # degraded (or this batch's dispatch just failed): host
+            # predicate evaluation over the same batch
+            with m.timer("hostEvalTime"):
+                hb = degrade.host_filter_batch(self, batch)
+            m.add("degradedToHost", 1)
+            if hb is None:
+                continue
             m.add("numOutputBatches", 1)
-            yield DeviceBatch(batch.table, batch.num_rows, new_mask,
-                              batch.capacity)
+            yield hb
 
 
 class LimitExec(TpuExec):

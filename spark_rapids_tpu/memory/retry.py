@@ -30,12 +30,33 @@ class OutOfCoreError(Exception):
     pass
 
 
+#: how much of the exception's own message is ITS message: the status
+#: line jaxlib/XLA put first. Matching beyond this (or past the first
+#: line) starts matching user data embedded in the repr — a ValueError
+#: quoting a row that says "out of memory" is not an OOM.
+_OOM_HEAD_CHARS = 256
+
+
+def _message_head(e: Exception) -> str:
+    return str(e).split("\n", 1)[0][:_OOM_HEAD_CHARS]
+
+
 def is_oom_error(e: Exception) -> bool:
     if isinstance(e, BudgetExceeded):
         return True
-    msg = str(e)
-    return ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
-            or "out of memory" in msg)
+    # typed classification first: jaxlib raises XlaRuntimeError with
+    # the canonical status name leading the message ("RESOURCE_EXHAUSTED:
+    # ..."); some builds expose .status — honor it when present
+    if type(e).__name__ == "XlaRuntimeError":
+        status = getattr(e, "status", None)
+        if status is not None and "RESOURCE_EXHAUSTED" in str(status):
+            return True
+        head = _message_head(e)
+        return ("RESOURCE_EXHAUSTED" in head
+                or "out of memory" in head.lower())
+    head = _message_head(e)
+    return ("RESOURCE_EXHAUSTED" in head or "Out of memory" in head
+            or "out of memory" in head)
 
 
 def split_batch_in_half(batch: DeviceBatch) -> List[DeviceBatch]:
@@ -109,5 +130,6 @@ def retry_no_split(fn: Callable[[], object], retries: int = 2):
             try:
                 from .device import device_manager
                 device_manager().trigger_spill()
+            # tpulint: allow[retry-swallows-cancel] best-effort spill nudge; the outer handler already classified via is_oom_error
             except Exception:
                 pass

@@ -36,6 +36,9 @@ def _write_spill_file(path: str, flat: Dict[str, np.ndarray], pool) -> None:
     churning fresh allocations per handle; without a pool (conf-less
     store) arrays write directly."""
     import json
+    from ..runtime import faults
+    if faults.ACTIVE:
+        faults.hit("spill.write")
     header = {k: {"dtype": str(a.dtype), "shape": list(a.shape)}
               for k, a in flat.items()}
     with open(path, "wb") as f:

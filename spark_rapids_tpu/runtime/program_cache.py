@@ -288,6 +288,13 @@ class CachedProgram:
                 _cache.move_to_end(key)
                 _stats["program_cache_hits"] += 1
             else:
+                from . import faults
+                if faults.ACTIVE:
+                    # compile-on-miss is the xla.compile fault point: a
+                    # raise here fails the query before any dispatch (a
+                    # service-level retry re-enters and recompiles)
+                    faults.hit("xla.compile", op=self._base_key[0]
+                               if self._base_key else None)
                 prog = self._jit()
                 _cache[key] = prog
                 _stats["program_cache_misses"] += 1

@@ -49,9 +49,13 @@ class TpuSession:
         from .config import RETRY_COVERAGE_ENABLED
         from .memory.diagnostics import enable_retry_coverage
         enable_retry_coverage(bool(self.conf.get(RETRY_COVERAGE_ENABLED)))
-        from .runtime import ledger, lockdep
+        from .runtime import faults, ledger, lockdep
         lockdep.maybe_enable_from_conf(self.conf)
         ledger.maybe_enable_from_conf(self.conf)
+        # conf-carried fault plan (sql.debug.faults.plan) activates here
+        # so distributed fragments — executors rebuild TpuSession(conf)
+        # — inject under the same plan as the driver
+        faults.install_from_conf(self.conf)
 
     @staticmethod
     def builder_get_or_create(conf: Optional[Dict] = None) -> "TpuSession":
@@ -811,15 +815,45 @@ class DataFrame:
                                         outer, nested=True,
                                         cache_token=token)
         mgr = self._session.query_manager()
-        handle = mgr.open_query(plan=self._plan, conf=conf, action=action)
-        try:
-            out = self._execute_action(action, body, conf, handle,
-                                       cache_token=token)
-        except BaseException as e:
-            mgr.close_query(handle, error=e)
-            raise
-        mgr.close_query(handle, result=out)
-        return out
+        # service-level transparent retry: a CLASSIFIED-transient
+        # failure (is_transient_error — injected faults, FetchFailed,
+        # executor loss; never cancellation/deadline/user errors)
+        # re-admits the query as a fresh attempt, with the FIRST
+        # attempt's deadline still binding. Each attempt is its own
+        # query_id, so admission accounting, the event log, and the
+        # resource-ledger per-query balance check all see it whole.
+        from .config import SERVICE_MAX_QUERY_RETRIES
+        from .runtime.faults import is_transient_error, note_recovery
+        max_retries = int(conf.get(SERVICE_MAX_QUERY_RETRIES))
+        attempt = 0
+        deadline = None      # original deadline, binding across retries
+        retry_of = None
+        while True:
+            timeout = None
+            if deadline is not None:
+                timeout = max(deadline - _time.monotonic(), 1e-3)
+            handle = mgr.open_query(plan=self._plan, conf=conf,
+                                    action=action, timeout=timeout)
+            if deadline is None:
+                deadline = handle.token.deadline
+            try:
+                out = self._execute_action(action, body, conf, handle,
+                                           cache_token=token,
+                                           retry_of=retry_of)
+            except BaseException as e:
+                mgr.close_query(handle, error=e)
+                if (attempt < max_retries and is_transient_error(e)
+                        and (deadline is None
+                             or _time.monotonic() < deadline)):
+                    attempt += 1
+                    note_recovery("query_retries")
+                    retry_of = {"attempt": attempt,
+                                "prior_query_id": handle.query_id,
+                                "error": repr(e)}
+                    continue
+                raise
+            mgr.close_query(handle, result=out)
+            return out
 
     def submit(self, action: str = "collect", pool=None, timeout=None):
         """Async action through the query service: returns a QueryHandle
@@ -857,7 +891,8 @@ class DataFrame:
                           action="collect", pool=pool, timeout=timeout)
 
     def _execute_action(self, action: str, body, conf, handle,
-                        nested: bool = False, cache_token=None):
+                        nested: bool = False, cache_token=None,
+                        retry_of=None):
         """The admitted half of an action: plan (or reuse the cached
         physical tree), execute under the profiler wrapper, then attach
         the per-query XLA/semaphore/queue-wait accounting to the root
@@ -891,6 +926,11 @@ class DataFrame:
             with _query_scope(handle.query_id if handle else "?"):
                 with profile_query(self._session, root, ctx, action,
                                    handle=None if nested else handle) as w:
+                    if retry_of and w is not None:
+                        # this attempt is a service-level transparent
+                        # retry of a transient failure; link it to the
+                        # prior attempt's query_id in the event log
+                        w.emit("query_retry", action=action, **retry_of)
                     try:
                         # AQE stage driver: materialize shuffle stages
                         # bottom-up and replan (coalesce / skew-split /
@@ -927,6 +967,18 @@ class DataFrame:
                                 result_cache.put_query(cache_token, out,
                                                        conf)
                     finally:
+                        # recovery events queued mid-execution
+                        # (degrade_to_host and friends) drain into the
+                        # query's event log even when the run failed
+                        if w is not None and ctx.pending_events:
+                            for ev in ctx.pending_events:
+                                kw = dict(ev)
+                                name = kw.pop("event")
+                                try:
+                                    w.emit(name, **kw)
+                                except Exception:
+                                    pass
+                            ctx.pending_events = []
                         ctx.close()
         except BaseException:
             try:

@@ -61,5 +61,32 @@ def q3_final(s, df):
     return _sorted_top10(df)
 
 
+def q6_map(s, split):
+    """TPC-H Q6 map fragment: filter + partial revenue sum over this
+    executor's lineitem split. The single constant group key makes the
+    shuffle a 1-bucket partial-aggregate merge — the smallest
+    distributed shape, which is why the chaos smoke uses it alongside
+    Q3."""
+    d = decimal.Decimal
+    li = s.read.parquet(*_as_list(split["lineitem"]))
+    return (li.filter((col("l_shipdate") >= 8766)
+                      & (col("l_shipdate") < 9131)
+                      & (col("l_discount") >= lit(d("0.05")))
+                      & (col("l_discount") <= lit(d("0.07")))
+                      & (col("l_quantity") < lit(d("24"))))
+            .with_column("g", lit(0))
+            .group_by("g")
+            .agg(F.sum(col("l_extendedprice") * col("l_discount"))
+                 .alias("revenue")))
+
+
+def q6_reduce(s, df):
+    """Merge the mappers' partial sums (sum of partial decimal sums is
+    exact) and drop the synthetic group key."""
+    return (df.group_by("g")
+            .agg(F.sum(col("revenue")).alias("revenue"))
+            .select(col("revenue")))
+
+
 def _as_list(x):
     return list(x) if isinstance(x, (list, tuple)) else [x]

@@ -1,0 +1,197 @@
+"""End-to-end failure recovery on the distributed topology: an
+executor killed between map and reduce is recovered by lineage-based
+shuffle regeneration (byte-identical answer + shuffle_regeneration
+events), and the seeded chaos smoke runs TPC-H q3/q6 distributed under
+an active fault plan to the same result as the fault-free local
+reference (the same local-vs-distributed identity the cluster suite
+asserts fault-free)."""
+import json
+import os
+
+import pyarrow.parquet as pq
+import pytest
+
+from spark_rapids_tpu.cluster.driver import (DEAD_TAG_TTL_S,
+                                             ClusterManager)
+from spark_rapids_tpu.cluster.query import DistributedRunner
+from spark_rapids_tpu.runtime import faults
+from spark_rapids_tpu.workloads import tpch, tpch_cluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear_plan()
+    faults.reset_recovery_stats()
+    yield
+    faults.clear_plan()
+    faults.reset_recovery_stats()
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    """One shared sf=0.01 TPC-H slice: 2 lineitem splits + full
+    customer/orders, plus the full lineitem for local references."""
+    tmp_path = tmp_path_factory.mktemp("fault-recovery")
+    li = tpch.gen_lineitem(sf=0.01, seed=7)
+    cust = tpch.gen_customer(sf=0.01, seed=7)
+    orders = tpch.gen_orders(sf=0.01, seed=7)
+    cust_p = str(tmp_path / "customer.parquet")
+    ord_p = str(tmp_path / "orders.parquet")
+    li_p = str(tmp_path / "lineitem-full.parquet")
+    pq.write_table(cust, cust_p)
+    pq.write_table(orders, ord_p)
+    pq.write_table(li, li_p)
+    n = li.num_rows
+    splits = []
+    for i in range(2):
+        sl = li.slice(i * n // 2, (i + 1) * n // 2 - i * n // 2)
+        p = str(tmp_path / f"lineitem-{i}.parquet")
+        pq.write_table(sl, p)
+        splits.append({"lineitem": p, "customer": cust_p,
+                       "orders": ord_p})
+    return {"splits": splits, "tables": (li, cust, orders),
+            "lineitem_full": li_p, "dir": tmp_path}
+
+
+def _rows(at):
+    return [tuple(at.column(i)[j].as_py()
+                  for i in range(at.num_columns))
+            for j in range(at.num_rows)]
+
+
+def _local_q3(tables):
+    import spark_rapids_tpu as st
+    li, cust, orders = tables
+    s = st.TpuSession()
+    return tpch.q3(s.create_dataframe(cust),
+                   s.create_dataframe(orders),
+                   s.create_dataframe(li)).to_arrow()
+
+
+def _local_q6(lineitem_path):
+    """q6_map over the FULL lineitem is one partial; q6_reduce of one
+    partial is the exact answer — the same local-pipeline identity the
+    distributed runner must reproduce under faults."""
+    import spark_rapids_tpu as st
+    s = st.TpuSession()
+    part = tpch_cluster.q6_map(s, {"lineitem": lineitem_path})
+    return tpch_cluster.q6_reduce(s, part).to_arrow()
+
+
+def _run_q3(cm, splits, conf, n_reduce=2):
+    runner = DistributedRunner(cm, conf)
+    got = runner.run(splits, tpch_cluster.q3_map,
+                     part_keys=["l_orderkey"],
+                     reduce_fn=tpch_cluster.q3_reduce,
+                     n_reduce=n_reduce,
+                     final_fn=tpch_cluster.q3_final)
+    return got, runner
+
+
+def _run_q6(cm, splits, conf):
+    runner = DistributedRunner(cm, conf)
+    got = runner.run(splits, tpch_cluster.q6_map, part_keys=["g"],
+                     reduce_fn=tpch_cluster.q6_reduce, n_reduce=1)
+    return got, runner
+
+
+def test_executor_killed_between_map_and_reduce_regenerates(dataset):
+    """Kill one of two executors AFTER the map stage parked its shuffle
+    blocks, BEFORE the reduce fetches them: the reducers' fetches fail,
+    the driver re-executes the dead mapper's splits on the survivor
+    (lineage regeneration), and the answer is byte-identical — with
+    shuffle_regeneration + fetch_retry events in the driver's query
+    log."""
+    from spark_rapids_tpu.cluster import query as qmod
+
+    want = _local_q3(dataset["tables"])
+    conf = {"spark.rapids.tpu.sql.batchSizeRows": 8192,
+            # keep the backoff story but not its wall-clock: the dead
+            # server refuses fast, so retries only add sleep time
+            "spark.rapids.tpu.sql.shuffle.fetch.retryWaitMs": "5",
+            "spark.rapids.tpu.sql.eventLog.enabled": "true",
+            "spark.rapids.tpu.sql.eventLog.dir":
+                str(dataset["dir"] / "ev")}
+
+    cm = ClusterManager(2)
+    cm.start()
+    try:
+        state = {"killed": False}
+        real_submit = cm.submit
+
+        def killing_submit(fn, *args, **kw):
+            if fn is qmod.reduce_fetch_task and not state["killed"]:
+                state["killed"] = True
+                # the map stage is complete; kill an executor PROCESS
+                # so its block server (and parked shuffle blocks) die
+                eid = cm.alive_executors[0]
+                cm._executors[eid].proc.kill()
+            return real_submit(fn, *args, **kw)
+
+        cm.submit = killing_submit
+        got, runner = _run_q3(cm, dataset["splits"], conf)
+        cm.submit = real_submit
+    finally:
+        cm.shutdown()
+
+    assert state["killed"]
+    assert _rows(got) == _rows(want)
+    assert faults.recovery_stats().get("regenerations", 0) >= 1
+    assert runner.last_event_log and os.path.exists(runner.last_event_log)
+    with open(runner.last_event_log) as f:
+        evs = [json.loads(line) for line in f]
+    names = [e["event"] for e in evs]
+    assert "shuffle_regeneration" in names
+    assert "fetch_retry" in names
+    regen = next(e for e in evs if e["event"] == "shuffle_regeneration")
+    assert regen["map_ids"] and regen["survivors"] >= 1
+
+
+def test_chaos_smoke_q3_q6_distributed(dataset, monkeypatch):
+    """The tier-1 chaos smoke: q3 + q6 distributed under a seeded fault
+    plan covering executor-side points (fetch, dispatch, exchange,
+    compile) answer byte-identically to the fault-free local reference.
+    The plan ships via SRTPU_FAULTS so every executor process inherits
+    it at spawn."""
+    want3 = _local_q3(dataset["tables"])
+    want6 = _local_q6(dataset["lineitem_full"])
+    conf = {"spark.rapids.tpu.sql.batchSizeRows": 4096,
+            "spark.rapids.tpu.sql.shuffle.fetch.retryWaitMs": "5"}
+
+    plan = ("block.fetch:prob=0.25:seed=5:raise=FetchFailed;"
+            "device.dispatch:prob=0.1:seed=6:raise=ChaosError;"
+            "exchange.map:prob=0.1:seed=7:raise=RESOURCE_EXHAUSTED;"
+            "xla.compile:nth=3:raise=ChaosCompile")
+    monkeypatch.setenv("SRTPU_FAULTS", plan)
+    cm = ClusterManager(2)
+    cm.start()
+    try:
+        got3, _ = _run_q3(cm, dataset["splits"], conf)
+        got6, _ = _run_q6(cm, dataset["splits"], conf)
+    finally:
+        cm.shutdown()
+
+    assert _rows(got3) == _rows(want3)
+    assert _rows(got6) == _rows(want6)
+
+
+def test_dead_tag_entries_expire():
+    """cancel_tag() entries are pruned after DEAD_TAG_TTL_S by the
+    monitor loop instead of accumulating one per cancelled query for
+    the life of a service driver."""
+    import time
+    cm = ClusterManager(1)
+    cm.start()
+    try:
+        cm.cancel_tag("q-old")
+        cm.cancel_tag("q-new")
+        assert "q-old" in cm._dead_tags and "q-new" in cm._dead_tags
+        with cm._lock:
+            cm._dead_tags["q-old"] -= DEAD_TAG_TTL_S + 5
+        deadline = time.time() + 5
+        while "q-old" in cm._dead_tags and time.time() < deadline:
+            time.sleep(0.05)
+        assert "q-old" not in cm._dead_tags     # expired entry pruned
+        assert "q-new" in cm._dead_tags         # fresh entry kept
+    finally:
+        cm.shutdown()

@@ -1,0 +1,431 @@
+"""Deterministic fault injection (runtime/faults.py) and the recovery
+paths it exercises: plan grammar, seeded determinism, the conservative
+transient/OOM classifiers, bounded backoff, graceful device->host
+degradation, and the service-level transparent query retry."""
+import time
+
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.expr.expressions import col
+from spark_rapids_tpu.runtime import faults
+from spark_rapids_tpu.runtime.backoff import backoff_delays
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear_plan()
+    faults.reset_recovery_stats()
+    yield
+    faults.clear_plan()
+    faults.reset_recovery_stats()
+
+
+# ----------------------------------------------------------------------
+# plan grammar + injection mechanics
+# ----------------------------------------------------------------------
+def test_nth_rule_fires_on_exactly_the_nth_call():
+    assert faults.install_plan("p.x:nth=3:raise=Boom") == 1
+    faults.hit("p.x")
+    faults.hit("p.x")
+    with pytest.raises(faults.InjectedFault, match="Boom"):
+        faults.hit("p.x")
+    # nth= implies times=1: the 4th, 5th... calls pass clean
+    faults.hit("p.x")
+    faults.hit("p.x")
+    assert faults.injection_counts() == {"injected": 1, "raise": 1}
+    assert faults.injection_trace() == [
+        {"point": "p.x", "call": 3, "action": "raise", "arg": "Boom"}]
+
+
+def test_times_widens_an_nth_rule_and_caps_a_prob_rule():
+    faults.install_plan("p.x:prob=1.0:times=2:raise=Boom")
+    for _ in range(2):
+        with pytest.raises(faults.InjectedFault):
+            faults.hit("p.x")
+    faults.hit("p.x")                      # cap reached: clean
+    assert faults.injection_counts()["injected"] == 2
+
+
+def test_prob_seed_is_deterministic_across_reinstalls():
+    spec = "p.x:prob=0.4:seed=11:raise=Boom"
+
+    def trace_of():
+        faults.install_plan(spec)
+        for _ in range(50):
+            try:
+                faults.hit("p.x")
+            except faults.InjectedFault:
+                pass
+        return faults.injection_trace()
+
+    first, second = trace_of(), trace_of()
+    assert first and first == second
+    # a different seed produces a different schedule
+    faults.install_plan("p.x:prob=0.4:seed=12:raise=Boom")
+    for _ in range(50):
+        try:
+            faults.hit("p.x")
+        except faults.InjectedFault:
+            pass
+    assert faults.injection_trace() != first
+
+
+def test_query_and_op_selectors():
+    faults.install_plan("p.x:op=FilterExec:raise=Boom;"
+                        "p.y:query=q-7:raise=Boom")
+    faults.hit("p.x", op="ProjectExec")          # op mismatch: clean
+    with pytest.raises(faults.InjectedFault):
+        faults.hit("p.x", op="FilterExec")
+    faults.hit("p.y", query_id="q-3")            # query mismatch: clean
+    with pytest.raises(faults.InjectedFault):
+        faults.hit("p.y", query_id="dist-q-7-1")
+
+
+def test_delay_action_sleeps_then_continues():
+    faults.install_plan("p.x:nth=1:delay=60")
+    t0 = time.perf_counter()
+    faults.hit("p.x")                            # no raise
+    assert time.perf_counter() - t0 >= 0.055
+    assert faults.injection_counts() == {"injected": 1, "delay": 1}
+
+
+def test_kill_action_parses_without_firing():
+    faults.install_plan("executor.task:nth=99:kill")
+    assert faults._rules[0].action == "kill"
+    faults.hit("executor.task")                  # call 1 != 99: survives
+
+
+def test_raise_named_maps_to_engine_exceptions():
+    from spark_rapids_tpu.cluster.blocks import FetchFailed
+    from spark_rapids_tpu.cluster.driver import ExecutorLostError
+    faults.install_plan("a.b:nth=1:raise=FetchFailed;"
+                        "c.d:nth=1:raise=ExecutorLost;"
+                        "e.f:nth=1:raise=RESOURCE_EXHAUSTED")
+    with pytest.raises(FetchFailed):
+        faults.hit("a.b")
+    with pytest.raises(ExecutorLostError):
+        faults.hit("c.d")
+    with pytest.raises(faults.InjectedFault,
+                       match="^RESOURCE_EXHAUSTED"):
+        faults.hit("e.f")
+
+
+def test_bad_rule_fields_rejected():
+    with pytest.raises(ValueError):
+        faults.install_plan("p.x:wat=1")
+    with pytest.raises(ValueError):
+        faults.install_plan("p.x:badfield")
+
+
+def test_clear_plan_disables_the_active_guard():
+    faults.install_plan("p.x:nth=1:raise=Boom")
+    assert faults.ACTIVE
+    faults.clear_plan()
+    assert not faults.ACTIVE
+    assert faults.current_plan() is None
+    assert faults.injection_trace() == []
+
+
+def test_install_from_conf_is_idempotent_by_spec():
+    from spark_rapids_tpu.config import TpuConf
+    conf = TpuConf({"spark.rapids.tpu.sql.debug.faults.plan":
+                    "p.x:nth=5:raise=Boom"})
+    faults.install_from_conf(conf)
+    faults.hit("p.x")
+    faults.hit("p.x")
+    # re-adoption of the SAME spec (a per-fragment TpuSession in an
+    # executor) must not reset mid-query call counters
+    faults.install_from_conf(conf)
+    assert faults._calls["p.x"] == 2
+
+
+def test_env_plan_activates_at_import(tmp_path):
+    import subprocess
+    import sys
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "from spark_rapids_tpu.runtime import faults; "
+         "print(faults.ACTIVE, faults.current_plan())"],
+        capture_output=True, text=True,
+        env={"PATH": "/usr/bin:/bin", "JAX_PLATFORMS": "cpu",
+             "SRTPU_FAULTS": "p.x:nth=1:raise=Boom",
+             "HOME": str(tmp_path)})
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "True p.x:nth=1:raise=Boom"
+
+
+# ----------------------------------------------------------------------
+# bounded exponential backoff + jitter
+# ----------------------------------------------------------------------
+def test_backoff_deterministic_bounded_and_capped():
+    a = backoff_delays(6, 100.0, max_ms=800.0, seed=3)
+    b = backoff_delays(6, 100.0, max_ms=800.0, seed=3)
+    assert a == b and len(a) == 6
+    for k, d in enumerate(a):
+        cap = min(100.0 * 2 ** k, 800.0) / 1000.0
+        assert cap * 0.5 <= d < cap
+    assert backoff_delays(6, 100.0, max_ms=800.0, seed=4) != a
+
+
+# ----------------------------------------------------------------------
+# OOM classification (memory/retry.py) — head-only, typed first
+# ----------------------------------------------------------------------
+def test_is_oom_budget_exceeded_and_status_heads():
+    from spark_rapids_tpu.memory.device import BudgetExceeded
+    from spark_rapids_tpu.memory.retry import is_oom_error
+    assert is_oom_error(BudgetExceeded("over budget"))
+    assert is_oom_error(RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory while trying to allocate"))
+    assert is_oom_error(RuntimeError("Out of memory allocating 8GiB"))
+    assert not is_oom_error(ValueError("bad plan"))
+
+
+def test_is_oom_matches_only_the_message_head():
+    from spark_rapids_tpu.memory.retry import is_oom_error
+    # user data quoting an OOM-looking string PAST the first line is
+    # not an OOM
+    assert not is_oom_error(ValueError(
+        "cannot parse row\npayload: 'RESOURCE_EXHAUSTED: fake'"))
+    # ... nor is a match beyond the head-size cut on a one-line message
+    assert not is_oom_error(ValueError(
+        "x" * 300 + " RESOURCE_EXHAUSTED"))
+
+
+def test_is_oom_xla_runtime_error_classified_by_type():
+    from spark_rapids_tpu.memory.retry import is_oom_error
+
+    class XlaRuntimeError(Exception):
+        pass
+
+    e = XlaRuntimeError("RESOURCE_EXHAUSTED: Out of memory")
+    assert is_oom_error(e)
+    e2 = XlaRuntimeError("INTERNAL: something broke")
+    assert not is_oom_error(e2)
+    # builds that expose .status are honored over the message
+    e3 = XlaRuntimeError("opaque text")
+    e3.status = "RESOURCE_EXHAUSTED"
+    assert is_oom_error(e3)
+    e4 = XlaRuntimeError("out of memory (lowercase xla wording)")
+    assert is_oom_error(e4)
+
+
+def test_injected_resource_exhausted_routes_through_oom_classifier():
+    from spark_rapids_tpu.memory.retry import is_oom_error
+    faults.install_plan("p.x:nth=1:raise=RESOURCE_EXHAUSTED")
+    with pytest.raises(faults.InjectedFault) as ei:
+        faults.hit("p.x")
+    assert is_oom_error(ei.value)
+
+
+# ----------------------------------------------------------------------
+# transient classification (service retry) — conservative by contract
+# ----------------------------------------------------------------------
+def test_is_transient_error_per_class():
+    from spark_rapids_tpu.cluster.blocks import FetchFailed
+    from spark_rapids_tpu.cluster.driver import ExecutorLostError
+    from spark_rapids_tpu.service.query_manager import (QueryCancelled,
+                                                        QueryTimedOut)
+    t = faults.is_transient_error
+    assert t(faults.InjectedFault("boom"))
+    assert t(FetchFailed("mapper gone"))
+    assert t(ExecutorLostError("lost"))
+    assert t(ConnectionResetError("reset"))
+    # NEVER transient: explicit decisions and user/plan errors
+    assert not t(QueryCancelled("user cancel"))
+    assert not t(QueryTimedOut("deadline"))
+    assert not t(KeyboardInterrupt())
+    assert not t(SystemExit())
+    assert not t(GeneratorExit())
+    assert not t(ValueError("bad expression"))
+    assert not t(TypeError("bad plan"))
+    assert not t(RuntimeError("arbitrary"))
+
+
+# ----------------------------------------------------------------------
+# graceful device->host degradation
+# ----------------------------------------------------------------------
+_DATA = {"id": list(range(3000)), "v": [i % 97 for i in range(3000)]}
+
+
+def _q(s):
+    return (s.create_dataframe(_DATA)
+            .filter(col("v") > 10)
+            .select((col("id") * 2).alias("x"), col("v")))
+
+
+def test_degradation_recovers_byte_identical():
+    ref = _q(st.TpuSession(
+        {"spark.rapids.tpu.sql.resultCache.enabled": "false"})).to_arrow()
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.debug.faults.plan":
+            "device.dispatch:prob=1.0:seed=5:raise=InternalError",
+        "spark.rapids.tpu.sql.resultCache.enabled": "false",
+        "spark.rapids.tpu.sql.batchSizeRows": 1024})
+    df = _q(s)
+    out = df.to_arrow()
+    assert out.equals(ref)
+    assert faults.injection_counts()["injected"] >= 1
+    assert faults.recovery_stats()["degradations"] >= 1
+    m = df.last_metrics()
+    degraded = sum(v.get("degradedToHost", 0) for v in m.values()
+                   if isinstance(v, dict))
+    assert degraded >= 1
+
+
+def test_degradation_pins_after_threshold_and_logs_event(tmp_path):
+    import glob
+    import json
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.debug.faults.plan":
+            "device.dispatch:prob=1.0:seed=5:raise=InternalError",
+        "spark.rapids.tpu.sql.resultCache.enabled": "false",
+        "spark.rapids.tpu.sql.batchSizeRows": 512,
+        "spark.rapids.tpu.sql.eventLog.enabled": "true",
+        "spark.rapids.tpu.sql.eventLog.dir": str(tmp_path)})
+    _q(s).to_arrow()
+    evs = []
+    for p in glob.glob(str(tmp_path / "*")):
+        with open(p) as f:
+            evs += [json.loads(line) for line in f]
+    dg = [e for e in evs if e.get("event") == "degrade_to_host"]
+    assert dg, "degrade_to_host event missing from the query log"
+    assert dg[0]["failures"] >= 2        # pinned at FAILURE_THRESHOLD
+
+
+def test_degradation_gate_off_propagates():
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.debug.faults.plan":
+            "device.dispatch:prob=1.0:seed=5:raise=InternalError",
+        "spark.rapids.tpu.sql.exec.degradeToHost.enabled": "false",
+        "spark.rapids.tpu.sql.service.maxQueryRetries": "0",
+        "spark.rapids.tpu.sql.resultCache.enabled": "false"})
+    with pytest.raises(faults.InjectedFault):
+        _q(s).to_arrow()
+    assert "degradations" not in faults.recovery_stats()
+
+
+def test_degradation_never_claims_oom_or_cancel():
+    from spark_rapids_tpu.exec import degrade
+    from spark_rapids_tpu.exec.base import ExecContext
+    from spark_rapids_tpu.service.query_manager import QueryCancelled
+
+    class _Node:
+        _op_id = "X@1"
+
+    ctx = ExecContext(planning=True)
+    assert not degrade.should_degrade(
+        ctx, _Node(), faults.InjectedFault("RESOURCE_EXHAUSTED: dev"))
+    assert not degrade.should_degrade(ctx, _Node(),
+                                      QueryCancelled("stop"))
+    assert ctx.device_failures == {}     # neither counted as a failure
+
+
+# ----------------------------------------------------------------------
+# service-level transparent retry
+# ----------------------------------------------------------------------
+def _agg_q(s):
+    from spark_rapids_tpu import functions as F
+    return (s.create_dataframe(_DATA).group_by("v")
+            .agg(F.sum(col("id")).alias("s")).sort("v"))
+
+
+def test_service_retry_is_transparent_and_event_logged(tmp_path):
+    import glob
+    import json
+
+    from spark_rapids_tpu.runtime import program_cache
+    ref = _agg_q(st.TpuSession(
+        {"spark.rapids.tpu.sql.resultCache.enabled": "false"})).to_arrow()
+    program_cache.clear()      # the retried attempt must recompile
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.debug.faults.plan":
+            "xla.compile:nth=1:raise=FetchFailed",
+        "spark.rapids.tpu.sql.resultCache.enabled": "false",
+        "spark.rapids.tpu.sql.eventLog.enabled": "true",
+        "spark.rapids.tpu.sql.eventLog.dir": str(tmp_path)})
+    out = _agg_q(s).to_arrow()
+    assert out.equals(ref)
+    assert faults.recovery_stats()["query_retries"] == 1
+    evs = []
+    for p in glob.glob(str(tmp_path / "*")):
+        with open(p) as f:
+            evs += [json.loads(line) for line in f]
+    rt = [e for e in evs if e.get("event") == "query_retry"]
+    assert len(rt) == 1
+    assert rt[0]["attempt"] == 1
+    assert rt[0]["prior_query_id"] != rt[0]["query_id"]
+    assert "FetchFailed" in rt[0]["error"]
+
+
+def test_service_retry_is_bounded():
+    from spark_rapids_tpu.cluster.blocks import FetchFailed
+    from spark_rapids_tpu.runtime import program_cache
+    program_cache.clear()
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.debug.faults.plan":
+            "xla.compile:raise=FetchFailed",     # EVERY attempt fails
+        "spark.rapids.tpu.sql.service.maxQueryRetries": "2",
+        "spark.rapids.tpu.sql.resultCache.enabled": "false"})
+    with pytest.raises(FetchFailed):
+        _agg_q(s).to_arrow()
+    assert faults.recovery_stats()["query_retries"] == 2
+
+
+def test_timeout_is_never_retried():
+    from spark_rapids_tpu.runtime import program_cache
+    from spark_rapids_tpu.service.query_manager import QueryCancelled
+    program_cache.clear()
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.debug.faults.plan":
+            "xla.compile:delay=400",
+        "spark.rapids.tpu.sql.service.queryTimeoutSecs": "0.15",
+        "spark.rapids.tpu.sql.service.maxQueryRetries": "5",
+        "spark.rapids.tpu.sql.resultCache.enabled": "false"})
+    with pytest.raises(QueryCancelled):      # QueryTimedOut subclasses
+        _agg_q(s).to_arrow()
+    assert "query_retries" not in faults.recovery_stats()
+
+
+def test_retries_respect_the_original_deadline():
+    from spark_rapids_tpu.cluster.blocks import FetchFailed
+    from spark_rapids_tpu.runtime import program_cache
+    from spark_rapids_tpu.service.query_manager import QueryCancelled
+    program_cache.clear()
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.debug.faults.plan":
+            "xla.compile:raise=FetchFailed;xla.compile:delay=100",
+        "spark.rapids.tpu.sql.service.queryTimeoutSecs": "0.8",
+        "spark.rapids.tpu.sql.service.maxQueryRetries": "1000",
+        "spark.rapids.tpu.sql.resultCache.enabled": "false"})
+    t0 = time.monotonic()
+    with pytest.raises((FetchFailed, QueryCancelled)):
+        _agg_q(s).to_arrow()
+    elapsed = time.monotonic() - t0
+    retries = faults.recovery_stats().get("query_retries", 0)
+    # the ORIGINAL deadline binds: far fewer than maxQueryRetries
+    # attempts ran, and the loop gave up around the 0.8s deadline
+    assert retries < 1000
+    assert elapsed < 10.0
+
+
+# ----------------------------------------------------------------------
+# deterministic replay: same plan + seed => same injection trace for a
+# full query (the per-batch dispatch schedule is itself deterministic)
+# ----------------------------------------------------------------------
+def test_same_seed_replays_identical_injection_trace():
+    spec = "device.dispatch:prob=0.3:seed=21:raise=InternalError"
+    conf = {"spark.rapids.tpu.sql.resultCache.enabled": "false",
+            "spark.rapids.tpu.sql.batchSizeRows": 512}
+
+    def run_once():
+        faults.install_plan(spec)
+        s = st.TpuSession(conf)
+        out = _q(s).to_arrow()
+        return out, faults.injection_trace()
+
+    out1, trace1 = run_once()
+    out2, trace2 = run_once()
+    assert trace1, "plan never injected — prob/seed changed?"
+    assert trace1 == trace2
+    assert out1.equals(out2)

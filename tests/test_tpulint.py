@@ -586,3 +586,104 @@ def test_cli_write_baseline_requires_reason(tmp_path):
          "--write-baseline"],
         capture_output=True, text=True)
     assert r.returncode == 2
+
+
+# ----------------------------------------------------------------------
+# retry-swallows-cancel
+# ----------------------------------------------------------------------
+def test_retry_swallows_cancel_fires_on_blind_retry_loop():
+    vs = _lint("""
+        def run_with_retries(fn):
+            for attempt in range(3):
+                try:
+                    return fn()
+                except Exception:
+                    continue
+    """)
+    assert [v.rule for v in vs] == ["retry-swallows-cancel"]
+
+
+def test_retry_swallows_cancel_fires_on_bare_except():
+    assert _rules("""
+        def poll(fn):
+            retries = 0
+            while retries < 5:
+                try:
+                    return fn()
+                except:
+                    retries += 1
+    """) == ["retry-swallows-cancel"]
+
+
+def test_retry_handler_with_reraise_is_clean():
+    assert _rules("""
+        def run_with_retries(fn):
+            for attempt in range(3):
+                try:
+                    return fn()
+                except Exception as e:
+                    if attempt == 2:
+                        raise
+    """) == []
+
+
+def test_retry_handler_consulting_classifier_is_clean():
+    assert _rules("""
+        def run_with_retries(fn):
+            for attempt in range(3):
+                try:
+                    return fn()
+                except Exception as e:
+                    if not is_transient_error(e):
+                        raise
+    """) == []
+
+
+def test_retry_handler_checking_cancel_type_is_clean():
+    assert _rules("""
+        def run_with_retries(fn):
+            for attempt in range(3):
+                try:
+                    return fn()
+                except Exception as e:
+                    if isinstance(e, QueryCancelled):
+                        raise
+    """) == []
+
+
+def test_non_retry_loop_broad_except_not_flagged():
+    """A broad handler in a plain data loop is out of scope — only
+    retry-shaped loops can resurrect a cancelled query."""
+    assert _rules("""
+        def drain(items):
+            out = []
+            for item in items:
+                try:
+                    out.append(parse(item))
+                except Exception:
+                    pass
+            return out
+    """) == []
+
+
+def test_narrow_except_in_retry_loop_not_flagged():
+    assert _rules("""
+        def run_with_retries(fn):
+            for attempt in range(3):
+                try:
+                    return fn()
+                except ValueError:
+                    continue
+    """) == []
+
+
+def test_retry_swallows_cancel_allow_marker():
+    assert _rules("""
+        def run_with_retries(fn):
+            for attempt in range(3):
+                try:
+                    return fn()
+                # tpulint: allow[retry-swallows-cancel] fn is pure local math, no cancellation in scope
+                except Exception:
+                    continue
+    """) == []
