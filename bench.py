@@ -116,6 +116,13 @@ if _ZIPFIAN and not _CONCURRENT:
     print("bench: --zipfian needs --concurrent N", file=sys.stderr)
     sys.exit(2)
 
+# --compile-tail: cold vs warm first-run compile tail across TPC-H —
+# per-query sync compiles + compile wall ms on a cold process program
+# cache, the fresh-rerun floor (must compile nothing), and the tail a
+# service restart pays when an AOT warm pack is preloaded
+# (sql.service.warmPack.path + stage-ahead prewarm from seeded specs).
+_COMPILE_TAIL = "--compile-tail" in sys.argv[1:]
+
 # milestone metrics flushed verbatim when the budget expires mid-run
 _partial = {"extra": {}}
 
@@ -184,14 +191,18 @@ def _best(fn, iters):
     return best
 
 
-def _best_fresh(build, iters):
+def _best_fresh(build, iters, on_warm=None):
     """Honest engine timing: `build()` returns a NEW DataFrame tree each
     iteration, so every timed run re-plans and re-executes from scratch
     (planning + program-cache lookups included) instead of replaying a
     resident physical plan's device state. The first build warms the
     process-global program cache — XLA compiles are a process cost, not
-    a per-query cost — and is untimed."""
+    a per-query cost — and is untimed. `on_warm` fires between the warm
+    run and the timed runs so callers can split compile activity into a
+    cold (first execution) and warm (rerun) share."""
     build().to_arrow()  # warm: first-ever shapes pay their XLA compiles
+    if on_warm is not None:
+        on_warm()
     best = float("inf")
     for _ in range(max(iters, 1)):
         q = build()
@@ -314,6 +325,26 @@ def _main_impl():
                   f"{soak['lockdep'].get('findings')}",
                   file=sys.stderr)
             sys.exit(1)
+        return
+
+    # ---- standalone compile-tail mode: bench.py --compile-tail --------
+    if _COMPILE_TAIL:
+        sf_c = float(os.environ.get("BENCH_SF_FULL",
+                                    "0.05" if _SMOKE else "0.2"))
+        with _alarm(_remaining() - 15.0, f"compile tail sf={sf_c}"):
+            tail = _compile_tail(
+                st, sf_c,
+                qids=((1, 3, 5, 6, 10, 12, 14, 19)
+                      if _SMOKE else None))
+        print(json.dumps({
+            "metric": f"tpch_compile_tail_sf{sf_c}",
+            "value": tail.get("cold_compiles_geomean"),
+            "unit": "xla_compiles_geomean",
+            "vs_baseline": None,
+            **({"backend_fallback": "cpu (tpu unreachable)",
+                "tpu_probe_errors": tpu_errors} if fellback else {}),
+            "extra": tail,
+        }))
         return
 
     # ---- standalone throughput mode: bench.py --concurrent N ----------
@@ -741,7 +772,10 @@ def _tpch_sweep(s, sf: float):
                 x0 = xla_stats.snapshot()
                 # headline: fresh tree per timed iteration; the same-
                 # object rerun is the optimistic resident_replay number
-                e_t = _best_fresh(lambda: reg[qn](dfs), 2)
+                xw = {}
+                e_t = _best_fresh(lambda: reg[qn](dfs), 2,
+                                  on_warm=lambda:
+                                  xw.update(xla_stats.snapshot()))
                 x1 = xla_stats.snapshot()
                 r_t = _best(lambda: q.to_arrow(), 1)
                 o_t = _best(lambda: ORACLES[qn](host), 2)
@@ -752,10 +786,22 @@ def _tpch_sweep(s, sf: float):
             # XLA activity across the query's 3 runs (warm + 2 timed):
             # the whole-stage fusion acceptance metric — fewer programs
             # compiled and fewer per-batch dispatches at equal results
-            xla[f"q{qn}"] = {
+            rec = {
                 "compiles": int(x1["compiles"] - x0["compiles"]),
                 "dispatches": int(x1["dispatches"] - x0["dispatches"]),
             }
+            if xw:
+                # cold/warm split: the warm-up run pays the first-run
+                # compile tail (the --compile-tail target metric); the
+                # timed fresh reruns must compile nothing (PR 6 gate)
+                rec["compiles_cold"] = int(xw["compiles"]
+                                           - x0["compiles"])
+                rec["compiles_warm"] = int(x1["compiles"]
+                                           - xw["compiles"])
+                rec["compile_ms_cold"] = round(
+                    float(xw.get("program_cache_compile_ms", 0.0)
+                          - x0.get("program_cache_compile_ms", 0.0)), 1)
+            xla[f"q{qn}"] = rec
             if _PROFILE:
                 try:
                     from spark_rapids_tpu.profiler.event_log import (
@@ -799,6 +845,139 @@ def _tpch_sweep(s, sf: float):
         out["tpch_profile"] = profile
     if errors:
         out["tpch_all22_errors"] = errors
+    return out
+
+
+def _compile_tail(st, sf: float, qids=None) -> dict:
+    """Cold vs warm first-run compile tail (ISSUE 15 acceptance).
+
+    Per query, on a process program cache cleared once up front:
+    `cold` = the first execution (sync compiles, compile wall ms,
+    end-to-end seconds — the first-user-visible-query tail), `warm` =
+    a fresh-tree rerun (must compile nothing, PR 6 gate; wall is the
+    steady-state floor). After the sweep the observed program set is
+    saved as a warm pack, the cache is cleared again (simulated fresh
+    process), the pack preloaded, and each query tree stage-ahead
+    prewarmed from the seeded specs with the pool drained before the
+    `packed` execution — the tail a service restart actually pays with
+    `sql.service.warmPack.path` set."""
+    import math
+    import shutil
+    import tempfile
+
+    from spark_rapids_tpu.exec.base import prewarm_tree
+    from spark_rapids_tpu.profiler import xla_stats
+    from spark_rapids_tpu.runtime import (compile_pool, program_cache,
+                                          warm_pack)
+    from spark_rapids_tpu.workloads import tpch
+
+    s = st.TpuSession()
+    tabs = tpch.gen_all(sf=sf, seed=7)
+    dfs = {k: s.create_dataframe(v).cache() for k, v in tabs.items()}
+    reg = tpch.queries()
+    qids = [q for q in (qids or range(1, 23)) if q in reg]
+    program_cache.clear()
+    program_cache.set_active_conf(s.conf)
+
+    def _pc_ms(x):
+        return float(x.get("program_cache_compile_ms", 0.0))
+
+    per_q, errors = {}, {}
+    for qn in qids:
+        left = _remaining() - 30.0
+        if left <= 2.0:
+            errors[f"q{qn}"] = "skipped: bench global budget exhausted"
+            continue
+        try:
+            with _alarm(min(_QUERY_BUDGET_S * 2, left),
+                        f"compile-tail q{qn}"):
+                x0 = xla_stats.snapshot()
+                t0 = time.perf_counter()
+                reg[qn](dfs).to_arrow()
+                cold_s = time.perf_counter() - t0
+                x1 = xla_stats.snapshot()
+                t0 = time.perf_counter()
+                reg[qn](dfs).to_arrow()
+                warm_s = time.perf_counter() - t0
+                x2 = xla_stats.snapshot()
+            per_q[f"q{qn}"] = {
+                "cold_compiles": int(x1["compiles"] - x0["compiles"]),
+                "cold_compile_ms": round(_pc_ms(x1) - _pc_ms(x0), 1),
+                "cold_s": round(cold_s, 4),
+                "warm_compiles": int(x2["compiles"] - x1["compiles"]),
+                "warm_s": round(warm_s, 4),
+            }
+        except _BenchTimeout as e:
+            errors[f"q{qn}"] = f"timeout: {e}"
+        except Exception as e:
+            errors[f"q{qn}"] = repr(e)[:300]
+
+    out = {"compile_tail_sf": sf, "per_query": per_q}
+    if errors:
+        out["errors"] = errors
+    if per_q:
+        # geomean over max(1, count): zero-compile queries must not
+        # zero the product, and the acceptance metric is the trajectory
+        # of this number vs earlier BENCH tpch_xla_per_query artifacts
+        k = len(per_q)
+        out["cold_compiles_geomean"] = round(math.exp(
+            sum(math.log(max(1, v["cold_compiles"]))
+                for v in per_q.values()) / k), 2)
+        out["cold_compile_ms_total"] = round(
+            sum(v["cold_compile_ms"] for v in per_q.values()), 1)
+        out["warm_compiles_total"] = sum(
+            v["warm_compiles"] for v in per_q.values())
+
+    # ---- packed phase: simulated service restart with a warm pack ----
+    tmpd = tempfile.mkdtemp(prefix="srtpu_pack_")
+    try:
+        pack = warm_pack.save(s.conf, os.path.join(tmpd, "tpch.pack"))
+        if pack and _remaining() > 60.0:
+            program_cache.clear()
+            program_cache.set_active_conf(s.conf)
+            summary = warm_pack.preload(s, pack)
+            pool = compile_pool.get_pool(s.conf)
+            packed = {}
+            for qn in qids:
+                if f"q{qn}" not in per_q or _remaining() <= 45.0:
+                    continue
+                try:
+                    with _alarm(min(_QUERY_BUDGET_S * 2,
+                                    _remaining() - 30.0),
+                                f"compile-tail packed q{qn}"):
+                        q = reg[qn](dfs)
+                        root, _ = q._execute(None)  # plan only
+                        if pool is not None:
+                            prewarm_tree(root, pool)
+                            pool.drain(min(60.0, _remaining() - 40.0))
+                        x0 = xla_stats.snapshot()
+                        t0 = time.perf_counter()
+                        q.to_arrow()
+                        packed_s = time.perf_counter() - t0
+                        x1 = xla_stats.snapshot()
+                    packed[f"q{qn}"] = {
+                        "compiles": int(x1["compiles"] - x0["compiles"]),
+                        "compile_ms": round(_pc_ms(x1) - _pc_ms(x0), 1),
+                        "s": round(packed_s, 4),
+                    }
+                except _BenchTimeout as e:
+                    errors[f"packed_q{qn}"] = f"timeout: {e}"
+                except Exception as e:
+                    errors[f"packed_q{qn}"] = repr(e)[:300]
+            out["packed_per_query"] = packed
+            out["warm_pack"] = {
+                "programs": summary.get("programs"),
+                "matched": summary.get("programs_matched"),
+                "seeded": summary.get("seeded"),
+                "submitted": summary.get("submitted"),
+            }
+            if packed:
+                out["packed_compile_ms_total"] = round(
+                    sum(v["compile_ms"] for v in packed.values()), 1)
+            if errors:
+                out["errors"] = errors
+    finally:
+        shutil.rmtree(tmpd, ignore_errors=True)
     return out
 
 
@@ -1103,6 +1282,53 @@ def _zipfian_throughput(st, sf: float, n_streams: int,
     committed = [write_side(0)]
     side_query()   # populate the whole-query tier for the side table
 
+    # fragment-tier side workload (BENCH_r06 follow-up: the TPC-H
+    # streams above are served from the whole-query tier — they never
+    # replan, so substitute_fragments never runs for them, and the
+    # single-partition side_query has no exchange; `fragment_hits: 0`
+    # was structural, not a keying bug). This pair forces the workflow
+    # the fragment tier exists for: a distributed shuffle join where
+    # the writer invalidates ONE side and the re-planned re-run must
+    # reuse the surviving side's exchange map output. A dedicated
+    # session supplies the shuffle-forcing confs (the result cache is
+    # process-global, so both sessions share one fragment table).
+    s_frag = st.TpuSession({
+        "spark.rapids.tpu.sql.cache.enabled": True,
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 0,
+        "spark.rapids.tpu.sql.batchSizeRows": 64,
+        "spark.rapids.tpu.sql.shuffle.partitions": 2})
+    import pyarrow.parquet as _pq_mod
+    stable_dir = os.path.join(side_dir, "frag_stable")
+    hot_dir = os.path.join(side_dir, "frag_hot")
+    os.makedirs(stable_dir), os.makedirs(hot_dir)
+    for i in range(3):   # multi-file: keeps >1 scan partition => real
+        _pq_mod.write_table(pa.table(   # exchanges on both join sides
+            {"a": [(j + i * 50) % 7 for j in range(50)],
+             "b": [float(j + i) for j in range(50)]}),
+            os.path.join(stable_dir, f"p{i}.parquet"))
+
+    def write_hot(version: int) -> None:
+        _pq_mod.write_table(pa.table(
+            {"a": [(j + version) % 7 for j in range(50)],
+             "c": [float(j * 2 + version) for j in range(50)]}),
+            os.path.join(hot_dir, "p0.parquet"))
+        for i in (1, 2):
+            if not os.path.exists(os.path.join(hot_dir,
+                                               f"p{i}.parquet")):
+                _pq_mod.write_table(pa.table(
+                    {"a": [(j + i * 50) % 7 for j in range(50)],
+                     "c": [float(j * 2) for j in range(50)]}),
+                    os.path.join(hot_dir, f"p{i}.parquet"))
+
+    def side_join():
+        l = s_frag.read.parquet(stable_dir)
+        r = s_frag.read.parquet(hot_dir)
+        return l.join(r, on="a").agg(
+            n=F.count(F.lit(1)), sb=F.sum("b")).to_arrow()
+
+    write_hot(0)
+    side_join()   # stores both sides' exchange fragments
+
     results = []   # (qn, table, latency_s)
     errors = []
     side_reads = 0
@@ -1116,6 +1342,7 @@ def _zipfian_throughput(st, sf: float, n_streams: int,
                 break
             with commit_lock:
                 committed.append(write_side(v))
+                write_hot(v)   # invalidates the hot join side only
 
     def stream(i: int):
         nonlocal side_reads
@@ -1140,6 +1367,14 @@ def _zipfian_throughput(st, sf: float, n_streams: int,
                         if got != want:
                             errors.append(f"stream{i}: stale side read "
                                           f"{got} != {want}")
+                if j % 7 == 3:
+                    # fragment-tier traffic: re-planned shuffle join
+                    # whose stable side must come from the cache; under
+                    # commit_lock so the hot-side writer cannot change
+                    # files mid-scan (SnapshotMismatch is the engine's
+                    # correct answer to that torn read, not a cache bug)
+                    with commit_lock:
+                        side_join()
             except Exception as e:  # noqa: BLE001 — reported in JSON
                 with lock:
                     errors.append(f"stream{i} q{qn}: {e!r}")
@@ -1168,6 +1403,27 @@ def _zipfian_throughput(st, sf: float, n_streams: int,
     invalidation_ok = (final == committed[-1]
                        and result_cache.stats()
                        ["result_cache_invalidations"] > inv_before)
+
+    # quiesced fragment check: one more invalidating write on the hot
+    # join side, then the re-planned join MUST reuse the stable side's
+    # exchange fragment (and agree with a cache-free execution)
+    fh0 = result_cache.stats()["result_cache_fragment_hits"]
+    write_hot(n_writes + 7)
+    frag_tbl = side_join()
+    frag_hits_after_write = (result_cache.stats()
+                             ["result_cache_fragment_hits"] - fh0)
+    assert frag_hits_after_write >= 1, (
+        "stable-side exchange fragment must hit after the hot-side "
+        "write invalidated its sibling")
+    s_nocache = st.TpuSession({
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 0,
+        "spark.rapids.tpu.sql.batchSizeRows": 64,
+        "spark.rapids.tpu.sql.shuffle.partitions": 2})
+    frag_fresh = s_nocache.read.parquet(stable_dir).join(
+        s_nocache.read.parquet(hot_dir), on="a").agg(
+        n=F.count(F.lit(1)), sb=F.sum("b")).to_arrow()
+    assert frag_tbl.equals(frag_fresh), (
+        "fragment-served join diverges from cache-free execution")
 
     mismatched = sorted({qn for qn, tbl, _ in results
                          if not tbl.equals(serial[qn])})
@@ -1216,6 +1472,7 @@ def _zipfian_throughput(st, sf: float, n_streams: int,
         "side_writes": len(committed),
         "side_reads": side_reads,
         "invalidation_ok": invalidation_ok,
+        "fragment_hits_after_side_write": int(frag_hits_after_write),
         "byte_identical": True,
     }
     for df in dfs.values():

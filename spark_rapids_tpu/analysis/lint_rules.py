@@ -698,6 +698,44 @@ def rule_fp_unstable_attr(ctx: _ModuleCtx):
                        f"structurally")
 
 
+def rule_unstable_program_key(ctx: _ModuleCtx):
+    """Flag `cached_program(..., key=<unstable>)` where the key draws
+    from id(), a clock, a uuid, a random source, or a process-global
+    counter. The program-cache key IS the sharing contract: an
+    unstable component makes every structurally identical site compile
+    its own program (cache always misses), and excludes the site from
+    warm-pack manifests — keys that cannot match across processes are
+    dropped at record time (runtime/warm_pack.py). A site whose program
+    genuinely depends on unkeyable instance state must spell it
+    `key=("id", id(self))` AND carry an allow marker explaining why,
+    like the documented per-instance fallbacks do."""
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fname = None
+        if isinstance(node.func, ast.Name):
+            fname = node.func.id
+        elif isinstance(node.func, ast.Attribute):
+            fname = node.func.attr
+        if fname != "cached_program":
+            continue
+        for kw in node.keywords:
+            if kw.arg != "key" or kw.value is None:
+                continue
+            desc = _unstable_value(kw.value)
+            if desc is not None:
+                yield (node.lineno, node.col_offset,
+                       "unstable-program-key",
+                       f"cached_program key= contains the process-"
+                       f"unstable value {desc}: the entry can never be "
+                       f"shared across instances or recorded in a warm "
+                       f"pack — derive the key from structural "
+                       f"fingerprints (expr_fp/stage_fingerprint/"
+                       f"chunk counts) or mark the documented "
+                       f"('id', id(self)) fallback with an allow "
+                       f"marker")
+
+
 #: identifiers whose presence in a broad retry handler shows the author
 #: thought about cancellation/transience classification (the classifier
 #: helpers, the cancel exception types, and the token itself)
@@ -797,6 +835,7 @@ RULES = {
     "pool-cancel": rule_pool_cancel,
     "retry-swallows-cancel": rule_retry_swallows_cancel,
     "fp-unstable-attr": rule_fp_unstable_attr,
+    "unstable-program-key": rule_unstable_program_key,
 }
 
 

@@ -25,8 +25,9 @@ import numpy as np
 
 from . import dtypes as dt
 
-__all__ = ["Column", "bucket_capacity", "MIN_CAPACITY", "flatten_bufs",
-           "unflatten_bufs"]
+__all__ = ["Column", "bucket_capacity", "bucket_chunks", "MIN_CAPACITY",
+           "set_bucket_policy", "bucket_policy", "shape_stats",
+           "reset_shape_stats", "flatten_bufs", "unflatten_bufs"]
 
 
 def flatten_bufs(bufs, prefix: str = "", out=None):
@@ -61,6 +62,66 @@ def unflatten_bufs(flat):
 
 MIN_CAPACITY = 128
 
+# ---------------------------------------------------------------------
+# shape-bucket policy (sql.exec.shapeBuckets.*): every capacity (and,
+# through ops/sortkeys.nchunks_for_len, every string-chunk count) in the
+# engine rounds up onto the geometric grid {minRows * growthFactor^k}.
+# The default grid (128, x2) is the historical power-of-two bucketing —
+# zero behavior change. A coarser grid (e.g. minRows=4096, x4) collapses
+# many nearby sizes onto one bucket: structurally equal operators at
+# different input sizes then share ONE padded XLA program, shrinking the
+# cold compile tail at a bounded padding cost (capacity < growthFactor
+# * rows for rows > minRows — the measured bound shape_stats() reports).
+# Adopted per query by runtime/program_cache.set_active_conf; process-
+# global like the program cache itself (last conf wins), because the
+# programs the buckets key are process-global too.
+_BUCKET_MIN = MIN_CAPACITY
+_BUCKET_GROWTH_BITS = 1      # log2(growthFactor); 1 == power-of-two
+# advisory padding-waste accounting (racy += under the GIL is fine: the
+# counters steer nothing, they only report the measured waste bound)
+_shape_stats = {"bucket_requests": 0, "requested_rows": 0,
+                "bucketed_rows": 0}
+
+_ALLOWED_GROWTH = (2, 4, 8, 16)
+
+
+def set_bucket_policy(min_rows: int = MIN_CAPACITY,
+                      growth_factor: int = 2) -> None:
+    """Install the capacity-bucket grid. `min_rows` must be a power of
+    two >= MIN_CAPACITY (the TPU lane-width floor); `growth_factor` one
+    of 2/4/8/16. Out-of-range values clamp to the nearest legal value
+    rather than raise — a mistyped conf must not fail every query."""
+    global _BUCKET_MIN, _BUCKET_GROWTH_BITS
+    m = max(int(min_rows), MIN_CAPACITY)
+    m = 1 << (m - 1).bit_length()           # round up to a power of two
+    g = min(_ALLOWED_GROWTH, key=lambda a: abs(a - int(growth_factor)))
+    _BUCKET_MIN = m
+    _BUCKET_GROWTH_BITS = g.bit_length() - 1
+
+
+def bucket_policy() -> tuple:
+    """(min_rows, growth_factor) currently installed."""
+    return _BUCKET_MIN, 1 << _BUCKET_GROWTH_BITS
+
+
+def shape_stats() -> dict:
+    """Padding-waste accounting since the last reset: how many rows
+    callers asked for vs how many the buckets allocated. waste_frac is
+    the measured padding fraction — bounded by 1 - 1/growthFactor for
+    requests above the floor."""
+    out = dict(_shape_stats)
+    br = out["bucketed_rows"]
+    out["waste_frac"] = (round(1.0 - out["requested_rows"] / br, 4)
+                         if br else 0.0)
+    out["policy_min_rows"] = _BUCKET_MIN
+    out["policy_growth_factor"] = 1 << _BUCKET_GROWTH_BITS
+    return out
+
+
+def reset_shape_stats() -> None:
+    for k in _shape_stats:
+        _shape_stats[k] = 0
+
 
 def alloc_shape(dtype: "dt.DataType", cap: int):
     """Data-buffer shape for a fixed-width column of `cap` rows.
@@ -72,10 +133,32 @@ def alloc_shape(dtype: "dt.DataType", cap: int):
 
 
 def bucket_capacity(n: int) -> int:
-    """Round n up to the next power of two, with a floor of MIN_CAPACITY."""
-    if n <= MIN_CAPACITY:
-        return MIN_CAPACITY
-    return 1 << (int(n - 1).bit_length())
+    """Round n up onto the bucket grid {minRows * growthFactor^k}. The
+    default policy (128, x2) is the historical next-power-of-two with a
+    MIN_CAPACITY floor."""
+    m = _BUCKET_MIN
+    if n <= m:
+        cap = m
+    else:
+        g = _BUCKET_GROWTH_BITS
+        steps = -(-(int(n - 1).bit_length() - (m.bit_length() - 1)) // g)
+        cap = m << (steps * g)
+    _shape_stats["bucket_requests"] += 1
+    _shape_stats["requested_rows"] += max(int(n), 0)
+    _shape_stats["bucketed_rows"] += cap
+    return cap
+
+
+def bucket_chunks(n: int) -> int:
+    """Round a chunk COUNT up onto the same geometric grid (floor 1).
+    String-key programs are traced per chunk count; canonicalizing the
+    count means nearby key lengths share one program at the cost of a
+    few all-padding chunks."""
+    if n <= 1:
+        return 1
+    g = _BUCKET_GROWTH_BITS
+    steps = -(-int(n - 1).bit_length() // g)
+    return 1 << (steps * g)
 
 
 def _pad_to(arr: np.ndarray, capacity: int, fill=0) -> np.ndarray:

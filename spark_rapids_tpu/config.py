@@ -238,6 +238,76 @@ PROGRAM_CACHE_MAX_ENTRIES = _conf(
     "raising it far beyond the default risks mmap exhaustion in "
     "long-lived many-query processes. Eviction counts surface as "
     "program_cache_evictions in the xla_compile event record.", int)
+SHAPE_BUCKET_MIN_ROWS = _conf(
+    "sql.exec.shapeBuckets.minRows", 128,
+    "Floor of the capacity-bucket grid (columnar/column.py "
+    "set_bucket_policy): every device buffer capacity rounds up onto "
+    "{minRows * growthFactor^k}. Rounded to a power of two, minimum "
+    "128 (TPU lane width). Raising the floor collapses many small "
+    "batch sizes onto one bucket so structurally equal operators "
+    "share one padded XLA program — fewer cold compiles, bounded "
+    "extra padding. Adopted process-globally at query start "
+    "(program_cache.set_active_conf), like the program cache it "
+    "feeds.", int)
+SHAPE_BUCKET_GROWTH = _conf(
+    "sql.exec.shapeBuckets.growthFactor", 2,
+    "Growth factor of the capacity-bucket grid (one of 2/4/8/16). "
+    "2 is the historical next-power-of-two bucketing; 4 compiles "
+    "~half as many distinct shapes per operator at a padding-waste "
+    "bound of 1 - 1/growthFactor (measured waste surfaces in "
+    "columnar.column.shape_stats and the bench --compile-tail "
+    "report). String-key chunk counts canonicalize on the same grid "
+    "(ops/sortkeys.nchunks_for_len).", int)
+COMPILE_POOL_ENABLED = _conf(
+    "sql.exec.compilePool.enabled", True,
+    "Background XLA compilation (runtime/compile_pool.py): a bounded "
+    "pool of daemon threads (tpu-compile-N) compiles stage programs "
+    "ahead of first dispatch — downstream fused-stage programs are "
+    "submitted at query launch and compile while upstream stages "
+    "execute; warm-pack preloads compile speculatively at service "
+    "startup. Dispatch NEVER waits on a background compile: a sync "
+    "miss compiles inline exactly as before (a duplicate compile is "
+    "accepted over a stall), and speculative tasks yield while "
+    "queries are running (admission-aware). Background failures — "
+    "including injected xla.compile faults — are swallowed, counted "
+    "(program_cache_background_failures), and fall back to the sync "
+    "path.", bool)
+COMPILE_POOL_THREADS = _conf(
+    "sql.exec.compilePool.threads", 2,
+    "Worker threads in the background compile pool. Compilation is "
+    "CPU-bound in the XLA C++ compiler (GIL released), so a small "
+    "pool overlaps well with query execution without starving "
+    "dispatch.", int)
+WARM_PACK_PATH = _conf(
+    "sql.service.warmPack.path", "",
+    "Warm-pack manifest preloaded at service startup "
+    "(runtime/warm_pack.py): recorded query texts are re-planned "
+    "(constructing the program-cache builders) and each recorded "
+    "program signature is compiled in the background pool, so the "
+    "first user-visible query per shape is already warm. The "
+    "manifest is validated against the host CPU-feature fingerprint "
+    "and version; a mismatched or corrupt pack is skipped with a "
+    "warning, never an error. Empty: no preload. Hard-disabled by "
+    "SRTPU_COMPILE_CACHE=0 alongside the persistent XLA cache.", str)
+WARM_PACK_RECORD = _conf(
+    "sql.service.warmPack.record", "",
+    "When set to a path, the session records every sql() text and "
+    "every program-cache key it compiles, and save_warm_pack() (or "
+    "server shutdown) writes the manifest there. Program keys "
+    "containing identity fallbacks (('id', ...)) are excluded — they "
+    "cannot match across processes (see the unstable-program-key "
+    "lint rule).", str)
+WARM_PACK_REPLAY = _conf(
+    "sql.service.warmPack.replay", True,
+    "Warm-pack preload strategy. True (default): execute each "
+    "recorded query once at startup, which compiles every program in "
+    "its tree — including programs built lazily inside "
+    "execute_partition that a plan-only pass cannot reach — at the "
+    "cost of startup wall time proportional to the recorded "
+    "workload. False: plan-only preload; construction-time programs "
+    "are compiled speculatively through the background pool and "
+    "lazily-built programs still compile sync on first dispatch.",
+    bool)
 RESULT_CACHE_ENABLED = _conf(
     "sql.cache.enabled", False,
     "Process-global cross-query result & fragment cache "

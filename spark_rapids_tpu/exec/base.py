@@ -16,7 +16,7 @@ from ..columnar.table import Schema
 from ..utils.metrics import MetricSet
 from .batch import DeviceBatch
 
-__all__ = ["TpuExec", "ExecContext"]
+__all__ = ["TpuExec", "ExecContext", "prewarm_tree"]
 
 
 class ExecContext:
@@ -150,6 +150,17 @@ class TpuExec:
         share one trace."""
         return ("inst", id(self))
 
+    def cached_programs(self) -> list:
+        """The CachedPrograms this node holds at construction time
+        (stage-ahead prewarm walks these at query launch). The default
+        scans instance attributes, which covers every node that builds
+        its programs in __init__ (Project/Filter/Limit/FusedStage/
+        exchange/aggregate pre-stages); programs built lazily inside
+        execute_partition are reachable only once observed."""
+        from ..runtime.program_cache import CachedProgram
+        return [v for v in vars(self).values()
+                if isinstance(v, CachedProgram)]
+
     # ------------------------------------------------------------------
     def execute_all(self, ctx: ExecContext) -> Iterator[DeviceBatch]:
         for pid in range(self.num_partitions(ctx)):
@@ -168,6 +179,47 @@ class TpuExec:
         for c in self.children:
             s += c.tree_string(indent + 1)
         return s
+
+
+def prewarm_tree(root: TpuExec, pool, query_id: Optional[str] = None,
+                 limit: int = 64) -> int:
+    """Stage-ahead compilation: at query launch, submit every program
+    in the physical tree whose signature has been observed before (an
+    earlier structurally identical query, or a warm-pack manifest) to
+    the background compile pool. Downstream stage programs then compile
+    on `tpu-compile-N` threads while upstream stages execute; the first
+    dispatch finds them warm instead of paying the trace inline.
+
+    Never blocks and never raises: submissions are best-effort
+    (`CompilePool.submit` drops on a full queue) and a program with no
+    observed signature is simply skipped — it compiles sync on first
+    dispatch exactly as before."""
+    from ..runtime import program_cache
+    n = 0
+    stack = [root]
+    seen = set()
+    while stack and n < limit:
+        node = stack.pop()
+        if id(node) in seen:
+            continue
+        seen.add(id(node))
+        stack.extend(node.children)
+        try:
+            progs = node.cached_programs()
+        except Exception:
+            continue
+        for prog in progs:
+            for entry in program_cache.observed_for(prog.base_key):
+                if not program_cache.prewarm_needed(prog, entry["spec"]):
+                    continue
+                if pool.submit(
+                        prog,
+                        program_cache.prewarm_thunk(prog, entry["spec"]),
+                        speculative=False, query_id=query_id):
+                    n += 1
+                if n >= limit:
+                    return n
+    return n
 
 
 def collapse_fusable(node: TpuExec, require_ordinals: bool = False):

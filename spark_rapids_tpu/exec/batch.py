@@ -27,8 +27,11 @@ class DeviceBatch:
             num_rows = table.num_rows
         self.num_rows = num_rows           # upper bound of live rows (host)
         if capacity is None:
-            capacity = (table.columns[0].capacity if table.columns
-                        else max(num_rows, 128))
+            if table.columns:
+                capacity = table.columns[0].capacity
+            else:
+                from ..columnar.column import bucket_capacity
+                capacity = bucket_capacity(max(num_rows, 1))
         self.capacity = capacity
         if row_mask is None:
             row_mask = jnp.arange(capacity) < num_rows
@@ -58,12 +61,14 @@ def maybe_compact(batch: DeviceBatch, schema, factor: int = 4):
     shrinks by `factor` or more."""
     import jax.numpy as jnp
 
-    from ..columnar.column import MIN_CAPACITY, bucket_capacity
+    from ..columnar.column import bucket_capacity, bucket_policy
     from ..ops.gather import compaction_perm, gather_cols
     from ..utils.transfer import fetch_int
     from .nodes import make_table
 
-    if batch.capacity <= MIN_CAPACITY * factor:
+    # the policy floor, not the constant: under a coarse bucket grid a
+    # batch at the floor capacity cannot shrink, so skip the fetch
+    if batch.capacity <= bucket_policy()[0] * factor:
         return batch
     live = fetch_int(jnp.sum(batch.row_mask.astype(jnp.int32)))
     new_cap = bucket_capacity(max(live, 1))

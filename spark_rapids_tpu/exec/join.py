@@ -124,7 +124,7 @@ class HashJoinExec(TpuExec):
                 self._base_left, self._n_fused = self.children[0], 0
             if self._n_fused:
                 from ..runtime.program_cache import cached_program
-                # tpulint: allow[fp-unstable-attr] id(self) is the documented per-instance fallback key: unshared, never falsely shared
+                # tpulint: allow[fp-unstable-attr,unstable-program-key] id(self) is the documented per-instance fallback key: unshared, never falsely shared, excluded from warm packs
                 self._pre_jit = cached_program(
                     self._lstages, cls=type(self).__name__, tag="pre",
                     key=getattr(self._lstages, "_stage_fp",

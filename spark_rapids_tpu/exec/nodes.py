@@ -903,7 +903,7 @@ class LimitExec(TpuExec):
                 new_mask, took = clip(mask, remaining)
                 return cvs, new_mask, took
 
-            # tpulint: allow[fp-unstable-attr] id(self) is the documented per-instance fallback key: unshared, never falsely shared
+            # tpulint: allow[fp-unstable-attr,unstable-program-key] id(self) is the documented per-instance fallback key: unshared, never falsely shared, excluded from warm packs
             self._fused_jit = cached_program(
                 _clip_fused, cls="LimitExec", tag="clip_fused",
                 key=getattr(self._stages, "_stage_fp",

@@ -88,7 +88,7 @@ class SortExec(TpuExec):
                 self._base, self._n_fused = self.children[0], 0
             if self._n_fused:
                 from ..runtime.program_cache import cached_program
-                # tpulint: allow[fp-unstable-attr] id(self) is the documented per-instance fallback key: unshared, never falsely shared
+                # tpulint: allow[fp-unstable-attr,unstable-program-key] id(self) is the documented per-instance fallback key: unshared, never falsely shared, excluded from warm packs
                 self._pre_jit = cached_program(
                     self._stages, cls="SortExec", tag="pre",
                     key=getattr(self._stages, "_stage_fp",

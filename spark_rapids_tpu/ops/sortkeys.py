@@ -33,8 +33,12 @@ __all__ = ["order_keys", "string_chunk_keys", "lexsort", "group_boundaries",
 
 
 def nchunks_for_len(maxlen: int) -> int:
-    nc = max(1, -(-maxlen // 4))
-    return 1 << (nc - 1).bit_length()
+    """Chunk count for string keys of max byte length `maxlen`, rounded
+    onto the shape-bucket grid (columnar/column.py set_bucket_policy) so
+    chunk-count program signatures canonicalize the same way capacities
+    do. The default grid keeps the historical next-power-of-two."""
+    from ..columnar.column import bucket_chunks
+    return bucket_chunks(max(1, -(-maxlen // 4)))
 
 
 def _f32_key(x, descending):

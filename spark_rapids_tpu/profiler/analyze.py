@@ -99,6 +99,14 @@ def render_analyze(tree: dict, metrics_by_lore: Dict[Optional[int], dict],
         if m.get("programCacheMisses") is not None:
             ann.append(
                 f"programCacheMisses={int(m['programCacheMisses'])}")
+        # compile-tail view: wall ms spent compiling during this action
+        # and how many compiles ran off the dispatch path (stage-ahead
+        # prewarm / warm-pack preload)
+        if m.get("compileMs"):
+            ann.append(f"compileMs={float(m['compileMs']):.1f}")
+        if m.get("backgroundCompiles"):
+            ann.append(
+                f"backgroundCompiles={int(m['backgroundCompiles'])}")
         # exchange pipeline (docs/observability.md): parallel-map pool
         # waits, async broadcast overlap, and plan-level reuse hits
         if m.get("mapPoolWaitMs") is not None:

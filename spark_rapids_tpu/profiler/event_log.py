@@ -301,6 +301,14 @@ def profile_query(session, root, ctx, action: str, handle=None):
             w.emit("xla_compile",
                    **{k: round(x1[k] - xla0.get(k, 0), 6)
                       for k in x1})
+            # per-compile events (program key hash, wall ms, sync vs
+            # background) accumulated since the last drain; global, so
+            # concurrent queries' compiles land in whichever query's
+            # log drains first — attribution is best-effort, the
+            # counters above are the invariant
+            from ..runtime import program_cache
+            for ev in program_cache.drain_compile_events():
+                w.emit("compile", **ev)
             if rc_on:
                 rc1 = result_cache.stats()
                 w.emit("result_cache",

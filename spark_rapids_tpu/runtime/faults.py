@@ -92,10 +92,11 @@ class InjectedFault(RuntimeError):
 
 class _Rule:
     __slots__ = ("point", "nth", "prob", "seed", "times", "query", "op",
-                 "action", "arg", "_rng", "_fired")
+                 "action", "arg", "bg", "_rng", "_fired")
 
     def __init__(self, point: str):
         self.point = point
+        self.bg: Optional[bool] = None  # None matches either path
         self.nth: Optional[int] = None
         self.prob: Optional[float] = None
         self.seed: int = 0
@@ -132,6 +133,10 @@ def _parse_rule(text: str) -> _Rule:
             r.query = v
         elif k == "op":
             r.op = v
+        elif k == "bg":
+            # background-path selector: bg=1 matches only compile-pool
+            # prewarms, bg=0 only the sync dispatch path (xla.compile)
+            r.bg = bool(int(v))
         elif k == "raise":
             r.action, r.arg = "raise", v
         elif k == "delay":
@@ -193,11 +198,13 @@ def install_from_conf(conf) -> None:
         install_plan(spec)
 
 
-def hit(point: str, query_id: str = None, op: str = None) -> None:
+def hit(point: str, query_id: str = None, op: str = None,
+        background: bool = False) -> None:
     """The fault point entry: count this call, match it against the
     installed rules, and perform the first matching rule's action.
     Call sites guard with `if faults.ACTIVE:` so this never runs while
-    injection is disabled."""
+    injection is disabled. `background=True` marks the compile pool's
+    prewarm path (rules select it with bg=1)."""
     with _lock:
         _calls[point] = call = _calls.get(point, 0) + 1
         fired = None
@@ -210,6 +217,8 @@ def hit(point: str, query_id: str = None, op: str = None) -> None:
                                         or r.query not in query_id):
                 continue
             if r.op is not None and r.op != op:
+                continue
+            if r.bg is not None and r.bg != bool(background):
                 continue
             if r.nth is not None:
                 if call != r.nth:

@@ -96,6 +96,19 @@ class TpuSession:
                     from .service.query_manager import QueryManager
                     mgr = QueryManager(self.conf)
                     self._query_manager = mgr
+                    # admission-awareness for the background compile
+                    # pool: speculative (warm-pack) compiles defer
+                    # while any admitted query is running; weakref so
+                    # the hook never outlives session.stop()
+                    import weakref
+
+                    from .runtime import compile_pool
+                    ref = weakref.ref(mgr)
+
+                    def _busy(_ref=ref):
+                        m = _ref()
+                        return m is not None and m._running > 0
+                    compile_pool.set_busy_hook(_busy)
         return mgr
 
     def serve(self, host: str = "127.0.0.1", port: int = 0):
@@ -103,9 +116,24 @@ class TpuSession:
         client sessions onto this engine process; returns the server
         (its .host/.port carry the bound address)."""
         from .service.server import QueryServer
+        # AOT warm pack: when sql.service.warmPack.path is set, replay
+        # the recorded key set through the background compile pool
+        # before accepting connections — the first client query finds
+        # its programs warm (or compiling) instead of paying the full
+        # cold tail inline. Advisory: any pack problem logs and serves
+        # cold.
+        from .runtime import warm_pack
+        self._warm_pack_summary = warm_pack.preload(self)
         srv = QueryServer(self, host, port)
         srv.start()
         return srv
+
+    def save_warm_pack(self, path: Optional[str] = None):
+        """Write the warm-pack manifest (recorded SQL + observed
+        program signatures) to `path` or sql.service.warmPack.record;
+        returns the path written or None when disabled."""
+        from .runtime import warm_pack
+        return warm_pack.save(self.conf, path)
 
     def stop(self):
         cm = getattr(self, "_cluster", None)
@@ -133,6 +161,8 @@ class TpuSession:
 
     def sql(self, query: str) -> "DataFrame":
         from .sql.parser import parse_sql
+        from .runtime import warm_pack
+        warm_pack.note_query(query, self.conf)
         return parse_sql(self, query)
 
 
@@ -910,6 +940,20 @@ class DataFrame:
             mgr = getattr(self._session, "_query_manager", None)
             if mgr is not None:
                 ctx.sem_priority = mgr.scheduler.priority_of(handle)
+        # stage-ahead compilation: submit this tree's programs whose
+        # signatures were observed before (earlier query or warm-pack
+        # seed) to the background pool; downstream stage programs
+        # compile while upstream stages execute. Best-effort, never
+        # blocks the launch.
+        from .runtime import compile_pool
+        _cpool = compile_pool.get_pool(conf)
+        if _cpool is not None:
+            from .exec.base import prewarm_tree
+            try:
+                prewarm_tree(root, _cpool,
+                             handle.query_id if handle else None)
+            except Exception:
+                pass
         sem = getattr(self._session, "_semaphore", None)
         sem_acq0 = sem.metrics["acquires"] if sem is not None else 0
         xla0 = xla_stats.snapshot()
@@ -987,6 +1031,14 @@ class DataFrame:
                 pass
             if self._cached is not None and self._cached[1] is root:
                 self._cached = None
+            # cooperative prewarm cancellation: a dead query's queued
+            # stage-ahead compiles are dropped (a task already
+            # compiling finishes — the result is cached for a retry)
+            if handle is not None and _cpool is not None:
+                try:
+                    _cpool.cancel_query(handle.query_id)
+                except Exception:
+                    pass
             raise
         finally:
             if not nested:
@@ -1004,6 +1056,18 @@ class DataFrame:
         rm.add("programCacheMisses",
                int(xla1.get("program_cache_misses", 0)
                    - xla0.get("program_cache_misses", 0)))
+        # compile-tail accounting: wall ms spent in XLA compilation
+        # attributed to this action (sync misses on this thread plus
+        # background prewarms that completed during it) and how many of
+        # those compiles ran off the dispatch path
+        cms = (xla1.get("program_cache_compile_ms", 0.0)
+               - xla0.get("program_cache_compile_ms", 0.0))
+        if cms:
+            rm.add("compileMs", round(cms, 3))
+        bg = int(xla1.get("program_cache_background_compiles", 0)
+                 - xla0.get("program_cache_background_compiles", 0))
+        if bg:
+            rm.add("backgroundCompiles", bg)
         if handle is not None and not nested:
             rm.add("queueWaitMs", round(handle.queue_wait_ms, 3))
         if rc_on:

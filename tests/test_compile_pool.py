@@ -1,0 +1,227 @@
+"""Background compile pool (runtime/compile_pool.py) + prewarm
+semantics (CachedProgram.prewarm): the dispatch path never waits,
+speculative work yields to running queries, failures are swallowed and
+counted, cancellation is cooperative."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.runtime import program_cache
+from spark_rapids_tpu.runtime.compile_pool import CompilePool
+from spark_rapids_tpu.runtime.program_cache import cached_program
+
+_BASE = {"spark.rapids.tpu.sql.batchSizeRows": 512}
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    program_cache.clear()
+    program_cache.set_active_conf(st.TpuSession(dict(_BASE)).conf)
+    yield
+    program_cache.clear()
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _prog(key, traces=None):
+    def f(x):
+        if traces is not None:
+            traces["n"] += 1
+        return x * 2
+    return cached_program(f, cls="PoolT", tag="run", key=key)
+
+
+# ---------------------------------------------------------------------
+# prewarm
+# ---------------------------------------------------------------------
+def test_prewarm_then_dispatch_is_hit():
+    """A prewarmed signature makes the first real dispatch a cache hit:
+    zero sync misses, and the result is still correct."""
+    jnp = _jnp()
+    traces = {"n": 0}
+    p = _prog(("k1",), traces)
+    assert p.prewarm((jnp.zeros(8, jnp.int32),)) is True
+    m0 = program_cache.stats()["program_cache_misses"]
+    out = p(jnp.arange(8, dtype=jnp.int32))
+    assert np.asarray(out)[3] == 6
+    assert program_cache.stats()["program_cache_misses"] == m0
+    assert traces["n"] == 1  # one trace total, done by the prewarm
+
+
+def test_prewarm_idempotent():
+    jnp = _jnp()
+    p = _prog(("k2",))
+    args = (jnp.zeros(8, jnp.int32),)
+    assert p.prewarm(args) is True
+    assert p.prewarm(args) is False  # already warm
+
+
+def test_prewarm_counts_background_compile():
+    jnp = _jnp()
+    s0 = program_cache.stats()["program_cache_background_compiles"]
+    _prog(("k3",)).prewarm((jnp.zeros(8, jnp.int32),))
+    s1 = program_cache.stats()["program_cache_background_compiles"]
+    assert s1 == s0 + 1
+
+
+# ---------------------------------------------------------------------
+# the pool
+# ---------------------------------------------------------------------
+def test_pool_compiles_submitted_program():
+    jnp = _jnp()
+    pool = CompilePool(threads=1)
+    try:
+        p = _prog(("k4",))
+        assert pool.submit(p, lambda: (jnp.zeros(8, jnp.int32),))
+        assert pool.drain(30)
+        assert pool.stats["compiled"] == 1
+        m0 = program_cache.stats()["program_cache_misses"]
+        p(jnp.arange(8, dtype=jnp.int32))
+        assert program_cache.stats()["program_cache_misses"] == m0
+    finally:
+        pool.shutdown()
+
+
+def test_pool_swallow_failures():
+    """A thunk or compile failure never propagates: counted on the
+    pool and in program_cache_background_failures."""
+    pool = CompilePool(threads=1)
+    try:
+        f0 = program_cache.stats()["program_cache_background_failures"]
+
+        def boom():
+            raise RuntimeError("injected")
+        assert pool.submit(_prog(("k5",)), boom)
+        assert pool.drain(30)
+        assert pool.stats["failed"] == 1
+        f1 = program_cache.stats()["program_cache_background_failures"]
+        assert f1 == f0 + 1
+    finally:
+        pool.shutdown()
+
+
+def test_pool_submit_never_blocks_when_full():
+    pool = CompilePool(threads=1, queue_cap=8)
+    try:
+        gate = threading.Event()
+
+        def wait_thunk():
+            gate.wait(10)
+            return None
+        pool.submit(_prog(("k6",)), wait_thunk)  # occupies the worker
+        ok = sum(1 for i in range(64)
+                 if pool.submit(_prog((f"k6-{i}",)), lambda: None))
+        assert ok < 64                       # some were dropped...
+        assert pool.stats["dropped_full"] > 0
+        gate.set()                           # ...and nothing blocked
+        assert pool.drain(30)
+    finally:
+        pool.shutdown()
+
+
+def test_speculative_defers_while_busy_stage_ahead_runs():
+    """The admission contract: with the busy hook up, a speculative
+    task parks while a stage-ahead task submitted later still runs."""
+    jnp = _jnp()
+    pool = CompilePool(threads=1)
+    busy = {"v": True}
+    pool.set_busy_hook(lambda: busy["v"])
+    try:
+        spec = _prog(("k7-spec",))
+        ahead = _prog(("k7-ahead",))
+        pool.submit(spec, lambda: (jnp.zeros(8, jnp.int32),),
+                    speculative=True)
+        pool.submit(ahead, lambda: (jnp.zeros(8, jnp.int32),))
+        deadline = time.monotonic() + 20
+        while pool.stats["compiled"] < 1 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        # stage-ahead compiled; the speculative one is still deferred
+        assert pool.stats["compiled"] == 1
+        assert pool.stats["deferred_busy"] > 0
+        m0 = program_cache.stats()["program_cache_misses"]
+        ahead(jnp.arange(8, dtype=jnp.int32))   # warm
+        assert program_cache.stats()["program_cache_misses"] == m0
+        busy["v"] = False                        # queries done
+        assert pool.drain(30)
+        assert pool.stats["compiled"] == 2       # speculative ran
+    finally:
+        pool.shutdown()
+
+
+def test_cancel_query_drops_queued_tasks():
+    pool = CompilePool(threads=1)
+    try:
+        gate = threading.Event()
+        pool.submit(_prog(("k8-hold",)), lambda: gate.wait(10) and None)
+        for i in range(4):
+            pool.submit(_prog((f"k8-{i}",)), lambda: None,
+                        query_id=f"q-dead")
+        n = pool.cancel_query("q-dead")
+        assert n == 4
+        gate.set()
+        assert pool.drain(30)
+        assert pool.stats["cancelled"] >= 4
+        assert pool.stats["compiled"] == 0
+    finally:
+        pool.shutdown()
+
+
+def test_background_fault_injection_swallowed():
+    """An injected xla.compile fault in the background path is counted,
+    swallowed, and the sync path still serves the program."""
+    jnp = _jnp()
+    from spark_rapids_tpu.runtime import faults
+    pool = CompilePool(threads=1)
+    try:
+        faults.install_plan("xla.compile:bg=1:times=1")
+        p = _prog(("k9",))
+        pool.submit(p, lambda: (jnp.zeros(8, jnp.int32),))
+        assert pool.drain(30)
+        assert pool.stats["failed"] == 1
+        # sync path unaffected (the rule only matches bg=1)
+        out = p(jnp.arange(8, dtype=jnp.int32))
+        assert np.asarray(out)[2] == 4
+    finally:
+        faults.clear_plan()
+        pool.shutdown()
+
+
+# ---------------------------------------------------------------------
+# observed-spec round trip (stage-ahead's data source)
+# ---------------------------------------------------------------------
+def test_observed_spec_prewarms_equivalent_program():
+    """A sync miss records a spec; a fresh program at the same site
+    prewarmed from that spec makes the matching dispatch a hit."""
+    jnp = _jnp()
+    p1 = _prog(("k10",))
+    p1(jnp.arange(16, dtype=jnp.int32))          # sync miss, observed
+    entries = program_cache.observed_for(p1.base_key)
+    assert entries, "sync miss must record a prewarmable spec"
+    program_cache.clear()                         # cold cache
+    program_cache.set_active_conf(st.TpuSession(dict(_BASE)).conf)
+    p2 = _prog(("k10",))
+    args = program_cache.example_args_from_spec(entries[0]["spec"])
+    assert p2.prewarm(args) is True
+    m0 = program_cache.stats()["program_cache_misses"]
+    p2(jnp.arange(16, dtype=jnp.int32))
+    assert program_cache.stats()["program_cache_misses"] == m0
+
+
+def test_prewarm_thunk_skips_warm_keys():
+    jnp = _jnp()
+    p = _prog(("k11",))
+    p(jnp.arange(8, dtype=jnp.int32))            # compiles + observes
+    entry = program_cache.observed_for(p.base_key)[0]
+    thunk = program_cache.prewarm_thunk(p, entry["spec"])
+    assert thunk() is None                        # already warm
+    program_cache.clear()
+    program_cache.set_active_conf(st.TpuSession(dict(_BASE)).conf)
+    p2 = _prog(("k11",))
+    thunk2 = program_cache.prewarm_thunk(p2, entry["spec"])
+    assert thunk2() is not None                   # cold: yields args

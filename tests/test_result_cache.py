@@ -305,6 +305,65 @@ def test_fragment_tier_hit_and_explain_annotation():
     assert r2.equals(r2b)
 
 
+def test_fragment_hit_after_invalidating_side_write(tmp_path):
+    """The BENCH_r06 `fragment_hits: 0` regression scenario, done
+    right. The zipfian bench showed zero fragment hits not because
+    fragment keying was broken but because its streams were served from
+    the whole-query tier (no replanning => substitute_fragments never
+    ran) and its only replanned query had no shuffle exchange. This
+    test forces the real workflow the fragment tier exists for: a
+    two-table shuffle join, a write that invalidates ONE side, and a
+    re-run that must reuse the surviving side's exchange fragment."""
+    import pyarrow.parquet as pq
+    s = _session({
+        # force a distributed shuffle join with real exchanges: no
+        # broadcast, small batches, 2 shuffle partitions, multi-file
+        # scans so the planner keeps >1 input partition
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 0,
+        "spark.rapids.tpu.sql.batchSizeRows": 64,
+        "spark.rapids.tpu.sql.shuffle.partitions": 2})
+    left_dir, right_dir = str(tmp_path / "L"), str(tmp_path / "R")
+    os.makedirs(left_dir), os.makedirs(right_dir)
+    for i in range(3):
+        pq.write_table(pa.table(
+            {"a": [(j + i * 50) % 7 for j in range(50)],
+             "b": [float(j + i) for j in range(50)]}),
+            os.path.join(left_dir, f"p{i}.parquet"))
+        pq.write_table(pa.table(
+            {"a": [(j + i * 50) % 7 for j in range(50)],
+             "c": [float(j * 2 + i) for j in range(50)]}),
+            os.path.join(right_dir, f"p{i}.parquet"))
+
+    def q():
+        l = s.read.parquet(left_dir)
+        r = s.read.parquet(right_dir)
+        return l.join(r, on="a").agg(n=F.count(F.lit(1)),
+                                     sb=F.sum("b")).to_arrow()
+
+    r1 = q()
+    assert result_cache.stats()["result_cache_fragment_stores"] >= 2
+    # overwrite the RIGHT table: its scan snapshot changes, its
+    # fragments die, the whole-query entry dies — but the LEFT side's
+    # exchange fragment survives and must be reused on the re-run
+    pq.write_table(pa.table({"a": [0, 1, 2], "c": [9.0, 9.0, 9.0]}),
+                   os.path.join(right_dir, "p0.parquet"))
+    h0 = result_cache.stats()["result_cache_fragment_hits"]
+    r2 = q()
+    stc = result_cache.stats()
+    assert stc["result_cache_fragment_hits"] > h0, \
+        "surviving side's fragment must hit after the side write"
+    # and correctness: a cache-free session on the new files agrees
+    s2 = st.TpuSession({
+        "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": 0,
+        "spark.rapids.tpu.sql.batchSizeRows": 64,
+        "spark.rapids.tpu.sql.shuffle.partitions": 2})
+    fresh = s2.read.parquet(left_dir).join(
+        s2.read.parquet(right_dir), on="a").agg(
+        n=F.count(F.lit(1)), sb=F.sum("b")).to_arrow()
+    assert r2.equals(fresh)
+    assert not r2.equals(r1)
+
+
 def test_fragments_disabled_conf():
     s = _session({"spark.rapids.tpu.sql.cache.fragments.enabled": False,
                   "spark.rapids.tpu.sql.autoBroadcastJoinThreshold": -1})

@@ -1,0 +1,190 @@
+"""Shape canonicalization (columnar/column.py bucket policy): the
+geometric capacity grid that bounds program-cache cardinality.
+
+The tentpole invariant: bucket_capacity maps every row count onto
+{minRows * growthFactor^k}, so structurally equal operators at
+different input sizes share one padded program per grid point, and the
+padding waste is bounded by 1 - 1/growthFactor of the padded rows."""
+import numpy as np
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.columnar import column as C
+from spark_rapids_tpu.ops import sortkeys as sk
+
+_BASE = {"spark.rapids.tpu.sql.batchSizeRows": 512}
+
+
+@pytest.fixture(autouse=True)
+def _default_policy():
+    """Bucket policy is process-global; every test starts and ends on
+    the defaults."""
+    C.set_bucket_policy()
+    C.reset_shape_stats()
+    yield
+    C.set_bucket_policy()
+    C.reset_shape_stats()
+
+
+# ---------------------------------------------------------------------
+# the grid
+# ---------------------------------------------------------------------
+def test_default_grid_is_pow2():
+    """Default policy (minRows=128, growth=2) reproduces the historical
+    power-of-two bucketing exactly."""
+    for n in (1, 2, 127, 128, 129, 255, 256, 257, 1000, 1 << 20):
+        assert C.bucket_capacity(n) == max(128, 1 << (n - 1).bit_length())
+
+
+def test_grid_monotone_and_idempotent():
+    for g in (2, 4, 8):
+        C.set_bucket_policy(128, g)
+        prev = 0
+        for n in range(1, 5000, 37):
+            cap = C.bucket_capacity(n)
+            assert cap >= n
+            assert cap >= prev or n <= prev  # monotone in n
+            # grid points are fixed points: re-bucketing is identity
+            assert C.bucket_capacity(cap) == cap
+            prev = cap
+
+
+def test_coarser_growth_collapses_buckets():
+    """growthFactor=4 produces a strict subset of the pow2 grid — the
+    whole point: fewer distinct avals => fewer compiled programs."""
+    C.set_bucket_policy(128, 2)
+    fine = {C.bucket_capacity(n) for n in range(1, 1 << 14, 101)}
+    C.set_bucket_policy(128, 4)
+    coarse = {C.bucket_capacity(n) for n in range(1, 1 << 14, 101)}
+    assert len(coarse) < len(fine)
+    # every coarse point sits on the pow2 grid (128 * 4^k)
+    assert all(c >= 128 and (c & (c - 1)) == 0 and
+               (c // 128).bit_length() % 2 == 1 for c in coarse)
+
+
+def test_waste_bound():
+    """Padding waste is bounded by 1 - 1/growthFactor: a bucket of
+    capacity m*g^k only ever holds n > m*g^(k-1) rows."""
+    for g in (2, 4, 8):
+        C.set_bucket_policy(128, g)
+        for n in range(129, 1 << 13, 97):
+            cap = C.bucket_capacity(n)
+            waste = (cap - n) / cap
+            assert waste < 1 - 1 / g + 1e-9, (g, n, cap)
+
+
+def test_min_rows_floor():
+    C.set_bucket_policy(1024, 2)
+    assert C.bucket_capacity(1) == 1024
+    assert C.bucket_capacity(1024) == 1024
+    assert C.bucket_capacity(1025) == 2048
+    # floor is itself bucketed to a power of two
+    C.set_bucket_policy(1000, 2)
+    assert C.bucket_policy()[0] == 1024
+
+
+def test_growth_factor_snaps_to_allowed():
+    C.set_bucket_policy(128, 3)   # snaps to nearest allowed {2,4,8,16}
+    assert C.bucket_policy()[1] in (2, 4)
+    C.set_bucket_policy(128, 100)
+    assert C.bucket_policy()[1] == 16
+
+
+def test_shape_stats_waste_accounting():
+    C.reset_shape_stats()
+    C.bucket_capacity(129)   # pads to 256
+    s = C.shape_stats()
+    assert s["bucket_requests"] == 1
+    assert s["requested_rows"] == 129
+    assert s["bucketed_rows"] == 256
+    assert 0 < s["waste_frac"] < 0.5
+
+
+# ---------------------------------------------------------------------
+# chunk-count canonicalization (string signatures)
+# ---------------------------------------------------------------------
+def test_chunk_counts_ride_the_same_grid():
+    """nchunks_for_len routes through bucket_chunks: under the default
+    policy the historical pow2 rounding is reproduced exactly."""
+    for maxlen in (1, 3, 4, 5, 16, 17, 63, 64, 65, 255):
+        nc = -(-maxlen // 4)
+        want = max(1, 1 << (nc - 1).bit_length())
+        assert sk.nchunks_for_len(maxlen) == want
+
+
+def test_chunk_counts_coarsen_with_policy():
+    C.set_bucket_policy(128, 4)
+    seen = {sk.nchunks_for_len(m) for m in range(1, 256)}
+    # chunk grid is {1, 4, 16, 64}: powers of the growth factor
+    assert seen <= {1, 4, 16, 64}
+
+
+# ---------------------------------------------------------------------
+# conf plumbing + end-to-end program sharing
+# ---------------------------------------------------------------------
+def test_conf_sets_policy():
+    from spark_rapids_tpu.runtime import program_cache
+    s = st.TpuSession(dict(
+        _BASE, **{"spark.rapids.tpu.sql.exec.shapeBuckets.minRows": 512,
+                  "spark.rapids.tpu.sql.exec.shapeBuckets."
+                  "growthFactor": 4}))
+    program_cache.set_active_conf(s.conf)
+    try:
+        assert C.bucket_policy() == (512, 4)
+        assert C.bucket_capacity(10) == 512
+    finally:
+        program_cache.set_active_conf(st.TpuSession(dict(_BASE)).conf)
+
+
+def test_different_sizes_share_program_coarse_grid():
+    """Two same-shaped queries over different row counts that land in
+    the same coarse bucket compile ONE set of programs: the second
+    run's misses are zero."""
+    import pyarrow as pa
+
+    from spark_rapids_tpu.runtime import program_cache
+    program_cache.clear()
+    s = st.TpuSession(dict(
+        _BASE, **{"spark.rapids.tpu.sql.exec.shapeBuckets.minRows": 2048,
+                  "spark.rapids.tpu.sql.exec.shapeBuckets."
+                  "growthFactor": 4}))
+
+    import spark_rapids_tpu.functions as F
+
+    def run(n):
+        t = pa.table({"a": list(range(n)),
+                      "b": [float(i) for i in range(n)]})
+        df = s.create_dataframe(t)
+        return df.filter(F.col("a") > 1).select(
+            (F.col("a") + 1).alias("a1"), F.col("b")).collect()
+
+    run(300)
+    m0 = program_cache.stats()["program_cache_misses"]
+    run(900)   # different size, same 2048-bucket => same avals
+    m1 = program_cache.stats()["program_cache_misses"]
+    assert m1 == m0, "coarse grid must dedupe the second size"
+    program_cache.clear()
+
+
+def test_results_identical_across_policies():
+    """Bucketing is padding only: results are byte-identical between
+    the default and a coarse policy."""
+    import pyarrow as pa
+    n = 700
+    t = pa.table({"a": list(range(n)),
+                  "b": [float(i) % 7 for i in range(n)]})
+
+    import spark_rapids_tpu.functions as F
+
+    def run(extra):
+        from spark_rapids_tpu.runtime import program_cache
+        s = st.TpuSession(dict(_BASE, **extra))
+        program_cache.set_active_conf(s.conf)
+        df = s.create_dataframe(t)
+        return df.filter(F.col("b") > 2.0).group_by("b").agg(
+            F.sum("a").alias("sa")).sort("b").collect()
+
+    a = run({})
+    b = run({"spark.rapids.tpu.sql.exec.shapeBuckets.minRows": 4096,
+             "spark.rapids.tpu.sql.exec.shapeBuckets.growthFactor": 8})
+    assert str(a) == str(b)

@@ -687,3 +687,75 @@ def test_retry_swallows_cancel_allow_marker():
                 except Exception:
                     continue
     """) == []
+
+
+# ----------------------------------------------------------------------
+# unstable-program-key
+# ----------------------------------------------------------------------
+def test_unstable_program_key_fires_on_id():
+    vs = _lint("""
+        from spark_rapids_tpu.runtime.program_cache import cached_program
+
+        class Node:
+            def prep(self):
+                self._jit = cached_program(
+                    lambda x: x, cls="Node", tag="run",
+                    key=("id", id(self)))
+    """)
+    assert [v.rule for v in vs] == ["unstable-program-key"]
+    assert "id(...)" in vs[0].message
+    assert "warm" in vs[0].message
+
+
+def test_unstable_program_key_fires_on_clock_and_counter():
+    assert _rules("""
+        import time
+        from spark_rapids_tpu.runtime import program_cache
+
+        def build(fn, seq):
+            a = program_cache.cached_program(
+                fn, cls="T", tag="a", key=("t", time.time()))
+            b = program_cache.cached_program(
+                fn, cls="T", tag="b", key=("n", next(seq_counter)))
+            return a, b
+    """) == ["unstable-program-key", "unstable-program-key"]
+
+
+def test_unstable_program_key_fires_inside_getattr_fallback():
+    """The documented fallback idiom still fires — it must carry an
+    allow marker to pass, keeping every such site visibly audited."""
+    assert _rules("""
+        from spark_rapids_tpu.runtime.program_cache import cached_program
+
+        class Node:
+            def prep(self):
+                self._pre = cached_program(
+                    self._stages, cls="Node", tag="pre",
+                    key=getattr(self._stages, "_stage_fp",
+                                ("inst", id(self))))
+    """) == ["unstable-program-key"]
+
+
+def test_unstable_program_key_structural_key_clean():
+    assert _rules("""
+        from spark_rapids_tpu.runtime.program_cache import cached_program
+
+        class Node:
+            def prep(self, nchunks):
+                self._jit = cached_program(
+                    self._run, cls="Node", tag="run",
+                    key=(self.expr_fp(), nchunks))
+    """) == []
+
+
+def test_unstable_program_key_allow_marker_suppresses():
+    assert _rules("""
+        from spark_rapids_tpu.runtime.program_cache import cached_program
+
+        class Node:
+            def prep(self):
+                # tpulint: allow[unstable-program-key] per-instance closure state, documented fallback
+                self._jit = cached_program(
+                    lambda x: x, cls="Node", tag="run",
+                    key=("id", id(self)))
+    """) == []

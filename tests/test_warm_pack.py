@@ -1,0 +1,183 @@
+"""AOT warm-pack lifecycle (runtime/warm_pack.py): record/save/preload
+round trip, fingerprint + version gating, corrupt-pack tolerance,
+idempotent preload, and the SRTPU_COMPILE_CACHE=0 kill switch."""
+import os
+import pickle
+
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.runtime import compile_pool, program_cache, warm_pack
+
+_BASE = {"spark.rapids.tpu.sql.batchSizeRows": 512}
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    program_cache.clear()
+    warm_pack.reset()
+    yield
+    program_cache.clear()
+    warm_pack.reset()
+    compile_pool.shutdown_pool()
+
+
+def _session(tmp_path, **extra):
+    conf = dict(_BASE)
+    conf.update({f"spark.rapids.tpu.{k}": v for k, v in extra.items()})
+    return st.TpuSession(conf)
+
+
+def _table(s, tmp_path, name="t", rows=200):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    d = tmp_path / name
+    d.mkdir(exist_ok=True)
+    pq.write_table(
+        pa.table({"a": list(range(rows)),
+                  "b": [float(i % 9) for i in range(rows)]}),
+        str(d / "p0.parquet"))
+    s.read.parquet(str(d)).create_or_replace_temp_view(name)
+
+
+_Q = "SELECT a, SUM(b) AS sb FROM t WHERE b > 1.0 GROUP BY a"
+
+
+def _record(tmp_path):
+    pack = str(tmp_path / "pack.bin")
+    s = _session(tmp_path, **{"sql.service.warmPack.record": pack})
+    _table(s, tmp_path)
+    s.sql(_Q).collect()
+    assert s.save_warm_pack() == pack
+    return pack
+
+
+# ---------------------------------------------------------------------
+def test_record_save_preload_roundtrip(tmp_path):
+    pack = _record(tmp_path)
+    with open(pack, "rb") as f:
+        m = pickle.load(f)
+    assert m["version"] == warm_pack.VERSION
+    assert m["queries"] == [_Q]
+    assert m["programs"], "sync compiles must be recorded"
+    assert all(program_cache.key_stable(p["base_key"])
+               for p in m["programs"])
+
+    # fresh cache = simulated fresh process (same host fingerprint)
+    program_cache.clear()
+    warm_pack.reset()
+    s2 = _session(tmp_path, **{"sql.service.warmPack.path": pack})
+    _table(s2, tmp_path)
+    summary = warm_pack.preload(s2)
+    assert summary["status"] == "ok"
+    assert summary["queries_planned"] == 1
+    pool = compile_pool.current_pool()
+    if pool is not None:
+        assert pool.drain(60)
+    # the recorded query now runs without a single sync compile
+    st0 = program_cache.stats()
+    df = s2.sql(_Q)
+    out = df.collect()
+    st1 = program_cache.stats()
+    assert len(out) > 0
+    assert st1["program_cache_misses"] == st0["program_cache_misses"]
+
+
+def test_preload_idempotent(tmp_path):
+    pack = _record(tmp_path)
+    program_cache.clear()
+    warm_pack.reset()
+    s2 = _session(tmp_path, **{"sql.service.warmPack.path": pack})
+    _table(s2, tmp_path)
+    warm_pack.preload(s2)
+    pool = compile_pool.current_pool()
+    if pool is not None:
+        assert pool.drain(60)
+    bg0 = program_cache.stats()["program_cache_background_compiles"]
+    c0 = program_cache.stats()["program_cache_misses"]
+    # second preload against the same pack: nothing recompiles
+    warm_pack.preload(s2)
+    if pool is not None:
+        assert pool.drain(60)
+    st = program_cache.stats()
+    assert st["program_cache_background_compiles"] == bg0
+    assert st["program_cache_misses"] == c0
+
+
+def test_fingerprint_mismatch_skips_with_warning(tmp_path, caplog):
+    pack = _record(tmp_path)
+    with open(pack, "rb") as f:
+        m = pickle.load(f)
+    m["fingerprint"] = "deadbeefcafe"
+    with open(pack, "wb") as f:
+        pickle.dump(m, f)
+    s2 = _session(tmp_path, **{"sql.service.warmPack.path": pack})
+    with caplog.at_level("WARNING", logger="spark_rapids_tpu.runtime."
+                                           "warm_pack"):
+        summary = warm_pack.preload(s2)
+    assert summary == {"status": "skipped"}
+    assert any("fingerprint" in r.message for r in caplog.records)
+
+
+def test_version_mismatch_skips(tmp_path):
+    pack = _record(tmp_path)
+    with open(pack, "rb") as f:
+        m = pickle.load(f)
+    m["version"] = warm_pack.VERSION + 1
+    with open(pack, "wb") as f:
+        pickle.dump(m, f)
+    s2 = _session(tmp_path, **{"sql.service.warmPack.path": pack})
+    assert warm_pack.preload(s2) == {"status": "skipped"}
+
+
+def test_corrupt_pack_warns_never_crashes(tmp_path, caplog):
+    pack = str(tmp_path / "pack.bin")
+    with open(pack, "wb") as f:
+        f.write(b"\x00not a pickle at all\xff\xfe")
+    s2 = _session(tmp_path, **{"sql.service.warmPack.path": pack})
+    with caplog.at_level("WARNING", logger="spark_rapids_tpu.runtime."
+                                           "warm_pack"):
+        summary = warm_pack.preload(s2)
+    assert summary == {"status": "skipped"}
+    assert any("unreadable" in r.message for r in caplog.records)
+    # a pickle that is not a dict is equally tolerated
+    with open(pack, "wb") as f:
+        pickle.dump(["wrong", "shape"], f)
+    assert warm_pack.preload(s2) == {"status": "skipped"}
+
+
+def test_missing_pack_skips(tmp_path):
+    s2 = _session(tmp_path, **{"sql.service.warmPack.path":
+                               str(tmp_path / "nope.bin")})
+    assert warm_pack.preload(s2) == {"status": "skipped"}
+
+
+def test_env_kill_switch(tmp_path, monkeypatch):
+    """SRTPU_COMPILE_CACHE=0 hard-disables recording, saving and
+    preloading — the same gate as the persistent jax compile cache."""
+    pack = _record(tmp_path)
+    warm_pack.reset()
+    monkeypatch.setenv("SRTPU_COMPILE_CACHE", "0")
+    assert not warm_pack.enabled()
+    s = _session(tmp_path, **{"sql.service.warmPack.record":
+                              str(tmp_path / "p2.bin"),
+                              "sql.service.warmPack.path": pack})
+    warm_pack.note_query("SELECT 1 AS one", s.conf)
+    assert warm_pack.recorded_queries() == []
+    assert warm_pack.save(s.conf) is None
+    assert warm_pack.preload(s) == {"status": "skipped"}
+
+
+def test_unstable_keys_never_recorded(tmp_path):
+    """A program keyed on an identity fallback must not enter the
+    manifest: it cannot match across processes."""
+    import jax.numpy as jnp
+
+    from spark_rapids_tpu.runtime.program_cache import cached_program
+    sentinel = object()
+    p = cached_program(lambda x: x + 1, cls="WP", tag="run",
+                       key=("inst", id(sentinel)))
+    p(jnp.arange(8, dtype=jnp.int32))
+    assert all(program_cache.key_stable(e["base_key"])
+               for e in program_cache.observed_programs())
+    assert not program_cache.observed_for(p.base_key)
