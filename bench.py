@@ -123,6 +123,26 @@ if _ZIPFIAN and not _CONCURRENT:
 # (sql.service.warmPack.path + stage-ahead prewarm from seeded specs).
 _COMPILE_TAIL = "--compile-tail" in sys.argv[1:]
 
+# --multichip: SPMD-stage dryrun — q3/q6 distributed shapes over an
+# 8-device mesh through three paths (host shuffle / round-based mesh
+# exchange / fused SpmdStageExec), asserting byte parity, exactly one
+# compiled program per fused stage, and a compile-free warm rerun. The
+# workload runs in a SUBPROCESS (workloads/spmd_bench.py): the virtual
+# CPU device count must be in XLA_FLAGS before jax first imports, which
+# this process cannot guarantee for itself. Results land in
+# MULTICHIP_r06.json and extra.spmd_stage.
+_MULTICHIP = "--multichip" in sys.argv[1:]
+
+if _CHAOS is not None and "--xla_force_host_platform_device_count" \
+        not in os.environ.get("XLA_FLAGS", ""):
+    # chaos soak: give the CPU backend 8 virtual devices so the mesh
+    # path (and its mesh.collective fault point) is live in the soak —
+    # must be in the env before jax first imports; no-op on real
+    # multi-chip backends
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=8"
+                               ).strip()
+
 # milestone metrics flushed verbatim when the budget expires mid-run
 _partial = {"extra": {}}
 
@@ -282,6 +302,10 @@ def _main_impl():
     plat = os.environ.get("BENCH_PLATFORM")
     fellback = False
     tpu_errors = []
+    if not plat and _MULTICHIP:
+        # the multichip dryrun runs entirely in a subprocess that picks
+        # its own backend; don't spend minutes probing one here
+        plat = "cpu"
     if not plat:
         ok, tpu_errors = _backend_alive()
         if not ok:
@@ -324,6 +348,42 @@ def _main_impl():
                   f"lockdep_findings="
                   f"{soak['lockdep'].get('findings')}",
                   file=sys.stderr)
+            sys.exit(1)
+        return
+
+    # ---- standalone multichip mode: bench.py --multichip --------------
+    if _MULTICHIP:
+        with _alarm(_remaining() - 15.0, "multichip spmd dryrun"):
+            doc = _multichip_spmd()
+        spmd = doc.get("spmd_stage") or {}
+        # carried through partial flushes: a budget-killed later section
+        # still ships the spmd_stage section it already earned
+        _partial["extra"]["spmd_stage"] = spmd
+        try:
+            with open(os.path.join(os.path.dirname(
+                    os.path.abspath(__file__)), "MULTICHIP_r06.json"),
+                    "w") as f:
+                json.dump(doc, f, indent=2)
+                f.write("\n")
+        except OSError as e:
+            print(f"bench: MULTICHIP_r06.json write failed: {e}",
+                  file=sys.stderr)
+        n_stages = sum(int(q.get("spmd_stages", 0))
+                       for q in spmd.get("queries", {}).values())
+        print(json.dumps({
+            "metric": "tpch_multichip_spmd_dryrun",
+            "value": n_stages,
+            "unit": "fused_stages",
+            "vs_baseline": None,
+            **({"backend_fallback": "cpu (tpu unreachable)",
+                "tpu_probe_errors": tpu_errors} if fellback else {}),
+            "extra": doc,
+        }))
+        if not doc.get("ok") and not doc.get("skipped"):
+            print(f"bench: multichip spmd dryrun FAILED: rc={doc['rc']} "
+                  f"queries="
+                  f"{ {k: v.get('ok') for k, v in spmd.get('queries', {}).items()} } "
+                  f"tail={doc.get('tail', '')[-400:]}", file=sys.stderr)
             sys.exit(1)
         return
 
@@ -981,6 +1041,111 @@ def _compile_tail(st, sf: float, qids=None) -> dict:
     return out
 
 
+def _multichip_spmd() -> dict:
+    """Run the SPMD-stage dryrun (workloads/spmd_bench.py) in a
+    subprocess with 8 virtual CPU devices forced into XLA_FLAGS — the
+    flag must precede jax's first import, which only a fresh process
+    guarantees — and fold its one-JSON-document stdout into the
+    MULTICHIP artifact shape ({n_devices, rc, ok, skipped, tail} plus
+    the new spmd_stage section)."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = env.get("BENCH_PLATFORM") or "cpu"
+    if "--xla_force_host_platform_device_count" not in env.get(
+            "XLA_FLAGS", ""):
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                            + " --xla_force_host_platform_device_count=8"
+                            ).strip()
+    env.setdefault("SPMD_BENCH_SF", "0.01" if _SMOKE else "0.02")
+    here = os.path.dirname(os.path.abspath(__file__))
+    budget = max(30.0, _remaining() - 30.0)
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-m", "spark_rapids_tpu.workloads.spmd_bench"],
+            cwd=here, env=env, capture_output=True, text=True,
+            timeout=budget)
+        rc, out, err = proc.returncode, proc.stdout, proc.stderr
+    except subprocess.TimeoutExpired as e:
+        rc = 124
+        out = (e.stdout or b"").decode() if isinstance(
+            e.stdout, bytes) else (e.stdout or "")
+        err = f"timeout after {budget:.0f}s"
+    tail = (err or "")[-2000:]
+    spmd = None
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            spmd = json.loads(line)
+            break
+        except ValueError:
+            continue
+    doc = {
+        "n_devices": (spmd or {}).get("n_devices", 8),
+        "rc": rc,
+        "ok": bool(rc == 0 and spmd is not None
+                   and spmd.get("ok", False)),
+        "skipped": bool(spmd and spmd.get("skipped", False)),
+        "tail": tail,
+        "spmd_stage": spmd,
+    }
+    return doc
+
+
+def _mesh_chaos(st, sf: float) -> dict:
+    """Chaos coverage for the mesh.collective fault point: run the q6
+    distributed shape through the fused SPMD-stage path, fault-free for
+    a reference, then with the collective's first live launch failing —
+    the stage must degrade to the round-based exchange (counted
+    spmdDegraded) and still return byte-identical results. Skipped
+    (ok=True) when the backend exposes fewer than 2 devices."""
+    import jax
+
+    from spark_rapids_tpu.runtime import faults
+    from spark_rapids_tpu.workloads import spmd_bench, tpch
+
+    n_dev = min(8, len(jax.devices()))
+    if n_dev < 2:
+        return {"skipped": True, "ok": True,
+                "reason": f"{len(jax.devices())} device(s); mesh needs 2+"}
+    s = st.TpuSession({
+        "spark.rapids.tpu.mesh.devices": n_dev,
+        "spark.rapids.tpu.sql.batchSizeRows": 2048,
+        "spark.rapids.tpu.sql.resultCache.enabled": "false",
+    })
+    df = s.create_dataframe(tpch.gen_lineitem(sf=sf, seed=7)).cache()
+    faults.clear_plan()
+    ref_q = spmd_bench._q6_shape(df)
+    ref = spmd_bench._canon(ref_q.to_arrow())
+    stages = spmd_bench._metric_sum(ref_q, "spmdStages")
+
+    faults.reset_recovery_stats()
+    # prob=1/times=1 on the live (bg=0) path: the FIRST fused collective
+    # launch fails, deterministically; prewarm hits are left alone
+    faults.install_plan(
+        "mesh.collective:prob=1.0:times=1:bg=0:raise=FetchFailed")
+    try:
+        q = spmd_bench._q6_shape(df)
+        tbl = spmd_bench._canon(q.to_arrow())
+        degraded = spmd_bench._metric_sum(q, "spmdDegraded")
+    finally:
+        counts = faults.injection_counts()
+        faults.clear_plan()
+    rec = faults.recovery_stats()
+    df.uncache()
+    out = {
+        "skipped": False,
+        "devices": n_dev,
+        "spmd_stages_ref": stages,
+        "injected": counts.get("injected", 0),
+        "spmd_degraded": degraded,
+        "degradations": rec.get("degradations", 0),
+        "parity": tbl.equals(ref),
+        "ok": bool(tbl.equals(ref) and stages > 0
+                   and counts.get("injected", 0) >= 1 and degraded >= 1),
+    }
+    return out
+
+
 def _chaos_soak(st, sf: float, seed: int, n_streams: int = 2,
                 qids=(1, 3, 6, 12, 14), max_retries: int = 8) -> dict:
     """Fault-injection soak (ISSUE 14 acceptance): derive a randomized
@@ -1075,6 +1240,10 @@ def _chaos_soak(st, sf: float, seed: int, n_streams: int = 2,
     retry_budget = len(qids) * n_streams * max_retries
     for df in dfs.values():
         df.uncache()
+    # focused mesh.collective pass: the randomized plan above arms the
+    # point but the soak session runs mesh-less, so exercise the fused
+    # SPMD stage -> round-based degradation path explicitly
+    mesh = _mesh_chaos(st, min(sf, 0.02))
     out = {
         "seed": seed,
         "plan": plan,
@@ -1091,10 +1260,12 @@ def _chaos_soak(st, sf: float, seed: int, n_streams: int = 2,
         "retries_bounded": retries <= retry_budget,
         "ledger": led,
         "lockdep": lockrep,
+        "mesh_collective": mesh,
         "ok": (not mismatched and not errors
                and retries <= retry_budget
                and bool(led.get("balanceOk", True))
-               and int(lockrep.get("findings", 0)) == 0),
+               and int(lockrep.get("findings", 0)) == 0
+               and bool(mesh.get("ok", False))),
     }
     if errors:
         out["errors"] = errors[:10]

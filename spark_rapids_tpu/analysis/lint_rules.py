@@ -736,6 +736,69 @@ def rule_unstable_program_key(ctx: _ModuleCtx):
                        f"marker")
 
 
+def rule_mesh_program_key(ctx: _ModuleCtx):
+    """Flag shard_map/mesh programs in exec/ that are not built through
+    `cached_program()` with a mesh-topology-bearing key. A collective
+    program's lowering bakes in the mesh topology — replica groups, ICI
+    routing, the device target — so a key that omits
+    `mesh_topology_key(...)` lets two topologies share one cache entry:
+    the second mesh silently dispatches a program compiled for the
+    first (wrong replica groups at best, an XLA runtime error at
+    worst), and warm packs recorded on one topology preload into
+    processes that can never run them. Every function that traces a
+    `shard_map` must register it via `cached_program(..., key=(
+    mesh_topology_key(n, axis), ...))`."""
+    if not re.search(r"(^|/)exec/", ctx.path):
+        return
+
+    def outer_funcs(body):
+        for n in body:
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                yield n
+            elif isinstance(n, ast.ClassDef):
+                yield from outer_funcs(n.body)
+
+    def called_name(call: ast.Call) -> Optional[str]:
+        if isinstance(call.func, ast.Name):
+            return call.func.id
+        if isinstance(call.func, ast.Attribute):
+            return call.func.attr
+        return None
+
+    for fn in outer_funcs(ctx.tree.body):
+        smaps = [n for n in ast.walk(fn) if isinstance(n, ast.Call)
+                 and called_name(n) in ("shard_map", "_shard_map")]
+        if not smaps:
+            continue
+        cps = [n for n in ast.walk(fn) if isinstance(n, ast.Call)
+               and called_name(n) == "cached_program"]
+        if not cps:
+            yield (smaps[0].lineno, smaps[0].col_offset,
+                   "mesh-program-key",
+                   f"{fn.name} traces a shard_map program without "
+                   f"cached_program(): the collective compiles outside "
+                   f"the program cache — invisible to warm packs, the "
+                   f"compile pool, and the topology-keying contract")
+            continue
+        mesh_keyed = False
+        for cp in cps:
+            for kw in cp.keywords:
+                if kw.arg == "key" and kw.value is not None and any(
+                        isinstance(n, ast.Call)
+                        and called_name(n) == "mesh_topology_key"
+                        for n in ast.walk(kw.value)):
+                    mesh_keyed = True
+        if not mesh_keyed:
+            yield (smaps[0].lineno, smaps[0].col_offset,
+                   "mesh-program-key",
+                   f"{fn.name} registers a shard_map program whose "
+                   f"cached_program key= lacks mesh_topology_key(): "
+                   f"two mesh topologies would share one cache entry "
+                   f"and a warm pack recorded on one would preload "
+                   f"into the other — lead the key with "
+                   f"mesh_topology_key(n_devices, axis_name)")
+
+
 #: identifiers whose presence in a broad retry handler shows the author
 #: thought about cancellation/transience classification (the classifier
 #: helpers, the cancel exception types, and the token itself)
@@ -836,6 +899,7 @@ RULES = {
     "retry-swallows-cancel": rule_retry_swallows_cancel,
     "fp-unstable-attr": rule_fp_unstable_attr,
     "unstable-program-key": rule_unstable_program_key,
+    "mesh-program-key": rule_mesh_program_key,
 }
 
 

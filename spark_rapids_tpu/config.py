@@ -668,6 +668,37 @@ MESH_DEVICES = _conf(
     "(jax.sharding.Mesh) instead of the host file shuffle — the TPU-pod "
     "analog of the reference's UCX shuffle mode. 0 disables (single-chip "
     "+ host shuffle).", int)
+SPMD_STAGE_ENABLED = _conf(
+    "mesh.spmdStage.enabled", True,
+    "Fuse a mesh exchange with its consumer (final hash aggregate, "
+    "fusable filter/project chain, co-partitioned join input) into ONE "
+    "shard_map program per stage: partition ids, the all_to_all "
+    "collective, and the consumer run inside the same jitted program — "
+    "no per-round host sync and no spill-handle park/unpark between "
+    "exchange and consumer. Stages whose staged working set exceeds "
+    "mesh.spmdStage.maxBytes (and any stage hit by a mesh.collective "
+    "fault) fall back to the streaming round-based exchange.", bool)
+SPMD_STAGE_MAX_BYTES = _conf(
+    "mesh.spmdStage.maxBytes", 256 << 20,
+    "Working-set budget for a fused SPMD stage: the stage drains its "
+    "map side first, and when the staged bytes exceed this the stage "
+    "degrades to the bounded-memory round-based exchange instead of "
+    "materializing everything into one collective round (the bounce-"
+    "buffer memory model keeps peak HBM at O(devices * round) there).",
+    int)
+SPMD_RESHARD_ENABLED = _conf(
+    "mesh.spmdStage.reshard.enabled", True,
+    "AQE mesh analog of partition coalescing: after the map side of a "
+    "fused SPMD stage materializes, shrink the ACTIVE mesh axis for "
+    "small stages (partition ids drawn mod n_active < n_devices) so "
+    "tiny reduce states do not shard 8 ways; trailing shards receive "
+    "nothing and emit no batches. Decided from exact staged byte "
+    "stats, recorded as an aqe_replan decision.", bool)
+SPMD_RESHARD_MIN_BYTES = _conf(
+    "mesh.spmdStage.reshard.minBytesPerShard", 1 << 20,
+    "Target minimum staged bytes per active shard for the AQE mesh "
+    "re-shard rule: the active axis halves until each remaining shard "
+    "would see at least this many bytes (or one shard remains).", int)
 SERVICE_QUERY_TIMEOUT_SECS = _conf(
     "sql.service.queryTimeoutSecs", 0.0,
     "Wall-clock deadline per query, measured from submission (queue "

@@ -529,13 +529,14 @@ class HashAggregateExec(TpuExec):
                 f"aggs={self.agg_names}{fused}]")
 
     # -- sort/segment machinery (runs inside jit) ----------------------
-    def _sort_and_segment(self, key_cvs, mask, nchunks):
+    def _sort_and_segment(self, key_cvs, mask, nchunks,
+                          allow_host_sort: bool = True):
         cap = mask.shape[0]
         arrays = [jnp.logical_not(mask).astype(jnp.uint8)]  # dead rows last
         for kcv, kexpr, nc in zip(key_cvs, self.keys, nchunks):
             arrays.append(jnp.logical_not(kcv.validity).astype(jnp.uint8))
             arrays.extend(sk.order_keys(kcv, kexpr.dtype, nc))
-        perm = sk.lexsort(arrays)
+        perm = sk.lexsort(arrays, allow_host=allow_host_sort)
         sorted_arrays = [a[perm] for a in arrays]
         boundary = sk.group_boundaries(sorted_arrays)
         seg_ids = jnp.cumsum(boundary.astype(jnp.int32)) - 1
@@ -918,13 +919,17 @@ class HashAggregateExec(TpuExec):
             return outs, sl_c, count, overflow
         return run
 
-    def _merge_body(self, key_cvs, flat_states, mask, nchunks):
+    def _merge_body(self, key_cvs, flat_states, mask, nchunks,
+                    allow_host_sort: bool = True):
         """In-trace merge (the body of _merge_fn without the jit
         boundary): sort-segment the partial keys, reduce states; live
-        groups come out first."""
+        groups come out first. `allow_host_sort=False` force-disables
+        the host-callback sort — mandatory when tracing inside
+        shard_map, where pure_callback would deadlock the collective."""
         cap = mask.shape[0]
         perm, seg_ids, live, seg_live, key_out = \
-            self._sort_and_segment(key_cvs, mask, nchunks)
+            self._sort_and_segment(key_cvs, mask, nchunks,
+                                   allow_host_sort=allow_host_sort)
         out_flat = []
         i = 0
         for a in self.aggs:

@@ -127,10 +127,15 @@ class MeshExchangeExec(TpuExec):
                 out_specs=(tuple(P(axis) for _ in flat), P(axis)),
             )(tuple(flat), mask)
 
+        from ..parallel.mesh import mesh_topology_key
         from ..runtime.program_cache import cached_program, exprs_fp
+        # the key leads with the mesh topology (n_devices, axis, device
+        # kind): collective lowering bakes in replica groups and ICI
+        # routing, so programs must never cross topologies
         return cached_program(
             step, cls="MeshExchangeExec", tag="step",
-            key=(n, axis, exprs_fp(keys), tuple(has_offsets)))
+            key=(mesh_topology_key(n, axis), exprs_fp(keys),
+                 tuple(has_offsets)))
 
     # ------------------------------------------------------------------
     def _assemble_global(self, pieces, sharding, devices, m=None):
@@ -230,6 +235,10 @@ class MeshExchangeExec(TpuExec):
                         m))
             mask_global = self._assemble_global(shard_masks, sharding,
                                                 devices, m)
+            m.add("meshRounds", 1)
+            m.add("collectiveBytes",
+                  sum(int(a.nbytes) for a in flat_global)
+                  + int(mask_global.nbytes))
 
         with m.timer("exchangeTime"):
             key = tuple(has_offsets)
@@ -240,19 +249,31 @@ class MeshExchangeExec(TpuExec):
             out_flat, stats = prog(flat_global, mask_global)
         return out_flat, stats, row_cap, bcaps
 
-    def _collect_round(self, m, store, out, rnd_state, has_offsets,
+    def _collect_round(self, ctx, m, store, out, rnd_state, has_offsets,
                        n_str):
         """Fetch a dispatched round's stats (blocks until the device
         finishes it), slice each shard's live prefix to a bucketed
-        capacity, and park the output as spillable handles."""
+        capacity, and park the output as spillable handles. Runs on the
+        collector thread; polls the cancel token between shards so a
+        killed query stops parking mid-round."""
         out_flat, stats, row_cap, bcaps = rnd_state
         n = self.n
+        ctx.check_cancel()
         with m.timer("exchangeTime"):
             from ..utils.transfer import fetch
             # tpulint: allow[sync-under-lock] round collection is double-buffered INSIDE the memoized build; the fetch overlaps the next round's collective and readers need _out anyway
             stats_h = fetch(stats).reshape(n, 1 + n_str)
         out_cap = n * row_cap
+        # collect each shard from its device-LOCAL piece: basic
+        # indexing on the GLOBAL sharded array lowers to an all-gather,
+        # and with the next round's all_to_all already in flight on the
+        # dispatch thread the two rendezvous interleave on the same
+        # device threads and deadlock each other (XLA collectives
+        # rendezvous by arrival, not by launch). Local-shard slices are
+        # single-device programs: no rendezvous, overlap stays safe.
+        flat_loc = [_local_shards(a, n) for a in out_flat]
         for s in range(n):
+            ctx.check_cancel()
             nlive = int(stats_h[s, 0])
             if nlive == 0:
                 continue
@@ -263,21 +284,19 @@ class MeshExchangeExec(TpuExec):
             fi = 0
             si = 1
             for ci, f in enumerate(self.schema.fields):
-                r0 = s * out_cap
                 if has_offsets[ci]:
                     bc = n * bcaps[ci]
                     nbytes = int(stats_h[s, si])
                     si += 1
                     bcap_new = min(bucket_capacity(nbytes), bc)
-                    data = out_flat[fi][s * bc:s * bc + bcap_new]
-                    valid = out_flat[fi + 1][r0:r0 + new_cap]
-                    o0 = s * (out_cap + 1)
-                    offs = out_flat[fi + 2][o0:o0 + new_cap + 1]
+                    data = flat_loc[fi][s][:bcap_new]
+                    valid = flat_loc[fi + 1][s][:new_cap]
+                    offs = flat_loc[fi + 2][s][:new_cap + 1]
                     cvs.append(CV(data, valid, offs))
                     fi += 3
                 else:
-                    data = out_flat[fi][r0:r0 + new_cap]
-                    valid = out_flat[fi + 1][r0:r0 + new_cap]
+                    data = flat_loc[fi][s][:new_cap]
+                    valid = flat_loc[fi + 1][s][:new_cap]
                     cvs.append(CV(data, valid))
                     fi += 2
             tbl = make_table(self.schema, cvs, nlive)
@@ -308,24 +327,37 @@ class MeshExchangeExec(TpuExec):
 
             # STREAMING: no full pre-drain (r3 buffered the entire child
             # before round 1). Child batches fill an n-slot round; as
-            # soon as it's full the round dispatches, and the PREVIOUS
-            # round's results are collected while this one runs on
-            # device — child execution and round assembly overlap the
-            # in-flight collective (double buffering).
+            # soon as it's full the round dispatches, and its collection
+            # — the blocking per-round stats fetch — moves to a
+            # single-thread collector so the orchestration thread goes
+            # straight back to draining the child and assembling the
+            # NEXT round (r5 collected round k-1 inline on the
+            # orchestration thread, which stalled round k+1's dispatch
+            # behind a device sync). One collector thread keeps round
+            # collection in dispatch order, so the per-shard output
+            # piles — and therefore exchange output — stay
+            # byte-identical to the serial collect.
+            import concurrent.futures as cf
             out: List[List] = [[] for _ in range(n)]
             slot: List = []
-            pending = None
+            collector = cf.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="mesh-collect")
+            futs: List = []
 
             def flush(slot_handles):
-                """Dispatch a round; collect the PREVIOUS round while
-                this one runs on device (double buffering)."""
-                nonlocal pending
+                """Dispatch a round; hand its collection to the
+                collector thread so the stats fetch overlaps the next
+                round's assembly and dispatch."""
+                # surface a collector failure before dispatching more
+                for f in futs:
+                    if f.done():
+                        # tpulint: allow[wait-under-lock] guarded by f.done() — result() never blocks here, it only rethrows a finished collect's failure
+                        f.result()
                 cur = self._dispatch_round(m, slot_handles, sharding,
                                            devices, has_offsets)
-                if pending is not None:
-                    self._collect_round(m, store, out, pending,
-                                        has_offsets, n_str)
-                pending = cur
+                futs.append(collector.submit(
+                    self._collect_round, ctx, m, store, out, cur,
+                    has_offsets, n_str))
 
             nparts = child.num_partitions(ctx)
             from .exchange_pool import PermitRider, resolve_map_threads
@@ -350,14 +382,18 @@ class MeshExchangeExec(TpuExec):
                 if slot:
                     flush(slot)
                     slot = []
-                if pending is not None:
-                    self._collect_round(m, store, out, pending,
-                                        has_offsets, n_str)
+                for f in futs:
+                    # tpulint: allow[wait-under-lock] the end-of-exchange barrier: the collector thread never takes this lock, its rounds are bounded device work, and _collect_round polls the cancel token
+                    f.result()
+                collector.shutdown(wait=True)
             except BaseException:
                 # failing mid-stream (upstream OOM, bad data, cancel)
-                # must not leak: close waiting queue/slot handles and
-                # everything parked so far; self._out stays None so a
-                # retried action re-runs the exchange from a clean slate
+                # must not leak: let in-flight collects finish parking
+                # (so their handles are visible below), then close
+                # waiting queue/slot handles and everything parked so
+                # far; self._out stays None so a retried action re-runs
+                # the exchange from a clean slate
+                collector.shutdown(wait=True)
                 for q in queues:
                     while True:
                         try:
@@ -483,6 +519,25 @@ class MeshExchangeExec(TpuExec):
             self.release()
         except Exception:
             pass
+
+
+def _local_shards(arr, n: int):
+    """Per-device local pieces of a 1-D array sharded n ways, ordered
+    by shard position. Slicing these is a single-device program; the
+    equivalent slice of the GLOBAL array lowers to an all-gather whose
+    rendezvous can deadlock against another in-flight collective."""
+    shards = getattr(arr, "addressable_shards", None)
+    if not shards or len(shards) != n:
+        # unsharded (single-device / committed) array: fall back to
+        # host-side views of the global buffer
+        shard_len = arr.shape[0] // n
+        return [arr[s * shard_len:(s + 1) * shard_len] for s in range(n)]
+    shard_len = arr.shape[0] // n
+    loc = [None] * n
+    for sh in shards:
+        start = sh.index[0].start or 0
+        loc[start // shard_len] = sh.data
+    return loc
 
 
 def _flatten_cvs(cvs: Sequence[CV]):

@@ -12,7 +12,37 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-__all__ = ["make_mesh", "P", "NamedSharding", "Mesh", "shard_rows"]
+__all__ = ["make_mesh", "P", "NamedSharding", "Mesh", "shard_rows",
+           "mesh_topology_key", "mesh_fingerprint"]
+
+
+def mesh_topology_key(n_devices: int, axis_name: str = "data") -> tuple:
+    """Program-cache key component for shard_map/mesh programs:
+    (n_devices, axis name, device kind). A collective program's lowering
+    bakes in the mesh topology — replica groups, ICI routing, the
+    device target — so two topologies must never share a cache entry or
+    a warm-pack manifest entry (the mesh-program-key lint rule polices
+    that every mesh program in exec/ keys on this)."""
+    return ("mesh", int(n_devices), str(axis_name), _device_kind())
+
+
+def mesh_fingerprint() -> str:
+    """Host-level mesh identity mixed into the warm-pack fingerprint:
+    device kind + visible device count. A pack recorded on an 8-device
+    mesh must not preload into a 1-device process (the sharded
+    signatures could never dispatch there) and vice versa."""
+    try:
+        n = len(jax.devices())
+    except RuntimeError:
+        n = 0
+    return f"mesh:{_device_kind()}:{n}"
+
+
+def _device_kind() -> str:
+    try:
+        return str(jax.devices()[0].device_kind)
+    except Exception:
+        return "unknown"
 
 
 def make_mesh(n_devices: Optional[int] = None,

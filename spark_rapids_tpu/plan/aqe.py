@@ -38,7 +38,8 @@ __all__ = ["run_stage_driver", "aqe_stats", "reset_stats"]
 
 # session-process AQE decision counters (bench --smoke `extra.aqe`)
 _STATS_LOCK = threading.Lock()
-_STATS = {"coalesced_partitions": 0, "skew_splits": 0, "demotions": 0}
+_STATS = {"coalesced_partitions": 0, "skew_splits": 0, "demotions": 0,
+          "mesh_reshards": 0, "mesh_demotions": 0}
 
 
 def aqe_stats() -> Dict[str, int]:
@@ -90,6 +91,7 @@ def run_stage_driver(root, ctx, conf) -> List[Dict[str, Any]]:
         return []
     from ..exec.aqe import AQEShuffleReadExec
     from ..exec.join import HashJoinExec
+    from ..exec.spmd_stage import SpmdStageExec
 
     decisions: List[Dict[str, Any]] = []
     seen_plans: set = set()
@@ -102,8 +104,20 @@ def run_stage_driver(root, ctx, conf) -> List[Dict[str, Any]]:
             # visited: forcing the stream reader's groups would run the
             # very map phase demotion exists to skip
             _maybe_demote(node, ctx, conf, decisions, lore_alloc, root)
+            _maybe_demote_mesh(node, ctx, conf, decisions, lore_alloc,
+                               root)
         for c in list(node.children):
             visit(c)
+        if isinstance(node, SpmdStageExec):
+            # mesh analog of partition coalescing: exact staged bytes
+            # shrink the active mesh axis for small stages (the
+            # decision logic lives on the stage, which owns the stats)
+            d = node.plan_reshard(ctx, conf)
+            if d is not None:
+                decisions.append(d)
+                if not getattr(node, "_reshard_counted", False):
+                    node._reshard_counted = True
+                    _bump("mesh_reshards")
         if isinstance(node, AQEShuffleReadExec):
             # stage barrier: materialize (exchange pool) + replan
             node.plan.groups(ctx)
@@ -179,3 +193,58 @@ def _maybe_demote(join, ctx, conf, decisions, lore_alloc, root) -> None:
     ctx.metrics_for(join._op_id).set("aqeDemotedBuildBytes", build_bytes)
     decisions.append(d)
     _bump("demotions")
+
+
+def _maybe_demote_mesh(join, ctx, conf, decisions, lore_alloc,
+                       root) -> None:
+    """The mesh-path twin of `_maybe_demote`: a shuffled hash join whose
+    inputs are bare SpmdStageExec exchange stages. The build stage is
+    materialized to its STAGED handles only (map side runs, collective
+    does not); when the exact staged bytes fit the broadcast threshold,
+    the build side broadcasts straight from those handles and the
+    stream side drops its stage entirely — NEITHER side's collective
+    program runs."""
+    from ..config import ADAPTIVE_DEMOTE_ENABLED, BROADCAST_THRESHOLD
+    prev = getattr(join, "_aqe_mesh_demoted", None)
+    if prev is not None:
+        decisions.append(prev)
+        return
+    thr = conf.get(BROADCAST_THRESHOLD)
+    if not (conf.get(ADAPTIVE_DEMOTE_ENABLED) and thr >= 0
+            and join.per_partition):
+        return
+    from ..exec.spmd_stage import SpmdStageExec
+    stream, build = join.children
+    if not (isinstance(stream, SpmdStageExec)
+            and isinstance(build, SpmdStageExec)
+            and stream.kind == "exchange" and build.kind == "exchange"):
+        return
+    # only a cold stream stage can be skipped: once staged or degraded,
+    # its map phase already ran and there is nothing left to save
+    if stream._staged is not None or stream._degraded \
+            or build._degraded or not stream.children:
+        return
+    ctx.check_cancel()
+    # stage barrier: the build map phase drains into spill handles NOW
+    # and reports exact device bytes (the mesh MapOutputStatistics)
+    build_bytes = int(build.stage_bytes(ctx))
+    if build_bytes > thr:
+        return
+    from ..exec.broadcast import BroadcastExchangeExec
+    src = build.staged_source(own=True)
+    bcast = BroadcastExchangeExec(src, src.schema)
+    if not lore_alloc[0]:
+        lore_alloc[0] = _max_lore_id(root)
+    lore_alloc[0] += 1
+    bcast.lore_id = lore_alloc[0]
+    old_lores = [getattr(n, "lore_id", None) for n in (stream, build)]
+    join.children = [stream.children[0], bcast]
+    join.per_partition = False
+    d = {"rule": "demote_broadcast_join", "mesh": True,
+         "join_lore": getattr(join, "lore_id", None),
+         "old_lores": old_lores, "new_lores": [bcast.lore_id],
+         "build_bytes": build_bytes, "threshold": int(thr)}
+    join._aqe_mesh_demoted = d
+    ctx.metrics_for(join._op_id).set("aqeDemotedBuildBytes", build_bytes)
+    decisions.append(d)
+    _bump("mesh_demotions")

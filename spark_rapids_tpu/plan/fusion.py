@@ -33,7 +33,7 @@ from ..exec.base import TpuExec
 from ..exec.fused import FusedStageExec
 from ..exec.nodes import CachedScanExec
 
-__all__ = ["fuse_stages"]
+__all__ = ["fuse_stages", "fuse_spmd_stages"]
 
 
 def _max_lore(root: TpuExec) -> int:
@@ -110,4 +110,96 @@ def fuse_stages(root: TpuExec, conf,
         next_id += 1
         g.lore_id = next_id
         lines.append(g.describe())
+    return new_root, lines
+
+
+def fuse_spmd_stages(root: TpuExec, conf) -> Tuple[TpuExec, List[str]]:
+    """Flip the mesh exchange from operator boundary to sharding
+    annotation: group each `MeshExchangeExec` with its fusible consumer
+    into a `SpmdStageExec` that runs partition ids + all_to_all +
+    consumer inside ONE shard_map program (exec/spmd_stage.py).
+
+    Runs after `fuse_stages`/`reuse_exchanges`/result-cache
+    substitution so it sees the final operator tree (a filter/project
+    chain over the exchange may already be one FusedStageExec — its
+    composed `fusable_stage()` fuses as a single chain member).
+
+    Patterns, matched top-down:
+      * final-mode HashAggregateExec directly over a MeshExchangeExec
+        (the partial→exchange→final shape `_agg` plants) -> kind "agg";
+        aggregates carrying "custom" host-side state reducers
+        (t-digest) cannot trace inside shard_map and are skipped;
+      * a single-child fusable chain ending at a MeshExchangeExec ->
+        kind "chain";
+      * any remaining MeshExchangeExec (shuffled-join inputs) -> a bare
+        kind "exchange" stage: one single-round collective program plus
+        the staged-byte stats hook AQE's mesh rules read.
+
+    The round-based exchange is NOT removed — it stays inside the stage
+    as the bounded-memory / fault-degradation fallback."""
+    from ..config import MESH_COMPRESS, MESH_DEVICES, SPMD_STAGE_ENABLED
+    mesh_n = conf.get(MESH_DEVICES)
+    if not conf.get(SPMD_STAGE_ENABLED) or not mesh_n or mesh_n <= 1:
+        return root, []
+    if conf.get(MESH_COMPRESS):
+        # byte-plane shuffle compression is a feature of the STAGED
+        # round-based exchange; the fused program moves shards
+        # in-program where packing has nothing to act on
+        return root, []
+    from ..exec.aggregate import HashAggregateExec
+    from ..exec.mesh_exchange import MeshExchangeExec
+    from ..exec.spmd_stage import SpmdStageExec
+
+    stages: List[SpmdStageExec] = []
+
+    def agg_traceable(agg: HashAggregateExec) -> bool:
+        # "custom" reducers merge through a host-side callback
+        # (g_merge_custom) — untraceable inside shard_map
+        return not any("custom" in a.state_reducers for a in agg.aggs)
+
+    def fusable(n: TpuExec) -> bool:
+        return (len(n.children) == 1
+                and n.fusable_stage() is not None
+                and not getattr(n, "fusion_opt_out", False))
+
+    def walk(node: TpuExec) -> TpuExec:
+        if (isinstance(node, HashAggregateExec) and node.mode == "final"
+                and len(node.children) == 1
+                and isinstance(node.children[0], MeshExchangeExec)
+                and agg_traceable(node)):
+            ex = node.children[0]
+            st = SpmdStageExec(ex, consumer=node, kind="agg")
+            stages.append(st)
+            _walk_into(st)
+            return st
+        chain, cur = [], node
+        while fusable(cur):
+            chain.append(cur)
+            cur = cur.children[0]
+        if chain and isinstance(cur, MeshExchangeExec):
+            st = SpmdStageExec(cur, chain=chain, kind="chain")
+            stages.append(st)
+            _walk_into(st)
+            return st
+        if isinstance(node, MeshExchangeExec):
+            st = SpmdStageExec(node, kind="exchange")
+            stages.append(st)
+            _walk_into(st)
+            return st
+        node.children = [walk(c) for c in node.children]
+        return node
+
+    def _walk_into(st: "SpmdStageExec") -> None:
+        # recurse into the shared map subtree, keeping the fallback
+        # exchange's child pointer in sync with the wrapped tree
+        st.children = [walk(c) for c in st.children]
+        st.exchange.children = list(st.children)
+
+    new_root = walk(root)
+    next_id = _max_lore(new_root)
+    lines = []
+    for st in stages:
+        next_id += 1
+        st.lore_id = next_id
+        lines.append(st.describe())
     return new_root, lines

@@ -653,6 +653,14 @@ class Planner:
             root_exec, frag_hits = result_cache.substitute_fragments(
                 root_exec, self.conf)
             root_exec.result_cache_fragment_hits = frag_hits
+            # SPMD stage grouping (plan/fusion.py): each surviving mesh
+            # exchange fuses with its consumer into ONE shard_map
+            # program — runs last so it sees the final tree (reused /
+            # cache-substituted exchanges must not be double-wrapped)
+            from .fusion import fuse_spmd_stages
+            root_exec, spmd_groups = fuse_spmd_stages(root_exec,
+                                                      self.conf)
+            report.fusion_groups = fusion_groups + spmd_groups
             # ride the physical root so the profiler wrapper can emit
             # the plan_audit event without re-walking
             root_exec.audit_report = report

@@ -132,6 +132,21 @@ def render_analyze(tree: dict, metrics_by_lore: Dict[Optional[int], dict],
         if m.get("aqeDemotedBuildBytes") is not None:
             ann.append("aqeDemotedToBroadcast="
                        f"{fmt_bytes(m['aqeDemotedBuildBytes'])}")
+        # mesh/SPMD stage metrics: rounds dispatched by the round-based
+        # exchange, fused one-program stages, collective traffic, and
+        # fault-driven degradations back to the round path
+        if m.get("meshRounds"):
+            ann.append(f"meshRounds={int(m['meshRounds'])}")
+        if m.get("spmdStages"):
+            ann.append(f"spmdStages={int(m['spmdStages'])}")
+        if m.get("collectiveBytes"):
+            ann.append(
+                f"collectiveBytes={fmt_bytes(m['collectiveBytes'])}")
+        if m.get("spmdDegraded"):
+            ann.append(f"spmdDegraded={int(m['spmdDegraded'])}")
+        if m.get("spmdActiveShards") is not None:
+            ann.append(
+                f"spmdActiveShards={int(m['spmdActiveShards'])}")
         if m.get("shufflePartitionBytesMax") is not None:
             ann.append(
                 "shufflePartitionBytes="

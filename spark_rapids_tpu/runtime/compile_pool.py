@@ -17,7 +17,12 @@ mechanism:
 - **speculative** tasks: warm-pack preload at service startup. These
   are admission-aware — a busy hook (wired to the QueryManager's
   running count) defers them while any query is running, so a running
-  query's dispatch never competes with speculative compilation.
+  query's dispatch never competes with speculative compilation. They
+  are also per-topology: the pack fingerprint (warm_pack._fingerprint)
+  includes the mesh identity, so an 8-device service process preloads
+  sharded collective programs (SpmdStageExec / MeshExchangeExec, keyed
+  on mesh_topology_key) recorded on the SAME topology, and a pack from
+  a different mesh never spends this pool's budget.
 
 The dispatch path NEVER waits on this pool: `CachedProgram.__call__`
 compiles inline on a miss exactly as before — a duplicate compile is

@@ -6,8 +6,12 @@ the plugin's OOM-retry / shuffle-refetch machinery is *exercised*, not
 hoped-for. Same idea here, engine-native: named fault points are
 instrumented across cluster/, shuffle/, exec/, memory/ and service/
 (`block.fetch`, `rpc.send`, `executor.task`, `device.dispatch`,
-`exchange.map`, `spill.write`, `xla.compile`), and a fault PLAN selects
-which calls fail and how.
+`exchange.map`, `spill.write`, `xla.compile`, `mesh.collective`), and a
+fault PLAN selects which calls fail and how. `mesh.collective` fires in
+the SPMD stage launch path (exec/spmd_stage.py): live hits
+(background=0) fail the fused collective program and must degrade the
+stage to the round-based exchange (counted `spmdDegraded`); bg=1 hits
+fire in the prewarm walk, which is best-effort and swallows them.
 
 Plan grammar (conf `spark.rapids.tpu.sql.debug.faults.plan` or env
 `SRTPU_FAULTS`), rules separated by `;`:
@@ -70,7 +74,8 @@ ACTIVE = False
 #: the instrumented fault-point inventory (docs/robustness.md and the
 #: bench --chaos plan generator both derive from this tuple)
 POINTS = ("block.fetch", "device.dispatch", "executor.task",
-          "spill.write", "xla.compile", "exchange.map", "rpc.send")
+          "spill.write", "xla.compile", "exchange.map", "rpc.send",
+          "mesh.collective")
 
 _lock = threading.Lock()
 _spec: Optional[str] = None

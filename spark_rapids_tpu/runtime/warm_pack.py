@@ -89,7 +89,12 @@ def reset() -> None:
 
 def _fingerprint() -> str:
     from .. import _cache_fingerprint
-    return _cache_fingerprint()
+    from ..parallel.mesh import mesh_fingerprint
+    # packs are per-topology: a manifest recorded against an 8-device
+    # mesh carries sharded collective signatures that can never warm a
+    # 1-device process (and would waste its compile-pool budget), so
+    # the device kind + visible device count gates the load
+    return _cache_fingerprint() + "|" + mesh_fingerprint()
 
 
 def save(conf, path: Optional[str] = None) -> Optional[str]:

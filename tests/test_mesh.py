@@ -400,5 +400,7 @@ def test_mesh_non_power_of_two_devices():
 
 def _walk(node):
     yield node
+    for m in getattr(node, "members", []) or []:
+        yield m
     for c in node.children:
         yield from _walk(c)

@@ -113,6 +113,20 @@ def report(events: List[dict], top: int = 0) -> str:
                     None)
         ops = _ops_of(evs)
         agg = aggregate_ops(ops)
+        # mesh/SPMD summary: fused one-program stages vs round-based
+        # exchange rounds, collective traffic, and fault degradations
+        mesh = {k: 0 for k in ("spmdStages", "meshRounds",
+                               "collectiveBytes", "spmdDegraded")}
+        for r in agg.values():
+            for k in mesh:
+                mesh[k] += int(r["metrics"].get(k) or 0)
+        if any(mesh.values()):
+            lines.append(
+                f"  mesh: {mesh['spmdStages']} spmd stage(s), "
+                f"{mesh['meshRounds']} exchange round(s), "
+                f"{fmt_bytes(mesh['collectiveBytes'])} collective"
+                + (f", {mesh['spmdDegraded']} degraded to round-based"
+                   if mesh["spmdDegraded"] else ""))
         if plan is not None:
             by_lore = {v["lore_id"]: v["metrics"] for v in agg.values()}
             lines.append(render_analyze(plan, by_lore))
@@ -146,11 +160,20 @@ def report(events: List[dict], top: int = 0) -> str:
                 for d in decs:
                     if d.get("rule") == "demote_broadcast_join":
                         parts.append(
-                            "demoted join lore "
-                            f"{d.get('join_lore')} to broadcast "
+                            ("demoted mesh join lore "
+                             if d.get("mesh") else "demoted join lore ")
+                            + f"{d.get('join_lore')} to broadcast "
                             f"({fmt_bytes(d.get('build_bytes', 0))} "
                             f"build, lores {d.get('old_lores')}"
                             f"→{d.get('new_lores')})")
+                    elif d.get("rule") == "mesh_reshard":
+                        parts.append(
+                            f"resharded spmd stage lore "
+                            f"{d.get('stage_lore')} "
+                            f"{d.get('devices')}→{d.get('active')} "
+                            f"active shards "
+                            f"({fmt_bytes(d.get('staged_bytes', 0))} "
+                            f"staged)")
                     else:
                         seg = (f"shuffle read "
                                f"{d.get('partitions_before')}"
