@@ -426,6 +426,10 @@ def _main_impl():
             else:
                 s = st.TpuSession()
                 conc = _concurrent_throughput(s, sf_c, _CONCURRENT)
+        try:
+            conc["telemetry"] = _telemetry_snapshot()
+        except Exception:  # advisory: never lose the bench result
+            pass
         print(json.dumps({
             "metric": (f"tpch_{mode}_{_CONCURRENT}streams_"
                        f"sf{sf_c}_q_per_s"),
@@ -692,6 +696,14 @@ def _main_impl():
                 "error": repr(e)[:300]}
             print(f"bench: concurrent smoke failed: {e!r}",
                   file=sys.stderr)
+        # live-telemetry extract (ISSUE 17): latency/queue-wait
+        # histograms, pool saturation and per-category critical-path
+        # shares across everything this smoke ran — recorded into the
+        # partial so a budget-exhausted flush still carries it
+        try:
+            _partial["extra"]["telemetry"] = _telemetry_snapshot()
+        except Exception as e:  # advisory: never lose the bench result
+            _partial["extra"]["telemetry"] = {"error": repr(e)[:300]}
     else:
         try:
             _arm("scan profile")
@@ -755,7 +767,7 @@ def _main_impl():
             _partial["extra"]["ledger"] = _lg.report()
     for k in ("scan_profile", "smoke", "fresh_rerun_compiles",
               "concurrent_2stream", "service", "exchange", "lockdep",
-              "result_cache", "aqe", "ledger", "chaos"):
+              "result_cache", "aqe", "ledger", "chaos", "telemetry"):
         if k in _partial["extra"]:
             extra[k] = _partial["extra"][k]
     # ---- regression gate vs the previous round's JSON -------------------
@@ -774,7 +786,8 @@ def _main_impl():
             "tpch_all22_geomean_s": tpch_all.get("tpch_all22_geomean_s"),
         }, fellback, {"q1_sf": sf_agg, "q3_sf": sf_join, "q6_sf": sf,
                       "tpch_sf": tpch_all.get("tpch_all22_sf")},
-            xla_per_query=tpch_all.get("tpch_xla_per_query"))
+            xla_per_query=tpch_all.get("tpch_xla_per_query"),
+            telemetry=extra.get("telemetry"))
     except Exception as e:  # advisory: never lose the bench result
         regressions = []
         extra["regression_gate_error"] = repr(e)
@@ -1270,6 +1283,31 @@ def _chaos_soak(st, sf: float, seed: int, n_streams: int = 2,
     if errors:
         out["errors"] = errors[:10]
     return out
+
+
+def _telemetry_snapshot() -> dict:
+    """Compact live-telemetry extract for the bench artifact: latency /
+    queue-wait histogram summaries (p50/p95/p99 from the log-bucket
+    registry), pool-saturation gauges, service counters, and the mean
+    critical-path share per category across every traced query in this
+    process — the numbers the regression gate compares across rounds."""
+    from spark_rapids_tpu.profiler import telemetry
+    snap = telemetry.snapshot()
+    hists = snap.get("histograms") or {}
+    shares = {}
+    pfx = "critical_path_share_pct_"
+    for hname, s2 in hists.items():
+        if hname.startswith(pfx) and s2.get("count"):
+            shares[hname[len(pfx):]] = round(s2["sum"] / s2["count"], 2)
+    gauges = snap.get("gauges") or {}
+    return {
+        "histograms": {k: v for k, v in hists.items()
+                       if not k.startswith(pfx)},
+        "critical_path_shares": shares,
+        "pool": {k: v for k, v in gauges.items()
+                 if k.startswith(("compile_pool_", "service_"))},
+        "counters": snap.get("counters") or {},
+    }
 
 
 def _concurrent_throughput(s, sf: float, n_streams: int,
@@ -1898,12 +1936,15 @@ def _scan_profile(st, sf: float) -> dict:
 
 
 def _regression_gate(current: dict, fellback: bool, sfs: dict,
-                     xla_per_query: dict = None):
+                     xla_per_query: dict = None, telemetry: dict = None):
     """Compare engine-time metrics against the newest BENCH_r*.json that
     ran on the same backend class (fallback vs real). Returns a list of
     human-readable regression strings for slips >15%, plus per-query
     XLA compile-count growth >1.5x (plan-shape churn shows up as
-    recompiles long before it shows up in wall time at small SF)."""
+    recompiles long before it shows up in wall time at small SF), plus
+    critical-path share growth >1.5x for the queue/spill categories
+    (a scheduling or memory regression shows up as where the wall clock
+    goes before it moves the totals)."""
     import glob
     import re
     here = os.path.dirname(os.path.abspath(__file__))
@@ -1978,6 +2019,18 @@ def _regression_gate(current: dict, fellback: bool, sfs: dict,
             if oc > 0 and cc >= 8 and cc > 1.5 * oc:
                 out.append(f"{q}: xla compiles {cc} vs {oc} in {name} "
                            f"({cc / oc:.2f}x growth)")
+    # critical-path share drift: queue-wait / spill-wait growing >1.5x
+    # vs the prior artifact means queries newly stalled on admission or
+    # memory pressure; floor at 5% so jitter on near-zero shares never
+    # warns
+    cur_sh = (telemetry or {}).get("critical_path_shares") or {}
+    old_sh = ((extra.get("telemetry") or {})
+              .get("critical_path_shares") or {})
+    for cat in ("queue", "spill"):
+        cur, old = cur_sh.get(cat), old_sh.get(cat)
+        if cur and old and cur >= 5.0 and cur > 1.5 * old:
+            out.append(f"critical-path {cat} share: {cur:.1f}% vs "
+                       f"{old:.1f}% in {name} ({cur / old:.2f}x growth)")
     return out
 
 

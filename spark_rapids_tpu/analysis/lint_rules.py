@@ -54,6 +54,15 @@ module turns those conventions into machine-checked rules (consumed by
                    and retry a query the user already killed — retry
                    handlers must re-raise, or route through
                    is_transient_error/is_oom_error/check_cancel
+  span-leak        a tracing span opened imperatively
+                   (`tracing.open_span(...)`) whose result is never
+                   `.end()`-ed in a `finally` (and not handed to the
+                   caller): a leaked span never records — Span only
+                   emits on end — and every child opened under it
+                   mis-parents, so the trace silently loses that edge.
+                   `with tracing.span(...)` closes itself and is the
+                   preferred shape; deferred-close root spans (ended by
+                   `tracing.finish`) carry allow markers
   allow-no-reason  a `# tpulint: allow[...]` marker without a reason —
                    every accepted violation must say why
 
@@ -887,6 +896,86 @@ def rule_retry_swallows_cancel(ctx: _ModuleCtx):
     yield from visit(ctx.tree, None)
 
 
+def rule_span_leak(ctx: _ModuleCtx):
+    """Flag `open_span(...)` results that are not provably closed: no
+    `<name>.end()` inside the finalbody of a try in the same function,
+    and the span is not returned to the caller. `with tracing.span(...)`
+    closes itself and is never flagged; a discarded or
+    attribute-stashed open_span() is always flagged (nothing in scope
+    can reliably end it). Scope: the whole engine tree except
+    profiler/tracing.py, which defines the API."""
+    if re.search(r"(^|/)profiler/tracing\.py$", ctx.path):
+        return
+
+    def open_span_call(expr):
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call):
+                f = n.func
+                nm = f.attr if isinstance(f, ast.Attribute) else (
+                    f.id if isinstance(f, ast.Name) else None)
+                if nm == "open_span":
+                    return n
+        return None
+
+    # enclosing-function map: a statement's close obligations are
+    # discharged within its own function scope
+    func_of = {}
+
+    def _tag(node, fn):
+        for child in ast.iter_child_nodes(node):
+            func_of[child] = fn
+            _tag(child, child if isinstance(
+                child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                        ast.Lambda)) else fn)
+
+    _tag(ctx.tree, None)
+
+    def _ends_or_returns(fn_node, name) -> bool:
+        root = fn_node if fn_node is not None else ctx.tree
+        for n in ast.walk(root):
+            if isinstance(n, ast.Try):
+                for s in n.finalbody:
+                    for m in ast.walk(s):
+                        if (isinstance(m, ast.Call)
+                                and isinstance(m.func, ast.Attribute)
+                                and m.func.attr == "end"
+                                and isinstance(m.func.value, ast.Name)
+                                and m.func.value.id == name):
+                            return True
+            elif isinstance(n, ast.Return) and n.value is not None:
+                for m in ast.walk(n.value):
+                    if isinstance(m, ast.Name) and m.id == name:
+                        return True
+        return False
+
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            call = open_span_call(node.value)
+            targets = node.targets
+        elif isinstance(node, ast.Expr):
+            call = open_span_call(node.value)
+            targets = None
+        else:
+            continue
+        if call is None:
+            continue
+        if targets is not None and len(targets) == 1 \
+                and isinstance(targets[0], ast.Name):
+            name = targets[0].id
+            if _ends_or_returns(func_of.get(node), name):
+                continue
+            yield (call.lineno, call.col_offset, "span-leak",
+                   f"span `{name}` from open_span() has no `.end()` in "
+                   "a finally and is not returned: a leaked span never "
+                   "records and its children mis-parent — end it in a "
+                   "finally or use `with tracing.span(...)`")
+        else:
+            yield (call.lineno, call.col_offset, "span-leak",
+                   "open_span() result discarded or stored where no "
+                   "finally can end it — bind it to a local closed in "
+                   "a finally, or use `with tracing.span(...)`")
+
+
 RULES = {
     "host-sync": rule_host_sync,
     "block-sync": rule_block_sync,
@@ -900,6 +989,7 @@ RULES = {
     "fp-unstable-attr": rule_fp_unstable_attr,
     "unstable-program-key": rule_unstable_program_key,
     "mesh-program-key": rule_mesh_program_key,
+    "span-leak": rule_span_leak,
 }
 
 

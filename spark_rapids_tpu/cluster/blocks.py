@@ -263,13 +263,20 @@ def fetch_blocks(addr: Tuple[str, int], shuffle_id: str,
 
     from ..runtime.backoff import backoff_delays
     from ..runtime.faults import note_recovery
+    from ..profiler import tracing
     addr = tuple(addr)
     seed = hash((shuffle_id, pid)) & 0xFFFFFFFF
     delays = backoff_delays(max_retries, wait_ms, seed=seed)
     attempt = 0
     while True:
         try:
-            out = _fetch_once(addr, shuffle_id, map_ids, pid)
+            # the span covers the whole attempt — connect, server read,
+            # transfer, AND any injected block.fetch delay (fault
+            # harness), which is exactly how an injected slow fetch
+            # becomes the critical path's shuffle_fetch edge
+            with tracing.span("shuffle.fetch_blocks", "fetch",
+                              pid=pid, attempt=attempt):
+                out = _fetch_once(addr, shuffle_id, map_ids, pid)
             if attempt and stats is not None:
                 stats["fetch_recovered"] = \
                     stats.get("fetch_recovered", 0) + 1
@@ -280,13 +287,26 @@ def fetch_blocks(addr: Tuple[str, int], shuffle_id: str,
             d = delays[attempt]
             attempt += 1
             note_recovery("fetch_retries")
+            ent = None
             if stats is not None:
-                stats.setdefault("fetch_attempts", []).append(
-                    {"addr": list(addr), "pid": pid, "attempt": attempt,
-                     "delay_ms": round(d * 1e3, 3), "error": repr(e)})
+                # per-attempt timing (ts + the measured wait below)
+                # rides home with task metrics so the driver can
+                # reconstruct the retry WAIT TIMELINE, not just the
+                # stage-level fetchRetryMs sum
+                ent = {"addr": list(addr), "pid": pid,
+                       "attempt": attempt, "ts": round(_time.time(), 6),
+                       "delay_ms": round(d * 1e3, 3), "error": repr(e)}
+                stats.setdefault("fetch_attempts", []).append(ent)
                 stats["fetch_retry_ms"] = \
                     stats.get("fetch_retry_ms", 0.0) + d * 1e3
+            t0 = _time.perf_counter()
             _time.sleep(d)
+            waited_ms = (_time.perf_counter() - t0) * 1e3
+            if ent is not None:
+                ent["wait_ms"] = round(waited_ms, 3)
+            tracing.record_wait_span("shuffle.fetch_backoff", "backoff",
+                                     waited_ms, pid=pid,
+                                     attempt=attempt)
 
 
 def drop_shuffle(addr: Tuple[str, int], shuffle_id: str) -> bool:

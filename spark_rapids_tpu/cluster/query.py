@@ -31,12 +31,42 @@ the driver keys buckets by reduce-partition id.
 from __future__ import annotations
 
 import time
+from contextlib import contextmanager
 from typing import Callable, Dict, List, Optional, Sequence
 
 from .driver import ClusterManager
 from .rpc import ArrowResult
 
 __all__ = ["DistributedRunner", "map_fragment_task", "reduce_fragment_task"]
+
+
+@contextmanager
+def _task_trace(conf, name: str, **attrs):
+    """Executor-side task scope: adopt the trace context the driver
+    injected into this task frame's conf dict, run the task body under
+    a `task` span, and ship every span the task recorded home on the
+    task-metric side channel. Drained with close=False — later tasks of
+    the same query in this executor keep accumulating under the same
+    trace. No-op when the driver ran untraced."""
+    from ..profiler import tracing
+    tc = tracing.adopt_from_conf(conf)
+    if tc is None:
+        yield
+        return
+    sp = tracing.open_span(name, "task", tc, **attrs)
+    try:
+        with tracing.use(tracing.TraceContext(tc.trace_id, sp.span_id,
+                                              True)):
+            yield
+    finally:
+        sp.end()
+        try:
+            from .task_metrics import record_task_metrics
+            spans = tracing.drain_trace(tc.trace_id, close=False)
+            if spans:
+                record_task_metrics({"spans": spans})
+        except Exception:
+            pass
 
 
 def _record_fragment_profile(root, ctx, stage: str, **extra):
@@ -75,32 +105,33 @@ def map_fragment_task(map_fn, split, conf, n_reduce: int,
     import spark_rapids_tpu as st
     from ..exec.nodes import _batch_to_arrow
 
-    s = st.TpuSession(conf)
-    df = map_fn(s, split)
-    df = df.repartition(n_reduce, *part_keys)
-    root, ctx = df._execute()
-    pids: List[int] = []
-    tables = []
-    for pid in range(root.num_partitions(ctx)):
-        parts = [_batch_to_arrow(b)
-                 for b in root.execute_partition(ctx, pid)]
-        parts = [p for p in parts if p.num_rows]
-        if parts:
-            pids.append(pid)
-            tables.append(pa.concat_tables(parts))
-    _record_fragment_profile(root, ctx, "map", map_id=map_id)
-    if shuffle_id is None:
-        return ArrowResult({"pids": pids}, tables)
-    from . import blocks
-    from ..config import CLUSTER_BLOCK_ADVERTISE_HOST
-    addr = blocks.ensure_server(
-        s.conf.get(CLUSTER_BLOCK_ADVERTISE_HOST))
-    st_ = blocks.store()
-    sizes = {}
-    for pid, t in zip(pids, tables):
-        sizes[pid] = st_.put(shuffle_id, map_id, pid, t)
-    return {"pids": pids, "sizes": sizes, "addr": addr,
-            "map_id": map_id}
+    with _task_trace(conf, "task.map", map_id=map_id):
+        s = st.TpuSession(conf)
+        df = map_fn(s, split)
+        df = df.repartition(n_reduce, *part_keys)
+        root, ctx = df._execute()
+        pids: List[int] = []
+        tables = []
+        for pid in range(root.num_partitions(ctx)):
+            parts = [_batch_to_arrow(b)
+                     for b in root.execute_partition(ctx, pid)]
+            parts = [p for p in parts if p.num_rows]
+            if parts:
+                pids.append(pid)
+                tables.append(pa.concat_tables(parts))
+        _record_fragment_profile(root, ctx, "map", map_id=map_id)
+        if shuffle_id is None:
+            return ArrowResult({"pids": pids}, tables)
+        from . import blocks
+        from ..config import CLUSTER_BLOCK_ADVERTISE_HOST
+        addr = blocks.ensure_server(
+            s.conf.get(CLUSTER_BLOCK_ADVERTISE_HOST))
+        st_ = blocks.store()
+        sizes = {}
+        for pid, t in zip(pids, tables):
+            sizes[pid] = st_.put(shuffle_id, map_id, pid, t)
+        return {"pids": pids, "sizes": sizes, "addr": addr,
+                "map_id": map_id}
 
 
 def _run_reduce_fragment(reduce_fn, conf, tables, pid):
@@ -129,8 +160,9 @@ def reduce_fragment_task(reduce_fn, conf, tables):
     """Executor-side reduce stage: concatenate this bucket's shuffle
     blocks into a DataFrame, run the reduce fragment, return its result
     as one Arrow table."""
-    return ArrowResult({}, [_run_reduce_fragment(reduce_fn, conf,
-                                                 tables, None)])
+    with _task_trace(conf, "task.reduce"):
+        return ArrowResult({}, [_run_reduce_fragment(reduce_fn, conf,
+                                                     tables, None)])
 
 
 def reduce_fetch_task(reduce_fn, conf, shuffle_id: str, pid: int,
@@ -145,23 +177,25 @@ def reduce_fetch_task(reduce_fn, conf, shuffle_id: str, pid: int,
     tc = TpuConf(conf)
     max_retries = int(tc.get(FETCH_RETRY_MAX))
     wait_ms = float(tc.get(FETCH_RETRY_WAIT_MS))
-    tables = []
-    fetched_bytes = 0
-    fstats: dict = {}
-    for addr, map_ids in sources:
-        got = blocks.fetch_blocks(addr, shuffle_id, map_ids, pid,
-                                  max_retries=max_retries,
-                                  wait_ms=wait_ms, stats=fstats)
-        fetched_bytes += sum(t.nbytes for t in got)
-        tables.extend(got)
-    out = _run_reduce_fragment(reduce_fn, conf, tables, pid)
-    try:
-        from .task_metrics import record_task_metrics
-        record_task_metrics({"stage": "reduce", "reduce_pid": pid,
-                             "fetch_bytes": fetched_bytes, **fstats})
-    except Exception:
-        pass
-    return ArrowResult({}, [out])
+    with _task_trace(conf, "task.reduce", reduce_pid=pid):
+        tables = []
+        fetched_bytes = 0
+        fstats: dict = {}
+        for addr, map_ids in sources:
+            got = blocks.fetch_blocks(addr, shuffle_id, map_ids, pid,
+                                      max_retries=max_retries,
+                                      wait_ms=wait_ms, stats=fstats)
+            fetched_bytes += sum(t.nbytes for t in got)
+            tables.extend(got)
+        out = _run_reduce_fragment(reduce_fn, conf, tables, pid)
+        try:
+            from .task_metrics import record_task_metrics
+            record_task_metrics({"stage": "reduce", "reduce_pid": pid,
+                                 "fetch_bytes": fetched_bytes,
+                                 **fstats})
+        except Exception:
+            pass
+        return ArrowResult({}, [out])
 
 
 class DistributedRunner:
@@ -188,6 +222,15 @@ class DistributedRunner:
         accumulators (plan kept from the first task; op records
         concatenated for a later lore-keyed merge)."""
         for rec in getattr(fut, "task_metrics", None) or []:
+            spans = rec.pop("spans", None)
+            if spans:
+                # executor-side trace spans come home on the same side
+                # channel; re-buffer them under the query's trace so
+                # the close-out drain assembles ONE per-query trace
+                from ..profiler import tracing
+                tracing.absorb_spans(spans)
+                if not rec:
+                    continue
             acc = stages.setdefault(rec.get("stage") or "map", {
                 "plan": None, "ops": [], "tasks": 0, "wall_s": 0.0,
                 "watermarks": {}, "fetch_bytes": 0})
@@ -244,6 +287,7 @@ class DistributedRunner:
 
         from ..config import SHUFFLE_MAX_REGENERATIONS, TpuConf
         from ..profiler import event_log as EL
+        from ..profiler import tracing
         from ..runtime.faults import note_recovery
         from .blocks import FetchFailed, drop_shuffle
         from .driver import ExecutorLostError
@@ -261,6 +305,17 @@ class DistributedRunner:
         self.last_profile = {"query_id": qid, "stages": stages}
         t_query = time.perf_counter()
 
+        # one trace for the whole distributed query: driver stage spans
+        # parent the executor task spans (context rides the conf dict in
+        # every task frame; spans come home with task metrics)
+        tc = tracing.start_trace(qid, TpuConf(self.conf))
+        # tpulint: allow[span-leak] query root span: ended by tracing.finish() in run()'s trace close-out finally
+        rsp = (tracing.open_span("query", "query", tc,
+                                 action="distributed_run")
+               if tc is not None else None)
+        qtc = (tracing.TraceContext(qid, rsp.span_id, True)
+               if tc is not None else None)
+
         def emit(event, **kw):
             if w is not None:
                 w.emit(event, **kw)
@@ -270,9 +325,9 @@ class DistributedRunner:
             if token is not None:
                 token.check()
 
-        def submit_map(i):
+        def submit_map(i, cnf):
             return self.cm.submit(
-                map_fragment_task, map_fn, splits[i], self.conf,
+                map_fragment_task, map_fn, splits[i], cnf,
                 n_reduce, list(part_keys), shuffle_id, i, tag=qid)
 
         def run_maps(idxs, attempt=0):
@@ -282,27 +337,32 @@ class DistributedRunner:
             emit("stage_submit", stage="map", n_tasks=len(idxs),
                  attempt=attempt)
             t0 = time.perf_counter()
-            pending = [(i, submit_map(i)) for i in idxs]
-            out, tries = {}, {}
-            while pending:
-                i, f = pending.pop(0)
-                check()
-                try:
-                    out[i] = f.result()
-                except Exception as e:
-                    # idempotent map fragments: a TRANSIENT in-task
-                    # failure (injected fault, lost executor mid-run)
-                    # is resubmitted — possibly landing on another
-                    # executor — up to the task-retry budget
-                    tries[i] = tries.get(i, 0) + 1
-                    if not is_transient_error(e) \
-                            or tries[i] > MAX_TASK_RETRIES:
-                        raise
-                    emit("task_retry", stage="map", split=i,
-                         attempt=tries[i], error=repr(e))
-                    pending.append((i, submit_map(i)))
-                    continue
-                self._absorb(f, stages)
+            with tracing.span("stage.map", "stage", qtc,
+                              attempt=attempt, n_tasks=len(idxs)):
+                cnf = (tracing.inject_into_conf(self.conf,
+                                                tracing.current())
+                       if qtc is not None else self.conf)
+                pending = [(i, submit_map(i, cnf)) for i in idxs]
+                out, tries = {}, {}
+                while pending:
+                    i, f = pending.pop(0)
+                    check()
+                    try:
+                        out[i] = f.result()
+                    except Exception as e:
+                        # idempotent map fragments: a TRANSIENT in-task
+                        # failure (injected fault, lost executor
+                        # mid-run) is resubmitted — possibly landing on
+                        # another executor — up to the task-retry budget
+                        tries[i] = tries.get(i, 0) + 1
+                        if not is_transient_error(e) \
+                                or tries[i] > MAX_TASK_RETRIES:
+                            raise
+                        emit("task_retry", stage="map", split=i,
+                             attempt=tries[i], error=repr(e))
+                        pending.append((i, submit_map(i, cnf)))
+                        continue
+                    self._absorb(f, stages)
             wall = time.perf_counter() - t0
             stages.setdefault("map", {}).setdefault("wall_s", 0.0)
             stages["map"]["wall_s"] = stages["map"].get("wall_s",
@@ -334,63 +394,68 @@ class DistributedRunner:
                     all_pids = sorted({p for m2 in metas.values()
                                        for p in m2["pids"]})
                     t0 = time.perf_counter()
-                    rfuts = []
-                    for pid in all_pids:
-                        if pid in done:      # keep completed partitions
-                            continue
-                        by_addr: Dict[tuple, List[int]] = {}
-                        for i, m2 in metas.items():
-                            if pid in m2["pids"]:
-                                by_addr.setdefault(
-                                    tuple(m2["addr"]),
-                                    []).append(m2["map_id"])
-                        sources = [(list(a), ids)
-                                   for a, ids in sorted(by_addr.items())]
-                        rfuts.append((pid, self.cm.submit(
-                            reduce_fetch_task, reduce_fn, self.conf,
-                            shuffle_id, pid, sources, tag=qid)))
-                    emit("stage_submit", stage="reduce",
-                         n_tasks=len(rfuts), attempt=attempt)
-                    refetch = set()
-                    retry_only = False
-                    for pid, f in rfuts:
-                        check()
-                        try:
-                            done[pid] = f.result().tables[0]
-                            self._absorb(f, stages)
-                        except (FetchFailed, ExecutorLostError) as e:
-                            emit("fetch_retry", stage="reduce", pid=pid,
-                                 shuffle_id=shuffle_id,
-                                 addr=list(e.addr)
-                                 if getattr(e, "addr", None) else None,
-                                 attempt=attempt, error=repr(e))
-                            if attempt >= max_regen:
-                                raise
-                            # lineage: re-execute the map splits of the
-                            # FAILED mapper, identified by the typed
-                            # exception's structured addr (idempotent
-                            # fragments); an addr-less failure — or an
-                            # executor lost outright — re-executes
-                            # everything still unreduced
-                            dead = set()
-                            addr = getattr(e, "addr", None)
-                            if addr is not None:
-                                dead = {i for i, m2 in metas.items()
-                                        if tuple(m2["addr"]) == addr}
-                            refetch |= dead or set(metas)
-                        except Exception as e:
-                            # TRANSIENT in-task reduce failure (injected
-                            # fault): the shuffle blocks are still
-                            # parked, so retry JUST this partition next
-                            # round — no map regeneration needed
-                            from ..runtime.faults import \
-                                is_transient_error
-                            if not is_transient_error(e) \
-                                    or attempt >= max_regen:
-                                raise
-                            emit("task_retry", stage="reduce", pid=pid,
-                                 attempt=attempt, error=repr(e))
-                            retry_only = True
+                    with tracing.span("stage.reduce", "stage", qtc,
+                                      attempt=attempt):
+                        rcnf = (tracing.inject_into_conf(
+                            self.conf, tracing.current())
+                            if qtc is not None else self.conf)
+                        rfuts = []
+                        for pid in all_pids:
+                          if pid in done:      # keep completed partitions
+                              continue
+                          by_addr: Dict[tuple, List[int]] = {}
+                          for i, m2 in metas.items():
+                              if pid in m2["pids"]:
+                                  by_addr.setdefault(
+                                      tuple(m2["addr"]),
+                                      []).append(m2["map_id"])
+                          sources = [(list(a), ids)
+                                     for a, ids in sorted(by_addr.items())]
+                          rfuts.append((pid, self.cm.submit(
+                              reduce_fetch_task, reduce_fn, rcnf,
+                              shuffle_id, pid, sources, tag=qid)))
+                        emit("stage_submit", stage="reduce",
+                             n_tasks=len(rfuts), attempt=attempt)
+                        refetch = set()
+                        retry_only = False
+                        for pid, f in rfuts:
+                          check()
+                          try:
+                              done[pid] = f.result().tables[0]
+                              self._absorb(f, stages)
+                          except (FetchFailed, ExecutorLostError) as e:
+                              emit("fetch_retry", stage="reduce", pid=pid,
+                                   shuffle_id=shuffle_id,
+                                   addr=list(e.addr)
+                                   if getattr(e, "addr", None) else None,
+                                   attempt=attempt, error=repr(e))
+                              if attempt >= max_regen:
+                                  raise
+                              # lineage: re-execute the map splits of the
+                              # FAILED mapper, identified by the typed
+                              # exception's structured addr (idempotent
+                              # fragments); an addr-less failure — or an
+                              # executor lost outright — re-executes
+                              # everything still unreduced
+                              dead = set()
+                              addr = getattr(e, "addr", None)
+                              if addr is not None:
+                                  dead = {i for i, m2 in metas.items()
+                                          if tuple(m2["addr"]) == addr}
+                              refetch |= dead or set(metas)
+                          except Exception as e:
+                              # TRANSIENT in-task reduce failure (injected
+                              # fault): the shuffle blocks are still
+                              # parked, so retry JUST this partition next
+                              # round — no map regeneration needed
+                              from ..runtime.faults import \
+                                  is_transient_error
+                              if not is_transient_error(e) \
+                                      or attempt >= max_regen:
+                                  raise
+                              emit("task_retry", stage="reduce", pid=pid,
+                                   attempt=attempt, error=repr(e))
+                              retry_only = True
                     # executor-side transport retries that SUCCEEDED
                     # ride back in task metrics: surface each attempt
                     # as its own driver-log event
@@ -448,6 +513,23 @@ class DistributedRunner:
                      ops=list(acc["ops"].values()))
                 if acc.get("watermarks"):
                     emit("watermarks", stage=name, **acc["watermarks"])
+            # close out the trace: end the root span, drain the
+            # assembled driver+executor spans into trace_span records
+            # and reduce them to critical-path shares
+            if rsp is not None:
+                try:
+                    import types
+                    shim = types.SimpleNamespace(trace=tc,
+                                                 _root_span=rsp)
+                    for s2 in tracing.finish(
+                            shim, time.perf_counter() - t_query):
+                        emit("trace_span", **s2)
+                    summ = getattr(shim, "trace_summary", None)
+                    if summ is not None:
+                        self.last_profile["trace_summary"] = summ
+                        emit("trace_summary", **summ)
+                except Exception:
+                    pass
             end = {"status": status,
                    "wall_s": round(time.perf_counter() - t_query, 6)}
             if err is not None:
@@ -464,6 +546,13 @@ class DistributedRunner:
         from ..profiler.analyze import render_analyze
         prof = self.last_profile or {}
         parts = []
+        summ = prof.get("trace_summary")
+        if summ:
+            tops = ", ".join(
+                f"{c}:{p:.0f}%"
+                for c, p in sorted(summ["share_pct"].items(),
+                                   key=lambda kv: -kv[1]) if p >= 1.0)
+            parts.append(f"criticalPath={summ['dominant']} [{tops}]")
         for name in ("map", "reduce"):
             acc = (prof.get("stages") or {}).get(name)
             if not acc or not acc.get("plan"):

@@ -361,6 +361,31 @@ EVENT_LOG_ENABLED = _conf(
 EVENT_LOG_DIR = _conf(
     "sql.eventLog.dir", "/tmp/srtpu-events",
     "Directory for per-query event-log JSONL files.", str)
+TRACE_ENABLED = _conf(
+    "sql.trace.enabled", True,
+    "Open per-query spans (profiler/tracing.py) around queue wait, "
+    "planning, AQE stage decisions, compiles, pool map tasks, shuffle "
+    "fetches, spills, collective launches and retry/degrade recovery. "
+    "Spans assemble into one trace per query — written to the event "
+    "log as trace_span records (when sql.eventLog.enabled) and reduced "
+    "to critical-path latency shares (profiler/critical_path.py) shown "
+    "in EXPLAIN ANALYZE root annotations and profile_report --trace. "
+    "Overhead is gated <3% on the q6 A/B (tests/test_tracing.py).",
+    bool)
+TRACE_SAMPLE_RATE = _conf(
+    "sql.trace.sampleRate", 1.0,
+    "Fraction of queries traced (0.0-1.0). Sampling is deterministic "
+    "on the query id (crc32 bucket), so a query's driver threads, "
+    "pool workers and executor fragments always agree on the decision "
+    "and retries of the same query id re-sample identically.", float)
+TELEMETRY_ENABLED = _conf(
+    "sql.telemetry.enabled", True,
+    "Expose the process-global telemetry registry (profiler/"
+    "telemetry.py: latency/queue-wait histograms, admission and cache "
+    "counters, pool-saturation and memory-watermark gauges) through "
+    "the service gateway's `metrics` verb and its Prometheus text "
+    "dump. Recording itself is always-on and O(1) per observation; "
+    "this gates the scrape surface.", bool)
 MULTITHREADED_READ_THREADS = _conf(
     "sql.format.parquet.multiThreadedRead.numThreads", 4,
     "Thread pool for the multithreaded (cloud) parquet reader "

@@ -46,6 +46,11 @@ class ExecContext:
         self.cancel = None
         self.query_id: Optional[str] = None
         self.sem_priority = 0
+        # distributed-tracing context (profiler/tracing.py): set by the
+        # session/runner once the query id is known; None when tracing
+        # is off or this query sampled out. Operators open spans with
+        # `tracing.span(name, kind, ctx)` — one attribute read when off
+        self.trace = None
         # SharedBuildExec's per-run materialization cache:
         # {id(node): {pid: [spill handles]}} — closed by close()
         self.shared_handles: Dict[int, dict] = {}

@@ -102,8 +102,19 @@ class BroadcastExchangeExec(TpuExec):
             if self._future is None:
                 # tpulint: allow[fp-unstable-attr] runtime timing capture, not plan identity
                 self._submit_t = time.perf_counter()
-                self._future = _build_pool().submit(self._materialize,
-                                                    ctx)
+                from ..profiler import tracing
+                tc = getattr(ctx, "trace", None) or tracing.current()
+
+                def _build_task():
+                    # build runs on a tpu-bcast-build thread: seed it
+                    # with the submitting query's trace context
+                    ctx.check_cancel()
+                    with tracing.use(tc), \
+                            tracing.span("broadcast.build",
+                                         "pool_task"):
+                        return self._materialize(ctx)
+
+                self._future = _build_pool().submit(_build_task)
             return self._future
 
     def await_build(self, ctx: ExecContext,
@@ -122,10 +133,24 @@ class BroadcastExchangeExec(TpuExec):
         # witness proves it stays that way)
         lockdep.check_pool_wait(BUILD_POOL_PREFIX)
         t_await = time.perf_counter()
+
+        def _note_wait():
+            # the time the JOIN was blocked on the async build — a
+            # pool_wait edge on the critical path (the overlap portion
+            # below is free and earns no span)
+            waited = time.perf_counter() - t_await
+            if waited > 1e-3:
+                from ..profiler import tracing
+                tracing.record_wait_span("broadcast.await_build",
+                                         "pool_wait", waited * 1e3,
+                                         ctx)
+
         try:
             batches = fut.result(timeout_secs if timeout_secs
                                  and timeout_secs > 0 else None)
+            _note_wait()
         except cf.TimeoutError:
+            _note_wait()
             m.add("broadcastTimeoutFallbacks", 1)
             fut.cancel()  # not-yet-started futures build fresh below
             batches = self._materialize(ctx)

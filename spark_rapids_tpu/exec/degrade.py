@@ -58,6 +58,13 @@ def should_degrade(ctx, node, e: BaseException) -> bool:
         ctx.pending_events.append({
             "event": "degrade_to_host", "op": type(node).__name__,
             "op_id": op_id, "failures": n, "error": repr(e)})
+        # zero-length marker span: the DECISION is instant, the cost
+        # (host re-execution) shows up as compute — but the trace must
+        # say the query crossed onto the recovery path
+        from ..profiler import tracing
+        with tracing.span("degrade.to_host", "degrade", ctx,
+                          op=type(node).__name__, failures=n):
+            pass
     return True
 
 

@@ -235,10 +235,23 @@ class ShuffleExchangeExec(TpuExec):
                         sem, priority=getattr(ctx, "sem_priority", 0),
                         token=ctx.cancel)
                     stop = threading.Event()
+                    from ..profiler import tracing
+                    _tc = tracing.current()
+
+                    def _map_task(mpid, rider, stop):
+                        # seed the worker with the submitting query's
+                        # trace context: pool_wait/compile spans opened
+                        # inside parent under this map-task span
+                        ctx.check_cancel()
+                        with tracing.use(_tc), \
+                                tracing.span("exchange.map",
+                                             "pool_task", mpid=mpid):
+                            map_partition(mpid, rider, stop)
+
                     with cf.ThreadPoolExecutor(
                             threads,
                             thread_name_prefix="tpu-exch-map") as pool:
-                        futs = [pool.submit(map_partition, mpid, rider,
+                        futs = [pool.submit(_map_task, mpid, rider,
                                             stop)
                                 for mpid in range(nparts)]
                         try:

@@ -99,6 +99,13 @@ class PermitRider:
             waited = time.perf_counter() - t0
             with self._lock:
                 self._waited += waited
+            if waited > 1e-3:
+                # admission wait that actually stalled this map step:
+                # back-dated pool_wait span in the query's trace (the
+                # worker thread was seeded via tracing.use)
+                from ..profiler import tracing
+                tracing.record_wait_span("exchange.pool_admission",
+                                         "pool_wait", waited * 1e3)
             return waited
 
         from ..runtime import ledger, lockdep

@@ -275,17 +275,25 @@ class SpmdStageExec(TpuExec):
                 self._out = [[] for _ in range(self.exchange.n)]
                 return
             try:
-                if faults.ACTIVE:
-                    # the live stage-launch fault point (bg=0); the
-                    # prewarm path hits with background=True
-                    faults.hit("mesh.collective", query_id=ctx.query_id,
-                               op=type(self).__name__, background=False)
-                self._run_fused(ctx, m)
+                from ..profiler import tracing
+                with tracing.span("spmd.collective", "collective", ctx,
+                                  bytes=self._staged_bytes):
+                    if faults.ACTIVE:
+                        # the live stage-launch fault point (bg=0); the
+                        # prewarm path hits with background=True
+                        faults.hit("mesh.collective",
+                                   query_id=ctx.query_id,
+                                   op=type(self).__name__,
+                                   background=False)
+                    self._run_fused(ctx, m)
             except BaseException as e:
                 if faults.is_transient_error(e):
                     # recovery contract: the stage falls back to the
                     # round-based exchange over the SAME staged handles
-                    self._degrade(ctx, type(e).__name__)
+                    from ..profiler import tracing
+                    with tracing.span("spmd.degrade", "degrade", ctx,
+                                      reason=type(e).__name__):
+                        self._degrade(ctx, type(e).__name__)
                     faults.note_recovery("degradations")
                     return
                 raise

@@ -113,8 +113,11 @@ class SpillableBatchHandle:
             "cols": [c.device_buffers() for c in b.table.columns],
             "mask": b.row_mask,
         }
-        # tpulint: allow[sync-under-lock] spill D2H must run under the store lock: the handle's state machine (DEVICE->HOST) and the pressure sweep that chose this victim both key off it; audited PR 10, no waiter can need the device result
-        self._host = fetch(tree)
+        from ..profiler import tracing
+        with tracing.span("spill.to_host", "spill_write", tier="host",
+                          bytes=self.nbytes):
+            # tpulint: allow[sync-under-lock] spill D2H must run under the store lock: the handle's state machine (DEVICE->HOST) and the pressure sweep that chose this victim both key off it; audited PR 10, no waiter can need the device result
+            self._host = fetch(tree)
         self._meta = (b.table.schema, list(b.table.names), b.num_rows,
                       b.capacity)
         self._batch = None
@@ -143,8 +146,11 @@ class SpillableBatchHandle:
             flatten_bufs(bufs, f"c{i}_", flat)
         # tpulint: allow[host-sync] _host tier is already on the host
         flat["mask"] = np.asarray(self._host["mask"])
-        _write_spill_file(path, flat,
-                          getattr(self.store, "staging", None))
+        from ..profiler import tracing
+        with tracing.span("spill.to_disk", "spill_write", tier="disk",
+                          bytes=self.nbytes):
+            _write_spill_file(path, flat,
+                              getattr(self.store, "staging", None))
         self._disk_path = path
         self._host = None
         self.state = DISK
@@ -156,6 +162,12 @@ class SpillableBatchHandle:
         # pin first: the reserve() below may fire the spill hook, which
         # must not demote the handle being promoted (re-entrancy guard)
         self.pin()
+        from ..profiler import tracing
+        sp = (tracing.open_span("spill.materialize", "spill_read",
+                                tier=("disk" if self.state == DISK
+                                      else "host"),
+                                bytes=self.nbytes)
+              if self.state != DEVICE else None)
         try:
             if self.state == DEVICE:
                 return self._batch
@@ -184,6 +196,8 @@ class SpillableBatchHandle:
             self.state = DEVICE
             return batch
         finally:
+            if sp is not None:
+                sp.end()
             self.unpin()
 
     def pin(self):

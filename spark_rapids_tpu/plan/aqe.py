@@ -112,7 +112,9 @@ def run_stage_driver(root, ctx, conf) -> List[Dict[str, Any]]:
             # mesh analog of partition coalescing: exact staged bytes
             # shrink the active mesh axis for small stages (the
             # decision logic lives on the stage, which owns the stats)
-            d = node.plan_reshard(ctx, conf)
+            from ..profiler import tracing
+            with tracing.span("aqe.reshard", "aqe", ctx):
+                d = node.plan_reshard(ctx, conf)
             if d is not None:
                 decisions.append(d)
                 if not getattr(node, "_reshard_counted", False):
@@ -120,7 +122,10 @@ def run_stage_driver(root, ctx, conf) -> List[Dict[str, Any]]:
                     _bump("mesh_reshards")
         if isinstance(node, AQEShuffleReadExec):
             # stage barrier: materialize (exchange pool) + replan
-            node.plan.groups(ctx)
+            from ..profiler import tracing
+            with tracing.span("aqe.stage_materialize", "aqe", ctx,
+                              lore_id=getattr(node, "lore_id", None)):
+                node.plan.groups(ctx)
             d = node.plan.decision
             if d is not None and id(node.plan) not in seen_plans:
                 seen_plans.add(id(node.plan))
@@ -173,7 +178,9 @@ def _maybe_demote(join, ctx, conf, decisions, lore_alloc, root) -> None:
     # stage barrier: the build map phase materializes NOW (under the
     # exchange's own lock, via the exchange pool) and reports exact
     # serialized bytes — the runtime stat the planning estimate missed
-    build_bytes = int(sum(bex.stage_stats(ctx)))
+    from ..profiler import tracing
+    with tracing.span("aqe.demote_build_materialize", "aqe", ctx):
+        build_bytes = int(sum(bex.stage_stats(ctx)))
     if build_bytes > thr:
         return
     from ..exec.broadcast import BroadcastExchangeExec
@@ -227,7 +234,9 @@ def _maybe_demote_mesh(join, ctx, conf, decisions, lore_alloc,
     ctx.check_cancel()
     # stage barrier: the build map phase drains into spill handles NOW
     # and reports exact device bytes (the mesh MapOutputStatistics)
-    build_bytes = int(build.stage_bytes(ctx))
+    from ..profiler import tracing
+    with tracing.span("aqe.demote_mesh_materialize", "aqe", ctx):
+        build_bytes = int(build.stage_bytes(ctx))
     if build_bytes > thr:
         return
     from ..exec.broadcast import BroadcastExchangeExec

@@ -163,6 +163,20 @@ def render_analyze(tree: dict, metrics_by_lore: Dict[Optional[int], dict],
         if m.get("semaphoreAcquires") is not None:
             ann.append(
                 f"semaphoreAcquires={int(m['semaphoreAcquires'])}")
+        # critical-path attribution (root node): where the END-TO-END
+        # wall clock went, reduced from the query's trace
+        # (profiler/critical_path.py) — dominant edge plus every share
+        # above the noise floor
+        cps = {k.split(".", 1)[1]: float(v) for k, v in m.items()
+               if k.startswith("criticalPathShare.")}
+        if cps:
+            from .critical_path import dominant_of_pct
+            dom = dominant_of_pct(cps)
+            tops = ", ".join(
+                f"{c}:{cps[c]:.0f}%" for c in sorted(
+                    cps, key=cps.get, reverse=True)
+                if cps[c] >= 1.0)
+            ann.append(f"criticalPath={dom} [{tops}]")
         # resource ledger (root node, when SRTPU_LEDGER/conf enabled):
         # staging-lease traffic this action + the global balance sample
         if m.get("ledgerBalanced") is not None:

@@ -41,6 +41,13 @@ typed events the profiling tool post-processes:
                 (resource-lifetime ledger, runtime/ledger.py, when
                  enabled — per-kind acquire/release counters and the
                  per-query balance verdicts)
+  trace_span    {trace_id, span_id, parent_id, name, kind, start_ns,
+                 end_ns, dur_ms, proc, attrs?}  (distributed tracing,
+                 profiler/tracing.py — the query's assembled spans,
+                 driver + pools + executors, one trace per query)
+  trace_summary {total_ms, shares, share_pct, dominant, dominant_pct,
+                 span_count}  (critical-path decomposition,
+                 profiler/critical_path.py)
   query_end     {status: ok|error|cancelled|timeout, wall_s, error?}
 
 Locally `session.py` wraps every action (`profile_query`); the
@@ -100,13 +107,25 @@ class EventLogWriter:
         with self._lock:
             if self._f is None:
                 return
-            self._f.write(line + "\n")
-            self._f.flush()
+            try:
+                self._f.write(line + "\n")
+                self._f.flush()
+            except OSError:
+                # a full/yanked log volume must not fail the query; a
+                # torn line is fine — the reader skips it
+                f, self._f = self._f, None
+                try:
+                    f.close()
+                except OSError:
+                    pass
 
     def close(self):
         with self._lock:
             if self._f is not None:
-                self._f.close()
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
                 self._f = None
 
 
@@ -331,8 +350,24 @@ def profile_query(session, root, ctx, action: str, handle=None):
                        - rc0["result_cache_invalidations"],
                        entries=rc1["result_cache_entries"],
                        bytes=rc1["result_cache_bytes"])
-            end = {"status": status,
-                   "wall_s": round(time.perf_counter() - t0, 6)}
+            wall = time.perf_counter() - t0
+            # distributed-tracing assembly: end the root span, drain
+            # every span the query recorded (driver threads, pool
+            # workers, executor-side spans absorbed from the
+            # task-metric side channel) and reduce them to the
+            # critical-path summary. Failure paths included — a trace
+            # of a failed query is exactly when attribution matters.
+            try:
+                from . import tracing
+                spans = tracing.finish(ctx, wall)
+                for s in spans:
+                    w.emit("trace_span", **s)
+                summ = getattr(ctx, "trace_summary", None)
+                if spans and summ is not None:
+                    w.emit("trace_summary", **summ)
+            except Exception:
+                pass
+            end = {"status": status, "wall_s": round(wall, 6)}
             if err is not None:
                 end["error"] = err
             w.emit("query_end", **end)

@@ -52,14 +52,18 @@ __all__ = ["CompilePool", "get_pool", "current_pool", "shutdown_pool",
 
 class _Task:
     __slots__ = ("prog", "args_thunk", "speculative", "query_id",
-                 "cancelled")
+                 "cancelled", "trace")
 
-    def __init__(self, prog, args_thunk, speculative, query_id):
+    def __init__(self, prog, args_thunk, speculative, query_id,
+                 trace=None):
         self.prog = prog
         self.args_thunk = args_thunk    # () -> example args (built lazily
         self.speculative = speculative  # on the worker, not the submitter)
         self.query_id = query_id
         self.cancelled = False
+        # submitter's TraceContext: background compiles show up in the
+        # submitting query's trace (profiler/tracing.py)
+        self.trace = trace
 
 
 class CompilePool:
@@ -106,7 +110,9 @@ class CompilePool:
                query_id: Optional[str] = None) -> bool:
         """Enqueue one prewarm. Never blocks: a full queue drops the
         task (the sync path compiles it later; counted dropped_full)."""
-        task = _Task(prog, args_thunk, speculative, query_id)
+        from ..profiler import tracing
+        task = _Task(prog, args_thunk, speculative, query_id,
+                     trace=tracing.current())
         with self._cv:
             if self._stop or len(self._queue) >= self._queue_cap:
                 self.stats["dropped_full"] += 1
@@ -181,12 +187,18 @@ class CompilePool:
                 if args is None:
                     with self._cv:
                         self.stats["already_warm"] += 1
-                elif task.prog.prewarm(args):
-                    with self._cv:
-                        self.stats["compiled"] += 1
                 else:
+                    # the span lands in the SUBMITTING query's trace
+                    # (task.trace rode along from submit); no-op when
+                    # that query ran untraced
+                    from ..profiler import tracing
+                    with tracing.span("xla.prewarm", "compile",
+                                      task.trace, bg=1) as sp:
+                        compiled = task.prog.prewarm(args)
+                        sp.set("compiled", bool(compiled))
                     with self._cv:
-                        self.stats["already_warm"] += 1
+                        self.stats["compiled" if compiled
+                                   else "already_warm"] += 1
             except Exception:
                 # swallowed by contract: background compilation must
                 # never fail a query (the sync path recompiles);

@@ -602,8 +602,15 @@ class CachedProgram:
         # recorded BEFORE the call: donated arg buffers are dead after.
         _note_observed(key, self._base_key, self._donate, self._static,
                        args)
+        from ..profiler import tracing
         t0 = _time.perf_counter()
-        out = prog(*args)
+        # sync compile ON the dispatch path: exactly the latency the
+        # critical path must blame on 'compile' (thread-local context —
+        # the query thread runs under tracing.use)
+        with tracing.span("xla.compile", "compile",
+                          op=self._base_key[0] if self._base_key
+                          else None):
+            out = prog(*args)
         _note_compile(self._base_key,
                       (_time.perf_counter() - t0) * 1e3, "sync")
         return out

@@ -221,6 +221,16 @@ class QueryManager:
         self.stats = {"submitted": 0, "admitted": 0, "finished": 0,
                       "failed": 0, "cancelled": 0, "timed_out": 0,
                       "queued_peak": 0, "cache_fast_path": 0}
+        # live-telemetry pull gauges: sampled at scrape time, so the
+        # admission path itself carries zero instrumentation cost
+        try:
+            from ..profiler import telemetry
+            telemetry.register_gauge_fn(
+                "service",
+                lambda: {"running": self._running,
+                         "queued": self.scheduler.queued_count()})
+        except Exception:
+            pass
 
     # -- submission -----------------------------------------------------
     def _new_handle(self, plan=None, conf=None, action: str = "",
@@ -363,6 +373,23 @@ class QueryManager:
             self._queries.pop(h.query_id, None)
             self._pump_locked()
             self._cond.notify_all()
+        # live telemetry: latency by terminal state + queue wait (the
+        # event log is per-query and post-hoc; the registry is what the
+        # gateway's `metrics` verb scrapes while the service runs)
+        try:
+            from ..config import TELEMETRY_ENABLED
+            if self.conf.get(TELEMETRY_ENABLED):
+                from ..profiler import telemetry
+                st_ = h.state.lower()
+                telemetry.counter(f"queries_{st_}").inc()
+                telemetry.histogram("queue_wait_ms").observe(
+                    h.queue_wait_ms)
+                if h.finished_at is not None:
+                    telemetry.histogram(
+                        f"query_latency_ms_{st_}").observe(
+                        (h.finished_at - h.submitted_at) * 1e3)
+        except Exception:
+            pass
         # drop the query's memory-attribution record (bounded bookkeeping)
         try:
             from ..memory.diagnostics import reset_query_attribution

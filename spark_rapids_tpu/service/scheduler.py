@@ -127,9 +127,17 @@ class FairScheduler:
             return True
         dev, host = h.estimate
         dev_limit, host_limit = self._limits()
-        if dev_limit > 0 and self._admitted_dev + int(dev) > dev_limit:
-            return False
-        if host_limit > 0 and self._admitted_host + int(host) > host_limit:
+        if (dev_limit > 0 and self._admitted_dev + int(dev) > dev_limit) \
+                or (host_limit > 0
+                    and self._admitted_host + int(host) > host_limit):
+            # admission deferred on the memory budget: the query stays
+            # queued; the counter tells a scraper the service is
+            # memory-bound rather than slot-bound
+            try:
+                from ..profiler import telemetry
+                telemetry.counter("admission_rejections").inc()
+            except Exception:
+                pass
             return False
         return True
 

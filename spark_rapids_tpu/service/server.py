@@ -16,6 +16,14 @@ Wire protocol (see docs/service.md):
         -> {"ok": true, "columns": {...}, "num_rows": N, "last": false}
     {"op": "cancel", "query_id": "..."}  -> {"ok": true, "cancelled": true}
     {"op": "ping"}                       -> {"ok": true}
+    {"op": "metrics"}                    -> {"ok": true, "metrics": {...}}
+    {"op": "metrics", "format": "prometheus"} -> {"ok": true, "text": "..."}
+
+The `metrics` verb scrapes the live telemetry registry
+(profiler/telemetry.py): process-wide counters, pull gauges and
+log-bucket latency histograms (p50/p95/p99), readable WHILE queries
+run — the surface a fleet router polls. `format: "prometheus"` returns
+the standard text exposition instead of JSON.
 
 Result pages are COLUMNAR ({name: [values...]}) — the arrow batches a
 Thrift client would receive, JSON-encoded for transport neutrality.
@@ -134,7 +142,19 @@ class QueryServer:
             return self._fetch(req)
         if op == "cancel":
             return self._cancel(req)
+        if op == "metrics":
+            return self._metrics(req)
         return {"ok": False, "error": f"unknown op: {op!r}"}
+
+    def _metrics(self, req: dict) -> dict:
+        from ..config import TELEMETRY_ENABLED
+        if not self.session.conf.get(TELEMETRY_ENABLED):
+            return {"ok": False, "error": "telemetry disabled "
+                    "(spark.rapids.tpu.sql.telemetry.enabled=false)"}
+        from ..profiler import telemetry
+        if req.get("format") == "prometheus":
+            return {"ok": True, "text": telemetry.render_prometheus()}
+        return {"ok": True, "metrics": telemetry.snapshot()}
 
     def _submit(self, req: dict) -> dict:
         df = self.session.sql(req["sql"])

@@ -930,16 +930,42 @@ class DataFrame:
         cancellation — the physical plan is released deterministically
         (exchange handles, spill files, parked device buffers) instead
         of waiting for GC."""
-        from .profiler import xla_stats
+        from .profiler import tracing, xla_stats
         from .profiler.event_log import profile_query
         from .service.query_manager import _query_scope
-        root, ctx = self._execute(conf)
+        # distributed tracing: one trace per query (trace_id ==
+        # query_id). A nested action joins the enclosing query's trace
+        # (the outer action installed its context on this thread);
+        # otherwise the sampling decision is taken here, before
+        # planning, so the plan span is part of the trace.
+        tc = tracing.current() if nested else (
+            tracing.start_trace(handle.query_id, conf)
+            if handle is not None else None)
+        rsp = None
+        if tc is not None and not nested:
+            # open the root span BEFORE planning so the plan span and
+            # the back-dated admission wait parent under it — the trace
+            # is one rooted tree, not a forest of top-level siblings
+            # tpulint: allow[span-leak] query root span: ended by tracing.finish() in this action's finally (idempotent close-out)
+            rsp = tracing.open_span("query", "query", tc, action=action)
+            tc = tracing.TraceContext(tc.trace_id, rsp.span_id, True)
+            if handle is not None:
+                tracing.record_queue_span(tc, handle.queue_wait_ms,
+                                          pool=handle.pool)
+        if tc is not None:
+            with tracing.span("plan", "plan", tc):
+                root, ctx = self._execute(conf)
+        else:
+            root, ctx = self._execute(conf)
+        ctx.trace = tc
         if handle is not None:
             ctx.cancel = handle.token
             ctx.query_id = handle.query_id
             mgr = getattr(self._session, "_query_manager", None)
             if mgr is not None:
                 ctx.sem_priority = mgr.scheduler.priority_of(handle)
+        if rsp is not None:
+            ctx._root_span = rsp
         # stage-ahead compilation: submit this tree's programs whose
         # signatures were observed before (earlier query or warm-pack
         # seed) to the background pool; downstream stage programs
@@ -950,8 +976,11 @@ class DataFrame:
         if _cpool is not None:
             from .exec.base import prewarm_tree
             try:
-                prewarm_tree(root, _cpool,
-                             handle.query_id if handle else None)
+                # under use(): the pool snapshots the submitter's trace
+                # context so background compiles land in this trace
+                with tracing.use(ctx.trace):
+                    prewarm_tree(root, _cpool,
+                                 handle.query_id if handle else None)
             except Exception:
                 pass
         sem = getattr(self._session, "_semaphore", None)
@@ -967,7 +996,8 @@ class DataFrame:
         rc_on = result_cache.enabled(conf)
         rc0 = result_cache.stats() if rc_on else None
         try:
-            with _query_scope(handle.query_id if handle else "?"):
+            with _query_scope(handle.query_id if handle else "?"), \
+                    tracing.use(ctx.trace):
                 with profile_query(self._session, root, ctx, action,
                                    handle=None if nested else handle) as w:
                     if retry_of and w is not None:
@@ -1043,6 +1073,14 @@ class DataFrame:
         finally:
             if not nested:
                 _ACTION_TLS.handle = None
+                # event-log-off fallback: the profiler wrapper normally
+                # drains the trace (and emits trace_span records);
+                # without it the trace must still close so EXPLAIN
+                # ANALYZE gets its summary and the buffers drain
+                try:
+                    tracing.finish(ctx)
+                except Exception:
+                    pass
         # per-query XLA accounting rides the root node's MetricSet so it
         # flows into last_metrics() / EXPLAIN ANALYZE / op_metrics events
         xla1 = xla_stats.snapshot()
@@ -1070,6 +1108,14 @@ class DataFrame:
             rm.add("backgroundCompiles", bg)
         if handle is not None and not nested:
             rm.add("queueWaitMs", round(handle.queue_wait_ms, 3))
+        # critical-path decomposition of this action's wall clock
+        # (profiler/critical_path.py): per-edge percentage shares ride
+        # the root MetricSet so EXPLAIN ANALYZE prints criticalPath=
+        summ = getattr(ctx, "trace_summary", None)
+        if summ:
+            for c, pct in summ["share_pct"].items():
+                if pct:
+                    rm.add(f"criticalPathShare.{c}", pct)
         if rc_on:
             # per-action cache accounting on the root MetricSet (flows
             # into EXPLAIN ANALYZE / op_metrics); global-counter diffs,
@@ -1239,9 +1285,24 @@ class DataFrame:
         root, ctx = self._execute(conf)
         ctx.cancel = handle.token
         ctx.query_id = handle.query_id
+        from .profiler import tracing
+        tc = tracing.current() if outer is not None else \
+            tracing.start_trace(handle.query_id, conf)
+        ctx.trace = tc
+        if tc is not None and outer is None:
+            # root first, so the back-dated admission wait parents
+            # under it (same rooted-tree shape as _execute_action)
+            # tpulint: allow[span-leak] query root span: ended by tracing.finish() in the write path's finally
+            rsp = tracing.open_span("query", "query", tc, action="write")
+            ctx._root_span = rsp
+            ctx.trace = tracing.TraceContext(tc.trace_id, rsp.span_id,
+                                             True)
+            tracing.record_queue_span(ctx.trace, handle.queue_wait_ms,
+                                      pool=handle.pool)
         try:
-            with profile_query(self._session, root, ctx, "write",
-                               handle=None if outer else handle) as w:
+            with tracing.use(ctx.trace), \
+                    profile_query(self._session, root, ctx, "write",
+                                  handle=None if outer else handle) as w:
                 try:
                     from .plan.aqe import run_stage_driver
                     decisions = run_stage_driver(root, ctx, conf)
@@ -1277,6 +1338,13 @@ class DataFrame:
         else:
             if mgr is not None:
                 mgr.close_query(handle)
+        finally:
+            # profile_query normally finishes the trace with the true
+            # wall clock; this is the event-log-off fallback
+            try:
+                tracing.finish(ctx)
+            except Exception:
+                pass
         self._last_root = root
         self._last_metrics = {op: ms.snapshot(ctx.metrics_level)
                               for op, ms in ctx.metrics.items()}

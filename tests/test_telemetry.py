@@ -1,0 +1,225 @@
+"""Live telemetry registry (profiler/telemetry.py) and its service
+surface: log-bucket histogram accuracy vs exact quantiles, pull-gauge
+expansion, the Prometheus text exposition, query-lifecycle metrics, the
+admission-rejection counter, and the gateway `metrics` verb round-trip
+(service/server.py) — live scrape while queries run."""
+import json
+import socket
+import threading
+import time
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+import spark_rapids_tpu as st
+from spark_rapids_tpu.config import (
+    SERVICE_ADMISSION_DEVICE_LIMIT, SERVICE_MAX_CONCURRENT, TpuConf)
+from spark_rapids_tpu.profiler import telemetry
+from spark_rapids_tpu.service.query_manager import QueryManager
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    """Isolate every test from instruments other tests (and other
+    sessions in this process) already touched."""
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+# ----------------------------------------------------------------------
+# instruments
+# ----------------------------------------------------------------------
+def test_counter_and_gauge_basics():
+    telemetry.counter("reqs").inc()
+    telemetry.counter("reqs").inc(4)
+    telemetry.gauge("depth").set(7)
+    snap = telemetry.snapshot()
+    assert snap["counters"]["reqs"] == 5
+    assert snap["gauges"]["depth"] == 7
+    # instruments are process-global singletons by name
+    assert telemetry.counter("reqs") is telemetry.counter("reqs")
+
+
+def test_histogram_quantiles_within_one_log_bucket():
+    """p50/p95/p99 from the bucket counts land within ~one geometric
+    bucket (base 2^0.25 ≈ 1.19x) of the exact sample quantiles — the
+    no-samples-stored design's accuracy contract."""
+    rng = np.random.default_rng(17)
+    samples = rng.uniform(0.5, 5000.0, 4000)
+    h = telemetry.histogram("lat_ms")
+    for v in samples:
+        h.observe(float(v))
+    for q in (0.50, 0.95, 0.99):
+        exact = float(np.quantile(samples, q))
+        est = h.quantile(q)
+        # one bucket of relative width + rank discretization: a 2x
+        # bound still catches any bucket-math regression (wrong base,
+        # off-by-one bucket index, missing clamp)
+        assert exact / 1.5 <= est <= exact * 1.5, (q, est, exact)
+    s = h.summary()
+    assert s["count"] == len(samples)
+    assert s["min"] == pytest.approx(samples.min(), rel=1e-6)
+    assert s["max"] == pytest.approx(samples.max(), rel=1e-6)
+    assert s["mean"] == pytest.approx(samples.mean(), rel=1e-6)
+
+
+def test_histogram_quantile_clamped_to_observed_range():
+    h = telemetry.histogram("one")
+    h.observe(123.4)
+    # a single sample: every quantile IS the sample, not a bucket mid
+    for q in (0.5, 0.95, 0.99):
+        assert h.quantile(q) == pytest.approx(123.4)
+
+
+def test_histogram_zero_negative_and_junk():
+    h = telemetry.histogram("edge")
+    h.observe(0.0)
+    h.observe(-3.0)
+    h.observe("not-a-number")            # silently ignored
+    s = h.summary()
+    assert s["count"] == 2
+    assert h.quantile(0.5) == 0.0        # zero/negative bucket mid,
+    assert telemetry.histogram("never").quantile(0.5) is None
+
+
+def test_register_gauge_fn_dict_expansion_and_failure_isolation():
+    telemetry.register_gauge_fn("pool", lambda: {"active": 2,
+                                                 "queued": 5})
+    telemetry.register_gauge_fn("boom", lambda: 1 / 0)
+    g = telemetry.snapshot()["gauges"]
+    assert g["pool_active"] == 2 and g["pool_queued"] == 5
+    assert "boom" not in g               # a failing callback is skipped
+
+
+def test_render_prometheus_exposition():
+    telemetry.counter("hits").inc(3)
+    telemetry.gauge("depth").set(2)
+    telemetry.histogram("lat").observe(10.0)
+    text = telemetry.render_prometheus()
+    assert "# TYPE srtpu_hits counter\nsrtpu_hits 3" in text
+    assert "# TYPE srtpu_depth gauge\nsrtpu_depth 2" in text
+    assert "# TYPE srtpu_lat summary" in text
+    assert 'srtpu_lat{quantile="0.50"}' in text
+    assert "srtpu_lat_count 1" in text
+    assert text.endswith("\n")
+
+
+# ----------------------------------------------------------------------
+# engine-fed metrics: query lifecycle + admission
+# ----------------------------------------------------------------------
+def test_query_lifecycle_metrics_via_session():
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 4096})
+    df = s.create_dataframe({"k": list(range(100)),
+                             "v": [float(i % 7) for i in range(100)]})
+    df.to_arrow()
+    snap = telemetry.snapshot()
+    assert snap["counters"].get("queries_finished", 0) >= 1
+    hq = snap["histograms"].get("queue_wait_ms")
+    assert hq and hq["count"] >= 1
+    hl = snap["histograms"].get("query_latency_ms_finished")
+    assert hl and hl["count"] >= 1 and hl["max"] > 0
+    # the query manager's pull gauges report live depth (idle now)
+    assert snap["gauges"].get("service_running") == 0
+    assert snap["gauges"].get("service_queued") == 0
+
+
+def test_admission_rejection_counter():
+    """A queued-on-memory admission attempt counts as a rejection —
+    the saturation signal a fleet router scrapes."""
+    mgr = QueryManager(TpuConf({
+        SERVICE_MAX_CONCURRENT.key: 4,
+        SERVICE_ADMISSION_DEVICE_LIMIT.key: 1000}))
+    release = threading.Event()
+    started = threading.Event()
+
+    def hold(handle):
+        started.set()
+        release.wait(10)
+        return "done"
+
+    h1 = mgr.submit(hold, estimate=(600, 0))
+    assert started.wait(5)
+    h2 = mgr.submit(lambda handle: "ok", estimate=(600, 0))
+    deadline = time.monotonic() + 5
+    while telemetry.counter("admission_rejections").value == 0:
+        assert time.monotonic() < deadline, "no rejection counted"
+        time.sleep(0.01)
+    release.set()
+    assert h1.result(timeout=10) == "done"
+    assert h2.result(timeout=10) == "ok"
+    assert telemetry.counter("admission_rejections").value >= 1
+
+
+# ----------------------------------------------------------------------
+# gateway `metrics` verb
+# ----------------------------------------------------------------------
+def _rpc(f, **req):
+    f.write(json.dumps(req) + "\n")
+    f.flush()
+    return json.loads(f.readline())
+
+
+def test_gateway_metrics_verb_round_trip():
+    s = st.TpuSession({"spark.rapids.tpu.sql.batchSizeRows": 128})
+    n = 256
+    df = s.create_dataframe({"k": pa.array(list(range(n))),
+                             "v": pa.array([float(i % 5)
+                                            for i in range(n)])})
+    df.create_or_replace_temp_view("telemetry_t")
+    srv = s.serve()
+    sock = None
+    try:
+        sock = socket.create_connection(srv.address, timeout=10)
+        f = sock.makefile("rw", encoding="utf-8")
+        # live scrape before any query: shape only
+        m0 = _rpc(f, op="metrics")
+        assert m0["ok"]
+        assert set(m0["metrics"]) == {"counters", "gauges",
+                                      "histograms"}
+        # run a query through the gateway, then scrape again: the
+        # lifecycle instruments moved
+        sub = _rpc(f, op="submit",
+                   sql="SELECT k FROM telemetry_t WHERE v > 1")
+        assert sub["ok"]
+        deadline = time.monotonic() + 60
+        while True:
+            stt = _rpc(f, op="status", query_id=sub["query_id"])
+            if stt["state"] in ("FINISHED", "FAILED"):
+                break
+            assert time.monotonic() < deadline
+            time.sleep(0.02)
+        assert stt["state"] == "FINISHED"
+        m1 = _rpc(f, op="metrics")
+        assert m1["ok"]
+        assert m1["metrics"]["counters"].get("queries_finished", 0) >= 1
+        lat = m1["metrics"]["histograms"].get(
+            "query_latency_ms_finished")
+        assert lat and lat["count"] >= 1
+        assert json.loads(json.dumps(m1)) == m1   # JSON-clean
+        # prometheus exposition over the same verb
+        prom = _rpc(f, op="metrics", format="prometheus")
+        assert prom["ok"]
+        assert "srtpu_queries_finished" in prom["text"]
+        assert "# TYPE" in prom["text"]
+    finally:
+        if sock is not None:
+            sock.close()
+        srv.close()
+
+
+def test_gateway_metrics_verb_disabled():
+    s = st.TpuSession({
+        "spark.rapids.tpu.sql.telemetry.enabled": False})
+    srv = s.serve()
+    sock = None
+    try:
+        sock = socket.create_connection(srv.address, timeout=10)
+        f = sock.makefile("rw", encoding="utf-8")
+        m = _rpc(f, op="metrics")
+        assert not m["ok"] and "telemetry disabled" in m["error"]
+    finally:
+        if sock is not None:
+            sock.close()
+        srv.close()

@@ -759,3 +759,106 @@ def test_unstable_program_key_allow_marker_suppresses():
                     lambda x: x, cls="Node", tag="run",
                     key=("id", id(self)))
     """) == []
+
+
+# ----------------------------------------------------------------------
+# span-leak
+# ----------------------------------------------------------------------
+def test_span_leak_unclosed_open_span_fires():
+    vs = _lint("""
+        from spark_rapids_tpu.profiler import tracing
+
+        def f(tc):
+            sp = tracing.open_span("stage", "stage", tc)
+            do_work()
+    """)
+    assert [v.rule for v in vs] == ["span-leak"]
+    assert "`sp`" in vs[0].message and ".end()" in vs[0].message
+
+
+def test_span_leak_end_in_finally_clean():
+    assert _rules("""
+        from spark_rapids_tpu.profiler import tracing
+
+        def f(tc):
+            sp = tracing.open_span("stage", "stage", tc)
+            try:
+                do_work()
+            finally:
+                sp.end()
+    """) == []
+
+
+def test_span_leak_returned_span_clean():
+    """Handing the open span to the caller transfers the close
+    obligation — the callback-completion pattern."""
+    assert _rules("""
+        from spark_rapids_tpu.profiler import tracing
+
+        def start(tc):
+            sp = tracing.open_span("compile", "compile", tc)
+            return sp
+    """) == []
+
+
+def test_span_leak_with_statement_clean():
+    assert _rules("""
+        from spark_rapids_tpu.profiler import tracing
+
+        def f(tc):
+            with tracing.span("plan", "plan", tc):
+                do_work()
+    """) == []
+
+
+def test_span_leak_discarded_result_fires():
+    vs = _lint("""
+        from spark_rapids_tpu.profiler import tracing
+
+        def f(tc):
+            tracing.open_span("stage", "stage", tc)
+            do_work()
+    """)
+    assert [v.rule for v in vs] == ["span-leak"]
+    assert "discarded" in vs[0].message
+
+
+def test_span_leak_attribute_stash_fires():
+    """self._sp = open_span(...): no finally in scope can provably end
+    it; the deferred-close sites in the tree carry allow markers."""
+    assert _rules("""
+        from spark_rapids_tpu.profiler import tracing
+
+        class Q:
+            def start(self, tc):
+                self._sp = tracing.open_span("query", "query", tc)
+    """) == ["span-leak"]
+
+
+def test_span_leak_end_in_other_function_still_fires():
+    """The close obligation is per-function: an end() in a different
+    function does not discharge it (that path may never run)."""
+    assert _rules("""
+        from spark_rapids_tpu.profiler import tracing
+
+        def f(tc):
+            sp = tracing.open_span("stage", "stage", tc)
+            return None
+
+        def g(sp):
+            try:
+                pass
+            finally:
+                sp.end()
+    """) == ["span-leak"]
+
+
+def test_span_leak_allow_marker_suppresses():
+    assert _rules("""
+        from spark_rapids_tpu.profiler import tracing
+
+        def f(tc):
+            # tpulint: allow[span-leak] root span: ended by tracing.finish() in the action finally
+            sp = tracing.open_span("query", "query", tc)
+            return None
+    """) == []

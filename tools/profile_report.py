@@ -11,7 +11,12 @@ Usage:
     python tools/profile_report.py /tmp/srtpu-events
 
     # A/B regression attribution: which operator got slower in B?
+    # (when both logs carry traces, a critical-path delta row names
+    # the edge category whose share grew the most)
     python tools/profile_report.py --diff a.jsonl b.jsonl
+
+    # per-query trace waterfall + critical-path share table
+    python tools/profile_report.py --trace /tmp/srtpu-events/query-123-0.jsonl
 
     # BENCH_*.json emitted with --profile also parses
     python tools/profile_report.py BENCH_r06.json
@@ -34,6 +39,7 @@ from typing import Dict, List
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
+from spark_rapids_tpu.profiler import critical_path  # noqa: E402
 from spark_rapids_tpu.profiler.analyze import fmt_bytes, render_analyze  # noqa: E402
 from spark_rapids_tpu.profiler.event_log import (  # noqa: E402
     aggregate_ops, op_time_seconds, read_event_log)
@@ -231,6 +237,57 @@ def report(events: List[dict], top: int = 0) -> str:
     return "\n".join(lines)
 
 
+def _trace_spans_of(events: List[dict]) -> List[dict]:
+    return [e for e in events if e.get("event") == "trace_span"]
+
+
+def _trace_summary_of(events: List[dict]) -> dict | None:
+    """The query's critical-path summary: the emitted trace_summary
+    record when present, else recomputed from the trace_span records."""
+    s = next((e for e in events if e.get("event") == "trace_summary"),
+             None)
+    if s is not None:
+        return s
+    spans = _trace_spans_of(events)
+    return critical_path.summarize(spans) if spans else None
+
+
+def trace_report(events: List[dict], max_rows: int = 60) -> str:
+    """Per-query trace waterfall + critical-path share table."""
+    by_query: Dict[str, List[dict]] = {}
+    for e in events:
+        by_query.setdefault(e.get("query_id", "?"), []).append(e)
+    lines = []
+    for qid, evs in by_query.items():
+        spans = _trace_spans_of(evs)
+        if not spans:
+            continue
+        lines.append(f"== trace {qid} ({len(spans)} spans) ==")
+        lines.append(critical_path.render_waterfall(
+            spans, max_rows=max_rows))
+        summ = _trace_summary_of(evs)
+        if summ:
+            shares = summ.get("shares") or {}
+            pct = summ.get("share_pct") or {}
+            lines.append("")
+            lines.append(f"  {'edge':<14} {'time':>10} {'share':>7}")
+            for c in critical_path.CATEGORIES:
+                ms = shares.get(c, 0.0)
+                if ms <= 0:
+                    continue
+                lines.append(f"  {c:<14} {ms:9.1f}ms "
+                             f"{pct.get(c, 0.0):6.1f}%")
+            lines.append(f"  {'total':<14} "
+                         f"{summ.get('total_ms', 0.0):9.1f}ms")
+            lines.append(f"  critical path: {summ.get('dominant')} "
+                         f"({summ.get('dominant_pct', 0.0):.1f}%)")
+        lines.append("")
+    if not lines:
+        return ("(no trace_span records — run with "
+                "spark.rapids.tpu.sql.trace.enabled=true)")
+    return "\n".join(lines)
+
+
 def diff_ops(a_events: List[dict], b_events: List[dict]) -> List[dict]:
     """A/B regression attribution: per `lore_id:name` operator key, the
     op-time delta B-A, sorted worst regression first. The top entry is
@@ -269,6 +326,24 @@ def diff_report(a_events: List[dict], b_events: List[dict],
         lines.append(f"most regressed operator: [{w['key']}] "
                      f"{w['describe']} "
                      f"(+{w['delta_s'] * 1e3:.1f}ms)")
+    # critical-path delta: when both runs carry traces, name the edge
+    # category whose absolute share grew the most — "the query got
+    # slower because it now waits on X", one level above operators
+    sa = _trace_summary_of(a_events)
+    sb = _trace_summary_of(b_events)
+    if sa and sb:
+        da = sa.get("shares") or {}
+        db = sb.get("shares") or {}
+        deltas = {c: db.get(c, 0.0) - da.get(c, 0.0)
+                  for c in critical_path.CATEGORIES}
+        worst = max(deltas, key=lambda c: deltas[c])
+        lines.append(
+            f"critical path: A={sa.get('dominant')} "
+            f"({sa.get('dominant_pct', 0.0):.1f}%), "
+            f"B={sb.get('dominant')} "
+            f"({sb.get('dominant_pct', 0.0):.1f}%); "
+            f"largest share growth: {worst} "
+            f"({deltas[worst]:+.1f}ms)")
     return "\n".join(lines)
 
 
@@ -282,10 +357,18 @@ def main(argv=None) -> int:
     ap.add_argument("--diff", action="store_true",
                     help="treat the two paths as runs A and B and "
                          "attribute the regression")
+    ap.add_argument("--trace", action="store_true",
+                    help="render the per-query span waterfall and "
+                         "critical-path share table instead of the "
+                         "operator breakdown")
     ap.add_argument("--top", type=int, default=10,
                     help="rows to show in diff / flat listings")
     args = ap.parse_args(argv)
     paths = _expand(args.paths)
+    if args.trace:
+        for p in paths:
+            print(trace_report(load_events(p)))
+        return 0
     if args.diff:
         if len(paths) != 2:
             ap.error("--diff needs exactly two logs (A and B)")

@@ -3,7 +3,8 @@
 
 Rules live in spark_rapids_tpu/analysis/lint_rules.py (host-sync,
 block-sync, jit-static-shape, strong-literal, donate-missing,
-jit-instance, ctx-cancel, unstable-program-key, allow-no-reason).
+jit-instance, ctx-cancel, unstable-program-key, span-leak,
+allow-no-reason).
 Accepted sites carry inline
 `# tpulint: allow[<rule>] <reason>` markers; anything else must be in
 the committed baseline (tools/tpulint_baseline.json) or the run fails.
