@@ -101,10 +101,13 @@ if "--chaos" in sys.argv[1:]:
     except (IndexError, ValueError):
         print("bench: --chaos needs an integer seed", file=sys.stderr)
         sys.exit(2)
-    # the soak's cleanliness claims need both witnesses live from the
-    # first engine import
+    # the soak's cleanliness claims need the witnesses live from the
+    # first engine import; racedep record-only (findings fail the pass
+    # through its report, not by raising mid-query)
     os.environ.setdefault("SRTPU_LOCKDEP", "1")
     os.environ.setdefault("SRTPU_LEDGER", "1")
+    os.environ.setdefault("SRTPU_RACEDEP", "1")
+    os.environ.setdefault("SRTPU_RACEDEP_RAISE", "0")
 
 # --zipfian (with --concurrent N): repeat-heavy variant — streams draw
 # from a zipfian query mix through a cache-ENABLED session, with
@@ -665,6 +668,7 @@ def _main_impl():
                 "regenerations": soak["regenerations"],
                 "query_retries": soak["query_retries"],
                 "degradations": soak["degradations"],
+                "schedule_perturbation": soak["schedule_perturbation"],
                 **({"errors": soak["errors"]}
                    if soak.get("errors") else {}),
             }
@@ -1251,6 +1255,15 @@ def _chaos_soak(st, sf: float, seed: int, n_streams: int = 2,
                                                  "findings": 0}
     retries = rec.get("query_retries", 0)
     retry_budget = len(qids) * n_streams * max_retries
+
+    # schedule-perturbation pass (ISSUE 18): seeded adversarial
+    # interleavings — microsecond bytecode switch interval plus
+    # RNG-chosen yields at instrumented shared-structure accesses —
+    # with NO fault plan armed; byte-identity against the same serial
+    # reference plus a balanced ledger and a collapse-free racedep
+    # report prove the pools' sharing discipline rather than retry luck
+    perturb = _schedule_perturbation(reg, dfs, serial, seed,
+                                     n_streams, _ledger)
     for df in dfs.values():
         df.uncache()
     # focused mesh.collective pass: the randomized plan above arms the
@@ -1274,11 +1287,85 @@ def _chaos_soak(st, sf: float, seed: int, n_streams: int = 2,
         "ledger": led,
         "lockdep": lockrep,
         "mesh_collective": mesh,
+        "schedule_perturbation": perturb,
         "ok": (not mismatched and not errors
                and retries <= retry_budget
                and bool(led.get("balanceOk", True))
                and int(lockrep.get("findings", 0)) == 0
-               and bool(mesh.get("ok", False))),
+               and bool(mesh.get("ok", False))
+               and bool(perturb.get("ok", False))),
+    }
+    if errors:
+        out["errors"] = errors[:10]
+    return out
+
+
+def _schedule_perturbation(reg, dfs, serial, seed: int, n_streams: int,
+                           _ledger, qids=(3, 6)) -> dict:
+    """Seeded adversarial-scheduling pass inside the chaos soak: arm
+    racedep's perturbation mode (tiny `sys.setswitchinterval` + seeded
+    yields at instrumented accesses), run the q3/q6 streams
+    concurrently with NO faults, and require byte-identity against the
+    serial reference, zero witnessed lockset collapses, and a balanced
+    ledger under the hostile interleavings."""
+    import random
+    import threading
+
+    from spark_rapids_tpu.runtime import racedep as _racedep
+
+    pqids = [q for q in qids if q in serial]
+    was_enabled = _racedep.enabled()
+    rw = _racedep.witness() if was_enabled \
+        else _racedep.enable(raise_on_race=False)
+    base_findings = len(rw.findings)
+    mismatched, errors = [], []
+    lock = threading.Lock()
+
+    def stream(i: int):
+        order = pqids[:]
+        random.Random(seed * 77 + i).shuffle(order)
+        for qn in order:
+            try:
+                tbl = reg[qn](dfs).to_arrow()
+                if not tbl.equals(serial[qn]):
+                    with lock:
+                        mismatched.append(qn)
+            except Exception as e:  # noqa: BLE001 — reported in JSON
+                with lock:
+                    errors.append(f"perturb-stream{i} q{qn}: {e!r}")
+
+    wall = 0.0
+    _racedep.perturb(seed, yield_prob=0.2)
+    try:
+        threads = [threading.Thread(target=stream, args=(i,),
+                                    name=f"chaos-perturb-{i}")
+                   for i in range(n_streams)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.perf_counter() - t0
+    finally:
+        _racedep.restore()
+    report = rw.report()
+    new_findings = len(rw.findings) - base_findings
+    if not was_enabled:
+        _racedep.disable()
+    lg = _ledger.ledger()
+    led = lg.report() if lg is not None else {"enabled": False,
+                                              "balanceOk": True}
+    out = {
+        "seed": seed,
+        "qids": pqids,
+        "streams": n_streams,
+        "wall_s": round(wall, 3),
+        "mismatched": sorted(set(mismatched)),
+        "racedep": report,
+        "race_findings": new_findings,
+        "ledger_ok": bool(led.get("balanceOk", True)),
+        "ok": (not mismatched and not errors and new_findings == 0
+               and bool(led.get("balanceOk", True))),
     }
     if errors:
         out["errors"] = errors[:10]

@@ -1,0 +1,1176 @@
+"""Shared interprocedural machinery for the static auditors.
+
+Three passes need the same whole-tree model: the concurrency auditor
+(analysis/concurrency.py, deadlock shapes), the resource-lifetime
+auditor (analysis/lifetime.py, acquire/release shapes), and the
+data-race auditor (analysis/races.py, Eraser-style locksets). This
+module owns the parts they share:
+
+- the module walk (`build_model`): every function/method in the tree
+  becomes a `FuncInfo` with its synchronization events, call edges and
+  per-``class.attr`` access sites;
+- resource inventory: threading.Lock/RLock/Condition/Semaphore
+  creations (class-keyed: ``ShuffleExchangeExec._lock``),
+  ``lockdep.lock("K")`` factories, TpuSemaphore permits, bounded pools
+  (keyed by ``thread_name_prefix``), queues;
+- call resolution (`Model.resolve_ref`): lexical scope chain for
+  nested defs, module-local and imported engine functions,
+  self-methods, and the unique-method heuristic with the
+  ``_NO_RESOLVE`` polymorphic blocklist;
+- pool-worker / thread-target resolution (``Model.pools[*].workers``,
+  ``Model.thread_targets``) — the thread-context roots every pass
+  derives worker reachability from;
+- memoized interprocedural event summaries (`Model.summarize`) with
+  held-sets composed across resolvable calls;
+- the shared allow-marker filter (``# tpulint: allow[rule] reason``)
+  and per-file marker cache (`filter_markers`).
+
+Static analysis of Python is necessarily approximate. Calls are
+propagated only when unambiguous (self-methods, module-local and
+imported engine functions, uniquely-named methods); polymorphic names
+(``execute_partition`` et al) are skipped — the runtime witnesses
+(lockdep/ledger/racedep) cover the dynamic side.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from .lint_rules import MARKER_RE
+
+__all__ = ["PERMIT", "Event", "PoolInfo", "FuncInfo", "Model",
+           "build_model", "filter_markers"]
+
+PERMIT = "TpuSemaphore.permit"
+
+_SUMMARY_CAP = 400
+
+# attribute-call names never resolved by the unique-method heuristic:
+# polymorphic across the operator tree or too generic to trust
+_NO_RESOLVE = {
+    "execute_partition", "execute_all", "num_partitions", "describe",
+    "release", "close", "get", "set", "add", "put", "append", "items",
+    "values", "keys", "pop", "update", "start", "join", "cancel",
+    "check", "read", "write", "send", "recv", "result", "submit",
+    "wait", "acquire", "done", "copy", "extend", "clear", "sort",
+    "split", "strip", "format", "encode", "decode", "timer", "info",
+    "debug", "warning", "error", "flush", "seek", "tell", "next",
+    # names shared with stdlib/pyarrow objects: gc.collect(),
+    # Event.is_set(), schema.to_arrow(), table.filter(), ...
+    "collect", "is_set", "to_arrow", "exists", "filter", "count",
+    "index", "insert", "remove", "discard", "shutdown", "status",
+    "tolist", "item", "reshape", "astype", "mkdir", "unlink",
+}
+
+_LOCKY = ("lock", "cond", "mutex")
+
+#: container-mutating method names: `self.attr.append(x)` mutates the
+#: shared container (GIL-atomic per call, but shared state) and
+#: `self.attr[k].append(x)` is a read-modify-write through the slot
+_MUTATORS = {"append", "extend", "add", "update", "pop", "popitem",
+             "remove", "discard", "insert", "clear", "move_to_end"}
+
+#: assignment sources that make an attr write a queue/Future hand-off
+#: (the object is itself the synchronization point) rather than raw
+#: shared-state mutation
+_HANDOFF_CTORS = {"Queue", "SimpleQueue", "LifoQueue", "PriorityQueue",
+                  "deque", "Event", "Barrier", "Future",
+                  "ThreadPoolExecutor"}
+
+#: sink method names through which `self` escapes during __init__
+#: (publish-before-init detection: registries, queues, pools)
+_PUBLISH_SINKS = {"append", "add", "put", "register", "submit"}
+
+
+def _ctor_name(call: ast.Call) -> Optional[str]:
+    """Class name when `call` looks like a constructor (Name func with
+    a capitalized stem, underscore-private included: `_Parser(...)`)."""
+    f = call.func
+    if isinstance(f, ast.Name):
+        stem = f.id.lstrip("_")
+        if stem[:1].isupper():
+            return f.id
+    return None
+
+
+def _last_name(expr) -> Optional[str]:
+    """Trailing identifier of a Name/Attribute/Call chain."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        return expr.attr
+    if isinstance(expr, ast.Call):
+        return _last_name(expr.func)
+    return None
+
+
+def _is_locky(name: Optional[str]) -> bool:
+    if not name:
+        return False
+    low = name.lower()
+    return any(t in low for t in _LOCKY) or low == "_mu"
+
+
+def _is_semish(expr) -> bool:
+    n = _last_name(expr)
+    return bool(n) and "sem" in n.lower() and "semaphore" not in (
+        n,)  # TpuSemaphore class ref itself is not an instance
+
+
+def _is_riderish(expr) -> bool:
+    n = _last_name(expr)
+    return bool(n) and "rider" in n.lower()
+
+
+class Event:
+    """One synchronization- or access-relevant action at a source site.
+
+    `kind` is one of: acquire | release | wait | sync | submit (the
+    synchronization stream consumed by the concurrency auditor), or
+    read | write | rmw | checkact | publish (the per-``class.attr``
+    access stream consumed by the race auditor — kept in
+    ``FuncInfo.accesses``, never in ``FuncInfo.events``, so the
+    summary caps of the two passes cannot starve each other)."""
+
+    __slots__ = ("kind", "line", "col", "desc", "blocking", "resource",
+                 "pool", "wclass", "exempt")
+
+    def __init__(self, kind: str, line: int, col: int, desc: str,
+                 blocking: bool = False, resource: Optional[str] = None,
+                 pool: Optional[str] = None, wclass: str = "",
+                 exempt: frozenset = frozenset()):
+        self.kind = kind
+        self.line = line
+        self.col = col
+        self.desc = desc
+        self.blocking = blocking
+        self.resource = resource
+        self.pool = pool
+        self.wclass = wclass      # future | queue | sem | cond | socket
+        # for access events: aug | subscript | method:<name> | handoff..
+        self.exempt = exempt      # held keys this wait releases
+
+
+class PoolInfo:
+    """A bounded executor, keyed by worker-thread name prefix."""
+
+    __slots__ = ("key", "mod", "path", "line", "workers", "sites")
+
+    def __init__(self, key: str, mod: str, path: str, line: int):
+        self.key = key
+        self.mod = mod
+        self.path = path
+        self.line = line
+        self.workers: List[Tuple[str, tuple]] = []  # (owner fid, ref)
+        self.sites: List[int] = []
+
+
+class FuncInfo:
+    """Per-function facts: events with lexical held-sets, call edges,
+    attribute-access sites."""
+
+    __slots__ = ("fid", "path", "mod", "cls", "name", "qual", "line",
+                 "events", "calls", "nested", "parent", "accesses")
+
+    def __init__(self, fid: str, path: str, mod: str,
+                 cls: Optional[str], name: str, line: int,
+                 parent: Optional[str] = None):
+        self.fid = fid
+        self.path = path
+        self.mod = mod
+        self.cls = cls
+        self.name = name
+        self.qual = f"{cls}.{name}" if cls else name
+        self.line = line
+        self.parent = parent      # enclosing function's fid (nested defs)
+        self.events: List[Tuple[Event, frozenset]] = []
+        self.calls: List[Tuple[tuple, int, frozenset]] = []
+        self.nested: Dict[str, str] = {}
+        # per-`class.attr` access events (read/write/rmw/checkact/
+        # publish) with the lexically-held lockset at the site
+        self.accesses: List[Tuple[Event, frozenset]] = []
+
+
+class Model:
+    """Whole-tree facts the rules run against."""
+
+    def __init__(self):
+        self.funcs: Dict[str, FuncInfo] = {}
+        self.resources: Dict[str, str] = {}       # key -> kind
+        self.resource_sites: Dict[str, List[Tuple[str, int]]] = {}
+        self.cond_pairs: Dict[str, Optional[str]] = {}
+        self.attr_res: Dict[Tuple[str, str], str] = {}  # (cls, attr) -> key
+        self.pools: Dict[str, PoolInfo] = {}
+        self.module_fns: Dict[Tuple[str, str], str] = {}
+        self.methods: Dict[Tuple[str, str, str], str] = {}
+        self.methods_by_name: Dict[str, List[str]] = {}
+        self.imports: Dict[str, Dict[str, Tuple[str, str]]] = {}
+        self.thread_targets: List[Tuple[str, tuple, Optional[str]]] = []
+        self.lines: Dict[str, List[str]] = {}     # relpath -> source lines
+        # class name -> constructor-site escape shapes ("local" =
+        # assigned to a plain local, "recv" = temporary method
+        # receiver, "stored"/"escaped" = reaches shared state): the
+        # race auditor's instance-confinement evidence
+        self.ctors: Dict[str, List[str]] = {}
+        self._summaries: Dict[str, list] = {}
+
+    # -- registration --------------------------------------------------
+    def add_resource(self, key: str, kind: str, path: str, line: int):
+        self.resources.setdefault(key, kind)
+        self.resource_sites.setdefault(key, []).append((path, line))
+
+    def add_func(self, fn: FuncInfo):
+        self.funcs[fn.fid] = fn
+        if fn.cls is None and "." not in fn.name:
+            self.module_fns.setdefault((fn.mod, fn.name), fn.fid)
+        if fn.cls is not None:
+            self.methods.setdefault((fn.mod, fn.cls, fn.name), fn.fid)
+            self.methods_by_name.setdefault(fn.name, []).append(fn.fid)
+
+    # -- call resolution -----------------------------------------------
+    def resolve_ref(self, fn: FuncInfo, ref: tuple) -> Optional[str]:
+        kind, name = ref
+        if kind == "local":
+            # lexical scope chain: own nested defs, then enclosing
+            # functions' (siblings like map_one called from
+            # map_partition, both nested in _ensure_shuffled)
+            cur: Optional[FuncInfo] = fn
+            while cur is not None:
+                if name in cur.nested:
+                    return cur.nested[name]
+                cur = self.funcs.get(cur.parent) if cur.parent else None
+            fid = self.module_fns.get((fn.mod, name))
+            if fid is not None:
+                return fid
+            imp = self.imports.get(fn.mod, {}).get(name)
+            if imp is not None:
+                return self.module_fns.get(imp)
+            return None
+        if kind == "self":
+            if fn.cls is not None:
+                fid = self.methods.get((fn.mod, fn.cls, name))
+                if fid is not None:
+                    return fid
+            return self._unique_method(name)
+        if kind == "attr":
+            return self._unique_method(name)
+        return None
+
+    def _unique_method(self, name: str) -> Optional[str]:
+        if name in _NO_RESOLVE or name.startswith("__"):
+            return None
+        cands = self.methods_by_name.get(name, ())
+        return cands[0] if len(cands) == 1 else None
+
+    # -- interprocedural summaries --------------------------------------
+    def summarize(self, fid: str, _stack: Optional[set] = None) -> list:
+        """All (event, held-keys, site-fid) pairs realizable by calling
+        `fid`, with held-sets relative to its entry. Memoized; recursion
+        cut at the in-progress set; capped at _SUMMARY_CAP entries."""
+        if fid in self._summaries:
+            return self._summaries[fid]
+        stack = _stack if _stack is not None else set()
+        if fid in stack:
+            return []
+        stack.add(fid)
+        fn = self.funcs[fid]
+        out: List[tuple] = []
+        for ev, held in fn.events:
+            out.append((ev, held, fid))
+        for ref, _line, held in fn.calls:
+            callee = self.resolve_ref(fn, ref)
+            if callee is None or callee == fid:
+                continue
+            for ev, add_held, site in self.summarize(callee, stack):
+                out.append((ev, held | add_held, site))
+                if len(out) >= _SUMMARY_CAP:
+                    break
+            if len(out) >= _SUMMARY_CAP:
+                break
+        stack.discard(fid)
+        out = out[:_SUMMARY_CAP]
+        self._summaries[fid] = out
+        return out
+
+    def reachable_from(self, roots: List[str]) -> Set[str]:
+        seen = set(roots)
+        work = list(roots)
+        while work:
+            fid = work.pop()
+            fn = self.funcs.get(fid)
+            if fn is None:
+                continue
+            for ref, _line, _held in fn.calls:
+                callee = self.resolve_ref(fn, ref)
+                if callee is not None and callee not in seen:
+                    seen.add(callee)
+                    work.append(callee)
+        return seen
+
+    def snippet(self, path: str, line: int) -> str:
+        lines = self.lines.get(path, ())
+        if 1 <= line <= len(lines):
+            return lines[line - 1].strip()
+        return ""
+
+
+# ---------------------------------------------------------------------
+# module scanning
+# ---------------------------------------------------------------------
+_THREADING_LOCKS = {"Lock": "lock", "RLock": "rlock",
+                    "Condition": "cond", "Semaphore": "sem",
+                    "BoundedSemaphore": "sem"}
+
+
+def _threading_ctor(call: ast.Call) -> Optional[str]:
+    """'lock'/'rlock'/'cond'/'sem' when `call` constructs a threading
+    primitive (threading.Lock(), Lock(), ...)."""
+    f = call.func
+    name = None
+    if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+            and f.value.id == "threading":
+        name = f.attr
+    elif isinstance(f, ast.Name):
+        name = f.id
+    return _THREADING_LOCKS.get(name) if name else None
+
+
+def _lockdep_factory(call: ast.Call) -> Optional[Tuple[str, str]]:
+    """(key, kind) for lockdep.lock("K") / lockdep.rlock("K")."""
+    f = call.func
+    attr = None
+    if isinstance(f, ast.Attribute) and _last_name(f.value) == "lockdep":
+        attr = f.attr
+    elif isinstance(f, ast.Name) and f.id in ("lock", "rlock"):
+        attr = f.id
+    if attr in ("lock", "rlock") and call.args and \
+            isinstance(call.args[0], ast.Constant) and \
+            isinstance(call.args[0].value, str):
+        return call.args[0].value, attr
+    return None
+
+
+def _is_pool_ctor(call: ast.Call) -> bool:
+    return _last_name(call.func) == "ThreadPoolExecutor"
+
+
+def _kw(call: ast.Call, name: str):
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+class _Scanner:
+    """One source module -> FuncInfos + resources + pools in the model."""
+
+    def __init__(self, model: Model, mod: str, path: str, src: str):
+        self.model = model
+        self.mod = mod
+        self.path = path
+        self.tree = ast.parse(src)
+        model.lines[path] = src.splitlines()
+
+    def scan(self):
+        imap = self.model.imports.setdefault(self.mod, {})
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.ImportFrom) and node.module:
+                src = self._resolve_import(node)
+                if src is not None:
+                    for a in node.names:
+                        imap[a.asname or a.name] = (src, a.name)
+        for node in self.tree.body:
+            if isinstance(node, ast.ClassDef):
+                for sub in node.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        self._scan_fn(sub, node.name)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self._scan_fn(node, None)
+            elif isinstance(node, ast.Assign):
+                self._module_assign(node)
+
+    def _resolve_import(self, node: ast.ImportFrom) -> Optional[str]:
+        """Dotted engine-module path for `from .x import y` relative to
+        this module; absolute engine imports pass through."""
+        mod = node.module or ""
+        if node.level == 0:
+            if mod.startswith("spark_rapids_tpu."):
+                return mod[len("spark_rapids_tpu."):]
+            return None
+        parts = self.mod.split(".")
+        # level 1 = sibling package level, 2 = one package up, ...
+        base = parts[:len(parts) - node.level]
+        return ".".join(base + mod.split(".")) if mod else None
+
+    def _module_assign(self, node: ast.Assign):
+        if not (len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and isinstance(node.value, ast.Call)):
+            return
+        name = node.targets[0].id
+        kind = _threading_ctor(node.value)
+        if kind is not None:
+            key = f"{self.mod}.{name}"
+            self.model.add_resource(key, kind, self.path, node.lineno)
+            if kind == "cond":
+                self.model.cond_pairs[key] = None
+            return
+        ld = _lockdep_factory(node.value)
+        if ld is not None:
+            self.model.add_resource(ld[0], ld[1], self.path, node.lineno)
+            return
+        cn = _ctor_name(node.value)
+        if cn is not None:
+            # module-level singleton: shared by construction
+            self.model.ctors.setdefault(cn, []).append("escaped")
+
+    def _scan_fn(self, node, cls: Optional[str],
+                 parent: Optional[FuncInfo] = None) -> FuncInfo:
+        qual = node.name if cls is None else f"{cls}.{node.name}"
+        if parent is not None:
+            qual = f"{parent.qual}.<{node.name}>"
+        fid = f"{self.mod}:{qual}"
+        fn = FuncInfo(fid, self.path, self.mod, cls, node.name,
+                      node.lineno,
+                      parent=parent.fid if parent is not None else None)
+        fn.qual = qual
+        self.model.add_func(fn)
+        _FnWalker(self, fn, cls).walk(node.body)
+        return fn
+
+
+class _FnWalker:
+    """Statement walk of one function body, carrying the lexical
+    held-resource stack and emitting events / call edges / accesses."""
+
+    def __init__(self, scanner: _Scanner, fn: FuncInfo,
+                 cls: Optional[str]):
+        self.sc = scanner
+        self.model = scanner.model
+        self.fn = fn
+        self.cls = cls
+        self.held: List[str] = []
+        self.pool_vars: Dict[str, str] = {}    # local name -> pool key
+        self.fut_pools: Dict[str, str] = {}    # future var -> pool key
+        self.queue_vars: Set[str] = set()
+        self.local_res: Dict[str, str] = {}    # local name -> resource
+        self.ctor_vars: Dict[str, str] = {}    # local name -> class name
+        self._ctor_seen: Set[int] = set()      # Call node ids recorded
+
+    # -- helpers -------------------------------------------------------
+    def _snap(self) -> frozenset:
+        return frozenset(self.held)
+
+    def _emit(self, ev: Event):
+        self.fn.events.append((ev, self._snap()))
+
+    def _call_edge(self, ref: tuple, line: int):
+        self.fn.calls.append((ref, line, self._snap()))
+
+    def _push(self, key: str, line: int, col: int, desc: str):
+        self._emit(Event("acquire", line, col, desc, resource=key))
+        self.held.append(key)
+
+    def _pop(self, key: str):
+        for i in range(len(self.held) - 1, -1, -1):
+            if self.held[i] == key:
+                del self.held[i]
+                return
+
+    def _pool_key_for(self, call: ast.Call, line: int) -> str:
+        pref = _kw(call, "thread_name_prefix")
+        if isinstance(pref, ast.Constant) and isinstance(pref.value, str) \
+                and pref.value:
+            key = pref.value
+        else:
+            key = f"{self.sc.mod}.{self.fn.name}.pool@{line}"
+        p = self.model.pools.get(key)
+        if p is None:
+            p = PoolInfo(key, self.sc.mod, self.fn.path, line)
+            self.model.pools[key] = p
+        p.sites.append(line)
+        return key
+
+    # -- attribute-access recording (race auditor's input) -------------
+    def _self_attr(self, expr) -> Optional[str]:
+        """Attr name when `expr` is a `self.X` access in a method."""
+        if isinstance(expr, ast.Attribute) and \
+                isinstance(expr.value, ast.Name) and \
+                expr.value.id == "self" and self.cls is not None:
+            return expr.attr
+        return None
+
+    def _access(self, kind: str, line: int, col: int, attr: str,
+                wclass: str = ""):
+        # lock attributes are resources, not data: their consistency is
+        # the concurrency auditor's domain
+        if _is_locky(attr) or (self.cls, attr) in self.model.attr_res:
+            return
+        self.fn.accesses.append((Event(
+            kind, line, col, self.model.snippet(self.fn.path, line),
+            resource=f"{self.cls}.{attr}", wclass=wclass), self._snap()))
+
+    def _record_store(self, tgt, line: int, wclass: str = ""):
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._record_store(el, line, wclass)
+            return
+        a = self._self_attr(tgt)
+        if a is not None:
+            self._access("write", line, tgt.col_offset, a, wclass)
+            return
+        if isinstance(tgt, ast.Subscript):
+            a = self._self_attr(tgt.value)
+            if a is not None:
+                self._access("write", line, tgt.col_offset, a,
+                             wclass or "subscript")
+
+    def _is_handoff_value(self, val) -> bool:
+        """True when an attr write's source is a queue/Future/pool
+        hand-off: the assigned object is itself the synchronization
+        point (or the value was received through one)."""
+        if not isinstance(val, ast.Call):
+            return False
+        if _is_pool_ctor(val):
+            return True
+        n = _last_name(val.func)
+        if n in _HANDOFF_CTORS:
+            return True
+        if isinstance(val.func, ast.Attribute) and \
+                val.func.attr in ("submit", "result", "get"):
+            return True
+        return False
+
+    def _checkact(self, s: ast.If):
+        """check-then-act shapes: `if k not in self.d: self.d[k] = ...`
+        and `if self.x is None: self.x = ...` (lazy memo)."""
+        t = s.test
+        if isinstance(t, ast.BoolOp) and isinstance(t.op, ast.And) \
+                and t.values:
+            # `if self._arena is None and native_lib() is not None:`
+            # still checks-then-acts on the leading condition
+            t = t.values[0]
+        if not (isinstance(t, ast.Compare) and len(t.ops) == 1):
+            return
+        if isinstance(t.ops[0], ast.NotIn):
+            a = self._self_attr(t.comparators[0])
+            if a is not None and self._stores_subscript(s.body, a):
+                self._access("checkact", s.lineno, s.col_offset, a,
+                             "notin")
+        elif isinstance(t.ops[0], ast.Is) and \
+                isinstance(t.comparators[0], ast.Constant) and \
+                t.comparators[0].value is None:
+            a = self._self_attr(t.left)
+            if a is not None and self._stores_attr(s.body, a):
+                self._access("checkact", s.lineno, s.col_offset, a,
+                             "isnone")
+
+    def _stores_subscript(self, body, attr: str) -> bool:
+        for st in body:
+            for n in ast.walk(st):
+                if isinstance(n, ast.Assign):
+                    for tgt in n.targets:
+                        if isinstance(tgt, ast.Subscript) and \
+                                self._self_attr(tgt.value) == attr:
+                            return True
+        return False
+
+    def _stores_attr(self, body, attr: str) -> bool:
+        for st in body:
+            for n in ast.walk(st):
+                if isinstance(n, ast.Assign):
+                    for tgt in n.targets:
+                        if self._self_attr(tgt) == attr:
+                            return True
+        return False
+
+    # -- resource resolution -------------------------------------------
+    def resolve_resource(self, expr) -> Optional[str]:
+        """Resource key for a lock-ish expression, or None."""
+        if isinstance(expr, ast.Name):
+            n = expr.id
+            if n in self.local_res:
+                return self.local_res[n]
+            key = f"{self.sc.mod}.{n}"
+            if key in self.model.resources:
+                return key
+            if _is_locky(n):
+                self.model.add_resource(key, "lock", self.fn.path, 0)
+                return key
+            return None
+        if isinstance(expr, ast.Attribute):
+            attr = expr.attr
+            if isinstance(expr.value, ast.Name) and expr.value.id == "self" \
+                    and self.cls is not None:
+                key = self.model.attr_res.get((self.cls, attr))
+                if key is not None:
+                    return key
+                key = f"{self.cls}.{attr}"
+                if key in self.model.resources:
+                    return key
+                if _is_locky(attr):
+                    self.model.add_resource(key, "lock", self.fn.path, 0)
+                    return key
+                return None
+            # foreign attribute: unique suffix across the registry
+            if _is_locky(attr):
+                cands = [k for k in self.model.resources
+                         if k.endswith(f".{attr}")]
+                if len(cands) == 1:
+                    return cands[0]
+                owner = _last_name(expr.value) or "ext"
+                key = f"{owner}.{attr}"
+                self.model.add_resource(key, "lock", self.fn.path, 0)
+                return key
+        return None
+
+    def resolve_with_item(self, expr, line: int) -> Optional[str]:
+        """Resource a `with` item holds for its body, or None."""
+        if isinstance(expr, ast.Call):
+            f = expr.func
+            if isinstance(f, ast.Attribute):
+                if f.attr == "hold" and _is_semish(f.value):
+                    return PERMIT
+                if f.attr == "step" and _is_riderish(f.value):
+                    return PERMIT
+            return None
+        return self.resolve_resource(expr)
+
+    # -- statement walk -------------------------------------------------
+    def walk(self, stmts):
+        for s in stmts:
+            self.stmt(s)
+
+    def stmt(self, s):
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            sub = self.sc._scan_fn(s, self.cls, parent=self.fn)
+            self.fn.nested[s.name] = sub.fid
+            return
+        if isinstance(s, (ast.ClassDef, ast.Lambda)):
+            return
+        if isinstance(s, ast.With):
+            self._with(s)
+            return
+        if isinstance(s, ast.Try):
+            self.walk(s.body)
+            for h in s.handlers:
+                self.walk(h.body)
+            self.walk(s.orelse)
+            self.walk(s.finalbody)
+            return
+        if isinstance(s, (ast.If, ast.While)):
+            # snapshot BEFORE the test: a non-blocking acquire in the
+            # test (if sem.try_acquire(): ...) holds for the BODY but
+            # must not leak past the branch (PermitRider's alternating
+            # ride/real-permit loop would otherwise read as a cycle)
+            snap = list(self.held)
+            if isinstance(s, ast.If):
+                self._checkact(s)
+            self.exprs(s.test, s.lineno)
+            self.walk(s.body)
+            self.held = list(snap)
+            self.walk(s.orelse)
+            self.held = snap
+            return
+        if isinstance(s, ast.For):
+            snap = list(self.held)
+            self.exprs(s.iter, s.lineno)
+            self.walk(s.body)
+            self.held = list(snap)
+            self.walk(s.orelse)
+            self.held = snap
+            return
+        if isinstance(s, ast.Assign):
+            self._assign(s)
+            return
+        if isinstance(s, ast.AugAssign):
+            a = self._self_attr(s.target)
+            if a is not None:
+                self._access("rmw", s.lineno, s.target.col_offset, a,
+                             "aug")
+            elif isinstance(s.target, ast.Subscript):
+                a = self._self_attr(s.target.value)
+                if a is not None:
+                    self._access("rmw", s.lineno, s.target.col_offset,
+                                 a, "aug-subscript")
+            self.exprs(s.value, s.lineno)
+            return
+        if isinstance(s, ast.AnnAssign):
+            if s.value is not None:
+                self._record_store(s.target, s.lineno)
+                self.exprs(s.value, s.lineno)
+            return
+        if isinstance(s, (ast.Expr, ast.Return, ast.Assert, ast.Raise)):
+            val = getattr(s, "value", None)
+            if val is None and isinstance(s, ast.Raise):
+                val = s.exc
+            if val is not None:
+                self.exprs(val, s.lineno)
+            return
+        # everything else: still sweep for calls in child expressions
+        for child in ast.iter_child_nodes(s):
+            if isinstance(child, ast.expr):
+                self.exprs(child, s.lineno)
+            elif isinstance(child, ast.stmt):
+                self.stmt(child)
+
+    def _with(self, s: ast.With):
+        pushed: List[str] = []
+        for item in s.items:
+            ce = item.context_expr
+            if isinstance(ce, ast.Call) and _is_pool_ctor(ce):
+                key = self._pool_key_for(ce, ce.lineno)
+                if isinstance(item.optional_vars, ast.Name):
+                    self.pool_vars[item.optional_vars.id] = key
+                continue
+            r = self.resolve_with_item(ce, ce.lineno)
+            if r is not None:
+                self._push(r, ce.lineno, ce.col_offset,
+                           self.model.snippet(self.fn.path, ce.lineno))
+                pushed.append(r)
+            else:
+                self.exprs(ce, s.lineno)
+        self.walk(s.body)
+        for r in reversed(pushed):
+            self._pop(r)
+
+    def _assign(self, s: ast.Assign):
+        tgt = s.targets[0] if len(s.targets) == 1 else None
+        val = s.value
+        if isinstance(val, ast.Call):
+            kind = _threading_ctor(val)
+            ld = _lockdep_factory(val)
+            if kind is not None or ld is not None:
+                self._register_lock(tgt, val, kind, ld, s.lineno)
+                return
+            if _is_pool_ctor(val) and isinstance(tgt, ast.Name):
+                self.pool_vars[tgt.id] = self._pool_key_for(val,
+                                                            val.lineno)
+                return
+            if _last_name(val.func) in ("Queue", "SimpleQueue",
+                                        "LifoQueue") and \
+                    isinstance(tgt, ast.Name):
+                self.queue_vars.add(tgt.id)
+                return
+            # fut = pool.submit(...) keeps the pool association
+            if isinstance(val.func, ast.Attribute) and \
+                    val.func.attr == "submit" and isinstance(tgt, ast.Name):
+                pk = self._submit(val)
+                if pk is not None:
+                    self.fut_pools[tgt.id] = pk
+                    return
+        cname = _ctor_name(val) if isinstance(val, ast.Call) else None
+        if cname is not None:
+            shape = ("local" if isinstance(tgt, ast.Name) else "stored")
+            self.model.ctors.setdefault(cname, []).append(shape)
+            self._ctor_seen.add(id(val))
+            if shape == "local":
+                self.ctor_vars[tgt.id] = cname
+        elif isinstance(val, ast.Name) and val.id in self.ctor_vars:
+            # a locally-constructed instance stored into an attribute
+            # or container escapes its creating thread
+            for t in s.targets:
+                if not isinstance(t, ast.Name):
+                    self.model.ctors.setdefault(
+                        self.ctor_vars[val.id], []).append("stored")
+        wclass = "handoff" if self._is_handoff_value(val) else ""
+        for t in s.targets:
+            self._record_store(t, s.lineno, wclass)
+        # publish-before-init: `REGISTRY[k] = self` (or any non-self
+        # container slot) inside __init__ makes the instance visible to
+        # other threads before construction completes
+        if self.fn.name == "__init__" and isinstance(val, ast.Name) \
+                and val.id == "self":
+            for t in s.targets:
+                if isinstance(t, ast.Subscript) and \
+                        self._self_attr(t.value) is None:
+                    sink = _last_name(t.value) or "?"
+                    self._access("publish", s.lineno, t.col_offset,
+                                 sink, "store")
+        self.exprs(val, s.lineno)
+
+    def _register_lock(self, tgt, call: ast.Call, kind, ld, line: int):
+        if ld is not None:
+            key, kind = ld
+        elif isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self" \
+                and self.cls is not None:
+            key = f"{self.cls}.{tgt.attr}"
+        elif isinstance(tgt, ast.Name):
+            key = f"{self.sc.mod}.{self.fn.name}.{tgt.id}"
+            self.local_res[tgt.id] = key
+        else:
+            return
+        self.model.add_resource(key, kind, self.fn.path, line)
+        if isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self" \
+                and self.cls is not None:
+            self.model.attr_res[(self.cls, tgt.attr)] = key
+        if kind == "cond":
+            paired = None
+            if call.args:
+                paired = self.resolve_resource(call.args[0])
+            self.model.cond_pairs[key] = paired
+
+    # -- expression / call classification -------------------------------
+    def exprs(self, expr, line: int):
+        skip: Set[int] = set()
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self.call(node)
+                f = node.func
+                if isinstance(f, ast.Attribute) and \
+                        isinstance(f.value, ast.Call):
+                    cn = _ctor_name(f.value)
+                    if cn is not None and id(f.value) not in \
+                            self._ctor_seen:
+                        # `_Parser(src).parse()`: a temporary receiver
+                        # stays on the constructing thread
+                        self._ctor_seen.add(id(f.value))
+                        self.model.ctors.setdefault(cn, []).append(
+                            "recv")
+                cn = _ctor_name(node)
+                if cn is not None and id(node) not in self._ctor_seen:
+                    self._ctor_seen.add(id(node))
+                    self.model.ctors.setdefault(cn, []).append(
+                        "escaped")
+                if isinstance(f, ast.Attribute):
+                    recv = f.value
+                    if isinstance(recv, ast.Name) and recv.id == "self":
+                        skip.add(id(f))   # self.method(): not a data read
+                        continue
+                    a = self._self_attr(recv)
+                    if a is not None and f.attr in _MUTATORS:
+                        # self.attr.append(x): shared-container mutation
+                        self._access("write", node.lineno,
+                                     node.col_offset, a,
+                                     f"method:{f.attr}")
+                        skip.add(id(recv))
+                    elif isinstance(recv, ast.Subscript):
+                        a2 = self._self_attr(recv.value)
+                        if a2 is not None and f.attr in _MUTATORS:
+                            # self.attr[k].append(x): slot RMW
+                            self._access("rmw", node.lineno,
+                                         node.col_offset, a2,
+                                         f"method:{f.attr}")
+                            skip.add(id(recv.value))
+                    if self.fn.name == "__init__" and \
+                            f.attr in _PUBLISH_SINKS and \
+                            self._self_attr(f.value) is None and \
+                            any(isinstance(arg, ast.Name)
+                                and arg.id == "self"
+                                for arg in node.args):
+                        self._access("publish", node.lineno,
+                                     node.col_offset,
+                                     _last_name(f.value) or "?",
+                                     f"sink:{f.attr}")
+            elif isinstance(node, ast.Attribute) and \
+                    id(node) not in skip and \
+                    isinstance(node.ctx, ast.Load):
+                a = self._self_attr(node)
+                if a is not None:
+                    self._access("read", node.lineno, node.col_offset, a)
+
+    def call(self, c: ast.Call):
+        f = c.func
+        line, col = c.lineno, c.col_offset
+        desc = self.model.snippet(self.fn.path, line)
+        # nested functions passed as arguments (with_retry(batch,
+        # map_one)) run with the caller's held-set: edge them —
+        # checking the whole lexical scope chain
+        for arg in c.args:
+            if isinstance(arg, ast.Name):
+                cur = self.fn
+                while cur is not None:
+                    if arg.id in cur.nested:
+                        self._call_edge(("local", arg.id), line)
+                        break
+                    cur = (self.model.funcs.get(cur.parent)
+                           if cur.parent else None)
+        if isinstance(f, ast.Name):
+            name = f.id
+            if name == "fetch":
+                self._emit(Event("sync", line, col, desc))
+            elif name == "as_completed":
+                self._emit(Event("wait", line, col, desc, blocking=True,
+                                 wclass="future"))
+            elif name == "recv_msg":
+                self._emit(Event("wait", line, col, desc, blocking=True,
+                                 wclass="socket"))
+            elif name in ("Thread",):
+                self._thread(c)
+            elif name not in ("print", "len", "range", "isinstance",
+                              "int", "float", "str", "list", "dict",
+                              "set", "tuple", "max", "min", "sorted",
+                              "enumerate", "zip", "super", "getattr",
+                              "hasattr", "setattr", "iter", "next",
+                              "type", "repr", "id", "abs", "sum",
+                              "round", "bool", "bytes", "open",
+                              "frozenset", "divmod", "map", "filter",
+                              "any", "all", "vars", "callable"):
+                self._call_edge(("local", name), line)
+            return
+        if not isinstance(f, ast.Attribute):
+            return
+        attr = f.attr
+        base = f.value
+        if attr == "Thread" and _last_name(base) == "threading":
+            self._thread(c)
+            return
+        if attr == "acquire":
+            self._acquire(c, base, line, col, desc)
+            return
+        if attr == "try_acquire" and _is_semish(base):
+            self._emit(Event("acquire", line, col, desc, resource=PERMIT))
+            self.held.append(PERMIT)
+            return
+        if attr == "release":
+            r = (PERMIT if _is_semish(base) or _is_riderish(base)
+                 else self.resolve_resource(base))
+            if r is not None:
+                self._emit(Event("release", line, col, desc, resource=r))
+                self._pop(r)
+            return
+        if attr == "result":
+            bn = _last_name(base)
+            futish = bn in self.fut_pools or (
+                bn and ("fut" in bn.lower() or bn == "f"))
+            if futish:
+                blocking = not c.args and not c.keywords
+                self._emit(Event("wait", line, col, desc,
+                                 blocking=blocking, wclass="future",
+                                 pool=self.fut_pools.get(bn)))
+            return
+        if attr == "as_completed":
+            self._emit(Event("wait", line, col, desc, blocking=True,
+                             wclass="future"))
+            return
+        if attr == "submit":
+            self._submit(c)
+            return
+        if attr == "map":
+            bn = _last_name(base)
+            if bn in self.pool_vars:
+                pk = self.pool_vars[bn]
+                if c.args:
+                    self._worker(pk, c.args[0])
+                self._emit(Event("submit", line, col, desc, pool=pk))
+                self._emit(Event("wait", line, col, desc, blocking=True,
+                                 wclass="future", pool=pk))
+            return
+        if attr == "get":
+            bn = _last_name(base)
+            if bn in self.queue_vars or (
+                    bn and (bn in ("q", "queue") or bn.endswith("_q")
+                            or bn.endswith("_queue"))):
+                blocking = not c.args and _kw(c, "timeout") is None \
+                    and _kw(c, "block") is None
+                self._emit(Event("wait", line, col, desc,
+                                 blocking=blocking, wclass="queue"))
+            return
+        if attr == "wait":
+            self._wait(c, base, line, col, desc)
+            return
+        if attr in ("recv", "recvall", "recv_into", "accept", "recv_msg"):
+            self._emit(Event("wait", line, col, desc, blocking=True,
+                             wclass="socket"))
+            return
+        if attr == "block_until_ready" or attr == "device_get":
+            self._emit(Event("sync", line, col, desc))
+            return
+        if isinstance(base, ast.Name) and base.id == "self":
+            self._call_edge(("self", attr), line)
+            return
+        self._call_edge(("attr", attr), line)
+
+    def _acquire(self, c, base, line, col, desc):
+        blocking = True
+        if c.args and isinstance(c.args[0], ast.Constant) and \
+                c.args[0].value in (False, 0):
+            blocking = False
+        bl = _kw(c, "blocking")
+        if isinstance(bl, ast.Constant) and bl.value in (False, 0):
+            blocking = False
+        if _kw(c, "timeout") is not None:
+            blocking = False
+        if _is_semish(base):
+            # TpuSemaphore.acquire: blocking device admission (its
+            # internal token poll does not bound the wait for a permit)
+            self._emit(Event("wait", line, col, desc, blocking=blocking,
+                             wclass="sem", resource=PERMIT))
+            self._emit(Event("acquire", line, col, desc, resource=PERMIT))
+            self.held.append(PERMIT)
+            return
+        r = self.resolve_resource(base)
+        if r is not None:
+            if blocking:
+                self._push(r, line, col, desc)
+            else:
+                self._emit(Event("acquire", line, col, desc, resource=r))
+                self.held.append(r)
+
+    def _wait(self, c, base, line, col, desc):
+        blocking = not c.args and _kw(c, "timeout") is None
+        exempt: frozenset = frozenset()
+        r = self.resolve_resource(base)
+        if r is not None and self.model.resources.get(r) == "cond":
+            # Condition.wait releases its lock while parked
+            paired = self.model.cond_pairs.get(r)
+            exempt = frozenset(k for k in (r, paired) if k)
+        self._emit(Event("wait", line, col, desc, blocking=blocking,
+                         wclass="cond" if exempt else "event",
+                         exempt=exempt))
+
+    def _submit(self, c: ast.Call) -> Optional[str]:
+        f = c.func
+        base = f.value
+        bn = _last_name(base)
+        pk = self.pool_vars.get(bn)
+        if pk is None and isinstance(base, ast.Call):
+            # _build_pool().submit(...): resolve through the factory
+            ref = ("local", _last_name(base.func) or "")
+            callee = self.model.resolve_ref(self.fn, ref)
+            pk = f"factory:{_last_name(base.func)}" \
+                if callee is None else None
+            if callee is not None:
+                pk = self._factory_pool(callee)
+        if pk is None and bn and "pool" in bn.lower():
+            pk = f"{self.sc.mod}.{bn}"
+        if pk is None:
+            return None
+        if c.args:
+            self._worker(pk, c.args[0])
+        self._emit(Event("submit", c.lineno, c.col_offset,
+                         self.model.snippet(self.fn.path, c.lineno),
+                         pool=pk))
+        return pk
+
+    def _factory_pool(self, fid: str) -> Optional[str]:
+        """Pool key created inside a factory function (e.g.
+        _build_pool): the unique pool whose creation site is in it."""
+        fn = self.model.funcs.get(fid)
+        if fn is None:
+            return None
+        cands = [k for k, p in self.model.pools.items()
+                 if p.mod == fn.mod and any(
+                     fn.line <= ln for ln in p.sites)]
+        return cands[0] if len(cands) == 1 else None
+
+    def _worker(self, pool_key: str, arg):
+        ref = None
+        if isinstance(arg, ast.Name):
+            ref = ("local", arg.id)
+        elif isinstance(arg, ast.Attribute) and \
+                isinstance(arg.value, ast.Name) and arg.value.id == "self":
+            ref = ("self", arg.attr)
+        if ref is not None:
+            p = self.model.pools.get(pool_key)
+            if p is None:
+                p = PoolInfo(pool_key, self.sc.mod, self.fn.path, 0)
+                self.model.pools[pool_key] = p
+            p.workers.append((self.fn.fid, ref))
+
+    def _thread(self, c: ast.Call):
+        tgt = _kw(c, "target")
+        name = _kw(c, "name")
+        ref = None
+        if isinstance(tgt, ast.Name):
+            ref = ("local", tgt.id)
+        elif isinstance(tgt, ast.Attribute) and \
+                isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            ref = ("self", tgt.attr)
+        nm = name.value if isinstance(name, ast.Constant) and \
+            isinstance(name.value, str) else None
+        if ref is not None:
+            self.model.thread_targets.append((self.fn.fid, ref, nm))
+        # a Thread construction is a spawn point like pool.submit:
+        # writes that lexically precede the function's first spawn are
+        # single-threaded (the race auditor's init-before-first-submit
+        # exemption); pool=None keeps pool-self-wait indifferent
+        self._emit(Event("submit", c.lineno, c.col_offset,
+                         self.model.snippet(self.fn.path, c.lineno),
+                         wclass="thread"))
+
+
+# ---------------------------------------------------------------------
+# model building
+# ---------------------------------------------------------------------
+def _iter_py(paths: List[str]) -> List[str]:
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d != "__pycache__"]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        files.append(os.path.join(dirpath, fn))
+        else:
+            files.append(p)
+    return files
+
+
+def _mod_name(path: str, rel_to: Optional[str]) -> str:
+    rel = os.path.relpath(path, rel_to) if rel_to else path
+    rel = rel.replace(os.sep, "/")
+    stem = rel[:-3] if rel.endswith(".py") else rel
+    parts = [p for p in stem.split("/") if p]
+    if parts and parts[0] == "spark_rapids_tpu":
+        parts = parts[1:]
+    if parts and parts[-1] == "__init__":
+        parts = parts[:-1]
+    return ".".join(parts) or "root"
+
+
+def build_model(paths: List[str],
+                rel_to: Optional[str] = None) -> Model:
+    model = Model()
+    for f in _iter_py(paths):
+        rel = (os.path.relpath(f, rel_to) if rel_to else f)
+        rel = rel.replace(os.sep, "/")
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            _Scanner(model, _mod_name(f, rel_to), rel, src).scan()
+        except SyntaxError:
+            continue
+    return model
+
+
+# ---------------------------------------------------------------------
+# shared allow-marker filtering
+# ---------------------------------------------------------------------
+def _allowed(markers: Dict[int, Tuple[Set[str], bool]], rule: str,
+             line: int) -> bool:
+    for ln in (line, line - 1):
+        m = markers.get(ln)
+        if m and rule in m[0]:
+            return True
+    return False
+
+
+def _file_markers(lines: List[str]) -> Dict[int, Tuple[Set[str], bool]]:
+    markers: Dict[int, Tuple[Set[str], bool]] = {}
+    for i, raw in enumerate(lines, start=1):
+        m = MARKER_RE.search(raw)
+        if m:
+            rules = {r.strip() for r in m.group(1).split(",")
+                     if r.strip()}
+            markers[i] = (rules, bool(m.group(2).strip()))
+    return markers
+
+
+def filter_markers(model: Model, violations: list) -> list:
+    """Drop violations whose site (or the line above) carries an
+    inline `# tpulint: allow[rule] reason` marker."""
+    out = []
+    marker_cache: Dict[str, Dict[int, Tuple[Set[str], bool]]] = {}
+    for v in violations:
+        mk = marker_cache.get(v.path)
+        if mk is None:
+            mk = _file_markers(model.lines.get(v.path, []))
+            marker_cache[v.path] = mk
+        if not _allowed(mk, v.rule, v.line):
+            out.append(v)
+    return out

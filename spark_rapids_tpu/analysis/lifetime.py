@@ -32,7 +32,7 @@ marker and baseline machinery as lint_rules.py / concurrency.py):
                         the corresponding parameter: nobody owns it on
                         the worker's error path.
 
-Call resolution for unbalanced-transfer reuses concurrency.py's Model
+Call resolution for unbalanced-transfer reuses analysis/core.py's Model
 (lexical-scope chain, unique-method heuristic); allow-markers
 (`# tpulint: allow[rule] reason`) and the JSON baseline flow through
 tools/tpulint.py --lifetime exactly like the other analyzers.
@@ -49,9 +49,9 @@ import ast
 import os
 from typing import Dict, List, Optional, Tuple
 
-from .concurrency import (Model, _allowed, _file_markers, _is_riderish,
-                          _is_semish, _iter_py, _last_name, _mod_name,
-                          build_model)
+from .core import (Model, _allowed, _file_markers, _is_riderish,
+                   _is_semish, _iter_py, _last_name, _mod_name,
+                   build_model)
 from .lint_rules import Violation
 
 __all__ = ["LIFETIME_RULES", "analyze_paths", "analyze_source"]

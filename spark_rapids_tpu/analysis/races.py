@@ -1,0 +1,425 @@
+"""Static data-race auditor: Eraser-style lockset analysis.
+
+The concurrency auditor (deadlocks) and the lifetime auditor (leaks)
+leave a third fatal class uncovered: unsynchronized access to shared
+mutable state. The engine runs every query across ~15 named thread
+pools while promising byte-identical results; a single unlocked
+read-modify-write on a shared counter or a check-then-act slot
+creation in a shuffle map can silently break that. This pass is the
+static half of the race tooling (the runtime half is
+runtime/racedep.py): an Eraser-style lockset analysis over the model
+built by analysis/core.py.
+
+Access model
+------------
+core.py's walker records every ``self.attr`` access in every method
+with the lexically-held lockset: plain reads, stores (including
+``self.attr[k] = v`` and container mutators like
+``self.attr.append(x)``), read-modify-writes (``self.x += 1``,
+``self.attr[k].append(x)``), check-then-act shapes (``if k not in
+self.d: self.d[k] = ...`` / ``if self.x is None: self.x = ...``) and
+``self``-escapes during ``__init__``. Accesses are composed
+interprocedurally from thread ROOTS — functions nobody calls, pool
+worker targets (resolved from ``pool.submit(fn)`` exactly as the
+concurrency auditor resolves them) and ``threading.Thread`` targets —
+so an access site's lockset is the INTERSECTION over every realizable
+path to it, and its thread-context set is the union of root contexts
+(``query`` for caller-thread code, ``pool:<prefix>`` per named pool,
+``thread:<name>`` per dedicated thread). A pool context is inherently
+multi-threaded: one pool reaching an attr already means concurrent
+access.
+
+Rules
+-----
+  unlocked-shared-write  attr written from >= 2 contexts (or written
+                         in one and read in another) with an empty
+                         lockset intersection across the accesses
+  compound-rmw           ``self.x += 1`` / ``self.d[k].append(v)`` on
+                         a shared attr outside any lock — the GIL
+                         makes each bytecode atomic, not the
+                         read-modify-write
+  check-then-act         ``if k not in self.d: self.d[k] = ...`` /
+                         ``if self.x is None: self.x = ...`` on shared
+                         state without a lock: two threads both pass
+                         the check
+  publish-before-init    ``self`` stored into a cross-thread-visible
+                         structure (registry slot, queue, pool) before
+                         all fields are assigned in ``__init__``
+
+Exemptions (principled, not noise suppression)
+----------------------------------------------
+  init-before-first-submit  writes in ``__init__`` (and ``_init*``
+                            helpers), or writes that lexically precede
+                            the function's first pool submission:
+                            nothing else can run yet
+  immutable-after-publish   attrs whose every write is init-phase:
+                            concurrent reads of frozen state are fine
+  queue/Future hand-off     attrs assigned from Queue/Executor/Future
+                            constructors or ``.get()``/``.result()``:
+                            the object IS the synchronization point
+  lockdep-guarded           a non-empty lockset intersection (plain or
+                            lockdep-wrapped locks) is the fix, not a
+                            finding
+
+Remaining intentional sites carry the shared inline marker::
+
+    self._hits += 1  # tpulint: allow[compound-rmw] stats are advisory
+
+Violations share lint_rules' (path, rule, snippet) identity; the
+baseline (tools/tpulint_races_baseline.json) is committed EMPTY and
+`tools/tpulint.py --races --check` keeps it that way.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set
+
+from .core import (Model, PERMIT, build_model, filter_markers)
+from .lint_rules import Violation
+
+__all__ = ["RACE_RULES", "analyze_model", "analyze_paths"]
+
+RACE_RULES = ("unlocked-shared-write", "compound-rmw", "check-then-act",
+              "publish-before-init")
+
+#: per-function cap on composed access entries (same role as
+#: core._SUMMARY_CAP for synchronization events; accesses are denser
+#: because every `self.attr` read counts, so the cap is higher)
+_ACCESS_CAP = 800
+
+
+# ---------------------------------------------------------------------
+# thread contexts
+# ---------------------------------------------------------------------
+def _worker_roots(model: Model) -> Dict[str, Set[str]]:
+    """fid -> context labels for resolved pool-worker / Thread
+    targets."""
+    roots: Dict[str, Set[str]] = {}
+    for pkey, pool in model.pools.items():
+        for owner_fid, ref in pool.workers:
+            owner = model.funcs.get(owner_fid)
+            fid = model.resolve_ref(owner, ref) if owner else None
+            if fid is not None:
+                roots.setdefault(fid, set()).add(f"pool:{pkey}")
+    for owner_fid, ref, nm in model.thread_targets:
+        owner = model.funcs.get(owner_fid)
+        fid = model.resolve_ref(owner, ref) if owner else None
+        if fid is not None:
+            roots.setdefault(fid, set()).add(f"thread:{nm or ref[1]}")
+    return roots
+
+
+def _contexts(model: Model,
+              wroots: Dict[str, Set[str]]) -> Dict[str, Set[str]]:
+    """fid -> every thread context that can execute it. Worker contexts
+    propagate through each worker target's call closure; everything
+    reachable from non-worker functions additionally runs on the
+    caller ('query') thread."""
+    ctx: Dict[str, Set[str]] = {fid: set() for fid in model.funcs}
+    by_label: Dict[str, List[str]] = {}
+    for fid, labels in wroots.items():
+        for lb in labels:
+            by_label.setdefault(lb, []).append(fid)
+    for lb, roots in sorted(by_label.items()):
+        for fid in model.reachable_from(roots):
+            if fid in ctx:
+                ctx[fid].add(lb)
+    main_roots = [fid for fid in model.funcs if fid not in wroots]
+    for fid in model.reachable_from(main_roots):
+        if fid in ctx:
+            ctx[fid].add("query")
+    return ctx
+
+
+def _roots(model: Model, wroots: Dict[str, Set[str]]) -> List[str]:
+    """Realization roots: true entry points (no static caller), every
+    worker/thread target, plus any function left uncovered (methods
+    only reachable through polymorphic calls the resolver skips)."""
+    called: Set[str] = set()
+    for fn in model.funcs.values():
+        for ref, _line, _held in fn.calls:
+            callee = model.resolve_ref(fn, ref)
+            if callee is not None:
+                called.add(callee)
+    roots = [fid for fid in model.funcs if fid not in called]
+    roots += [fid for fid in wroots if fid in called]
+    covered = model.reachable_from(roots)
+    roots += [fid for fid in model.funcs if fid not in covered]
+    return roots
+
+
+# ---------------------------------------------------------------------
+# interprocedural access composition
+# ---------------------------------------------------------------------
+def _summarize_accesses(model: Model, fid: str, memo: dict,
+                        _stack: Optional[set] = None) -> list:
+    """(access-event, held-keys, site-fid) realizable by calling `fid`,
+    held-sets relative to its entry — core.Model.summarize over the
+    access stream instead of the synchronization stream."""
+    if fid in memo:
+        return memo[fid]
+    stack = _stack if _stack is not None else set()
+    if fid in stack:
+        return []
+    stack.add(fid)
+    fn = model.funcs[fid]
+    out: List[tuple] = []
+    for ev, held in fn.accesses:
+        out.append((ev, held, fid))
+    for ref, _line, held in fn.calls:
+        callee = model.resolve_ref(fn, ref)
+        if callee is None or callee == fid:
+            continue
+        for ev, add_held, site in _summarize_accesses(model, callee,
+                                                      memo, stack):
+            out.append((ev, held | add_held, site))
+            if len(out) >= _ACCESS_CAP:
+                break
+        if len(out) >= _ACCESS_CAP:
+            break
+    stack.discard(fid)
+    out = out[:_ACCESS_CAP]
+    memo[fid] = out
+    return out
+
+
+class _Site:
+    """One access site with facts merged across every realization."""
+
+    __slots__ = ("ev", "fid", "held", "ctxs", "init", "handoff",
+                 "pre_submit")
+
+    def __init__(self, ev, fid, held, ctxs):
+        self.ev = ev
+        self.fid = fid
+        self.held = set(held)     # lockset INTERSECTION across paths
+        self.ctxs = set(ctxs)     # context UNION across paths
+        self.init = False
+        self.handoff = ev.wclass == "handoff"
+        self.pre_submit = False
+
+
+def _locks(model: Model, held) -> Set[str]:
+    """Mutual-exclusion members of a held-set (permits are counted
+    admission, not exclusion). A Condition constructed over a lock IS
+    that lock: canonicalize through cond_pairs so `with self._cond:`
+    and `with self._lock:` intersect non-empty."""
+    out = set()
+    for h in held:
+        if h == PERMIT:
+            continue
+        out.add(model.cond_pairs.get(h) or h)
+    return out
+
+
+def _in_init(model: Model, fid: str) -> bool:
+    """True when `fid` is __init__ / an _init* helper, or nested in
+    one (construction-phase code: single-threaded by contract)."""
+    fn = model.funcs.get(fid)
+    while fn is not None:
+        if fn.name == "__init__" or fn.name.startswith("_init"):
+            return True
+        fn = model.funcs.get(fn.parent) if fn.parent else None
+    return False
+
+
+def _first_submit_line(model: Model, fid: str) -> Optional[int]:
+    fn = model.funcs.get(fid)
+    if fn is None:
+        return None
+    lines = [ev.line for ev, _h in fn.events if ev.kind == "submit"]
+    return min(lines) if lines else None
+
+
+def _confined_classes(model: Model,
+                      wroots: Dict[str, Set[str]]) -> Set[str]:
+    """Classes whose instances are thread-confined: every observed
+    constructor site is a plain local assignment or a temporary method
+    receiver, and no method of the class is a pool-worker/Thread
+    target. Many contexts can run `_Parser.next` — each on its own
+    per-call instance; that is not sharing."""
+    rootcls: Set[str] = set()
+    for fid in wroots:
+        fn = model.funcs.get(fid)
+        if fn is not None and fn.cls:
+            rootcls.add(fn.cls)
+    out: Set[str] = set()
+    for (_mod, cls, _name) in model.methods:
+        if cls in rootcls or cls in out:
+            continue
+        shapes = model.ctors.get(cls)
+        if shapes and all(sh in ("local", "recv") for sh in shapes):
+            out.add(cls)
+    return out
+
+
+def _shared(ctxs: Set[str]) -> bool:
+    """Two distinct contexts, or any pool context (a pool's own
+    workers already race each other)."""
+    return len(ctxs) >= 2 or any(c.startswith("pool:") for c in ctxs)
+
+
+def _collect_sites(model: Model, wroots: Dict[str, Set[str]]
+                   ) -> Dict[tuple, _Site]:
+    ctx = _contexts(model, wroots)
+    memo: dict = {}
+    sites: Dict[tuple, _Site] = {}
+    for root in _roots(model, wroots):
+        rctx = ctx.get(root) or {"query"}
+        for ev, held, site_fid in _summarize_accesses(model, root, memo):
+            path = model.funcs[site_fid].path
+            k = (path, ev.line, ev.col, ev.kind, ev.resource, ev.wclass)
+            s = sites.get(k)
+            if s is None:
+                sites[k] = _Site(ev, site_fid, held, rctx)
+            else:
+                s.held &= set(held)
+                s.ctxs |= rctx
+    for s in sites.values():
+        s.init = _in_init(model, s.fid)
+        if not s.init:
+            first = _first_submit_line(model, s.fid)
+            if first is not None and s.ev.line < first:
+                s.pre_submit = True
+    return sites
+
+
+# ---------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------
+def analyze_model(model: Model) -> List[Violation]:
+    wroots = _worker_roots(model)
+    sites = _collect_sites(model, wroots)
+    confined = _confined_classes(model, wroots)
+    out: List[Violation] = []
+    seen: Set[tuple] = set()
+
+    def add(path: str, line: int, col: int, rule: str, msg: str):
+        k = (path, line, rule)
+        if k in seen:
+            return
+        seen.add(k)
+        out.append(Violation(path, line, col, rule, msg,
+                             model.snippet(path, line)))
+
+    # group per class.attr
+    attrs: Dict[str, List[_Site]] = {}
+    for s in sites.values():
+        if s.ev.kind == "publish":
+            continue
+        attrs.setdefault(s.ev.resource, []).append(s)
+
+    for key in sorted(attrs):
+        if key.split(".", 1)[0] in confined:
+            continue
+        acc = attrs[key]
+        # queue/Future/Event hand-off: the attr holds a synchronization
+        # object (assigned from a Queue/Executor/Future constructor or
+        # received through .get()/.result()); mutating method calls on
+        # it (`self._idle.clear()`) are synchronized operations
+        if any(s.ev.wclass == "handoff" for s in acc):
+            continue
+        ctxs: Set[str] = set()
+        for s in acc:
+            ctxs |= s.ctxs
+        shared = _shared(ctxs)
+
+        writes = [s for s in acc if s.ev.kind in ("write", "rmw")]
+        eff_writes = sorted(
+            (s for s in writes
+             if not (s.init or s.handoff or s.pre_submit)),
+            key=lambda s: (s.ev.line, s.ev.col))
+        # immutable-after-publish / init-only / pure hand-off: no
+        # post-construction raw write -> nothing to race on
+        if eff_writes and shared:
+            racy = eff_writes + sorted(
+                (s for s in acc
+                 if s.ev.kind in ("read", "checkact") and not s.init),
+                key=lambda s: (s.ev.line, s.ev.col))
+            lockset = _locks(model, racy[0].held)
+            for s in racy[1:]:
+                lockset &= _locks(model, s.held)
+            if not lockset:
+                # anchor at the first UNLOCKED access (write preferred)
+                # so the finding — and any allow-marker — lands on the
+                # site missing the lock, not on a correctly-locked
+                # write whose counterpart read is the actual hazard
+                unlocked = [s for s in racy if not _locks(model, s.held)]
+                w = next((s for s in unlocked
+                          if s.ev.kind in ("write", "rmw")),
+                         unlocked[0] if unlocked else eff_writes[0])
+                wr = w.ev.kind in ("write", "rmw")
+                # counterpart: a write when the anchor is a read, any
+                # other access when the anchor is a write
+                other = next(
+                    (s for s in racy
+                     if s is not w
+                     and (wr or s.ev.kind in ("write", "rmw"))), w)
+                fn = model.funcs[w.fid]
+                verb = "written" if wr else "read"
+                add(fn.path, w.ev.line, w.ev.col,
+                    "unlocked-shared-write",
+                    f"{key} is {verb} unlocked in {fn.qual} and "
+                    f"accessed from contexts {sorted(ctxs)} with no "
+                    f"common lock (counterpart at "
+                    f"{model.funcs[other.fid].path}:{other.ev.line}) — "
+                    f"guard every access with one lock, or make the "
+                    f"attr immutable after construction")
+
+        if not shared:
+            continue
+        for s in sorted(acc, key=lambda s: (s.ev.line, s.ev.col)):
+            if s.init or s.handoff or s.pre_submit:
+                continue
+            if _locks(model, s.held):
+                continue
+            fn = model.funcs[s.fid]
+            if s.ev.kind == "rmw":
+                add(fn.path, s.ev.line, s.ev.col, "compound-rmw",
+                    f"read-modify-write of shared {key} in {fn.qual} "
+                    f"({s.ev.wclass}) outside any lock — the GIL does "
+                    f"not make `+=`/slot-mutation atomic; contexts "
+                    f"{sorted(ctxs)} can interleave and lose updates")
+            elif s.ev.kind == "checkact":
+                add(fn.path, s.ev.line, s.ev.col, "check-then-act",
+                    f"check-then-act on shared {key} in {fn.qual} "
+                    f"({s.ev.wclass}) without a lock — two contexts "
+                    f"({sorted(ctxs)}) can both pass the check and "
+                    f"double-create/overwrite the slot; hold a lock "
+                    f"across test and store (or use setdefault)")
+
+    # publish-before-init: self escapes __init__ before the last field
+    # assignment (another thread can observe a half-built instance)
+    for fid in sorted(model.funcs):
+        fn = model.funcs[fid]
+        if fn.name != "__init__":
+            continue
+        pubs = [ev for ev, _h in fn.accesses if ev.kind == "publish"]
+        if not pubs:
+            continue
+        field_writes = [ev for ev, _h in fn.accesses
+                        if ev.kind in ("write", "rmw")]
+        last = max((ev.line for ev in field_writes), default=0)
+        for pub in pubs:
+            if pub.line < last:
+                add(fn.path, pub.line, pub.col, "publish-before-init",
+                    f"{fn.qual} publishes `self` into "
+                    f"`{pub.resource.split('.', 1)[1]}` "
+                    f"({pub.wclass}) at line {pub.line} before its "
+                    f"last field assignment at line {last} — another "
+                    f"thread can observe a half-constructed instance; "
+                    f"publish as the final statement")
+
+    out.sort(key=lambda v: (v.path, v.line, v.rule))
+    return out
+
+
+# ---------------------------------------------------------------------
+# entry point
+# ---------------------------------------------------------------------
+def analyze_paths(paths: List[str], rel_to: Optional[str] = None,
+                  model: Optional[Model] = None) -> List[Violation]:
+    """Build the model, run the race rules, drop marker-allowed sites.
+    Violations share lint_rules' (path, rule, snippet) identity, so
+    tpulint's baseline/diff machinery applies unchanged."""
+    model = model or build_model(paths, rel_to)
+    return filter_markers(model, analyze_model(model))

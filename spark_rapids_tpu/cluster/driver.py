@@ -122,15 +122,16 @@ class ClusterManager:
         accept = threading.Thread(target=self._accept_loop, daemon=True,
                                   name="tpu-driver-accept")
         accept.start()
-        self._threads.append(accept)
         mon = threading.Thread(target=self._monitor_loop, daemon=True,
                                name="tpu-driver-monitor")
         mon.start()
-        self._threads.append(mon)
         disp = threading.Thread(target=self._dispatch_loop, daemon=True,
                                 name="tpu-driver-dispatch")
         disp.start()
-        self._threads.append(disp)
+        # _threads is also appended from the accept loop once it is
+        # running; every mutation goes through self._lock
+        with self._lock:
+            self._threads.extend([accept, mon, disp])
         # wait for registrations
         deadline = time.time() + 30
         while time.time() < deadline:
@@ -247,14 +248,16 @@ class ClusterManager:
                                        args=(eid, sock), daemon=True,
                                        name=f"tpu-driver-send-{eid}")
                 st_.start()
-                self._threads.extend([rt, st_])
+                with self._lock:
+                    self._threads.extend([rt, st_])
                 self._idle.put(eid)
             elif kind == "hb_register":
                 ht = threading.Thread(target=self._hb_loop,
                                       args=(eid, sock), daemon=True,
                                       name=f"tpu-driver-hb-{eid}")
                 ht.start()
-                self._threads.append(ht)
+                with self._lock:
+                    self._threads.append(ht)
             else:
                 sock.close()
 

@@ -819,6 +819,22 @@ LEDGER_POISON = _conf(
     "latent use-after-release reads (the PR 4 corruption class) into "
     "deterministic garbage instead of data-dependent flakes. Debug "
     "mode: adds a memset per lease release.", bool)
+RACEDEP_ENABLED = _conf(
+    "sql.debug.racedep.enabled", False,
+    "Runtime data-race witness (runtime/racedep.py): Eraser-style "
+    "lockset tracking on instrumented shared structures (program "
+    "cache observed table, telemetry registry, result-cache LRU, "
+    "shuffle map-file slots, operator metric sets), recording "
+    "(thread, lockset) per access and reporting when a shared slot's "
+    "candidate lockset collapses to empty. Locks created before the "
+    "session exist are only lockset-visible when env SRTPU_RACEDEP=1 "
+    "was set before import. Debug tool; overhead is small (<3% on "
+    "instrumented query paths) but nonzero.", bool)
+RACEDEP_RAISE = _conf(
+    "sql.debug.racedep.raiseOnRace", True,
+    "With racedep enabled: raise DataRaceDetected at the access that "
+    "collapses a shared slot's lockset (fail fast). False records "
+    "findings for the race_report event without raising.", bool)
 
 
 class TpuConf:

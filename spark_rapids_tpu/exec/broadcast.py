@@ -90,6 +90,7 @@ class BroadcastExchangeExec(TpuExec):
 
     def build_done(self) -> bool:
         """Whether the materialized build is ready without blocking."""
+        # tpulint: allow[unlocked-shared-write] monotonic None->list memo written under _lock; a stale None only reports not-ready
         if self._batches is not None:
             return True
         f = self._future

@@ -244,8 +244,10 @@ class ParquetScanExec(TpuExec):
         if got is None:
             from ..io.dv import load_dv_positions
             root, desc = self.dv[path]
-            got = set(load_dv_positions(root, desc))
-            self._dv_cache[path] = got
+            # concurrent scan workers may both miss; setdefault keeps
+            # one winner so every caller shares a single row set
+            got = self._dv_cache.setdefault(
+                path, set(load_dv_positions(root, desc)))
         return got
 
     def _device_decode_on(self, ctx) -> bool:

@@ -41,6 +41,10 @@ typed events the profiling tool post-processes:
                 (resource-lifetime ledger, runtime/ledger.py, when
                  enabled — per-kind acquire/release counters and the
                  per-query balance verdicts)
+  race_report   {enabled, tracked, shared, accesses, findings,
+                 perturbed}  (data-race witness, runtime/racedep.py,
+                 when enabled — Eraser lockset tracking over the
+                 instrumented shared structures)
   trace_span    {trace_id, span_id, parent_id, name, kind, start_ns,
                  end_ns, dur_ms, proc, attrs?}  (distributed tracing,
                  profiler/tracing.py — the query's assembled spans,
@@ -308,13 +312,16 @@ def profile_query(session, root, ctx, action: str, handle=None):
         try:
             w.emit("op_metrics", ops=op_metrics_records(
                 root, ctx.metrics, ctx.metrics_level))
-            from ..runtime import ledger, lockdep
+            from ..runtime import ledger, lockdep, racedep
             lw = lockdep.witness()
             if lw is not None:
                 w.emit("concurrency_report", **lw.report())
             lg = ledger.ledger()
             if lg is not None:
                 w.emit("resource_ledger", **lg.report())
+            rw = racedep.witness()
+            if rw is not None:
+                w.emit("race_report", **rw.report())
             w.emit("watermarks", **diagnostics.watermarks_snapshot())
             x1 = xla_stats.snapshot()
             w.emit("xla_compile",

@@ -31,10 +31,12 @@ import math
 import threading
 from typing import Callable, Dict, Optional
 
+from ..runtime import lockdep, racedep
+
 __all__ = ["counter", "gauge", "histogram", "register_gauge_fn",
            "snapshot", "render_prometheus", "reset", "Histogram"]
 
-_LOCK = threading.Lock()
+_LOCK = lockdep.lock("telemetry._LOCK")
 _COUNTERS: Dict[str, "Counter"] = {}
 _GAUGES: Dict[str, "Gauge"] = {}
 _GAUGE_FNS: Dict[str, Callable[[], object]] = {}
@@ -160,6 +162,7 @@ class Histogram:
 # ---------------------------------------------------------------------
 def counter(name: str) -> Counter:
     with _LOCK:
+        racedep.note_access("telemetry.registry", name, write=True)
         c = _COUNTERS.get(name)
         if c is None:
             c = _COUNTERS[name] = Counter(name)
@@ -168,6 +171,7 @@ def counter(name: str) -> Counter:
 
 def gauge(name: str) -> Gauge:
     with _LOCK:
+        racedep.note_access("telemetry.registry", name, write=True)
         g = _GAUGES.get(name)
         if g is None:
             g = _GAUGES[name] = Gauge(name)
@@ -176,6 +180,7 @@ def gauge(name: str) -> Gauge:
 
 def histogram(name: str) -> Histogram:
     with _LOCK:
+        racedep.note_access("telemetry.registry", name, write=True)
         h = _HISTOGRAMS.get(name)
         if h is None:
             h = _HISTOGRAMS[name] = Histogram(name)
@@ -249,6 +254,7 @@ def _builtin_gauges() -> Dict[str, object]:
 def snapshot() -> dict:
     """The whole registry as one JSON-able dict (the `metrics` verb)."""
     with _LOCK:
+        racedep.note_access("telemetry.registry")
         counters = {n: c.value for n, c in _COUNTERS.items()}
         gauges = {n: g.value for n, g in _GAUGES.items()}
         fns = dict(_GAUGE_FNS)

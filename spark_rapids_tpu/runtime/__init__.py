@@ -10,5 +10,6 @@ the runtime half of the concurrency auditor
 """
 from . import lockdep  # noqa: F401
 from . import program_cache  # noqa: F401
+from . import racedep  # noqa: F401
 
-__all__ = ["lockdep", "program_cache"]
+__all__ = ["lockdep", "program_cache", "racedep"]

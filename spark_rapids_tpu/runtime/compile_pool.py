@@ -93,6 +93,7 @@ class CompilePool:
     def set_busy_hook(self, hook: Optional[Callable[[], bool]]) -> None:
         """`hook() == True` means queries are running: speculative
         tasks wait; stage-ahead tasks (for those very queries) run."""
+        # tpulint: allow[unlocked-shared-write] single reference swap; _busy() snapshots into a local before calling
         self._busy_hook = hook
 
     def _busy(self) -> bool:

@@ -43,6 +43,8 @@ import sys
 import threading
 from typing import Dict, List, Optional
 
+from . import racedep
+
 __all__ = ["LockOrderViolation", "PoolSelfWait", "Witness", "witness",
            "enabled", "enable", "disable", "lock", "rlock",
            "note_acquired", "note_released", "check_pool_wait",
@@ -280,12 +282,14 @@ class _WitnessLock:
             w = _WITNESS
             if w is not None:
                 w.acquired(self.name)
+            racedep.note_lock(self.name)
         return ok
 
     def release(self):
         w = _WITNESS
         if w is not None:
             w.released(self.name)
+        racedep.note_unlock(self.name)
         self._inner.release()
 
     def locked(self):
@@ -303,18 +307,26 @@ class _WitnessLock:
         return f"<WitnessLock {self.name} {self._inner!r}>"
 
 
+def _wrapping() -> bool:
+    """Wrap freshly created locks when EITHER witness is live: lockdep
+    needs orderings, racedep (runtime/racedep.py) needs per-thread
+    locksets — both ride the same acquire/release notes."""
+    return _WITNESS is not None or racedep.enabled()
+
+
 def lock(name: str):
-    """A threading.Lock, witness-wrapped when lockdep is enabled."""
+    """A threading.Lock, witness-wrapped when lockdep or racedep is
+    enabled."""
     inner = threading.Lock()
-    return _WitnessLock(name, inner) if _WITNESS is not None else inner
+    return _WitnessLock(name, inner) if _wrapping() else inner
 
 
 def rlock(name: str):
-    """A threading.RLock, witness-wrapped when lockdep is enabled.
-    Recursive re-entry appends the key again (no self edges), so the
-    paired releases unwind correctly."""
+    """A threading.RLock, witness-wrapped when lockdep or racedep is
+    enabled. Recursive re-entry appends the key again (no self edges),
+    so the paired releases unwind correctly."""
     inner = threading.RLock()
-    return _WitnessLock(name, inner) if _WITNESS is not None else inner
+    return _WitnessLock(name, inner) if _wrapping() else inner
 
 
 # ---------------------------------------------------------------------

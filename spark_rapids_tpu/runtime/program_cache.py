@@ -39,13 +39,15 @@ import weakref
 from collections import OrderedDict
 from typing import Any, Dict, Iterable, List, Optional, Tuple
 
+from . import lockdep, racedep
+
 __all__ = ["cached_program", "CachedProgram", "stats", "clear",
            "set_active_conf", "expr_fp", "exprs_fp", "conf_fingerprint",
            "drain_compile_events", "observed_programs",
            "lookup_program", "example_args_from_spec", "key_stable",
            "observed_for", "seed_observed", "prewarm_thunk"]
 
-_lock = threading.RLock()
+_lock = lockdep.rlock("program_cache._lock")
 _cache: "OrderedDict[tuple, Any]" = OrderedDict()
 _stats = {"program_cache_hits": 0, "program_cache_misses": 0,
           "program_cache_evictions": 0,
@@ -286,6 +288,7 @@ def _note_observed(key: tuple, base_key: tuple, donate, static,
     if spec is None:
         return
     with _lock:
+        racedep.note_access("program_cache._observed", key, write=True)
         _observed_insert(key, {"base_key": base_key,
                                "donate": tuple(donate),
                                "static": tuple(static), "spec": spec})
@@ -313,6 +316,7 @@ def _observed_insert(key: tuple, entry: dict) -> None:
 def observed_programs() -> List[dict]:
     """Snapshot of the observed program table (warm-pack record)."""
     with _lock:
+        racedep.note_access("program_cache._observed")
         return [dict(v) for v in _observed.values()]
 
 
@@ -329,6 +333,7 @@ def observed_for(base_key) -> List[dict]:
     identical tree compiled before — earlier in this process, or seeded
     from a warm-pack manifest)."""
     with _lock:
+        racedep.note_access("program_cache._observed", base_key)
         return [dict(_observed[k])
                 for k in _observed_by_base.get(base_key, ())]
 
@@ -340,6 +345,7 @@ def seed_observed(entries: Iterable) -> int:
     Returns the number of new entries."""
     n = 0
     with _lock:
+        racedep.note_access("program_cache._observed", write=True)
         for e in entries:
             try:
                 k = ("seed", e["base_key"], tuple(e["donate"]),

@@ -50,7 +50,7 @@ import weakref
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
-from . import lockdep
+from . import lockdep, racedep
 
 __all__ = [
     "enabled", "fragments_enabled", "lookup_query", "put_query",
@@ -262,6 +262,7 @@ def _store(key, entry: _Entry, conf):
                         tag=f"result_cache[{entry.tier}]")
     dropped = []
     with _lock:
+        racedep.note_access("result_cache._entries", key, write=True)
         old = _entries.pop(key, None)
         if old is not None:
             _unindex_locked(key, old)
@@ -289,6 +290,7 @@ def _get(key, tier: str) -> Optional[_Entry]:
     mk = ("result_cache_misses" if tier == "query"
           else "result_cache_fragment_misses")
     with _lock:
+        racedep.note_access("result_cache._entries", key)
         e = _entries.get(key)
         if e is None:
             _stats[mk] += 1

@@ -76,6 +76,7 @@ class CancelToken:
 
     def cancel(self, reason: str = "cancelled"):
         self._reason = reason
+        # tpulint: allow[unlocked-shared-write] monotonic flag set before read by design: check() runs per batch and must stay one attr read
         self._cancelled = True
 
     def cancelled(self) -> bool:

@@ -49,9 +49,10 @@ class TpuSession:
         from .config import RETRY_COVERAGE_ENABLED
         from .memory.diagnostics import enable_retry_coverage
         enable_retry_coverage(bool(self.conf.get(RETRY_COVERAGE_ENABLED)))
-        from .runtime import faults, ledger, lockdep
+        from .runtime import faults, ledger, lockdep, racedep
         lockdep.maybe_enable_from_conf(self.conf)
         ledger.maybe_enable_from_conf(self.conf)
+        racedep.maybe_enable_from_conf(self.conf)
         # conf-carried fault plan (sql.debug.faults.plan) activates here
         # so distributed fragments — executors rebuild TpuSession(conf)
         # — inject under the same plan as the driver
@@ -64,6 +65,7 @@ class TpuSession:
         return TpuSession._active
 
     def set_conf(self, key, value):
+        # tpulint: allow[unlocked-shared-write] conf snapshots are immutable; readers see the old or new frozen conf, never a torn one
         self.conf = self.conf.set(key, value)
 
     def cluster_manager(self):
@@ -140,7 +142,11 @@ class TpuSession:
         if cm is not None:
             cm.shutdown()
             self._cluster = None
-        self._query_manager = None
+        # pair with query_manager()'s double-checked build: clearing
+        # outside _QM_LOCK could interleave with a concurrent build and
+        # resurrect a manager the session just tore down
+        with _QM_LOCK:
+            self._query_manager = None
         if TpuSession._active is self:
             TpuSession._active = None
 

@@ -24,6 +24,7 @@ from ..columnar import dtypes as dt
 from ..columnar.column import Column, bucket_capacity
 from ..columnar.table import Schema, Table
 from ..exec.batch import DeviceBatch
+from ..runtime import racedep
 from ..utils.transfer import fetch
 from .serializer import HostSubBatch, read_subbatch, write_subbatch
 
@@ -130,6 +131,8 @@ class LocalShuffle:
                 f.write(struct.pack("<QQ", off, ln))
             f.write(struct.pack("<QI", idx_off, self.n))
         with self._lock:  # concurrent map workers share the metrics dict
+            racedep.note_access("LocalShuffle._map_files", mpid,
+                                write=True)
             self.metrics["bytesWritten"] += nbytes
             self.metrics["blocksWritten"] += nblocks
             for rp in range(self.n):
@@ -169,6 +172,7 @@ class LocalShuffle:
         specs = [wire_spec(f.dtype) for f in self.schema.fields]
 
         with self._lock:
+            racedep.note_access("LocalShuffle._map_files")
             files = [self._map_files[k] for k in sorted(self._map_files)]
 
         selected = None

@@ -13,9 +13,10 @@ pipelining; see docs/observability.md.
 """
 from __future__ import annotations
 
-import threading
 import time
 from contextlib import contextmanager
+
+from ..runtime import racedep
 
 ESSENTIAL = 0
 MODERATE = 1
@@ -41,23 +42,28 @@ class MetricSet:
     """Thread-safe: partitions update operator metrics concurrently."""
 
     def __init__(self, sync: bool = False):
+        from ..runtime import lockdep
         self._values = {}
         self._levels = {}
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("MetricSet._lock")
         self._sync = sync
 
     def add(self, name: str, amount, level: int = MODERATE):
         with self._lock:
+            racedep.note_access("MetricSet._values", name, write=True)
             self._values[name] = self._values.get(name, 0) + amount
             self._levels[name] = level
 
     def set(self, name: str, value, level: int = MODERATE):
         with self._lock:
+            racedep.note_access("MetricSet._values", name, write=True)
             self._values[name] = value
             self._levels[name] = level
 
     def get(self, name: str, default=0):
-        return self._values.get(name, default)
+        with self._lock:
+            racedep.note_access("MetricSet._values", name)
+            return self._values.get(name, default)
 
     @contextmanager
     def timer(self, name: str, level: int = MODERATE):
@@ -70,8 +76,12 @@ class MetricSet:
             self.add(name, time.perf_counter() - t0, level)
 
     def snapshot(self, max_level: int = DEBUG):
-        return {k: v for k, v in self._values.items()
-                if self._levels.get(k, MODERATE) <= max_level}
+        # iterating _values while a partition worker resizes it raises
+        # RuntimeError; snapshot under the same lock add/set hold
+        with self._lock:
+            racedep.note_access("MetricSet._values")
+            return {k: v for k, v in self._values.items()
+                    if self._levels.get(k, MODERATE) <= max_level}
 
     def __repr__(self):
         return f"MetricSet({self._values})"

@@ -14,6 +14,15 @@ os.environ.setdefault("SRTPU_LOCKDEP", "1")
 # TIMED_OUT) with balanced query-scoped acquire/release counters, or
 # QueryManager._finalize raises ResourceLeakError and the test fails.
 os.environ.setdefault("SRTPU_LEDGER", "1")
+# Data-race witness for the WHOLE suite (runtime/racedep.py),
+# record-only: Eraser lockset tracking on the instrumented shared
+# structures (program cache observed table, telemetry registry,
+# result-cache LRU, shuffle map slots, metric sets). Record-only so a
+# witnessed collapse surfaces through tests/test_racedep.py's
+# clean-report assertion instead of raising at an arbitrary point
+# mid-suite.
+os.environ.setdefault("SRTPU_RACEDEP", "1")
+os.environ.setdefault("SRTPU_RACEDEP_RAISE", "0")
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:

@@ -1,0 +1,55 @@
+"""Archived pre-fix shape: cluster/driver.py ClusterManager._threads.
+
+`start()` (query thread) appended to `self._threads` AFTER spawning the
+accept loop, while the accept loop itself appends recv/send/heartbeat
+threads to the same list as executors register — two contexts mutating
+one list with no common lock. The fix routes every `_threads` mutation
+through `self._lock`. This file preserves the racy shape so the static
+pass (analysis/races.py) provably re-detects it.
+"""
+import socket
+import threading
+from typing import List, Optional
+
+
+class ClusterManager:
+    def __init__(self, n: int):
+        self.n = n
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: List[threading.Thread] = []
+        self._listener: Optional[socket.socket] = None
+
+    def start(self):
+        self._listener = socket.socket()
+        self._listener.listen(self.n)
+        accept = threading.Thread(target=self._accept_loop, daemon=True,
+                                  name="tpu-driver-accept")
+        accept.start()
+        # post-spawn append: the accept loop may already be appending
+        self._threads.append(accept)
+        mon = threading.Thread(target=self._monitor_loop, daemon=True,
+                               name="tpu-driver-monitor")
+        mon.start()
+        self._threads.append(mon)
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                return
+            rt = threading.Thread(target=self._recv_loop, args=(sock,),
+                                  daemon=True, name="tpu-driver-recv")
+            rt.start()
+            self._threads.append(rt)
+
+    def _monitor_loop(self):
+        while not self._stop.is_set():
+            self._stop.wait(0.5)
+
+    def _recv_loop(self, sock):
+        while not self._stop.is_set():
+            data = sock.recv(4096)
+            if not data:
+                return

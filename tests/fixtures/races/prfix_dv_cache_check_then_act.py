@@ -1,0 +1,33 @@
+"""Archived pre-fix shape: exec/nodes.py ParquetScanExec._dv_cache.
+
+The deletion-vector cache was populated with an unlocked
+check-then-act: concurrent scan partitions (collect pool workers) could
+both miss and both store, and — worse for a non-idempotent value —
+interleave the membership test and the store. The fix uses
+`dict.setdefault` so exactly one loaded row set wins. This file
+preserves the racy shape so the static pass re-detects it.
+"""
+from concurrent.futures import ThreadPoolExecutor
+
+
+def _load_positions(path):
+    return {hash(path) % 97}
+
+
+class ParquetScanExec:
+    def __init__(self, paths):
+        self.paths = list(paths)
+        self._dv_cache = {}
+        self._pool = ThreadPoolExecutor(max_workers=4,
+                                        thread_name_prefix="tpu-scan")
+
+    def execute(self):
+        futs = [self._pool.submit(self._dead_positions, p)
+                for p in self.paths]
+        return [f.result() for f in futs]
+
+    def _dead_positions(self, path):
+        # two workers can both pass the membership test and both store
+        if path not in self._dv_cache:
+            self._dv_cache[path] = _load_positions(path)
+        return self._dv_cache[path]

@@ -1,0 +1,34 @@
+"""Archived pre-fix shape: utils/metrics.py MetricSet.
+
+Writers held `self._lock` but `get`/`snapshot` read `self._values`
+bare: a reader iterating while a partition worker resized the dict
+gets RuntimeError, and a read racing an in-flight update sees torn
+aggregate state. (On the live tree the accessor names sit on the
+resolver's polymorphic-name blocklist, so this self-contained shape —
+with the pool submission visible — is what the static pass checks.)
+The fix takes the same lock in the accessors.
+"""
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class MetricSet:
+    def __init__(self):
+        self._values = {}
+        self._lock = threading.Lock()
+        self._pool = ThreadPoolExecutor(max_workers=8,
+                                        thread_name_prefix="tpu-part")
+
+    def run_partitions(self, n):
+        futs = [self._pool.submit(self.bump, "rowsProduced", i)
+                for i in range(n)]
+        for f in futs:
+            f.result()
+
+    def bump(self, name, amount):
+        with self._lock:
+            self._values[name] = self._values.get(name, 0) + amount
+
+    def peek(self, name):
+        # unlocked read racing the locked writers above
+        return self._values.get(name, 0)

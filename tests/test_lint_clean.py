@@ -18,6 +18,8 @@ _CONC_BASELINE = os.path.join(_ROOT, "tools",
                               "tpulint_concurrency_baseline.json")
 _LIFETIME_BASELINE = os.path.join(_ROOT, "tools",
                                   "tpulint_lifetime_baseline.json")
+_RACES_BASELINE = os.path.join(_ROOT, "tools",
+                               "tpulint_races_baseline.json")
 
 
 def test_tpulint_clean_against_committed_baseline():
@@ -98,6 +100,33 @@ def test_tpulint_lifetime_cli_check_clean():
     r = subprocess.run(
         [sys.executable, os.path.join(_ROOT, "tools", "tpulint.py"),
          "--lifetime", "--check"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_race_audit_clean_against_committed_baseline():
+    """The static data-race pass (analysis/races.py) runs clean: every
+    intentional lock-free access carries an inline allow marker and
+    the committed races baseline stays EMPTY — the engine accepts no
+    unannotated shared-state hazards."""
+    from spark_rapids_tpu.analysis.races import analyze_paths
+    violations = analyze_paths([os.path.join(_ROOT, "spark_rapids_tpu")],
+                               rel_to=_ROOT)
+    baseline = load_baseline(_RACES_BASELINE)
+    assert baseline == [], (
+        "races baseline must stay empty — annotate intentional sites "
+        "inline instead")
+    new, stale = diff_baseline(violations, baseline)
+    assert not new, (
+        "new data-race violations (fix them or add a "
+        "`# tpulint: allow[<rule>] <reason>` marker):\n"
+        + "\n".join(v.describe() for v in new))
+
+
+def test_tpulint_races_cli_check_clean():
+    r = subprocess.run(
+        [sys.executable, os.path.join(_ROOT, "tools", "tpulint.py"),
+         "--races", "--check"],
         capture_output=True, text=True)
     assert r.returncode == 0, r.stdout + r.stderr
 

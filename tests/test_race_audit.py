@@ -1,0 +1,340 @@
+"""Static data-race audit (analysis/races.py): the archived pre-fix
+race shapes must be re-detected, each rule must separate its positive
+from its negative, the principled exemptions (init-before-spawn,
+immutable-after-publish, hand-off objects, instance confinement,
+Condition/lock pairing) must hold, allow markers and the baseline must
+behave like the other tpulint passes, and the live tree must be clean
+against the committed EMPTY baseline."""
+import json
+import os
+import subprocess
+import sys
+
+from spark_rapids_tpu.analysis.lint_rules import (baseline_entries,
+                                                  diff_baseline,
+                                                  load_baseline)
+from spark_rapids_tpu.analysis.races import (RACE_RULES, analyze_paths)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(ROOT, "tests", "fixtures", "races")
+ENGINE = os.path.join(ROOT, "spark_rapids_tpu")
+
+
+def _rules(violations):
+    rules = {v.rule for v in violations}
+    assert rules <= set(RACE_RULES)
+    return rules
+
+
+def _analyze_src(tmp_path, src, name="mod.py"):
+    p = tmp_path / name
+    p.write_text(src)
+    return analyze_paths([str(p)], rel_to=str(tmp_path))
+
+
+# ---------------------------------------------------------------------
+# the archived pre-fix races (fixed in this tree) are re-detected
+# ---------------------------------------------------------------------
+def test_prfix_driver_threads_append_detected():
+    vs = analyze_paths(
+        [os.path.join(FIXTURES, "prfix_driver_threads_append.py")],
+        rel_to=ROOT)
+    assert "unlocked-shared-write" in _rules(vs)
+    usw = [v for v in vs if v.rule == "unlocked-shared-write"]
+    assert any("ClusterManager._threads" in v.message for v in usw)
+
+
+def test_prfix_dv_cache_check_then_act_detected():
+    vs = analyze_paths(
+        [os.path.join(FIXTURES, "prfix_dv_cache_check_then_act.py")],
+        rel_to=ROOT)
+    rules = _rules(vs)
+    assert "check-then-act" in rules
+    cta = [v for v in vs if v.rule == "check-then-act"]
+    assert any("ParquetScanExec._dv_cache" in v.message for v in cta)
+
+
+def test_prfix_metricset_unlocked_read_detected():
+    vs = analyze_paths(
+        [os.path.join(FIXTURES, "prfix_metricset_unlocked_read.py")],
+        rel_to=ROOT)
+    assert "unlocked-shared-write" in _rules(vs)
+    usw = [v for v in vs if v.rule == "unlocked-shared-write"]
+    # anchored at the racy UNLOCKED site — the bare read in peek(),
+    # not the correctly locked writer
+    assert any("MetricSet._values" in v.message and "read" in v.message
+               for v in usw)
+
+
+# ---------------------------------------------------------------------
+# rule units: positive and negative per rule
+# ---------------------------------------------------------------------
+_POOLED = """\
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Engine:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.count = 0
+        self.slots = {{}}
+        self._pool = ThreadPoolExecutor(max_workers=4,
+                                        thread_name_prefix="wrk")
+
+    def run(self):
+        for i in range(4):
+            self._pool.submit(self.work, i)
+
+    def work(self, i):
+{body}
+"""
+
+
+def test_unlocked_shared_write_detected(tmp_path):
+    vs = _analyze_src(tmp_path, _POOLED.format(
+        body="        self.count = i\n"))
+    assert "unlocked-shared-write" in _rules(vs)
+
+
+def test_locked_shared_write_clean(tmp_path):
+    vs = _analyze_src(tmp_path, _POOLED.format(
+        body="        with self._lock:\n"
+             "            self.count = i\n"))
+    assert vs == []
+
+
+def test_compound_rmw_detected(tmp_path):
+    vs = _analyze_src(tmp_path, _POOLED.format(
+        body="        self.count += 1\n"))
+    assert "compound-rmw" in _rules(vs)
+
+
+def test_locked_rmw_clean(tmp_path):
+    vs = _analyze_src(tmp_path, _POOLED.format(
+        body="        with self._lock:\n"
+             "            self.count += 1\n"))
+    assert vs == []
+
+
+def test_check_then_act_detected(tmp_path):
+    vs = _analyze_src(tmp_path, _POOLED.format(
+        body="        if i not in self.slots:\n"
+             "            self.slots[i] = []\n"))
+    assert "check-then-act" in _rules(vs)
+
+
+def test_check_then_act_is_none_detected(tmp_path):
+    src = _POOLED.format(
+        body="        if self.memo is None:\n"
+             "            self.memo = i\n")
+    src = src.replace("self.count = 0", "self.count = 0\n"
+                      "        self.memo = None")
+    vs = _analyze_src(tmp_path, src)
+    assert "check-then-act" in _rules(vs)
+
+
+def test_locked_check_then_act_clean(tmp_path):
+    vs = _analyze_src(tmp_path, _POOLED.format(
+        body="        with self._lock:\n"
+             "            if i not in self.slots:\n"
+             "                self.slots[i] = []\n"))
+    assert vs == []
+
+
+def test_publish_before_init_detected(tmp_path):
+    vs = _analyze_src(tmp_path, """\
+REGISTRY = {}
+
+
+class Worker:
+    def __init__(self, wid):
+        REGISTRY[wid] = self
+        self.state = "ready"
+""")
+    assert "publish-before-init" in _rules(vs)
+
+
+def test_publish_last_is_clean(tmp_path):
+    vs = _analyze_src(tmp_path, """\
+REGISTRY = {}
+
+
+class Worker:
+    def __init__(self, wid):
+        self.state = "ready"
+        REGISTRY[wid] = self
+""")
+    assert "publish-before-init" not in _rules(vs)
+
+
+# ---------------------------------------------------------------------
+# exemption idioms
+# ---------------------------------------------------------------------
+def test_init_before_first_submit_exempt(tmp_path):
+    # writes that lexically precede the function's first pool
+    # submission / Thread spawn are single-threaded
+    vs = _analyze_src(tmp_path, """\
+import threading
+
+
+class Server:
+    def __init__(self):
+        self._stop = False
+
+    def start(self):
+        self.sock = object()
+        t = threading.Thread(target=self.loop, name="srv")
+        t.start()
+
+    def loop(self):
+        while not self._stop:
+            data = self.sock
+""")
+    assert vs == []
+
+
+def test_immutable_after_publish_exempt(tmp_path):
+    # attr written only during construction, read concurrently: frozen
+    vs = _analyze_src(tmp_path, _POOLED.format(
+        body="        return self.count\n"))
+    assert vs == []
+
+
+def test_handoff_object_exempt(tmp_path):
+    # Queue/Event-valued attrs ARE synchronization points; their
+    # mutating method calls are not races
+    vs = _analyze_src(tmp_path, """\
+import queue
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Pipe:
+    def __init__(self):
+        self._q = queue.Queue()
+        self._idle = threading.Event()
+        self._pool = ThreadPoolExecutor(max_workers=2,
+                                        thread_name_prefix="pipe")
+
+    def run(self):
+        self._pool.submit(self.work)
+        self._idle.clear()
+
+    def work(self):
+        self._q.put(1)
+        self._idle.set()
+""")
+    assert vs == []
+
+
+def test_instance_confined_class_exempt(tmp_path):
+    # every constructor site is a plain local: each context gets its
+    # own instance, unsynchronized self-mutation is fine
+    vs = _analyze_src(tmp_path, """\
+from concurrent.futures import ThreadPoolExecutor
+
+_POOL = ThreadPoolExecutor(max_workers=4, thread_name_prefix="par")
+
+
+class _Parser:
+    def __init__(self, text):
+        self.text = text
+        self.i = 0
+
+    def next(self):
+        self.i += 1
+        return self.text[self.i - 1]
+
+
+def parse(text):
+    p = _Parser(text)
+    return p.next()
+
+
+def parse_all(texts):
+    return [f.result() for f in
+            [_POOL.submit(parse, t) for t in texts]]
+""")
+    assert vs == []
+
+
+def test_condition_paired_lock_counts_as_same_lock(tmp_path):
+    # `with self._cond:` and `with self._lock:` over
+    # Condition(self._lock) are the SAME mutex
+    vs = _analyze_src(tmp_path, """\
+import threading
+from concurrent.futures import ThreadPoolExecutor
+
+
+class Mgr:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self.jobs = {}
+        self._pool = ThreadPoolExecutor(max_workers=2,
+                                        thread_name_prefix="mgr")
+
+    def run(self):
+        self._pool.submit(self.work, 1)
+
+    def work(self, i):
+        with self._cond:
+            self.jobs[i] = "done"
+            self._cond.notify_all()
+
+    def peek(self, i):
+        with self._lock:
+            return self.jobs.get(i)
+""")
+    assert vs == []
+
+
+# ---------------------------------------------------------------------
+# markers, baseline, live tree, CLI
+# ---------------------------------------------------------------------
+def test_allow_marker_suppresses(tmp_path):
+    src = _POOLED.format(body="        self.count += 1\n")
+    allowed = src.replace(
+        "        self.count += 1",
+        "        # tpulint: allow[compound-rmw] advisory stat\n"
+        "        self.count += 1")
+    vs = _analyze_src(tmp_path, allowed, name="mod2.py")
+    assert "compound-rmw" not in _rules(vs)
+
+
+def test_baseline_diff_roundtrip(tmp_path):
+    vs = _analyze_src(tmp_path, _POOLED.format(
+        body="        self.count += 1\n"))
+    assert vs
+    entries = baseline_entries(vs, "accepted for test")["entries"]
+    new, stale = diff_baseline(vs, entries)
+    assert new == [] and stale == []
+    new2, stale2 = diff_baseline([], entries)
+    assert new2 == [] and len(stale2) == len(entries)
+
+
+def test_live_tree_clean_and_baseline_empty():
+    vs = analyze_paths([ENGINE], rel_to=ROOT)
+    assert vs == [], "\n".join(v.describe() for v in vs)
+    baseline = load_baseline(os.path.join(
+        ROOT, "tools", "tpulint_races_baseline.json"))
+    assert baseline == [], "races baseline must stay EMPTY"
+
+
+def test_cli_races_check():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+         "--races", "--check", "--json"],
+        capture_output=True, text=True)
+    assert r.returncode == 0, r.stdout + r.stderr
+    doc = json.loads(r.stdout)
+    assert doc["new"] == [] and doc["stale"] == []
+
+
+def test_cli_flag_exclusion():
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "tpulint.py"),
+         "--races", "--lifetime"],
+        capture_output=True, text=True)
+    assert r.returncode == 2

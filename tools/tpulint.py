@@ -28,6 +28,13 @@ Usage:
                 #  release-before-sync, unbalanced-transfer; same allow
                 #  markers, separate baseline — committed EMPTY: the
                 #  live tree holds no accepted lifetime hazards)
+  python tools/tpulint.py --races               # static data-race audit
+                # (analysis/races.py: unlocked-shared-write,
+                #  compound-rmw, check-then-act, publish-before-init;
+                #  Eraser-style lockset analysis over shared engine
+                #  state; same allow markers, separate baseline —
+                #  committed EMPTY: every true positive is fixed or
+                #  inline-annotated)
 
 Exit codes: 0 clean, 1 new violations (or baseline entries without a
 reason), 2 usage error.
@@ -48,6 +55,8 @@ DEFAULT_CONC_BASELINE = os.path.join(
     _ROOT, "tools", "tpulint_concurrency_baseline.json")
 DEFAULT_LIFETIME_BASELINE = os.path.join(
     _ROOT, "tools", "tpulint_lifetime_baseline.json")
+DEFAULT_RACES_BASELINE = os.path.join(
+    _ROOT, "tools", "tpulint_races_baseline.json")
 
 
 def main(argv=None) -> int:
@@ -64,6 +73,10 @@ def main(argv=None) -> int:
                     help="run the resource-lifetime audit (acquire/"
                          "release shape analysis) instead of the "
                          "per-line hazard rules")
+    ap.add_argument("--races", action="store_true",
+                    help="run the static data-race audit (Eraser-"
+                         "style lockset analysis) instead of the "
+                         "per-line hazard rules")
     ap.add_argument("--check", action="store_true",
                     help="strict mode: stale baseline entries are "
                          "failures too (CI gate)")
@@ -77,13 +90,14 @@ def main(argv=None) -> int:
                     help="emit JSON instead of text")
     args = ap.parse_args(argv)
 
-    if args.concurrency and args.lifetime:
-        print("tpulint: pick one of --concurrency/--lifetime per run",
-              file=sys.stderr)
+    if sum((args.concurrency, args.lifetime, args.races)) > 1:
+        print("tpulint: pick one of --concurrency/--lifetime/--races "
+              "per run", file=sys.stderr)
         return 2
     if args.baseline is None:
         args.baseline = (DEFAULT_CONC_BASELINE if args.concurrency
                          else DEFAULT_LIFETIME_BASELINE if args.lifetime
+                         else DEFAULT_RACES_BASELINE if args.races
                          else DEFAULT_BASELINE)
     paths = args.paths or [os.path.join(_ROOT, "spark_rapids_tpu")]
     for p in paths:
@@ -95,6 +109,9 @@ def main(argv=None) -> int:
         violations = analyze_paths(paths, rel_to=_ROOT)
     elif args.lifetime:
         from spark_rapids_tpu.analysis.lifetime import analyze_paths
+        violations = analyze_paths(paths, rel_to=_ROOT)
+    elif args.races:
+        from spark_rapids_tpu.analysis.races import analyze_paths
         violations = analyze_paths(paths, rel_to=_ROOT)
     else:
         violations = lint_paths(paths, rel_to=_ROOT)
