@@ -774,6 +774,74 @@ SERVICE_ADMISSION_DEVICE_LIMIT = _conf(
     "Explicit admission byte budget for device estimates; overrides "
     "deviceFraction * DeviceManager budget when > 0.", int,
     internal=True)
+FLEET_DIRECTORY = _conf(
+    "sql.fleet.directory", None,
+    "Root directory of the fleet peer registry (fleet/directory.py). "
+    "When set, serve() joins the multi-host serving fabric: register "
+    "in the directory, start the peer cache server, pull warm state "
+    "from the longest-lived peer, and consult peers on result-cache "
+    "misses. Unset (the default) disables the fleet entirely.", str)
+FLEET_ADVERTISE_HOST = _conf(
+    "sql.fleet.advertiseHost", "127.0.0.1",
+    "Host peers use to reach this member's peer cache server (the "
+    "address written into the peer directory). Single-box fleets keep "
+    "the loopback default; multi-host deployments set the reachable "
+    "interface.", str)
+FLEET_CONSULT_FANOUT = _conf(
+    "sql.fleet.consultFanout", 2,
+    "How many rendezvous-ordered peers a result-cache miss probes "
+    "before recomputing locally. 1 asks only the key's owner; higher "
+    "values tolerate membership churn (an entry published before a "
+    "join may live one step down the preference order) at the cost of "
+    "extra round trips on a true fleet-wide miss.", int)
+FLEET_FETCH_TIMEOUT_SECS = _conf(
+    "sql.fleet.fetchTimeoutSecs", 5.0,
+    "Socket timeout per peer-cache request (connect + transfer). A "
+    "peer slower than this is treated as a miss after the bounded "
+    "retries — recomputing locally is always sound.", float)
+FLEET_FETCH_RETRIES = _conf(
+    "sql.fleet.fetchRetries", 2,
+    "Transient-failure retries per peer-cache fetch, on "
+    "deterministic-jitter backoff (runtime/backoff.py). Structural "
+    "failures (protocol violations) never retry.", int)
+FLEET_FETCH_BACKOFF_MS = _conf(
+    "sql.fleet.fetchBackoffMs", 20.0,
+    "Base backoff between peer-cache fetch retries; doubles per "
+    "attempt with deterministic jitter seeded per (peer, verb).",
+    float)
+FLEET_INVALIDATE_RETRIES = _conf(
+    "sql.fleet.invalidateRetries", 1,
+    "Retries per peer when broadcasting a cache invalidation. "
+    "Deliveries are best-effort by design — a peer that misses the "
+    "broadcast holds entries under keys no requester will compute "
+    "again (keys embed scan snapshots), and the requester-side "
+    "snapshot re-stat rejects the race window.", int)
+FLEET_EXPORT_MAX_BYTES = _conf(
+    "sql.fleet.exportMaxBytes", 256 << 20,
+    "Byte budget for the export store — the LRU index of locally "
+    "computed results a member serves to peers. Held by reference to "
+    "the result cache's own immutable tables, so this bounds the "
+    "index's ability to pin evicted entries alive, not a second copy.",
+    int)
+FLEET_WARM_PULL = _conf(
+    "sql.fleet.warmPull", True,
+    "Cold-join warm-state publication: pull the warm-pack manifest "
+    "and calibration table from the longest-lived live peer at join "
+    "and replay it through the background compile pool, so a fresh "
+    "process reaches steady-state latency within its first few "
+    "queries. Advisory — any failure serves cold.", bool)
+FLEET_TENANT_MAX_INFLIGHT = _conf(
+    "sql.fleet.tenantMaxInflight", 0,
+    "Fleet-wide cap on one tenant's in-flight routed queries (the "
+    "route verb's admission control); a tenant at its cap gets "
+    "rejected leases until it completes work. 0 = unlimited.", int)
+FLEET_PEER_MAX_INFLIGHT = _conf(
+    "sql.fleet.peerMaxInflight", 0,
+    "Per-peer in-flight ceiling for the router: past it, a query "
+    "spills to the next peer in its fingerprint's rendezvous order "
+    "(stable, so overflow lands warm too). When every peer is "
+    "saturated the sticky choice queues rather than spill cold. "
+    "0 = unlimited (always sticky).", int)
 LOCKDEP_ENABLED = _conf(
     "sql.debug.lockdep.enabled", False,
     "Runtime lockdep witness (runtime/lockdep.py): wrap engine locks, "

@@ -1,0 +1,53 @@
+"""Multi-host serving fabric: N service processes as one logical
+service.
+
+Three coupled pieces (docs/fleet.md has the full protocol):
+
+- a cluster cache tier (`peer_cache.py`): on a local result-cache
+  miss, consult the rendezvous-ordered owning peers for the same
+  snapshot-embedding key, fetch the Arrow bytes, and re-stat the
+  shipped snapshot before accepting — with invalidation broadcast as
+  hygiene and the snapshot-key discipline as the soundness floor;
+- fingerprint-sticky routing (`router.py`, the `route` gateway verb):
+  repeat queries land on the process whose caches are warm for them,
+  with fleet-wide per-tenant admission;
+- warm-state publication (`member.py`): a joining process pulls the
+  warm-pack manifest and calibration table from the longest-lived
+  peer before taking traffic.
+
+Joining is one call — `serve()` does it when `sql.fleet.directory` is
+set — and everything degrades to single-process behavior when the
+fleet is unreachable: a failed fetch is a local recompute, a lost
+broadcast is caught by snapshot re-stat, a missing donor is a cold
+start.
+"""
+from __future__ import annotations
+
+from . import context
+from .directory import PeerDirectory, PeerInfo, rendezvous_order
+from .member import FleetMember, install_dispatcher, join
+from .peer_cache import ExportStore, PeerCacheServer, PeerFetchFailed
+from .router import RouteRejected, Router
+
+__all__ = [
+    "context", "PeerDirectory", "PeerInfo", "rendezvous_order",
+    "FleetMember", "install_dispatcher", "join",
+    "ExportStore", "PeerCacheServer", "PeerFetchFailed",
+    "RouteRejected", "Router", "reset",
+]
+
+
+def reset() -> None:
+    """Test/module-boundary teardown: leave + detach every member this
+    process knows about (the default plus any scoped ones are owned by
+    their creators; this handles the common single-default case) and
+    uninstall the result-cache dispatcher."""
+    m = context.default_member()
+    if m is not None:
+        try:
+            m.leave()
+        except Exception:
+            pass
+    context.reset()
+    from ..runtime import result_cache
+    result_cache.set_peer_tier(None)

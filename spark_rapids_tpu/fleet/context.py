@@ -1,0 +1,69 @@
+"""Fleet member resolution: which FleetMember owns the work on this
+thread.
+
+A real deployment has exactly ONE member per process (`fleet.join`
+installs it as the process default), but the test suite and the chaos
+harness run two or three members inside one process to exercise the
+wire paths without spawning interpreters. The thread-local override is
+what makes that honest: work scoped to member B consults B's peer view
+and publishes into B's export store even though A lives in the same
+process.
+
+This module is intentionally stdlib-only — `session.py` and
+`runtime/result_cache.py` import it on hot paths, and it must never
+drag the fleet wire machinery (sockets, pyarrow) into processes that
+never join a fleet. Resolution is two attribute reads and out.
+"""
+from __future__ import annotations
+
+import threading
+from contextlib import contextmanager
+
+__all__ = ["active_member", "default_member", "set_default", "scoped",
+           "reset"]
+
+_TLS = threading.local()
+_DEFAULT = None          # the process's joined member (fleet.join)
+_LOCK = threading.Lock()
+
+
+def set_default(member) -> None:
+    """Install/clear the process-default member (one per process in a
+    real deployment; `None` detaches)."""
+    global _DEFAULT
+    with _LOCK:
+        _DEFAULT = member
+
+
+def default_member():
+    return _DEFAULT
+
+
+def active_member():
+    """The member owning work on THIS thread: the scoped override when
+    one is installed, else the process default."""
+    m = getattr(_TLS, "member", None)
+    return m if m is not None else _DEFAULT
+
+
+@contextmanager
+def scoped(member):
+    """Pin `member` as this thread's active member for the duration —
+    the bridge onto query-manager worker threads (DataFrame.submit
+    captures the submitter's member and re-enters this scope inside the
+    admitted body) and the multi-member test/chaos harness."""
+    prev = getattr(_TLS, "member", None)
+    _TLS.member = member
+    try:
+        yield member
+    finally:
+        _TLS.member = prev
+
+
+def reset() -> None:
+    """Drop the process default and THIS thread's override (module-
+    boundary teardown in tests/conftest.py)."""
+    global _DEFAULT
+    with _LOCK:
+        _DEFAULT = None
+    _TLS.member = None

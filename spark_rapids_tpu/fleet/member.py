@@ -1,0 +1,389 @@
+"""FleetMember: one process's seat in the serving fabric.
+
+A member owns (a) the export store + peer cache server it answers the
+fleet from, (b) its registration in the file-backed peer directory,
+and (c) the client side of the tier: consult on local result-cache
+miss, publish on local store, invalidation broadcast on local drop,
+and the cold-join warm-state pull. `fleet.join(session)` builds one,
+installs it as the process default (fleet/context.py) and wires the
+dispatcher into runtime/result_cache.py; `member.leave()` undoes all
+of it.
+
+Everything here is advisory with respect to query results: a dead
+peer, a lost broadcast, an injected peer.fetch fault, or a stale entry
+all degrade to exactly what a fleet of one does — local recompute over
+re-stat'd snapshots, byte-identical.
+"""
+from __future__ import annotations
+
+import logging
+import time
+from typing import Optional
+
+from ..runtime import lockdep
+from . import context as fleet_context
+from .directory import PeerDirectory, PeerInfo, rendezvous_order
+from .peer_cache import (ExportStore, PeerCacheServer, PeerFetchFailed,
+                         fetch_entry, pull_warm_state, send_invalidate)
+
+__all__ = ["FleetMember", "join", "install_dispatcher"]
+
+log = logging.getLogger(__name__)
+
+#: live-peer listing cache TTL: consult fires per cache miss, and the
+#: directory is a filesystem listing — 500ms staleness costs at most
+#: one failed fetch against a just-departed peer
+_PEERS_TTL_SECS = 0.5
+
+_STAT_KEYS = (
+    "fleet_peer_hits", "fleet_peer_misses", "fleet_peer_fetch_failures",
+    "fleet_peer_stale_rejected", "fleet_publishes",
+    "fleet_inv_broadcasts", "fleet_inv_broadcast_failures",
+    "fleet_inv_applied", "fleet_warm_pulls", "fleet_warm_served",
+)
+
+
+def _telemetry():
+    from ..profiler import telemetry
+    return telemetry
+
+
+class FleetMember:
+    """One member: export store + server + peer-facing client logic."""
+
+    def __init__(self, session, conf, directory_root: str,
+                 gateway_addr=None, advertise_host: str = None,
+                 warm_pull: bool = None):
+        from ..config import (FLEET_ADVERTISE_HOST, FLEET_CONSULT_FANOUT,
+                              FLEET_EXPORT_MAX_BYTES,
+                              FLEET_FETCH_BACKOFF_MS,
+                              FLEET_FETCH_RETRIES,
+                              FLEET_FETCH_TIMEOUT_SECS,
+                              FLEET_INVALIDATE_RETRIES, FLEET_WARM_PULL)
+        self.session = session
+        self.conf = conf
+        self.directory = PeerDirectory(directory_root)
+        self._slock = lockdep.lock("Fleet.Member._slock")
+        self.stats = {k: 0 for k in _STAT_KEYS}
+        self._fanout = max(1, int(conf.get(FLEET_CONSULT_FANOUT)))
+        self._timeout = float(conf.get(FLEET_FETCH_TIMEOUT_SECS))
+        self._retries = int(conf.get(FLEET_FETCH_RETRIES))
+        self._backoff_ms = float(conf.get(FLEET_FETCH_BACKOFF_MS))
+        self._inv_retries = int(conf.get(FLEET_INVALIDATE_RETRIES))
+        self._warm_pull = (bool(conf.get(FLEET_WARM_PULL))
+                           if warm_pull is None else bool(warm_pull))
+        self.export = ExportStore(int(conf.get(FLEET_EXPORT_MAX_BYTES)))
+        self.server = PeerCacheServer(self)
+        host = advertise_host or str(
+            conf.get(FLEET_ADVERTISE_HOST) or "127.0.0.1")
+        gw_host, gw_port = (gateway_addr or (None, None))
+        self.info = PeerInfo(f"{host}:{self.server.port}", host,
+                             self.server.port, gw_host=gw_host,
+                             gw_port=gw_port)
+        self.peer_id = self.info.peer_id
+        self.warm_summary = None
+        self._peers_cache = (0.0, [])
+        self._closed = False
+        self.directory.register(self.info)
+
+    # -- membership -----------------------------------------------------
+    def peers(self, include_self: bool = False):
+        """Live peers, briefly cached (the consult path calls this per
+        local miss)."""
+        now = time.monotonic()
+        ts, cached = self._peers_cache
+        if now - ts > _PEERS_TTL_SECS:
+            cached = self.directory.peers()
+            self._peers_cache = (now, cached)
+        if include_self:
+            return list(cached)
+        return [p for p in cached if p.peer_id != self.peer_id]
+
+    def refresh_peers(self) -> None:
+        self._peers_cache = (0.0, [])
+
+    def leave(self) -> None:
+        """Deregister, stop serving, detach from the process default.
+        Idempotent."""
+        if self._closed:
+            return
+        self._closed = True
+        self.directory.deregister(self.peer_id)
+        self.server.close()
+        self.export.clear()
+        if fleet_context.default_member() is self:
+            fleet_context.set_default(None)
+
+    # -- cache tier: consult / publish ---------------------------------
+    def consult(self, key, paths=()):
+        """Peer-tier lookup after a local result-cache miss: probe the
+        key's rendezvous-ordered owners (fanout-bounded). Returns
+        (tier, value, meta) or None; never raises — every failure mode
+        is a miss."""
+        peers = self.peers()
+        if not peers:
+            return None
+        by_id = {p.peer_id: p for p in peers}
+        order = rendezvous_order(key, list(by_id))
+        t = _telemetry()
+        t0 = time.perf_counter()
+        for pid in order[:self._fanout]:
+            peer = by_id[pid]
+            try:
+                got = fetch_entry(peer.addr, key,
+                                  timeout=self._timeout,
+                                  retries=self._retries,
+                                  backoff_ms=self._backoff_ms)
+            except Exception as e:
+                from ..service.query_manager import QueryCancelled
+                if isinstance(e, QueryCancelled):
+                    # a cancelled/timed-out query must die, not probe
+                    # the next peer
+                    raise
+                # socket failure, protocol violation, or an injected
+                # peer.fetch fault that exhausted its retries: all
+                # degrade identically — this peer is a miss
+                self._bump("fleet_peer_fetch_failures")
+                t.counter("fleet_peer_fetch_failures").inc()
+                continue
+            if got is None:
+                continue
+            tier, value, meta = got
+            if not self._snapshot_current(meta.get("snapshot")):
+                # the stale-invalidation race: the owner missed (or has
+                # not yet applied) an invalidation for files that
+                # changed under it — reject and recompute locally
+                self._bump("fleet_peer_stale_rejected")
+                continue
+            self._bump("fleet_peer_hits")
+            t.counter("fleet_peer_hits").inc()
+            t.histogram("fleet_peer_fetch_ms").observe(
+                (time.perf_counter() - t0) * 1e3)
+            return tier, value, meta
+        self._bump("fleet_peer_misses")
+        t.counter("fleet_peer_misses").inc()
+        return None
+
+    @staticmethod
+    def _snapshot_current(snap) -> bool:
+        """Requester-side re-stat of the snapshot the entry was
+        published under. `None` (owner skipped snapshotting a huge
+        path set) defers to the key-embedded snapshot discipline."""
+        if not snap:
+            return True
+        from ..io.snapshot import snapshot_current
+        try:
+            return snapshot_current(tuple(
+                (p, mt, sz) for p, mt, sz in snap))
+        except Exception:
+            return False
+
+    def publish(self, key, value, nbytes: int, tier: str, paths,
+                plan_fp=None) -> None:
+        """Export a locally stored cache entry so peers can fetch it.
+        By reference — no copy; the snapshot recorded here is what a
+        fetching peer re-stats before accepting the bytes."""
+        from ..io.snapshot import scan_snapshot
+        paths = tuple(paths or ())
+        snap = scan_snapshot(paths) if 0 < len(paths) <= 64 else None
+        self.export.put(key, value, int(nbytes),
+                        {"tier": tier, "paths": paths,
+                         "snapshot": snap, "plan_fp": plan_fp})
+        self._bump("fleet_publishes")
+
+    # -- invalidation ---------------------------------------------------
+    def broadcast_invalidate(self, mode: str, arg) -> int:
+        """Gossip one invalidation to every live peer (best-effort,
+        bounded retry per peer). Also applies it to our OWN export
+        store — an entry we just invalidated locally must not keep
+        being served to the fleet. Returns peers acked."""
+        self._drop_export(mode, arg)
+        acked = 0
+        t = _telemetry()
+        for peer in self.peers():
+            ok = send_invalidate(peer.addr, mode, arg,
+                                 timeout=self._timeout,
+                                 retries=self._inv_retries,
+                                 backoff_ms=self._backoff_ms)
+            if ok:
+                acked += 1
+            else:
+                self._bump("fleet_inv_broadcast_failures")
+                t.counter("fleet_inv_broadcast_failures").inc()
+        self._bump("fleet_inv_broadcasts")
+        t.counter("fleet_inv_broadcasts").inc()
+        return acked
+
+    def apply_invalidation(self, mode: str, arg) -> int:
+        """Server side of `inv`: drop matching LOCAL result-cache
+        entries (propagate=False — the origin already told everyone)
+        and matching export entries."""
+        from ..runtime import result_cache
+        n = self._drop_export(mode, arg)
+        if mode == "prefix":
+            n += result_cache.invalidate_prefix(str(arg),
+                                                propagate=False)
+        elif mode == "paths":
+            n += result_cache.invalidate_paths(list(arg or ()),
+                                               propagate=False)
+        elif mode == "plan_fp":
+            n += result_cache.invalidate_plan_fp(arg)
+        self._bump("fleet_inv_applied")
+        _telemetry().counter("fleet_inv_applied").inc()
+        return n
+
+    def _drop_export(self, mode: str, arg) -> int:
+        if mode == "prefix":
+            return self.export.drop_prefix(str(arg))
+        if mode == "paths":
+            return self.export.drop_paths(arg or ())
+        if mode == "plan_fp":
+            return self.export.drop_plan_fp(
+                _normalize_fp(arg))
+        return 0
+
+    # -- warm-state publication ----------------------------------------
+    def warm_state_payload(self) -> dict:
+        """What a joining peer pulls from us: the in-memory warm-pack
+        manifest (recorded SQL + stable observed program specs, host-
+        fingerprint-gated on the RECEIVING side) and the calibration
+        table."""
+        from ..plan.stats import export_calibration
+        from ..runtime import warm_pack
+        self._bump("fleet_warm_served")
+        return {"manifest": warm_pack.build_manifest(self.conf),
+                "calibration": export_calibration()}
+
+    def pull_warm_state(self) -> dict:
+        """Cold-join warm-up: pull from the designated donor (the
+        longest-lived live peer) and apply. Advisory — any failure
+        returns a skipped summary and the member serves cold."""
+        summary = {"status": "skipped"}
+        if not self._warm_pull:
+            self.warm_summary = summary
+            return summary
+        donor = self.directory.oldest_peer(exclude=self.peer_id)
+        if donor is None:
+            self.warm_summary = summary
+            return summary
+        payload = pull_warm_state(donor.addr, timeout=self._timeout * 6)
+        if not payload:
+            self.warm_summary = summary
+            return summary
+        from ..plan.stats import import_calibration
+        from ..runtime import warm_pack
+        imported = 0
+        try:
+            imported = import_calibration(payload.get("calibration"))
+        except Exception:
+            log.warning("fleet: calibration import from %s failed",
+                        donor.peer_id, exc_info=True)
+        summary = {"status": "ok", "donor": donor.peer_id,
+                   "calibration_imported": imported}
+        manifest = payload.get("manifest")
+        if manifest:
+            summary["preload"] = warm_pack.preload_manifest(
+                self.session, manifest)
+        self._bump("fleet_warm_pulls")
+        self.warm_summary = summary
+        return summary
+
+    # -- introspection --------------------------------------------------
+    def _bump(self, key: str, n: int = 1) -> None:
+        with self._slock:
+            self.stats[key] += n
+
+    def snapshot(self) -> dict:
+        with self._slock:
+            out = dict(self.stats)
+        out.update({f"fleet_export_{k}": v
+                    for k, v in self.export.stats().items()})
+        out["fleet_peer_id"] = self.peer_id
+        out["fleet_peers_live"] = len(self.peers(include_self=True))
+        return out
+
+
+def _normalize_fp(fp):
+    """Plan fingerprints are nested tuples; they ride the wire through
+    pickle intact, but normalize list-shaped ones defensively."""
+    if isinstance(fp, list):
+        return tuple(_normalize_fp(x) for x in fp)
+    return fp
+
+
+# ---------------------------------------------------------------------
+# the result-cache dispatcher + join()
+# ---------------------------------------------------------------------
+class _Dispatcher:
+    """What runtime/result_cache.py holds: resolves the thread's active
+    member per call, so one process can host several members (tests)
+    while the common case stays two attribute reads + None check."""
+
+    @staticmethod
+    def consult(key, paths=()):
+        m = fleet_context.active_member()
+        return m.consult(key, paths) if m is not None else None
+
+    @staticmethod
+    def publish(key, value, nbytes, tier, paths, plan_fp=None):
+        m = fleet_context.active_member()
+        if m is not None:
+            m.publish(key, value, nbytes, tier, paths, plan_fp=plan_fp)
+
+    @staticmethod
+    def broadcast(mode, arg):
+        m = fleet_context.active_member()
+        if m is not None:
+            m.broadcast_invalidate(mode, arg)
+
+
+_DISPATCHER = _Dispatcher()
+
+
+def install_dispatcher() -> None:
+    """Idempotently wire the fleet tier into the result cache and
+    register the pull gauges. Safe to call with no member joined —
+    every dispatch no-ops on a None active member."""
+    from ..profiler import telemetry
+    from ..runtime import result_cache
+    result_cache.set_peer_tier(_DISPATCHER)
+
+    def _fleet_gauges():
+        m = fleet_context.default_member()
+        if m is None:
+            return {}
+        return {k: v for k, v in m.snapshot().items()
+                if isinstance(v, (int, float))}
+
+    telemetry.register_gauge_fn("fleet", _fleet_gauges)
+
+
+def join(session, gateway_addr=None) -> Optional[FleetMember]:
+    """Join the fleet named by sql.fleet.directory: start the peer
+    cache server, register, install as process default, and pull warm
+    state from the designated donor. Returns None (and changes
+    nothing) when no fleet directory is configured."""
+    from ..config import FLEET_DIRECTORY
+    conf = session.conf
+    root = str(conf.get(FLEET_DIRECTORY) or "").strip()
+    if not root:
+        return None
+    # idempotent per process: serve() after an explicit join (or a
+    # second serve()) must not register a phantom second member. A
+    # late-arriving gateway address upgrades the existing registration.
+    existing = fleet_context.default_member()
+    if existing is not None and not existing._closed:
+        if gateway_addr is not None and existing.info.gateway is None:
+            existing.info.gw_host, existing.info.gw_port = gateway_addr
+            existing.directory.register(existing.info)
+            existing.refresh_peers()
+        return existing
+    member = FleetMember(session, conf, root, gateway_addr=gateway_addr)
+    install_dispatcher()
+    fleet_context.set_default(member)
+    try:
+        member.pull_warm_state()
+    except Exception:
+        log.warning("fleet: warm-state pull failed; serving cold",
+                    exc_info=True)
+    return member

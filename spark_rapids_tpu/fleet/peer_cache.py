@@ -1,0 +1,373 @@
+"""Peer cache server + client: the wire layer of the cluster cache
+tier.
+
+Each fleet member runs one of these servers (the block-server shape
+from cluster/blocks.py — length-prefixed Arrow-IPC frames via
+cluster/rpc.py, a daemon accept loop, one thread per connection) and
+serves three verbs:
+
+  fetch {key}     -> hit {meta, _arrow: [tables...]} | miss {}
+  inv   {mode, arg} -> ok {}   (apply a peer's invalidation locally)
+  warm  {}        -> warm {manifest, calibration}  (cold-join pull)
+  ping  {}        -> ok {}
+
+What a member SERVES is its export store: a byte-bounded LRU of the
+query results and exchange fragments its local result cache stored,
+held BY REFERENCE (the same immutable pyarrow tables — exporting costs
+an index entry, not a copy). Serving from a separate store rather than
+reading the process-global result cache directly is what lets two
+in-process members behave like two processes under test: each member
+only ever answers with results IT computed.
+
+Soundness does not depend on this wire: cache keys embed scan-snapshot
+fingerprints (io/snapshot.py), and every requester re-stats its plan's
+files before computing the key it asks for — a peer still holding an
+entry for overwritten files holds it under a key nobody will ever
+request again. The `inv` verb (and the broadcast feeding it) is
+hygiene: it frees stale bytes promptly and keeps the export index from
+serving entries whose files the requester would immediately reject.
+On top of the key discipline, a fetched entry's recorded snapshot is
+re-stat'd ON THE REQUESTER before acceptance — a stale entry that
+slipped past both layers (the chaos harness manufactures this race) is
+rejected, counted, and recomputed locally.
+
+Fault injection: every client fetch/broadcast attempt passes the
+`peer.fetch` point (runtime/faults.py), and transient failures retry
+on bounded backoff (runtime/backoff.py) before the consult degrades —
+byte-identically — to local recompute.
+"""
+from __future__ import annotations
+
+import socket
+import threading
+from collections import OrderedDict
+from typing import Dict, List, Optional, Tuple
+
+from ..cluster.rpc import RpcClosed, recv_msg, send_msg
+from ..runtime import lockdep
+
+__all__ = ["PeerFetchFailed", "ExportStore", "PeerCacheServer",
+           "fetch_entry", "send_invalidate", "pull_warm_state"]
+
+
+class PeerFetchFailed(ConnectionError):
+    """A peer-cache fetch failed. Subclasses ConnectionError so
+    faults.is_transient_error classifies it without a special case;
+    `transient=False` marks structural replies (peer answered 'miss' is
+    NOT an error; a protocol violation is, and retrying won't fix it)."""
+
+    def __init__(self, msg: str, addr=None, transient: bool = True):
+        super().__init__(msg)
+        self.addr = tuple(addr) if addr else None
+        self.transient = transient
+
+
+# ---------------------------------------------------------------------
+# export store
+# ---------------------------------------------------------------------
+class ExportStore:
+    """Byte-bounded LRU of (key -> value, meta) a member serves to
+    peers. Values are the result cache's own immutable objects
+    (pa.Table for the query tier, the fragment record for the fragment
+    tier); `meta` carries tier/paths/snapshot so the server can build a
+    wire reply and apply path-prefix invalidations without touching the
+    value."""
+
+    def __init__(self, max_bytes: int):
+        self._lock = lockdep.lock("Fleet.ExportStore._lock")
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        self._bytes = 0
+        self.max_bytes = int(max_bytes)
+
+    def put(self, key, value, nbytes: int, meta: dict) -> None:
+        if nbytes > self.max_bytes:
+            return
+        with self._lock:
+            old = self._entries.pop(key, None)
+            if old is not None:
+                self._bytes -= old[1]
+            while self._entries and \
+                    self._bytes + nbytes > self.max_bytes:
+                _, (v, nb, m) = self._entries.popitem(last=False)
+                self._bytes -= nb
+            self._entries[key] = (value, int(nbytes), meta)
+            self._bytes += int(nbytes)
+
+    def get(self, key):
+        """(value, meta) or None; touches LRU recency."""
+        with self._lock:
+            ent = self._entries.get(key)
+            if ent is None:
+                return None
+            self._entries.move_to_end(key)
+            return ent[0], ent[2]
+
+    def drop_paths(self, paths) -> int:
+        """Drop every entry whose meta paths intersect `paths`."""
+        pset = set(paths)
+        with self._lock:
+            doomed = [k for k, (v, nb, m) in self._entries.items()
+                      if pset.intersection(m.get("paths") or ())]
+            for k in doomed:
+                _, nb, _ = self._entries.pop(k)
+                self._bytes -= nb
+        return len(doomed)
+
+    def drop_prefix(self, prefix: str) -> int:
+        with self._lock:
+            doomed = [k for k, (v, nb, m) in self._entries.items()
+                      if any(p.startswith(prefix)
+                             for p in (m.get("paths") or ()))]
+            for k in doomed:
+                _, nb, _ = self._entries.pop(k)
+                self._bytes -= nb
+        return len(doomed)
+
+    def drop_plan_fp(self, pfp) -> int:
+        with self._lock:
+            doomed = [k for k, (v, nb, m) in self._entries.items()
+                      if m.get("plan_fp") == pfp]
+            for k in doomed:
+                _, nb, _ = self._entries.pop(k)
+                self._bytes -= nb
+        return len(doomed)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {"entries": len(self._entries), "bytes": self._bytes}
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+
+# ---------------------------------------------------------------------
+# wire encoding of cached values
+# ---------------------------------------------------------------------
+def _encode(value, meta: dict) -> Tuple[dict, List]:
+    """(payload_meta, tables) for one export entry. Query tier ships
+    the table; fragment tier ships the non-empty partitions plus a
+    presence mask (None partitions reconstruct on the far side)."""
+    out = {k: meta[k] for k in ("tier", "paths", "snapshot", "plan_fp")
+           if k in meta}
+    if meta.get("tier") == "fragment":
+        mask = [t is not None for t in value.tables]
+        out["mask"] = mask
+        out["pstats"] = list(value.pstats)
+        return out, [t for t in value.tables if t is not None]
+    return out, [value]
+
+
+def _decode(payload: dict):
+    """(tier, value, meta) from a `hit` reply; value is a pa.Table for
+    the query tier, (tables, pstats) for the fragment tier."""
+    tables = payload.get("_arrow") or []
+    meta = {k: payload[k] for k in ("tier", "paths", "snapshot",
+                                    "plan_fp") if k in payload}
+    tier = meta.get("tier", "query")
+    if tier == "fragment":
+        it = iter(tables)
+        full = [next(it) if present else None
+                for present in payload.get("mask", ())]
+        return tier, (full, list(payload.get("pstats", ()))), meta
+    if not tables:
+        raise PeerFetchFailed("hit reply carried no table",
+                              transient=False)
+    return tier, tables[0], meta
+
+
+# ---------------------------------------------------------------------
+# server
+# ---------------------------------------------------------------------
+class PeerCacheServer:
+    """One member's cache/warm-state server. Instantiable (NOT a
+    process singleton like the shuffle block server) because the test
+    and chaos harnesses run several members per process; `member` is
+    the owning FleetMember — `inv` and `warm` delegate to it."""
+
+    def __init__(self, member, host: str = "0.0.0.0"):
+        self.member = member
+        self._listener = socket.socket(socket.AF_INET,
+                                       socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET,
+                                  socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, 0))
+        self._listener.listen(16)
+        self.port = self._listener.getsockname()[1]
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._accept_loop, daemon=True,
+            name=f"tpu-fleet-peer-{self.port}")
+        self._thread.start()
+
+    def close(self) -> None:
+        self._stop.set()
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+
+    def _accept_loop(self):
+        while not self._stop.is_set():
+            try:
+                conn, _ = self._listener.accept()
+            except OSError:
+                return
+            threading.Thread(target=self._serve_conn, args=(conn,),
+                             daemon=True,
+                             name="tpu-fleet-peer-conn").start()
+
+    def _serve_conn(self, sock: socket.socket):
+        try:
+            while True:
+                kind, payload = recv_msg(sock)
+                if kind == "fetch":
+                    self._serve_fetch(sock, payload)
+                elif kind == "inv":
+                    n = self.member.apply_invalidation(
+                        payload.get("mode"), payload.get("arg"))
+                    send_msg(sock, "ok", {"dropped": n})
+                elif kind == "warm":
+                    send_msg(sock, "warm",
+                             self.member.warm_state_payload())
+                elif kind == "ping":
+                    send_msg(sock, "ok", {})
+                else:
+                    return
+        except (RpcClosed, OSError):
+            pass
+        finally:
+            sock.close()
+
+    def _serve_fetch(self, sock, payload):
+        ent = self.member.export.get(tuple_key(payload.get("key")))
+        if ent is None:
+            send_msg(sock, "miss", {})
+            return
+        value, meta = ent
+        out, tables = _encode(value, meta)
+        send_msg(sock, "hit", out, tables=tables)
+
+
+def tuple_key(key):
+    """Cache keys are nested tuples; pickle round-trips them intact,
+    but normalize defensively so a list-shaped key from a foreign
+    client still indexes."""
+    if isinstance(key, list):
+        return tuple(tuple_key(k) for k in key)
+    return key
+
+
+# ---------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------
+def _request(addr: Tuple[str, int], kind: str, payload: dict,
+             timeout: float):
+    """One request/response exchange, faults.peer.fetch instrumented.
+    Transient socket failures raise PeerFetchFailed(transient=True)."""
+    from ..runtime import faults
+    if faults.ACTIVE:
+        faults.hit("peer.fetch", op=kind)
+    try:
+        sock = socket.create_connection(tuple(addr), timeout=timeout)
+    except OSError as e:
+        raise PeerFetchFailed(f"connect {addr}: {e!r}",
+                              addr=addr) from e
+    try:
+        sock.settimeout(timeout)
+        send_msg(sock, kind, payload)
+        return recv_msg(sock)
+    except (RpcClosed, OSError) as e:
+        raise PeerFetchFailed(f"{kind} from {addr}: {e!r}",
+                              addr=addr) from e
+    finally:
+        sock.close()
+
+
+def _retrying(addr, kind, payload, timeout, retries, backoff_ms,
+              seed_extra=0):
+    """Bounded-retry wrapper shared by fetch and invalidation sends;
+    deterministic jitter seeded per (addr, verb) so concurrent callers
+    de-synchronize (the fetch_blocks discipline)."""
+    import time as _time
+
+    from ..profiler import tracing
+    from ..runtime.backoff import backoff_delays
+    from ..runtime.faults import is_transient_error, note_recovery
+    seed = (hash((tuple(addr), kind)) ^ seed_extra) & 0xFFFFFFFF
+    delays = backoff_delays(retries, backoff_ms, seed=seed)
+    attempt = 0
+    while True:
+        try:
+            return _request(addr, kind, payload, timeout)
+        except Exception as e:
+            # PeerFetchFailed carries its own transience verdict;
+            # anything else (an injected peer.fetch fault — FetchFailed,
+            # InjectedFault) goes through the engine classifier, so the
+            # chaos harness exercises the same retry loop real socket
+            # failures do
+            if isinstance(e, PeerFetchFailed):
+                transient = e.transient
+            else:
+                transient = is_transient_error(e)
+            if not transient or attempt >= retries:
+                raise
+            d = delays[attempt]
+            attempt += 1
+            note_recovery("peer_fetch_retries")
+            t0 = _time.perf_counter()
+            _time.sleep(d)
+            tracing.record_wait_span(
+                "fleet.peer_backoff", "backoff",
+                (_time.perf_counter() - t0) * 1e3, attempt=attempt)
+
+
+def fetch_entry(addr: Tuple[str, int], key, timeout: float = 5.0,
+                retries: int = 2, backoff_ms: float = 20.0):
+    """Ask one peer for a cache entry. Returns (tier, value, meta) or
+    None on a miss; raises PeerFetchFailed after the bounded retries.
+    The span covers the whole attempt — connect, transfer, and any
+    injected peer.fetch delay — which is how a slow peer becomes the
+    critical path's peer_fetch edge."""
+    from ..profiler import tracing
+    with tracing.span("fleet.peer_fetch", "peer_fetch",
+                      peer=f"{addr[0]}:{addr[1]}"):
+        kind, payload = _retrying(addr, "fetch", {"key": key}, timeout,
+                                  retries, backoff_ms,
+                                  seed_extra=hash(repr(key)))
+    if kind == "miss":
+        return None
+    if kind != "hit":
+        raise PeerFetchFailed(f"peer {addr} answered {kind!r}",
+                              addr=addr, transient=False)
+    return _decode(payload)
+
+
+def send_invalidate(addr: Tuple[str, int], mode: str, arg,
+                    timeout: float = 5.0, retries: int = 1,
+                    backoff_ms: float = 20.0) -> bool:
+    """Deliver one invalidation to one peer; True on ack. Best-effort
+    by contract — the caller counts failures and moves on (the
+    snapshot-key discipline keeps a missed delivery sound)."""
+    try:
+        kind, _ = _retrying(addr, "inv", {"mode": mode, "arg": arg},
+                            timeout, retries, backoff_ms)
+        return kind == "ok"
+    except Exception:
+        # injected faults included: an undelivered broadcast is counted
+        # by the caller and covered by the snapshot-key discipline
+        return False
+
+
+def pull_warm_state(addr: Tuple[str, int],
+                    timeout: float = 30.0) -> Optional[Dict]:
+    """Fetch a donor peer's warm-state payload (warm-pack manifest +
+    calibration table). None on any failure — cold-join warm-up is
+    advisory, exactly like a missing warm pack on disk."""
+    try:
+        kind, payload = _retrying(addr, "warm", {}, timeout,
+                                  retries=1, backoff_ms=50.0)
+    except Exception:
+        return None
+    return payload if kind == "warm" else None

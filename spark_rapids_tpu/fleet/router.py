@@ -1,0 +1,144 @@
+"""Fingerprint-sticky front-end routing with fleet-wide admission.
+
+Any member's gateway can answer the `route` verb: given a query's plan
+fingerprint, every member independently computes the same rendezvous
+order over the live gateway-bearing peers, so "which process is warm
+for this query" needs no shared state — the answer IS the hash. Index
+0 is the sticky choice (its result cache, program cache, and
+calibration tables have seen this fingerprint before, or will own it
+from now on); the router spills down the order only when the sticky
+peer is saturated, and the spill target is itself stable, so even the
+overflow lands warm.
+
+Admission is the fleet analog of the per-pool DRR caps: a per-tenant
+in-flight ceiling across ALL peers (one tenant cannot occupy every
+backend) and a per-peer ceiling that converts "sticky" into "sticky
+until saturated". Both are lease-based: `route` grants a lease, the
+client reports `route_done`, and leases expire on a lazy TTL so a
+crashed client cannot permanently consume a tenant's budget.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from ..runtime import lockdep
+from .directory import rendezvous_order
+
+__all__ = ["RouteRejected", "Router"]
+
+#: leases older than this are presumed abandoned (client crashed
+#: between route and route_done) and reclaimed lazily on the next route
+_LEASE_TTL_SECS = 600.0
+
+
+class RouteRejected(Exception):
+    """Fleet-wide admission refused the query (tenant over its
+    in-flight cap, or no live gateway peers)."""
+
+    def __init__(self, reason: str, tenant: str = None):
+        super().__init__(reason)
+        self.reason = reason
+        self.tenant = tenant
+
+
+class Router:
+    """Routing + admission state for one gateway process."""
+
+    def __init__(self, member, conf=None):
+        self.member = member
+        self._lock = lockdep.lock("Fleet.Router._lock")
+        self._leases: Dict[str, tuple] = {}   # id -> (peer, tenant, ts)
+        self._peer_inflight: Dict[str, int] = {}
+        self._tenant_inflight: Dict[str, int] = {}
+        self._seq = 0
+        self._stats = {"fleet_route_sticky": 0, "fleet_route_spill": 0,
+                       "fleet_route_rejected": 0}
+        conf = conf if conf is not None else member.conf
+        from ..config import (FLEET_PEER_MAX_INFLIGHT,
+                              FLEET_TENANT_MAX_INFLIGHT)
+        self.tenant_cap = int(conf.get(FLEET_TENANT_MAX_INFLIGHT))
+        self.peer_cap = int(conf.get(FLEET_PEER_MAX_INFLIGHT))
+
+    # -- lease bookkeeping (all under _lock) ---------------------------
+    def _expire_locked(self, now: float) -> None:
+        doomed = [lid for lid, (_, _, ts) in self._leases.items()
+                  if now - ts > _LEASE_TTL_SECS]
+        for lid in doomed:
+            self._release_locked(lid)
+
+    def _release_locked(self, lease_id: str) -> bool:
+        ent = self._leases.pop(lease_id, None)
+        if ent is None:
+            return False
+        peer, tenant, _ = ent
+        for table, k in ((self._peer_inflight, peer),
+                         (self._tenant_inflight, tenant)):
+            n = table.get(k, 0) - 1
+            if n > 0:
+                table[k] = n
+            else:
+                table.pop(k, None)
+        return True
+
+    # -- the route decision --------------------------------------------
+    def route(self, plan_fp, tenant: str = "default") -> dict:
+        """Pick the serving peer for `plan_fp`, grant a lease. Returns
+        {peer_id, host, port, sticky, lease}; host/port are the chosen
+        peer's GATEWAY. Raises RouteRejected on admission failure."""
+        from ..profiler import telemetry
+        peers = [p for p in self.member.peers(include_self=True)
+                 if p.gateway is not None]
+        if not peers:
+            self._bump("fleet_route_rejected")
+            telemetry.counter("fleet_route_rejected").inc()
+            raise RouteRejected("no live gateway peers", tenant)
+        by_id = {p.peer_id: p for p in peers}
+        order = rendezvous_order(plan_fp, list(by_id))
+        now = time.monotonic()
+        with self._lock:
+            self._expire_locked(now)
+            if self.tenant_cap > 0 and \
+                    self._tenant_inflight.get(tenant, 0) >= \
+                    self.tenant_cap:
+                self._stats["fleet_route_rejected"] += 1
+                telemetry.counter("fleet_route_rejected").inc()
+                raise RouteRejected("tenant over in-flight cap", tenant)
+            chosen, sticky = order[0], True
+            if self.peer_cap > 0:
+                for pid in order:
+                    if self._peer_inflight.get(pid, 0) < self.peer_cap:
+                        chosen, sticky = pid, (pid == order[0])
+                        break
+                # every peer saturated: stay sticky — queueing on the
+                # warm peer beats a cold compile on a busy one
+            self._seq += 1
+            lease = f"{self.member.peer_id}#{self._seq}"
+            self._leases[lease] = (chosen, tenant, now)
+            self._peer_inflight[chosen] = \
+                self._peer_inflight.get(chosen, 0) + 1
+            self._tenant_inflight[tenant] = \
+                self._tenant_inflight.get(tenant, 0) + 1
+            self._stats["fleet_route_sticky" if sticky
+                        else "fleet_route_spill"] += 1
+        telemetry.counter("fleet_route_sticky" if sticky
+                          else "fleet_route_spill").inc()
+        gw = by_id[chosen].gateway
+        return {"peer_id": chosen, "host": gw[0], "port": gw[1],
+                "sticky": sticky, "lease": lease}
+
+    def done(self, lease_id: str) -> bool:
+        """Client-side completion: release the lease's admission slots."""
+        with self._lock:
+            return self._release_locked(lease_id)
+
+    def stats(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["fleet_route_leases"] = len(self._leases)
+            out["fleet_route_tenants"] = len(self._tenant_inflight)
+        return out
+
+    def _bump(self, key: str) -> None:
+        with self._lock:
+            self._stats[key] += 1

@@ -26,7 +26,8 @@ __all__ = ["Stats", "compute_stats", "scan_column_ndv",
            "calibration_scope", "calibration_lookup", "logical_fp",
            "join_set_fp", "attach_calibration_fps",
            "harvest_calibration", "calibration_stats",
-           "clear_calibration"]
+           "clear_calibration", "export_calibration",
+           "import_calibration"]
 
 # Rows sampled (from the first batch / the arrow table head) for NDV.
 SAMPLE_ROWS = 1 << 16
@@ -98,6 +99,43 @@ def clear_calibration() -> None:
         _CAL.clear()
         for k in _CAL_STATS:
             _CAL_STATS[k] = 0
+
+
+def export_calibration():
+    """The calibration table as a picklable [(key, rows), ...] — the
+    fleet warm-state payload (fleet/member.py). Keys are nested tuples
+    of primitives (logical_fp/join_set_fp), so they survive the wire
+    intact."""
+    with _CAL_LOCK:
+        return list(_CAL.items())
+
+
+def import_calibration(table) -> int:
+    """Merge a peer's exported calibration table. Peer entries only
+    fill HOLES — a locally observed row count reflects THIS process's
+    data view and always wins. Returns entries adopted."""
+    if not table:
+        return 0
+    adopted = 0
+    with _CAL_LOCK:
+        for key, rows in table:
+            key = _freeze(key)
+            if key in _CAL:
+                continue
+            _CAL[key] = float(rows)
+            adopted += 1
+        if adopted:
+            _CAL_STATS["calibration_updates"] += adopted
+    return adopted
+
+
+def _freeze(key):
+    """Normalize list-shaped wire keys back to the tuple form the
+    fingerprint functions produce (defensive: pickle preserves tuples,
+    but a JSON-bounced payload would not)."""
+    if isinstance(key, list):
+        return tuple(_freeze(k) for k in key)
+    return key
 
 
 def logical_fp(node: L.LogicalPlan):

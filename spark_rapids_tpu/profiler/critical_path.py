@@ -36,7 +36,7 @@ __all__ = ["CATEGORIES", "category_of", "summarize", "span_depths",
 
 #: edge vocabulary, in render order
 CATEGORIES = ("queue", "plan", "compile", "shuffle_fetch", "collective",
-              "spill", "pool_wait", "retry", "compute")
+              "spill", "pool_wait", "retry", "peer_fetch", "compute")
 
 #: span kind -> edge category (kinds not listed count as compute)
 _KIND_CATEGORY = {
@@ -54,6 +54,10 @@ _KIND_CATEGORY = {
     "retry": "retry",
     "backoff": "retry",
     "degrade": "retry",
+    # fleet peer-cache fetches (fleet/peer_cache.py): a slow peer shows
+    # up as its own edge rather than hiding inside compute, so "was the
+    # fleet worth it" is answerable per query
+    "peer_fetch": "peer_fetch",
 }
 
 #: a non-compute edge must cover at least this fraction of the query

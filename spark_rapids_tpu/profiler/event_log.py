@@ -265,6 +265,7 @@ def profile_query(session, root, ctx, action: str, handle=None):
     from ..runtime import result_cache
     rc_on = result_cache.enabled(ctx.conf)
     rc0 = result_cache.stats() if rc_on else None
+    fleet0 = _fleet_stats()
     diagnostics.reset_watermarks()
     t0 = time.perf_counter()
     if handle is not None:
@@ -357,6 +358,9 @@ def profile_query(session, root, ctx, action: str, handle=None):
                        - rc0["result_cache_invalidations"],
                        entries=rc1["result_cache_entries"],
                        bytes=rc1["result_cache_bytes"])
+            fleet1 = _fleet_stats()
+            if fleet1 is not None:
+                w.emit("fleet", **_fleet_delta(fleet0, fleet1))
             wall = time.perf_counter() - t0
             # distributed-tracing assembly: end the root span, drain
             # every span the query recorded (driver threads, pool
@@ -380,6 +384,34 @@ def profile_query(session, root, ctx, action: str, handle=None):
             w.emit("query_end", **end)
         finally:
             w.close()
+
+
+def _fleet_stats():
+    """Counter snapshot of this thread's active fleet member, or None
+    outside a fleet — the `fleet` event only appears in logs of fleet
+    processes."""
+    try:
+        from ..fleet import context as fleet_context
+    except Exception:
+        return None
+    m = fleet_context.active_member()
+    if m is None:
+        return None
+    return {k: v for k, v in m.snapshot().items()
+            if isinstance(v, (int, float))}
+
+
+def _fleet_delta(before, after) -> dict:
+    """Per-query deltas for the monotone counters, absolute values for
+    the gauges (export size, live-peer count)."""
+    before = before or {}
+    out = {}
+    for k, v in after.items():
+        if k.startswith(("fleet_export_", "fleet_peers_")):
+            out[k.replace("fleet_", "", 1)] = v
+        else:
+            out[k.replace("fleet_", "", 1)] = v - before.get(k, 0)
+    return out
 
 
 def log_fast_path(session, conf, handle, action: str, rows: int,

@@ -6,8 +6,13 @@ the plugin's OOM-retry / shuffle-refetch machinery is *exercised*, not
 hoped-for. Same idea here, engine-native: named fault points are
 instrumented across cluster/, shuffle/, exec/, memory/ and service/
 (`block.fetch`, `rpc.send`, `executor.task`, `device.dispatch`,
-`exchange.map`, `spill.write`, `xla.compile`, `mesh.collective`), and a
-fault PLAN selects which calls fail and how. `mesh.collective` fires in
+`exchange.map`, `spill.write`, `xla.compile`, `mesh.collective`,
+`peer.fetch`), and a fault PLAN selects which calls fail and how.
+`peer.fetch` fires on every fleet peer-cache request (fetch,
+invalidation delivery, warm-state pull — fleet/peer_cache.py; the verb
+arrives as op=), so peer failures, slow peers, and delayed/lost
+invalidation broadcasts are all injectable; every one must degrade to
+local recompute, byte-identically. `mesh.collective` fires in
 the SPMD stage launch path (exec/spmd_stage.py): live hits
 (background=0) fail the fused collective program and must degrade the
 stage to the round-based exchange (counted `spmdDegraded`); bg=1 hits
@@ -75,7 +80,7 @@ ACTIVE = False
 #: bench --chaos plan generator both derive from this tuple)
 POINTS = ("block.fetch", "device.dispatch", "executor.task",
           "spill.write", "xla.compile", "exchange.map", "rpc.send",
-          "mesh.collective")
+          "mesh.collective", "peer.fetch")
 
 _lock = threading.Lock()
 _spec: Optional[str] = None

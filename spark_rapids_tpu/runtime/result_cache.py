@@ -43,6 +43,16 @@ auditor covers the cache for the whole tier-1 suite.
 
 Off by default (`spark.rapids.tpu.sql.cache.enabled`): repeat-heavy
 serving opts in per session, the Spark/Presto result-cache posture.
+
+**Fleet tier** (PR 20): when a process has joined the serving fabric
+(spark_rapids_tpu/fleet/), a local miss in either tier consults the
+rendezvous-ordered owning peers before recomputing, local stores are
+published (by reference) to the member's export store, and every
+invalidation broadcasts to the fleet. The hook is one module-level
+dispatcher installed by `set_peer_tier`; all peer IO happens OUTSIDE
+`_lock`, and soundness never depends on it — keys embed scan
+snapshots, so a peer holding a stale entry holds an unreachable key,
+and fetched entries are re-stat'd before acceptance besides.
 """
 from __future__ import annotations
 
@@ -55,8 +65,8 @@ from . import lockdep, racedep
 __all__ = [
     "enabled", "fragments_enabled", "lookup_query", "put_query",
     "substitute_fragments", "harvest_fragments", "invalidate_paths",
-    "invalidate_prefix", "invalidate_plan", "stats", "clear",
-    "set_host_manager",
+    "invalidate_prefix", "invalidate_plan", "invalidate_plan_fp",
+    "stats", "clear", "set_host_manager", "set_peer_tier",
     "CachedFragmentExec",
 ]
 
@@ -78,6 +88,8 @@ _stats = {
     "result_cache_evictions": 0,
     "result_cache_invalidations": 0,
     "result_cache_rejected": 0,
+    "result_cache_peer_hits": 0,
+    "result_cache_peer_fragment_hits": 0,
 }
 # host managers that already carry our pressure hook (the global
 # singleton plus any test-injected private manager)
@@ -85,6 +97,19 @@ _hooked: "weakref.WeakSet" = weakref.WeakSet()
 # test hook: a PRIVATE HostMemoryManager so budget tests never mutate
 # the process singleton's budget (that would poison later tests)
 _host_override = None
+# the fleet dispatcher (fleet/member.py installs it; None = no fleet).
+# Resolved per call, never under _lock: consult/publish/broadcast all
+# do socket IO and must not serialize the cache.
+_peer_tier = None
+
+
+def set_peer_tier(tier) -> None:
+    """Install (or clear, with None) the fleet peer-tier dispatcher:
+    an object with consult(key, paths), publish(key, value, nbytes,
+    tier, paths, plan_fp=), broadcast(mode, arg). Every dispatch
+    no-ops when no fleet member is active on the calling thread."""
+    global _peer_tier
+    _peer_tier = tier
 
 
 class _Entry:
@@ -150,9 +175,14 @@ def _conf_fp(conf) -> tuple:
     # the FULL conf snapshot: partition counts, batch sizes, broadcast
     # thresholds etc. all change row order or typing of results, and
     # byte-identity to fresh execution is the acceptance bar —
-    # conservative splitting beats a subtly shared wrong answer
-    return tuple(sorted((k, repr(v))
-                        for k, v in conf._settings.items()))
+    # conservative splitting beats a subtly shared wrong answer.
+    # sql.fleet.* is the one excluded family: fleet confs (directory
+    # path, fanout, timeouts) cannot change result bytes, and they
+    # NECESSARILY differ across members — including them would make
+    # every cross-peer key a guaranteed miss.
+    return tuple(sorted(
+        (k, repr(v)) for k, v in conf._settings.items()
+        if not k.startswith("spark.rapids.tpu.sql.fleet.")))
 
 
 def _plan_paths(plan) -> Tuple[str, ...]:
@@ -234,10 +264,12 @@ def _pressure_hook(bytes_needed: int) -> int:
     return freed
 
 
-def _store(key, entry: _Entry, conf):
+def _store(key, entry: _Entry, conf, publish: bool = True):
     """Insert under the byte budget: evict LRU past sql.cache.maxBytes,
     charge the host budget, reject when the host refuses even after
-    making room."""
+    making room. `publish=False` suppresses the fleet export (peer-
+    fetched inserts: a member only ever serves what IT computed, so
+    entries never ping-pong around the fleet)."""
     global _bytes
     cap = _max_bytes(conf)
     if entry.nbytes > min(cap, _max_entry_bytes(conf)):
@@ -281,6 +313,13 @@ def _store(key, entry: _Entry, conf):
         _stats["result_cache_stores" if entry.tier == "query"
                else "result_cache_fragment_stores"] += 1
     _release_host(dropped)
+    if publish and _peer_tier is not None:
+        try:
+            _peer_tier.publish(key, entry.value, entry.nbytes,
+                               entry.tier, entry.paths,
+                               plan_fp=entry.plan_fp)
+        except Exception:
+            pass              # export is advisory, never fails a store
     return True
 
 
@@ -319,7 +358,34 @@ def lookup_query(plan, conf):
     key, pfp, paths = _query_key(plan, conf)
     e = _get(key, "query")
     token = (key, pfp, paths)
-    return (e.value if e is not None else None), token
+    if e is not None:
+        return e.value, token
+    value = _peer_consult_query(key, pfp, paths, conf)
+    return value, token
+
+
+def _peer_consult_query(key, pfp, paths, conf):
+    """Fleet consult after a local query-tier miss (outside _lock).
+    A peer hit is adopted into the local cache WITHOUT re-export
+    (publish=False) and served exactly like a local hit."""
+    if _peer_tier is None:
+        return None
+    try:
+        got = _peer_tier.consult(key, paths)
+    except Exception:
+        return None
+    if got is None or got[0] != "query":
+        return None
+    _, value, _meta = got
+    try:
+        nbytes = int(value.get_total_buffer_size())
+    except Exception:
+        return None
+    with _lock:
+        _stats["result_cache_peer_hits"] += 1
+    _store(key, _Entry(value, nbytes, "query", tuple(paths),
+                       plan_fp=pfp), conf, publish=False)
+    return value
 
 
 def put_query(token, value, conf) -> bool:
@@ -473,6 +539,8 @@ def substitute_fragments(root, conf):
             if key is None:
                 return node
             e = _get(key, "fragment")
+            if e is None:
+                e = _peer_consult_fragment(key, node, conf)
             if e is not None:
                 r = CachedFragmentExec(e, node)
                 replaced[id(node)] = r
@@ -483,6 +551,30 @@ def substitute_fragments(root, conf):
 
     root = walk(root)
     return root, hits
+
+
+def _peer_consult_fragment(key, node, conf) -> Optional[_Entry]:
+    """Fleet consult after a fragment-tier miss (planner thread,
+    outside _lock): a peer's materialized map output substitutes just
+    like a local one, adopted locally without re-export."""
+    if _peer_tier is None:
+        return None
+    paths = _plan_paths(node)
+    try:
+        got = _peer_tier.consult(key, paths)
+    except Exception:
+        return None
+    if got is None or got[0] != "fragment":
+        return None
+    _, value, _meta = got
+    tables, pstats = value
+    nbytes = sum(int(t.get_total_buffer_size())
+                 for t in tables if t is not None)
+    entry = _Entry(_Fragment(tables, pstats), nbytes, "fragment", paths)
+    with _lock:
+        _stats["result_cache_peer_fragment_hits"] += 1
+    _store(key, entry, conf, publish=False)
+    return entry
 
 
 def harvest_fragments(root, ctx) -> int:
@@ -537,10 +629,25 @@ def harvest_fragments(root, ctx) -> int:
 # ---------------------------------------------------------------------
 # invalidation
 
-def invalidate_paths(paths) -> int:
+def _broadcast(mode: str, arg) -> None:
+    """Gossip one invalidation to the fleet (outside _lock, best-
+    effort). No-op without a joined member — the common case costs one
+    None check."""
+    if _peer_tier is None:
+        return
+    try:
+        _peer_tier.broadcast(mode, arg)
+    except Exception:
+        pass
+
+
+def invalidate_paths(paths, propagate: bool = True) -> int:
     """Drop every entry that scans any of `paths` (called by the write
     paths — parquet overwrite, Delta commit — and by the snapshot
-    refresh when it observes an external change). Returns drops."""
+    refresh when it observes an external change). Returns drops.
+    `propagate=False` marks a fleet-delivered invalidation: apply
+    locally only, the origin already told everyone else."""
+    paths = list(paths)
     dropped = []
     with _lock:
         keys = set()
@@ -554,27 +661,25 @@ def invalidate_paths(paths) -> int:
         if dropped:
             _stats["result_cache_invalidations"] += len(dropped)
     _release_host(dropped)
+    if propagate and paths:
+        _broadcast("paths", paths)
     return len(dropped)
 
 
-def invalidate_prefix(prefix: str) -> int:
+def invalidate_prefix(prefix: str, propagate: bool = True) -> int:
     """Drop every entry scanning a file under `prefix` (a table
     directory — the Delta/parquet writers know the root, not which
-    scans read which data files)."""
+    scans read which data files). The broadcast ships the PREFIX, not
+    our resolved paths: each peer indexes different data files."""
     with _lock:
         paths = [p for p in _by_path if p.startswith(prefix)]
-    return invalidate_paths(paths) if paths else 0
+    n = invalidate_paths(paths, propagate=False) if paths else 0
+    if propagate:
+        _broadcast("prefix", prefix)
+    return n
 
 
-def invalidate_plan(plan, conf=None) -> int:
-    """Drop the query-tier entries for `plan` under ANY conf — the
-    `DataFrame.uncache()` interplay: uncache promises the next action
-    is a fresh execution, so the cache must not answer it."""
-    try:
-        from .program_cache import expr_fp
-        pfp = expr_fp(plan)
-    except Exception:
-        return 0
+def _drop_plan_fp(pfp) -> int:
     dropped = []
     with _lock:
         for key in list(_by_plan.get(pfp, ())):
@@ -586,6 +691,28 @@ def invalidate_plan(plan, conf=None) -> int:
             _stats["result_cache_invalidations"] += len(dropped)
     _release_host(dropped)
     return len(dropped)
+
+
+def invalidate_plan(plan, conf=None, propagate: bool = True) -> int:
+    """Drop the query-tier entries for `plan` under ANY conf — the
+    `DataFrame.uncache()` interplay: uncache promises the next action
+    is a fresh execution, so the cache must not answer it — on THIS
+    process and (via the broadcast) on every peer."""
+    try:
+        from .program_cache import expr_fp
+        pfp = expr_fp(plan)
+    except Exception:
+        return 0
+    n = _drop_plan_fp(pfp)
+    if propagate:
+        _broadcast("plan_fp", pfp)
+    return n
+
+
+def invalidate_plan_fp(pfp) -> int:
+    """Fleet-delivered uncache: drop by plan fingerprint directly (the
+    wire carries the fp, not the plan). Never propagates."""
+    return _drop_plan_fp(pfp)
 
 
 # ---------------------------------------------------------------------

@@ -39,7 +39,8 @@ import threading
 from typing import Optional
 
 __all__ = ["VERSION", "enabled", "record_path", "note_query", "save",
-           "preload", "recorded_queries", "reset"]
+           "preload", "recorded_queries", "reset", "build_manifest",
+           "preload_manifest"]
 
 log = logging.getLogger(__name__)
 
@@ -97,6 +98,20 @@ def _fingerprint() -> str:
     return _cache_fingerprint() + "|" + mesh_fingerprint()
 
 
+def build_manifest(conf=None) -> dict:
+    """The manifest dict `save` persists — also the fleet warm-state
+    payload a member serves to a joining peer (fleet/member.py), which
+    ships it over the wire instead of through a file. Same content
+    either way: recorded SQL + every stable observed program spec,
+    bound to this host's cache/mesh fingerprint (the RECEIVER gates on
+    it, exactly like load_manifest)."""
+    from . import program_cache
+    programs = [p for p in program_cache.observed_programs()
+                if program_cache.key_stable(p["base_key"])]
+    return {"version": VERSION, "fingerprint": _fingerprint(),
+            "queries": recorded_queries(), "programs": programs}
+
+
 def save(conf, path: Optional[str] = None) -> Optional[str]:
     """Write the manifest: recorded SQL + every stable observed program
     spec. Returns the path written, or None when recording is disabled
@@ -107,11 +122,7 @@ def save(conf, path: Optional[str] = None) -> Optional[str]:
     path = path or record_path(conf)
     if not path:
         return None
-    from . import program_cache
-    programs = [p for p in program_cache.observed_programs()
-                if program_cache.key_stable(p["base_key"])]
-    manifest = {"version": VERSION, "fingerprint": _fingerprint(),
-                "queries": recorded_queries(), "programs": programs}
+    manifest = build_manifest(conf)
     tmp = f"{path}.tmp.{os.getpid()}"
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(tmp, "wb") as f:
@@ -136,19 +147,27 @@ def load_manifest(path: str) -> Optional[dict]:
         log.warning("warm pack %s is unreadable (%r); starting cold",
                     path, e)
         return None
+    return m if _validate_manifest(m, path) else None
+
+
+def _validate_manifest(m, source: str) -> bool:
+    """Version + host-fingerprint gate, shared by the file path and
+    the fleet wire path — a peer's manifest is as foreign as a file
+    recorded on another box and gets exactly the same scrutiny."""
     if not isinstance(m, dict) or m.get("version") != VERSION:
         log.warning("warm pack %s has version %r (want %d); ignoring",
-                    path, m.get("version") if isinstance(m, dict)
+                    source, m.get("version") if isinstance(m, dict)
                     else None, VERSION)
-        return None
+        return False
     fp = _fingerprint()
     if m.get("fingerprint") != fp:
         log.warning(
             "warm pack %s was recorded on host fingerprint %s; this "
             "host is %s — programs may embed foreign microarch target "
-            "options, ignoring the pack", path, m.get("fingerprint"), fp)
-        return None
-    return m
+            "options, ignoring the pack", source,
+            m.get("fingerprint"), fp)
+        return False
+    return True
 
 
 def preload(session, path: Optional[str] = None) -> dict:
@@ -156,7 +175,7 @@ def preload(session, path: Optional[str] = None) -> dict:
     compiling — every program in their trees), then background-compile
     any recorded signature still cold. Returns a summary dict;
     {"status": "skipped"} when disabled/invalid. Never raises."""
-    from ..config import WARM_PACK_PATH, WARM_PACK_REPLAY
+    from ..config import WARM_PACK_PATH
     conf = session.conf
     path = path or str(conf.get(WARM_PACK_PATH) or "").strip()
     if not path or not enabled():
@@ -164,6 +183,19 @@ def preload(session, path: Optional[str] = None) -> dict:
     m = load_manifest(path)
     if m is None:
         return {"status": "skipped"}
+    return preload_manifest(session, m, validated=True)
+
+
+def preload_manifest(session, m: dict, validated: bool = False) -> dict:
+    """Preload from an in-memory manifest (the fleet cold-join pull
+    hands the donor's manifest straight here). Validates unless the
+    caller already did."""
+    if not enabled() or m is None:
+        return {"status": "skipped"}
+    if not validated and not _validate_manifest(m, "<peer>"):
+        return {"status": "skipped"}
+    from ..config import WARM_PACK_REPLAY
+    conf = session.conf
     from . import compile_pool, program_cache
     # seed the observed-spec table first: even for sites the replay
     # below cannot resolve to a live program (missing tables on this

@@ -25,6 +25,21 @@ log-bucket latency histograms (p50/p95/p99), readable WHILE queries
 run — the surface a fleet router polls. `format: "prometheus"` returns
 the standard text exposition instead of JSON.
 
+Fleet verbs (live only when this process joined a fleet —
+spark_rapids_tpu/fleet/, docs/fleet.md):
+
+    {"op": "route", "sql": "...", "tenant": "t1"}
+        -> {"ok": true, "peer_id": "...", "host": ..., "port": ...,
+            "sticky": true, "lease": "..."}
+    {"op": "route_done", "lease": "..."}  -> {"ok": true}
+    {"op": "fleet"}  -> {"ok": true, "peer_id": ..., "peers": [...],
+                         "stats": {...}}
+
+`route` answers WHERE to submit (the fingerprint-sticky rendezvous
+choice, admission-checked); the client then submits to that peer's
+gateway. Any member's gateway answers `route` identically — the
+rendezvous hash needs no shared state.
+
 Result pages are COLUMNAR ({name: [values...]}) — the arrow batches a
 Thrift client would receive, JSON-encoded for transport neutrality.
 """
@@ -57,6 +72,7 @@ class QueryServer:
         # after the handle leaves the manager's live table
         self._results = {}
         self._lock = threading.Lock()
+        self._router = None       # built on first route (fleet only)
 
     # -- lifecycle ------------------------------------------------------
     def start(self):
@@ -108,7 +124,24 @@ class QueryServer:
                                  daemon=True, name="tpu-svc-conn")
             t.start()
 
+    def _member(self):
+        """This gateway's fleet member (None outside a fleet)."""
+        return getattr(self.session, "_fleet_member", None)
+
     def _serve_conn(self, conn: socket.socket):
+        # bind this connection's work to the session's fleet member:
+        # in-process multi-member tests run several gateways in one
+        # interpreter, and a submit through gateway B must consult and
+        # publish as member B
+        member = self._member()
+        if member is not None:
+            from ..fleet import context as fleet_context
+            with fleet_context.scoped(member):
+                self._conn_loop(conn)
+        else:
+            self._conn_loop(conn)
+
+    def _conn_loop(self, conn: socket.socket):
         with conn:
             rfile = conn.makefile("r", encoding="utf-8")
             wfile = conn.makefile("w", encoding="utf-8")
@@ -144,7 +177,59 @@ class QueryServer:
             return self._cancel(req)
         if op == "metrics":
             return self._metrics(req)
+        if op == "route":
+            return self._route(req)
+        if op == "route_done":
+            return self._route_done(req)
+        if op == "fleet":
+            return self._fleet_info(req)
         return {"ok": False, "error": f"unknown op: {op!r}"}
+
+    # -- fleet verbs ----------------------------------------------------
+    def _get_router(self):
+        member = self._member()
+        if member is None:
+            return None
+        with self._lock:
+            if self._router is None:
+                from ..fleet.router import Router
+                self._router = Router(member)
+            return self._router
+
+    def _route(self, req: dict) -> dict:
+        router = self._get_router()
+        if router is None:
+            return {"ok": False, "error": "not a fleet member"}
+        from ..fleet.router import RouteRejected
+        from ..runtime.program_cache import expr_fp
+        plan_fp = expr_fp(self.session.sql(req["sql"])._plan)
+        try:
+            out = router.route(plan_fp,
+                               tenant=str(req.get("tenant", "default")))
+        except RouteRejected as e:
+            return {"ok": False, "rejected": True, "error": e.reason,
+                    "tenant": e.tenant}
+        out["ok"] = True
+        return out
+
+    def _route_done(self, req: dict) -> dict:
+        router = self._get_router()
+        if router is None:
+            return {"ok": False, "error": "not a fleet member"}
+        return {"ok": True,
+                "released": router.done(str(req.get("lease", "")))}
+
+    def _fleet_info(self, req: dict) -> dict:
+        member = self._member()
+        if member is None:
+            return {"ok": False, "error": "not a fleet member"}
+        out = {"ok": True, "peer_id": member.peer_id,
+               "peers": [p.to_dict() for p in
+                         member.peers(include_self=True)],
+               "stats": member.snapshot()}
+        if self._router is not None:
+            out["router"] = self._router.stats()
+        return out
 
     def _metrics(self, req: dict) -> dict:
         from ..config import TELEMETRY_ENABLED

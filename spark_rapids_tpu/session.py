@@ -128,6 +128,19 @@ class TpuSession:
         self._warm_pack_summary = warm_pack.preload(self)
         srv = QueryServer(self, host, port)
         srv.start()
+        # multi-host serving fabric: when sql.fleet.directory is set,
+        # register this process in the fleet (peer cache tier + sticky
+        # routing + warm-state pull from the longest-lived peer); a
+        # no-fleet session skips all of it in one conf read
+        from . import fleet
+        try:
+            self._fleet_member = fleet.join(
+                self, gateway_addr=(srv.host, srv.port))
+        except Exception:
+            import logging
+            logging.getLogger(__name__).warning(
+                "fleet join failed; serving solo", exc_info=True)
+            self._fleet_member = None
         return srv
 
     def save_warm_pack(self, path: Optional[str] = None):
@@ -138,6 +151,13 @@ class TpuSession:
         return warm_pack.save(self.conf, path)
 
     def stop(self):
+        member = getattr(self, "_fleet_member", None)
+        if member is not None:
+            try:
+                member.leave()
+            except Exception:
+                pass
+            self._fleet_member = None
         cm = getattr(self, "_cluster", None)
         if cm is not None:
             cm.shutdown()
@@ -768,7 +788,10 @@ class DataFrame:
                 pass
             self._cached = None
         # uncache promises the NEXT action is a fresh execution — the
-        # cross-query result cache must not answer it from a prior run
+        # cross-query result cache must not answer it from a prior run,
+        # and in a fleet no PEER may either: invalidate_plan broadcasts
+        # the plan fingerprint to every live member (best-effort; the
+        # requester-side snapshot re-stat backstops a lost delivery)
         try:
             from .runtime import result_cache
             result_cache.invalidate_plan(self._plan)
@@ -918,10 +941,22 @@ class DataFrame:
                               hit.num_rows, _time.perf_counter() - t0)
                 return handle
 
+        # the admitted body runs on a QueryManager worker thread, so
+        # the submitter's fleet member (thread-local) must be captured
+        # HERE and re-entered there — a multi-member process would
+        # otherwise publish gateway B's results as member A
+        from .fleet import context as _fleet_ctx
+        member = _fleet_ctx.active_member()
+
         def run(handle):
-            return self._execute_action(
-                "collect", lambda root, ctx: _collect(root, ctx),
-                conf, handle, cache_token=token)
+            if member is None:
+                return self._execute_action(
+                    "collect", lambda root, ctx: _collect(root, ctx),
+                    conf, handle, cache_token=token)
+            with _fleet_ctx.scoped(member):
+                return self._execute_action(
+                    "collect", lambda root, ctx: _collect(root, ctx),
+                    conf, handle, cache_token=token)
 
         return mgr.submit(run, plan=self._plan, conf=conf,
                           action="collect", pool=pool, timeout=timeout)

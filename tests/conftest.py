@@ -68,6 +68,14 @@ def _drop_program_cache_per_module():
     # not steer another module's join planning
     from spark_rapids_tpu.plan import stats as _stats
     _stats.clear_calibration()
+    # fleet membership is process state backed by an on-disk peer
+    # directory (usually a tmp_path): leave the fleet, stop the peer
+    # cache server, and uninstall the result-cache dispatcher so a
+    # later module never consults a dead directory
+    import sys
+    if "spark_rapids_tpu.fleet" in sys.modules:
+        from spark_rapids_tpu import fleet
+        fleet.reset()
 
 
 @pytest.fixture(scope="session")

@@ -233,6 +233,33 @@ def report(events: List[dict], top: int = 0) -> str:
                              f"{fmt_bytes(e.get('bytes', 0))} in "
                              f"{int(e.get('entries', 0))} entries")
                 lines.append(line)
+            elif e["event"] == "fleet" and (
+                    e.get("peer_hits") or e.get("peer_misses")
+                    or e.get("publishes") or e.get("inv_broadcasts")
+                    or e.get("warm_pulls")):
+                line = (f"  fleet: {int(e.get('peer_hits', 0))} peer "
+                        f"hits / {int(e.get('peer_misses', 0))} peer "
+                        f"misses, {int(e.get('publishes', 0))} "
+                        f"published")
+                bad = (int(e.get("peer_fetch_failures", 0)),
+                       int(e.get("peer_stale_rejected", 0)))
+                if any(bad):
+                    line += (f"; {bad[0]} fetch failures, "
+                             f"{bad[1]} stale rejected")
+                if e.get("inv_broadcasts"):
+                    line += (f"; {int(e.get('inv_broadcasts', 0))} "
+                             f"invalidation broadcasts "
+                             f"({int(e.get('inv_broadcast_failures', 0))}"
+                             f" undelivered)")
+                if e.get("warm_pulls"):
+                    line += f"; warm state pulled"
+                if e.get("export_bytes") is not None:
+                    line += (f"; exporting "
+                             f"{fmt_bytes(e.get('export_bytes', 0))} in "
+                             f"{int(e.get('export_entries', 0))} "
+                             f"entries to "
+                             f"{int(e.get('peers_live', 0))} live peers")
+                lines.append(line)
         lines.append("")
     return "\n".join(lines)
 
